@@ -1,0 +1,20 @@
+"""kueue_oss_tpu — a TPU-native job-queueing & admission framework.
+
+Capabilities mirror the reference (hiboyang/kueue_oss, a Kueue fork):
+hierarchical quota over ClusterQueues/Cohorts with borrowing/lending limits,
+flavor-fungible admission, fair sharing (dominant resource share), priority and
+fair-sharing preemption, topology-aware placement, two-phase admission checks,
+and the surrounding queueing control plane.
+
+The defining difference: the per-cycle scheduling core (flavor assignment,
+cohort quota algebra, fair-sharing math, preemption search) is expressed twice:
+
+- ``kueue_oss_tpu.core`` / ``kueue_oss_tpu.scheduler``: a scalar Python
+  "oracle" implementation mirroring the reference semantics exactly
+  (used as correctness reference and fallback path), and
+- ``kueue_oss_tpu.solver``: a batched, jitted JAX/Pallas implementation over
+  dense [node x flavor-resource] tensors that solves whole scheduling cycles
+  on TPU, sharded over a ``jax.sharding.Mesh`` for multi-chip scale.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,488 @@
+"""Core API object model.
+
+These dataclasses are the in-memory analog of the reference's CRDs
+(reference: apis/kueue/v1beta2/clusterqueue_types.go, workload_types.go,
+cohort_types.go, resourceflavor_types.go, topology_types.go,
+admissioncheck_types.go). Field names follow the reference API surface so a
+Kueue user can map concepts 1:1; quantities are plain integers in canonical
+milli-units (cpu -> millicores, memory -> bytes, devices -> count*1000 is NOT
+used — devices are whole counts) to keep the tensor path integer-exact.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# Shared scalar types
+# ---------------------------------------------------------------------------
+
+#: (flavor_name, resource_name) — the key of every quota/usage map.
+#: Reference parity: pkg/resources/resource.go FlavorResource.
+FlavorResource = tuple[str, str]
+
+
+class QueueingStrategy:
+    """Reference parity: apis/kueue/v1beta2/clusterqueue_types.go:180."""
+
+    STRICT_FIFO = "StrictFIFO"
+    BEST_EFFORT_FIFO = "BestEffortFIFO"
+
+
+class StopPolicy:
+    """Reference parity: clusterqueue_types.go StopPolicy."""
+
+    NONE = "None"
+    HOLD = "Hold"
+    HOLD_AND_DRAIN = "HoldAndDrain"
+
+
+class PreemptionPolicyValue:
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+    LOWER_OR_NEWER_EQUAL_PRIORITY = "LowerOrNewerEqualPriority"
+    ANY = "Any"
+
+
+@dataclass
+class BorrowWithinCohort:
+    """Reference parity: clusterqueue_types.go BorrowWithinCohort (KEP-1337)."""
+
+    policy: str = PreemptionPolicyValue.NEVER  # Never | LowerPriority
+    max_priority_threshold: Optional[int] = None
+
+
+@dataclass
+class PreemptionPolicy:
+    """Reference parity: clusterqueue_types.go ClusterQueuePreemption (KEP-83)."""
+
+    within_cluster_queue: str = PreemptionPolicyValue.NEVER
+    reclaim_within_cohort: str = PreemptionPolicyValue.NEVER
+    borrow_within_cohort: BorrowWithinCohort = field(default_factory=BorrowWithinCohort)
+
+    @property
+    def any_enabled(self) -> bool:
+        return (
+            self.within_cluster_queue != PreemptionPolicyValue.NEVER
+            or self.reclaim_within_cohort != PreemptionPolicyValue.NEVER
+        )
+
+
+class FlavorFungibilityPolicy:
+    BORROW = "Borrow"
+    PREEMPT = "Preempt"
+    TRY_NEXT_FLAVOR = "TryNextFlavor"
+
+
+@dataclass
+class FlavorFungibility:
+    """Reference parity: clusterqueue_types.go:432-449 FlavorFungibility."""
+
+    when_can_borrow: str = FlavorFungibilityPolicy.BORROW
+    when_can_preempt: str = FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+
+
+@dataclass
+class FairSharing:
+    """Reference parity: fairsharing types; weight scales DRS down."""
+
+    weight: float = 1.0
+
+
+@dataclass
+class AdmissionScope:
+    """Reference parity: AdmissionScope for admission fair sharing (KEP-4136)."""
+
+    admission_mode: str = "UsageBasedAdmissionFairSharing"
+
+
+# ---------------------------------------------------------------------------
+# ResourceFlavor / Topology
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ResourceFlavor:
+    """Reference parity: resourceflavor_types.go."""
+
+    name: str
+    node_labels: dict[str, str] = field(default_factory=dict)
+    node_taints: list[Taint] = field(default_factory=list)
+    tolerations: list[Toleration] = field(default_factory=list)
+    #: Name of a Topology object enabling TAS for this flavor (KEP-2724).
+    topology_name: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if not self.key:
+            return self.operator == "Exists"
+        if self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+@dataclass
+class Topology:
+    """Reference parity: topology_types.go — ordered levels, broadest first
+    (e.g. ["cloud.google.com/topology-block", "...-rack", "kubernetes.io/hostname"]).
+    """
+
+    name: str
+    levels: list[str] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Quota model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ResourceQuota:
+    """Per (flavor, resource) quota on a CQ or Cohort.
+
+    Reference parity: clusterqueue_types.go ResourceQuota
+    {nominalQuota, borrowingLimit, lendingLimit}.
+    """
+
+    name: str  # resource name, e.g. "cpu"
+    nominal: int = 0
+    borrowing_limit: Optional[int] = None
+    lending_limit: Optional[int] = None
+
+
+@dataclass
+class FlavorQuotas:
+    name: str  # ResourceFlavor name
+    resources: list[ResourceQuota] = field(default_factory=list)
+
+
+@dataclass
+class ResourceGroup:
+    """A set of resources admitted together through an ordered flavor list.
+
+    Reference parity: clusterqueue_types.go ResourceGroup — coveredResources
+    must match the union of resources across flavors; flavor order is the
+    assignment preference order.
+    """
+
+    covered_resources: list[str] = field(default_factory=list)
+    flavors: list[FlavorQuotas] = field(default_factory=list)
+
+
+def iter_quotas(resource_groups: list[ResourceGroup]):
+    """Yield ((flavor, resource), ResourceQuota) across resource groups."""
+    for rg in resource_groups:
+        for fq in rg.flavors:
+            for rq in fq.resources:
+                yield (fq.name, rq.name), rq
+
+
+def _quota_for(resource_groups: list[ResourceGroup],
+               fr: FlavorResource) -> Optional[ResourceQuota]:
+    for key, rq in iter_quotas(resource_groups):
+        if key == fr:
+            return rq
+    return None
+
+
+# ---------------------------------------------------------------------------
+# ClusterQueue / Cohort / LocalQueue
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ClusterQueue:
+    name: str
+    cohort: Optional[str] = None
+    resource_groups: list[ResourceGroup] = field(default_factory=list)
+    queueing_strategy: str = QueueingStrategy.BEST_EFFORT_FIFO
+    preemption: PreemptionPolicy = field(default_factory=PreemptionPolicy)
+    flavor_fungibility: FlavorFungibility = field(default_factory=FlavorFungibility)
+    fair_sharing: FairSharing = field(default_factory=FairSharing)
+    admission_scope: Optional[AdmissionScope] = None
+    namespace_selector: Optional[dict[str, str]] = None  # None selects everything
+    admission_checks: list[str] = field(default_factory=list)
+    stop_policy: str = StopPolicy.NONE
+
+    def flavor_resources(self) -> list[FlavorResource]:
+        """All (flavor, resource) pairs this CQ defines quota for."""
+        return [key for key, _ in iter_quotas(self.resource_groups)]
+
+    def quota_for(self, fr: FlavorResource) -> Optional[ResourceQuota]:
+        return _quota_for(self.resource_groups, fr)
+
+
+@dataclass
+class Cohort:
+    """Reference parity: cohort_types.go (KEP-79 hierarchical cohorts).
+
+    Cohorts form a forest; they may carry their own quotas and fair-sharing
+    weight. A ClusterQueue names its (leaf-adjacent) cohort by string.
+    """
+
+    name: str
+    parent: Optional[str] = None
+    resource_groups: list[ResourceGroup] = field(default_factory=list)
+    fair_sharing: FairSharing = field(default_factory=FairSharing)
+
+    def quota_for(self, fr: FlavorResource) -> Optional[ResourceQuota]:
+        return _quota_for(self.resource_groups, fr)
+
+
+@dataclass
+class LocalQueue:
+    name: str
+    namespace: str = "default"
+    cluster_queue: str = ""
+    stop_policy: str = StopPolicy.NONE
+    fair_sharing: FairSharing = field(default_factory=FairSharing)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class WorkloadPriorityClass:
+    """Reference parity: workloadpriorityclass_types.go."""
+
+    name: str
+    value: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Workload
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodSetTopologyRequest:
+    """Reference parity: workload_types.go PodSetTopologyRequest (KEP-2724)."""
+
+    required: Optional[str] = None  # topology level that must contain the podset
+    preferred: Optional[str] = None  # level to try, falling back upward
+    unconstrained: bool = False
+    podset_group_name: Optional[str] = None
+    podset_slice_required_topology: Optional[str] = None
+    podset_slice_size: Optional[int] = None
+
+
+@dataclass
+class PodSet:
+    name: str = "main"
+    count: int = 1
+    #: per-pod requests in canonical units, e.g. {"cpu": 1000, "memory": 2<<30}
+    requests: dict[str, int] = field(default_factory=dict)
+    #: minimum acceptable count for partial admission (KEP-420); None disables.
+    min_count: Optional[int] = None
+    topology_request: Optional[PodSetTopologyRequest] = None
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: list[Toleration] = field(default_factory=list)
+
+    def total_requests(self) -> dict[str, int]:
+        return {r: q * self.count for r, q in self.requests.items()}
+
+
+# Condition types on Workload status.
+# Reference parity: workload_types.go condition constants.
+class WorkloadConditionType:
+    QUOTA_RESERVED = "QuotaReserved"
+    ADMITTED = "Admitted"
+    EVICTED = "Evicted"
+    PREEMPTED = "Preempted"
+    FINISHED = "Finished"
+    REQUEUED = "Requeued"
+    PODS_READY = "PodsReady"
+
+
+@dataclass
+class Condition:
+    type: str
+    status: bool
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class PodSetAssignment:
+    """Reference parity: workload_types.go PodSetAssignment."""
+
+    name: str
+    #: resource -> flavor name chosen for it
+    flavors: dict[str, str] = field(default_factory=dict)
+    #: total usage counted against the quota (resource -> quantity)
+    resource_usage: dict[str, int] = field(default_factory=dict)
+    count: int = 0
+    topology_assignment: Optional[TopologyAssignment] = None
+    delayed_topology_request: Optional[str] = None  # "Pending" | "Ready"
+
+
+@dataclass
+class TopologyDomainAssignment:
+    values: list[str] = field(default_factory=list)  # node label values per level
+    count: int = 0
+
+
+@dataclass
+class TopologyAssignment:
+    levels: list[str] = field(default_factory=list)
+    domains: list[TopologyDomainAssignment] = field(default_factory=list)
+
+
+@dataclass
+class Admission:
+    cluster_queue: str
+    podset_assignments: list[PodSetAssignment] = field(default_factory=list)
+
+
+class CheckState:
+    """Reference parity: workload_types.go CheckState (KEP-993)."""
+
+    PENDING = "Pending"
+    READY = "Ready"
+    RETRY = "Retry"
+    REJECTED = "Rejected"
+
+
+@dataclass
+class AdmissionCheckState:
+    name: str
+    state: str = CheckState.PENDING
+    message: str = ""
+
+
+@dataclass
+class RequeueState:
+    """Reference parity: workload_types.go RequeueState — eviction backoff."""
+
+    count: int = 0
+    requeue_at: Optional[float] = None
+
+
+@dataclass
+class WorkloadSchedulingStatsEviction:
+    reason: str
+    underlying_cause: str = ""
+    count: int = 0
+
+
+@dataclass
+class WorkloadStatus:
+    conditions: dict[str, Condition] = field(default_factory=dict)
+    admission: Optional[Admission] = None
+    admission_checks: dict[str, AdmissionCheckState] = field(default_factory=dict)
+    requeue_state: Optional[RequeueState] = None
+    eviction_stats: list[WorkloadSchedulingStatsEviction] = field(default_factory=list)
+
+
+_uid_counter = itertools.count(1)
+
+
+@dataclass
+class Workload:
+    name: str
+    namespace: str = "default"
+    queue_name: str = ""  # LocalQueue name
+    priority: int = 0
+    priority_class: Optional[str] = None
+    podsets: list[PodSet] = field(default_factory=list)
+    #: spec.active=false deactivates the workload (reference: workload_types.go Active)
+    active: bool = True
+    creation_time: float = 0.0
+    uid: int = 0
+    #: maximum execution time in seconds; None = unlimited
+    max_execution_time: Optional[float] = None
+    status: WorkloadStatus = field(default_factory=WorkloadStatus)
+
+    def __post_init__(self) -> None:
+        if self.uid == 0:
+            self.uid = next(_uid_counter)
+        if not self.podsets:
+            self.podsets = [PodSet()]
+
+    # -- status helpers (reference parity: pkg/workload/workload.go) --------
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def condition(self, ctype: str) -> Optional[Condition]:
+        return self.status.conditions.get(ctype)
+
+    def has_condition(self, ctype: str) -> bool:
+        c = self.status.conditions.get(ctype)
+        return c is not None and c.status
+
+    def set_condition(self, ctype: str, status: bool, reason: str = "",
+                      message: str = "", now: float = 0.0) -> None:
+        # last_transition_time only moves when status actually flips
+        # (reference parity: apimeta.SetStatusCondition semantics).
+        prev = self.status.conditions.get(ctype)
+        if prev is not None and prev.status == status:
+            now = prev.last_transition_time
+        self.status.conditions[ctype] = Condition(
+            type=ctype, status=status, reason=reason, message=message,
+            last_transition_time=now)
+
+    @property
+    def is_quota_reserved(self) -> bool:
+        return self.has_condition(WorkloadConditionType.QUOTA_RESERVED)
+
+    @property
+    def is_admitted(self) -> bool:
+        return self.has_condition(WorkloadConditionType.ADMITTED)
+
+    @property
+    def is_finished(self) -> bool:
+        return self.has_condition(WorkloadConditionType.FINISHED)
+
+    @property
+    def is_evicted(self) -> bool:
+        return self.has_condition(WorkloadConditionType.EVICTED)
+
+    def total_requests(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for ps in self.podsets:
+            for r, q in ps.total_requests().items():
+                out[r] = out.get(r, 0) + q
+        return out
+
+
+# ---------------------------------------------------------------------------
+# AdmissionCheck
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AdmissionCheck:
+    """Reference parity: admissioncheck_types.go (KEP-993)."""
+
+    name: str
+    controller_name: str = ""
+    parameters: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class AdmissionCheckStatus:
+    active: bool = True

@@ -1,0 +1,4 @@
+"""Core state layer: hierarchical quota algebra, caches, queue manager.
+
+Reference parity: pkg/cache/{scheduler,queue,hierarchy} of hiboyang/kueue_oss.
+"""

@@ -1,0 +1,246 @@
+"""Quota-algebra oracle tests.
+
+Scenarios mirror the semantics of the reference's resource-node algebra
+(pkg/cache/scheduler/resource_node.go) and fair sharing
+(pkg/cache/scheduler/fair_sharing.go): borrowing, lending limits, borrowing
+limits, usage bubbling, hierarchical cohorts, and DRS.
+"""
+
+import random
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FairSharing,
+    FlavorQuotas,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_oss_tpu.core.quota import (
+    QuotaForest,
+    CohortCycleError,
+    compare_drs,
+    dominant_resource_share,
+)
+
+CPU = ("default", "cpu")
+
+
+def make_cq(name, nominal, cohort=None, borrowing_limit=None, lending_limit=None,
+            weight=1.0, flavor="default", resource="cpu"):
+    return ClusterQueue(
+        name=name,
+        cohort=cohort,
+        fair_sharing=FairSharing(weight=weight),
+        resource_groups=[
+            ResourceGroup(
+                covered_resources=[resource],
+                flavors=[
+                    FlavorQuotas(
+                        name=flavor,
+                        resources=[
+                            ResourceQuota(
+                                name=resource,
+                                nominal=nominal,
+                                borrowing_limit=borrowing_limit,
+                                lending_limit=lending_limit,
+                            )
+                        ],
+                    )
+                ],
+            )
+        ],
+    )
+
+
+def build(cqs, cohorts=(), usage=None):
+    f = QuotaForest()
+    f.build(cqs, cohorts, cq_usage=usage)
+    return f
+
+
+class TestStandalone:
+    def test_available_is_nominal_minus_usage(self):
+        f = build([make_cq("a", 10)], usage={"a": {CPU: 3}})
+        assert f.cqs["a"].available(CPU) == 7
+
+    def test_overadmission_goes_negative(self):
+        f = build([make_cq("a", 10)], usage={"a": {CPU: 12}})
+        assert f.cqs["a"].available(CPU) == -2
+
+    def test_potential_available(self):
+        f = build([make_cq("a", 10)], usage={"a": {CPU: 9}})
+        assert f.cqs["a"].potential_available(CPU) == 10
+
+
+class TestCohortBorrowing:
+    def test_borrow_unused_sibling_quota(self):
+        f = build([make_cq("a", 10, "co"), make_cq("b", 10, "co")])
+        assert f.cqs["a"].available(CPU) == 20
+
+    def test_sibling_usage_reduces_borrowable(self):
+        f = build(
+            [make_cq("a", 10, "co"), make_cq("b", 10, "co")],
+            usage={"b": {CPU: 6}},
+        )
+        assert f.cqs["a"].available(CPU) == 14
+
+    def test_borrowing_limit_caps_available(self):
+        f = build([make_cq("a", 10, "co", borrowing_limit=3), make_cq("b", 10, "co")])
+        assert f.cqs["a"].available(CPU) == 13
+
+    def test_lending_limit_hides_capacity_from_cohort(self):
+        # b lends at most 4 of its 10; a sees 10 + 4.
+        f = build([make_cq("a", 10, "co"), make_cq("b", 10, "co", lending_limit=4)])
+        assert f.cqs["a"].available(CPU) == 14
+        # b sees its local 6 plus everything in the cohort (4 lent + a's 10).
+        assert f.cqs["b"].available(CPU) == 20
+
+    def test_lending_limit_detailed(self):
+        f = build([make_cq("a", 10, "co"), make_cq("b", 10, "co", lending_limit=4)])
+        b = f.cqs["b"]
+        # b's local quota: 6 never visible to cohort; cohort subtree = 10(a) + 4(b).
+        assert b.local_quota(CPU) == 6
+        root = b.root()
+        assert root.subtree_quota[CPU] == 14
+        assert b.available(CPU) == 6 + 14
+
+    def test_lending_limit_usage_bubbling(self):
+        f = build(
+            [make_cq("a", 10, "co"), make_cq("b", 10, "co", lending_limit=4)],
+            usage={"b": {CPU: 8}},
+        )
+        b = f.cqs["b"]
+        root = b.root()
+        # usage above local quota (6) bubbles: cohort sees 2.
+        assert root.usage[CPU] == 2
+        assert f.cqs["a"].available(CPU) == 12
+
+    def test_borrowing_limit_with_own_usage_in_parent(self):
+        # a uses 12 (2 borrowed); borrowing_limit 5 leaves 3 more borrowable.
+        f = build(
+            [make_cq("a", 10, "co", borrowing_limit=5), make_cq("b", 10, "co")],
+            usage={"a": {CPU: 12}},
+        )
+        assert f.cqs["a"].available(CPU) == 3
+
+
+class TestHierarchy:
+    def test_three_level_tree_with_cohort_quota(self):
+        cohorts = [
+            Cohort(name="root"),
+            Cohort(name="left", parent="root"),
+            Cohort(
+                name="right",
+                parent="root",
+                resource_groups=[
+                    ResourceGroup(
+                        covered_resources=["cpu"],
+                        flavors=[
+                            FlavorQuotas(
+                                name="default",
+                                resources=[ResourceQuota(name="cpu", nominal=5)],
+                            )
+                        ],
+                    )
+                ],
+            ),
+        ]
+        cqs = [make_cq("a", 10, "left"), make_cq("b", 10, "right")]
+        f = build(cqs, cohorts)
+        # a can reach its 10, b's 10, and right's 5.
+        assert f.cqs["a"].available(CPU) == 25
+        assert f.cqs["b"].available(CPU) == 25
+
+    def test_cycle_detection(self):
+        cohorts = [Cohort(name="x", parent="y"), Cohort(name="y", parent="x")]
+        try:
+            build([make_cq("a", 1, "x")], cohorts)
+            raise AssertionError("expected cycle error")
+        except CohortCycleError:
+            pass
+
+    def test_incremental_usage_matches_recompute(self):
+        """add_usage/remove_usage bubbling preserves the bottom-up invariant."""
+        rng = random.Random(7)
+        cohorts = [Cohort(name="root"), Cohort(name="l", parent="root"),
+                   Cohort(name="r", parent="root")]
+        cqs = [
+            make_cq("a", 10, "l", lending_limit=5),
+            make_cq("b", 20, "l"),
+            make_cq("c", 15, "r", borrowing_limit=10),
+            make_cq("d", 5, "r", lending_limit=0),
+        ]
+        f = build(cqs, cohorts)
+        names = ["a", "b", "c", "d"]
+        balance = {n: [] for n in names}
+        for _ in range(300):
+            n = rng.choice(names)
+            if balance[n] and rng.random() < 0.45:
+                amt = balance[n].pop()
+                f.cqs[n].remove_usage(CPU, amt)
+            else:
+                amt = rng.randint(1, 12)
+                balance[n].append(amt)
+                f.cqs[n].add_usage(CPU, amt)
+            # Snapshot incremental state, then recompute from scratch and diff.
+            inc = {k: dict(v.usage) for k, v in f.nodes.items()}
+            g = build(cqs, cohorts,
+                      usage={n: dict(f.cqs[n].usage) for n in names})
+            for k, node in g.nodes.items():
+                keys = set(node.usage) | set(inc[k])
+                for fr in keys:
+                    assert inc[k].get(fr, 0) == node.usage.get(fr, 0), (k, fr)
+
+
+class TestDRS:
+    def test_no_parent_is_zero(self):
+        f = build([make_cq("a", 10)], usage={"a": {CPU: 20}})
+        assert dominant_resource_share(f.cqs["a"]).is_zero
+
+    def test_not_borrowing_is_zero(self):
+        f = build([make_cq("a", 10, "co"), make_cq("b", 10, "co")],
+                  usage={"a": {CPU: 10}})
+        assert dominant_resource_share(f.cqs["a"]).is_zero
+
+    def test_borrowing_ratio(self):
+        f = build([make_cq("a", 10, "co"), make_cq("b", 10, "co")],
+                  usage={"a": {CPU: 15}})
+        drs = dominant_resource_share(f.cqs["a"])
+        # borrowed 5 of 20 lendable -> 250 (permille)
+        assert drs.unweighted_ratio == 250.0
+        assert drs.dominant_resource == "cpu"
+        assert drs.borrowing
+
+    def test_hypothetical_workload_usage(self):
+        f = build([make_cq("a", 10, "co"), make_cq("b", 10, "co")],
+                  usage={"a": {CPU: 8}})
+        drs = dominant_resource_share(f.cqs["a"], {CPU: 6})
+        assert drs.unweighted_ratio == 200.0  # (8+6-10)/20
+
+    def test_weight_scales_share(self):
+        f = build(
+            [make_cq("a", 10, "co", weight=2.0), make_cq("b", 10, "co")],
+            usage={"a": {CPU: 15}},
+        )
+        drs = dominant_resource_share(f.cqs["a"])
+        assert drs.precise_weighted_share() == 125.0
+
+    def test_zero_weight_borrower_sorts_last(self):
+        f = build(
+            [make_cq("a", 10, "co", weight=0.0), make_cq("b", 10, "co")],
+            usage={"a": {CPU: 11}, "b": {CPU: 19}},
+        )
+        a = dominant_resource_share(f.cqs["a"])
+        b = dominant_resource_share(f.cqs["b"])
+        assert compare_drs(a, b) > 0  # zero-weight borrower "worse" (preempt first)
+        assert a.rounded_weighted_share() == (1 << 63) - 1
+
+    def test_compare_prefers_lower_share(self):
+        f = build(
+            [make_cq("a", 10, "co"), make_cq("b", 10, "co"), make_cq("c", 20, "co")],
+            usage={"a": {CPU: 12}, "b": {CPU: 18}},
+        )
+        a = dominant_resource_share(f.cqs["a"])
+        b = dominant_resource_share(f.cqs["b"])
+        assert compare_drs(a, b) < 0
