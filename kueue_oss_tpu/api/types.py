@@ -76,12 +76,18 @@ class FlavorFungibilityPolicy:
     TRY_NEXT_FLAVOR = "TryNextFlavor"
 
 
+class FlavorFungibilityPreference:
+    BORROWING_OVER_PREEMPTION = "BorrowingOverPreemption"
+    PREEMPTION_OVER_BORROWING = "PreemptionOverBorrowing"
+
+
 @dataclass
 class FlavorFungibility:
     """Reference parity: clusterqueue_types.go:432-449 FlavorFungibility."""
 
     when_can_borrow: str = FlavorFungibilityPolicy.BORROW
     when_can_preempt: str = FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+    preference: Optional[str] = None  # FlavorFungibilityPreference
 
 
 @dataclass

@@ -1,0 +1,271 @@
+"""Pending-workload queue manager.
+
+Reference parity: pkg/cache/queue/manager.go + cluster_queue.go —
+per-ClusterQueue heaps ordered by (priority desc, queue-order timestamp asc,
+uid), StrictFIFO vs BestEffortFIFO requeue behavior, inadmissible-workload
+parking, and cohort-scoped flushing when capacity frees up.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Iterable, Optional
+
+from kueue_oss_tpu.api.types import QueueingStrategy, StopPolicy, Workload
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.core.workload_info import (
+    WorkloadInfo,
+    effective_priority,
+    queue_order_timestamp,
+)
+
+
+class RequeueReason:
+    """Reference parity: pkg/cache/queue RequeueReason values."""
+
+    GENERIC = "Generic"
+    FAILED_AFTER_NOMINATION = "FailedAfterNomination"
+    PENDING_PREEMPTION = "PendingPreemption"
+    PREEMPTION_FAILED = "PreemptionFailed"
+    NAMESPACE_MISMATCH = "NamespaceMismatch"
+
+
+def _order_key(info: WorkloadInfo) -> tuple:
+    # Higher priority first, then FIFO on the eviction-aware timestamp.
+    return (-effective_priority(info.obj), queue_order_timestamp(info.obj),
+            info.obj.uid)
+
+
+class ClusterQueuePendingQueue:
+    """Heap + inadmissible parking for one ClusterQueue."""
+
+    def __init__(self, name: str, strategy: str) -> None:
+        self.name = name
+        self.strategy = strategy
+        self._heap: list[tuple[tuple, int, WorkloadInfo]] = []
+        self._in_heap: dict[str, WorkloadInfo] = {}
+        self._counter = itertools.count()
+        self.inadmissible: dict[str, WorkloadInfo] = {}
+        #: cycle at which inadmissible workloads were last re-queued
+        self.queue_inadmissible_cycle = -1
+        self.active = True
+
+    def __len__(self) -> int:
+        return len(self._heap) + len(self.inadmissible)
+
+    @property
+    def pending_active(self) -> int:
+        return len(self._in_heap)
+
+    @property
+    def pending_inadmissible(self) -> int:
+        return len(self.inadmissible)
+
+    def push(self, info: WorkloadInfo) -> None:
+        self.inadmissible.pop(info.key, None)
+        if info.key in self._in_heap:
+            # Re-push with fresh ordering (priority/timestamps may change).
+            self.delete(info.key)
+        self._in_heap[info.key] = info
+        heapq.heappush(self._heap, (_order_key(info), next(self._counter), info))
+
+    def pop_head(self) -> Optional[WorkloadInfo]:
+        while self._heap:
+            _, _, info = heapq.heappop(self._heap)
+            if self._in_heap.get(info.key) is info:
+                del self._in_heap[info.key]
+                return info
+        return None
+
+    def delete(self, key: str) -> None:
+        self._in_heap.pop(key, None)
+        self.inadmissible.pop(key, None)
+
+    def requeue_if_not_present(self, info: WorkloadInfo, reason: str,
+                               pop_cycle: int = -1) -> bool:
+        """Requeue semantics (reference: cluster_queue.go requeueIfNotPresent).
+
+        StrictFIFO always goes back to the heap (the head blocks the queue).
+        BestEffortFIFO parks generically-inadmissible workloads until an
+        event in the cohort frees capacity; scheduling-affecting reasons go
+        straight back to the heap. A capacity-freed flush that fired after
+        this workload was popped (queue_inadmissible_cycle >= pop_cycle)
+        also sends it to the heap, so mid-cycle events aren't lost.
+        """
+        if info.key in self._in_heap or info.key in self.inadmissible:
+            return False
+        if (self.strategy == QueueingStrategy.STRICT_FIFO
+                or reason != RequeueReason.GENERIC
+                or (pop_cycle >= 0
+                    and self.queue_inadmissible_cycle >= pop_cycle)):
+            self.push(info)
+            return True
+        self.inadmissible[info.key] = info
+        return False
+
+    def queue_inadmissible(self, cycle: int) -> bool:
+        """Move all parked workloads back into the heap."""
+        if not self.inadmissible:
+            self.queue_inadmissible_cycle = cycle
+            return False
+        parked = list(self.inadmissible.values())
+        self.inadmissible.clear()
+        for info in parked:
+            self.push(info)
+        self.queue_inadmissible_cycle = cycle
+        return True
+
+
+class QueueManager:
+    """Reference parity: pkg/cache/queue/manager.go."""
+
+    def __init__(self, store: Store) -> None:
+        self.store = store
+        self.queues: dict[str, ClusterQueuePendingQueue] = {}
+        self.cycle = 0
+        for cq in store.cluster_queues.values():
+            self.add_cluster_queue(cq.name)
+        store.watch(self._on_event)
+
+    # -- CQ lifecycle ------------------------------------------------------
+
+    def add_cluster_queue(self, name: str) -> None:
+        spec = self.store.cluster_queues[name]
+        if name not in self.queues:
+            self.queues[name] = ClusterQueuePendingQueue(
+                name, spec.queueing_strategy)
+        q = self.queues[name]
+        q.strategy = spec.queueing_strategy
+        q.active = spec.stop_policy == StopPolicy.NONE
+
+    def _on_event(self, event) -> None:
+        verb, kind, obj = event
+        if kind == "ClusterQueue":
+            self.add_cluster_queue(obj.name)
+            self.queues[obj.name].queue_inadmissible(self.cycle)
+        elif kind == "Workload":
+            if verb in ("add", "update"):
+                self.add_or_update_workload(obj)
+            elif verb == "delete":
+                cq = self._cq_for(obj)
+                if cq is not None:
+                    self.queues[cq].delete(obj.key)
+                    self.flush_cohort_for(cq)
+
+    # -- workload flow -----------------------------------------------------
+
+    def _cq_for(self, wl: Workload) -> Optional[str]:
+        cq = self.store.cluster_queue_for(wl)
+        if cq is None and wl.status.admission is not None:
+            cq = wl.status.admission.cluster_queue
+        return cq if cq in self.queues else None
+
+    def add_or_update_workload(self, wl: Workload) -> bool:
+        """Queue a workload if it is pending (active, no quota reserved)."""
+        cq = self._cq_for(wl)
+        if cq is None:
+            return False
+        if not wl.active or wl.is_quota_reserved or wl.is_finished:
+            self.queues[cq].delete(wl.key)
+            return False
+        self.queues[cq].push(WorkloadInfo(wl, cluster_queue=cq))
+        return True
+
+    def requeue_workload(self, info: WorkloadInfo, reason: str) -> bool:
+        """Re-fetch latest object state and requeue (manager.go:645)."""
+        wl = self.store.workloads.get(info.key)
+        if wl is None or not wl.active or wl.is_quota_reserved or wl.is_finished:
+            return False
+        fresh = WorkloadInfo(wl, cluster_queue=info.cluster_queue)
+        fresh.last_assignment = info.last_assignment
+        q = self.queues.get(info.cluster_queue)
+        if q is None:
+            return False
+        return q.requeue_if_not_present(
+            fresh, reason, pop_cycle=getattr(info, "pop_cycle", -1))
+
+    def delete_workload(self, wl: Workload) -> None:
+        cq = self._cq_for(wl)
+        if cq is not None:
+            self.queues[cq].delete(wl.key)
+
+    # -- heads -------------------------------------------------------------
+
+    def heads(self) -> list[WorkloadInfo]:
+        """Pop the head of every active ClusterQueue (one per CQ).
+
+        Non-popped entries stay; non-admitted heads must be requeued by the
+        scheduler (mirrors Heads+requeue contract of the reference cycle).
+        """
+        self.cycle += 1
+        out: list[WorkloadInfo] = []
+        for q in self.queues.values():
+            if not q.active:
+                continue
+            head = q.pop_head()
+            if head is not None:
+                head.pop_cycle = self.cycle
+                out.append(head)
+        return out
+
+    def has_pending(self) -> bool:
+        return any(len(q._in_heap) > 0 for q in self.queues.values() if q.active)
+
+    def pending_counts(self) -> dict[str, tuple[int, int]]:
+        return {
+            name: (q.pending_active, q.pending_inadmissible)
+            for name, q in self.queues.items()
+        }
+
+    # -- capacity-freed events ---------------------------------------------
+
+    def _cohort_members(self, cq_name: str) -> Iterable[str]:
+        spec = self.store.cluster_queues.get(cq_name)
+        if spec is None or not spec.cohort:
+            return [cq_name]
+        # All CQs sharing the cohort forest root with cq_name.
+        roots: dict[str, str] = {}
+
+        def root_of(cohort_name: str, seen=None) -> str:
+            if cohort_name in roots:
+                return roots[cohort_name]
+            seen = seen or set()
+            cur = cohort_name
+            while True:
+                if cur in seen:
+                    break
+                seen.add(cur)
+                spec_c = self.store.cohorts.get(cur)
+                if spec_c is None or not spec_c.parent:
+                    break
+                cur = spec_c.parent
+            roots[cohort_name] = cur
+            return cur
+
+        my_root = root_of(spec.cohort)
+        return [
+            name for name, other in self.store.cluster_queues.items()
+            if other.cohort and root_of(other.cohort) == my_root
+        ]
+
+    def flush_cohort_for(self, cq_name: str) -> None:
+        """Re-queue inadmissible workloads across the whole cohort.
+
+        Called when capacity may have freed (workload finished/evicted) —
+        reference: QueueAssociatedInadmissibleWorkloadsAfter.
+        """
+        for member in self._cohort_members(cq_name):
+            q = self.queues.get(member)
+            if q is not None:
+                q.queue_inadmissible(self.cycle)
+
+    def report_workload_finished(self, wl: Workload) -> None:
+        cq = self._cq_for(wl)
+        if cq is not None:
+            self.flush_cohort_for(cq)
+
+    def report_workload_evicted(self, wl: Workload) -> None:
+        cq = self._cq_for(wl)
+        if cq is not None:
+            self.flush_cohort_for(cq)

@@ -127,6 +127,10 @@ class QuotaNode:
             out.append(out[-1].parent)
         return out
 
+    def borrowing_with(self, fr: FlavorResource, val: int) -> bool:
+        """Whether usage + val would exceed this node's subtree quota."""
+        return self.usage.get(fr, 0) + val > self.subtree_quota.get(fr, 0)
+
     def fits(self, requests: dict[FlavorResource, int]) -> bool:
         """Whether requests fit in available capacity along the whole chain."""
         return all(v <= self.available(fr) for fr, v in requests.items())

@@ -1,0 +1,284 @@
+"""Per-cycle scheduling snapshot over the quota forest.
+
+Reference parity: pkg/cache/scheduler/snapshot.go, clusterqueue_snapshot.go,
+cohort_snapshot.go. The snapshot is built once per scheduling cycle and then
+mutated freely (usage simulation, workload removal) without affecting the
+authoritative store; the TPU solver exports its tensors from this object.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, Optional
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorResource,
+    ResourceFlavor,
+    ResourceQuota,
+)
+from kueue_oss_tpu.core.quota import (
+    DRS,
+    QuotaForest,
+    QuotaNode,
+    dominant_resource_share,
+)
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.core.workload_info import WorkloadInfo
+
+
+class CohortSnapshot:
+    """A cohort node plus navigation to child CQ snapshots."""
+
+    def __init__(self, node: QuotaNode, snapshot: "Snapshot") -> None:
+        self.node = node
+        self._snapshot = snapshot
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def has_parent(self) -> bool:
+        return self.node.parent is not None
+
+    def parent(self) -> Optional["CohortSnapshot"]:
+        if self.node.parent is None:
+            return None
+        return self._snapshot.cohort_snapshot(self.node.parent)
+
+    def root(self) -> "CohortSnapshot":
+        return self._snapshot.cohort_snapshot(self.node.root())
+
+    def child_cohorts(self) -> list["CohortSnapshot"]:
+        return [
+            self._snapshot.cohort_snapshot(c)
+            for c in self.node.children.values()
+            if not c.is_cq
+        ]
+
+    def child_cqs(self) -> list["ClusterQueueSnapshot"]:
+        return [
+            self._snapshot.cq_for_node(c)
+            for c in self.node.children.values()
+            if c.is_cq
+        ]
+
+    def child_count(self) -> int:
+        return len(self.node.children)
+
+    def subtree_cluster_queues(self) -> Iterator["ClusterQueueSnapshot"]:
+        for cq in self.child_cqs():
+            yield cq
+        for coh in self.child_cohorts():
+            yield from coh.subtree_cluster_queues()
+
+    def is_within_nominal(self, frs: Iterable[FlavorResource]) -> bool:
+        return self.node.is_within_nominal(frs)
+
+    def borrowing_with(self, fr: FlavorResource, val: int) -> bool:
+        return self.node.borrowing_with(fr, val)
+
+    def dominant_resource_share(self) -> DRS:
+        return dominant_resource_share(self.node)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, CohortSnapshot) and other.node is self.node
+
+    def __hash__(self) -> int:
+        return id(self.node)
+
+
+class ClusterQueueSnapshot:
+    """Reference parity: pkg/cache/scheduler/clusterqueue_snapshot.go."""
+
+    def __init__(self, spec: ClusterQueue, node: QuotaNode,
+                 snapshot: "Snapshot", generation: int) -> None:
+        self.spec = spec
+        self.name = spec.name
+        self.node = node
+        self._snapshot = snapshot
+        self.generation = generation
+        #: admitted workloads (holding quota) by workload key
+        self.workloads: dict[str, WorkloadInfo] = {}
+
+    # -- hierarchy ---------------------------------------------------------
+
+    def has_parent(self) -> bool:
+        return self.node.parent is not None
+
+    def parent(self) -> Optional[CohortSnapshot]:
+        if self.node.parent is None:
+            return None
+        return self._snapshot.cohort_snapshot(self.node.parent)
+
+    def path_parent_to_root(self) -> Iterator[CohortSnapshot]:
+        cur = self.node.parent
+        while cur is not None:
+            yield self._snapshot.cohort_snapshot(cur)
+            cur = cur.parent
+
+    # -- quota queries -----------------------------------------------------
+
+    def quota_for(self, fr: FlavorResource) -> ResourceQuota:
+        q = self.node.quotas.get(fr)
+        return q if q is not None else ResourceQuota(name=fr[1], nominal=0)
+
+    def available(self, fr: FlavorResource) -> int:
+        return self.node.available(fr)
+
+    def potential_available(self, fr: FlavorResource) -> int:
+        return self.node.potential_available(fr)
+
+    def borrowing(self, fr: FlavorResource) -> bool:
+        return self.node.usage.get(fr, 0) > self.node.subtree_quota.get(fr, 0)
+
+    def borrowing_with(self, fr: FlavorResource, val: int) -> bool:
+        return self.node.borrowing_with(fr, val)
+
+    def is_within_nominal(self, frs: Iterable[FlavorResource]) -> bool:
+        return self.node.is_within_nominal(frs)
+
+    def fits(self, usage: dict[FlavorResource, int]) -> bool:
+        return self.node.fits(usage)
+
+    def rg_by_resource(self, resource: str):
+        for rg in self.spec.resource_groups:
+            if resource in rg.covered_resources:
+                return rg
+        return None
+
+    # -- usage mutation ----------------------------------------------------
+
+    def add_usage(self, usage: dict[FlavorResource, int]) -> None:
+        for fr, v in usage.items():
+            self.node.add_usage(fr, v)
+
+    def remove_usage(self, usage: dict[FlavorResource, int]) -> None:
+        for fr, v in usage.items():
+            self.node.remove_usage(fr, v)
+
+    def simulate_usage_addition(
+        self, usage: dict[FlavorResource, int]
+    ) -> Callable[[], None]:
+        self.add_usage(usage)
+        return lambda: self.remove_usage(usage)
+
+    def simulate_usage_removal(
+        self, usage: dict[FlavorResource, int]
+    ) -> Callable[[], None]:
+        self.remove_usage(usage)
+        return lambda: self.add_usage(usage)
+
+    # -- fair sharing ------------------------------------------------------
+
+    def fair_weight(self) -> float:
+        return self.spec.fair_sharing.weight
+
+    def dominant_resource_share(
+        self, wl_req: Optional[dict[FlavorResource, int]] = None
+    ) -> DRS:
+        return dominant_resource_share(self.node, wl_req)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, ClusterQueueSnapshot) and other.node is self.node
+
+    def __hash__(self) -> int:
+        return id(self.node)
+
+    def __repr__(self) -> str:
+        return f"CQSnapshot({self.name})"
+
+
+class Snapshot:
+    """Whole-cluster scheduling snapshot."""
+
+    def __init__(
+        self,
+        forest: QuotaForest,
+        cluster_queues: dict[str, ClusterQueueSnapshot],
+        resource_flavors: dict[str, ResourceFlavor],
+        inactive_cluster_queues: frozenset[str] = frozenset(),
+    ) -> None:
+        self.forest = forest
+        self.cluster_queues = cluster_queues
+        self.resource_flavors = resource_flavors
+        self.inactive_cluster_queues = inactive_cluster_queues
+        self._cohort_snapshots: dict[int, CohortSnapshot] = {}
+        self._node_to_cq: dict[int, ClusterQueueSnapshot] = {
+            id(cq.node): cq for cq in cluster_queues.values()
+        }
+
+    def cluster_queue(self, name: str) -> Optional[ClusterQueueSnapshot]:
+        return self.cluster_queues.get(name)
+
+    def cq_for_node(self, node: QuotaNode) -> ClusterQueueSnapshot:
+        return self._node_to_cq[id(node)]
+
+    def cohort_snapshot(self, node: QuotaNode) -> CohortSnapshot:
+        cs = self._cohort_snapshots.get(id(node))
+        if cs is None:
+            cs = CohortSnapshot(node, self)
+            self._cohort_snapshots[id(node)] = cs
+        return cs
+
+    # -- workload add/remove (preemption simulation) -----------------------
+
+    def remove_workload(self, info: WorkloadInfo) -> None:
+        cq = self.cluster_queues[info.cluster_queue]
+        cq.workloads.pop(info.key, None)
+        cq.remove_usage(info.usage())
+
+    def add_workload(self, info: WorkloadInfo) -> None:
+        cq = self.cluster_queues[info.cluster_queue]
+        cq.workloads[info.key] = info
+        cq.add_usage(info.usage())
+
+    def simulate_workload_removal(
+        self, infos: list[WorkloadInfo]
+    ) -> Callable[[], None]:
+        """Remove only the usage (not queue membership); O(1) revert."""
+        for info in infos:
+            self.cluster_queues[info.cluster_queue].remove_usage(info.usage())
+
+        def revert() -> None:
+            for info in infos:
+                self.cluster_queues[info.cluster_queue].add_usage(info.usage())
+
+        return revert
+
+
+def build_snapshot(store: Store) -> Snapshot:
+    """Build a cycle snapshot from the store's current state."""
+    forest = QuotaForest()
+    forest.build(store.cluster_queues.values(), store.cohorts.values())
+
+    cqs: dict[str, ClusterQueueSnapshot] = {}
+    snapshot = Snapshot(
+        forest,
+        cqs,
+        dict(store.resource_flavors),
+        inactive_cluster_queues=frozenset(
+            name for name, cq in store.cluster_queues.items()
+            if cq.stop_policy != "None"
+        ),
+    )
+    for name, spec in store.cluster_queues.items():
+        cqs[name] = ClusterQueueSnapshot(
+            spec, forest.cqs[name], snapshot,
+            generation=store.cq_generation.get(name, 0),
+        )
+    snapshot._node_to_cq = {id(cq.node): cq for cq in cqs.values()}
+
+    for wl in store.admitted_workloads():
+        # Admitted usage is charged to the CQ recorded in the admission,
+        # not the LocalQueue's current target (reference: workload.go:299) —
+        # repointing a LocalQueue must not move already-admitted usage.
+        cq_name = None
+        if wl.status.admission is not None:
+            cq_name = wl.status.admission.cluster_queue
+        if cq_name is None:
+            cq_name = store.cluster_queue_for(wl)
+        if cq_name is None or cq_name not in cqs:
+            continue
+        info = WorkloadInfo(wl, cluster_queue=cq_name)
+        snapshot.add_workload(info)
+    return snapshot

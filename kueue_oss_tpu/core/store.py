@@ -1,0 +1,125 @@
+"""In-memory object store — the control-plane state backing.
+
+The reference persists all state as CRDs in etcd behind an apiserver
+(SURVEY.md §5 checkpoint/resume: the store is the only source of truth, and
+caches rebuild from watches). Here the store is an in-process dict-of-objects
+with the same contract: everything durable lives on the objects' status; the
+scheduler and controllers read/write through it, and watchers can subscribe
+to change events.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+from kueue_oss_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueue,
+    Cohort,
+    LocalQueue,
+    ResourceFlavor,
+    Topology,
+    Workload,
+    WorkloadPriorityClass,
+)
+
+Event = tuple[str, str, object]  # (verb, kind, obj)
+
+
+class Store:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.cluster_queues: dict[str, ClusterQueue] = {}
+        self.cohorts: dict[str, Cohort] = {}
+        self.local_queues: dict[str, LocalQueue] = {}  # key "ns/name"
+        self.resource_flavors: dict[str, ResourceFlavor] = {}
+        self.topologies: dict[str, Topology] = {}
+        self.admission_checks: dict[str, AdmissionCheck] = {}
+        self.priority_classes: dict[str, WorkloadPriorityClass] = {}
+        self.workloads: dict[str, Workload] = {}  # key "ns/name"
+        self.namespaces: dict[str, dict[str, str]] = {"default": {}}
+        #: bumped whenever a CQ's quota config changes; invalidates flavor cursors
+        self.cq_generation: dict[str, int] = {}
+        self._watchers: list[Callable[[Event], None]] = []
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, fn: Callable[[Event], None]) -> None:
+        self._watchers.append(fn)
+
+    def _emit(self, verb: str, kind: str, obj: object) -> None:
+        for fn in self._watchers:
+            fn((verb, kind, obj))
+
+    # -- writers -----------------------------------------------------------
+
+    def upsert_cluster_queue(self, cq: ClusterQueue) -> None:
+        with self._lock:
+            verb = "update" if cq.name in self.cluster_queues else "add"
+            self.cluster_queues[cq.name] = cq
+            self.cq_generation[cq.name] = self.cq_generation.get(cq.name, 0) + 1
+        self._emit(verb, "ClusterQueue", cq)
+
+    def upsert_cohort(self, cohort: Cohort) -> None:
+        with self._lock:
+            self.cohorts[cohort.name] = cohort
+        self._emit("update", "Cohort", cohort)
+
+    def upsert_local_queue(self, lq: LocalQueue) -> None:
+        with self._lock:
+            self.local_queues[lq.key] = lq
+        self._emit("update", "LocalQueue", lq)
+
+    def upsert_resource_flavor(self, rf: ResourceFlavor) -> None:
+        with self._lock:
+            self.resource_flavors[rf.name] = rf
+        self._emit("update", "ResourceFlavor", rf)
+
+    def upsert_topology(self, t: Topology) -> None:
+        with self._lock:
+            self.topologies[t.name] = t
+        self._emit("update", "Topology", t)
+
+    def upsert_admission_check(self, ac: AdmissionCheck) -> None:
+        with self._lock:
+            self.admission_checks[ac.name] = ac
+        self._emit("update", "AdmissionCheck", ac)
+
+    def upsert_priority_class(self, pc: WorkloadPriorityClass) -> None:
+        with self._lock:
+            self.priority_classes[pc.name] = pc
+        self._emit("update", "WorkloadPriorityClass", pc)
+
+    def add_workload(self, wl: Workload) -> None:
+        with self._lock:
+            if wl.priority_class and wl.priority == 0:
+                pc = self.priority_classes.get(wl.priority_class)
+                if pc is not None:
+                    wl.priority = pc.value
+            self.workloads[wl.key] = wl
+        self._emit("add", "Workload", wl)
+
+    def update_workload(self, wl: Workload) -> None:
+        with self._lock:
+            self.workloads[wl.key] = wl
+        self._emit("update", "Workload", wl)
+
+    def delete_workload(self, key: str) -> Optional[Workload]:
+        with self._lock:
+            wl = self.workloads.pop(key, None)
+        if wl is not None:
+            self._emit("delete", "Workload", wl)
+        return wl
+
+    # -- readers -----------------------------------------------------------
+
+    def cluster_queue_for(self, wl: Workload) -> Optional[str]:
+        lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        return lq.cluster_queue if lq is not None else None
+
+    def admitted_workloads(self) -> Iterable[Workload]:
+        """Workloads holding quota (reserved and not finished)."""
+        for wl in self.workloads.values():
+            if wl.is_quota_reserved and not wl.is_finished:
+                yield wl

@@ -1,0 +1,136 @@
+"""Workload domain logic: request totals, assignment state, ordering.
+
+Reference parity: pkg/workload/workload.go (Info, TotalRequests, Usage,
+queue-order timestamps) and pkg/scheduler LastAssignment cursor handling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu.api.types import (
+    FlavorResource,
+    Workload,
+    WorkloadConditionType,
+)
+
+
+@dataclass
+class PodSetResources:
+    """Total (count-scaled) requests of one podset plus assigned flavors."""
+
+    name: str
+    requests: dict[str, int] = field(default_factory=dict)  # resource -> total
+    count: int = 0
+    #: resource -> flavor name, filled after assignment (or from admission)
+    flavors: dict[str, str] = field(default_factory=dict)
+
+    def scaled_to(self, count: int) -> "PodSetResources":
+        if self.count == 0 or count == self.count:
+            return PodSetResources(self.name, dict(self.requests), self.count,
+                                   dict(self.flavors))
+        scaled = {r: (q // self.count) * count for r, q in self.requests.items()}
+        return PodSetResources(self.name, scaled, count, dict(self.flavors))
+
+
+@dataclass
+class AssignmentClusterQueueState:
+    """Flavor cursor carried across cycles (reference: LastAssignment).
+
+    Invalidated when the ClusterQueue's allocatable-resource generation
+    changes (flavorassigner.go:571-577).
+    """
+
+    last_tried_flavor_idx: list[dict[str, int]] = field(default_factory=list)
+    cluster_queue_generation: int = -1
+
+    def next_flavor_to_try(self, ps_idx: int, resource: str) -> int:
+        if ps_idx < len(self.last_tried_flavor_idx):
+            idx = self.last_tried_flavor_idx[ps_idx].get(resource, -1)
+            return idx + 1
+        return 0
+
+
+class WorkloadInfo:
+    """A Workload enriched with totals and scheduling state."""
+
+    def __init__(self, obj: Workload, cluster_queue: str = "",
+                 local_queue_fs_usage: Optional[float] = None) -> None:
+        self.obj = obj
+        self.cluster_queue = cluster_queue
+        self.total_requests: list[PodSetResources] = [
+            PodSetResources(
+                name=ps.name,
+                requests=ps.total_requests(),
+                count=ps.count,
+            )
+            for ps in obj.podsets
+        ]
+        # Seed flavors from an existing admission (for admitted workloads).
+        adm = obj.status.admission
+        if adm is not None:
+            for psr in self.total_requests:
+                for psa in adm.podset_assignments:
+                    if psa.name == psr.name:
+                        psr.flavors = dict(psa.flavors)
+                        psr.requests = dict(psa.resource_usage)
+                        psr.count = psa.count
+        self.last_assignment: Optional[AssignmentClusterQueueState] = None
+        #: LocalQueue fair-sharing usage (admission fair sharing, KEP-4136)
+        self.local_queue_fs_usage = local_queue_fs_usage
+        #: queue-manager cycle at which this head was popped (for the
+        #: mid-cycle capacity-freed flush check on requeue)
+        self.pop_cycle = -1
+
+    @property
+    def key(self) -> str:
+        return self.obj.key
+
+    def usage(self) -> dict[FlavorResource, int]:
+        """Quota usage keyed by (flavor, resource), from assigned flavors."""
+        out: dict[FlavorResource, int] = {}
+        for psr in self.total_requests:
+            for resource, qty in psr.requests.items():
+                flavor = psr.flavors.get(resource)
+                if flavor is None:
+                    continue
+                fr = (flavor, resource)
+                out[fr] = out.get(fr, 0) + qty
+        return out
+
+    def can_be_partially_admitted(self) -> bool:
+        return any(ps.min_count is not None for ps in self.obj.podsets)
+
+    def scheduling_hash(self) -> tuple:
+        """Shape key for BestEffortFIFO NoFit dedup (workload.go:227-230)."""
+        return tuple(
+            (psr.name, psr.count, tuple(sorted(psr.requests.items())))
+            for psr in self.total_requests
+        )
+
+    def __repr__(self) -> str:
+        return f"WorkloadInfo({self.key}@{self.cluster_queue})"
+
+
+def effective_priority(wl: Workload) -> int:
+    return wl.priority
+
+
+def queue_order_timestamp(wl: Workload) -> float:
+    """Eviction-aware ordering timestamp (reference: workload.Ordering).
+
+    An evicted workload re-enters the queue ordered by its eviction time
+    rather than creation time, so requeued work doesn't jump the line.
+    """
+    evicted = wl.status.conditions.get(WorkloadConditionType.EVICTED)
+    if evicted is not None and evicted.status:
+        return evicted.last_transition_time
+    return wl.creation_time
+
+
+def quota_reservation_time(wl: Workload, now: float) -> float:
+    cond = wl.status.conditions.get(WorkloadConditionType.QUOTA_RESERVED)
+    if cond is None or not cond.status:
+        return now
+    return cond.last_transition_time

@@ -1,0 +1,6 @@
+"""Scheduling decision stack (the scalar oracle path).
+
+Reference parity: pkg/scheduler of hiboyang/kueue_oss — flavor assignment,
+preemption (classical + fair sharing), and the per-cycle scheduling loop.
+The batched TPU path in kueue_oss_tpu.solver mirrors these semantics.
+"""

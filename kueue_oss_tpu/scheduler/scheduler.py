@@ -1,0 +1,538 @@
+"""The scheduling cycle.
+
+Reference parity: pkg/scheduler/scheduler.go — one cycle = pop queue heads,
+snapshot the cache, nominate (flavor assignment + preemption targets), order
+entries (classical sort or fair-sharing tournament), then admit/preempt with
+at most one cohort-conflicting admission per cycle, requeueing the rest.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu.api.types import (
+    Admission,
+    PodSetAssignment,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager, RequeueReason
+from kueue_oss_tpu.core.snapshot import (
+    ClusterQueueSnapshot,
+    Snapshot,
+    build_snapshot,
+)
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.core.workload_info import (
+    WorkloadInfo,
+    effective_priority,
+    queue_order_timestamp,
+)
+from kueue_oss_tpu.scheduler import flavor_assigner as fa
+from kueue_oss_tpu.scheduler.flavor_assigner import (
+    Assignment,
+    FlavorAssigner,
+    PodSetReducer,
+)
+from kueue_oss_tpu.scheduler.preemption import Preemptor, Target
+
+# entry status (scheduler.go entryStatus)
+NOT_NOMINATED = ""
+NOMINATED = "nominated"
+ASSUMED = "assumed"
+SKIPPED = "skipped"
+EVICTED = "evicted"
+
+
+@dataclass
+class Entry:
+    info: WorkloadInfo
+    assignment: Assignment = field(default_factory=Assignment)
+    status: str = NOT_NOMINATED
+    inadmissible_msg: str = ""
+    requeue_reason: str = RequeueReason.GENERIC
+    preemption_targets: list[Target] = field(default_factory=list)
+    cq_snapshot: Optional[ClusterQueueSnapshot] = None
+
+    def assignment_usage(self):
+        if self.info.obj.is_quota_reserved:
+            return {}
+        return dict(self.assignment.usage_quota)
+
+
+@dataclass
+class CycleStats:
+    cycle: int = 0
+    heads: int = 0
+    admitted: int = 0
+    preempted: int = 0
+    skipped: int = 0
+    inadmissible: int = 0
+    duration_s: float = 0.0
+
+
+class Scheduler:
+    """One-process scheduler over the in-memory store."""
+
+    def __init__(
+        self,
+        store: Store,
+        queues: QueueManager,
+        enable_fair_sharing: bool = False,
+        enable_partial_admission: bool = True,
+        clock=time.monotonic,
+        solver=None,
+    ) -> None:
+        self.store = store
+        self.queues = queues
+        self.enable_fair_sharing = enable_fair_sharing
+        self.enable_partial_admission = enable_partial_admission
+        self.clock = clock
+        self.preemptor = Preemptor(enable_fair_sharing=enable_fair_sharing)
+        self.cycle_count = 0
+        #: optional batched TPU solver implementing nominate() acceleration
+        self.solver = solver
+        # metrics
+        self.admitted_total: dict[str, int] = {}
+        self.preempted_total: dict[str, int] = {}
+        self.evicted_total: dict[str, int] = {}
+        self.admission_attempt_durations: list[float] = []
+
+    # ------------------------------------------------------------------
+    # Cycle
+    # ------------------------------------------------------------------
+
+    def schedule(self, now: Optional[float] = None) -> CycleStats:
+        start = self.clock()
+        now = now if now is not None else start
+        self.cycle_count += 1
+        stats = CycleStats(cycle=self.cycle_count)
+
+        heads = self.queues.heads()
+        stats.heads = len(heads)
+        if not heads:
+            return stats
+
+        snapshot = build_snapshot(self.store)
+        entries, inadmissible = self._nominate(heads, snapshot, now)
+        stats.inadmissible = len(inadmissible)
+
+        iterator = self._make_iterator(entries, snapshot)
+        preempted_workloads: dict[str, WorkloadInfo] = {}
+        while iterator.has_next():
+            self._process_entry(iterator.pop(), snapshot,
+                                preempted_workloads, stats, now)
+
+        for e in entries:
+            if e.status not in (ASSUMED, EVICTED):
+                self._requeue_and_update(e)
+        for e in inadmissible:
+            self._requeue_and_update(e)
+
+        stats.duration_s = self.clock() - start
+        self.admission_attempt_durations.append(stats.duration_s)
+        return stats
+
+    def run_until_quiet(self, max_cycles: int = 10_000,
+                        now: Optional[float] = None) -> int:
+        """Run cycles until the pending state stops changing."""
+        cycles = 0
+        prev_fingerprint = None
+        while cycles < max_cycles:
+            fingerprint = self._queue_fingerprint()
+            stats = self.schedule(now=now)
+            cycles += 1
+            if stats.heads == 0:
+                break
+            if (stats.admitted == 0 and stats.preempted == 0
+                    and fingerprint == prev_fingerprint):
+                break
+            prev_fingerprint = self._queue_fingerprint()
+        return cycles
+
+    def _queue_fingerprint(self):
+        return tuple(sorted(
+            (name, tuple(sorted(q._in_heap)), tuple(sorted(q.inadmissible)))
+            for name, q in self.queues.queues.items()
+        ))
+
+    # ------------------------------------------------------------------
+    # Nomination
+    # ------------------------------------------------------------------
+
+    def _nominate(self, heads: list[WorkloadInfo], snapshot: Snapshot,
+                  now: float) -> tuple[list[Entry], list[Entry]]:
+        entries: list[Entry] = []
+        inadmissible: list[Entry] = []
+        for info in heads:
+            e = Entry(info=info)
+            e.cq_snapshot = snapshot.cluster_queue(info.cluster_queue)
+            if info.cluster_queue in snapshot.inactive_cluster_queues:
+                e.inadmissible_msg = (
+                    f"ClusterQueue {info.cluster_queue} is inactive")
+            elif e.cq_snapshot is None:
+                e.inadmissible_msg = (
+                    f"ClusterQueue {info.cluster_queue} not found")
+            elif not self._namespace_matches(e.cq_snapshot, info.obj):
+                e.inadmissible_msg = (
+                    "Workload namespace doesn't match ClusterQueue selector")
+                e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
+            else:
+                assignment, targets = self._get_assignments(info, snapshot, now)
+                e.assignment = assignment
+                e.preemption_targets = targets
+                e.inadmissible_msg = assignment.message()
+                info.last_assignment = assignment.last_state
+                entries.append(e)
+                continue
+            inadmissible.append(e)
+        return entries, inadmissible
+
+    def _namespace_matches(self, cq: ClusterQueueSnapshot, wl: Workload) -> bool:
+        selector = cq.spec.namespace_selector
+        if selector is None:
+            return True
+        labels = self.store.namespaces.get(wl.namespace, {})
+        return all(labels.get(k) == v for k, v in selector.items())
+
+    def _get_assignments(self, info: WorkloadInfo, snapshot: Snapshot,
+                         now: float) -> tuple[Assignment, list[Target]]:
+        """scheduler.go getInitialAssignments: full fit, else preempt,
+        else partial admission."""
+        cq = snapshot.cluster_queue(info.cluster_queue)
+        assert cq is not None
+        assigner = FlavorAssigner(
+            info, cq, snapshot.resource_flavors, oracle=self.preemptor,
+            enable_fair_sharing=self.enable_fair_sharing)
+        full = assigner.assign()
+        mode = full.representative_mode()
+        if mode == fa.FIT:
+            return full, []
+        if mode == fa.PREEMPT:
+            targets = self.preemptor.get_targets(info, full, snapshot, now)
+            if targets:
+                return full, targets
+
+        if self.enable_partial_admission and info.can_be_partially_admitted():
+            def probe(counts):
+                assignment = assigner.assign(counts)
+                m = assignment.representative_mode()
+                if m == fa.FIT:
+                    return (assignment, []), True
+                if m == fa.PREEMPT:
+                    t = self.preemptor.get_targets(info, assignment, snapshot, now)
+                    if t:
+                        return (assignment, t), True
+                return None, False
+
+            reducer = PodSetReducer(info.obj.podsets, probe)
+            result, found = reducer.search()
+            if found:
+                return result
+        return full, []
+
+    # ------------------------------------------------------------------
+    # Iterators
+    # ------------------------------------------------------------------
+
+    def _make_iterator(self, entries: list[Entry], snapshot: Snapshot):
+        if self.enable_fair_sharing:
+            return _FairSharingIterator(entries)
+        return _ClassicalIterator(entries)
+
+    # ------------------------------------------------------------------
+    # Entry processing
+    # ------------------------------------------------------------------
+
+    def _process_entry(self, e: Entry, snapshot: Snapshot,
+                       preempted_workloads: dict[str, WorkloadInfo],
+                       stats: CycleStats, now: float) -> None:
+        cq = e.cq_snapshot
+        assert cq is not None
+        mode = e.assignment.representative_mode()
+        if mode == fa.NO_FIT:
+            stats.skipped += 1
+            return
+
+        if mode == fa.PREEMPT and not e.preemption_targets:
+            # Preemption is needed but no targets: reserve the capacity we
+            # are entitled to so lower entries can't squat on it
+            # (scheduler.go reserveCapacityForUnreclaimablePreempt).
+            cq.add_usage(self._quota_to_reserve(e, cq))
+            stats.skipped += 1
+            return
+
+        # One cohort-conflicting admission per cycle: skip overlapping targets.
+        if any(t.info.key in preempted_workloads for t in e.preemption_targets):
+            e.status = SKIPPED
+            e.inadmissible_msg = (
+                "Workload has overlapping preemption targets with another workload")
+            stats.skipped += 1
+            return
+
+        usage = e.assignment_usage()
+        if not self._fits(snapshot, cq, usage, preempted_workloads,
+                          e.preemption_targets):
+            e.status = SKIPPED
+            e.inadmissible_msg = (
+                "Workload no longer fits after processing another workload")
+            stats.skipped += 1
+            return
+        for t in e.preemption_targets:
+            preempted_workloads[t.info.key] = t.info
+        cq.add_usage(usage)
+
+        if mode == fa.PREEMPT:
+            self._issue_preemptions(e, now)
+            stats.preempted += len(e.preemption_targets)
+            return
+
+        e.status = NOMINATED
+        self._admit(e, now)
+        stats.admitted += 1
+
+    @staticmethod
+    def _fits(snapshot: Snapshot, cq: ClusterQueueSnapshot, usage,
+              preempted_workloads: dict[str, WorkloadInfo],
+              targets: list[Target]) -> bool:
+        infos = list(preempted_workloads.values()) + [t.info for t in targets]
+        revert = snapshot.simulate_workload_removal(infos)
+        try:
+            return cq.fits(usage)
+        finally:
+            revert()
+
+    def _quota_to_reserve(self, e: Entry, cq: ClusterQueueSnapshot):
+        """scheduler.go quotaResourcesToReserve for Preempt-mode entries."""
+        reserved = {}
+        borrowing = e.assignment.borrows() > 0
+        for fr, usage in e.assignment.usage_quota.items():
+            quota = cq.quota_for(fr)
+            if borrowing:
+                if quota.borrowing_limit is None:
+                    reserved[fr] = usage
+                else:
+                    reserved[fr] = min(
+                        usage,
+                        quota.nominal + quota.borrowing_limit
+                        - cq.node.usage.get(fr, 0))
+            else:
+                reserved[fr] = max(
+                    0, min(usage, quota.nominal - cq.node.usage.get(fr, 0)))
+        return reserved
+
+    # ------------------------------------------------------------------
+    # Admission / preemption / eviction
+    # ------------------------------------------------------------------
+
+    def _admit(self, e: Entry, now: float) -> None:
+        """Reserve quota and write Admission into the store (scheduler.go
+        admit/assumeWorkload; store write is synchronous here)."""
+        wl = self.store.workloads.get(e.info.key)
+        if wl is None:
+            e.status = SKIPPED
+            return
+        admission = Admission(
+            cluster_queue=e.info.cluster_queue,
+            podset_assignments=[
+                PodSetAssignment(
+                    name=psa.name,
+                    flavors={r: rec.name for r, rec in psa.flavors.items()},
+                    resource_usage=dict(psa.requests),
+                    count=psa.count,
+                )
+                for psa in e.assignment.podsets
+            ],
+        )
+        wl.status.admission = admission
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                         reason="QuotaReserved", now=now)
+        cq_spec = self.store.cluster_queues[e.info.cluster_queue]
+        if cq_spec.admission_checks:
+            for name in cq_spec.admission_checks:
+                from kueue_oss_tpu.api.types import AdmissionCheckState
+                wl.status.admission_checks.setdefault(
+                    name, AdmissionCheckState(name=name))
+        else:
+            wl.set_condition(WorkloadConditionType.ADMITTED, True,
+                             reason="Admitted", now=now)
+        self.store.update_workload(wl)
+        e.status = ASSUMED
+        self.admitted_total[e.info.cluster_queue] = (
+            self.admitted_total.get(e.info.cluster_queue, 0) + 1)
+
+    def _issue_preemptions(self, e: Entry, now: float) -> None:
+        for target in e.preemption_targets:
+            self.evict_workload(
+                target.info.key,
+                reason="Preempted",
+                message=f"Preempted to accommodate {e.info.key} due to "
+                        f"{target.reason}",
+                now=now,
+                preemption_reason=target.reason,
+            )
+        e.inadmissible_msg += (
+            f". Pending the preemption of {len(e.preemption_targets)} workload(s)")
+        e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+        e.info.last_assignment = None
+
+    def evict_workload(self, key: str, reason: str, message: str, now: float,
+                       preemption_reason: str = "") -> None:
+        """Evict + finalize: release quota and requeue (the reference splits
+        this between the scheduler patch and the Workload controller)."""
+        wl = self.store.workloads.get(key)
+        if wl is None or wl.is_finished:
+            return
+        wl.set_condition(WorkloadConditionType.EVICTED, True, reason=reason,
+                         message=message, now=now)
+        if preemption_reason:
+            wl.set_condition(WorkloadConditionType.PREEMPTED, True,
+                             reason=preemption_reason, message=message, now=now)
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, False,
+                         reason=reason, now=now)
+        wl.set_condition(WorkloadConditionType.ADMITTED, False, reason=reason,
+                         now=now)
+        wl.status.admission = None
+        wl.status.admission_checks.clear()
+        self.store.update_workload(wl)
+        self.evicted_total[wl.key] = self.evicted_total.get(wl.key, 0) + 1
+        cq = self.store.cluster_queue_for(wl)
+        if cq:
+            self.preempted_total[cq] = self.preempted_total.get(cq, 0) + 1
+        # Back into the pending queues, ordered by eviction time.
+        self.queues.add_or_update_workload(wl)
+        self.queues.report_workload_evicted(wl)
+
+    def finish_workload(self, key: str, now: float = 0.0) -> None:
+        """Mark Finished and release quota (jobframework Finished path)."""
+        wl = self.store.workloads.get(key)
+        if wl is None:
+            return
+        wl.set_condition(WorkloadConditionType.FINISHED, True,
+                         reason="JobFinished", now=now)
+        self.store.update_workload(wl)
+        self.queues.report_workload_finished(wl)
+
+    def _requeue_and_update(self, e: Entry) -> None:
+        if e.status != NOT_NOMINATED and e.requeue_reason == RequeueReason.GENERIC:
+            e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
+        self.queues.requeue_workload(e.info, e.requeue_reason)
+
+
+# ---------------------------------------------------------------------------
+# Entry iterators
+# ---------------------------------------------------------------------------
+
+
+class _ClassicalIterator:
+    """scheduler.go makeClassicalIterator: quota-reserved first, fewer
+    borrows first, higher priority, FIFO."""
+
+    def __init__(self, entries: list[Entry]) -> None:
+        def cmp(a: Entry, b: Entry) -> int:
+            aq = a.info.obj.is_quota_reserved
+            bq = b.info.obj.is_quota_reserved
+            if aq != bq:
+                return -1 if aq else 1
+            ab, bb = a.assignment.borrows(), b.assignment.borrows()
+            if ab != bb:
+                return -1 if ab < bb else 1
+            pa = effective_priority(a.info.obj)
+            pb = effective_priority(b.info.obj)
+            if pa != pb:
+                return -1 if pa > pb else 1
+            ta = queue_order_timestamp(a.info.obj)
+            tb = queue_order_timestamp(b.info.obj)
+            if ta != tb:
+                return -1 if ta < tb else 1
+            return 0
+
+        self.entries = sorted(entries, key=functools.cmp_to_key(cmp))
+        self._idx = 0
+
+    def has_next(self) -> bool:
+        return self._idx < len(self.entries)
+
+    def pop(self) -> Entry:
+        e = self.entries[self._idx]
+        self._idx += 1
+        return e
+
+
+class _FairSharingIterator:
+    """fair_sharing_iterator.go: per-cohort tournament picking, at every
+    level, the child whose nominated workload yields the lowest DRS."""
+
+    def __init__(self, entries: list[Entry]) -> None:
+        self.cq_to_entry: dict[ClusterQueueSnapshot, Entry] = {}
+        for e in entries:
+            assert e.cq_snapshot is not None
+            self.cq_to_entry[e.cq_snapshot] = e
+
+    def has_next(self) -> bool:
+        return bool(self.cq_to_entry)
+
+    def pop(self) -> Entry:
+        cq = next(iter(self.cq_to_entry))
+        if not cq.has_parent():
+            return self.cq_to_entry.pop(cq)
+        root = cq.parent().root()
+        drs_values = self._compute_drs(root)
+        winner = self._run_tournament(root, drs_values)
+        assert winner is not None
+        del self.cq_to_entry[winner.cq_snapshot]
+        return winner
+
+    def _compute_drs(self, root):
+        drs_values: dict[tuple[str, str], object] = {}
+        for cq in root.subtree_cluster_queues():
+            entry = self.cq_to_entry.get(cq)
+            if entry is None:
+                continue
+            usage = entry.assignment_usage()
+            revert = cq.simulate_usage_addition(usage)
+            try:
+                share = cq.dominant_resource_share()
+                for ancestor in cq.path_parent_to_root():
+                    drs_values[(ancestor.name, entry.info.key)] = share
+                    share = ancestor.dominant_resource_share()
+            finally:
+                revert()
+        return drs_values
+
+    def _run_tournament(self, cohort, drs_values) -> Optional[Entry]:
+        from kueue_oss_tpu.core.quota import compare_drs
+
+        candidates: list[Entry] = []
+        for child in cohort.child_cohorts():
+            c = self._run_tournament(child, drs_values)
+            if c is not None:
+                candidates.append(c)
+        for child_cq in cohort.child_cqs():
+            if child_cq in self.cq_to_entry:
+                candidates.append(self.cq_to_entry[child_cq])
+        if not candidates:
+            return None
+
+        def less(a: Entry, b: Entry) -> bool:
+            a_drs = drs_values.get((cohort.name, a.info.key))
+            b_drs = drs_values.get((cohort.name, b.info.key))
+            if a_drs is not None and b_drs is not None:
+                c = compare_drs(a_drs, b_drs)
+                if c != 0:
+                    return c < 0
+            pa = effective_priority(a.info.obj)
+            pb = effective_priority(b.info.obj)
+            if pa != pb:
+                return pa > pb
+            return (queue_order_timestamp(a.info.obj)
+                    < queue_order_timestamp(b.info.obj))
+
+        best = candidates[0]
+        for cur in candidates[1:]:
+            if less(cur, best):
+                best = cur
+        return best
