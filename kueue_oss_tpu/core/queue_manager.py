@@ -82,6 +82,17 @@ class ClusterQueuePendingQueue:
         self._in_heap.pop(key, None)
         self.inadmissible.pop(key, None)
 
+    def snapshot_order(self) -> list[WorkloadInfo]:
+        """Heap contents in pop (rank) order, without consuming them."""
+        return sorted(self._in_heap.values(), key=_order_key)
+
+    def park(self, key: str) -> None:
+        """Move a heap entry to the inadmissible set (external decision)."""
+        info = self._in_heap.get(key)
+        if info is not None:
+            self.delete(key)
+            self.inadmissible[key] = info
+
     def requeue_if_not_present(self, info: WorkloadInfo, reason: str,
                                pop_cycle: int = -1) -> bool:
         """Requeue semantics (reference: cluster_queue.go requeueIfNotPresent).
@@ -126,6 +137,10 @@ class QueueManager:
         self.cycle = 0
         for cq in store.cluster_queues.values():
             self.add_cluster_queue(cq.name)
+        # Initial LIST: enqueue pending workloads already in the store
+        # (reference parity: informer list+watch startup).
+        for wl in store.workloads.values():
+            self.add_or_update_workload(wl)
         store.watch(self._on_event)
 
     # -- CQ lifecycle ------------------------------------------------------

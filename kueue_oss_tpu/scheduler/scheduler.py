@@ -140,17 +140,15 @@ class Scheduler:
                         now: Optional[float] = None) -> int:
         """Run cycles until the pending state stops changing."""
         cycles = 0
-        prev_fingerprint = None
         while cycles < max_cycles:
-            fingerprint = self._queue_fingerprint()
+            pre = self._queue_fingerprint()
             stats = self.schedule(now=now)
             cycles += 1
             if stats.heads == 0:
                 break
             if (stats.admitted == 0 and stats.preempted == 0
-                    and fingerprint == prev_fingerprint):
+                    and self._queue_fingerprint() == pre):
                 break
-            prev_fingerprint = self._queue_fingerprint()
         return cycles
 
     def _queue_fingerprint(self):

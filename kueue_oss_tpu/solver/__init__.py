@@ -1,0 +1,15 @@
+"""Batched TPU admission solver (JAX/pjit/Pallas).
+
+This is the framework's defining component: the per-cycle scheduling core —
+hierarchical quota availability, flavor assignment, entry ordering, and the
+one-admission-at-a-time cohort contract — reformulated over dense
+[node x flavor-resource] tensors and executed as a single jitted program.
+One solver invocation drains an entire pending backlog (multi-round
+wavefront), where the reference's Go loop needs one cycle per admission
+wave. The scalar oracle in kueue_oss_tpu.scheduler remains the correctness
+reference; parity tests diff the two on randomized scenarios.
+"""
+
+from kueue_oss_tpu.solver.tensors import SolverProblem, export_problem  # noqa: F401
+from kueue_oss_tpu.solver.kernels import solve_backlog  # noqa: F401
+from kueue_oss_tpu.solver.engine import SolverEngine  # noqa: F401

@@ -1,0 +1,176 @@
+"""Solver engine: export → jitted drain → apply plan to the store.
+
+The engine is the TPU-native replacement for running the reference's Go
+scheduler loop cycle-by-cycle: one invocation computes the admission plan
+for the whole backlog. Each admission can optionally be re-verified against
+the scalar oracle before committing (mirrors the safety pattern of
+verifying solver plans before assuming, SURVEY.md §7 step 4).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from kueue_oss_tpu.api.types import (
+    Admission,
+    PodSetAssignment,
+    PreemptionPolicyValue,
+    WorkloadConditionType,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.core.workload_info import WorkloadInfo
+from kueue_oss_tpu.solver.kernels import solve_backlog, to_device
+from kueue_oss_tpu.solver.tensors import (
+    SolverProblem,
+    UnsupportedProblem,
+    export_problem,
+)
+
+
+@dataclass
+class DrainResult:
+    admitted: int = 0
+    rounds: int = 0
+    solver_time_s: float = 0.0
+    apply_time_s: float = 0.0
+    #: workload keys admitted, in (round, entry-order) sequence
+    admitted_keys: list[str] = field(default_factory=list)
+
+
+class SolverEngine:
+    """Drains pending backlogs through the jitted TPU kernel."""
+
+    def __init__(self, store: Store, queues: QueueManager) -> None:
+        self.store = store
+        self.queues = queues
+
+    def supported(self) -> bool:
+        """The jitted drain models Fit/borrow admission; CQs with
+        preemption enabled need the oracle's target search."""
+        for cq in self.store.cluster_queues.values():
+            if cq.preemption.any_enabled:
+                return False
+            if len(cq.resource_groups) > 1:
+                return False
+        return True
+
+    def pending_backlog(self) -> dict[str, list[WorkloadInfo]]:
+        """Current heap contents per CQ in rank (pop) order."""
+        out: dict[str, list[WorkloadInfo]] = {}
+        for name, q in self.queues.queues.items():
+            if not q.active:
+                continue
+            infos = q.snapshot_order()
+            if infos:
+                out[name] = infos
+        return out
+
+    def export(self) -> tuple[SolverProblem, dict[str, list[WorkloadInfo]]]:
+        pending = self.pending_backlog()
+        problem = export_problem(self.store, pending)
+        return problem, pending
+
+    def drain(self, now: float = 0.0, verify: bool = False) -> DrainResult:
+        """Solve the whole backlog on-device and commit the plan."""
+        if not self.supported():
+            raise UnsupportedProblem(
+                "preemption-enabled or multi-RG ClusterQueues present")
+        result = DrainResult()
+        problem, pending = self.export()
+        if problem.n_workloads == 0:
+            return result
+
+        t0 = time.monotonic()
+        tensors = to_device(problem)
+        admitted, opt, admit_round, parked, rounds, _usage = solve_backlog(
+            tensors)
+        admitted = np.asarray(admitted)
+        opt = np.asarray(opt)
+        admit_round = np.asarray(admit_round)
+        parked = np.asarray(parked)
+        result.rounds = int(rounds)
+        result.solver_time_s = time.monotonic() - t0
+
+        t1 = time.monotonic()
+        self._apply_plan(problem, admitted, opt, admit_round, parked, now,
+                         result, verify=verify)
+        result.apply_time_s = time.monotonic() - t1
+        return result
+
+    # -- plan application --------------------------------------------------
+
+    def _apply_plan(self, problem: SolverProblem, admitted: np.ndarray,
+                    opt: np.ndarray, admit_round: np.ndarray,
+                    parked: np.ndarray, now: float,
+                    result: DrainResult, verify: bool = False) -> None:
+        # Optional safety net: replay the plan through the scalar quota
+        # oracle, checking each admission fits before it is committed
+        # (SURVEY.md §7 step 4 verify-then-assume pattern).
+        oracle_forest = None
+        if verify:
+            from kueue_oss_tpu.core.snapshot import build_snapshot
+            oracle_forest = build_snapshot(self.store).forest
+
+        order = np.argsort(admit_round[:-1], kind="stable")
+        for w in order:
+            if not admitted[w]:
+                continue
+            key = problem.wl_keys[w]
+            wl = self.store.workloads.get(key)
+            if wl is None or wl.is_quota_reserved or not wl.active:
+                continue
+            cq_name = problem.cq_names[problem.wl_cqid[w]]
+            flavor = problem.cq_option_flavors[cq_name][opt[w]]
+            info = WorkloadInfo(wl, cluster_queue=cq_name)
+            if oracle_forest is not None:
+                node = oracle_forest.cqs[cq_name]
+                plan_usage = {
+                    (flavor, r): q
+                    for psr in info.total_requests
+                    for r, q in psr.requests.items()
+                }
+                if not node.fits(plan_usage):
+                    raise AssertionError(
+                        f"solver plan failed oracle verification: {key} "
+                        f"does not fit in {cq_name}")
+                for fr, q in plan_usage.items():
+                    node.add_usage(fr, q)
+            admission = Admission(
+                cluster_queue=cq_name,
+                podset_assignments=[
+                    PodSetAssignment(
+                        name=psr.name,
+                        flavors={r: flavor for r in psr.requests},
+                        resource_usage=dict(psr.requests),
+                        count=psr.count,
+                    )
+                    for psr in info.total_requests
+                ],
+            )
+            wl.status.admission = admission
+            wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                             reason="QuotaReserved", now=now)
+            cq_spec = self.store.cluster_queues[cq_name]
+            if cq_spec.admission_checks:
+                from kueue_oss_tpu.api.types import AdmissionCheckState
+                for ac_name in cq_spec.admission_checks:
+                    wl.status.admission_checks.setdefault(
+                        ac_name, AdmissionCheckState(name=ac_name))
+            else:
+                wl.set_condition(WorkloadConditionType.ADMITTED, True,
+                                 reason="Admitted", now=now)
+            self.store.update_workload(wl)
+            self.queues.queues[cq_name].delete(key)
+            result.admitted += 1
+            result.admitted_keys.append(key)
+        # Mirror the solver's inadmissible-parking decisions host-side;
+        # StrictFIFO blocked heads (not parked) stay in their heaps.
+        for w in range(problem.n_workloads):
+            if parked[w]:
+                cq_name = problem.cq_names[problem.wl_cqid[w]]
+                self.queues.queues[cq_name].park(problem.wl_keys[w])

@@ -1,0 +1,357 @@
+"""Snapshot → dense tensor export for the TPU solver.
+
+Flattens the cohort forest into parents-first node arrays over a global
+(flavor, resource) vocabulary, and the pending backlog into per-workload
+flavor-option request tensors. All quantities are int32 after gcd-based
+unit scaling (the exporter rejects problems whose totals could overflow).
+
+Reference parity: this is the tensor form of pkg/cache/scheduler's
+Snapshot — resource_node.go quantities (nominal/subtree/local quota,
+borrowing limits, usage) plus the queue heads' request vectors.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from kueue_oss_tpu.api.types import (
+    FlavorFungibilityPolicy,
+    FlavorResource,
+    QueueingStrategy,
+    ResourceFlavor,
+)
+from kueue_oss_tpu.core.snapshot import Snapshot, build_snapshot
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.core.workload_info import (
+    WorkloadInfo,
+    effective_priority,
+    queue_order_timestamp,
+)
+from kueue_oss_tpu.scheduler.flavor_assigner import (
+    _selector_matches,
+    _untolerated_taint,
+)
+
+#: "infinity" for missing borrowing limits; headroom against int32 overflow.
+BIG = np.int32(1 << 30)
+#: quantities must stay below this after scaling so sums can't overflow.
+MAX_QUANTITY = 1 << 28
+
+
+class UnsupportedProblem(Exception):
+    """Raised when a scenario needs the oracle path (solver fallback)."""
+
+
+@dataclass
+class SolverProblem:
+    """Dense problem instance. Node axis is [N+1] (last row = null node);
+    workload axis is [W+1] (last row = null workload)."""
+
+    # --- node (CQ + cohort) arrays, parents-first topo order -------------
+    parent: np.ndarray        # [N+1] int32, null node index N for roots
+    depth: np.ndarray         # [N+1] int32
+    height: np.ndarray        # [N+1] int32 (cohort height; CQs are 0)
+    has_parent: np.ndarray    # [N+1] bool
+    path: np.ndarray          # [N+1, D] int32 ancestor chain (self first), padded with N
+    nominal: np.ndarray       # [N+1, F] int32
+    subtree: np.ndarray       # [N+1, F] int32
+    local_quota: np.ndarray   # [N+1, F] int32
+    has_borrow: np.ndarray    # [N+1, F] bool
+    borrow_limit: np.ndarray  # [N+1, F] int32 (BIG when unset)
+    usage0: np.ndarray        # [N+1, F] int32 (initial usage incl. cohorts)
+
+    # --- ClusterQueue arrays (C = number of CQs) --------------------------
+    cq_node: np.ndarray       # [C] int32 node index of each CQ
+    cq_strict: np.ndarray     # [C] bool (StrictFIFO)
+    cq_try_next: np.ndarray   # [C] bool (whenCanBorrow == TryNextFlavor)
+    cq_root_height: np.ndarray  # [C] int32 height of the CQ's root cohort
+    cq_nflavors: np.ndarray   # [C] int32 number of flavors in the CQ's RG
+
+    # --- workload arrays --------------------------------------------------
+    wl_cqid: np.ndarray       # [W+1] int32 CQ id (C for null)
+    wl_rank: np.ndarray       # [W+1] int32 FIFO rank within its CQ
+    wl_prio: np.ndarray       # [W+1] int32
+    wl_ts: np.ndarray         # [W+1] int32 (dense timestamp rank)
+    wl_uid: np.ndarray        # [W+1] int32
+    wl_req: np.ndarray        # [W+1, K, F] int32 request under flavor-option k
+    wl_valid: np.ndarray      # [W+1, K] bool option exists & taints/selector ok
+
+    # --- host-side decode tables -----------------------------------------
+    fr_list: list[FlavorResource] = field(default_factory=list)
+    node_names: list[str] = field(default_factory=list)
+    cq_names: list[str] = field(default_factory=list)
+    wl_keys: list[str] = field(default_factory=list)
+    #: per CQ: ordered flavor names (option k -> flavor)
+    cq_option_flavors: dict[str, list[str]] = field(default_factory=dict)
+    scale: int = 1
+
+    @property
+    def n_nodes(self) -> int:
+        return self.parent.shape[0] - 1
+
+    @property
+    def n_cqs(self) -> int:
+        return self.cq_node.shape[0]
+
+    @property
+    def n_workloads(self) -> int:
+        return self.wl_cqid.shape[0] - 1
+
+
+def _flavor_compatible(info: WorkloadInfo, flavor: ResourceFlavor,
+                       allowed_keys: frozenset[str]) -> bool:
+    for ps in info.obj.podsets:
+        if _untolerated_taint(ps, flavor) is not None:
+            return False
+        if not _selector_matches(ps, flavor, allowed_keys):
+            return False
+    return True
+
+
+def export_problem(
+    store: Store,
+    pending: dict[str, list[WorkloadInfo]],
+    snapshot: Optional[Snapshot] = None,
+) -> SolverProblem:
+    """Build a SolverProblem from the store and the pending backlog.
+
+    ``pending`` maps CQ name -> workloads in FIFO-heap order (rank order).
+    Raises UnsupportedProblem for shapes the solver doesn't model yet
+    (multiple resource groups per CQ, per-podset topology groups) so the
+    caller can fall back to the oracle.
+    """
+    snapshot = snapshot or build_snapshot(store)
+    forest = snapshot.forest
+
+    # ---- node ordering: parents-first (BFS from roots) -------------------
+    nodes = []
+    for root in forest.roots():
+        stack = [root]
+        while stack:
+            n = stack.pop(0)
+            nodes.append(n)
+            stack.extend(n.children.values())
+    index = {id(n): i for i, n in enumerate(nodes)}
+    n_nodes = len(nodes)
+    null = n_nodes
+
+    # ---- FR vocabulary ---------------------------------------------------
+    frs: set[FlavorResource] = set()
+    for n in nodes:
+        frs.update(n.quotas.keys())
+        frs.update(n.usage.keys())
+    for infos in pending.values():
+        for info in infos:
+            cq = store.cluster_queues[info.cluster_queue]
+            for rg in cq.resource_groups:
+                for fq in rg.flavors:
+                    for r in rg.covered_resources:
+                        frs.add((fq.name, r))
+    fr_list = sorted(frs)
+    fr_index = {fr: i for i, fr in enumerate(fr_list)}
+    F = max(1, len(fr_list))
+
+    # ---- node arrays -----------------------------------------------------
+    parent = np.full(n_nodes + 1, null, dtype=np.int32)
+    depth = np.zeros(n_nodes + 1, dtype=np.int32)
+    has_parent = np.zeros(n_nodes + 1, dtype=bool)
+    nominal = np.zeros((n_nodes + 1, F), dtype=np.int64)
+    subtree = np.zeros((n_nodes + 1, F), dtype=np.int64)
+    local_quota = np.zeros((n_nodes + 1, F), dtype=np.int64)
+    has_borrow = np.zeros((n_nodes + 1, F), dtype=bool)
+    borrow_limit = np.zeros((n_nodes + 1, F), dtype=np.int64)
+    usage0 = np.zeros((n_nodes + 1, F), dtype=np.int64)
+
+    for i, n in enumerate(nodes):
+        if n.parent is not None:
+            parent[i] = index[id(n.parent)]
+            has_parent[i] = True
+            depth[i] = depth[parent[i]] + 1
+        for fr, q in n.quotas.items():
+            j = fr_index[fr]
+            nominal[i, j] = q.nominal
+            if q.borrowing_limit is not None:
+                has_borrow[i, j] = True
+                borrow_limit[i, j] = q.borrowing_limit
+        for fr, v in n.subtree_quota.items():
+            subtree[i, fr_index[fr]] = v
+        for fr, v in n.usage.items():
+            usage0[i, fr_index[fr]] = v
+        for j, fr in enumerate(fr_list):
+            local_quota[i, j] = n.local_quota(fr)
+
+    D = int(depth.max()) + 1 if n_nodes else 1
+    path = np.full((n_nodes + 1, D), null, dtype=np.int32)
+    for i, n in enumerate(nodes):
+        cur, d = i, 0
+        while cur != null and d < D:
+            path[i, d] = cur
+            cur = parent[cur]
+            d += 1
+
+    # height (distance to furthest leaf, counting cohort edges only;
+    # reference: classical/hierarchical_preemption.go getNodeHeight)
+    height = np.zeros(n_nodes + 1, dtype=np.int32)
+    for i in range(n_nodes - 1, -1, -1):
+        n = nodes[i]
+        h = min(len(n.children), 1)
+        for c in n.children.values():
+            if not c.is_cq:
+                h = max(h, height[index[id(c)]] + 1)
+        height[i] = h
+
+    # ---- CQ arrays -------------------------------------------------------
+    cq_names = sorted(forest.cqs.keys())
+    C = len(cq_names)
+    cq_node = np.zeros(C, dtype=np.int32)
+    cq_strict = np.zeros(C, dtype=bool)
+    cq_try_next = np.zeros(C, dtype=bool)
+    cq_root_height = np.zeros(C, dtype=np.int32)
+    cq_nflavors = np.zeros(C, dtype=np.int32)
+    cq_option_flavors: dict[str, list[str]] = {}
+    K = 1
+    for cid, name in enumerate(cq_names):
+        spec = store.cluster_queues[name]
+        node = forest.cqs[name]
+        cq_node[cid] = index[id(node)]
+        cq_strict[cid] = spec.queueing_strategy == QueueingStrategy.STRICT_FIFO
+        cq_try_next[cid] = (
+            spec.flavor_fungibility.when_can_borrow
+            == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR)
+        cq_root_height[cid] = height[index[id(node.root())]]
+        if len(spec.resource_groups) > 1:
+            raise UnsupportedProblem(
+                f"CQ {name} has multiple resource groups")
+        flavors = ([fq.name for fq in spec.resource_groups[0].flavors]
+                   if spec.resource_groups else [])
+        cq_option_flavors[name] = flavors
+        cq_nflavors[cid] = len(flavors)
+        K = max(K, len(flavors))
+
+    cq_id = {name: i for i, name in enumerate(cq_names)}
+
+    # ---- workload arrays -------------------------------------------------
+    all_infos: list[WorkloadInfo] = []
+    wl_cqid_l, wl_rank_l = [], []
+    for name, infos in pending.items():
+        for rank, info in enumerate(infos):
+            all_infos.append(info)
+            wl_cqid_l.append(cq_id[info.cluster_queue])
+            wl_rank_l.append(rank)
+    W = len(all_infos)
+
+    wl_cqid = np.concatenate(
+        [np.asarray(wl_cqid_l, dtype=np.int32), [C]]).astype(np.int32)
+    wl_rank = np.concatenate(
+        [np.asarray(wl_rank_l, dtype=np.int32), [BIG]]).astype(np.int32)
+    wl_prio = np.zeros(W + 1, dtype=np.int32)
+    wl_ts = np.zeros(W + 1, dtype=np.int32)
+    wl_uid = np.zeros(W + 1, dtype=np.int32)
+    wl_req = np.zeros((W + 1, K, F), dtype=np.int64)
+    wl_valid = np.zeros((W + 1, K), dtype=bool)
+
+    # Timestamps are exported as dense ranks: only relative order matters
+    # for entry sorting, and float32 would collapse epoch-scale values
+    # less than ~128s apart (ties must stay ties for the uid tiebreak).
+    raw_ts = [queue_order_timestamp(i.obj) for i in all_infos]
+    ts_rank = {ts: r for r, ts in enumerate(sorted(set(raw_ts)))}
+
+    for w, info in enumerate(all_infos):
+        wl_prio[w] = effective_priority(info.obj)
+        wl_ts[w] = ts_rank[raw_ts[w]]
+        wl_uid[w] = info.obj.uid
+        spec = store.cluster_queues[info.cluster_queue]
+        if not spec.resource_groups:
+            continue
+        rg = spec.resource_groups[0]
+        groups = {
+            ps.topology_request.podset_group_name
+            for ps in info.obj.podsets
+            if ps.topology_request is not None
+            and ps.topology_request.podset_group_name
+        }
+        if groups:
+            raise UnsupportedProblem(
+                f"workload {info.key} uses podset topology groups")
+        totals: dict[str, int] = {}
+        for psr in info.total_requests:
+            for r, q in psr.requests.items():
+                totals[r] = totals.get(r, 0) + q
+        for r in totals:
+            if r not in rg.covered_resources and totals[r] > 0:
+                # Undeclared resource: no option can ever fit; leave all
+                # options invalid so the solver parks it (oracle parity).
+                totals = None
+                break
+        if totals is None:
+            continue
+        allowed_keys = frozenset(
+            k for fq in rg.flavors
+            for k in store.resource_flavors.get(
+                fq.name, ResourceFlavor(name=fq.name)).node_labels)
+        for k, fq in enumerate(rg.flavors):
+            flavor = store.resource_flavors.get(fq.name)
+            if flavor is None:
+                continue
+            if not _flavor_compatible(info, flavor, allowed_keys):
+                continue
+            wl_valid[w, k] = True
+            for r, q in totals.items():
+                if r in rg.covered_resources:
+                    wl_req[w, k, fr_index[(fq.name, r)]] = q
+
+    # ---- unit scaling ----------------------------------------------------
+    # The gcd must cover every quantity that gets divided — including the
+    # lending-limit-derived local_quota and subtree sums, which otherwise
+    # truncate and change availability.
+    quantities = [int(x) for arr in (nominal, borrow_limit[has_borrow],
+                                     usage0, wl_req, subtree, local_quota)
+                  for x in np.asarray(arr).ravel() if x > 0]
+    scale = 0
+    for q in quantities:
+        scale = math.gcd(scale, q)
+    scale = max(scale, 1)
+
+    def scaled(a: np.ndarray) -> np.ndarray:
+        out = a // scale
+        if out.size and out.max() >= MAX_QUANTITY:
+            raise UnsupportedProblem(
+                "quantities too large for int32 solver tensors")
+        return out.astype(np.int32)
+
+    return SolverProblem(
+        parent=parent,
+        depth=depth,
+        height=height,
+        has_parent=has_parent,
+        path=path,
+        nominal=scaled(nominal),
+        subtree=scaled(subtree),
+        local_quota=scaled(local_quota),
+        has_borrow=has_borrow,
+        borrow_limit=np.where(has_borrow, scaled(borrow_limit),
+                              BIG).astype(np.int32),
+        usage0=scaled(usage0),
+        cq_node=cq_node,
+        cq_strict=cq_strict,
+        cq_try_next=cq_try_next,
+        cq_root_height=cq_root_height,
+        cq_nflavors=cq_nflavors,
+        wl_cqid=wl_cqid,
+        wl_rank=wl_rank,
+        wl_prio=wl_prio,
+        wl_ts=wl_ts,
+        wl_uid=wl_uid,
+        wl_req=scaled(wl_req),
+        wl_valid=wl_valid,
+        fr_list=fr_list,
+        node_names=[n.name for n in nodes],
+        cq_names=cq_names,
+        wl_keys=[i.key for i in all_infos],
+        cq_option_flavors=cq_option_flavors,
+        scale=scale,
+    )

@@ -93,16 +93,15 @@ class Harness:
         return stats
 
     def settle(self, max_cycles=50):
-        prev = None
         for _ in range(max_cycles):
-            fp = self.scheduler._queue_fingerprint()
+            pre = self.scheduler._queue_fingerprint()
             self._t += 1.0
             stats = self.scheduler.schedule(now=self._t)
             if stats.heads == 0:
                 break
-            if (stats.admitted == 0 and stats.preempted == 0 and fp == prev):
+            if (stats.admitted == 0 and stats.preempted == 0
+                    and self.scheduler._queue_fingerprint() == pre):
                 break
-            prev = self.scheduler._queue_fingerprint()
 
     def finish(self, key):
         self._t += 1.0
