@@ -184,6 +184,13 @@ class QueueManager:
         if not wl.active or wl.is_quota_reserved or wl.is_finished:
             self.queues[cq].delete(wl.key)
             return False
+        rs = wl.status.requeue_state
+        if rs is not None and rs.requeue_at is not None:
+            # Eviction backoff pending; Scheduler.requeue_due clears the
+            # gate when the backoff expires. Drop any stale heap entry so
+            # a gated workload can't still be popped.
+            self.queues[cq].delete(wl.key)
+            return False
         self.queues[cq].push(WorkloadInfo(wl, cluster_queue=cq))
         return True
 

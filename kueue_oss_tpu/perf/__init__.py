@@ -1,0 +1,7 @@
+"""Performance harness: synthetic cluster/workload generator + simulator.
+
+Reference parity: test/performance/scheduler/{minimalkueue,runner} — a
+generator builds cohorts/CQs/workload classes from a config, a runner
+fakes workload execution (finish after runtime_ms) and collects admission
+stats (wall time, per-class time-to-admission, throughput, CQ usage).
+"""

@@ -1,0 +1,135 @@
+"""Synthetic cluster + workload generator.
+
+Config shape mirrors the reference's generator.yaml
+(test/performance/scheduler/configs/*/generator.yaml): cohorts ×
+queue-sets × workload-sets, each workload class with request size,
+priority, and runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    PreemptionPolicyValue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.store import Store
+
+
+@dataclass
+class WorkloadClass:
+    class_name: str
+    count: int
+    request: int            # cpu units per workload
+    priority: int
+    runtime_ms: int
+    creation_interval_ms: int = 0
+
+
+@dataclass
+class GeneratorConfig:
+    """Reference parity: baseline/generator.yaml shape."""
+
+    n_cohorts: int = 5
+    cqs_per_cohort: int = 6
+    nominal_quota: int = 20
+    borrowing_limit: int | None = 100
+    reclaim_within_cohort: str = PreemptionPolicyValue.ANY
+    within_cluster_queue: str = PreemptionPolicyValue.LOWER_PRIORITY
+    classes: list[WorkloadClass] = field(default_factory=lambda: [
+        WorkloadClass("small", 350, 1, 50, 200, 100),
+        WorkloadClass("medium", 100, 5, 100, 500, 500),
+        WorkloadClass("large", 50, 20, 200, 1000, 1200),
+    ])
+
+    @classmethod
+    def baseline(cls) -> "GeneratorConfig":
+        """test/performance/scheduler/configs/baseline: 5x6 CQs, 15k wl."""
+        return cls()
+
+    @classmethod
+    def large_scale(cls, preemption: bool = True) -> "GeneratorConfig":
+        """configs/large-scale: 10 cohorts x 100 CQs = 1000 CQs, 50k wl."""
+        return cls(
+            n_cohorts=10,
+            cqs_per_cohort=100,
+            reclaim_within_cohort=(PreemptionPolicyValue.ANY if preemption
+                                   else PreemptionPolicyValue.NEVER),
+            within_cluster_queue=(PreemptionPolicyValue.LOWER_PRIORITY
+                                  if preemption
+                                  else PreemptionPolicyValue.NEVER),
+            classes=[
+                WorkloadClass("small", 35, 1, 50, 150, 60),
+                WorkloadClass("medium", 11, 5, 100, 350, 300),
+                WorkloadClass("large", 4, 20, 200, 700, 700),
+            ],
+        )
+
+
+@dataclass
+class GeneratedWorkload:
+    workload: Workload
+    class_name: str
+    runtime_ms: int
+    arrival_ms: float
+
+
+def generate(config: GeneratorConfig) -> tuple[Store, list[GeneratedWorkload]]:
+    """Build the store (CQs/cohorts/LQs/flavor) and the arrival schedule.
+
+    Workloads are NOT added to the store; the simulator feeds them in at
+    their arrival times (or all at once for backlog-drain benchmarks).
+    """
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    schedule: list[GeneratedWorkload] = []
+    uid = 0
+    for ci in range(config.n_cohorts):
+        store.upsert_cohort(Cohort(name=f"cohort-{ci}"))
+        for qi in range(config.cqs_per_cohort):
+            cq_name = f"cq-{ci}-{qi}"
+            store.upsert_cluster_queue(ClusterQueue(
+                name=cq_name,
+                cohort=f"cohort-{ci}",
+                preemption=PreemptionPolicy(
+                    reclaim_within_cohort=config.reclaim_within_cohort,
+                    within_cluster_queue=config.within_cluster_queue,
+                ),
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources=[
+                        ResourceQuota(
+                            name="cpu",
+                            nominal=config.nominal_quota,
+                            borrowing_limit=config.borrowing_limit)])],
+                )],
+            ))
+            store.upsert_local_queue(
+                LocalQueue(name=f"lq-{cq_name}", cluster_queue=cq_name))
+            for wc in config.classes:
+                for i in range(wc.count):
+                    arrival = i * wc.creation_interval_ms
+                    uid += 1
+                    wl = Workload(
+                        name=f"{wc.class_name}-{cq_name}-{i}",
+                        queue_name=f"lq-{cq_name}",
+                        priority=wc.priority,
+                        creation_time=arrival / 1000.0,
+                        podsets=[PodSet(count=1,
+                                        requests={"cpu": wc.request})],
+                    )
+                    schedule.append(GeneratedWorkload(
+                        workload=wl, class_name=wc.class_name,
+                        runtime_ms=wc.runtime_ms, arrival_ms=arrival))
+    schedule.sort(key=lambda g: g.arrival_ms)
+    return store, schedule

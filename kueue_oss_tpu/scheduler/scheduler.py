@@ -9,6 +9,7 @@ at most one cohort-conflicting admission per cycle, requeueing the rest.
 from __future__ import annotations
 
 import functools
+import heapq
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -85,6 +86,8 @@ class Scheduler:
         enable_partial_admission: bool = True,
         clock=time.monotonic,
         solver=None,
+        eviction_backoff_base_s: float = 1.0,
+        eviction_backoff_max_s: float = 30.0,
     ) -> None:
         self.store = store
         self.queues = queues
@@ -95,6 +98,14 @@ class Scheduler:
         self.cycle_count = 0
         #: optional batched TPU solver implementing nominate() acceleration
         self.solver = solver
+        #: evicted workloads requeue after an exponential backoff
+        #: (reference parity: RequeueState, workload_types.go:774) — this
+        #: also damps preemption churn where revived high-priority
+        #: workloads would endlessly re-take capacity from preemptors.
+        self.eviction_backoff_base_s = eviction_backoff_base_s
+        self.eviction_backoff_max_s = eviction_backoff_max_s
+        #: min-heap of (requeue_at, workload key) pending backoff expiries
+        self._requeue_heap: list[tuple[float, str]] = []
         # metrics
         self.admitted_total: dict[str, int] = {}
         self.preempted_total: dict[str, int] = {}
@@ -110,6 +121,7 @@ class Scheduler:
         now = now if now is not None else start
         self.cycle_count += 1
         stats = CycleStats(cycle=self.cycle_count)
+        self.requeue_due(now)
 
         heads = self.queues.heads()
         stats.heads = len(heads)
@@ -348,6 +360,9 @@ class Scheduler:
         wl.status.admission = admission
         wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
                          reason="QuotaReserved", now=now)
+        # Successful re-admission clears eviction-backoff history
+        # (reference: RequeueState cleared on quota reservation).
+        wl.status.requeue_state = None
         cq_spec = self.store.cluster_queues[e.info.cluster_queue]
         if cq_spec.admission_checks:
             for name in cq_spec.admission_checks:
@@ -395,14 +410,57 @@ class Scheduler:
                          now=now)
         wl.status.admission = None
         wl.status.admission_checks.clear()
+        # Exponential requeue backoff: the workload becomes schedulable
+        # again only at requeue_at (reference: RequeueState).
+        from kueue_oss_tpu.api.types import RequeueState
+
+        rs = wl.status.requeue_state or RequeueState()
+        rs.count += 1
+        delay = min(self.eviction_backoff_base_s * (2 ** (rs.count - 1)),
+                    self.eviction_backoff_max_s)
+        rs.requeue_at = now + delay
+        wl.status.requeue_state = rs
+        heapq.heappush(self._requeue_heap, (rs.requeue_at, key))
         self.store.update_workload(wl)
         self.evicted_total[wl.key] = self.evicted_total.get(wl.key, 0) + 1
         cq = self.store.cluster_queue_for(wl)
         if cq:
             self.preempted_total[cq] = self.preempted_total.get(cq, 0) + 1
-        # Back into the pending queues, ordered by eviction time.
-        self.queues.add_or_update_workload(wl)
+        # Freed capacity wakes parked workloads in the cohort.
         self.queues.report_workload_evicted(wl)
+
+    def requeue_due(self, now: float) -> bool:
+        """Re-queue evicted workloads whose backoff has expired.
+
+        A min-heap of (requeue_at, key) avoids scanning the whole store;
+        stale entries (cleared or re-admitted workloads) are skipped.
+        """
+        added = False
+        while self._requeue_heap and self._requeue_heap[0][0] <= now:
+            due_at, key = heapq.heappop(self._requeue_heap)
+            wl = self.store.workloads.get(key)
+            if wl is None:
+                continue
+            rs = wl.status.requeue_state
+            if rs is None or rs.requeue_at != due_at:
+                continue  # stale: cleared or rescheduled since
+            if not wl.active or wl.is_quota_reserved or wl.is_finished:
+                continue
+            rs.requeue_at = None
+            added |= self.queues.add_or_update_workload(wl)
+        return added
+
+    def next_requeue_at(self) -> Optional[float]:
+        while self._requeue_heap:
+            due_at, key = self._requeue_heap[0]
+            wl = self.store.workloads.get(key)
+            rs = wl.status.requeue_state if wl is not None else None
+            if (wl is None or rs is None or rs.requeue_at != due_at
+                    or wl.is_finished or not wl.active):
+                heapq.heappop(self._requeue_heap)
+                continue
+            return due_at
+        return None
 
     def finish_workload(self, key: str, now: float = 0.0) -> None:
         """Mark Finished and release quota (jobframework Finished path)."""

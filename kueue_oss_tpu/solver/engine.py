@@ -155,6 +155,7 @@ class SolverEngine:
             wl.status.admission = admission
             wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
                              reason="QuotaReserved", now=now)
+            wl.status.requeue_state = None
             cq_spec = self.store.cluster_queues[cq_name]
             if cq_spec.admission_checks:
                 from kueue_oss_tpu.api.types import AdmissionCheckState

@@ -1,0 +1,266 @@
+"""Multi-chip SPMD drain: workloads sharded over a device mesh.
+
+Scaling model: the workload axis (the dimension that grows — pending
+backlogs of 10^5-10^7 entries) is sharded across the mesh's ``wl`` axis;
+the node/quota state (10^3 nodes) is replicated. Each round needs three
+small collectives, all riding ICI:
+
+  1. per-CQ head rank:   pmin over a [C]-vector of local segment minima
+  2. per-CQ head index:  pmin over a [C]-vector (two-pass argmin, int32)
+  3. candidate payload:  psum of [C,K,F] request rows + [C] metadata
+                         (each head lives on exactly one shard)
+
+The nomination + admission scan then runs replicated (identical on every
+device — it only touches [C]- and [N,F]-sized state), and each device
+updates the admitted/parked flags for its own workload shard. This keeps
+per-round collective volume at ~C*K*F ints regardless of backlog size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kueue_oss_tpu.solver.kernels import (
+    M_NOFIT,
+    ProblemTensors,
+    _round_scan,
+    available_all,
+    nominate,
+    potential_available_all,
+    refresh_cohort_usage,
+)
+from kueue_oss_tpu.solver.tensors import BIG, SolverProblem
+
+
+def pad_workloads(p: SolverProblem, multiple: int) -> SolverProblem:
+    """Pad the workload axis so (W+1) divides evenly across the mesh.
+
+    Padding rows replicate the null-workload row (rank BIG, no options),
+    so they are never selected as heads.
+    """
+    import dataclasses
+
+    W1 = p.wl_cqid.shape[0]
+    target = ((W1 + multiple - 1) // multiple) * multiple
+    pad = target - W1
+    if pad == 0:
+        return p
+    C = p.cq_node.shape[0]
+
+    def pad1(a, fill):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths, constant_values=fill)
+
+    return dataclasses.replace(
+        p,
+        wl_cqid=pad1(p.wl_cqid, C),
+        wl_rank=pad1(p.wl_rank, BIG),
+        wl_prio=pad1(p.wl_prio, 0),
+        wl_ts=pad1(p.wl_ts, 0),
+        wl_uid=pad1(p.wl_uid, 0),
+        wl_req=pad1(p.wl_req, 0),
+        wl_valid=pad1(p.wl_valid, False),
+    )
+
+
+def _local_heads(t_local, C, w_offset, admitted, parked):
+    """Per-CQ (min rank, head index) over this device's workload shard."""
+    W_loc = t_local.wl_rank.shape[0]
+    pending = ~admitted & ~parked
+    rank_eff = jnp.where(pending, t_local.wl_rank, BIG)
+    min_rank = jax.ops.segment_min(
+        rank_eff, t_local.wl_cqid, num_segments=C + 1)[:C]
+    w_global = jnp.arange(W_loc, dtype=jnp.int32) + w_offset
+    is_head = rank_eff == min_rank[jnp.minimum(t_local.wl_cqid, C)]
+    head_w = jax.ops.segment_min(
+        jnp.where(is_head & pending, w_global, BIG), t_local.wl_cqid,
+        num_segments=C + 1)[:C]
+    return min_rank, head_w
+
+
+def make_sharded_drain(mesh: Mesh, axis: str = "wl"):
+    """Build the sharded drain fn for a mesh; call with sharded tensors."""
+
+    n_dev = mesh.shape[axis]
+
+    def drain(t: ProblemTensors):
+        C = t.cq_node.shape[0]
+        W1 = t.wl_rank.shape[0]
+        K = t.wl_req.shape[1]
+        F = t.wl_req.shape[2]
+        W_null = W1 - 1
+        shard = W1 // n_dev
+
+        node_specs = ProblemTensors(
+            parent=P(), depth=P(), height=P(), has_parent=P(), is_cq=P(),
+            path=P(), subtree=P(), local_quota=P(), nominal=P(),
+            has_borrow=P(), borrow_limit=P(), usage0=P(), cq_node=P(),
+            cq_strict=P(), cq_try_next=P(), cq_nflavors=P(),
+            wl_cqid=P(axis), wl_rank=P(axis), wl_prio=P(axis),
+            wl_ts=P(axis), wl_uid=P(axis), wl_req=P(axis), wl_valid=P(axis),
+        )
+
+        @partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(node_specs,),
+            out_specs=(P(axis), P(axis), P(), P()),
+        )
+        def run(tl: ProblemTensors):
+            my = jax.lax.axis_index(axis)
+            w_offset = (my * shard).astype(jnp.int32)
+            pot = potential_available_all(tl)
+
+            def cond(state):
+                return state[-2] & (state[-1] < W1 + C + 2)
+
+            def body(state):
+                usage, admitted, parked, cursor_c, prev_head, _, rounds = state
+
+                # --- head selection across shards (2x pmin over ICI) ---
+                min_rank_l, head_w_l = _local_heads(
+                    tl, C, w_offset, admitted, parked)
+                min_rank = jax.lax.pmin(min_rank_l, axis)
+                head_valid_l = min_rank_l == min_rank
+                head_w = jax.lax.pmin(
+                    jnp.where(head_valid_l, head_w_l, BIG), axis)
+                has_head = min_rank < BIG
+
+                # --- candidate payload: psum of one-hot rows -----------
+                local_w = head_w - w_offset
+                mine = has_head & (local_w >= 0) & (local_w < shard)
+                lw = jnp.clip(local_w, 0, shard - 1)
+                payload_req = jnp.where(
+                    mine[:, None, None], tl.wl_req[lw], 0)
+                payload_valid = jnp.where(mine[:, None], tl.wl_valid[lw],
+                                          False)
+                payload_prio = jnp.where(mine, tl.wl_prio[lw], 0)
+                payload_ts = jnp.where(mine, tl.wl_ts[lw], 0)
+                payload_uid = jnp.where(mine, tl.wl_uid[lw], 0)
+                req_c = jax.lax.psum(payload_req, axis)
+                valid_c = jax.lax.psum(payload_valid.astype(jnp.int32),
+                                       axis) > 0
+                prio_c = jax.lax.psum(payload_prio, axis)
+                ts_c = jax.lax.psum(payload_ts, axis)
+                uid_c = jax.lax.psum(payload_uid, axis)
+
+                # --- replicated nomination + scan over candidate rows --
+                # Build a candidate-indexed pseudo problem: candidates map
+                # 1:1 to CQ slots; reuse the single-chip kernels by
+                # substituting gathered arrays.
+                t_cand = tl._replace(
+                    wl_cqid=jnp.concatenate(
+                        [jnp.arange(C, dtype=jnp.int32), jnp.array([C])]),
+                    wl_rank=jnp.concatenate(
+                        [jnp.where(has_head, min_rank, BIG),
+                         jnp.array([BIG], dtype=jnp.int32)]),
+                    wl_prio=jnp.concatenate(
+                        [prio_c, jnp.array([0], dtype=jnp.int32)]),
+                    wl_ts=jnp.concatenate(
+                        [ts_c, jnp.array([0], dtype=ts_c.dtype)]),
+                    wl_uid=jnp.concatenate(
+                        [uid_c, jnp.array([0], dtype=jnp.int32)]),
+                    wl_req=jnp.concatenate([req_c, jnp.zeros(
+                        (1, K, F), dtype=req_c.dtype)]),
+                    wl_valid=jnp.concatenate([valid_c, jnp.zeros(
+                        (1, K), dtype=bool)]),
+                )
+                cand_idx = jnp.where(has_head, jnp.arange(C), C)
+                # The flavor cursor belongs to a workload: reset it when a
+                # CQ's head changed since last round.
+                same_head = head_w == prev_head
+                cursor_eff = jnp.concatenate(
+                    [jnp.where(same_head, cursor_c[:C], 0),
+                     jnp.zeros((1,), dtype=jnp.int32)])
+                avail = available_all(tl, usage)
+                mode, k_chosen, borrow, next_cursor = nominate(
+                    t_cand, usage, avail, pot, cand_idx.astype(jnp.int32),
+                    cursor_eff)
+
+                is_head = has_head
+                strict_head = tl.cq_strict & is_head
+                park_now = is_head & (mode == M_NOFIT) & ~strict_head
+
+                adm_c = jnp.zeros(C + 1, dtype=bool)
+                park_c = jnp.zeros(C + 1, dtype=bool)
+                park_c = park_c.at[cand_idx].set(park_now)
+                cq_usage, adm_c, park_c, any_admitted = _round_scan(
+                    t_cand, usage, usage, adm_c, park_c,
+                    cand_idx.astype(jnp.int32), mode, k_chosen, borrow)
+                usage = refresh_cohort_usage(tl, cq_usage)
+
+                # --- scatter results back to the local shard -----------
+                adm_slot = adm_c[:C]
+                park_slot = park_c[:C]
+                # Scatter-or (duplicate clipped indices from non-owned
+                # slots must not clobber owned writes).
+                admitted = admitted.at[lw].max(mine & adm_slot)
+                parked = parked.at[lw].max(mine & park_slot)
+                keep = is_head & ~adm_slot
+                cursor_next = jnp.where(keep, next_cursor, 0)
+                cursor_changed = jnp.any(
+                    is_head & (cursor_next != cursor_eff[:C]))
+                cursor_c = cursor_c.at[:C].set(cursor_next)
+
+                # Progress must be computed from values replicated across
+                # devices (heads are never already-parked, so any park
+                # this round shows up in park_slot & is_head).
+                progress = (any_admitted
+                            | jnp.any(park_slot & is_head)
+                            | cursor_changed)
+                return (usage, admitted, parked, cursor_c, head_w,
+                        progress, rounds + 1)
+
+            init = (
+                tl.usage0,
+                # admitted/parked are per-shard state: mark them varying
+                # over the mesh axis so the carry types line up.
+                jax.lax.pcast(jnp.zeros((shard,), dtype=bool), (axis,), to='varying'),
+                jax.lax.pcast(jnp.zeros((shard,), dtype=bool), (axis,), to='varying'),
+                jnp.zeros((C + 1,), dtype=jnp.int32),
+                jnp.full((C,), BIG, dtype=jnp.int32),
+                jnp.ones((), dtype=bool),
+                jnp.zeros((), dtype=jnp.int32),
+            )
+            usage, admitted, parked, _, _, _, rounds = jax.lax.while_loop(
+                cond, body, init)
+            return admitted, parked, rounds, usage
+
+        return run(t)
+
+    return drain
+
+
+def solve_backlog_sharded(problem: SolverProblem, mesh: Mesh,
+                          axis: str = "wl"):
+    """Shard, place, and drain a problem over the mesh. Returns
+    (admitted [W+1] bool on host, parked, rounds, usage)."""
+    from kueue_oss_tpu.solver.kernels import to_device
+
+    n_dev = mesh.shape[axis]
+    padded = pad_workloads(problem, n_dev)
+    t = to_device(padded)
+    sharding = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    t = t._replace(
+        wl_cqid=jax.device_put(t.wl_cqid, sharding),
+        wl_rank=jax.device_put(t.wl_rank, sharding),
+        wl_prio=jax.device_put(t.wl_prio, sharding),
+        wl_ts=jax.device_put(t.wl_ts, sharding),
+        wl_uid=jax.device_put(t.wl_uid, sharding),
+        wl_req=jax.device_put(t.wl_req, sharding),
+        wl_valid=jax.device_put(t.wl_valid, sharding),
+        usage0=jax.device_put(t.usage0, rep),
+    )
+    drain = jax.jit(make_sharded_drain(mesh, axis))
+    admitted, parked, rounds, usage = drain(t)
+    W1 = problem.wl_cqid.shape[0]
+    admitted = np.asarray(admitted)[:W1].copy()
+    parked = np.asarray(parked)[:W1].copy()
+    admitted[-1] = False
+    parked[-1] = False
+    return admitted, parked, int(np.asarray(rounds)), np.asarray(usage)

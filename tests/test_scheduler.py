@@ -89,19 +89,32 @@ class Harness:
         stats = None
         for _ in range(n):
             self._t += 1.0
+            self.scheduler.requeue_due(self._t)
             stats = self.scheduler.schedule(now=self._t)
         return stats
 
     def settle(self, max_cycles=50):
+        idle = 0
         for _ in range(max_cycles):
             pre = self.scheduler._queue_fingerprint()
             self._t += 1.0
+            self.scheduler.requeue_due(self._t)
             stats = self.scheduler.schedule(now=self._t)
-            if stats.heads == 0:
+            if stats.heads == 0 and self.scheduler.next_requeue_at() is None:
                 break
             if (stats.admitted == 0 and stats.preempted == 0
                     and self.scheduler._queue_fingerprint() == pre):
-                break
+                idle += 1
+                # allow pending eviction backoffs to expire before giving up
+                if idle > 3 and self.scheduler.next_requeue_at() is None:
+                    break
+                nxt = self.scheduler.next_requeue_at()
+                if nxt is not None:
+                    self._t = max(self._t, nxt)
+                elif idle > 3:
+                    break
+            else:
+                idle = 0
 
     def finish(self, key):
         self._t += 1.0
