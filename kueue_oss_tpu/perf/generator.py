@@ -93,7 +93,6 @@ def generate(config: GeneratorConfig) -> tuple[Store, list[GeneratedWorkload]]:
     store = Store()
     store.upsert_resource_flavor(ResourceFlavor(name="default"))
     schedule: list[GeneratedWorkload] = []
-    uid = 0
     for ci in range(config.n_cohorts):
         store.upsert_cohort(Cohort(name=f"cohort-{ci}"))
         for qi in range(config.cqs_per_cohort):
@@ -119,7 +118,6 @@ def generate(config: GeneratorConfig) -> tuple[Store, list[GeneratedWorkload]]:
             for wc in config.classes:
                 for i in range(wc.count):
                     arrival = i * wc.creation_interval_ms
-                    uid += 1
                     wl = Workload(
                         name=f"{wc.class_name}-{cq_name}-{i}",
                         queue_name=f"lq-{cq_name}",

@@ -59,6 +59,15 @@ class Simulator:
         self.scheduler = Scheduler(store, self.queues,
                                    enable_fair_sharing=enable_fair_sharing)
         self.by_key = {g.workload.key: g for g in schedule}
+        #: workload keys touched since the last admission/eviction sweep —
+        #: keeps the sweep O(changed) instead of O(all workloads)
+        self._dirty: set[str] = set()
+        store.watch(self._on_event)
+
+    def _on_event(self, event) -> None:
+        verb, kind, obj = event
+        if kind == "Workload":
+            self._dirty.add(obj.key)
 
     def run(self, max_events: int = 10_000_000) -> SimStats:
         stats = SimStats(total_workloads=len(self.schedule))
@@ -107,7 +116,11 @@ class Simulator:
             stats.cycles += cycles
 
             # record admissions/evictions, schedule finish + wake events
-            for key, wl in self.store.workloads.items():
+            dirty, self._dirty = self._dirty, set()
+            for key in dirty:
+                wl = self.store.workloads.get(key)
+                if wl is None:
+                    continue
                 if wl.is_quota_reserved and key not in admitted_at:
                     admitted_at[key] = now_ms
                     g = self.by_key[key]

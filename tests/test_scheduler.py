@@ -111,8 +111,6 @@ class Harness:
                 nxt = self.scheduler.next_requeue_at()
                 if nxt is not None:
                     self._t = max(self._t, nxt)
-                elif idle > 3:
-                    break
             else:
                 idle = 0
 
