@@ -157,6 +157,27 @@ class Topology:
     levels: list[str] = field(default_factory=list)
 
 
+#: Label key marking the host level of a topology (kubernetes.io/hostname).
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+
+
+@dataclass
+class Node:
+    """Cluster node feeding TAS capacity (reference parity: corev1.Node as
+    consumed by pkg/cache/scheduler/tas_nodes_cache.go)."""
+
+    name: str
+    labels: dict[str, str] = field(default_factory=dict)
+    #: allocatable capacity in canonical units; "pods" defaults to 110
+    allocatable: dict[str, int] = field(default_factory=dict)
+    taints: list[Taint] = field(default_factory=list)
+    ready: bool = True
+
+    def __post_init__(self) -> None:
+        self.labels.setdefault(HOSTNAME_LABEL, self.name)
+        self.allocatable.setdefault("pods", 110)
+
+
 # ---------------------------------------------------------------------------
 # Quota model
 # ---------------------------------------------------------------------------
@@ -400,6 +421,10 @@ class WorkloadStatus:
     admission_checks: dict[str, AdmissionCheckState] = field(default_factory=dict)
     requeue_state: Optional[RequeueState] = None
     eviction_stats: list[WorkloadSchedulingStatsEviction] = field(default_factory=list)
+    #: names of nodes in this workload's topology assignment that became
+    #: unhealthy (reference: workload_types.go UnhealthyNodes, KEP TAS
+    #: failed-node replacement)
+    unhealthy_nodes: list[str] = field(default_factory=list)
 
 
 _uid_counter = itertools.count(1)

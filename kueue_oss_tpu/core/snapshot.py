@@ -24,6 +24,12 @@ from kueue_oss_tpu.core.quota import (
 )
 from kueue_oss_tpu.core.store import Store
 from kueue_oss_tpu.core.workload_info import WorkloadInfo
+from kueue_oss_tpu.tas.snapshot import (
+    TASAssignmentResult,
+    TASFlavorSnapshot,
+    TASPodSetRequest,
+    build_tas_flavor_snapshot,
+)
 
 
 class CohortSnapshot:
@@ -99,6 +105,42 @@ class ClusterQueueSnapshot:
         self.generation = generation
         #: admitted workloads (holding quota) by workload key
         self.workloads: dict[str, WorkloadInfo] = {}
+        # TAS lookups are hot (checked per podset x flavor candidate);
+        # the snapshot is immutable for the cycle, so compute once.
+        cq_flavors = [fq.name for rg in spec.resource_groups
+                      for fq in rg.flavors]
+        self.tas_flavors: dict[str, TASFlavorSnapshot] = {
+            f: snapshot.tas_flavors[f] for f in cq_flavors
+            if f in snapshot.tas_flavors
+        }
+        self._tas_only = bool(cq_flavors) and (
+            len(self.tas_flavors) == len(set(cq_flavors)))
+
+    # -- TAS ---------------------------------------------------------------
+
+    def is_tas_only(self) -> bool:
+        """True when every flavor in the CQ is a TAS flavor
+        (reference: ClusterQueueSnapshot.IsTASOnly)."""
+        return self._tas_only
+
+    def find_topology_assignments_for_workload(
+        self,
+        tas_requests: dict[str, list[TASPodSetRequest]],
+        simulate_empty: bool = False,
+        workload=None,
+    ) -> dict[str, TASAssignmentResult]:
+        """Per-flavor placement (clusterqueue_snapshot.go:191)."""
+        result: dict[str, TASAssignmentResult] = {}
+        for flavor, requests in tas_requests.items():
+            snap = self._snapshot.tas_flavors.get(flavor)
+            if snap is None:
+                for tr in requests:
+                    result[tr.podset.name] = TASAssignmentResult(
+                        failure=f"flavor {flavor} has no TAS information")
+                continue
+            result.update(snap.find_topology_assignments(
+                requests, simulate_empty=simulate_empty, workload=workload))
+        return result
 
     # -- hierarchy ---------------------------------------------------------
 
@@ -197,11 +239,15 @@ class Snapshot:
         cluster_queues: dict[str, ClusterQueueSnapshot],
         resource_flavors: dict[str, ResourceFlavor],
         inactive_cluster_queues: frozenset[str] = frozenset(),
+        tas_flavors: Optional[dict[str, TASFlavorSnapshot]] = None,
     ) -> None:
         self.forest = forest
         self.cluster_queues = cluster_queues
         self.resource_flavors = resource_flavors
         self.inactive_cluster_queues = inactive_cluster_queues
+        #: TAS snapshots keyed by ResourceFlavor name (flavors naming a
+        #: Topology); shared across CQs — the nodes are physical
+        self.tas_flavors: dict[str, TASFlavorSnapshot] = tas_flavors or {}
         self._cohort_snapshots: dict[int, CohortSnapshot] = {}
         self._node_to_cq: dict[int, ClusterQueueSnapshot] = {
             id(cq.node): cq for cq in cluster_queues.values()
@@ -222,15 +268,46 @@ class Snapshot:
 
     # -- workload add/remove (preemption simulation) -----------------------
 
+    def _tas_usage_entries(self, info: WorkloadInfo):
+        """Yield (flavor, domain_values, per_pod_requests, count) for every
+        TAS domain assignment held by an admitted workload."""
+        wl = info.obj
+        if wl.status.admission is None or not self.tas_flavors:
+            return
+        podsets = {ps.name: ps for ps in wl.podsets}
+        for psa in wl.status.admission.podset_assignments:
+            ta = psa.topology_assignment
+            if ta is None:
+                continue
+            flavor = next(
+                (f for f in psa.flavors.values() if f in self.tas_flavors),
+                None)
+            if flavor is None:
+                continue
+            ps = podsets.get(psa.name)
+            per_pod = dict(ps.requests) if ps is not None else {}
+            for dom in ta.domains:
+                yield flavor, tuple(dom.values), per_pod, dom.count
+
+    def _apply_tas_usage(self, info: WorkloadInfo, sign: int) -> None:
+        for flavor, values, per_pod, count in self._tas_usage_entries(info):
+            snap = self.tas_flavors[flavor]
+            if sign > 0:
+                snap.add_tas_usage(values, per_pod, count)
+            else:
+                snap.remove_tas_usage(values, per_pod, count)
+
     def remove_workload(self, info: WorkloadInfo) -> None:
         cq = self.cluster_queues[info.cluster_queue]
         cq.workloads.pop(info.key, None)
         cq.remove_usage(info.usage())
+        self._apply_tas_usage(info, -1)
 
     def add_workload(self, info: WorkloadInfo) -> None:
         cq = self.cluster_queues[info.cluster_queue]
         cq.workloads[info.key] = info
         cq.add_usage(info.usage())
+        self._apply_tas_usage(info, +1)
 
     def simulate_workload_removal(
         self, infos: list[WorkloadInfo]
@@ -238,18 +315,32 @@ class Snapshot:
         """Remove only the usage (not queue membership); O(1) revert."""
         for info in infos:
             self.cluster_queues[info.cluster_queue].remove_usage(info.usage())
+            self._apply_tas_usage(info, -1)
 
         def revert() -> None:
             for info in infos:
                 self.cluster_queues[info.cluster_queue].add_usage(info.usage())
+                self._apply_tas_usage(info, +1)
 
         return revert
 
 
-def build_snapshot(store: Store) -> Snapshot:
+def build_snapshot(store: Store, profile_mixed: bool = False) -> Snapshot:
     """Build a cycle snapshot from the store's current state."""
     forest = QuotaForest()
     forest.build(store.cluster_queues.values(), store.cohorts.values())
+
+    tas_flavors: dict[str, TASFlavorSnapshot] = {}
+    for rf in store.resource_flavors.values():
+        if rf.topology_name is None:
+            continue
+        topology = store.topologies.get(rf.topology_name)
+        if topology is None:
+            continue
+        tas_flavors[rf.name] = build_tas_flavor_snapshot(
+            topology.name, topology.levels, store.nodes.values(),
+            flavor_node_labels=rf.node_labels, tolerations=rf.tolerations,
+            profile_mixed=profile_mixed)
 
     cqs: dict[str, ClusterQueueSnapshot] = {}
     snapshot = Snapshot(
@@ -260,6 +351,7 @@ def build_snapshot(store: Store) -> Snapshot:
             name for name, cq in store.cluster_queues.items()
             if cq.stop_policy != "None"
         ),
+        tas_flavors=tas_flavors,
     )
     for name, spec in store.cluster_queues.items():
         cqs[name] = ClusterQueueSnapshot(

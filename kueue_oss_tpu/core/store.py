@@ -18,6 +18,7 @@ from kueue_oss_tpu.api.types import (
     ClusterQueue,
     Cohort,
     LocalQueue,
+    Node,
     ResourceFlavor,
     Topology,
     Workload,
@@ -38,6 +39,7 @@ class Store:
         self.admission_checks: dict[str, AdmissionCheck] = {}
         self.priority_classes: dict[str, WorkloadPriorityClass] = {}
         self.workloads: dict[str, Workload] = {}  # key "ns/name"
+        self.nodes: dict[str, Node] = {}
         self.namespaces: dict[str, dict[str, str]] = {"default": {}}
         #: bumped whenever a CQ's quota config changes; invalidates flavor cursors
         self.cq_generation: dict[str, int] = {}
@@ -90,6 +92,17 @@ class Store:
         with self._lock:
             self.priority_classes[pc.name] = pc
         self._emit("update", "WorkloadPriorityClass", pc)
+
+    def upsert_node(self, node: Node) -> None:
+        with self._lock:
+            self.nodes[node.name] = node
+        self._emit("update", "Node", node)
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            node = self.nodes.pop(name, None)
+        if node is not None:
+            self._emit("delete", "Node", node)
 
     def add_workload(self, wl: Workload) -> None:
         with self._lock:

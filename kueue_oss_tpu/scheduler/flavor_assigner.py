@@ -21,12 +21,14 @@ from kueue_oss_tpu.api.types import (
     PreemptionPolicyValue,
     ResourceFlavor,
     Taint,
+    TopologyAssignment,
 )
 from kueue_oss_tpu.core.snapshot import ClusterQueueSnapshot
 from kueue_oss_tpu.core.workload_info import (
     AssignmentClusterQueueState,
     WorkloadInfo,
 )
+from kueue_oss_tpu.tas.snapshot import TASPodSetRequest
 
 # FlavorAssignmentMode — public lattice (flavorassigner.go:362-377).
 NO_FIT = 0
@@ -118,6 +120,7 @@ class PodSetAssignmentResult:
     requests: dict[str, int] = field(default_factory=dict)
     flavors: dict[str, FlavorAssignmentRec] = field(default_factory=dict)
     reasons: list[str] = field(default_factory=list)
+    topology_assignment: Optional[TopologyAssignment] = None
 
     def representative_mode(self) -> int:
         if self.requests and not self.flavors:
@@ -126,6 +129,14 @@ class PodSetAssignmentResult:
         for rec in self.flavors.values():
             mode = min(mode, rec.mode)
         return mode
+
+    def set_mode(self, mode: int) -> None:
+        for rec in self.flavors.values():
+            rec.mode = mode
+
+    def cap_mode(self, mode: int) -> None:
+        for rec in self.flavors.values():
+            rec.mode = min(rec.mode, mode)
 
 
 @dataclass
@@ -152,6 +163,12 @@ class Assignment:
 
     def counts(self) -> list[int]:
         return [ps.count for ps in self.podsets]
+
+    def podset_by_name(self, name: str) -> Optional[PodSetAssignmentResult]:
+        for ps in self.podsets:
+            if ps.name == name:
+                return ps
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -235,6 +252,78 @@ def _selector_matches(podset: PodSet, flavor: ResourceFlavor,
         if k in allowed_keys and flavor.node_labels.get(k) != v:
             return False
     return True
+
+
+# ---------------------------------------------------------------------------
+# TAS helpers (reference: flavorassigner/tas_flavorassigner.go)
+# ---------------------------------------------------------------------------
+
+
+def is_tas_requested(podset: PodSet, cq: ClusterQueueSnapshot) -> bool:
+    """Explicit topology request, or implied because the CQ is TAS-only
+    (tas_flavorassigner.go:216-225)."""
+    return podset.topology_request is not None or cq.is_tas_only()
+
+
+def tas_flavor_mismatch(podset: PodSet, flavor: ResourceFlavor,
+                        cq: ClusterQueueSnapshot) -> Optional[str]:
+    """checkPodSetAndFlavorMatchForTAS (tas_flavorassigner.go:170-208)."""
+    if is_tas_requested(podset, cq):
+        if podset.topology_request is None and cq.is_tas_only():
+            return None  # implied: every flavor in the CQ is a TAS flavor
+        if flavor.topology_name is None:
+            return (f"flavor {flavor.name} does not support "
+                    "TopologyAwareScheduling")
+        snap = cq.tas_flavors.get(flavor.name)
+        if snap is None:
+            return f"flavor {flavor.name} information missing in TAS cache"
+        if not snap.has_level(podset):
+            return (f"flavor {flavor.name} does not contain the requested "
+                    "topology level")
+        return None
+    if flavor.topology_name is not None:
+        return f"flavor {flavor.name} supports only TopologyAwareScheduling"
+    return None
+
+
+def workload_topology_requests(
+    wl: WorkloadInfo, cq: ClusterQueueSnapshot, assignment: Assignment
+) -> dict[str, list[TASPodSetRequest]]:
+    """Per-flavor TAS placement requests for a quota-assigned workload
+    (Assignment.WorkloadsTopologyRequests, tas_flavorassigner.go:40-84)."""
+    out: dict[str, list[TASPodSetRequest]] = {}
+    for ps in wl.obj.podsets:
+        if not is_tas_requested(ps, cq):
+            continue
+        psa = assignment.podset_by_name(ps.name)
+        if psa is None or not psa.flavors or psa.count == 0:
+            continue
+        tas_flavor = next(
+            (rec.name for rec in psa.flavors.values()
+             if rec.name in cq.tas_flavors), None)
+        if tas_flavor is None:
+            psa.reasons.append("no TAS flavor assigned")
+            continue
+        out.setdefault(tas_flavor, []).append(TASPodSetRequest(
+            podset=ps,
+            single_pod_requests=dict(ps.requests),
+            count=psa.count,
+            flavor=tas_flavor,
+            implied=ps.topology_request is None,
+            podset_group_name=(
+                ps.topology_request.podset_group_name
+                if ps.topology_request is not None else None),
+        ))
+    return out
+
+
+def update_for_tas_result(assignment: Assignment, result: dict) -> None:
+    """Attach successful topology assignments to their podsets
+    (Assignment.UpdateForTASResult, flavorassigner.go:81-92)."""
+    for name, res in result.items():
+        psa = assignment.podset_by_name(name)
+        if psa is not None and res.assignment is not None:
+            psa.topology_assignment = res.assignment
 
 
 # ---------------------------------------------------------------------------
@@ -323,7 +412,54 @@ class FlavorAssigner:
                 self._append(assignment, psa, i)
             if failed:
                 return assignment
+        self._update_for_tas(assignment)
         return assignment
+
+    def _update_for_tas(self, assignment: Assignment) -> None:
+        """Topology placement after quota assignment (flavorassigner.go
+        assignFlavors TAS tail, :733-765).
+
+        Fit: place with real usage; a placement failure downgrades the
+        failing podset to Preempt. Preempt (not node-replacement): place
+        on an empty cluster; failure means NoFit even after preempting
+        everything, success keeps the TAS podsets at Preempt because
+        the free quota may be fragmented across domains.
+        """
+        if assignment.representative_mode() == NO_FIT:
+            return
+        tas_requests = workload_topology_requests(self.wl, self.cq, assignment)
+        if not tas_requests:
+            return
+        if assignment.representative_mode() == FIT:
+            result = self.cq.find_topology_assignments_for_workload(
+                tas_requests, workload=self.wl.obj)
+            failed = False
+            for name, res in result.items():
+                if res.failure:
+                    psa = assignment.podset_by_name(name)
+                    if psa is not None:
+                        psa.reasons.append(res.failure)
+                        psa.set_mode(PREEMPT)
+                    failed = True
+                    break
+            if not failed:
+                update_for_tas_result(assignment, result)
+        if (assignment.representative_mode() == PREEMPT
+                and not self.wl.obj.status.unhealthy_nodes):
+            result = self.cq.find_topology_assignments_for_workload(
+                tas_requests, simulate_empty=True)
+            for name, res in result.items():
+                if res.failure:
+                    psa = assignment.podset_by_name(name)
+                    if psa is not None:
+                        psa.reasons.append(res.failure)
+                        psa.set_mode(NO_FIT)
+                    return
+            for requests in tas_requests.values():
+                for tr in requests:
+                    psa = assignment.podset_by_name(tr.podset.name)
+                    if psa is not None:
+                        psa.cap_mode(PREEMPT)
 
     def _append(self, assignment: Assignment,
                 psa: PodSetAssignmentResult, ps_idx: int) -> None:
@@ -388,6 +524,11 @@ class FlavorAssigner:
                 if not _selector_matches(ps, flavor, allowed_keys):
                     reasons.append(
                         f"flavor {f_name} doesn't match node affinity")
+                    flavor_ok = False
+                    break
+                tas_reason = tas_flavor_mismatch(ps, flavor, self.cq)
+                if tas_reason is not None:
+                    reasons.append(tas_reason)
                     flavor_ok = False
                     break
             if not flavor_ok:

@@ -224,6 +224,8 @@ class Scheduler:
         if mode == fa.PREEMPT:
             targets = self.preemptor.get_targets(info, full, snapshot, now)
             if targets:
+                self._update_assignment_for_tas(
+                    info, cq, snapshot, full, targets)
                 return full, targets
 
         if self.enable_partial_admission and info.can_be_partially_admitted():
@@ -241,8 +243,35 @@ class Scheduler:
             reducer = PodSetReducer(info.obj.podsets, probe)
             result, found = reducer.search()
             if found:
+                if result[1]:
+                    self._update_assignment_for_tas(
+                        info, cq, snapshot, result[0], result[1])
                 return result
         return full, []
+
+    def _update_assignment_for_tas(self, info: WorkloadInfo,
+                                   cq: ClusterQueueSnapshot,
+                                   snapshot: Snapshot,
+                                   assignment: Assignment,
+                                   targets: list[Target]) -> None:
+        """Recompute topology assignments assuming the preemption victims
+        are gone (scheduler.go updateAssignmentForTAS, :759-783)."""
+        if assignment.representative_mode() != fa.PREEMPT:
+            return
+        if not any(fa.is_tas_requested(ps, cq) for ps in info.obj.podsets):
+            return
+        if info.obj.status.unhealthy_nodes:
+            return
+        tas_requests = fa.workload_topology_requests(info, cq, assignment)
+        if not tas_requests:
+            return
+        revert = snapshot.simulate_workload_removal(
+            [t.info for t in targets])
+        try:
+            result = cq.find_topology_assignments_for_workload(tas_requests)
+        finally:
+            revert()
+        fa.update_for_tas_result(assignment, result)
 
     # ------------------------------------------------------------------
     # Iterators
@@ -285,7 +314,7 @@ class Scheduler:
 
         usage = e.assignment_usage()
         if not self._fits(snapshot, cq, usage, preempted_workloads,
-                          e.preemption_targets):
+                          e.preemption_targets, e):
             e.status = SKIPPED
             e.inadmissible_msg = (
                 "Workload no longer fits after processing another workload")
@@ -300,20 +329,68 @@ class Scheduler:
             stats.preempted += len(e.preemption_targets)
             return
 
+        self._assume_tas_usage(e, snapshot)
         e.status = NOMINATED
         self._admit(e, now)
         stats.admitted += 1
 
     @staticmethod
+    def _assume_tas_usage(e: Entry, snapshot: Snapshot) -> None:
+        """Charge the entry's topology assignment to the TAS snapshots so
+        later entries in this cycle see the domain usage (mirrors the
+        reference's assume path covering TAS usage in the cache)."""
+        podsets = {ps.name: ps for ps in e.info.obj.podsets}
+        for psa in e.assignment.podsets:
+            ta = psa.topology_assignment
+            if ta is None:
+                continue
+            flavor = next(
+                (rec.name for rec in psa.flavors.values()
+                 if rec.name in snapshot.tas_flavors), None)
+            if flavor is None:
+                continue
+            ps = podsets.get(psa.name)
+            per_pod = dict(ps.requests) if ps is not None else {}
+            for dom in ta.domains:
+                snapshot.tas_flavors[flavor].add_tas_usage(
+                    dom.values, per_pod, dom.count)
+
+    @staticmethod
     def _fits(snapshot: Snapshot, cq: ClusterQueueSnapshot, usage,
               preempted_workloads: dict[str, WorkloadInfo],
-              targets: list[Target]) -> bool:
+              targets: list[Target], e: Entry) -> bool:
         infos = list(preempted_workloads.values()) + [t.info for t in targets]
         revert = snapshot.simulate_workload_removal(infos)
         try:
-            return cq.fits(usage)
+            return cq.fits(usage) and Scheduler._tas_fits(e, snapshot)
         finally:
             revert()
+
+    @staticmethod
+    def _tas_fits(e: Entry, snapshot: Snapshot) -> bool:
+        """Re-validate the entry's topology assignment against current
+        domain usage: earlier admissions in this cycle charged the TAS
+        snapshots (_assume_tas_usage), which can invalidate a placement
+        computed during nomination."""
+        if e.info.obj.is_quota_reserved:
+            return True
+        podsets = {ps.name: ps for ps in e.info.obj.podsets}
+        for psa in e.assignment.podsets:
+            ta = psa.topology_assignment
+            if ta is None:
+                continue
+            flavor = next(
+                (rec.name for rec in psa.flavors.values()
+                 if rec.name in snapshot.tas_flavors), None)
+            if flavor is None:
+                continue
+            snap = snapshot.tas_flavors[flavor]
+            ps = podsets.get(psa.name)
+            per_pod = dict(ps.requests) if ps is not None else {}
+            for dom in ta.domains:
+                if not snap.fits(dom.values, per_pod, dom.count):
+                    return False
+        return True
 
     def _quota_to_reserve(self, e: Entry, cq: ClusterQueueSnapshot):
         """scheduler.go quotaResourcesToReserve for Preempt-mode entries."""
@@ -353,6 +430,7 @@ class Scheduler:
                     flavors={r: rec.name for r, rec in psa.flavors.items()},
                     resource_usage=dict(psa.requests),
                     count=psa.count,
+                    topology_assignment=psa.topology_assignment,
                 )
                 for psa in e.assignment.podsets
             ],

@@ -1,0 +1,21 @@
+"""Topology-aware scheduling (TAS).
+
+Reference parity: pkg/cache/scheduler/tas_*.go + pkg/controller/tas
+(KEP-2724). Places podsets onto a topology tree (e.g. block > rack > host)
+honoring required/preferred/unconstrained levels, slice grouping, leader
+co-location, and unhealthy-node replacement.
+"""
+
+from kueue_oss_tpu.tas.snapshot import (
+    TASAssignmentResult,
+    TASFlavorSnapshot,
+    TASPodSetRequest,
+    build_tas_flavor_snapshot,
+)
+
+__all__ = [
+    "TASAssignmentResult",
+    "TASFlavorSnapshot",
+    "TASPodSetRequest",
+    "build_tas_flavor_snapshot",
+]

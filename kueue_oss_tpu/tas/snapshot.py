@@ -1,0 +1,959 @@
+"""TAS flavor snapshot: topology tree + two-phase placement.
+
+Reference parity: pkg/cache/scheduler/tas_flavor_snapshot.go (KEP-2724).
+The snapshot holds the topology domain tree for one TAS ResourceFlavor:
+leaves carry free capacity (node allocatable minus non-TAS usage) and
+TAS usage; placement runs in two phases:
+
+  1. fill counts — per-leaf pod/slice/leader capacity from the podset's
+     per-pod requests (after taint/selector/affinity filtering), rolled
+     up the tree (tas_flavor_snapshot.go:1568-1719);
+  2. placement — find the best level/domain set at or above the
+     requested level (findLevelWithFitDomains, :1236-1321), then walk
+     down level-by-level minimizing the number of domains used
+     (updateCountsToMinimumGeneric, :1405-1469), finally emitting the
+     lowest-level assignment (buildAssignment, :1490-1501).
+
+Supported: required/preferred/unconstrained levels, single-layer slice
+grouping (podset_slice_required_topology + size), leader/worker podset
+groups, BestFit and LeastFreeCapacity profiles, unhealthy-node
+replacement (findReplacementAssignment, :614-656). Multi-layer slice
+constraints and balanced placement are not yet implemented.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from kueue_oss_tpu.api.types import (
+    HOSTNAME_LABEL,
+    Node,
+    PodSet,
+    Toleration,
+    TopologyAssignment,
+    TopologyDomainAssignment,
+    Workload,
+)
+
+Requests = dict[str, int]
+
+
+def count_in(requests: Requests, capacity: Requests) -> int:
+    """How many pods with `requests` fit into `capacity`."""
+    fit = 1 << 30
+    for r, q in requests.items():
+        if q <= 0:
+            continue
+        fit = min(fit, capacity.get(r, 0) // q)
+    return max(fit, 0)
+
+
+def _limiting_resource(requests: Requests, capacity: Requests) -> str:
+    for r, q in requests.items():
+        if q > 0 and capacity.get(r, 0) // q <= 0:
+            return r
+    return ""
+
+
+def _add(dst: Requests, src: Requests, scale: int = 1) -> None:
+    for r, q in src.items():
+        dst[r] = dst.get(r, 0) + q * scale
+
+
+def _sub(dst: Requests, src: Requests) -> None:
+    for r, q in src.items():
+        dst[r] = dst.get(r, 0) - q
+
+
+class Domain:
+    """One topology domain (tas_flavor_snapshot.go:51-89).
+
+    `state`/`slice_state`/`leader_state` (+ with-leader variants) are
+    scratch fields of the placement algorithm: in phase 1 they hold how
+    many pods/slices/leaders *can* fit; in phase 2 they are overwritten
+    with how many *are* assigned.
+    """
+
+    __slots__ = ("id", "level_values", "parent", "children", "state",
+                 "slice_state", "state_with_leader",
+                 "slice_state_with_leader", "leader_state")
+
+    def __init__(self, domain_id: tuple[str, ...],
+                 level_values: tuple[str, ...]) -> None:
+        self.id = domain_id
+        self.level_values = level_values
+        self.parent: Optional[Domain] = None
+        self.children: list[Domain] = []
+        self.state = 0
+        self.slice_state = 0
+        self.state_with_leader = 0
+        self.slice_state_with_leader = 0
+        self.leader_state = 0
+
+
+class LeafDomain(Domain):
+    __slots__ = ("free_capacity", "tas_usage", "node")
+
+    def __init__(self, domain_id, level_values) -> None:
+        super().__init__(domain_id, level_values)
+        self.free_capacity: Requests = {}
+        self.tas_usage: Requests = {}
+        self.node: Optional[Node] = None
+
+
+@dataclass
+class TASPodSetRequest:
+    """Placement request for one podset on one TAS flavor
+    (reference: TASPodSetRequests, tas_flavor_snapshot.go:356-367)."""
+
+    podset: PodSet
+    single_pod_requests: Requests
+    count: int
+    flavor: str
+    implied: bool = False
+    podset_group_name: Optional[str] = None
+
+
+@dataclass
+class TASAssignmentResult:
+    assignment: Optional[TopologyAssignment] = None
+    failure: str = ""
+
+
+class TASFlavorSnapshot:
+    """Topology tree for one TAS ResourceFlavor."""
+
+    def __init__(self, topology_name: str, levels: list[str],
+                 tolerations: Optional[list[Toleration]] = None,
+                 profile_mixed: bool = False) -> None:
+        self.topology_name = topology_name
+        self.levels = list(levels)
+        self.tolerations = list(tolerations or [])
+        #: LeastFreeCapacity for unconstrained podsets (TASProfileMixed gate)
+        self.profile_mixed = profile_mixed
+        self.leaves: dict[tuple[str, ...], LeafDomain] = {}
+        self.domains: dict[tuple[str, ...], Domain] = {}
+        self.roots: dict[tuple[str, ...], Domain] = {}
+        self.domains_per_level: list[dict[tuple[str, ...], Domain]] = [
+            {} for _ in levels]
+        self.is_lowest_level_node = (
+            bool(levels) and levels[-1] == HOSTNAME_LABEL)
+
+    # ------------------------------------------------------------------
+    # Construction
+    # ------------------------------------------------------------------
+
+    def add_node(self, node: Node) -> Optional[tuple[str, ...]]:
+        """Register a ready node's capacity under its leaf domain."""
+        values = tuple(node.labels.get(k, "") for k in self.levels)
+        if any(v == "" for v in values):
+            return None  # node not part of this topology
+        leaf = self.leaves.get(values)
+        if leaf is None:
+            leaf = LeafDomain(values, values)
+            self.leaves[values] = leaf
+        if self.is_lowest_level_node:
+            leaf.node = node
+        _add(leaf.free_capacity, node.allocatable)
+        return values
+
+    def initialize(self) -> None:
+        """Connect leaves to parent domains up to the roots."""
+        for leaf in self.leaves.values():
+            self.domains[leaf.id] = leaf
+            self.domains_per_level[len(leaf.level_values) - 1][leaf.id] = leaf
+            self._link_ancestors(leaf)
+
+    def _link_ancestors(self, dom: Domain) -> None:
+        if len(dom.level_values) == 1:
+            self.roots[dom.id] = dom
+            return
+        parent_values = dom.level_values[:-1]
+        parent = self.domains.get(parent_values)
+        if parent is None:
+            parent = Domain(parent_values, parent_values)
+            self.domains[parent_values] = parent
+            self.domains_per_level[len(parent_values) - 1][parent_values] = parent
+            self._link_ancestors(parent)
+        dom.parent = parent
+        parent.children.append(dom)
+
+    def add_non_tas_usage(self, domain_id: tuple[str, ...],
+                          usage: Requests) -> None:
+        leaf = self.leaves.get(domain_id)
+        if leaf is not None:
+            _sub(leaf.free_capacity, usage)
+
+    def add_tas_usage(self, domain_values: Iterable[str],
+                      single_pod_requests: Requests, count: int) -> None:
+        leaf = self._leaf_for_values(tuple(domain_values))
+        if leaf is None:
+            return  # backing node deleted / not ready
+        _add(leaf.tas_usage, single_pod_requests, scale=count)
+        leaf.tas_usage["pods"] = leaf.tas_usage.get("pods", 0) + count
+
+    def remove_tas_usage(self, domain_values: Iterable[str],
+                         single_pod_requests: Requests, count: int) -> None:
+        leaf = self._leaf_for_values(tuple(domain_values))
+        if leaf is None:
+            return
+        _add(leaf.tas_usage, single_pod_requests, scale=-count)
+        leaf.tas_usage["pods"] = leaf.tas_usage.get("pods", 0) - count
+
+    def _leaf_for_values(self, values: tuple[str, ...]) -> Optional[LeafDomain]:
+        """Resolve assignment values (hostname-only or full path) to a leaf."""
+        leaf = self.leaves.get(values)
+        if leaf is not None:
+            return leaf
+        if len(values) == 1 and self.is_lowest_level_node:
+            for candidate in self.leaves.values():
+                if candidate.level_values[-1] == values[0]:
+                    return candidate
+        return None
+
+    def has_node(self, hostname: str) -> bool:
+        return any(leaf.level_values[-1] == hostname
+                   for leaf in self.leaves.values())
+
+    # ------------------------------------------------------------------
+    # Level helpers
+    # ------------------------------------------------------------------
+
+    def level_index(self, key: str) -> Optional[int]:
+        try:
+            return self.levels.index(key)
+        except ValueError:
+            return None
+
+    def has_level(self, podset: PodSet) -> bool:
+        tr = podset.topology_request
+        key = self._level_key(podset)
+        if key is None:
+            return False
+        if self.level_index(key) is None:
+            return False
+        if tr is not None and tr.podset_slice_required_topology is not None:
+            if self.level_index(tr.podset_slice_required_topology) is None:
+                return False
+        return True
+
+    def _level_key(self, podset: PodSet,
+                   implied: bool = False) -> Optional[str]:
+        tr = podset.topology_request
+        if tr is not None:
+            if tr.required is not None:
+                return tr.required
+            if tr.preferred is not None:
+                return tr.preferred
+            if tr.podset_slice_required_topology is not None and not (
+                    tr.required or tr.preferred):
+                return self.levels[0]
+            if tr.unconstrained:
+                return self.levels[-1]
+        if implied:
+            return self.levels[-1]
+        return None
+
+    # ------------------------------------------------------------------
+    # Fit re-check (clusterqueue_snapshot Fits analog)
+    # ------------------------------------------------------------------
+
+    def fits(self, domain_values: Iterable[str],
+             single_pod_requests: Requests, count: int) -> bool:
+        leaf = self._leaf_for_values(tuple(domain_values))
+        if leaf is None:
+            return False
+        remaining = dict(leaf.free_capacity)
+        _sub(remaining, leaf.tas_usage)
+        req = dict(single_pod_requests)
+        req["pods"] = req.get("pods", 0) + 1
+        return count_in(req, remaining) >= count
+
+    # ------------------------------------------------------------------
+    # Main entry: grouped placement over podsets
+    # ------------------------------------------------------------------
+
+    def find_topology_assignments(
+        self,
+        requests: list[TASPodSetRequest],
+        simulate_empty: bool = False,
+        workload: Optional[Workload] = None,
+    ) -> dict[str, TASAssignmentResult]:
+        """Place all podset requests, respecting group co-location and
+        accumulating assumed usage between groups
+        (FindTopologyAssignmentsForFlavor, tas_flavor_snapshot.go:519-594).
+        """
+        result: dict[str, TASAssignmentResult] = {}
+        assumed: dict[tuple[str, ...], Requests] = {}
+
+        groups: dict[str, list[TASPodSetRequest]] = {}
+        order: list[str] = []
+        for idx, tr in enumerate(requests):
+            key = tr.podset_group_name or f"__solo_{idx}"
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(tr)
+
+        unhealthy = list(workload.status.unhealthy_nodes) if workload else []
+        for key in order:
+            trs = groups[key]
+            if unhealthy:
+                for tr in trs:
+                    res = self._replace_unhealthy(tr, workload, unhealthy[0],
+                                                  assumed)
+                    result[tr.podset.name] = res
+                    if res.failure:
+                        return result
+                continue
+
+            if len(trs) > 2:
+                reason = (f"podset group {key!r} has {len(trs)} podsets; "
+                          "at most 2 (leader + workers) are supported")
+                for tr in trs:
+                    result[tr.podset.name] = TASAssignmentResult(
+                        failure=reason)
+                return result
+            leader, workers = self._split_leader(trs)
+            if leader is not None and leader.count != 1:
+                reason = (f"leader podset {leader.podset.name!r} must have "
+                          f"count 1, got {leader.count}")
+                for tr in trs:
+                    result[tr.podset.name] = TASAssignmentResult(
+                        failure=reason)
+                return result
+            assignments, reason = self._place(workers, leader, assumed,
+                                              simulate_empty)
+            for tr in trs:
+                result[tr.podset.name] = TASAssignmentResult(
+                    assignment=assignments.get(tr.podset.name),
+                    failure=reason)
+            if reason:
+                return result
+            for tr in trs:
+                self._assume(assumed, assignments.get(tr.podset.name), tr)
+        return result
+
+    @staticmethod
+    def _split_leader(trs: list[TASPodSetRequest]):
+        """Two grouped podsets = (leader, workers), leader has the lower
+        count (findLeaderAndWorkers, tas_flavor_snapshot.go:596-609)."""
+        workers = trs[0]
+        leader = None
+        if len(trs) > 1:
+            leader = trs[1]
+            if leader.count > workers.count:
+                leader, workers = workers, leader
+        return leader, workers
+
+    def _assume(self, assumed, ta: Optional[TopologyAssignment],
+                tr: TASPodSetRequest) -> None:
+        if ta is None:
+            return
+        for dom in ta.domains:
+            leaf = self._leaf_for_values(tuple(dom.values))
+            if leaf is None:
+                continue
+            bucket = assumed.setdefault(leaf.id, {})
+            _add(bucket, tr.single_pod_requests, scale=dom.count)
+            bucket["pods"] = bucket.get("pods", 0) + dom.count
+
+    # ------------------------------------------------------------------
+    # Unhealthy-node replacement
+    # ------------------------------------------------------------------
+
+    def _replace_unhealthy(self, tr: TASPodSetRequest,
+                           workload: Workload, unhealthy_node: str,
+                           assumed) -> TASAssignmentResult:
+        """Re-place only the pods that sat on the unhealthy node, keeping
+        the rest of the assignment (findReplacementAssignment,
+        tas_flavor_snapshot.go:614-656)."""
+        psa = None
+        if workload.status.admission is not None:
+            for cand in workload.status.admission.podset_assignments:
+                if cand.name == tr.podset.name:
+                    psa = cand
+        if psa is None or psa.topology_assignment is None:
+            return TASAssignmentResult()
+        existing = TopologyAssignment(
+            levels=list(psa.topology_assignment.levels),
+            domains=[TopologyDomainAssignment(list(d.values), d.count)
+                     for d in psa.topology_assignment.domains
+                     if d.values[-1] != unhealthy_node],
+        )
+        missing = sum(
+            d.count for d in psa.topology_assignment.domains
+            if d.values[-1] == unhealthy_node)
+        for dom in existing.domains:
+            if self._leaf_for_values(tuple(dom.values)) is None:
+                return TASAssignmentResult(failure=(
+                    f"existing topology assignment contains stale domain "
+                    f"{dom.values}"))
+        if missing == 0:
+            return TASAssignmentResult(assignment=existing)
+
+        required_domain = self._required_replacement_domain(
+            tr, existing, missing)
+        sub = TASPodSetRequest(
+            podset=tr.podset, single_pod_requests=tr.single_pod_requests,
+            count=missing, flavor=tr.flavor, implied=tr.implied)
+        assignments, reason = self._place(
+            sub, None, assumed, False,
+            required_replacement_domain=required_domain,
+            excluded_node=unhealthy_node)
+        if reason:
+            return TASAssignmentResult(failure=reason)
+        replacement = assignments.get(tr.podset.name)
+        if replacement is None or not replacement.domains:
+            return TASAssignmentResult(failure=(
+                f"cannot find replacement assignment for unhealthy node "
+                f"{unhealthy_node}"))
+        merged = self._merge_assignments(existing, replacement)
+        self._assume(assumed, replacement, sub)
+        return TASAssignmentResult(assignment=merged)
+
+    def _required_replacement_domain(
+        self, tr: TASPodSetRequest, existing: TopologyAssignment,
+        missing: int
+    ) -> Optional[tuple[str, ...]]:
+        """Confine the replacement to the domain whose required-level or
+        slice grouping the failure broke (requiredReplacementDomain,
+        tas_flavor_snapshot.go:680-731)."""
+        key = self._level_key(tr.podset, tr.implied)
+        if key is None:
+            return None
+        level_idx = self.level_index(key)
+        if level_idx is None or not existing.domains:
+            return None
+        tr_req = tr.podset.topology_request
+
+        slice_size = 1
+        if tr_req is not None and tr_req.podset_slice_required_topology:
+            slice_size = tr_req.podset_slice_size or 1
+        if slice_size > 1 and missing % slice_size != 0:
+            slice_level = self.level_index(
+                tr_req.podset_slice_required_topology)
+            if slice_level is None:
+                return None
+            per_domain: dict[tuple[str, ...], int] = {}
+            for dom in existing.domains:
+                leaf = self._leaf_for_values(tuple(dom.values))
+                if leaf is None:
+                    continue
+                anc = leaf.level_values[:slice_level + 1]
+                per_domain[anc] = per_domain.get(anc, 0) + dom.count
+            for domain_id, cnt in per_domain.items():
+                if (cnt + missing) % slice_size == 0:
+                    return domain_id
+            return None
+
+        if tr_req is None or tr_req.required is None:
+            return None
+        leaf = self._leaf_for_values(tuple(existing.domains[0].values))
+        if leaf is None:
+            return None
+        return leaf.level_values[:level_idx + 1]
+
+    def _merge_assignments(self, a: TopologyAssignment,
+                           b: TopologyAssignment) -> TopologyAssignment:
+        by_values: dict[tuple[str, ...], int] = {}
+        for dom in list(a.domains) + list(b.domains):
+            key = tuple(dom.values)
+            by_values[key] = by_values.get(key, 0) + dom.count
+
+        def sort_key(values: tuple[str, ...]):
+            leaf = self._leaf_for_values(values)
+            return leaf.level_values if leaf is not None else values
+
+        return TopologyAssignment(
+            levels=list(a.levels),
+            domains=[
+                TopologyDomainAssignment(list(v), by_values[v])
+                for v in sorted(by_values, key=sort_key)
+            ],
+        )
+
+    # ------------------------------------------------------------------
+    # Phase 1: capacity counting
+    # ------------------------------------------------------------------
+
+    def _fill_in_counts(
+        self,
+        tr: TASPodSetRequest,
+        leader: Optional[TASPodSetRequest],
+        assumed,
+        simulate_empty: bool,
+        slice_size: int,
+        slice_level_idx: int,
+        required_replacement_domain: Optional[tuple[str, ...]],
+        excluded_node: Optional[str] = None,
+    ) -> dict:
+        """Compute per-leaf pod/leader capacity and roll it up
+        (fillInCounts, tas_flavor_snapshot.go:1568-1646)."""
+        for dom in self.domains.values():
+            dom.state = dom.state_with_leader = 0
+            dom.slice_state = dom.slice_state_with_leader = 0
+            dom.leader_state = 0
+
+        req = dict(tr.single_pod_requests)
+        req["pods"] = req.get("pods", 0) + 1
+        leader_req = None
+        if leader is not None:
+            leader_req = dict(leader.single_pod_requests)
+            leader_req["pods"] = leader_req.get("pods", 0) + 1
+
+        tolerations = list(tr.podset.tolerations) + self.tolerations
+        stats = {"taints": 0, "selector": 0, "domain": 0, "resources": {},
+                 "total": 0}
+        for leaf in self.leaves.values():
+            stats["total"] += 1
+            if excluded_node is not None and (
+                    leaf.level_values[-1] == excluded_node):
+                stats["domain"] += 1
+                continue
+            if self.is_lowest_level_node and leaf.node is not None:
+                taint = self._untolerated(leaf.node, tolerations)
+                if taint is not None:
+                    stats["taints"] += 1
+                    continue
+                if not all(leaf.node.labels.get(k) == v
+                           for k, v in tr.podset.node_selector.items()):
+                    stats["selector"] += 1
+                    continue
+            if required_replacement_domain is not None and (
+                    leaf.level_values[:len(required_replacement_domain)]
+                    != required_replacement_domain):
+                stats["domain"] += 1
+                continue
+            remaining = dict(leaf.free_capacity)
+            if not simulate_empty:
+                _sub(remaining, leaf.tas_usage)
+            if leaf.id in assumed:
+                _sub(remaining, assumed[leaf.id])
+            leaf.state = count_in(req, remaining)
+            if leaf.state == 0:
+                limiting = _limiting_resource(req, remaining)
+                if limiting:
+                    stats["resources"][limiting] = (
+                        stats["resources"].get(limiting, 0) + 1)
+            leaf.leader_state = 0
+            if leader_req is not None and count_in(leader_req, remaining) > 0:
+                leaf.leader_state = 1
+                _sub(remaining, leader_req)
+            leaf.state_with_leader = count_in(req, remaining)
+        leader_required = leader is not None
+        for root in self.roots.values():
+            self._roll_up(root, slice_size, slice_level_idx, 0,
+                          leader_required)
+        return stats
+
+    @staticmethod
+    def _untolerated(node: Node, tolerations: list[Toleration]):
+        for taint in node.taints:
+            if taint.effect not in ("NoSchedule", "NoExecute"):
+                continue
+            if not any(t.tolerates(taint) for t in tolerations):
+                return taint
+        return None
+
+    def _roll_up(self, dom: Domain, slice_size: int, slice_level_idx: int,
+                 level: int, leader_required: bool) -> None:
+        """fillInCountsHelper (tas_flavor_snapshot.go:1658-1719)."""
+        if not dom.children:
+            if level == slice_level_idx:
+                dom.slice_state = dom.state // slice_size
+                dom.slice_state_with_leader = (
+                    dom.state_with_leader // slice_size)
+            return
+        total = 0
+        slice_total = 0
+        has_leader_contributor = False
+        min_state_diff = 1 << 30
+        min_slice_diff = 1 << 30
+        leader_state = 0
+        for child in dom.children:
+            self._roll_up(child, slice_size, slice_level_idx, level + 1,
+                          leader_required)
+            total += child.state
+            slice_total += child.slice_state
+            if not leader_required or child.leader_state > 0:
+                has_leader_contributor = True
+                min_state_diff = min(
+                    min_state_diff, child.state - child.state_with_leader)
+                min_slice_diff = min(
+                    min_slice_diff,
+                    child.slice_state - child.slice_state_with_leader)
+            leader_state = max(leader_state, child.leader_state)
+        dom.state = total
+        dom.leader_state = leader_state
+        slice_with_leader = 0
+        if has_leader_contributor:
+            dom.state_with_leader = total - min_state_diff
+            slice_with_leader = slice_total - min_slice_diff
+        else:
+            dom.state_with_leader = 0
+        if level == slice_level_idx:
+            slice_total = dom.state // slice_size
+            slice_with_leader = dom.state_with_leader // slice_size
+        dom.slice_state = slice_total
+        dom.slice_state_with_leader = slice_with_leader
+
+    # ------------------------------------------------------------------
+    # Phase 2: placement
+    # ------------------------------------------------------------------
+
+    def _place(
+        self,
+        tr: TASPodSetRequest,
+        leader: Optional[TASPodSetRequest],
+        assumed,
+        simulate_empty: bool,
+        required_replacement_domain: Optional[tuple[str, ...]] = None,
+        excluded_node: Optional[str] = None,
+    ) -> tuple[dict[str, TopologyAssignment], str]:
+        """findTopologyAssignment (tas_flavor_snapshot.go:804-999)."""
+        tr_req = tr.podset.topology_request
+        required = tr_req is not None and tr_req.required is not None
+        unconstrained = (
+            (tr_req is not None and tr_req.unconstrained) or tr.implied
+            or (tr_req is not None
+                and tr_req.podset_slice_required_topology is not None
+                and tr_req.required is None and tr_req.preferred is None))
+
+        key = self._level_key(tr.podset, tr.implied)
+        if key is None:
+            return {}, "topology level not specified"
+        level_idx = self.level_index(key)
+        if level_idx is None:
+            return {}, f"no requested topology level: {key}"
+
+        slice_size = 1
+        slice_level_idx = len(self.levels) - 1
+        if tr_req is not None and tr_req.podset_slice_required_topology:
+            idx = self.level_index(tr_req.podset_slice_required_topology)
+            if idx is None:
+                return {}, (
+                    "no requested topology level for slices: "
+                    f"{tr_req.podset_slice_required_topology}")
+            slice_level_idx = idx
+            if tr_req.podset_slice_size is None:
+                return {}, "slice topology requested, but slice size not provided"
+            slice_size = tr_req.podset_slice_size
+            if level_idx > slice_level_idx:
+                return {}, (
+                    f"podset slice topology "
+                    f"{tr_req.podset_slice_required_topology} is above the "
+                    f"podset topology {key}")
+            if tr.count % slice_size != 0:
+                return {}, (
+                    f"pod count {tr.count} not divisible by slice size "
+                    f"{slice_size}")
+
+        leader_count = 1 if leader is not None else 0
+        stats = self._fill_in_counts(
+            tr, leader, assumed, simulate_empty, slice_size, slice_level_idx,
+            required_replacement_domain, excluded_node=excluded_node)
+
+        least_free = unconstrained and self.profile_mixed
+        fit_level, fit_domains, reason = self._find_level_with_fit(
+            level_idx, tr.count, leader_count, slice_size, required,
+            unconstrained, least_free, stats)
+        if reason:
+            return {}, reason
+
+        fit_domains = self._consume_minimum(
+            fit_domains, tr.count, leader_count, slice_size, least_free,
+            slices=True)
+        cur_level = fit_level
+        while cur_level < min(len(self.levels) - 1, slice_level_idx):
+            lower = [c for d in fit_domains for c in d.children]
+            fit_domains = self._consume_minimum(
+                self._sorted(lower, least_free), tr.count, leader_count,
+                slice_size, least_free, slices=True)
+            cur_level += 1
+        while cur_level < len(self.levels) - 1:
+            new_fit: list[Domain] = []
+            for dom in fit_domains:
+                children = self._sorted(dom.children, least_free)
+                new_fit.extend(self._consume_minimum(
+                    children, dom.state, dom.leader_state, 1, least_free,
+                    slices=False))
+            fit_domains = new_fit
+            cur_level += 1
+
+        assignments: dict[str, TopologyAssignment] = {}
+        if leader is not None:
+            leader_domains = []
+            worker_domains = []
+            for dom in fit_domains:
+                if dom.leader_state > 0:
+                    copy = Domain(dom.id, dom.level_values)
+                    copy.state = dom.leader_state
+                    leader_domains.append(copy)
+                if dom.state > 0:
+                    worker_domains.append(dom)
+            assignments[leader.podset.name] = self._build(leader_domains)
+            fit_domains = worker_domains
+        assignments[tr.podset.name] = self._build(fit_domains)
+        return assignments, ""
+
+    def _find_level_with_fit(self, level_idx: int, count: int,
+                             leader_count: int, slice_size: int,
+                             required: bool, unconstrained: bool,
+                             least_free: bool, stats) -> tuple:
+        """findLevelWithFitDomains (tas_flavor_snapshot.go:1236-1321)."""
+        domains = list(self.domains_per_level[level_idx].values())
+        if not domains:
+            return 0, None, f"no topology domains at level: {self.levels[level_idx]}"
+        sorted_doms = self._sorted_with_leader(domains, least_free)
+        top = sorted_doms[0]
+        slice_count = count // slice_size
+
+        if (not least_free and top.slice_state_with_leader >= slice_count
+                and top.leader_state >= leader_count):
+            top = self._best_fit_slices(sorted_doms, slice_count, leader_count)
+
+        if least_free:
+            for cand in sorted_doms:
+                if cand.slice_state >= slice_count:
+                    return level_idx, [cand], ""
+            if required:
+                return 0, None, self._not_fit_message(
+                    sorted_doms[-1].state, slice_count, slice_size, stats)
+
+        if top.slice_state_with_leader < slice_count or (
+                top.leader_state < leader_count):
+            if required:
+                return 0, None, self._not_fit_message(
+                    top.slice_state, slice_count, slice_size, stats)
+            if level_idx > 0 and not unconstrained:
+                return self._find_level_with_fit(
+                    level_idx - 1, count, leader_count, slice_size, required,
+                    unconstrained, least_free, stats)
+            # accumulate multiple domains greedily, leaders first
+            results: list[Domain] = []
+            remaining_slices = slice_count
+            remaining_leaders = leader_count
+            idx = 0
+            while (remaining_leaders > 0 and idx < len(sorted_doms)
+                   and sorted_doms[idx].leader_state > 0):
+                dom = sorted_doms[idx]
+                if (not least_free
+                        and dom.slice_state_with_leader >= remaining_slices):
+                    dom = self._best_fit_slices(
+                        sorted_doms[idx:], remaining_slices, remaining_leaders)
+                results.append(dom)
+                remaining_leaders -= dom.leader_state
+                remaining_slices -= dom.slice_state_with_leader
+                idx += 1
+            if remaining_leaders > 0:
+                return 0, None, self._not_fit_message(
+                    leader_count - remaining_leaders, slice_count, slice_size,
+                    stats)
+            rest = self._sorted(sorted_doms[idx:], least_free)
+            for i in range(len(rest)):
+                if remaining_slices <= 0:
+                    break
+                dom = rest[i]
+                if not least_free and dom.slice_state >= remaining_slices:
+                    dom = self._best_fit_slices(rest[i:], remaining_slices, 0)
+                results.append(dom)
+                remaining_slices -= dom.slice_state
+            if remaining_slices > 0:
+                return 0, None, self._not_fit_message(
+                    slice_count - remaining_slices, slice_count, slice_size,
+                    stats)
+            return level_idx, results, ""
+        return level_idx, [top], ""
+
+    @staticmethod
+    def _best_fit_slices(domains: list[Domain], needed: int,
+                         leader_count: int) -> Domain:
+        """First domain with the smallest sufficient capacity
+        (findBestFitDomainBy, tas_flavor_snapshot.go:1216-1231)."""
+        def state(d: Domain) -> int:
+            return (d.slice_state_with_leader if leader_count > 0
+                    else d.slice_state)
+
+        best = domains[0]
+        for dom in domains:
+            if needed <= state(dom) < state(best):
+                best = dom
+        return best
+
+    @staticmethod
+    def _best_fit_pods(domains: list[Domain], needed: int,
+                       leader_count: int) -> Domain:
+        def state(d: Domain) -> int:
+            return d.state_with_leader if leader_count > 0 else d.state
+
+        best = domains[0]
+        for dom in domains:
+            if needed <= state(dom) < state(best):
+                best = dom
+        return best
+
+    def _consume_minimum(self, domains: list[Domain], count: int,
+                         leader_count: int, slice_size: int,
+                         least_free: bool, slices: bool) -> list[Domain]:
+        """Assign `count` pods (or count/slice_size slices) onto the fewest
+        domains, leaders first (updateCountsToMinimumGeneric,
+        tas_flavor_snapshot.go:1405-1469)."""
+        result: list[Domain] = []
+        remaining = count // slice_size if slices else count
+        remaining_leaders = leader_count
+        for i, dom in enumerate(domains):
+            if remaining_leaders > 0:
+                dom, done = self._consume_with_leader(
+                    dom, domains[i:], remaining, remaining_leaders,
+                    least_free, slice_size, slices)
+                if done:
+                    result.append(dom)
+                    return result
+                if slices:
+                    remaining -= dom.slice_state_with_leader
+                    remaining_leaders -= dom.leader_state
+                else:
+                    remaining -= dom.state_with_leader
+                    remaining_leaders -= dom.leader_state
+                result.append(dom)
+                continue
+            if slices:
+                if not least_free and dom.slice_state >= remaining:
+                    dom = self._best_fit_slices(domains[i:], remaining, 0)
+                dom.leader_state = 0
+                if dom.slice_state >= remaining:
+                    dom.state = remaining * slice_size
+                    dom.slice_state = remaining
+                    result.append(dom)
+                    return result
+                dom.state = dom.slice_state * slice_size
+                remaining -= dom.slice_state
+                result.append(dom)
+            else:
+                if not least_free and dom.state >= remaining:
+                    dom = self._best_fit_pods(domains[i:], remaining, 0)
+                dom.leader_state = 0
+                if dom.state >= remaining:
+                    dom.state = remaining
+                    result.append(dom)
+                    return result
+                remaining -= dom.state
+                result.append(dom)
+        # all domains consumed; remaining should be 0 when callers sized
+        # the domain set correctly
+        return result
+
+    def _consume_with_leader(self, dom: Domain, rest: list[Domain],
+                             remaining: int, remaining_leaders: int,
+                             least_free: bool, slice_size: int,
+                             slices: bool) -> tuple[Domain, bool]:
+        """consumeWithLeadersGeneric (tas_flavor_snapshot.go:1348-1403)."""
+        def with_leader(d: Domain) -> int:
+            return d.slice_state_with_leader if slices else d.state_with_leader
+
+        if (not least_free and with_leader(dom) >= remaining
+                and dom.leader_state >= remaining_leaders):
+            if slices:
+                dom = self._best_fit_slices(rest, remaining, remaining_leaders)
+            else:
+                dom = self._best_fit_pods(rest, remaining, remaining_leaders)
+        if with_leader(dom) >= remaining and dom.leader_state >= remaining_leaders:
+            if slices:
+                dom.slice_state = remaining
+                dom.slice_state_with_leader = remaining
+            else:
+                dom.state_with_leader = remaining
+            dom.leader_state = remaining_leaders
+            dom.state = remaining * slice_size if slices else remaining
+            return dom, True
+        if slices:
+            dom.slice_state_with_leader = min(
+                dom.slice_state_with_leader, remaining)
+            dom.leader_state = min(dom.leader_state, remaining_leaders)
+            dom.state = dom.slice_state_with_leader * slice_size
+        else:
+            dom.state_with_leader = min(dom.state_with_leader, remaining)
+            dom.leader_state = min(dom.leader_state, remaining_leaders)
+            dom.state = dom.state_with_leader
+        return dom, False
+
+    # -- sorting (sortedDomains / sortedDomainsWithLeader) ------------------
+
+    def _sorted(self, domains: list[Domain], least_free: bool) -> list[Domain]:
+        if least_free:
+            return sorted(domains, key=lambda d: (
+                d.slice_state, d.state, d.level_values))
+        return sorted(domains, key=lambda d: (
+            -d.slice_state, d.state, d.level_values))
+
+    def _sorted_with_leader(self, domains: list[Domain],
+                            least_free: bool) -> list[Domain]:
+        if least_free:
+            return sorted(domains, key=lambda d: (
+                -d.leader_state, d.slice_state_with_leader,
+                d.state_with_leader, d.level_values))
+        return sorted(domains, key=lambda d: (
+            -d.leader_state, -d.slice_state_with_leader,
+            d.state_with_leader, d.level_values))
+
+    # -- output -------------------------------------------------------------
+
+    def _build(self, domains: list[Domain]) -> TopologyAssignment:
+        """buildAssignment (tas_flavor_snapshot.go:1490-1501): lex order;
+        hostname-only values when the lowest level is the hostname."""
+        domains = sorted(domains, key=lambda d: d.level_values)
+        level_idx = len(self.levels) - 1 if self.is_lowest_level_node else 0
+        return TopologyAssignment(
+            levels=self.levels[level_idx:],
+            domains=[
+                TopologyDomainAssignment(
+                    values=list(d.level_values[level_idx:]), count=d.state)
+                for d in domains if d.state > 0
+            ],
+        )
+
+    def _not_fit_message(self, fit, total, slice_size, stats) -> str:
+        unit = "pod" if slice_size == 1 else "slice"
+        if fit <= 0:
+            msg = (f"topology {self.topology_name!r} doesn't allow to fit any "
+                   f"of {total} {unit}(s)")
+        else:
+            msg = (f"topology {self.topology_name!r} allows to fit only "
+                   f"{fit} out of {total} {unit}(s)")
+        exclusions = []
+        if stats["taints"]:
+            exclusions.append(f"taints: {stats['taints']}")
+        if stats["selector"]:
+            exclusions.append(f"nodeSelector: {stats['selector']}")
+        if stats["domain"]:
+            exclusions.append(f"topologyDomain: {stats['domain']}")
+        for res, cnt in sorted(stats["resources"].items()):
+            exclusions.append(f"resource {res!r}: {cnt}")
+        if exclusions:
+            msg += (f". Total nodes: {stats['total']}; excluded: "
+                    + ", ".join(exclusions))
+        return msg
+
+
+def build_tas_flavor_snapshot(
+    topology_name: str,
+    levels: list[str],
+    nodes: Iterable[Node],
+    flavor_node_labels: Optional[dict[str, str]] = None,
+    tolerations: Optional[list[Toleration]] = None,
+    profile_mixed: bool = False,
+) -> TASFlavorSnapshot:
+    """Build and initialize a snapshot from ready nodes matching the
+    flavor's nodeLabels (tas_flavor.go / tas_nodes_cache.go analog)."""
+    snap = TASFlavorSnapshot(topology_name, levels, tolerations,
+                             profile_mixed=profile_mixed)
+    selector = flavor_node_labels or {}
+    for node in nodes:
+        if not node.ready:
+            continue
+        if all(node.labels.get(k) == v for k, v in selector.items()):
+            snap.add_node(node)
+    snap.initialize()
+    return snap

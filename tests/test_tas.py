@@ -1,0 +1,568 @@
+"""Topology-aware scheduling tests.
+
+Scenario shapes mirror the reference's tas_flavor_snapshot_test.go /
+tas_cache_test.go coverage: level selection (required/preferred/
+unconstrained), best-fit domain choice, usage accounting, filtering,
+slices, leader groups, node replacement, and scheduler integration.
+"""
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    Node,
+    PodSet,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Taint,
+    Toleration,
+    Topology,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.snapshot import build_snapshot
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.tas.snapshot import (
+    TASPodSetRequest,
+    build_tas_flavor_snapshot,
+)
+
+HOST = "kubernetes.io/hostname"
+BLOCK = "cloud/block"
+RACK = "cloud/rack"
+
+
+def make_nodes(blocks=1, racks=2, hosts=2, cpu=4000, taints=None,
+               labels=None):
+    nodes = []
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                node_labels = {BLOCK: f"b{b}", RACK: f"b{b}-r{r}"}
+                if labels:
+                    node_labels.update(labels)
+                nodes.append(Node(
+                    name=f"n-{b}-{r}-{h}",
+                    labels=node_labels,
+                    allocatable={"cpu": cpu},
+                    taints=list(taints or []),
+                ))
+    return nodes
+
+
+def snap_3level(nodes, **kw):
+    return build_tas_flavor_snapshot(
+        "default", [BLOCK, RACK, HOST], nodes, **kw)
+
+
+def place(snap, podset, count=None, per_pod=None, simulate_empty=False,
+          workload=None):
+    req = TASPodSetRequest(
+        podset=podset,
+        single_pod_requests=per_pod or dict(podset.requests),
+        count=count if count is not None else podset.count,
+        flavor="default")
+    return snap.find_topology_assignments(
+        [req], simulate_empty=simulate_empty, workload=workload)
+
+
+def domains_of(result, name="main"):
+    ta = result[name].assignment
+    assert ta is not None, result[name].failure
+    return [(tuple(d.values), d.count) for d in ta.domains]
+
+
+class TestPlacementLevels:
+    def test_required_rack_fits_single_rack(self):
+        snap = snap_3level(make_nodes())
+        ps = PodSet(name="main", count=3, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=RACK))
+        res = place(snap, ps)
+        doms = domains_of(res)
+        # all pods under one rack (hosts of the same rack)
+        hosts = {v[0] for v, _ in doms}
+        assert sum(c for _, c in doms) == 3
+        racks = {h.split("-")[2] for h in hosts}
+        assert len(racks) == 1
+
+    def test_required_rack_too_big_fails(self):
+        snap = snap_3level(make_nodes())  # rack capacity = 2 hosts * 4 pods
+        ps = PodSet(name="main", count=9, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=RACK))
+        res = place(snap, ps)
+        assert "allows to fit only 8 out of 9" in res["main"].failure
+
+    def test_preferred_falls_back_to_block(self):
+        snap = snap_3level(make_nodes())
+        ps = PodSet(name="main", count=9, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(preferred=RACK))
+        res = place(snap, ps)
+        doms = domains_of(res)
+        assert sum(c for _, c in doms) == 9
+
+    def test_preferred_spans_top_level_domains(self):
+        # 2 blocks x 1 rack x 2 hosts, 4 pods/host = 8 per block
+        snap = snap_3level(make_nodes(blocks=2, racks=1))
+        ps = PodSet(name="main", count=10, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(preferred=RACK))
+        res = place(snap, ps)
+        assert sum(c for _, c in domains_of(res)) == 10
+
+    def test_unconstrained(self):
+        snap = snap_3level(make_nodes())
+        ps = PodSet(name="main", count=5, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(unconstrained=True))
+        res = place(snap, ps)
+        assert sum(c for _, c in domains_of(res)) == 5
+
+    def test_required_host_best_fit(self):
+        nodes = [
+            Node(name="big", labels={BLOCK: "b0", RACK: "r0"},
+                 allocatable={"cpu": 8000}),
+            Node(name="small", labels={BLOCK: "b0", RACK: "r0"},
+                 allocatable={"cpu": 2000}),
+        ]
+        snap = snap_3level(nodes)
+        ps = PodSet(name="main", count=2, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=HOST))
+        res = place(snap, ps)
+        # best fit picks the smallest host that still fits both pods
+        assert domains_of(res) == [(("small",), 2)]
+
+    def test_minimizes_domain_count(self):
+        snap = snap_3level(make_nodes())
+        ps = PodSet(name="main", count=4, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=RACK))
+        res = place(snap, ps)
+        # 4 pods fit on one host (4000/1000); should not spread
+        assert len(domains_of(res)) == 1
+
+
+class TestCapacityAccounting:
+    def test_tas_usage_reduces_capacity(self):
+        snap = snap_3level(make_nodes(racks=1, hosts=1))
+        snap.add_tas_usage(("n-0-0-0",), {"cpu": 1000}, 2)
+        ps = PodSet(name="main", count=3, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=RACK))
+        res = place(snap, ps)
+        assert "allows to fit only 2 out of 3" in res["main"].failure
+
+    def test_simulate_empty_ignores_usage(self):
+        snap = snap_3level(make_nodes(racks=1, hosts=1))
+        snap.add_tas_usage(("n-0-0-0",), {"cpu": 1000}, 2)
+        ps = PodSet(name="main", count=3, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=RACK))
+        res = place(snap, ps, simulate_empty=True)
+        assert sum(c for _, c in domains_of(res)) == 3
+
+    def test_non_tas_usage_reduces_capacity(self):
+        snap = snap_3level(make_nodes(racks=1, hosts=1))
+        snap.add_non_tas_usage(("b0", "b0-r0", "n-0-0-0"), {"cpu": 3000})
+        ps = PodSet(name="main", count=2, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=HOST))
+        res = place(snap, ps)
+        assert "allows to fit only 1 out of 2" in res["main"].failure
+
+    def test_pods_resource_limits_count(self):
+        nodes = [Node(name="n0", labels={BLOCK: "b0", RACK: "r0"},
+                      allocatable={"cpu": 100000, "pods": 3})]
+        snap = snap_3level(nodes)
+        ps = PodSet(name="main", count=4, requests={"cpu": 1},
+                    topology_request=PodSetTopologyRequest(required=HOST))
+        res = place(snap, ps)
+        assert res["main"].failure
+
+    def test_fits_recheck(self):
+        snap = snap_3level(make_nodes(racks=1, hosts=1))
+        assert snap.fits(("n-0-0-0",), {"cpu": 1000}, 4)
+        snap.add_tas_usage(("n-0-0-0",), {"cpu": 1000}, 2)
+        assert snap.fits(("n-0-0-0",), {"cpu": 1000}, 2)
+        assert not snap.fits(("n-0-0-0",), {"cpu": 1000}, 3)
+
+
+class TestFiltering:
+    def test_untolerated_taint_excludes_node(self):
+        taint = Taint(key="gpu", value="true", effect="NoSchedule")
+        nodes = make_nodes(racks=1, hosts=1, taints=[taint])
+        snap = snap_3level(nodes)
+        ps = PodSet(name="main", count=1, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=HOST))
+        res = place(snap, ps)
+        assert "taints: 1" in res["main"].failure
+
+        ps_tol = PodSet(
+            name="main", count=1, requests={"cpu": 1000},
+            tolerations=[Toleration(key="gpu", operator="Exists")],
+            topology_request=PodSetTopologyRequest(required=HOST))
+        res = place(snap, ps_tol)
+        assert res["main"].failure == ""
+
+    def test_flavor_tolerations_apply(self):
+        taint = Taint(key="gpu", value="true", effect="NoSchedule")
+        nodes = make_nodes(racks=1, hosts=1, taints=[taint])
+        snap = build_tas_flavor_snapshot(
+            "default", [BLOCK, RACK, HOST], nodes,
+            tolerations=[Toleration(key="gpu", operator="Exists")])
+        ps = PodSet(name="main", count=1, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=HOST))
+        res = place(snap, ps)
+        assert res["main"].failure == ""
+
+    def test_node_selector_excludes(self):
+        nodes = make_nodes(racks=1, hosts=2)
+        nodes[0].labels["zone"] = "a"
+        nodes[1].labels["zone"] = "b"
+        snap = snap_3level(nodes)
+        ps = PodSet(name="main", count=8, requests={"cpu": 1000},
+                    node_selector={"zone": "a"},
+                    topology_request=PodSetTopologyRequest(required=RACK))
+        res = place(snap, ps)
+        assert "allows to fit only 4 out of 8" in res["main"].failure
+        assert "nodeSelector: 1" in res["main"].failure
+
+    def test_not_ready_nodes_skipped(self):
+        nodes = make_nodes(racks=1, hosts=2)
+        nodes[0].ready = False
+        snap = snap_3level(nodes)
+        ps = PodSet(name="main", count=8, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=RACK))
+        res = place(snap, ps)
+        assert "fit only 4 out of 8" in res["main"].failure
+
+
+class TestSlices:
+    def test_slices_grouped_per_rack(self):
+        # each rack: 2 hosts * 4 pods = 8 pods -> 2 slices of 4
+        snap = snap_3level(make_nodes(racks=2, hosts=2))
+        ps = PodSet(
+            name="main", count=8, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(
+                preferred=BLOCK,
+                podset_slice_required_topology=RACK,
+                podset_slice_size=4,
+            ))
+        res = place(snap, ps)
+        assert sum(c for _, c in domains_of(res)) == 8
+
+    def test_slice_not_divisible_fails(self):
+        snap = snap_3level(make_nodes())
+        ps = PodSet(
+            name="main", count=5, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(
+                preferred=BLOCK,
+                podset_slice_required_topology=RACK,
+                podset_slice_size=4,
+            ))
+        res = place(snap, ps)
+        assert "not divisible" in res["main"].failure
+
+    def test_slice_bigger_than_rack_fails(self):
+        # rack capacity 8; slice of 9 can never be rack-contained
+        snap = snap_3level(make_nodes(racks=2, hosts=2))
+        ps = PodSet(
+            name="main", count=9, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(
+                required=BLOCK,
+                podset_slice_required_topology=RACK,
+                podset_slice_size=9,
+            ))
+        res = place(snap, ps)
+        assert "doesn't allow to fit any" in res["main"].failure
+
+
+class TestLeaderGroup:
+    def test_leader_colocated_with_workers(self):
+        snap = snap_3level(make_nodes(racks=2, hosts=2))
+        workers = PodSet(
+            name="workers", count=4, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(
+                required=RACK, podset_group_name="g"))
+        leader = PodSet(
+            name="leader", count=1, requests={"cpu": 500},
+            topology_request=PodSetTopologyRequest(
+                required=RACK, podset_group_name="g"))
+        reqs = [
+            TASPodSetRequest(podset=workers, single_pod_requests={"cpu": 1000},
+                             count=4, flavor="default",
+                             podset_group_name="g"),
+            TASPodSetRequest(podset=leader, single_pod_requests={"cpu": 500},
+                             count=1, flavor="default",
+                             podset_group_name="g"),
+        ]
+        res = snap.find_topology_assignments(reqs)
+        assert res["workers"].failure == ""
+        w_doms = domains_of(res, "workers")
+        l_doms = domains_of(res, "leader")
+        assert sum(c for _, c in w_doms) == 4
+        assert sum(c for _, c in l_doms) == 1
+        # leader and workers share the same rack
+        all_hosts = [v[0] for v, _ in w_doms + l_doms]
+        racks = {h.split("-")[2] for h in all_hosts}
+        assert len(racks) == 1
+
+    def test_sequential_groups_accumulate_usage(self):
+        # two separate podsets, each needing a full rack: must land on
+        # different racks because assumed usage accumulates
+        snap = snap_3level(make_nodes(racks=2, hosts=2))
+        ps1 = PodSet(name="a", count=8, requests={"cpu": 1000},
+                     topology_request=PodSetTopologyRequest(required=RACK))
+        ps2 = PodSet(name="b", count=8, requests={"cpu": 1000},
+                     topology_request=PodSetTopologyRequest(required=RACK))
+        reqs = [
+            TASPodSetRequest(podset=ps1, single_pod_requests={"cpu": 1000},
+                             count=8, flavor="default"),
+            TASPodSetRequest(podset=ps2, single_pod_requests={"cpu": 1000},
+                             count=8, flavor="default"),
+        ]
+        res = snap.find_topology_assignments(reqs)
+        assert res["a"].failure == "" and res["b"].failure == ""
+        racks_a = {v[0].split("-")[2] for v, _ in domains_of(res, "a")}
+        racks_b = {v[0].split("-")[2] for v, _ in domains_of(res, "b")}
+        assert racks_a.isdisjoint(racks_b)
+
+
+class TestNodeReplacement:
+    def _admitted_workload(self, snap):
+        wl = Workload(name="wl", podsets=[PodSet(
+            name="main", count=4, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(required=BLOCK))])
+        from kueue_oss_tpu.api.types import (
+            Admission,
+            PodSetAssignment,
+            TopologyAssignment,
+            TopologyDomainAssignment,
+        )
+        wl.status.admission = Admission(
+            cluster_queue="cq",
+            podset_assignments=[PodSetAssignment(
+                name="main", flavors={"cpu": "default"},
+                resource_usage={"cpu": 4000}, count=4,
+                topology_assignment=TopologyAssignment(
+                    levels=[HOST],
+                    domains=[
+                        TopologyDomainAssignment(["n-0-0-0"], 2),
+                        TopologyDomainAssignment(["n-0-0-1"], 2),
+                    ]))])
+        return wl
+
+    def test_replacement_on_other_node(self):
+        nodes = make_nodes(racks=2, hosts=2)
+        wl = self._admitted_workload(None)
+        wl.status.unhealthy_nodes = ["n-0-0-0"]
+        # unhealthy node removed from cluster
+        snap = snap_3level([n for n in nodes if n.name != "n-0-0-0"])
+        snap.add_tas_usage(("n-0-0-1",), {"cpu": 1000}, 2)
+        ps = wl.podsets[0]
+        res = place(snap, ps, workload=wl)
+        doms = dict(domains_of(res))
+        assert doms[("n-0-0-1",)] == 4 or sum(doms.values()) == 4
+
+    def test_replacement_avoids_unhealthy_node_still_in_snapshot(self):
+        # the unhealthy node is still Ready in the store (flapping);
+        # replacement must not land back on it
+        nodes = make_nodes(racks=2, hosts=2)
+        wl = self._admitted_workload(None)
+        wl.status.unhealthy_nodes = ["n-0-0-0"]
+        snap = snap_3level(nodes)  # n-0-0-0 still present with free capacity
+        ps = wl.podsets[0]
+        res = place(snap, ps, workload=wl)
+        doms = dict(domains_of(res))
+        assert ("n-0-0-0",) not in doms
+        assert sum(doms.values()) == 4
+
+    def test_replacement_impossible(self):
+        # only the unhealthy node's rack exists and it is full
+        nodes = make_nodes(racks=1, hosts=2)
+        wl = self._admitted_workload(None)
+        wl.status.unhealthy_nodes = ["n-0-0-0"]
+        snap = snap_3level([n for n in nodes if n.name != "n-0-0-0"])
+        snap.add_tas_usage(("n-0-0-1",), {"cpu": 1000}, 4)
+        ps = wl.podsets[0]
+        res = place(snap, ps, workload=wl)
+        assert res["main"].failure
+
+
+class TestSchedulerIntegration:
+    def _store(self, nominal=16000, racks=2, hosts=2):
+        store = Store()
+        store.upsert_topology(Topology(name="default",
+                                       levels=[BLOCK, RACK, HOST]))
+        store.upsert_resource_flavor(ResourceFlavor(
+            name="tas-flavor", topology_name="default"))
+        for n in make_nodes(racks=racks, hosts=hosts):
+            store.upsert_node(n)
+        store.upsert_cluster_queue(ClusterQueue(
+            name="cq",
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="tas-flavor", resources=[
+                    ResourceQuota(name="cpu", nominal=nominal)])])]))
+        store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        return store
+
+    def test_admit_writes_topology_assignment(self):
+        store = self._store()
+        wl = Workload(name="wl", queue_name="lq", podsets=[PodSet(
+            name="main", count=4, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(required=RACK))])
+        store.add_workload(wl)
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        sched.schedule(now=0.0)
+        assert wl.is_admitted
+        ta = wl.status.admission.podset_assignments[0].topology_assignment
+        assert ta is not None
+        assert sum(d.count for d in ta.domains) == 4
+
+    def test_implied_tas_on_tas_only_cq(self):
+        store = self._store()
+        wl = Workload(name="wl", queue_name="lq", podsets=[PodSet(
+            name="main", count=2, requests={"cpu": 1000})])
+        store.add_workload(wl)
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        sched.schedule(now=0.0)
+        assert wl.is_admitted
+        ta = wl.status.admission.podset_assignments[0].topology_assignment
+        assert ta is not None
+
+    def test_admitted_usage_visible_next_cycle(self):
+        # rack holds 8 pods; two 6-pod workloads cannot share a rack
+        store = self._store()
+        wl1 = Workload(name="wl1", queue_name="lq", podsets=[PodSet(
+            name="main", count=6, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(required=RACK))])
+        wl2 = Workload(name="wl2", queue_name="lq", podsets=[PodSet(
+            name="main", count=6, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(required=RACK))])
+        store.add_workload(wl1)
+        store.add_workload(wl2)
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        sched.schedule(now=0.0)
+        sched.schedule(now=1.0)
+        assert wl1.is_admitted and wl2.is_admitted
+        rack_of = {}
+        for wl in (wl1, wl2):
+            ta = wl.status.admission.podset_assignments[0].topology_assignment
+            racks = {v.values[0].split("-")[2] for v in ta.domains}
+            assert len(racks) == 1
+            rack_of[wl.name] = racks.pop()
+        assert rack_of["wl1"] != rack_of["wl2"]
+
+    def test_topology_full_means_inadmissible(self):
+        # quota allows it but topology (one rack of 8) cannot hold 9 pods
+        store = self._store(racks=1)
+        wl = Workload(name="wl", queue_name="lq", podsets=[PodSet(
+            name="main", count=9, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(required=RACK))])
+        store.add_workload(wl)
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        sched.schedule(now=0.0)
+        assert not wl.is_admitted
+
+    def test_same_cycle_no_domain_oversubscription(self):
+        # one host of 4 cpu; two 3-pod workloads nominated in the same
+        # cycle must not both admit onto it
+        store = self._store(racks=1, hosts=1)
+        wl1 = Workload(name="wl1", queue_name="lq", podsets=[PodSet(
+            name="main", count=3, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(required=HOST))])
+        wl2 = Workload(name="wl2", queue_name="lq", podsets=[PodSet(
+            name="main", count=3, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(required=HOST))])
+        store.add_workload(wl1)
+        store.add_workload(wl2)
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        for t in range(3):
+            sched.schedule(now=float(t))
+        admitted = [w for w in (wl1, wl2) if w.is_admitted]
+        assert len(admitted) == 1
+
+    def test_three_podset_group_rejected(self):
+        nodes = make_nodes()
+        snap = snap_3level(nodes)
+        reqs = []
+        for i in range(3):
+            ps = PodSet(name=f"ps{i}", count=1, requests={"cpu": 1000},
+                        topology_request=PodSetTopologyRequest(
+                            required=RACK, podset_group_name="g"))
+            reqs.append(TASPodSetRequest(
+                podset=ps, single_pod_requests={"cpu": 1000}, count=1,
+                flavor="default", podset_group_name="g"))
+        res = snap.find_topology_assignments(reqs)
+        assert all(r.failure for r in res.values())
+
+    def test_multi_count_leader_rejected(self):
+        nodes = make_nodes()
+        snap = snap_3level(nodes)
+        workers = PodSet(name="w", count=5, requests={"cpu": 100},
+                         topology_request=PodSetTopologyRequest(
+                             required=RACK, podset_group_name="g"))
+        leaders = PodSet(name="l", count=3, requests={"cpu": 100},
+                         topology_request=PodSetTopologyRequest(
+                             required=RACK, podset_group_name="g"))
+        reqs = [
+            TASPodSetRequest(podset=workers, single_pod_requests={"cpu": 100},
+                             count=5, flavor="default",
+                             podset_group_name="g"),
+            TASPodSetRequest(podset=leaders, single_pod_requests={"cpu": 100},
+                             count=3, flavor="default",
+                             podset_group_name="g"),
+        ]
+        res = snap.find_topology_assignments(reqs)
+        assert all("count 1" in r.failure for r in res.values())
+
+    def test_fragmentation_triggers_preemption(self):
+        # low-priority workloads fragment the racks; a high-priority
+        # rack-contained workload preempts to defragment
+        store = self._store()
+        store.upsert_cluster_queue(ClusterQueue(
+            name="cq",
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="tas-flavor", resources=[
+                    ResourceQuota(name="cpu", nominal=16000)])])],
+            preemption=__import__(
+                "kueue_oss_tpu.api.types", fromlist=["PreemptionPolicy"]
+            ).PreemptionPolicy(within_cluster_queue="LowerPriority"),
+        ))
+        fillers = []
+        for i in range(2):
+            f = Workload(name=f"filler-{i}", queue_name="lq", priority=0,
+                         podsets=[PodSet(
+                             name="main", count=6, requests={"cpu": 1000},
+                             topology_request=PodSetTopologyRequest(
+                                 required=RACK))])
+            fillers.append(f)
+            store.add_workload(f)
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        sched.schedule(now=0.0)
+        sched.schedule(now=1.0)
+        assert all(f.is_admitted for f in fillers)
+
+        big = Workload(name="big", queue_name="lq", priority=10,
+                       podsets=[PodSet(
+                           name="main", count=8, requests={"cpu": 1000},
+                           topology_request=PodSetTopologyRequest(
+                               required=RACK))])
+        store.add_workload(big)
+        sched.schedule(now=2.0)
+        # at least one filler evicted to make room
+        assert any(f.is_evicted for f in fillers)
+        # after eviction settles, big gets its rack
+        for t in range(3, 60):
+            sched.requeue_due(float(t))
+            sched.schedule(now=float(t))
+            if big.is_admitted:
+                break
+        assert big.is_admitted
+        ta = big.status.admission.podset_assignments[0].topology_assignment
+        racks = {v.values[0].split("-")[2] for v in ta.domains}
+        assert len(racks) == 1
