@@ -1,0 +1,6 @@
+from kueue_oss_tpu.admissionchecks.provisioning import (
+    ProvisioningController,
+    ProvisioningRequest,
+)
+
+__all__ = ["ProvisioningController", "ProvisioningRequest"]

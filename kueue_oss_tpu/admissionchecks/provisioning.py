@@ -1,0 +1,162 @@
+"""Provisioning admission-check controller.
+
+Reference parity: pkg/controller/admissionchecks/provisioning (KEP-1136) —
+for every quota-reserved workload whose ClusterQueue lists an AdmissionCheck
+handled by this controller, it creates a capacity ProvisioningRequest,
+relays the provider's answer into the workload's AdmissionCheckState, and
+retries failed requests with exponential backoff up to a retry limit
+(KEP-3258), after which the check goes Rejected.
+
+The cloud/autoscaler side is abstracted as a `CapacityProvider` callable so
+tests (and the in-process runtime) can decide provisioning outcomes; the
+reference's equivalent boundary is the autoscaler acting on the
+ProvisioningRequest CR.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kueue_oss_tpu.api.types import CheckState, Workload
+from kueue_oss_tpu.core.store import Store
+
+CONTROLLER_NAME = "kueue.x-k8s.io/provisioning-request"
+
+#: provider(request) -> True (provisioned) | False (failed) | None (pending)
+CapacityProvider = Callable[["ProvisioningRequest"], Optional[bool]]
+
+
+@dataclass
+class ProvisioningRequest:
+    """In-memory analog of the autoscaler ProvisioningRequest CR."""
+
+    name: str
+    workload_key: str
+    check_name: str
+    #: aggregated resource requests the capacity must cover
+    requests: dict[str, int] = field(default_factory=dict)
+    attempt: int = 1
+    state: str = "Pending"  # Pending | Provisioned | Failed
+    #: when a failed attempt may be retried
+    retry_at: Optional[float] = None
+    #: QuotaReserved transition time this request was provisioned for; a
+    #: later re-admission must re-provision, not reuse a stale answer
+    reservation_epoch: float = 0.0
+
+
+@dataclass
+class ProvisioningConfig:
+    """Reference parity: ProvisioningRequestConfig CRD (retry KEP-3258)."""
+
+    max_retries: int = 3
+    base_backoff_seconds: float = 60.0
+    max_backoff_seconds: float = 1800.0
+
+
+class ProvisioningController:
+    def __init__(self, store: Store,
+                 provider: Optional[CapacityProvider] = None,
+                 config: Optional[ProvisioningConfig] = None) -> None:
+        self.store = store
+        self.provider: CapacityProvider = provider or (lambda req: True)
+        self.config = config or ProvisioningConfig()
+        #: live request per (workload key, check name); superseded attempts
+        #: are replaced in place so retention stays O(reserved workloads)
+        self.requests: dict[tuple[str, str], ProvisioningRequest] = {}
+
+    # -- helpers ------------------------------------------------------------
+
+    def _checks_for(self, wl: Workload) -> list[str]:
+        """Names of this controller's checks pending on the workload."""
+        out = []
+        for name, state in wl.status.admission_checks.items():
+            ac = self.store.admission_checks.get(name)
+            if ac is not None and ac.controller_name == CONTROLLER_NAME:
+                if state.state == CheckState.PENDING:
+                    out.append(name)
+        return out
+
+    @staticmethod
+    def _request_name(wl: Workload, check: str, attempt: int) -> str:
+        return f"{wl.namespace}/{wl.name}/{check}/{attempt}"
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile(self, now: float) -> Optional[float]:
+        """Advance every provisioning request; returns next retry deadline."""
+        next_due: Optional[float] = None
+        for wl in list(self.store.workloads.values()):
+            if not wl.is_quota_reserved or wl.is_finished:
+                continue
+            for check in self._checks_for(wl):
+                due = self._advance(wl, check, now)
+                if due is not None:
+                    next_due = due if next_due is None else min(next_due, due)
+        self._gc(now)
+        return next_due
+
+    @staticmethod
+    def _epoch(wl: Workload) -> float:
+        from kueue_oss_tpu.api.types import WorkloadConditionType
+
+        cond = wl.condition(WorkloadConditionType.QUOTA_RESERVED)
+        return cond.last_transition_time if cond is not None else 0.0
+
+    def _advance(self, wl: Workload, check: str, now: float) -> Optional[float]:
+        epoch = self._epoch(wl)
+        req = self.requests.get((wl.key, check))
+        if req is not None and req.reservation_epoch != epoch:
+            # Evicted + re-admitted since this request was made: the old
+            # provisioned/failed answer belongs to the previous admission.
+            req = None
+        if req is None:
+            req = ProvisioningRequest(
+                name=self._request_name(wl, check, 1),
+                workload_key=wl.key, check_name=check,
+                requests=wl.total_requests(), reservation_epoch=epoch)
+            self.requests[(wl.key, check)] = req
+
+        if req.state == "Pending":
+            answer = self.provider(req)
+            if answer is None:
+                return None  # still provisioning; provider will be re-polled
+            req.state = "Provisioned" if answer else "Failed"
+
+        state = wl.status.admission_checks.get(check)
+        if state is None:
+            return None
+        if req.state == "Provisioned":
+            state.state = CheckState.READY
+            state.message = f"Provisioning request {req.name} provisioned"
+            self.store.update_workload(wl)
+            return None
+        # Failed: retry with backoff, then reject (KEP-3258).
+        if req.attempt > self.config.max_retries:
+            state.state = CheckState.REJECTED
+            state.message = (f"Provisioning request failed after "
+                             f"{req.attempt} attempt(s)")
+            self.store.update_workload(wl)
+            return None
+        if req.retry_at is None:
+            delay = min(
+                self.config.base_backoff_seconds * (2 ** (req.attempt - 1)),
+                self.config.max_backoff_seconds)
+            req.retry_at = now + delay
+        if now < req.retry_at:
+            return req.retry_at
+        nxt = ProvisioningRequest(
+            name=self._request_name(wl, check, req.attempt + 1),
+            workload_key=wl.key, check_name=check,
+            requests=wl.total_requests(), attempt=req.attempt + 1,
+            reservation_epoch=req.reservation_epoch)
+        self.requests[(wl.key, check)] = nxt
+        return self._advance(wl, check, now)
+
+    def _gc(self, now: float) -> None:
+        """Drop requests whose workload no longer reserves quota
+        (reference: provisioning controller owns requests via ownerRefs)."""
+        for key, req in list(self.requests.items()):
+            wl = self.store.workloads.get(req.workload_key)
+            if wl is None or not wl.is_quota_reserved or wl.is_finished:
+                del self.requests[key]

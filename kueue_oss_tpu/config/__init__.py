@@ -1,0 +1,29 @@
+from kueue_oss_tpu.config.configuration import (
+    AdmissionFairSharingConfig,
+    Configuration,
+    FairSharingConfig,
+    MultiKueueConfig,
+    ObjectRetentionPolicies,
+    RequeuingStrategy,
+    ResourceTransformation,
+    ResourcesConfig,
+    WaitForPodsReady,
+    apply_feature_gates,
+    load,
+    validate,
+)
+
+__all__ = [
+    "AdmissionFairSharingConfig",
+    "Configuration",
+    "FairSharingConfig",
+    "MultiKueueConfig",
+    "ObjectRetentionPolicies",
+    "RequeuingStrategy",
+    "ResourceTransformation",
+    "ResourcesConfig",
+    "WaitForPodsReady",
+    "apply_feature_gates",
+    "load",
+    "validate",
+]

@@ -1,0 +1,321 @@
+"""Framework configuration.
+
+Reference parity: apis/config/v1beta2/configuration_types.go:34-114 (the
+Configuration file CRD) + pkg/config (Load/Validate). The reference loads a
+YAML file into a versioned CRD scheme; here the same surface is a dataclass
+tree loadable from a plain dict (so tests and the CLI can supply YAML/JSON
+without a k8s scheme).
+
+Durations are plain float seconds (the tensor/scheduler path works in
+seconds since epoch).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class RequeuingTimestamp:
+    """Reference parity: config RequeuingStrategy.Timestamp values."""
+
+    EVICTION = "Eviction"
+    CREATION = "Creation"
+
+
+@dataclass
+class RequeuingStrategy:
+    """Backoff for WaitForPodsReady re-queues.
+
+    Reference parity: configuration_types.go RequeuingStrategy —
+    backoffBaseSeconds default 60, backoffMaxSeconds default 3600;
+    backoffLimitCount None = unlimited retries, otherwise the workload is
+    deactivated once the count is exhausted.
+    """
+
+    timestamp: str = RequeuingTimestamp.EVICTION
+    backoff_limit_count: Optional[int] = None
+    backoff_base_seconds: float = 60.0
+    backoff_max_seconds: float = 3600.0
+
+
+@dataclass
+class WaitForPodsReady:
+    """Reference parity: configuration_types.go WaitForPodsReady (KEP-349).
+
+    enable=True makes admission conditional on pods becoming ready within
+    `timeout`; on timeout the workload is evicted and re-queued with the
+    RequeuingStrategy backoff. recovery_timeout bounds how long an admitted
+    workload may sit with PodsReady=False after having been ready once.
+    """
+
+    enable: bool = False
+    timeout_seconds: float = 300.0
+    recovery_timeout_seconds: Optional[float] = None
+    #: block all other admissions while a workload waits for pods ready
+    block_admission: bool = False
+    requeuing_strategy: RequeuingStrategy = field(default_factory=RequeuingStrategy)
+
+
+@dataclass
+class FairSharingConfig:
+    """Reference parity: configuration_types.go FairSharing (KEP-1714)."""
+
+    enable: bool = False
+    #: ordered subset of {"LessThanOrEqualToFinalShare", "LessThanInitialShare"}
+    preemption_strategies: list[str] = field(
+        default_factory=lambda: ["LessThanOrEqualToFinalShare",
+                                 "LessThanInitialShare"])
+
+
+@dataclass
+class AdmissionFairSharingConfig:
+    """Reference parity: configuration_types.go AdmissionFairSharing (KEP-4136)."""
+
+    usage_half_life_time_seconds: float = 300.0
+    usage_sampling_interval_seconds: float = 10.0
+    resource_weights: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceTransformation:
+    """Reference parity: configuration_types.go ResourceTransformation —
+    maps an input resource to weighted output resources when building a
+    workload's quota usage. strategy Retain keeps the original resource as
+    well; Replace drops it."""
+
+    input: str
+    strategy: str = "Retain"  # Retain | Replace
+    outputs: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class ResourcesConfig:
+    """Reference parity: configuration_types.go Resources."""
+
+    exclude_resource_prefixes: list[str] = field(default_factory=list)
+    transformations: list[ResourceTransformation] = field(default_factory=list)
+    #: DRA: device class name -> logical resource name (KEP-2941)
+    device_class_mappings: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ObjectRetentionPolicies:
+    """Reference parity: configuration_types.go ObjectRetentionPolicies —
+    None = keep finished/deactivated workloads forever."""
+
+    finished_workload_retention_seconds: Optional[float] = None
+    deactivated_workload_retention_seconds: Optional[float] = None
+
+
+@dataclass
+class MultiKueueConfig:
+    """Reference parity: configuration_types.go MultiKueue."""
+
+    gc_interval_seconds: float = 60.0
+    origin: str = "multikueue"
+    worker_lost_timeout_seconds: float = 900.0
+    #: dispatcher algorithm: AllAtOnce | Incremental
+    dispatcher_name: str = "AllAtOnce"
+
+
+@dataclass
+class Configuration:
+    """Reference parity: configuration_types.go Configuration."""
+
+    namespace: str = "kueue-system"
+    manage_jobs_without_queue_name: bool = False
+    #: namespaces whose jobs are managed even without a queue name
+    managed_jobs_namespace_selector: Optional[dict[str, str]] = None
+    wait_for_pods_ready: Optional[WaitForPodsReady] = None
+    #: enabled job-framework integrations (reference: Integrations.Frameworks)
+    integrations: list[str] = field(
+        default_factory=lambda: ["batch/job"])
+    external_frameworks: list[str] = field(default_factory=list)
+    fair_sharing: FairSharingConfig = field(default_factory=FairSharingConfig)
+    admission_fair_sharing: Optional[AdmissionFairSharingConfig] = None
+    resources: ResourcesConfig = field(default_factory=ResourcesConfig)
+    object_retention_policies: Optional[ObjectRetentionPolicies] = None
+    multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
+    feature_gates: dict[str, bool] = field(default_factory=dict)
+
+
+_REQUEUING_TIMESTAMPS = {RequeuingTimestamp.EVICTION, RequeuingTimestamp.CREATION}
+_TRANSFORM_STRATEGIES = {"Retain", "Replace"}
+_FS_STRATEGIES = {"LessThanOrEqualToFinalShare", "LessThanInitialShare"}
+_DISPATCHERS = {"AllAtOnce", "Incremental"}
+
+
+def validate(cfg: Configuration) -> list[str]:
+    """Reference parity: pkg/config validation — returns a list of errors."""
+    errs: list[str] = []
+    wfpr = cfg.wait_for_pods_ready
+    if wfpr is not None and wfpr.enable:
+        if wfpr.timeout_seconds <= 0:
+            errs.append("waitForPodsReady.timeout must be > 0")
+        rs = wfpr.requeuing_strategy
+        if rs.timestamp not in _REQUEUING_TIMESTAMPS:
+            errs.append(f"waitForPodsReady.requeuingStrategy.timestamp "
+                        f"{rs.timestamp!r} not in {sorted(_REQUEUING_TIMESTAMPS)}")
+        if rs.backoff_limit_count is not None and rs.backoff_limit_count < 0:
+            errs.append("requeuingStrategy.backoffLimitCount must be >= 0")
+        if rs.backoff_base_seconds < 0:
+            errs.append("requeuingStrategy.backoffBaseSeconds must be >= 0")
+    for t in cfg.resources.transformations:
+        if t.strategy not in _TRANSFORM_STRATEGIES:
+            errs.append(f"resource transformation {t.input!r}: strategy "
+                        f"{t.strategy!r} not in {sorted(_TRANSFORM_STRATEGIES)}")
+    seen_inputs: set[str] = set()
+    for t in cfg.resources.transformations:
+        if t.input in seen_inputs:
+            errs.append(f"duplicate resource transformation for {t.input!r}")
+        seen_inputs.add(t.input)
+    for s in cfg.fair_sharing.preemption_strategies:
+        if s not in _FS_STRATEGIES:
+            errs.append(f"fairSharing.preemptionStrategies: unknown {s!r}")
+    if cfg.multikueue.dispatcher_name not in _DISPATCHERS:
+        errs.append(f"multiKueue.dispatcherName {cfg.multikueue.dispatcher_name!r} "
+                    f"not in {sorted(_DISPATCHERS)}")
+    afs = cfg.admission_fair_sharing
+    if afs is not None:
+        if afs.usage_half_life_time_seconds < 0:
+            errs.append("admissionFairSharing.usageHalfLifeTime must be >= 0")
+        for r, w in afs.resource_weights.items():
+            if w < 0:
+                errs.append(f"admissionFairSharing.resourceWeights[{r!r}] "
+                            "must be >= 0")
+    return errs
+
+
+def apply_feature_gates(cfg: Configuration) -> None:
+    """Apply Configuration.featureGates to the live gate registry
+    (reference: cmd/kueue/main.go:157-172 merges config + flag gates)."""
+    from kueue_oss_tpu import features
+
+    if cfg.feature_gates:
+        features.set_gates(cfg.feature_gates)
+
+
+def _build(cls, data: dict, mapping: dict):
+    kwargs = {}
+    for yaml_key, (attr, conv) in mapping.items():
+        if yaml_key in data:
+            v = data[yaml_key]
+            kwargs[attr] = conv(v) if conv else v
+    return cls(**kwargs)
+
+
+def load(data: Optional[dict] = None) -> Configuration:
+    """Build a Configuration from a plain (YAML-decoded) dict.
+
+    Reference parity: pkg/config.Load — unknown keys are ignored (the
+    reference tolerates forward-compat fields), camelCase keys follow the
+    reference API.
+    """
+    data = data or {}
+
+    def conv_rs(d: dict) -> RequeuingStrategy:
+        return _build(RequeuingStrategy, d, {
+            "timestamp": ("timestamp", None),
+            "backoffLimitCount": ("backoff_limit_count", None),
+            "backoffBaseSeconds": ("backoff_base_seconds", float),
+            "backoffMaxSeconds": ("backoff_max_seconds", float),
+        })
+
+    def conv_wfpr(d: dict) -> WaitForPodsReady:
+        return _build(WaitForPodsReady, d, {
+            "enable": ("enable", None),
+            "timeout": ("timeout_seconds", float),
+            "recoveryTimeout": ("recovery_timeout_seconds", float),
+            "blockAdmission": ("block_admission", None),
+            "requeuingStrategy": ("requeuing_strategy", conv_rs),
+        })
+
+    def conv_fs(d: dict) -> FairSharingConfig:
+        return _build(FairSharingConfig, d, {
+            "enable": ("enable", None),
+            "preemptionStrategies": ("preemption_strategies", list),
+        })
+
+    def conv_afs(d: dict) -> AdmissionFairSharingConfig:
+        return _build(AdmissionFairSharingConfig, d, {
+            "usageHalfLifeTime": ("usage_half_life_time_seconds", float),
+            "usageSamplingInterval": ("usage_sampling_interval_seconds", float),
+            "resourceWeights": ("resource_weights", dict),
+        })
+
+    def conv_transform(d: dict) -> ResourceTransformation:
+        return _build(ResourceTransformation, d, {
+            "input": ("input", None),
+            "strategy": ("strategy", None),
+            "outputs": ("outputs", dict),
+        })
+
+    def conv_resources(d: dict) -> ResourcesConfig:
+        return _build(ResourcesConfig, d, {
+            "excludeResourcePrefixes": ("exclude_resource_prefixes", list),
+            "transformations": (
+                "transformations",
+                lambda ts: [conv_transform(t) for t in ts]),
+            "deviceClassMappings": ("device_class_mappings", dict),
+        })
+
+    def conv_retention(d: dict) -> ObjectRetentionPolicies:
+        return _build(ObjectRetentionPolicies, d, {
+            "finishedWorkloadRetention": (
+                "finished_workload_retention_seconds", float),
+            "deactivatedWorkloadRetention": (
+                "deactivated_workload_retention_seconds", float),
+        })
+
+    def conv_mk(d: dict) -> MultiKueueConfig:
+        return _build(MultiKueueConfig, d, {
+            "gcInterval": ("gc_interval_seconds", float),
+            "origin": ("origin", None),
+            "workerLostTimeout": ("worker_lost_timeout_seconds", float),
+            "dispatcherName": ("dispatcher_name", None),
+        })
+
+    def conv_integrations(d: dict) -> list[str]:
+        return list(d.get("frameworks", []))
+
+    cfg = _build(Configuration, data, {
+        "namespace": ("namespace", None),
+        "manageJobsWithoutQueueName": ("manage_jobs_without_queue_name", None),
+        "managedJobsNamespaceSelector": ("managed_jobs_namespace_selector", None),
+        "waitForPodsReady": ("wait_for_pods_ready", conv_wfpr),
+        "fairSharing": ("fair_sharing", conv_fs),
+        "admissionFairSharing": ("admission_fair_sharing", conv_afs),
+        "resources": ("resources", conv_resources),
+        "objectRetentionPolicies": ("object_retention_policies", conv_retention),
+        "multiKueue": ("multikueue", conv_mk),
+        "featureGates": ("feature_gates", dict),
+    })
+    if "integrations" in data:
+        cfg.integrations = conv_integrations(data["integrations"])
+        cfg.external_frameworks = list(
+            data["integrations"].get("externalFrameworks", []))
+    return cfg
+
+
+def apply_resource_transformations(
+        requests: dict[str, int], cfg: ResourcesConfig) -> dict[str, int]:
+    """Apply exclude-prefixes then transformations to a request map.
+
+    Reference parity: pkg/workload/resources.go — transformations run on the
+    effective podset requests before quota accounting.
+    """
+    out: dict[str, int] = {}
+    transforms = {t.input: t for t in cfg.transformations}
+    for r, q in requests.items():
+        if any(r.startswith(p) for p in cfg.exclude_resource_prefixes):
+            continue
+        t = transforms.get(r)
+        if t is None:
+            out[r] = out.get(r, 0) + q
+            continue
+        if t.strategy == "Retain":
+            out[r] = out.get(r, 0) + q
+        for target, weight in t.outputs.items():
+            out[target] = out.get(target, 0) + int(q * weight)
+    return out

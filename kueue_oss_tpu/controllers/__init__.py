@@ -1,0 +1,6 @@
+from kueue_oss_tpu.controllers.workload_controller import (
+    EvictionReason,
+    WorkloadReconciler,
+)
+
+__all__ = ["EvictionReason", "WorkloadReconciler"]

@@ -1,0 +1,342 @@
+"""Workload lifecycle controller.
+
+Reference parity: pkg/controller/core/workload_controller.go (1601 LoC) —
+the state machine that sits between quota reservation (scheduler) and the
+job actually running:
+
+- admission-check sync: QuotaReserved + all checks Ready -> Admitted
+  (workload_controller.go:785); Retry -> evict + release quota; Rejected ->
+  evict + deactivate.
+- check-based eviction (:752), LQ/CQ StopPolicy handling (:836-918),
+- PodsReady timeout eviction with RequeuingStrategy backoff (:1004) and
+  deactivation once backoffLimitCount is exhausted,
+- maximum execution time (:697),
+- deactivation (spec.active=false, :1057),
+- finished/deactivated workload retention GC.
+
+The reference runs as a controller-runtime reconciler on watch events plus
+time-based requeues; here `reconcile(key, now)` is the event entry point and
+returns the next deadline (absolute seconds) at which it must run again, so
+a host loop (or test) can drive time explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_oss_tpu.api.types import (
+    AdmissionCheckState,
+    CheckState,
+    StopPolicy,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_oss_tpu.config import Configuration
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+class EvictionReason:
+    """Reference parity: workload_types.go WorkloadEvictedBy* reasons."""
+
+    PREEMPTED = "Preempted"
+    PODS_READY_TIMEOUT = "PodsReadyTimeout"
+    ADMISSION_CHECK = "AdmissionCheck"
+    CLUSTER_QUEUE_STOPPED = "ClusterQueueStopped"
+    LOCAL_QUEUE_STOPPED = "LocalQueueStopped"
+    DEACTIVATED = "Deactivated"
+    MAX_EXEC_TIME_EXCEEDED = "MaximumExecutionTimeExceeded"
+
+
+class WorkloadReconciler:
+    """Drives the Workload state machine on top of the scheduler's
+    eviction/requeue primitives."""
+
+    def __init__(self, store: Store, scheduler: Scheduler,
+                 config: Optional[Configuration] = None) -> None:
+        self.store = store
+        self.scheduler = scheduler
+        self.config = config or Configuration()
+        #: keys deleted by retention GC (observability/tests)
+        self.gc_deleted: list[str] = []
+
+    # -- public entry points ------------------------------------------------
+
+    def reconcile_all(self, now: float) -> Optional[float]:
+        """Sweep every workload; returns the earliest next deadline."""
+        deadlines = [self.reconcile(key, now)
+                     for key in list(self.store.workloads)]
+        due = [d for d in deadlines if d is not None]
+        return min(due) if due else None
+
+    def reconcile(self, key: str, now: float) -> Optional[float]:
+        wl = self.store.workloads.get(key)
+        if wl is None:
+            return None
+
+        if wl.is_finished:
+            return self._gc_finished(wl, now)
+
+        if not wl.active:
+            return self._handle_deactivated(wl, now)
+
+        if wl.is_quota_reserved:
+            if self._handle_stop_policies(wl, now):
+                return None
+            if self._sync_admission_checks(wl, now):
+                return None
+
+        deadlines: list[float] = []
+        if wl.is_admitted:
+            d = self._check_max_execution_time(wl, now)
+            if d is None and not wl.active:
+                return None  # deactivated by max-exec-time
+            if d is not None:
+                deadlines.append(d)
+        if wl.is_quota_reserved:
+            d = self._check_pods_ready(wl, now)
+            if d is not None:
+                deadlines.append(d)
+        return min(deadlines) if deadlines else None
+
+    def set_pods_ready(self, key: str, ready: bool, now: float) -> None:
+        """Signal from the job layer that all pods reached/left Ready.
+
+        Reference parity: jobframework reconciler sets the PodsReady
+        condition from Job.PodsReady() (reconciler.go).
+        """
+        wl = self.store.workloads.get(key)
+        if wl is None:
+            return
+        prev = wl.condition(WorkloadConditionType.PODS_READY)
+        was_ready = prev is not None and prev.status
+        # "PodsReadyLost" marks a readiness regression, which is what the
+        # recovery timeout (vs the initial timeout) applies to.
+        if ready:
+            reason = "PodsReady"
+        elif was_ready:
+            reason = "PodsReadyLost"
+        elif prev is not None and not prev.status:
+            reason = prev.reason  # repeated not-ready keeps the original cause
+        else:
+            reason = "PodsNotReady"
+        wl.set_condition(WorkloadConditionType.PODS_READY, ready,
+                         reason=reason, now=now)
+        if ready:
+            # Pods came up: the PodsReady requeue/backoff history is done
+            # (reference: RequeueState reset once the workload runs).
+            wl.status.requeue_state = None
+        self.store.update_workload(wl)
+
+    # -- retention GC -------------------------------------------------------
+
+    def _gc_finished(self, wl: Workload, now: float) -> Optional[float]:
+        from kueue_oss_tpu import features
+
+        pol = self.config.object_retention_policies
+        if (pol is None or pol.finished_workload_retention_seconds is None
+                or not features.enabled("ObjectRetentionPolicies")):
+            return None
+        fin = wl.condition(WorkloadConditionType.FINISHED)
+        due = fin.last_transition_time + pol.finished_workload_retention_seconds
+        if now >= due:
+            self.store.delete_workload(wl.key)
+            self.gc_deleted.append(wl.key)
+            return None
+        return due
+
+    def _handle_deactivated(self, wl: Workload, now: float) -> Optional[float]:
+        if wl.is_quota_reserved:
+            self.scheduler.evict_workload(
+                wl.key, reason=EvictionReason.DEACTIVATED,
+                message="The workload is deactivated", now=now, requeue=False)
+            return None
+        from kueue_oss_tpu import features
+
+        pol = self.config.object_retention_policies
+        if (pol is None or pol.deactivated_workload_retention_seconds is None
+                or not features.enabled("ObjectRetentionPolicies")):
+            return None
+        ev = wl.condition(WorkloadConditionType.EVICTED)
+        if ev is None:
+            # Deactivated while pending (never evicted): stamp the
+            # deactivation now so the retention deadline has a stable
+            # anchor instead of receding on every reconcile.
+            wl.set_condition(WorkloadConditionType.EVICTED, True,
+                             reason=EvictionReason.DEACTIVATED,
+                             message="The workload is deactivated", now=now)
+            self.store.update_workload(wl)
+            ev = wl.condition(WorkloadConditionType.EVICTED)
+        due = ev.last_transition_time + pol.deactivated_workload_retention_seconds
+        if now >= due:
+            self.store.delete_workload(wl.key)
+            self.gc_deleted.append(wl.key)
+            return None
+        return due
+
+    # -- stop policies ------------------------------------------------------
+
+    def _handle_stop_policies(self, wl: Workload, now: float) -> bool:
+        """HoldAndDrain evicts running workloads; Hold only blocks new
+        admissions (enforced queue-side via ClusterQueuePendingQueue.active).
+        Reference parity: workload_controller.go:836-918."""
+        cq_name = self.store.cluster_queue_for(wl)
+        if cq_name is None and wl.status.admission is not None:
+            cq_name = wl.status.admission.cluster_queue
+        cq = self.store.cluster_queues.get(cq_name) if cq_name else None
+        if cq is not None and cq.stop_policy == StopPolicy.HOLD_AND_DRAIN:
+            self.scheduler.evict_workload(
+                wl.key, reason=EvictionReason.CLUSTER_QUEUE_STOPPED,
+                message=f"ClusterQueue {cq.name} is stopped", now=now)
+            return True
+        lq = self.store.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        if lq is not None and lq.stop_policy == StopPolicy.HOLD_AND_DRAIN:
+            self.scheduler.evict_workload(
+                wl.key, reason=EvictionReason.LOCAL_QUEUE_STOPPED,
+                message=f"LocalQueue {lq.name} is stopped", now=now)
+            return True
+        return False
+
+    # -- admission checks ---------------------------------------------------
+
+    def _sync_admission_checks(self, wl: Workload, now: float) -> bool:
+        """Returns True if the workload was evicted as a result.
+
+        Reference parity: workload_controller.go:752-834 +
+        pkg/workload/admissionchecks.go — prune/seed states against the CQ
+        spec, then act on Rejected > Retry > all-Ready.
+        """
+        cq_name = (wl.status.admission.cluster_queue
+                   if wl.status.admission is not None
+                   else self.store.cluster_queue_for(wl))
+        cq = self.store.cluster_queues.get(cq_name) if cq_name else None
+        if cq is None:
+            return False
+        wanted = list(cq.admission_checks)
+        # prune states for checks no longer configured; seed missing ones
+        for name in list(wl.status.admission_checks):
+            if name not in wanted:
+                del wl.status.admission_checks[name]
+        for name in wanted:
+            wl.status.admission_checks.setdefault(
+                name, AdmissionCheckState(name=name))
+
+        states = wl.status.admission_checks.values()
+        rejected = [s for s in states if s.state == CheckState.REJECTED]
+        if rejected:
+            names = ", ".join(s.name for s in rejected)
+            # Rejected is terminal: deactivate so the workload is not retried
+            # (reference: workload_controller.go rejection deactivates).
+            wl.active = False
+            self.scheduler.evict_workload(
+                wl.key, reason=EvictionReason.ADMISSION_CHECK,
+                message=f"Admission check(s) {names} rejected the workload",
+                now=now, requeue=False, underlying_cause="Rejected")
+            self.store.update_workload(wl)
+            return True
+        retry = [s for s in states if s.state == CheckState.RETRY]
+        if retry:
+            names = ", ".join(s.name for s in retry)
+            self.scheduler.evict_workload(
+                wl.key, reason=EvictionReason.ADMISSION_CHECK,
+                message=f"Admission check(s) {names} requested a retry",
+                now=now, underlying_cause="Retry")
+            return True
+        if wanted and all(s.state == CheckState.READY for s in states):
+            if not wl.is_admitted:
+                wl.set_condition(WorkloadConditionType.ADMITTED, True,
+                                 reason="Admitted", now=now)
+                self.store.update_workload(wl)
+        return False
+
+    # -- max execution time -------------------------------------------------
+
+    def _check_max_execution_time(self, wl: Workload,
+                                  now: float) -> Optional[float]:
+        """Reference parity: workload_controller.go:697 — an admitted
+        workload that has run past maxExecutionTimeSeconds is deactivated."""
+        if wl.max_execution_time is None:
+            return None
+        adm = wl.condition(WorkloadConditionType.ADMITTED)
+        if adm is None:
+            return None
+        due = adm.last_transition_time + wl.max_execution_time
+        if now >= due:
+            wl.active = False
+            self.scheduler.evict_workload(
+                wl.key, reason=EvictionReason.MAX_EXEC_TIME_EXCEEDED,
+                message=(f"Exceeded the maximum execution time of "
+                         f"{wl.max_execution_time:g}s"),
+                now=now, requeue=False)
+            self.store.update_workload(wl)
+            return None
+        return due
+
+    # -- wait-for-pods-ready ------------------------------------------------
+
+    def _check_pods_ready(self, wl: Workload, now: float) -> Optional[float]:
+        """PodsReady timeout (KEP-349): a quota-reserved workload whose pods
+        have not all become Ready within the timeout is evicted and requeued
+        with the RequeuingStrategy backoff; once backoffLimitCount is
+        exhausted it is deactivated instead.
+
+        Reference parity: workload_controller.go:1004 + RequeueState
+        (workload_types.go:774)."""
+        from kueue_oss_tpu import features
+
+        wfpr = self.config.wait_for_pods_ready
+        if wfpr is None or not wfpr.enable:
+            return None
+        if not features.enabled("WaitForPodsReady"):
+            return None
+        pr = wl.condition(WorkloadConditionType.PODS_READY)
+        if pr is not None and pr.status:
+            return None  # pods are ready
+        adm = wl.condition(WorkloadConditionType.QUOTA_RESERVED)
+        if adm is None:
+            return None
+        if pr is not None and not pr.status and pr.reason == "PodsReadyLost":
+            # Was ready once, lost readiness: recovery timeout applies
+            # (None = wait forever for recovery).
+            if wfpr.recovery_timeout_seconds is None:
+                return None
+            due = pr.last_transition_time + wfpr.recovery_timeout_seconds
+            timeout_msg = (f"Didn't recover readiness within "
+                           f"{wfpr.recovery_timeout_seconds:g}s")
+        else:
+            due = adm.last_transition_time + wfpr.timeout_seconds
+            timeout_msg = (f"Didn't become ready within "
+                           f"{wfpr.timeout_seconds:g}s")
+        if now < due:
+            return due
+
+        rs = wfpr.requeuing_strategy
+        count = (wl.status.requeue_state.count
+                 if wl.status.requeue_state is not None else 0)
+        if rs.backoff_limit_count is not None and count >= rs.backoff_limit_count:
+            wl.active = False
+            self.scheduler.evict_workload(
+                wl.key, reason=EvictionReason.DEACTIVATED,
+                message=("Exceeded the PodsReady re-queue limit of "
+                         f"{rs.backoff_limit_count}"),
+                now=now, requeue=False, underlying_cause="RequeuingLimitExceeded")
+            self.store.update_workload(wl)
+            return None
+        self.scheduler.evict_workload(
+            wl.key, reason=EvictionReason.PODS_READY_TIMEOUT,
+            message=timeout_msg,
+            now=now,
+            backoff_base_s=rs.backoff_base_seconds,
+            backoff_max_s=rs.backoff_max_seconds)
+        if rs.timestamp == "Creation":
+            # Requeue ordered by creation time: rewrite the Evicted
+            # transition time back to the creation timestamp so the queue
+            # ordering (workload.Ordering) falls back to creation order
+            # (reference: RequeuingStrategy.Timestamp=Creation).
+            wl.status.conditions.pop(WorkloadConditionType.EVICTED, None)
+            wl.set_condition(WorkloadConditionType.EVICTED, True,
+                             reason=EvictionReason.PODS_READY_TIMEOUT,
+                             message="requeued by creation timestamp",
+                             now=wl.creation_time)
+            self.store.update_workload(wl)
+        return None

@@ -330,9 +330,13 @@ def build_snapshot(store: Store, profile_mixed: bool = False) -> Snapshot:
     forest = QuotaForest()
     forest.build(store.cluster_queues.values(), store.cohorts.values())
 
+    from kueue_oss_tpu import features
+
     tas_flavors: dict[str, TASFlavorSnapshot] = {}
     for rf in store.resource_flavors.values():
         if rf.topology_name is None:
+            continue
+        if not features.enabled("TopologyAwareScheduling"):
             continue
         topology = store.topologies.get(rf.topology_name)
         if topology is None:

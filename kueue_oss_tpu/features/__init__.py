@@ -1,0 +1,61 @@
+"""Feature gates.
+
+Reference parity: pkg/features/kube_features.go:30-386 — a named-gate
+registry with per-gate defaults, overridable from the Configuration file
+(featureGates map) or a --feature-gates-style dict. Only gates that guard
+behavior implemented in this framework are registered; unknown gates are
+rejected the way the reference's featuregate library rejects them.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: gate name -> default enabled. Every registered gate is read at a use
+#: site — a gate with no enforcing code must NOT be listed here (it would
+#: silently no-op); new features register their gate when they wire it in.
+#: Reference defaults as of v1beta2.
+_DEFAULTS: dict[str, bool] = {
+    # queueing / admission
+    "PartialAdmission": True,          # scheduler podset reduction
+    "ObjectRetentionPolicies": True,   # workload controller GC
+    # topology-aware scheduling
+    "TopologyAwareScheduling": True,   # core/snapshot.py TAS snapshot build
+    "TASFailedNodeReplacement": True,  # tas/snapshot.py replacement path
+    # misc controllers
+    "WaitForPodsReady": True,          # workload controller PodsReady path
+}
+
+_lock = threading.Lock()
+_overrides: dict[str, bool] = {}
+
+
+class UnknownFeatureGate(KeyError):
+    pass
+
+
+def enabled(name: str) -> bool:
+    if name not in _DEFAULTS:
+        raise UnknownFeatureGate(name)
+    with _lock:
+        return _overrides.get(name, _DEFAULTS[name])
+
+
+def set_gates(gates: dict[str, bool]) -> None:
+    """Apply overrides (Configuration.featureGates / --feature-gates)."""
+    unknown = sorted(set(gates) - set(_DEFAULTS))
+    if unknown:
+        raise UnknownFeatureGate(", ".join(unknown))
+    with _lock:
+        _overrides.update(gates)
+
+
+def reset() -> None:
+    """Restore defaults (test isolation)."""
+    with _lock:
+        _overrides.clear()
+
+
+def all_gates() -> dict[str, bool]:
+    with _lock:
+        return {n: _overrides.get(n, d) for n, d in _DEFAULTS.items()}

@@ -86,8 +86,7 @@ class Scheduler:
         enable_partial_admission: bool = True,
         clock=time.monotonic,
         solver=None,
-        eviction_backoff_base_s: float = 1.0,
-        eviction_backoff_max_s: float = 30.0,
+        eviction_backoff_max_s: float = 3600.0,
     ) -> None:
         self.store = store
         self.queues = queues
@@ -98,11 +97,11 @@ class Scheduler:
         self.cycle_count = 0
         #: optional batched TPU solver implementing nominate() acceleration
         self.solver = solver
-        #: evicted workloads requeue after an exponential backoff
-        #: (reference parity: RequeueState, workload_types.go:774) — this
-        #: also damps preemption churn where revived high-priority
-        #: workloads would endlessly re-take capacity from preemptors.
-        self.eviction_backoff_base_s = eviction_backoff_base_s
+        #: Preemption/generic evictions requeue immediately (ordered by
+        #: eviction time, reference workload.Ordering). Only controller
+        #: evictions that pass an explicit backoff_base_s (PodsReady
+        #: timeouts, RequeuingStrategy) get a RequeueState gate; this cap
+        #: bounds their exponential delay when no per-call cap is given.
         self.eviction_backoff_max_s = eviction_backoff_max_s
         #: min-heap of (requeue_at, workload key) pending backoff expiries
         self._requeue_heap: list[tuple[float, str]] = []
@@ -228,7 +227,11 @@ class Scheduler:
                     info, cq, snapshot, full, targets)
                 return full, targets
 
-        if self.enable_partial_admission and info.can_be_partially_admitted():
+        from kueue_oss_tpu import features
+
+        if (self.enable_partial_admission
+                and features.enabled("PartialAdmission")
+                and info.can_be_partially_admitted()):
             def probe(counts):
                 assignment = assigner.assign(counts)
                 m = assignment.representative_mode()
@@ -375,6 +378,10 @@ class Scheduler:
         if e.info.obj.is_quota_reserved:
             return True
         podsets = {ps.name: ps for ps in e.info.obj.podsets}
+        # Accumulate the whole entry's demand per (flavor, leaf) first: a
+        # multi-podset workload (leader+workers) or several domains landing
+        # on the same leaf must be checked jointly, not one domain at a time.
+        demand: dict[tuple[str, tuple[str, ...]], dict[str, int]] = {}
         for psa in e.assignment.podsets:
             ta = psa.topology_assignment
             if ta is None:
@@ -384,12 +391,19 @@ class Scheduler:
                  if rec.name in snapshot.tas_flavors), None)
             if flavor is None:
                 continue
-            snap = snapshot.tas_flavors[flavor]
             ps = podsets.get(psa.name)
             per_pod = dict(ps.requests) if ps is not None else {}
             for dom in ta.domains:
-                if not snap.fits(dom.values, per_pod, dom.count):
-                    return False
+                bucket = demand.setdefault((flavor, tuple(dom.values)), {})
+                for r, q in per_pod.items():
+                    bucket[r] = bucket.get(r, 0) + q * dom.count
+                bucket["pods"] = bucket.get("pods", 0) + dom.count
+        for (flavor, values), need in demand.items():
+            remaining = snapshot.tas_flavors[flavor].remaining_capacity(values)
+            if remaining is None:
+                return False
+            if any(q > remaining.get(r, 0) for r, q in need.items()):
+                return False
         return True
 
     def _quota_to_reserve(self, e: Entry, cq: ClusterQueueSnapshot):
@@ -438,9 +452,17 @@ class Scheduler:
         wl.status.admission = admission
         wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
                          reason="QuotaReserved", now=now)
-        # Successful re-admission clears eviction-backoff history
-        # (reference: RequeueState cleared on quota reservation).
-        wl.status.requeue_state = None
+        if wl.is_evicted:
+            # Quota reservation supersedes a previous eviction
+            # (reference: SetQuotaReservation resets the Evicted condition).
+            wl.set_condition(WorkloadConditionType.EVICTED, False,
+                             reason="QuotaReserved", now=now)
+        # Re-admission clears the backoff gate but keeps the count: the
+        # count accumulates across PodsReady eviction/re-admission rounds so
+        # RequeuingStrategy.backoffLimitCount can trip; it resets only when
+        # pods actually become ready (WorkloadReconciler.set_pods_ready).
+        if wl.status.requeue_state is not None:
+            wl.status.requeue_state.requeue_at = None
         cq_spec = self.store.cluster_queues[e.info.cluster_queue]
         if cq_spec.admission_checks:
             for name in cq_spec.admission_checks:
@@ -471,9 +493,21 @@ class Scheduler:
         e.info.last_assignment = None
 
     def evict_workload(self, key: str, reason: str, message: str, now: float,
-                       preemption_reason: str = "") -> None:
+                       preemption_reason: str = "",
+                       backoff_base_s: Optional[float] = None,
+                       backoff_max_s: Optional[float] = None,
+                       requeue: bool = True,
+                       underlying_cause: str = "") -> None:
         """Evict + finalize: release quota and requeue (the reference splits
-        this between the scheduler patch and the Workload controller)."""
+        this between the scheduler patch and the Workload controller).
+
+        Requeue semantics follow the reference: preemption/generic evictions
+        re-enter the queue immediately, ordered by their eviction timestamp
+        (workload.Ordering); ONLY controller-driven PodsReady evictions pass
+        an explicit backoff (configuration_types.go RequeuingStrategy) and
+        get a RequeueState gate + count. requeue=False skips re-queueing
+        entirely (deactivation — the workload cannot re-enter anyway).
+        """
         wl = self.store.workloads.get(key)
         if wl is None or wl.is_finished:
             return
@@ -488,21 +522,39 @@ class Scheduler:
                          now=now)
         wl.status.admission = None
         wl.status.admission_checks.clear()
-        # Exponential requeue backoff: the workload becomes schedulable
-        # again only at requeue_at (reference: RequeueState).
-        from kueue_oss_tpu.api.types import RequeueState
+        # Per-reason eviction counters on the workload status
+        # (reference: schedulingStats.evictions, workload_types.go).
+        for ev in wl.status.eviction_stats:
+            if ev.reason == reason and ev.underlying_cause == underlying_cause:
+                ev.count += 1
+                break
+        else:
+            from kueue_oss_tpu.api.types import WorkloadSchedulingStatsEviction
 
-        rs = wl.status.requeue_state or RequeueState()
-        rs.count += 1
-        delay = min(self.eviction_backoff_base_s * (2 ** (rs.count - 1)),
-                    self.eviction_backoff_max_s)
-        rs.requeue_at = now + delay
-        wl.status.requeue_state = rs
-        heapq.heappush(self._requeue_heap, (rs.requeue_at, key))
+            wl.status.eviction_stats.append(WorkloadSchedulingStatsEviction(
+                reason=reason, underlying_cause=underlying_cause, count=1))
+        # The unhealthy-nodes list and the pods-readiness signal belong to
+        # the admission being released; a future re-admission starts a
+        # fresh PodsReady window.
+        wl.status.unhealthy_nodes = []
+        wl.status.conditions.pop(WorkloadConditionType.PODS_READY, None)
+        if requeue and backoff_base_s is not None:
+            # Exponential requeue backoff: the workload becomes schedulable
+            # again only at requeue_at (reference: RequeueState).
+            from kueue_oss_tpu.api.types import RequeueState
+
+            cap = (backoff_max_s if backoff_max_s is not None
+                   else self.eviction_backoff_max_s)
+            rs = wl.status.requeue_state or RequeueState()
+            rs.count += 1
+            delay = min(backoff_base_s * (2 ** (rs.count - 1)), cap)
+            rs.requeue_at = now + delay
+            wl.status.requeue_state = rs
+            heapq.heappush(self._requeue_heap, (rs.requeue_at, key))
         self.store.update_workload(wl)
         self.evicted_total[wl.key] = self.evicted_total.get(wl.key, 0) + 1
         cq = self.store.cluster_queue_for(wl)
-        if cq:
+        if cq and preemption_reason:
             self.preempted_total[cq] = self.preempted_total.get(cq, 0) + 1
         # Freed capacity wakes parked workloads in the cohort.
         self.queues.report_workload_evicted(wl)

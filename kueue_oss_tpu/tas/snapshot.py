@@ -261,14 +261,22 @@ class TASFlavorSnapshot:
 
     def fits(self, domain_values: Iterable[str],
              single_pod_requests: Requests, count: int) -> bool:
-        leaf = self._leaf_for_values(tuple(domain_values))
-        if leaf is None:
+        remaining = self.remaining_capacity(domain_values)
+        if remaining is None:
             return False
-        remaining = dict(leaf.free_capacity)
-        _sub(remaining, leaf.tas_usage)
         req = dict(single_pod_requests)
         req["pods"] = req.get("pods", 0) + 1
         return count_in(req, remaining) >= count
+
+    def remaining_capacity(self, domain_values: Iterable[str]) -> Optional[Requests]:
+        """Free capacity minus assumed TAS usage for one leaf domain; None
+        if the domain is unknown (e.g. the node left the snapshot)."""
+        leaf = self._leaf_for_values(tuple(domain_values))
+        if leaf is None:
+            return None
+        remaining = dict(leaf.free_capacity)
+        _sub(remaining, leaf.tas_usage)
+        return remaining
 
     # ------------------------------------------------------------------
     # Main entry: grouped placement over podsets
@@ -297,9 +305,35 @@ class TASFlavorSnapshot:
             groups[key].append(tr)
 
         unhealthy = list(workload.status.unhealthy_nodes) if workload else []
+        # Replacement only applies to a workload that still holds a topology
+        # assignment; a requeued workload with a stale unhealthy list is
+        # placed from scratch. More than one failed node is beyond repair —
+        # fail so the caller evicts (reference: single-node replacement,
+        # tas_flavor_snapshot.go:614).
+        from kueue_oss_tpu import features
+
+        has_prior = (
+            workload is not None and workload.status.admission is not None
+            and any(psa.topology_assignment is not None
+                    for psa in workload.status.admission.podset_assignments))
+        if (unhealthy and has_prior
+                and not features.enabled("TASFailedNodeReplacement")):
+            reason = (f"node(s) {sorted(unhealthy)} in the topology "
+                      "assignment are unhealthy (replacement disabled)")
+            for key in order:
+                for tr in groups[key]:
+                    result[tr.podset.name] = TASAssignmentResult(failure=reason)
+            return result
+        if unhealthy and has_prior and len(unhealthy) > 1:
+            reason = (f"nodes {sorted(unhealthy)} in the topology assignment "
+                      "are unhealthy; only a single node can be replaced")
+            for key in order:
+                for tr in groups[key]:
+                    result[tr.podset.name] = TASAssignmentResult(failure=reason)
+            return result
         for key in order:
             trs = groups[key]
-            if unhealthy:
+            if unhealthy and has_prior:
                 for tr in trs:
                     res = self._replace_unhealthy(tr, workload, unhealthy[0],
                                                   assumed)
@@ -375,7 +409,12 @@ class TASFlavorSnapshot:
                 if cand.name == tr.podset.name:
                     psa = cand
         if psa is None or psa.topology_assignment is None:
-            return TASAssignmentResult()
+            # Inconsistent state: the workload holds a prior assignment for
+            # some podsets but not this one — fail so the caller evicts
+            # rather than silently admitting without a placement.
+            return TASAssignmentResult(failure=(
+                f"podset {tr.podset.name!r} has no prior topology assignment "
+                "to repair"))
         existing = TopologyAssignment(
             levels=list(psa.topology_assignment.levels),
             domains=[TopologyDomainAssignment(list(d.values), d.count)

@@ -1,0 +1,141 @@
+"""Configuration loading/validation + feature gate tests.
+
+Reference parity: pkg/config tests and pkg/features gate registry.
+"""
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.config import Configuration, load, validate
+from kueue_oss_tpu.config.configuration import apply_resource_transformations
+
+
+def test_load_defaults():
+    cfg = load({})
+    assert cfg.namespace == "kueue-system"
+    assert cfg.wait_for_pods_ready is None
+    assert cfg.integrations == ["batch/job"]
+    assert validate(cfg) == []
+
+
+def test_load_full_tree():
+    cfg = load({
+        "namespace": "custom",
+        "manageJobsWithoutQueueName": True,
+        "waitForPodsReady": {
+            "enable": True,
+            "timeout": 120,
+            "recoveryTimeout": 60,
+            "blockAdmission": True,
+            "requeuingStrategy": {
+                "timestamp": "Creation",
+                "backoffLimitCount": 5,
+                "backoffBaseSeconds": 30,
+                "backoffMaxSeconds": 600,
+            },
+        },
+        "integrations": {"frameworks": ["batch/job", "jobset", "pod"]},
+        "fairSharing": {"enable": True,
+                        "preemptionStrategies": ["LessThanInitialShare"]},
+        "admissionFairSharing": {
+            "usageHalfLifeTime": 600,
+            "usageSamplingInterval": 30,
+            "resourceWeights": {"cpu": 2.0},
+        },
+        "resources": {
+            "excludeResourcePrefixes": ["example.com/"],
+            "transformations": [
+                {"input": "nvidia.com/gpu", "strategy": "Replace",
+                 "outputs": {"accelerator": 1.0}},
+            ],
+            "deviceClassMappings": {"gpu.example.com": "accelerator"},
+        },
+        "objectRetentionPolicies": {"finishedWorkloadRetention": 3600},
+        "multiKueue": {"workerLostTimeout": 300, "dispatcherName": "Incremental"},
+        "featureGates": {"TPUSolver": False},
+    })
+    assert cfg.namespace == "custom"
+    wfpr = cfg.wait_for_pods_ready
+    assert wfpr.enable and wfpr.timeout_seconds == 120
+    assert wfpr.requeuing_strategy.timestamp == "Creation"
+    assert wfpr.requeuing_strategy.backoff_limit_count == 5
+    assert cfg.integrations == ["batch/job", "jobset", "pod"]
+    assert cfg.fair_sharing.enable
+    assert cfg.admission_fair_sharing.resource_weights == {"cpu": 2.0}
+    assert cfg.resources.transformations[0].strategy == "Replace"
+    assert cfg.object_retention_policies.finished_workload_retention_seconds == 3600
+    assert cfg.multikueue.dispatcher_name == "Incremental"
+    assert validate(cfg) == []
+
+
+def test_validate_rejects_bad_values():
+    cfg = load({
+        "waitForPodsReady": {"enable": True, "timeout": -5,
+                             "requeuingStrategy": {"timestamp": "Nope"}},
+        "multiKueue": {"dispatcherName": "Bogus"},
+        "resources": {"transformations": [
+            {"input": "cpu", "strategy": "Wat"},
+            {"input": "cpu", "strategy": "Retain"},
+        ]},
+        "fairSharing": {"preemptionStrategies": ["NotAStrategy"]},
+    })
+    errs = validate(cfg)
+    joined = "\n".join(errs)
+    assert "timeout must be > 0" in joined
+    assert "Nope" in joined
+    assert "Bogus" in joined
+    assert "Wat" in joined
+    assert "duplicate resource transformation" in joined
+    assert "NotAStrategy" in joined
+
+
+def test_resource_transformations():
+    cfg = load({"resources": {
+        "excludeResourcePrefixes": ["example.com/"],
+        "transformations": [
+            {"input": "nvidia.com/gpu", "strategy": "Replace",
+             "outputs": {"accelerator": 2.0}},
+            {"input": "cpu", "strategy": "Retain",
+             "outputs": {"compute-credits": 0.001}},
+        ],
+    }}).resources
+    out = apply_resource_transformations(
+        {"cpu": 4000, "nvidia.com/gpu": 2, "example.com/fpga": 7,
+         "memory": 1024}, cfg)
+    assert out == {"cpu": 4000, "compute-credits": 4, "accelerator": 4,
+                   "memory": 1024}
+
+
+def test_feature_gates():
+    features.reset()
+    assert features.enabled("PartialAdmission")
+    assert features.enabled("TopologyAwareScheduling")
+    features.set_gates({"TopologyAwareScheduling": False,
+                        "PartialAdmission": False})
+    assert not features.enabled("TopologyAwareScheduling")
+    assert not features.enabled("PartialAdmission")
+    features.reset()
+    assert features.enabled("PartialAdmission")
+
+
+def test_feature_gates_apply_from_config():
+    from kueue_oss_tpu.config import apply_feature_gates
+
+    features.reset()
+    cfg = load({"featureGates": {"WaitForPodsReady": False}})
+    apply_feature_gates(cfg)
+    assert not features.enabled("WaitForPodsReady")
+    features.reset()
+
+
+def test_feature_gate_unknown_rejected():
+    features.reset()
+    with pytest.raises(features.UnknownFeatureGate):
+        features.enabled("NoSuchGate")
+    with pytest.raises(features.UnknownFeatureGate):
+        features.set_gates({"NoSuchGate": True})
+
+
+def test_configuration_dataclass_direct():
+    cfg = Configuration()
+    assert validate(cfg) == []

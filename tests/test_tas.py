@@ -566,3 +566,153 @@ class TestSchedulerIntegration:
         ta = big.status.admission.podset_assignments[0].topology_assignment
         racks = {v.values[0].split("-")[2] for v in ta.domains}
         assert len(racks) == 1
+
+
+class TestReviewRegressions:
+    """Regressions from code review: multi-podset joint fit, unhealthy-node
+    edge cases, and the TASFailedNodeReplacement gate."""
+
+    def _grouped_workload(self, store, name, priority=0):
+        workers = PodSet(name="w", count=2, requests={"cpu": 1000},
+                         topology_request=PodSetTopologyRequest(
+                             required=HOST, podset_group_name="g"))
+        leader = PodSet(name="l", count=1, requests={"cpu": 1000},
+                        topology_request=PodSetTopologyRequest(
+                            required=HOST, podset_group_name="g"))
+        wl = Workload(name=name, queue_name="lq", priority=priority,
+                      podsets=[workers, leader])
+        store.add_workload(wl)
+        return wl
+
+    def test_same_cycle_multi_podset_no_oversubscription(self):
+        # one host with 4 cpu; wl1 (2x1000) + wl2 (leader+2 workers x1000)
+        # nominated in one cycle: both "fit" against the nomination-time
+        # snapshot but jointly need 5000 > 4000
+        store = Store()
+        store.upsert_topology(Topology(name="default",
+                                       levels=[BLOCK, RACK, HOST]))
+        store.upsert_resource_flavor(ResourceFlavor(
+            name="tas-flavor", topology_name="default"))
+        store.upsert_node(Node(name="n0", labels={BLOCK: "b0", RACK: "r0"},
+                               allocatable={"cpu": 4000}))
+        for cq in ("cq1", "cq2"):
+            store.upsert_cluster_queue(ClusterQueue(
+                name=cq,
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="tas-flavor", resources=[
+                        ResourceQuota(name="cpu", nominal=4000)])])]))
+        store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq1"))
+        store.upsert_local_queue(LocalQueue(name="lq2", cluster_queue="cq2"))
+        wl1 = Workload(name="wl1", queue_name="lq", podsets=[PodSet(
+            name="main", count=2, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(required=HOST))])
+        store.add_workload(wl1)
+        workers = PodSet(name="w", count=2, requests={"cpu": 1000},
+                         topology_request=PodSetTopologyRequest(
+                             required=HOST, podset_group_name="g"))
+        leader = PodSet(name="l", count=1, requests={"cpu": 1000},
+                        topology_request=PodSetTopologyRequest(
+                            required=HOST, podset_group_name="g"))
+        wl2 = Workload(name="wl2", queue_name="lq2",
+                       podsets=[workers, leader])
+        store.add_workload(wl2)
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        for t in range(3):
+            sched.schedule(now=float(t))
+        admitted = [w for w in (wl1, wl2) if w.is_admitted]
+        assert len(admitted) == 1, "joint demand 5000 > 4000 must not admit both"
+
+    def test_multiple_unhealthy_nodes_fail_to_eviction(self):
+        nodes = make_nodes(racks=2, hosts=2)
+        ps = PodSet(name="main", count=4, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=BLOCK))
+        wl = Workload(name="wl", queue_name="lq", podsets=[ps])
+        from kueue_oss_tpu.api.types import (
+            Admission,
+            PodSetAssignment,
+            TopologyAssignment,
+            TopologyDomainAssignment,
+        )
+        wl.status.admission = Admission(
+            cluster_queue="cq",
+            podset_assignments=[PodSetAssignment(
+                name="main", flavors={"cpu": "default"},
+                resource_usage={"cpu": 4000}, count=4,
+                topology_assignment=TopologyAssignment(
+                    levels=[HOST],
+                    domains=[
+                        TopologyDomainAssignment(["n-0-0-0"], 2),
+                        TopologyDomainAssignment(["n-0-0-1"], 2),
+                    ]))])
+        wl.status.unhealthy_nodes = ["n-0-0-0", "n-0-0-1"]
+        snap = snap_3level(nodes)
+        res = place(snap, ps, workload=wl)
+        assert res["main"].failure and "single node" in res["main"].failure
+
+    def test_stale_unhealthy_without_prior_assignment_places_fresh(self):
+        # a requeued workload (admission cleared) with a stale unhealthy
+        # list is placed from scratch, not silently admitted unplaced
+        nodes = make_nodes(racks=1, hosts=2)
+        ps = PodSet(name="main", count=2, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=RACK))
+        wl = Workload(name="wl", queue_name="lq", podsets=[ps])
+        wl.status.unhealthy_nodes = ["n-0-0-0"]
+        snap = snap_3level(nodes)
+        res = place(snap, ps, workload=wl)
+        doms = dict(domains_of(res))
+        assert sum(doms.values()) == 2
+
+    def test_eviction_clears_unhealthy_nodes(self):
+        store = Store()
+        store.upsert_resource_flavor(ResourceFlavor(name="default"))
+        store.upsert_cluster_queue(ClusterQueue(
+            name="cq",
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources=[
+                    ResourceQuota(name="cpu", nominal=4000)])])]))
+        store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        wl = Workload(name="wl", queue_name="lq",
+                      podsets=[PodSet(count=1, requests={"cpu": 1000})])
+        store.add_workload(wl)
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        sched.schedule(now=0.0)
+        wl.status.unhealthy_nodes = ["gone-node"]
+        sched.evict_workload(wl.key, reason="Test", message="", now=1.0)
+        assert wl.status.unhealthy_nodes == []
+
+    def test_replacement_gate_disabled_fails(self):
+        from kueue_oss_tpu import features
+
+        nodes = make_nodes(racks=2, hosts=2)
+        ps = PodSet(name="main", count=2, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=BLOCK))
+        wl = Workload(name="wl", queue_name="lq", podsets=[ps])
+        from kueue_oss_tpu.api.types import (
+            Admission,
+            PodSetAssignment,
+            TopologyAssignment,
+            TopologyDomainAssignment,
+        )
+        wl.status.admission = Admission(
+            cluster_queue="cq",
+            podset_assignments=[PodSetAssignment(
+                name="main", flavors={"cpu": "default"},
+                resource_usage={"cpu": 2000}, count=2,
+                topology_assignment=TopologyAssignment(
+                    levels=[HOST],
+                    domains=[TopologyDomainAssignment(["n-0-0-0"], 2)]))])
+        wl.status.unhealthy_nodes = ["n-0-0-0"]
+        snap = snap_3level(nodes)
+        features.set_gates({"TASFailedNodeReplacement": False})
+        try:
+            res = place(snap, ps, workload=wl)
+            assert res["main"].failure
+        finally:
+            features.reset()
+        # with the gate on (default) the same scenario heals
+        res = place(snap_3level(nodes), ps, workload=wl)
+        assert res["main"].assignment is not None

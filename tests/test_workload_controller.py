@@ -1,0 +1,490 @@
+"""Workload lifecycle controller tests: admission-check sync, PodsReady
+timeout/recovery/backoff-limit, max execution time, stop policies,
+deactivation, retention GC, and the provisioning admission-check controller.
+
+Scenario shapes mirror the reference's
+pkg/controller/core/workload_controller_test.go and
+pkg/controller/admissionchecks/provisioning tests.
+"""
+
+import pytest
+
+from kueue_oss_tpu.admissionchecks.provisioning import (
+    CONTROLLER_NAME,
+    ProvisioningConfig,
+    ProvisioningController,
+)
+from kueue_oss_tpu.api.types import (
+    AdmissionCheck,
+    CheckState,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    StopPolicy,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_oss_tpu.config import (
+    Configuration,
+    ObjectRetentionPolicies,
+    RequeuingStrategy,
+    WaitForPodsReady,
+)
+from kueue_oss_tpu.controllers import EvictionReason, WorkloadReconciler
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+def make_cq(name="cq", nominal=4000, checks=()):
+    return ClusterQueue(
+        name=name,
+        admission_checks=list(checks),
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=nominal)])],
+        )],
+    )
+
+
+class Env:
+    def __init__(self, config=None, checks=()):
+        self.store = Store()
+        self.store.upsert_resource_flavor(ResourceFlavor(name="default"))
+        self.store.upsert_cluster_queue(make_cq(checks=checks))
+        self.store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        for c in checks:
+            self.store.upsert_admission_check(
+                AdmissionCheck(name=c, controller_name=CONTROLLER_NAME))
+        self.queues = QueueManager(self.store)
+        self.scheduler = Scheduler(self.store, self.queues)
+        self.reconciler = WorkloadReconciler(self.store, self.scheduler,
+                                             config=config)
+        self.t = 0.0
+
+    def submit(self, name="wl", cpu=1000, **kw):
+        self.t += 1.0
+        wl = Workload(name=name, queue_name="lq", creation_time=self.t,
+                      podsets=[PodSet(count=1, requests={"cpu": cpu})], **kw)
+        self.store.add_workload(wl)
+        return wl
+
+    def cycle(self):
+        self.t += 1.0
+        self.scheduler.requeue_due(self.t)
+        return self.scheduler.schedule(self.t)
+
+    def wl(self, name="wl"):
+        return self.store.workloads.get(f"default/{name}")
+
+
+# ---------------------------------------------------------------------------
+# Admission checks
+# ---------------------------------------------------------------------------
+
+
+def test_no_checks_admitted_directly():
+    env = Env()
+    env.submit()
+    env.cycle()
+    assert env.wl().is_admitted
+
+
+def test_checks_gate_admitted_until_all_ready():
+    env = Env(checks=("check-a", "check-b"))
+    env.submit()
+    env.cycle()
+    wl = env.wl()
+    assert wl.is_quota_reserved and not wl.is_admitted
+    # one ready, one pending -> still not admitted
+    wl.status.admission_checks["check-a"].state = CheckState.READY
+    env.reconciler.reconcile(wl.key, env.t)
+    assert not wl.is_admitted
+    wl.status.admission_checks["check-b"].state = CheckState.READY
+    env.reconciler.reconcile(wl.key, env.t)
+    assert wl.is_admitted
+
+
+def test_check_retry_evicts_and_requeues():
+    env = Env(checks=("check-a",))
+    env.submit()
+    env.cycle()
+    wl = env.wl()
+    wl.status.admission_checks["check-a"].state = CheckState.RETRY
+    env.reconciler.reconcile(wl.key, env.t)
+    assert wl.is_evicted and not wl.is_quota_reserved
+    assert wl.active  # retry is not terminal
+    # checks reset on eviction; workload re-admits after backoff
+    assert not wl.status.admission_checks
+    for _ in range(8):
+        env.cycle()
+    assert env.wl().is_quota_reserved
+    assert env.wl().status.admission_checks["check-a"].state == CheckState.PENDING
+
+
+def test_check_rejected_deactivates():
+    env = Env(checks=("check-a",))
+    env.submit()
+    env.cycle()
+    wl = env.wl()
+    wl.status.admission_checks["check-a"].state = CheckState.REJECTED
+    env.reconciler.reconcile(wl.key, env.t)
+    assert wl.is_evicted and not wl.active
+    ev = [e for e in wl.status.eviction_stats
+          if e.reason == EvictionReason.ADMISSION_CHECK]
+    assert ev and ev[0].underlying_cause == "Rejected"
+    # deactivated: never re-queued
+    for _ in range(8):
+        env.cycle()
+    assert not env.wl().is_quota_reserved
+
+
+def test_check_pruned_when_removed_from_cq():
+    env = Env(checks=("check-a",))
+    env.submit()
+    env.cycle()
+    wl = env.wl()
+    cq = make_cq(checks=())
+    env.store.upsert_cluster_queue(cq)
+    env.reconciler.reconcile(wl.key, env.t)
+    assert "check-a" not in wl.status.admission_checks
+
+
+# ---------------------------------------------------------------------------
+# WaitForPodsReady
+# ---------------------------------------------------------------------------
+
+
+def podsready_config(timeout=10.0, limit=None, base=1.0, recovery=None,
+                     timestamp="Eviction"):
+    return Configuration(wait_for_pods_ready=WaitForPodsReady(
+        enable=True, timeout_seconds=timeout,
+        recovery_timeout_seconds=recovery,
+        requeuing_strategy=RequeuingStrategy(
+            timestamp=timestamp, backoff_limit_count=limit,
+            backoff_base_seconds=base, backoff_max_seconds=60.0)))
+
+
+def test_pods_ready_within_timeout_no_eviction():
+    env = Env(config=podsready_config())
+    env.submit()
+    env.cycle()
+    env.reconciler.set_pods_ready("default/wl", True, env.t)
+    due = env.reconciler.reconcile("default/wl", env.t)
+    assert due is None
+    assert env.wl().is_admitted
+
+
+def test_pods_ready_timeout_evicts_with_backoff():
+    env = Env(config=podsready_config(timeout=10.0, base=2.0))
+    env.submit()
+    env.cycle()
+    wl = env.wl()
+    admitted_at = wl.condition(WorkloadConditionType.QUOTA_RESERVED).last_transition_time
+    # before deadline: returns the deadline, no eviction
+    due = env.reconciler.reconcile(wl.key, admitted_at + 5)
+    assert due == pytest.approx(admitted_at + 10)
+    assert wl.is_admitted
+    # past deadline: evicted with the configured backoff (base 2s)
+    env.reconciler.reconcile(wl.key, admitted_at + 11)
+    assert wl.is_evicted
+    ev = wl.condition(WorkloadConditionType.EVICTED)
+    assert ev.reason == EvictionReason.PODS_READY_TIMEOUT
+    rs = wl.status.requeue_state
+    assert rs.count == 1
+    assert rs.requeue_at == pytest.approx(admitted_at + 11 + 2.0)
+
+
+def test_pods_ready_backoff_limit_deactivates():
+    env = Env(config=podsready_config(timeout=2.0, limit=1, base=1.0))
+    env.submit()
+    env.cycle()
+    # first timeout -> evict + requeue (count=1)
+    env.reconciler.reconcile("default/wl", env.t + 3)
+    assert env.wl().status.requeue_state.count == 1
+    # re-admit
+    env.t += 10
+    for _ in range(4):
+        env.cycle()
+    assert env.wl().is_quota_reserved
+    # second timeout: count(1) >= limit(1) -> deactivated
+    env.reconciler.reconcile("default/wl", env.t + 30)
+    wl = env.wl()
+    assert not wl.active
+    assert wl.condition(WorkloadConditionType.EVICTED).reason == \
+        EvictionReason.DEACTIVATED
+
+
+def test_pods_ready_recovery_timeout():
+    env = Env(config=podsready_config(timeout=10.0, recovery=3.0))
+    env.submit()
+    env.cycle()
+    env.reconciler.set_pods_ready("default/wl", True, env.t)
+    env.reconciler.set_pods_ready("default/wl", False, env.t + 5)
+    # recovery window (3s) not yet over
+    due = env.reconciler.reconcile("default/wl", env.t + 6)
+    assert due == pytest.approx(env.t + 8)
+    assert env.wl().is_admitted
+    # recovery window over -> eviction
+    env.reconciler.reconcile("default/wl", env.t + 9)
+    assert env.wl().is_evicted
+
+
+def test_pods_ready_never_ready_initial_timeout_applies():
+    env = Env(config=podsready_config(timeout=10.0, recovery=300.0))
+    env.submit()
+    env.cycle()
+    # pods reported not-ready (never were ready): initial timeout applies,
+    # not the recovery timeout
+    env.reconciler.set_pods_ready("default/wl", False, env.t)
+    adm = env.wl().condition(WorkloadConditionType.QUOTA_RESERVED)
+    env.reconciler.reconcile("default/wl", adm.last_transition_time + 11)
+    assert env.wl().is_evicted
+
+
+# ---------------------------------------------------------------------------
+# Max execution time / deactivation / stop policies / GC
+# ---------------------------------------------------------------------------
+
+
+def test_max_execution_time_deactivates():
+    env = Env()
+    env.submit(max_execution_time=100.0)
+    env.cycle()
+    wl = env.wl()
+    t0 = wl.condition(WorkloadConditionType.ADMITTED).last_transition_time
+    due = env.reconciler.reconcile(wl.key, t0 + 50)
+    assert due == pytest.approx(t0 + 100)
+    assert wl.active
+    env.reconciler.reconcile(wl.key, t0 + 101)
+    assert not wl.active
+    assert wl.condition(WorkloadConditionType.EVICTED).reason == \
+        EvictionReason.MAX_EXEC_TIME_EXCEEDED
+
+
+def test_deactivation_evicts_without_requeue():
+    env = Env()
+    env.submit()
+    env.cycle()
+    wl = env.wl()
+    wl.active = False
+    env.reconciler.reconcile(wl.key, env.t)
+    assert wl.is_evicted and wl.status.requeue_state is None
+
+
+def test_cluster_queue_hold_and_drain_evicts():
+    env = Env()
+    env.submit()
+    env.cycle()
+    cq = env.store.cluster_queues["cq"]
+    cq.stop_policy = StopPolicy.HOLD_AND_DRAIN
+    env.store.upsert_cluster_queue(cq)
+    env.reconciler.reconcile("default/wl", env.t)
+    wl = env.wl()
+    assert wl.is_evicted
+    assert wl.condition(WorkloadConditionType.EVICTED).reason == \
+        EvictionReason.CLUSTER_QUEUE_STOPPED
+    # stopped queue must not re-admit
+    for _ in range(8):
+        env.cycle()
+    assert not env.wl().is_quota_reserved
+
+
+def test_local_queue_hold_and_drain_evicts():
+    env = Env()
+    env.submit()
+    env.cycle()
+    lq = env.store.local_queues["default/lq"]
+    lq.stop_policy = StopPolicy.HOLD_AND_DRAIN
+    env.reconciler.reconcile("default/wl", env.t)
+    assert env.wl().condition(WorkloadConditionType.EVICTED).reason == \
+        EvictionReason.LOCAL_QUEUE_STOPPED
+
+
+def test_finished_retention_gc():
+    cfg = Configuration(object_retention_policies=ObjectRetentionPolicies(
+        finished_workload_retention_seconds=60.0))
+    env = Env(config=cfg)
+    env.submit()
+    env.cycle()
+    env.scheduler.finish_workload("default/wl", now=100.0)
+    due = env.reconciler.reconcile("default/wl", 110.0)
+    assert due == pytest.approx(160.0)
+    assert env.wl() is not None
+    env.reconciler.reconcile("default/wl", 161.0)
+    assert env.wl() is None
+    assert env.reconciler.gc_deleted == ["default/wl"]
+
+
+def test_reconcile_all_returns_earliest_deadline():
+    env = Env(config=podsready_config(timeout=50.0))
+    env.submit("a")
+    env.submit("b", max_execution_time=500.0)
+    env.cycle()
+    env.cycle()  # one head per CQ per cycle
+    due = env.reconciler.reconcile_all(env.t)
+    a_adm = env.wl("a").condition(
+        WorkloadConditionType.QUOTA_RESERVED).last_transition_time
+    b_adm = env.wl("b").condition(
+        WorkloadConditionType.QUOTA_RESERVED).last_transition_time
+    assert due == pytest.approx(min(a_adm + 50.0, b_adm + 500.0))
+
+
+# ---------------------------------------------------------------------------
+# Provisioning admission-check controller
+# ---------------------------------------------------------------------------
+
+
+def test_provisioning_happy_path():
+    env = Env(checks=("prov",))
+    ctl = ProvisioningController(env.store, provider=lambda req: True)
+    env.submit()
+    env.cycle()
+    ctl.reconcile(env.t)
+    wl = env.wl()
+    assert wl.status.admission_checks["prov"].state == CheckState.READY
+    env.reconciler.reconcile(wl.key, env.t)
+    assert wl.is_admitted
+
+
+def test_provisioning_pending_then_ready():
+    env = Env(checks=("prov",))
+    answers = {"v": None}
+    ctl = ProvisioningController(env.store, provider=lambda req: answers["v"])
+    env.submit()
+    env.cycle()
+    ctl.reconcile(env.t)
+    assert env.wl().status.admission_checks["prov"].state == CheckState.PENDING
+    answers["v"] = True
+    ctl.reconcile(env.t + 1)
+    assert env.wl().status.admission_checks["prov"].state == CheckState.READY
+
+
+def test_provisioning_retry_backoff_then_reject():
+    env = Env(checks=("prov",))
+    attempts = []
+
+    def provider(req):
+        attempts.append(req.attempt)
+        return False
+
+    ctl = ProvisioningController(
+        env.store, provider=provider,
+        config=ProvisioningConfig(max_retries=2, base_backoff_seconds=10.0))
+    env.submit()
+    env.cycle()
+    t0 = env.t
+    due = ctl.reconcile(t0)
+    # attempt 1 failed -> retry at t0+10
+    assert due == pytest.approx(t0 + 10)
+    assert env.wl().status.admission_checks["prov"].state == CheckState.PENDING
+    # before backoff expiry nothing happens
+    ctl.reconcile(t0 + 5)
+    assert max(attempts) == 1
+    # attempt 2 fails -> backoff 20s; attempt 3 fails -> attempts exhausted
+    due = ctl.reconcile(t0 + 11)
+    assert max(attempts) == 2
+    ctl.reconcile(due + 1)
+    assert max(attempts) == 3
+    assert env.wl().status.admission_checks["prov"].state == CheckState.REJECTED
+    # reconciler deactivates on rejection
+    env.reconciler.reconcile("default/wl", env.t)
+    assert not env.wl().active
+
+
+def test_provisioning_gc_after_finish():
+    env = Env(checks=("prov",))
+    ctl = ProvisioningController(env.store, provider=lambda req: None)
+    env.submit()
+    env.cycle()
+    ctl.reconcile(env.t)
+    assert len(ctl.requests) == 1
+    env.scheduler.finish_workload("default/wl", now=env.t)
+    ctl.reconcile(env.t + 1)
+    assert not ctl.requests
+
+
+def test_preemption_eviction_requeues_immediately_without_requeue_state():
+    """Reference parity: only PodsReady evictions carry RequeueState backoff;
+    preempted/generic evictions re-enter the queue at once ordered by
+    eviction time."""
+    env = Env()
+    env.submit()
+    env.cycle()
+    wl = env.wl()
+    env.scheduler.evict_workload(wl.key, reason="Preempted", message="",
+                                 now=env.t, preemption_reason="InClusterQueue")
+    assert wl.status.requeue_state is None
+    # already back in the pending queue without any requeue_due call
+    assert env.queues.has_pending()
+    env.scheduler.schedule(env.t + 1)
+    assert env.wl().is_quota_reserved
+
+
+def test_eviction_resets_pods_ready_window():
+    """A re-admission must get a fresh initial PodsReady window — the old
+    PodsReadyLost state belongs to the released admission."""
+    env = Env(config=podsready_config(timeout=300.0, recovery=60.0))
+    env.submit()
+    env.cycle()
+    wl = env.wl()
+    env.reconciler.set_pods_ready(wl.key, True, env.t + 10)
+    env.reconciler.set_pods_ready(wl.key, False, env.t + 100)
+    # recovery timeout expires -> eviction
+    env.reconciler.reconcile(wl.key, env.t + 161)
+    assert wl.is_evicted
+    assert wl.condition(WorkloadConditionType.PODS_READY) is None
+    # re-admit: fresh 300s initial window, not the stale recovery anchor
+    env.t += 200
+    for _ in range(4):
+        env.cycle()
+    wl = env.wl()
+    assert wl.is_quota_reserved
+    adm = wl.condition(WorkloadConditionType.QUOTA_RESERVED)
+    due = env.reconciler.reconcile(wl.key, adm.last_transition_time + 1)
+    assert due == pytest.approx(adm.last_transition_time + 300.0)
+    assert not wl.is_evicted
+
+
+def test_deactivated_pending_workload_gc_stable_anchor():
+    """A never-evicted deactivated workload must GC at a fixed deadline,
+    not one that recedes every reconcile."""
+    from kueue_oss_tpu.config import ObjectRetentionPolicies
+
+    cfg = Configuration(object_retention_policies=ObjectRetentionPolicies(
+        deactivated_workload_retention_seconds=60.0))
+    env = Env(config=cfg)
+    env.submit(active=False)
+    due1 = env.reconciler.reconcile("default/wl", 100.0)
+    assert due1 == pytest.approx(160.0)
+    due2 = env.reconciler.reconcile("default/wl", 130.0)
+    assert due2 == pytest.approx(160.0)
+    env.reconciler.reconcile("default/wl", 161.0)
+    assert env.wl() is None
+
+
+def test_provisioning_not_reused_across_readmission():
+    """Evict + re-admit must re-provision, not reuse the old answer."""
+    calls = []
+    env = Env(checks=("prov",))
+    ctl = ProvisioningController(env.store,
+                                 provider=lambda r: calls.append(r) or True)
+    env.submit()
+    env.cycle()
+    ctl.reconcile(env.t)
+    assert len(calls) == 1
+    env.reconciler.reconcile("default/wl", env.t)
+    assert env.wl().is_admitted
+    env.scheduler.evict_workload("default/wl", reason="Preempted",
+                                 message="", now=env.t + 1,
+                                 preemption_reason="InCohort")
+    env.t += 5
+    env.cycle()  # re-admission at a new QuotaReserved epoch
+    assert env.wl().is_quota_reserved
+    ctl.reconcile(env.t)
+    assert len(calls) == 2, "stale Provisioned answer must not be reused"
