@@ -242,11 +242,21 @@ class WorkloadReconciler:
                 message=f"Admission check(s) {names} requested a retry",
                 now=now, underlying_cause="Retry")
             return True
-        if wanted and all(s.state == CheckState.READY for s in states):
-            if not wl.is_admitted:
+        # `states` may be empty (checks removed from the CQ after quota
+        # reservation) — admitting on the vacuous all() mirrors the
+        # reference, where zero pending checks means Admitted.
+        if all(s.state == CheckState.READY for s in states):
+            if not wl.is_admitted and wl.is_quota_reserved:
                 wl.set_condition(WorkloadConditionType.ADMITTED, True,
                                  reason="Admitted", now=now)
                 self.store.update_workload(wl)
+                from kueue_oss_tpu import metrics
+
+                metrics.admitted_workload(cq_name, now - wl.creation_time)
+                qr = wl.condition(WorkloadConditionType.QUOTA_RESERVED)
+                if qr is not None:
+                    metrics.admission_checks_wait_time_seconds.observe(
+                        cq_name, value=max(now - qr.last_transition_time, 0.0))
         return False
 
     # -- max execution time -------------------------------------------------
@@ -292,8 +302,11 @@ class WorkloadReconciler:
         pr = wl.condition(WorkloadConditionType.PODS_READY)
         if pr is not None and pr.status:
             return None  # pods are ready
-        adm = wl.condition(WorkloadConditionType.QUOTA_RESERVED)
-        if adm is None:
+        # The countdown starts at Admitted, not QuotaReserved: slow
+        # admission checks must not eat into the PodsReady window
+        # (reference: workload_controller.go admittedNotReadyWorkload).
+        adm = wl.condition(WorkloadConditionType.ADMITTED)
+        if adm is None or not wl.is_admitted:
             return None
         if pr is not None and not pr.status and pr.reason == "PodsReadyLost":
             # Was ready once, lost readiness: recovery timeout applies

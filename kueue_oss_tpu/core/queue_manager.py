@@ -159,6 +159,12 @@ class QueueManager:
         if kind == "ClusterQueue":
             self.add_cluster_queue(obj.name)
             self.queues[obj.name].queue_inadmissible(self.cycle)
+        elif kind == "LocalQueue":
+            # Resume/stop of an LQ re-evaluates its pending workloads.
+            for wl in self.store.workloads.values():
+                if (wl.namespace == obj.namespace
+                        and wl.queue_name == obj.name):
+                    self.add_or_update_workload(wl)
         elif kind == "Workload":
             if verb in ("add", "update"):
                 self.add_or_update_workload(obj)
@@ -176,12 +182,20 @@ class QueueManager:
             cq = wl.status.admission.cluster_queue
         return cq if cq in self.queues else None
 
+    def _local_queue_stopped(self, wl: Workload) -> bool:
+        """A Hold/HoldAndDrain LocalQueue keeps its workloads out of the
+        pending heaps entirely (reference: manager.go LocalQueue active
+        check; the drain side is handled by the Workload controller)."""
+        lq = self.store.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        return lq is not None and lq.stop_policy != StopPolicy.NONE
+
     def add_or_update_workload(self, wl: Workload) -> bool:
         """Queue a workload if it is pending (active, no quota reserved)."""
         cq = self._cq_for(wl)
         if cq is None:
             return False
-        if not wl.active or wl.is_quota_reserved or wl.is_finished:
+        if (not wl.active or wl.is_quota_reserved or wl.is_finished
+                or self._local_queue_stopped(wl)):
             self.queues[cq].delete(wl.key)
             return False
         rs = wl.status.requeue_state
@@ -197,7 +211,8 @@ class QueueManager:
     def requeue_workload(self, info: WorkloadInfo, reason: str) -> bool:
         """Re-fetch latest object state and requeue (manager.go:645)."""
         wl = self.store.workloads.get(info.key)
-        if wl is None or not wl.active or wl.is_quota_reserved or wl.is_finished:
+        if (wl is None or not wl.active or wl.is_quota_reserved
+                or wl.is_finished or self._local_queue_stopped(wl)):
             return False
         fresh = WorkloadInfo(wl, cluster_queue=info.cluster_queue)
         fresh.last_assignment = info.last_assignment

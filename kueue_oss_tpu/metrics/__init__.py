@@ -1,0 +1,334 @@
+"""Prometheus-style metrics registry.
+
+Reference parity: pkg/metrics/metrics.go:316-857 — the same series names and
+label sets, backed by a small in-process registry instead of the Prometheus
+client. `render()` emits text exposition format for scraping/inspection, and
+the perf runner scrapes counters the same way the reference's runner scrapes
+minimalkueue's metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional
+
+# Default histogram buckets mirroring prometheus.DefBuckets plus the
+# exponential range the reference uses for wait-time series.
+DEF_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+WAIT_BUCKETS = tuple(1 * 2 ** i for i in range(15))  # 1s .. ~4.5h
+
+LabelValues = tuple[str, ...]
+
+
+class _Series:
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...]) -> None:
+        self.name = name
+        self.help = help_
+        self.labels = labels
+        self._lock = threading.Lock()
+
+    def _key(self, label_values: Iterable[str]) -> LabelValues:
+        key = tuple(str(v) for v in label_values)
+        if len(key) != len(self.labels):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labels}, got {key}")
+        return key
+
+
+class Counter(_Series):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str,
+                 labels: tuple[str, ...] = ()) -> None:
+        super().__init__(name, help_, labels)
+        self._values: dict[LabelValues, float] = {}
+
+    def inc(self, *label_values: str, by: float = 1.0) -> None:
+        key = self._key(label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, *label_values: str) -> float:
+        return self._values.get(self._key(label_values), 0.0)
+
+    def total(self) -> float:
+        return sum(self._values.values())
+
+    def delete_matching(self, **by_label: str) -> None:
+        idx = {self.labels.index(k): v for k, v in by_label.items()}
+        with self._lock:
+            for key in [k for k in self._values
+                        if all(k[i] == v for i, v in idx.items())]:
+                del self._values[key]
+
+    def collect(self) -> dict[LabelValues, float]:
+        return dict(self._values)
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, *label_values: str, value: float) -> None:
+        key = self._key(label_values)
+        with self._lock:
+            self._values[key] = float(value)
+
+
+class Histogram(_Series):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = (),
+                 buckets: tuple[float, ...] = DEF_BUCKETS) -> None:
+        super().__init__(name, help_, labels)
+        self.buckets = tuple(sorted(buckets))
+        #: key -> (bucket counts, sum, count)
+        self._values: dict[LabelValues, tuple[list[int], float, int]] = {}
+
+    def observe(self, *label_values: str, value: float) -> None:
+        key = self._key(label_values)
+        with self._lock:
+            counts, total, n = self._values.get(
+                key, ([0] * len(self.buckets), 0.0, 0))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._values[key] = (counts, total + value, n + 1)
+
+    def count(self, *label_values: str) -> int:
+        v = self._values.get(self._key(label_values))
+        return v[2] if v else 0
+
+    def sum(self, *label_values: str) -> float:
+        v = self._values.get(self._key(label_values))
+        return v[1] if v else 0.0
+
+    def total_count(self) -> int:
+        return sum(v[2] for v in self._values.values())
+
+    def delete_matching(self, **by_label: str) -> None:
+        idx = {self.labels.index(k): v for k, v in by_label.items()}
+        with self._lock:
+            for key in [k for k in self._values
+                        if all(k[i] == v for i, v in idx.items())]:
+                del self._values[key]
+
+    def collect(self):
+        return dict(self._values)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._series: dict[str, _Series] = {}
+
+    def register(self, s: _Series) -> _Series:
+        self._series[s.name] = s
+        return s
+
+    def get(self, name: str) -> Optional[_Series]:
+        return self._series.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out: list[str] = []
+        for s in self._series.values():
+            out.append(f"# HELP {s.name} {s.help}")
+            out.append(f"# TYPE {s.name} {s.kind}")
+            if isinstance(s, Histogram):
+                for key, (counts, total, n) in sorted(s.collect().items()):
+                    base = _fmt_labels(s.labels, key)
+                    for b, c in zip(s.buckets, counts):
+                        le = _merge_labels(base, f'le="{b}"')
+                        out.append(f"{s.name}_bucket{le} {c}")
+                    inf = _merge_labels(base, 'le="+Inf"')
+                    out.append(f"{s.name}_bucket{inf} {n}")
+                    out.append(f"{s.name}_sum{base} {total}")
+                    out.append(f"{s.name}_count{base} {n}")
+            else:
+                for key, v in sorted(s.collect().items()):  # type: ignore[attr-defined]
+                    out.append(f"{s.name}{_fmt_labels(s.labels, key)} {v}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt_labels(names: tuple[str, ...], values: LabelValues) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(f'{k}="{v}"' for k, v in zip(names, values))
+    return "{" + pairs + "}"
+
+
+def _merge_labels(base: str, extra: str) -> str:
+    if not base:
+        return "{" + extra + "}"
+    return base[:-1] + "," + extra + "}"
+
+
+registry = Registry()
+
+# -- scheduler cycle (metrics.go:316-347) -----------------------------------
+
+admission_attempts_total = registry.register(Counter(
+    "kueue_admission_attempts_total",
+    "Total number of admission cycle attempts by result", ("result",)))
+admission_attempt_duration_seconds = registry.register(Histogram(
+    "kueue_admission_attempt_duration_seconds",
+    "Latency of an admission cycle attempt", ("result",)))
+admission_cycle_preemption_skips = registry.register(Gauge(
+    "kueue_admission_cycle_preemption_skips",
+    "Workloads skipped by preemption in the last cycle", ("cluster_queue",)))
+
+# -- pending / status gauges (metrics.go:360-382, 677-732) -------------------
+
+pending_workloads = registry.register(Gauge(
+    "kueue_pending_workloads", "Pending workloads per CQ and status",
+    ("cluster_queue", "status")))
+local_queue_pending_workloads = registry.register(Gauge(
+    "kueue_local_queue_pending_workloads",
+    "Pending workloads per LocalQueue and status",
+    ("local_queue", "namespace", "status")))
+reserving_active_workloads = registry.register(Gauge(
+    "kueue_reserving_active_workloads",
+    "Workloads with reserved quota per CQ", ("cluster_queue",)))
+admitted_active_workloads = registry.register(Gauge(
+    "kueue_admitted_active_workloads",
+    "Admitted not-finished workloads per CQ", ("cluster_queue",)))
+cluster_queue_status = registry.register(Gauge(
+    "kueue_cluster_queue_status", "CQ status by condition",
+    ("cluster_queue", "status")))
+
+# -- workload flow counters (metrics.go:402-673) -----------------------------
+
+quota_reserved_workloads_total = registry.register(Counter(
+    "kueue_quota_reserved_workloads_total",
+    "Total workloads that got quota reserved", ("cluster_queue",)))
+admitted_workloads_total = registry.register(Counter(
+    "kueue_admitted_workloads_total",
+    "Total admitted workloads", ("cluster_queue",)))
+finished_workloads_total = registry.register(Counter(
+    "kueue_finished_workloads_total",
+    "Total finished workloads", ("cluster_queue",)))
+evicted_workloads_total = registry.register(Counter(
+    "kueue_evicted_workloads_total",
+    "Total evicted workloads by reason", ("cluster_queue", "reason")))
+preempted_workloads_total = registry.register(Counter(
+    "kueue_preempted_workloads_total",
+    "Total preempted workloads by reason", ("preempting_cluster_queue", "reason")))
+replaced_workload_slices_total = registry.register(Counter(
+    "kueue_replaced_workload_slices_total",
+    "Total workload slices replaced by a scaled-up slice", ("cluster_queue",)))
+
+quota_reserved_wait_time_seconds = registry.register(Histogram(
+    "kueue_quota_reserved_wait_time_seconds",
+    "Time from creation to quota reservation", ("cluster_queue",),
+    buckets=WAIT_BUCKETS))
+admission_wait_time_seconds = registry.register(Histogram(
+    "kueue_admission_wait_time_seconds",
+    "Time from creation to admission", ("cluster_queue",),
+    buckets=WAIT_BUCKETS))
+admission_checks_wait_time_seconds = registry.register(Histogram(
+    "kueue_admission_checks_wait_time_seconds",
+    "Time from quota reservation to admission", ("cluster_queue",),
+    buckets=WAIT_BUCKETS))
+
+# -- quota gauges (metrics.go:733-804) ---------------------------------------
+
+cluster_queue_resource_usage = registry.register(Gauge(
+    "kueue_cluster_queue_resource_usage", "Current usage per CQ/flavor/resource",
+    ("cluster_queue", "flavor", "resource")))
+cluster_queue_resource_reservation = registry.register(Gauge(
+    "kueue_cluster_queue_resource_reservation",
+    "Currently reserved quantity per CQ/flavor/resource",
+    ("cluster_queue", "flavor", "resource")))
+cluster_queue_nominal_quota = registry.register(Gauge(
+    "kueue_cluster_queue_nominal_quota", "Nominal quota per CQ/flavor/resource",
+    ("cluster_queue", "flavor", "resource")))
+cluster_queue_borrowing_limit = registry.register(Gauge(
+    "kueue_cluster_queue_borrowing_limit",
+    "Borrowing limit per CQ/flavor/resource",
+    ("cluster_queue", "flavor", "resource")))
+cluster_queue_lending_limit = registry.register(Gauge(
+    "kueue_cluster_queue_lending_limit",
+    "Lending limit per CQ/flavor/resource",
+    ("cluster_queue", "flavor", "resource")))
+
+# -- fair sharing (metrics.go:805-830) ---------------------------------------
+
+cluster_queue_weighted_share = registry.register(Gauge(
+    "kueue_cluster_queue_weighted_share",
+    "DominantResourceShare of the CQ (x1000, weighted)", ("cluster_queue",)))
+cohort_weighted_share = registry.register(Gauge(
+    "kueue_cohort_weighted_share",
+    "DominantResourceShare of the cohort (x1000, weighted)", ("cohort",)))
+
+# -- solver-specific (new; no reference analog) ------------------------------
+
+solver_cycle_duration_seconds = registry.register(Histogram(
+    "kueue_tpu_solver_cycle_duration_seconds",
+    "Wall time of one batched TPU solve", ("phase",)))
+solver_plan_fallbacks_total = registry.register(Counter(
+    "kueue_tpu_solver_plan_fallbacks_total",
+    "Solver plans rejected by the host oracle re-check", ()))
+
+
+# -- recording helpers (reference: pkg/metrics exported funcs) ---------------
+
+class CycleResult:
+    SUCCESS = "success"
+    INADMISSIBLE = "inadmissible"
+
+
+def observe_admission_attempt(result: str, duration_s: float) -> None:
+    admission_attempts_total.inc(result)
+    admission_attempt_duration_seconds.observe(result, value=duration_s)
+
+
+def report_pending_workloads(cq: str, active: int, inadmissible: int) -> None:
+    pending_workloads.set(cq, "active", value=active)
+    pending_workloads.set(cq, "inadmissible", value=inadmissible)
+
+
+def admitted_workload(cq: str, wait_s: float) -> None:
+    admitted_workloads_total.inc(cq)
+    admission_wait_time_seconds.observe(cq, value=max(wait_s, 0.0))
+
+
+def quota_reserved_workload(cq: str, wait_s: float) -> None:
+    quota_reserved_workloads_total.inc(cq)
+    quota_reserved_wait_time_seconds.observe(cq, value=max(wait_s, 0.0))
+
+
+def report_cluster_queue_quotas(cq: str, quotas) -> None:
+    """quotas: iterable of ((flavor, resource), ResourceQuota)."""
+    for (flavor, resource), rq in quotas:
+        cluster_queue_nominal_quota.set(cq, flavor, resource, value=rq.nominal)
+        if rq.borrowing_limit is not None:
+            cluster_queue_borrowing_limit.set(
+                cq, flavor, resource, value=rq.borrowing_limit)
+        if rq.lending_limit is not None:
+            cluster_queue_lending_limit.set(
+                cq, flavor, resource, value=rq.lending_limit)
+
+
+def report_cluster_queue_usage(cq: str, usage: dict) -> None:
+    for (flavor, resource), q in usage.items():
+        cluster_queue_resource_usage.set(cq, flavor, resource, value=q)
+        cluster_queue_resource_reservation.set(cq, flavor, resource, value=q)
+
+
+def clear_cluster_queue_metrics(cq: str) -> None:
+    """Reference parity: metrics.ClearClusterQueueResourceMetrics on CQ delete."""
+    for series in (cluster_queue_resource_usage,
+                   cluster_queue_resource_reservation,
+                   cluster_queue_nominal_quota,
+                   cluster_queue_borrowing_limit,
+                   cluster_queue_lending_limit):
+        series.delete_matching(cluster_queue=cq)
+    for series in (pending_workloads, admission_cycle_preemption_skips,
+                   reserving_active_workloads, admitted_active_workloads,
+                   cluster_queue_status, cluster_queue_weighted_share):
+        series.delete_matching(cluster_queue=cq)
+
+
+def reset_all() -> None:
+    """Test helper: drop every recorded sample (registry keeps its series)."""
+    for s in registry._series.values():
+        s._values = {}  # type: ignore[attr-defined]

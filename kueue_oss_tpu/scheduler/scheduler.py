@@ -27,6 +27,7 @@ from kueue_oss_tpu.core.snapshot import (
     build_snapshot,
 )
 from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu import metrics
 from kueue_oss_tpu.core.workload_info import (
     WorkloadInfo,
     effective_priority,
@@ -105,6 +106,9 @@ class Scheduler:
         self.eviction_backoff_max_s = eviction_backoff_max_s
         #: min-heap of (requeue_at, workload key) pending backoff expiries
         self._requeue_heap: list[tuple[float, str]] = []
+        #: CQs whose usage changed outside entry processing (evictions)
+        self._cycle_touched_cqs: set[str] = set()
+        self._last_pending_counts: dict[str, tuple[int, int]] = {}
         # metrics
         self.admitted_total: dict[str, int] = {}
         self.preempted_total: dict[str, int] = {}
@@ -145,7 +149,35 @@ class Scheduler:
 
         stats.duration_s = self.clock() - start
         self.admission_attempt_durations.append(stats.duration_s)
+        result = (metrics.CycleResult.SUCCESS if stats.admitted or stats.preempted
+                  else metrics.CycleResult.INADMISSIBLE)
+        metrics.observe_admission_attempt(result, stats.duration_s)
+        for cq_name, counts in self.queues.pending_counts().items():
+            if self._last_pending_counts.get(cq_name) != counts:
+                self._last_pending_counts[cq_name] = counts
+                metrics.report_pending_workloads(cq_name, *counts)
+        touched = {e.info.cluster_queue for e in entries}
+        touched.update(self._cycle_touched_cqs)
+        self._cycle_touched_cqs.clear()
+        self._report_snapshot_metrics(snapshot, touched)
         return stats
+
+    def _report_snapshot_metrics(self, snapshot: Snapshot,
+                                 touched: set[str]) -> None:
+        """Per-CQ usage/weighted-share gauges from the post-cycle snapshot,
+        limited to CQs the cycle touched — the hot loop must not sweep all
+        1k CQs (reference: cache usage reporting, metrics.go:733-830)."""
+        for name in touched:
+            cq = snapshot.cluster_queues.get(name)
+            if cq is None:
+                continue
+            metrics.report_cluster_queue_usage(cq.name, cq.node.usage)
+            metrics.reserving_active_workloads.set(
+                cq.name, value=len(cq.workloads))
+            if self.enable_fair_sharing:
+                drs = cq.dominant_resource_share()
+                metrics.cluster_queue_weighted_share.set(
+                    cq.name, value=drs.rounded_weighted_share())
 
     def run_until_quiet(self, max_cycles: int = 10_000,
                         now: Optional[float] = None) -> int:
@@ -472,8 +504,12 @@ class Scheduler:
         else:
             wl.set_condition(WorkloadConditionType.ADMITTED, True,
                              reason="Admitted", now=now)
+            metrics.admitted_workload(e.info.cluster_queue,
+                                      now - wl.creation_time)
         self.store.update_workload(wl)
         e.status = ASSUMED
+        metrics.quota_reserved_workload(e.info.cluster_queue,
+                                        now - wl.creation_time)
         self.admitted_total[e.info.cluster_queue] = (
             self.admitted_total.get(e.info.cluster_queue, 0) + 1)
 
@@ -554,8 +590,12 @@ class Scheduler:
         self.store.update_workload(wl)
         self.evicted_total[wl.key] = self.evicted_total.get(wl.key, 0) + 1
         cq = self.store.cluster_queue_for(wl)
+        if cq:
+            metrics.evicted_workloads_total.inc(cq, reason)
+            self._cycle_touched_cqs.add(cq)
         if cq and preemption_reason:
             self.preempted_total[cq] = self.preempted_total.get(cq, 0) + 1
+            metrics.preempted_workloads_total.inc(cq, preemption_reason)
         # Freed capacity wakes parked workloads in the cohort.
         self.queues.report_workload_evicted(wl)
 
@@ -600,6 +640,9 @@ class Scheduler:
         wl.set_condition(WorkloadConditionType.FINISHED, True,
                          reason="JobFinished", now=now)
         self.store.update_workload(wl)
+        cq = self.store.cluster_queue_for(wl)
+        if cq:
+            metrics.finished_workloads_total.inc(cq)
         self.queues.report_workload_finished(wl)
 
     def _requeue_and_update(self, e: Entry) -> None:

@@ -155,7 +155,14 @@ class SolverEngine:
             wl.status.admission = admission
             wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
                              reason="QuotaReserved", now=now)
-            wl.status.requeue_state = None
+            if wl.is_evicted:
+                wl.set_condition(WorkloadConditionType.EVICTED, False,
+                                 reason="QuotaReserved", now=now)
+            # Keep the requeue count across re-admissions (mirrors
+            # Scheduler._admit): only the backoff gate is cleared so
+            # RequeuingStrategy.backoffLimitCount can still trip.
+            if wl.status.requeue_state is not None:
+                wl.status.requeue_state.requeue_at = None
             cq_spec = self.store.cluster_queues[cq_name]
             if cq_spec.admission_checks:
                 from kueue_oss_tpu.api.types import AdmissionCheckState

@@ -1,0 +1,131 @@
+"""Metrics registry + scheduler wiring tests.
+
+Reference parity: pkg/metrics/metrics_test.go (series semantics) and the
+perf runner's metric scraping of admitted/evicted counters.
+"""
+
+import pytest
+
+from kueue_oss_tpu import metrics
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+    iter_quotas,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    metrics.reset_all()
+    yield
+    metrics.reset_all()
+
+
+def _mk_env(nominal=4000):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=nominal)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    return store, queues, sched
+
+
+def test_counter_gauge_histogram_basics():
+    c = metrics.Counter("t_total", "t", ("a",))
+    c.inc("x")
+    c.inc("x", by=2)
+    assert c.value("x") == 3
+    g = metrics.Gauge("t_g", "t", ("a",))
+    g.set("x", value=7)
+    assert g.value("x") == 7
+    h = metrics.Histogram("t_h", "t", buckets=(1.0, 10.0))
+    h.observe(value=0.5)
+    h.observe(value=5.0)
+    h.observe(value=50.0)
+    assert h.count() == 3
+    assert h.sum() == 55.5
+
+
+def test_label_arity_enforced():
+    c = metrics.Counter("t2_total", "t", ("a", "b"))
+    with pytest.raises(ValueError):
+        c.inc("only-one")
+
+
+def test_scheduler_records_admission_metrics():
+    store, queues, sched = _mk_env()
+    store.add_workload(Workload(
+        name="w1", queue_name="lq",
+        podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+    sched.schedule(now=10.0)
+    assert metrics.admitted_workloads_total.value("cq") == 1
+    assert metrics.quota_reserved_workloads_total.value("cq") == 1
+    assert metrics.admission_attempts_total.value("success") == 1
+    assert metrics.admission_wait_time_seconds.count("cq") == 1
+    # usage gauge reflects the assumed admission
+    assert metrics.cluster_queue_resource_usage.value(
+        "cq", "default", "cpu") == 1000
+
+
+def test_eviction_and_finish_metrics():
+    store, queues, sched = _mk_env()
+    store.add_workload(Workload(
+        name="w1", queue_name="lq",
+        podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+    sched.schedule(now=0.0)
+    sched.evict_workload("default/w1", reason="Preempted", message="m",
+                         now=1.0, preemption_reason="InClusterQueue")
+    assert metrics.evicted_workloads_total.value("cq", "Preempted") == 1
+    assert metrics.preempted_workloads_total.value("cq", "InClusterQueue") == 1
+    sched.schedule(now=2.0)  # re-admits
+    sched.finish_workload("default/w1", now=3.0)
+    assert metrics.finished_workloads_total.value("cq") == 1
+
+
+def test_pending_gauge_reports_inadmissible():
+    store, queues, sched = _mk_env(nominal=500)
+    store.add_workload(Workload(
+        name="big", queue_name="lq",
+        podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+    sched.schedule(now=0.0)
+    assert metrics.admission_attempts_total.value("inadmissible") == 1
+    active = metrics.pending_workloads.value("cq", "active")
+    inadmissible = metrics.pending_workloads.value("cq", "inadmissible")
+    assert active + inadmissible == 1
+
+
+def test_quota_gauges_and_clear():
+    store, _, _ = _mk_env()
+    cq = store.cluster_queues["cq"]
+    metrics.report_cluster_queue_quotas("cq", iter_quotas(cq.resource_groups))
+    assert metrics.cluster_queue_nominal_quota.value(
+        "cq", "default", "cpu") == 4000
+    metrics.clear_cluster_queue_metrics("cq")
+    assert metrics.cluster_queue_nominal_quota.value(
+        "cq", "default", "cpu") == 0
+
+
+def test_render_exposition_format():
+    store, queues, sched = _mk_env()
+    store.add_workload(Workload(
+        name="w1", queue_name="lq",
+        podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+    sched.schedule(now=0.0)
+    text = metrics.registry.render()
+    assert '# TYPE kueue_admitted_workloads_total counter' in text
+    assert 'kueue_admitted_workloads_total{cluster_queue="cq"} 1' in text
+    assert 'kueue_admission_attempt_duration_seconds_count{result="success"} 1' in text

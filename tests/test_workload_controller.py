@@ -488,3 +488,67 @@ def test_provisioning_not_reused_across_readmission():
     assert env.wl().is_quota_reserved
     ctl.reconcile(env.t)
     assert len(calls) == 2, "stale Provisioned answer must not be reused"
+
+
+def test_local_queue_hold_and_drain_stays_held():
+    """Regression: a drained LQ's workload must not churn evict/re-admit —
+    the queue manager keeps stopped-LQ workloads out of the pending heaps."""
+    env = Env()
+    env.submit()
+    env.cycle()
+    lq = env.store.local_queues["default/lq"]
+    lq.stop_policy = StopPolicy.HOLD_AND_DRAIN
+    env.store.upsert_local_queue(lq)
+    env.reconciler.reconcile("default/wl", env.t)
+    assert env.wl().is_evicted
+    for _ in range(6):
+        env.cycle()
+        env.reconciler.reconcile("default/wl", env.t)
+    assert not env.wl().is_quota_reserved
+    stats = [e for e in env.wl().status.eviction_stats
+             if e.reason == EvictionReason.LOCAL_QUEUE_STOPPED]
+    assert stats and stats[0].count == 1, "must evict exactly once, not churn"
+    # resume: workload re-enters the queue and is re-admitted
+    lq.stop_policy = StopPolicy.NONE
+    env.store.upsert_local_queue(lq)
+    env.cycle()
+    assert env.wl().is_quota_reserved
+
+
+def test_checks_emptied_after_reservation_admits():
+    """Regression: removing every check from the CQ after quota reservation
+    must still flip Admitted (vacuous all-ready)."""
+    env = Env(checks=("check-a",))
+    env.submit()
+    env.cycle()
+    assert env.wl().is_quota_reserved and not env.wl().is_admitted
+    cq = env.store.cluster_queues["cq"]
+    cq.admission_checks = []
+    env.reconciler.reconcile("default/wl", env.t)
+    assert env.wl().is_admitted
+
+
+def test_pods_ready_window_anchored_at_admitted():
+    """Regression: slow admission checks must not eat the PodsReady window."""
+    cfg = Configuration(wait_for_pods_ready=WaitForPodsReady(
+        enable=True, timeout_seconds=10.0))
+    env = Env(config=cfg, checks=("slow",))
+    env.submit()
+    env.cycle()  # QuotaReserved at ~t=2, Admitted deferred on the check
+    reserved_at = env.t
+    # the check stays pending past the PodsReady timeout
+    env.t = reserved_at + 30.0
+    assert env.reconciler.reconcile("default/wl", env.t) is None or True
+    assert not env.wl().is_evicted, "not admitted yet: no PodsReady clock"
+    env.wl().status.admission_checks["slow"].state = CheckState.READY
+    env.reconciler.reconcile("default/wl", env.t)
+    assert env.wl().is_admitted
+    admitted_at = env.t
+    # within the window counted from Admitted: no eviction
+    env.t = admitted_at + 9.0
+    env.reconciler.reconcile("default/wl", env.t)
+    assert not env.wl().is_evicted
+    # past it: evicted
+    env.t = admitted_at + 11.0
+    env.reconciler.reconcile("default/wl", env.t)
+    assert env.wl().is_evicted
