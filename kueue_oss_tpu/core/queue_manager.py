@@ -40,7 +40,8 @@ def _order_key(info: WorkloadInfo) -> tuple:
 class ClusterQueuePendingQueue:
     """Heap + inadmissible parking for one ClusterQueue."""
 
-    def __init__(self, name: str, strategy: str) -> None:
+    def __init__(self, name: str, strategy: str,
+                 on_change=None) -> None:
         self.name = name
         self.strategy = strategy
         self._heap: list[tuple[tuple, int, WorkloadInfo]] = []
@@ -50,6 +51,10 @@ class ClusterQueuePendingQueue:
         #: cycle at which inadmissible workloads were last re-queued
         self.queue_inadmissible_cycle = -1
         self.active = True
+        #: called with the CQ name on any pending-count mutation (the
+        #: manager uses it to keep a dirty set so metric reporting is
+        #: O(changed CQs), not O(all CQs))
+        self._on_change = on_change or (lambda name: None)
 
     def __len__(self) -> int:
         return len(self._heap) + len(self.inadmissible)
@@ -69,16 +74,20 @@ class ClusterQueuePendingQueue:
             self.delete(info.key)
         self._in_heap[info.key] = info
         heapq.heappush(self._heap, (_order_key(info), next(self._counter), info))
+        self._on_change(self.name)
 
     def pop_head(self) -> Optional[WorkloadInfo]:
         while self._heap:
             _, _, info = heapq.heappop(self._heap)
             if self._in_heap.get(info.key) is info:
                 del self._in_heap[info.key]
+                self._on_change(self.name)
                 return info
         return None
 
     def delete(self, key: str) -> None:
+        if key in self._in_heap or key in self.inadmissible:
+            self._on_change(self.name)
         self._in_heap.pop(key, None)
         self.inadmissible.pop(key, None)
 
@@ -92,6 +101,7 @@ class ClusterQueuePendingQueue:
         if info is not None:
             self.delete(key)
             self.inadmissible[key] = info
+            self._on_change(self.name)
 
     def requeue_if_not_present(self, info: WorkloadInfo, reason: str,
                                pop_cycle: int = -1) -> bool:
@@ -113,6 +123,7 @@ class ClusterQueuePendingQueue:
             self.push(info)
             return True
         self.inadmissible[info.key] = info
+        self._on_change(self.name)
         return False
 
     def queue_inadmissible(self, cycle: int) -> bool:
@@ -125,6 +136,7 @@ class ClusterQueuePendingQueue:
         for info in parked:
             self.push(info)
         self.queue_inadmissible_cycle = cycle
+        self._on_change(self.name)
         return True
 
 
@@ -135,6 +147,8 @@ class QueueManager:
         self.store = store
         self.queues: dict[str, ClusterQueuePendingQueue] = {}
         self.cycle = 0
+        #: CQs whose pending counts changed since the last drain
+        self.dirty_cqs: set[str] = set()
         for cq in store.cluster_queues.values():
             self.add_cluster_queue(cq.name)
         # Initial LIST: enqueue pending workloads already in the store
@@ -149,7 +163,8 @@ class QueueManager:
         spec = self.store.cluster_queues[name]
         if name not in self.queues:
             self.queues[name] = ClusterQueuePendingQueue(
-                name, spec.queueing_strategy)
+                name, spec.queueing_strategy,
+                on_change=self.dirty_cqs.add)
         q = self.queues[name]
         q.strategy = spec.queueing_strategy
         q.active = spec.stop_policy == StopPolicy.NONE
@@ -248,6 +263,18 @@ class QueueManager:
 
     def has_pending(self) -> bool:
         return any(len(q._in_heap) > 0 for q in self.queues.values() if q.active)
+
+    def drain_dirty_pending_counts(self) -> dict[str, tuple[int, int]]:
+        """Pending counts for CQs that changed since the last drain —
+        O(changed CQs) so the scheduler's metric refresh stays off the
+        all-CQs path."""
+        dirty, self.dirty_cqs = self.dirty_cqs, set()
+        out = {}
+        for name in dirty:
+            q = self.queues.get(name)
+            if q is not None:
+                out[name] = (q.pending_active, q.pending_inadmissible)
+        return out
 
     def pending_counts(self) -> dict[str, tuple[int, int]]:
         return {

@@ -1,0 +1,39 @@
+"""Job integration framework.
+
+Reference parity: pkg/controller/jobframework — the GenericJob contract
+(interface.go:40-64), the generic reconciler (reconciler.go:281
+ReconcileGenericJob), the integration registry (integrationmanager.go) and
+the suspend-on-create base webhook (base_webhook.go).
+"""
+
+from kueue_oss_tpu.jobframework.interface import (
+    BaseJob,
+    GenericJob,
+    PodSetInfo,
+    StopReason,
+)
+from kueue_oss_tpu.jobframework.registry import (
+    IntegrationManager,
+    integration_manager,
+)
+from kueue_oss_tpu.jobframework.reconciler import JobReconciler
+from kueue_oss_tpu.jobframework.webhook import (
+    JobWebhookError,
+    default_job,
+    validate_job_create,
+    validate_job_update,
+)
+
+__all__ = [
+    "BaseJob",
+    "GenericJob",
+    "PodSetInfo",
+    "StopReason",
+    "IntegrationManager",
+    "integration_manager",
+    "JobReconciler",
+    "JobWebhookError",
+    "default_job",
+    "validate_job_create",
+    "validate_job_update",
+]

@@ -1,0 +1,162 @@
+"""The GenericJob contract.
+
+Reference parity: pkg/controller/jobframework/interface.go:40-64 — Object,
+IsSuspended, Suspend, RunWithPodSetsInfo, RestorePodSetsInfo, Finished,
+PodSets, IsActive, PodsReady, GVK — plus the podset.PodSetInfo carrier
+(pkg/podset) used to inject flavor node-selectors and scheduling gates.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu.api.types import PodSet, Toleration
+
+
+class StopReason:
+    """Reference parity: interface.go StopReason values."""
+
+    WORKLOAD_DELETED = "WorkloadDeleted"
+    WORKLOAD_EVICTED = "WorkloadEvicted"
+    NO_MATCHING_WORKLOAD = "NoMatchingWorkload"
+    NOT_ADMITTED = "NotAdmitted"
+
+
+@dataclass
+class PodSetInfo:
+    """What admission injects into a job's podset before it runs.
+
+    Reference parity: pkg/podset/podset.go PodSetInfo {NodeSelector,
+    Tolerations, Labels, Annotations, SchedulingGates, Count}.
+    """
+
+    name: str = "main"
+    count: int = 0
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: list[Toleration] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    #: scheduling gates to place on pods (TAS topology ungating)
+    scheduling_gates: list[str] = field(default_factory=list)
+
+
+class GenericJob(abc.ABC):
+    """Every integration implements this (interface.go:40-64)."""
+
+    kind: str = ""
+
+    @property
+    @abc.abstractmethod
+    def key(self) -> str:
+        """'namespace/name' identity."""
+
+    @abc.abstractmethod
+    def is_suspended(self) -> bool: ...
+
+    @abc.abstractmethod
+    def do_suspend(self) -> None: ...
+
+    @abc.abstractmethod
+    def run_with_podsets_info(self, infos: list[PodSetInfo]) -> None:
+        """Inject node selectors / counts and unsuspend."""
+
+    @abc.abstractmethod
+    def restore_podsets_info(self, infos: list[PodSetInfo]) -> bool:
+        """Restore original podset templates; True if anything changed."""
+
+    @abc.abstractmethod
+    def finished(self) -> tuple[str, bool, bool]:
+        """(message, success, finished)."""
+
+    @abc.abstractmethod
+    def pod_sets(self) -> list[PodSet]:
+        """Workload podsets corresponding to the job."""
+
+    @abc.abstractmethod
+    def is_active(self) -> bool:
+        """True if any pods are running."""
+
+    @abc.abstractmethod
+    def pods_ready(self) -> bool: ...
+
+
+@dataclass
+class BaseJob(GenericJob):
+    """Common state shared by the concrete integrations.
+
+    Concrete jobs supply `kind` and `pod_sets()`; suspension, podset-info
+    injection/restore and finish bookkeeping live here so each integration
+    is just its podset shape (mirrors how the reference integrations lean
+    on jobframework helpers).
+    """
+
+    name: str = ""
+    namespace: str = "default"
+    #: kueue.x-k8s.io/queue-name label on the reference
+    queue_name: str = ""
+    suspend: bool = True
+    priority_class: Optional[str] = None
+    priority: int = 0
+    max_execution_time: Optional[float] = None
+    creation_time: float = 0.0
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+    # runtime status (maintained by the simulator / tests)
+    active_pods: int = 0
+    ready_pods: int = 0
+    is_finished: bool = False
+    finish_success: bool = True
+    finish_message: str = ""
+
+    #: podset infos injected at admission (None = not running under kueue)
+    injected: Optional[list[PodSetInfo]] = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def is_suspended(self) -> bool:
+        return self.suspend
+
+    def do_suspend(self) -> None:
+        self.suspend = True
+        self.active_pods = 0
+        self.ready_pods = 0
+
+    def run_with_podsets_info(self, infos: list[PodSetInfo]) -> None:
+        self.injected = infos
+        self.suspend = False
+
+    def restore_podsets_info(self, infos: list[PodSetInfo]) -> bool:
+        changed = self.injected is not None
+        self.injected = None
+        return changed
+
+    def finished(self) -> tuple[str, bool, bool]:
+        return self.finish_message, self.finish_success, self.is_finished
+
+    def pod_sets(self) -> list[PodSet]:  # pragma: no cover - abstract-ish
+        raise NotImplementedError
+
+    def is_active(self) -> bool:
+        return self.active_pods > 0
+
+    def pods_ready(self) -> bool:
+        total = sum(ps.count for ps in self.pod_sets())
+        return self.ready_pods >= total
+
+    # -- test/simulator helpers -------------------------------------------
+
+    def mark_running(self, ready: bool = True) -> None:
+        total = sum(ps.count for ps in self.pod_sets())
+        self.active_pods = total
+        self.ready_pods = total if ready else 0
+
+    def mark_finished(self, success: bool = True, message: str = "") -> None:
+        self.is_finished = True
+        self.finish_success = success
+        self.finish_message = message or ("JobFinished" if success else "JobFailed")
+        self.active_pods = 0

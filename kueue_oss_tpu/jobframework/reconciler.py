@@ -1,0 +1,186 @@
+"""The generic job reconciler.
+
+Reference parity: pkg/controller/jobframework/reconciler.go
+ReconcileGenericJob (:281) — ensure a Workload mirrors the job's podsets,
+unsuspend the job with injected node selectors once the Workload is
+admitted, stop the job when the Workload is evicted/deleted, and mark the
+Workload Finished when the job completes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_oss_tpu.api.types import (
+    PodSet,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.jobframework.interface import (
+    GenericJob,
+    PodSetInfo,
+    StopReason,
+)
+from kueue_oss_tpu.jobframework.registry import (
+    IntegrationManager,
+    integration_manager,
+)
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+def workload_name_for(job: GenericJob) -> str:
+    """Reference parity: jobframework/workload_names.go
+    GetWorkloadNameForOwnerWithGVK (kind-prefixed, no hash needed here
+    because the in-memory store has no name-length limit)."""
+    return f"{job.kind.lower()}-{job.name}"
+
+
+class JobReconciler:
+    """Bridges GenericJobs to Workloads over the in-memory store."""
+
+    def __init__(self, store: Store, scheduler: Scheduler,
+                 manager: IntegrationManager = integration_manager,
+                 manage_jobs_without_queue_name: bool = False,
+                 workload_reconciler=None) -> None:
+        self.store = store
+        self.scheduler = scheduler
+        self.manager = manager
+        self.manage_jobs_without_queue_name = manage_jobs_without_queue_name
+        #: optional WorkloadReconciler for PodsReady propagation
+        self.workload_reconciler = workload_reconciler
+        #: jobs under management, keyed "namespace/name" per kind
+        self.jobs: dict[tuple[str, str], GenericJob] = {}
+
+    # -- job lifecycle ------------------------------------------------------
+
+    def upsert_job(self, job: GenericJob) -> None:
+        if not self.manager.is_enabled(job.kind):
+            raise ValueError(f"integration {job.kind} is not enabled")
+        self.jobs[(job.kind, job.key)] = job
+
+    def delete_job(self, job: GenericJob, now: float = 0.0) -> None:
+        self.jobs.pop((job.kind, job.key), None)
+        key = f"{job.namespace}/{workload_name_for(job)}"
+        wl = self.store.workloads.get(key)
+        if wl is not None:
+            self.scheduler.evict_workload(
+                key, reason="WorkloadDeleted", message="owner job deleted",
+                now=now, requeue=False)
+            self.store.delete_workload(key)
+
+    def reconcile_all(self, now: float) -> None:
+        for job in list(self.jobs.values()):
+            self.reconcile(job, now)
+
+    # -- core ---------------------------------------------------------------
+
+    def workload_for(self, job: GenericJob) -> Optional[Workload]:
+        return self.store.workloads.get(
+            f"{job.namespace}/{workload_name_for(job)}")
+
+    def reconcile(self, job: GenericJob, now: float) -> None:
+        """One pass of ReconcileGenericJob (reconciler.go:281)."""
+        if not job.queue_name and not self.manage_jobs_without_queue_name:
+            return
+
+        wl = self.workload_for(job)
+
+        # 1. Job finished → propagate Finished to the workload and stop.
+        msg, success, finished = job.finished()
+        if finished:
+            if wl is not None and not wl.is_finished:
+                self.scheduler.finish_workload(wl.key, now=now)
+            return
+
+        # 2. Ensure the Workload exists and mirrors the job's podsets
+        #    (equivalence check, reconciler.go ensureOneWorkload).
+        podsets = job.pod_sets()
+        if wl is None:
+            wl = self._create_workload(job, podsets, now)
+        elif not _equivalent(wl, podsets):
+            if wl.is_quota_reserved:
+                # Shape changed under an admitted workload: release quota
+                # and rebuild (the reference stops the job and recreates).
+                self._stop_job(job, wl, StopReason.NO_MATCHING_WORKLOAD, now)
+                self.scheduler.evict_workload(
+                    wl.key, reason="NoMatchingWorkload",
+                    message="job podsets changed", now=now, requeue=False)
+            self.store.delete_workload(wl.key)
+            wl = self._create_workload(job, podsets, now)
+
+        # 3. Not admitted → the job must be suspended.
+        if not wl.is_admitted:
+            if not job.is_suspended():
+                self._stop_job(job, wl, StopReason.NOT_ADMITTED, now)
+            return
+
+        # 4. Admitted → run with injected podset infos.
+        if job.is_suspended():
+            job.run_with_podsets_info(self._podset_infos(wl))
+
+        # 5. Propagate pod readiness to the Workload condition.
+        if self.workload_reconciler is not None:
+            self.workload_reconciler.set_pods_ready(
+                wl.key, job.pods_ready(), now)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _create_workload(self, job: GenericJob, podsets: list[PodSet],
+                         now: float) -> Workload:
+        wl = Workload(
+            name=workload_name_for(job),
+            namespace=job.namespace,
+            queue_name=job.queue_name,
+            priority=getattr(job, "priority", 0),
+            priority_class=getattr(job, "priority_class", None),
+            max_execution_time=getattr(job, "max_execution_time", None),
+            podsets=[PodSet(
+                name=ps.name, count=ps.count, requests=dict(ps.requests),
+                min_count=ps.min_count,
+                topology_request=ps.topology_request,
+                node_selector=dict(ps.node_selector),
+                tolerations=list(ps.tolerations),
+            ) for ps in podsets],
+            creation_time=getattr(job, "creation_time", now) or now,
+        )
+        self.store.add_workload(wl)
+        return wl
+
+    def _stop_job(self, job: GenericJob, wl: Workload, reason: str,
+                  now: float) -> None:
+        job.restore_podsets_info(self._podset_infos(wl))
+        if not job.is_suspended():
+            job.do_suspend()
+
+    def _podset_infos(self, wl: Workload) -> list[PodSetInfo]:
+        """Build the injected infos from the admission: flavor node labels
+        + tolerations, TAS selector (reconciler.go getPodSetsInfoFromStatus)."""
+        if wl.status.admission is None:
+            return [PodSetInfo(name=ps.name, count=ps.count)
+                    for ps in wl.podsets]
+        infos: list[PodSetInfo] = []
+        for psa in wl.status.admission.podset_assignments:
+            info = PodSetInfo(name=psa.name, count=psa.count)
+            for flavor_name in set(psa.flavors.values()):
+                rf = self.store.resource_flavors.get(flavor_name)
+                if rf is None:
+                    continue
+                info.node_selector.update(rf.node_labels)
+                info.tolerations.extend(rf.tolerations)
+            if psa.topology_assignment is not None:
+                info.scheduling_gates.append(
+                    "kueue.x-k8s.io/topology")  # ungated per-domain by TAS
+            infos.append(info)
+        return infos
+
+
+def _equivalent(wl: Workload, podsets: list[PodSet]) -> bool:
+    """Shape equality of workload vs job podsets (name/count/requests)."""
+    if len(wl.podsets) != len(podsets):
+        return False
+    for a, b in zip(wl.podsets, podsets):
+        if (a.name, a.count, sorted(a.requests.items())) != (
+                b.name, b.count, sorted(b.requests.items())):
+            return False
+    return True

@@ -1,0 +1,50 @@
+"""Integration registry.
+
+Reference parity: pkg/controller/jobframework/integrationmanager.go — each
+integration registers its kind at import time; the manager setup enables
+the subset named in Configuration.integrations (cmd/kueue/main.go:433-436).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Type
+
+from kueue_oss_tpu.jobframework.interface import GenericJob
+
+
+class IntegrationManager:
+    def __init__(self) -> None:
+        self._by_kind: dict[str, Type[GenericJob]] = {}
+        self._enabled: Optional[set[str]] = None  # None = all registered
+
+    def register(self, cls: Type[GenericJob]) -> Type[GenericJob]:
+        """Usable as a class decorator on integrations."""
+        if not cls.kind:
+            raise ValueError(f"{cls.__name__} must set a kind")
+        self._by_kind[cls.kind] = cls
+        return cls
+
+    def get(self, kind: str) -> Optional[Type[GenericJob]]:
+        return self._by_kind.get(kind)
+
+    def kinds(self) -> list[str]:
+        return sorted(self._by_kind)
+
+    def enable(self, kinds: Optional[list[str]]) -> None:
+        """Restrict reconciliation to the listed kinds (None = all)."""
+        if kinds is None:
+            self._enabled = None
+            return
+        unknown = [k for k in kinds if k not in self._by_kind]
+        if unknown:
+            raise ValueError(f"unknown integrations: {unknown}")
+        self._enabled = set(kinds)
+
+    def is_enabled(self, kind: str) -> bool:
+        if kind not in self._by_kind:
+            return False
+        return self._enabled is None or kind in self._enabled
+
+
+#: process-wide registry, like the reference's package-level manager
+integration_manager = IntegrationManager()

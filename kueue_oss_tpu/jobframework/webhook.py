@@ -1,0 +1,45 @@
+"""Job admission webhooks.
+
+Reference parity: pkg/controller/jobframework/base_webhook.go (suspend on
+create when managed) + validation.go (queue-name immutability while
+admitted/running).
+"""
+
+from __future__ import annotations
+
+from kueue_oss_tpu.jobframework.interface import GenericJob
+
+
+class JobWebhookError(ValueError):
+    pass
+
+
+def default_job(job: GenericJob,
+                manage_jobs_without_queue_name: bool = False) -> None:
+    """Mutating webhook: a managed job is created suspended so kueue
+    controls its start (base_webhook.go Default)."""
+    if job.queue_name or manage_jobs_without_queue_name:
+        if not job.is_suspended():
+            job.do_suspend()
+
+
+def validate_job_create(job: GenericJob) -> list[str]:
+    errs = []
+    for ps in job.pod_sets():
+        if ps.count < 0:
+            errs.append(f"podset {ps.name}: negative count")
+        if ps.min_count is not None and not 0 < ps.min_count <= ps.count:
+            errs.append(f"podset {ps.name}: minCount must be in (0, count]")
+        for r, q in ps.requests.items():
+            if q < 0:
+                errs.append(f"podset {ps.name}: negative request {r}")
+    return errs
+
+
+def validate_job_update(old: GenericJob, new: GenericJob) -> list[str]:
+    """queue-name is immutable while the job is unsuspended
+    (validation.go ValidateJobOnUpdate)."""
+    errs = validate_job_create(new)
+    if old.queue_name != new.queue_name and not old.is_suspended():
+        errs.append("queueName is immutable while the job is running")
+    return errs

@@ -1,0 +1,34 @@
+"""Built-in job integrations.
+
+Reference parity: pkg/controller/jobs/jobs.go:20-35 — importing this
+package registers every built-in integration with the process-wide
+IntegrationManager, mirroring the reference's init() side-effect imports.
+"""
+
+from kueue_oss_tpu.jobs.batch_job import BatchJob
+from kueue_oss_tpu.jobs.job_set import JobSet, ReplicatedJob
+from kueue_oss_tpu.jobs.pod import PlainPod, PodGroup, PodGroupRole
+from kueue_oss_tpu.jobs.deployment import Deployment
+from kueue_oss_tpu.jobs.stateful_set import StatefulSet
+from kueue_oss_tpu.jobs.leader_worker_set import LeaderWorkerSet
+from kueue_oss_tpu.jobs.mpi_job import MPIJob
+from kueue_oss_tpu.jobs.ray import RayCluster, RayJob, RayService, WorkerGroup
+from kueue_oss_tpu.jobs.kubeflow import (
+    JAXJob,
+    PaddleJob,
+    PyTorchJob,
+    ReplicaSpec,
+    TFJob,
+    XGBoostJob,
+)
+from kueue_oss_tpu.jobs.train_job import TrainJob
+from kueue_oss_tpu.jobs.app_wrapper import AppWrapper
+from kueue_oss_tpu.jobs.spark import SparkApplication
+
+__all__ = [
+    "BatchJob", "JobSet", "ReplicatedJob", "PlainPod", "PodGroup",
+    "PodGroupRole", "Deployment", "StatefulSet", "LeaderWorkerSet", "MPIJob",
+    "RayCluster", "RayJob", "RayService", "WorkerGroup", "TFJob",
+    "PyTorchJob", "XGBoostJob", "PaddleJob", "JAXJob", "ReplicaSpec",
+    "TrainJob", "AppWrapper", "SparkApplication",
+]

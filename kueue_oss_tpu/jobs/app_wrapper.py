@@ -1,0 +1,27 @@
+"""AppWrapper integration.
+
+Reference parity: pkg/controller/jobs/appwrapper — the wrapper's component
+podsets are concatenated into one workload.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.registry import integration_manager
+
+
+@integration_manager.register
+@dataclass
+class AppWrapper(BaseJob):
+    kind = "AppWrapper"
+
+    #: (component name, count, per-pod requests)
+    components: list[tuple[str, int, dict[str, int]]] = field(
+        default_factory=list)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(name=name, count=count, requests=dict(requests))
+                for name, count, requests in self.components]

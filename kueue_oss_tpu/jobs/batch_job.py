@@ -1,0 +1,49 @@
+"""batch/v1 Job integration.
+
+Reference parity: pkg/controller/jobs/job/job_controller.go — one "main"
+podset sized by parallelism; partial admission maps to min_parallelism
+(KEP-420, the reference's minimum parallelism annotation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu.api.types import PodSet, PodSetTopologyRequest, Toleration
+from kueue_oss_tpu.jobframework.interface import BaseJob, PodSetInfo
+from kueue_oss_tpu.jobframework.registry import integration_manager
+
+
+@integration_manager.register
+@dataclass
+class BatchJob(BaseJob):
+    kind = "Job"
+
+    parallelism: int = 1
+    completions: Optional[int] = None
+    #: per-pod resource requests in canonical units
+    requests: dict[str, int] = field(default_factory=dict)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: list[Toleration] = field(default_factory=list)
+    #: minimum parallelism acceptable for partial admission (KEP-420)
+    min_parallelism: Optional[int] = None
+    topology_request: Optional[PodSetTopologyRequest] = None
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(
+            name="main",
+            count=self.parallelism,
+            requests=dict(self.requests),
+            min_count=self.min_parallelism,
+            topology_request=self.topology_request,
+            node_selector=dict(self.node_selector),
+            tolerations=list(self.tolerations),
+        )]
+
+    def run_with_podsets_info(self, infos: list[PodSetInfo]) -> None:
+        super().run_with_podsets_info(infos)
+        # Partial admission shrinks parallelism to the admitted count
+        # (job_controller.go RunWithPodSetsInfo).
+        if infos and infos[0].count:
+            self.parallelism = infos[0].count

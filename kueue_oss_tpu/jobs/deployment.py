@@ -1,0 +1,26 @@
+"""Deployment integration (serving workloads).
+
+Reference parity: pkg/controller/jobs/deployment — a Deployment's pods are
+admitted as a single podset sized by replicas.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.registry import integration_manager
+
+
+@integration_manager.register
+@dataclass
+class Deployment(BaseJob):
+    kind = "Deployment"
+
+    replicas: int = 1
+    requests: dict[str, int] = field(default_factory=dict)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(name="main", count=self.replicas,
+                       requests=dict(self.requests))]

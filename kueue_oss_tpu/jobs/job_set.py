@@ -1,0 +1,39 @@
+"""JobSet integration.
+
+Reference parity: pkg/controller/jobs/jobset/jobset_controller.go — one
+podset per replicated job, count = replicas * parallelism.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu.api.types import PodSet, PodSetTopologyRequest
+from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.registry import integration_manager
+
+
+@dataclass
+class ReplicatedJob:
+    name: str
+    replicas: int = 1
+    parallelism: int = 1
+    requests: dict[str, int] = field(default_factory=dict)
+    topology_request: Optional[PodSetTopologyRequest] = None
+
+
+@integration_manager.register
+@dataclass
+class JobSet(BaseJob):
+    kind = "JobSet"
+
+    replicated_jobs: list[ReplicatedJob] = field(default_factory=list)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(
+            name=rj.name,
+            count=rj.replicas * rj.parallelism,
+            requests=dict(rj.requests),
+            topology_request=rj.topology_request,
+        ) for rj in self.replicated_jobs]

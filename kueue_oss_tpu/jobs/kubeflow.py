@@ -1,0 +1,65 @@
+"""Kubeflow training-operator integrations.
+
+Reference parity: pkg/controller/jobs/kubeflow/jobs/{tfjob,pytorchjob,
+xgboostjob,paddlejob,jaxjob} — one podset per replica spec role, ordered
+with the master/chief role first (kubeflowjob.go OrderedReplicaTypes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.registry import integration_manager
+
+
+@dataclass
+class ReplicaSpec:
+    role: str  # e.g. "Master", "Worker", "PS", "Chief"
+    replicas: int = 1
+    requests: dict[str, int] = field(default_factory=dict)
+
+
+_ROLE_ORDER = {"Master": 0, "Chief": 0, "Launcher": 0}
+
+
+@dataclass
+class _KubeflowJob(BaseJob):
+    replica_specs: list[ReplicaSpec] = field(default_factory=list)
+
+    def pod_sets(self) -> list[PodSet]:
+        ordered = sorted(self.replica_specs,
+                         key=lambda rs: (_ROLE_ORDER.get(rs.role, 1), rs.role))
+        return [PodSet(name=rs.role.lower(), count=rs.replicas,
+                       requests=dict(rs.requests)) for rs in ordered]
+
+
+@integration_manager.register
+@dataclass
+class TFJob(_KubeflowJob):
+    kind = "TFJob"
+
+
+@integration_manager.register
+@dataclass
+class PyTorchJob(_KubeflowJob):
+    kind = "PyTorchJob"
+
+
+@integration_manager.register
+@dataclass
+class XGBoostJob(_KubeflowJob):
+    kind = "XGBoostJob"
+
+
+@integration_manager.register
+@dataclass
+class PaddleJob(_KubeflowJob):
+    kind = "PaddleJob"
+
+
+@integration_manager.register
+@dataclass
+class JAXJob(_KubeflowJob):
+    kind = "JAXJob"

@@ -1,0 +1,34 @@
+"""LeaderWorkerSet integration.
+
+Reference parity: pkg/controller/jobs/leaderworkerset — per replica group:
+one leader pod + (size-1) workers; modeled as two podsets across replicas.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.registry import integration_manager
+
+
+@integration_manager.register
+@dataclass
+class LeaderWorkerSet(BaseJob):
+    kind = "LeaderWorkerSet"
+
+    replicas: int = 1
+    size: int = 1  # pods per replica group (leader + workers)
+    leader_requests: dict[str, int] = field(default_factory=dict)
+    worker_requests: dict[str, int] = field(default_factory=dict)
+
+    def pod_sets(self) -> list[PodSet]:
+        podsets = [PodSet(name="leader", count=self.replicas,
+                          requests=dict(self.leader_requests))]
+        workers_per_group = max(self.size - 1, 0)
+        if workers_per_group:
+            podsets.append(PodSet(
+                name="workers", count=self.replicas * workers_per_group,
+                requests=dict(self.worker_requests)))
+        return podsets

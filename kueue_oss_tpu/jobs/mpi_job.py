@@ -1,0 +1,30 @@
+"""MPIJob integration.
+
+Reference parity: pkg/controller/jobs/mpijob — launcher + worker podsets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.registry import integration_manager
+
+
+@integration_manager.register
+@dataclass
+class MPIJob(BaseJob):
+    kind = "MPIJob"
+
+    launcher_requests: dict[str, int] = field(default_factory=dict)
+    worker_count: int = 1
+    worker_requests: dict[str, int] = field(default_factory=dict)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [
+            PodSet(name="launcher", count=1,
+                   requests=dict(self.launcher_requests)),
+            PodSet(name="worker", count=self.worker_count,
+                   requests=dict(self.worker_requests)),
+        ]

@@ -1,0 +1,50 @@
+"""Plain Pod and pod-group integrations.
+
+Reference parity: pkg/controller/jobs/pod/pod_controller.go — a single
+gated pod is a one-pod workload; pods sharing the pod-group label + total
+count annotation form a composable group whose podsets are the distinct
+pod template shapes (roles).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.registry import integration_manager
+
+
+@integration_manager.register
+@dataclass
+class PlainPod(BaseJob):
+    kind = "Pod"
+
+    requests: dict[str, int] = field(default_factory=dict)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(name="main", count=1, requests=dict(self.requests))]
+
+
+@dataclass
+class PodGroupRole:
+    """Pods of one template shape within a group."""
+
+    name: str
+    count: int = 1
+    requests: dict[str, int] = field(default_factory=dict)
+
+
+@integration_manager.register
+@dataclass
+class PodGroup(BaseJob):
+    """An assembled pod group (kueue.x-k8s.io/pod-group-name label +
+    pod-group-total-count annotation on the reference)."""
+
+    kind = "PodGroup"
+
+    roles: list[PodGroupRole] = field(default_factory=list)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(name=r.name, count=r.count,
+                       requests=dict(r.requests)) for r in self.roles]

@@ -1,0 +1,52 @@
+"""Ray integrations: RayJob, RayCluster, RayService.
+
+Reference parity: pkg/controller/jobs/{rayjob,raycluster,rayservice} —
+head podset + one podset per worker group.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.registry import integration_manager
+
+
+@dataclass
+class WorkerGroup:
+    name: str
+    replicas: int = 1
+    requests: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class _RayBase(BaseJob):
+    head_requests: dict[str, int] = field(default_factory=dict)
+    worker_groups: list[WorkerGroup] = field(default_factory=list)
+
+    def pod_sets(self) -> list[PodSet]:
+        podsets = [PodSet(name="head", count=1,
+                          requests=dict(self.head_requests))]
+        podsets.extend(PodSet(name=wg.name, count=wg.replicas,
+                              requests=dict(wg.requests))
+                       for wg in self.worker_groups)
+        return podsets
+
+
+@integration_manager.register
+@dataclass
+class RayJob(_RayBase):
+    kind = "RayJob"
+
+
+@integration_manager.register
+@dataclass
+class RayCluster(_RayBase):
+    kind = "RayCluster"
+
+
+@integration_manager.register
+@dataclass
+class RayService(_RayBase):
+    kind = "RayService"

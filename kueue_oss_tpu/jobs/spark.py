@@ -1,0 +1,31 @@
+"""SparkApplication integration.
+
+Reference parity: pkg/controller/jobs/sparkapplication — driver + executor
+podsets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.registry import integration_manager
+
+
+@integration_manager.register
+@dataclass
+class SparkApplication(BaseJob):
+    kind = "SparkApplication"
+
+    driver_requests: dict[str, int] = field(default_factory=dict)
+    executor_instances: int = 1
+    executor_requests: dict[str, int] = field(default_factory=dict)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [
+            PodSet(name="driver", count=1,
+                   requests=dict(self.driver_requests)),
+            PodSet(name="executor", count=self.executor_instances,
+                   requests=dict(self.executor_requests)),
+        ]

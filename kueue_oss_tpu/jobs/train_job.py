@@ -1,0 +1,28 @@
+"""TrainJob (kubeflow trainer v2) integration.
+
+Reference parity: pkg/controller/jobs/trainjob — podsets derived from the
+training runtime's pod-group shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.registry import integration_manager
+from kueue_oss_tpu.jobs.kubeflow import ReplicaSpec
+
+
+@integration_manager.register
+@dataclass
+class TrainJob(BaseJob):
+    kind = "TrainJob"
+
+    #: pod groups from the referenced TrainingRuntime
+    replica_specs: list[ReplicaSpec] = field(default_factory=list)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(name=rs.role.lower(), count=rs.replicas,
+                       requests=dict(rs.requests))
+                for rs in self.replica_specs]

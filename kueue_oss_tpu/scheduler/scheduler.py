@@ -108,7 +108,6 @@ class Scheduler:
         self._requeue_heap: list[tuple[float, str]] = []
         #: CQs whose usage changed outside entry processing (evictions)
         self._cycle_touched_cqs: set[str] = set()
-        self._last_pending_counts: dict[str, tuple[int, int]] = {}
         # metrics
         self.admitted_total: dict[str, int] = {}
         self.preempted_total: dict[str, int] = {}
@@ -129,6 +128,10 @@ class Scheduler:
         heads = self.queues.heads()
         stats.heads = len(heads)
         if not heads:
+            # Still flush gauges for CQs touched by out-of-cycle evictions
+            # or finishes, so an idle scheduler doesn't report stale usage.
+            if self._cycle_touched_cqs or self.queues.dirty_cqs:
+                self._flush_metrics(build_snapshot(self.store), entries=[])
             return stats
 
         snapshot = build_snapshot(self.store)
@@ -152,15 +155,16 @@ class Scheduler:
         result = (metrics.CycleResult.SUCCESS if stats.admitted or stats.preempted
                   else metrics.CycleResult.INADMISSIBLE)
         metrics.observe_admission_attempt(result, stats.duration_s)
-        for cq_name, counts in self.queues.pending_counts().items():
-            if self._last_pending_counts.get(cq_name) != counts:
-                self._last_pending_counts[cq_name] = counts
-                metrics.report_pending_workloads(cq_name, *counts)
+        self._flush_metrics(snapshot, entries)
+        return stats
+
+    def _flush_metrics(self, snapshot: Snapshot, entries: list[Entry]) -> None:
+        for cq_name, counts in self.queues.drain_dirty_pending_counts().items():
+            metrics.report_pending_workloads(cq_name, *counts)
         touched = {e.info.cluster_queue for e in entries}
         touched.update(self._cycle_touched_cqs)
         self._cycle_touched_cqs.clear()
         self._report_snapshot_metrics(snapshot, touched)
-        return stats
 
     def _report_snapshot_metrics(self, snapshot: Snapshot,
                                  touched: set[str]) -> None:
@@ -547,6 +551,11 @@ class Scheduler:
         wl = self.store.workloads.get(key)
         if wl is None or wl.is_finished:
             return
+        # Resolve the CQ before the admission is cleared: the LQ mapping
+        # may be stale/deleted, but quota was released on the admitting CQ.
+        cq = (wl.status.admission.cluster_queue
+              if wl.status.admission is not None
+              else self.store.cluster_queue_for(wl))
         wl.set_condition(WorkloadConditionType.EVICTED, True, reason=reason,
                          message=message, now=now)
         if preemption_reason:
@@ -589,7 +598,6 @@ class Scheduler:
             heapq.heappush(self._requeue_heap, (rs.requeue_at, key))
         self.store.update_workload(wl)
         self.evicted_total[wl.key] = self.evicted_total.get(wl.key, 0) + 1
-        cq = self.store.cluster_queue_for(wl)
         if cq:
             metrics.evicted_workloads_total.inc(cq, reason)
             self._cycle_touched_cqs.add(cq)
@@ -637,12 +645,15 @@ class Scheduler:
         wl = self.store.workloads.get(key)
         if wl is None:
             return
+        cq = (wl.status.admission.cluster_queue
+              if wl.status.admission is not None
+              else self.store.cluster_queue_for(wl))
         wl.set_condition(WorkloadConditionType.FINISHED, True,
                          reason="JobFinished", now=now)
         self.store.update_workload(wl)
-        cq = self.store.cluster_queue_for(wl)
         if cq:
             metrics.finished_workloads_total.inc(cq)
+            self._cycle_touched_cqs.add(cq)
         self.queues.report_workload_finished(wl)
 
     def _requeue_and_update(self, e: Entry) -> None:

@@ -24,6 +24,7 @@ from kueue_oss_tpu.api.types import (
 from kueue_oss_tpu.core.queue_manager import QueueManager
 from kueue_oss_tpu.core.store import Store
 from kueue_oss_tpu.core.workload_info import WorkloadInfo
+from kueue_oss_tpu import metrics
 from kueue_oss_tpu.solver.kernels import solve_backlog, to_device
 from kueue_oss_tpu.solver.tensors import (
     SolverProblem,
@@ -95,11 +96,15 @@ class SolverEngine:
         parked = np.asarray(parked)
         result.rounds = int(rounds)
         result.solver_time_s = time.monotonic() - t0
+        metrics.solver_cycle_duration_seconds.observe(
+            "solve", value=result.solver_time_s)
 
         t1 = time.monotonic()
         self._apply_plan(problem, admitted, opt, admit_round, parked, now,
                          result, verify=verify)
         result.apply_time_s = time.monotonic() - t1
+        metrics.solver_cycle_duration_seconds.observe(
+            "apply", value=result.apply_time_s)
         return result
 
     # -- plan application --------------------------------------------------
@@ -135,9 +140,11 @@ class SolverEngine:
                     for r, q in psr.requests.items()
                 }
                 if not node.fits(plan_usage):
-                    raise AssertionError(
-                        f"solver plan failed oracle verification: {key} "
-                        f"does not fit in {cq_name}")
+                    # Verify-then-fallback (scheduler.go:427 fits re-check):
+                    # a plan entry the oracle rejects is not committed — the
+                    # workload stays queued for the host scheduler path.
+                    metrics.solver_plan_fallbacks_total.inc()
+                    continue
                 for fr, q in plan_usage.items():
                     node.add_usage(fr, q)
             admission = Admission(
@@ -174,6 +181,9 @@ class SolverEngine:
                                  reason="Admitted", now=now)
             self.store.update_workload(wl)
             self.queues.queues[cq_name].delete(key)
+            metrics.quota_reserved_workload(cq_name, now - wl.creation_time)
+            if wl.is_admitted:
+                metrics.admitted_workload(cq_name, now - wl.creation_time)
             result.admitted += 1
             result.admitted_keys.append(key)
         # Mirror the solver's inadmissible-parking decisions host-side;

@@ -538,7 +538,7 @@ def test_pods_ready_window_anchored_at_admitted():
     reserved_at = env.t
     # the check stays pending past the PodsReady timeout
     env.t = reserved_at + 30.0
-    assert env.reconciler.reconcile("default/wl", env.t) is None or True
+    env.reconciler.reconcile("default/wl", env.t)
     assert not env.wl().is_evicted, "not admitted yet: no PodsReady clock"
     env.wl().status.admission_checks["slow"].state = CheckState.READY
     env.reconciler.reconcile("default/wl", env.t)
