@@ -1,0 +1,291 @@
+"""CRD defaulting and validation webhooks.
+
+Reference parity: pkg/webhooks (webhooks.go:28-50 registers ClusterQueue,
+Cohort, ResourceFlavor, LocalQueue and Workload webhooks). Each validator
+returns a list of error strings (empty = valid), mirroring field.ErrorList;
+`admit_*` helpers raise ValidationError on non-empty results so callers can
+use them as an enforcing gate in front of the store.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorFungibilityPolicy,
+    LocalQueue,
+    PreemptionPolicyValue,
+    ResourceFlavor,
+    Workload,
+    iter_quotas,
+)
+from kueue_oss_tpu.core.store import Store
+
+#: RFC-1123 label, same constraint the apiserver puts on CRD names
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_MAX_NAME_LEN = 253
+
+
+class ValidationError(ValueError):
+    def __init__(self, errors: list[str]) -> None:
+        super().__init__("; ".join(errors))
+        self.errors = errors
+
+
+def _check_name(name: str, what: str) -> list[str]:
+    if not name:
+        return [f"{what}: name is required"]
+    if len(name) > _MAX_NAME_LEN:
+        return [f"{what} {name!r}: name exceeds {_MAX_NAME_LEN} chars"]
+    if not _NAME_RE.match(name):
+        return [f"{what} {name!r}: not a valid RFC-1123 name"]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# ClusterQueue (reference: pkg/webhooks/clusterqueue_webhook.go)
+# ---------------------------------------------------------------------------
+
+_WITHIN_CQ = {PreemptionPolicyValue.NEVER,
+              PreemptionPolicyValue.LOWER_PRIORITY,
+              PreemptionPolicyValue.LOWER_OR_NEWER_EQUAL_PRIORITY}
+_RECLAIM = {PreemptionPolicyValue.NEVER,
+            PreemptionPolicyValue.LOWER_PRIORITY,
+            PreemptionPolicyValue.ANY}
+_BORROW_WITHIN = {PreemptionPolicyValue.NEVER,
+                  PreemptionPolicyValue.LOWER_PRIORITY}
+_FUNGIBILITY = {FlavorFungibilityPolicy.BORROW,
+                FlavorFungibilityPolicy.PREEMPT,
+                FlavorFungibilityPolicy.TRY_NEXT_FLAVOR}
+
+
+def validate_cluster_queue(cq: ClusterQueue) -> list[str]:
+    errs = _check_name(cq.name, "clusterQueue")
+    for i, rg in enumerate(cq.resource_groups):
+        covered = set(rg.covered_resources)
+        if not covered:
+            errs.append(f"resourceGroups[{i}]: coveredResources is required")
+        if not rg.flavors:
+            errs.append(f"resourceGroups[{i}]: at least one flavor required")
+        for fq in rg.flavors:
+            have = {rq.name for rq in fq.resources}
+            if have != covered:
+                errs.append(
+                    f"resourceGroups[{i}] flavor {fq.name}: resources "
+                    f"{sorted(have)} must match coveredResources "
+                    f"{sorted(covered)}")
+            for rq in fq.resources:
+                if rq.nominal < 0:
+                    errs.append(f"flavor {fq.name}/{rq.name}: "
+                                "nominalQuota must be >= 0")
+                if rq.borrowing_limit is not None and rq.borrowing_limit < 0:
+                    errs.append(f"flavor {fq.name}/{rq.name}: "
+                                "borrowingLimit must be >= 0")
+                if rq.lending_limit is not None:
+                    if rq.lending_limit < 0:
+                        errs.append(f"flavor {fq.name}/{rq.name}: "
+                                    "lendingLimit must be >= 0")
+                    elif rq.lending_limit > rq.nominal:
+                        errs.append(f"flavor {fq.name}/{rq.name}: "
+                                    "lendingLimit must be <= nominalQuota")
+    # a resource may appear in only one resource group
+    seen: dict[str, int] = {}
+    for i, rg in enumerate(cq.resource_groups):
+        for r in rg.covered_resources:
+            if r in seen:
+                errs.append(f"resource {r}: covered by resourceGroups "
+                            f"[{seen[r]}] and [{i}]")
+            seen[r] = i
+    p = cq.preemption
+    if p.within_cluster_queue not in _WITHIN_CQ:
+        errs.append(f"preemption.withinClusterQueue: invalid value "
+                    f"{p.within_cluster_queue!r}")
+    if p.reclaim_within_cohort not in _RECLAIM:
+        errs.append(f"preemption.reclaimWithinCohort: invalid value "
+                    f"{p.reclaim_within_cohort!r}")
+    if p.borrow_within_cohort.policy not in _BORROW_WITHIN:
+        errs.append(f"preemption.borrowWithinCohort.policy: invalid value "
+                    f"{p.borrow_within_cohort.policy!r}")
+    if (p.borrow_within_cohort.policy == PreemptionPolicyValue.NEVER
+            and p.borrow_within_cohort.max_priority_threshold is not None):
+        errs.append("preemption.borrowWithinCohort.maxPriorityThreshold: "
+                    "only allowed with policy LowerPriority")
+    ff = cq.flavor_fungibility
+    if ff.when_can_borrow not in _FUNGIBILITY:
+        errs.append(f"flavorFungibility.whenCanBorrow: invalid value "
+                    f"{ff.when_can_borrow!r}")
+    if ff.when_can_preempt not in _FUNGIBILITY:
+        errs.append(f"flavorFungibility.whenCanPreempt: invalid value "
+                    f"{ff.when_can_preempt!r}")
+    if cq.fair_sharing.weight < 0:
+        errs.append("fairSharing.weight must be >= 0")
+    if cq.cohort:
+        errs.extend(_check_name(cq.cohort, "cohort"))
+    return errs
+
+
+def validate_cluster_queue_update(old: ClusterQueue,
+                                  new: ClusterQueue) -> list[str]:
+    return validate_cluster_queue(new)
+
+
+# ---------------------------------------------------------------------------
+# Cohort (reference: pkg/webhooks/cohort_webhook.go + hierarchy cycle check)
+# ---------------------------------------------------------------------------
+
+
+def validate_cohort(cohort: Cohort,
+                    store: Optional[Store] = None) -> list[str]:
+    errs = _check_name(cohort.name, "cohort")
+    if cohort.parent:
+        errs.extend(_check_name(cohort.parent, "parent"))
+        if cohort.parent == cohort.name:
+            errs.append(f"cohort {cohort.name}: cannot be its own parent")
+        elif store is not None and _would_cycle(cohort, store):
+            errs.append(f"cohort {cohort.name}: parent chain forms a cycle")
+    for (flavor, resource), rq in iter_quotas(cohort.resource_groups):
+        if rq.nominal < 0:
+            errs.append(f"cohort {cohort.name} {flavor}/{resource}: "
+                        "nominalQuota must be >= 0")
+    if cohort.fair_sharing.weight < 0:
+        errs.append("fairSharing.weight must be >= 0")
+    return errs
+
+
+def _would_cycle(cohort: Cohort, store: Store) -> bool:
+    """Walk the would-be parent chain (reference: hierarchy/cycle.go
+    HasCycle, evaluated against the store instead of the live forest)."""
+    seen = {cohort.name}
+    cur = cohort.parent
+    while cur:
+        if cur in seen:
+            return True
+        seen.add(cur)
+        parent = store.cohorts.get(cur)
+        cur = parent.parent if parent is not None else None
+    return False
+
+
+# ---------------------------------------------------------------------------
+# ResourceFlavor / LocalQueue
+# ---------------------------------------------------------------------------
+
+
+def validate_resource_flavor(rf: ResourceFlavor) -> list[str]:
+    errs = _check_name(rf.name, "resourceFlavor")
+    for k in rf.node_labels:
+        if not k:
+            errs.append("nodeLabels: empty key")
+    for t in rf.node_taints:
+        if not t.key:
+            errs.append("nodeTaints: taint key is required")
+        if t.effect not in ("NoSchedule", "PreferNoSchedule", "NoExecute"):
+            errs.append(f"nodeTaints {t.key}: invalid effect {t.effect!r}")
+    return errs
+
+
+def validate_local_queue(lq: LocalQueue) -> list[str]:
+    errs = _check_name(lq.name, "localQueue")
+    errs.extend(_check_name(lq.cluster_queue, "clusterQueue"))
+    return errs
+
+
+def validate_local_queue_update(old: LocalQueue, new: LocalQueue) -> list[str]:
+    """clusterQueue is immutable (localqueue_webhook.go ValidateUpdate)."""
+    errs = validate_local_queue(new)
+    if old.cluster_queue != new.cluster_queue:
+        errs.append("clusterQueue is immutable")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# Workload (reference: pkg/webhooks/workload_webhook.go)
+# ---------------------------------------------------------------------------
+
+
+def default_workload(wl: Workload, store: Optional[Store] = None) -> None:
+    """Defaulting: podset names, priority from WorkloadPriorityClass."""
+    for i, ps in enumerate(wl.podsets):
+        if not ps.name:
+            ps.name = "main" if i == 0 else f"ps{i}"
+    if store is not None and wl.priority_class and wl.priority == 0:
+        pc = store.priority_classes.get(wl.priority_class)
+        if pc is not None:
+            wl.priority = pc.value
+
+
+def validate_workload(wl: Workload) -> list[str]:
+    errs = _check_name(wl.name, "workload")
+    if not wl.podsets:
+        errs.append("podSets: at least one required")
+    if len(wl.podsets) > 8:
+        errs.append("podSets: at most 8 podsets allowed")
+    names = set()
+    for ps in wl.podsets:
+        if ps.name in names:
+            errs.append(f"podSets: duplicate name {ps.name!r}")
+        names.add(ps.name)
+        if ps.count < 0:
+            errs.append(f"podSet {ps.name}: count must be >= 0")
+        if ps.min_count is not None and not 0 < ps.min_count <= ps.count:
+            errs.append(f"podSet {ps.name}: minCount must be in (0, count]")
+        for r, q in ps.requests.items():
+            if q < 0:
+                errs.append(f"podSet {ps.name}: negative request for {r}")
+        tr = ps.topology_request
+        if tr is not None and tr.required and tr.preferred:
+            errs.append(f"podSet {ps.name}: topology required and preferred "
+                        "are mutually exclusive")
+    return errs
+
+
+def validate_workload_update(old: Workload, new: Workload) -> list[str]:
+    """Podsets immutable while quota is reserved; queueName immutable
+    while admitted (workload_webhook.go ValidateWorkloadUpdate)."""
+    errs = validate_workload(new)
+    if old.is_quota_reserved:
+        old_shape = [(ps.name, ps.count, sorted(ps.requests.items()))
+                     for ps in old.podsets]
+        new_shape = [(ps.name, ps.count, sorted(ps.requests.items()))
+                     for ps in new.podsets]
+        if old_shape != new_shape:
+            errs.append("podSets are immutable while quota is reserved")
+        if old.queue_name != new.queue_name:
+            errs.append("queueName is immutable while quota is reserved")
+        if old.priority != new.priority:
+            errs.append("priority is immutable while quota is reserved")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# Enforcing helpers
+# ---------------------------------------------------------------------------
+
+
+def _admit(errs: list[str]) -> None:
+    if errs:
+        raise ValidationError(errs)
+
+
+def admit_cluster_queue(cq: ClusterQueue) -> None:
+    _admit(validate_cluster_queue(cq))
+
+
+def admit_cohort(cohort: Cohort, store: Optional[Store] = None) -> None:
+    _admit(validate_cohort(cohort, store))
+
+
+def admit_resource_flavor(rf: ResourceFlavor) -> None:
+    _admit(validate_resource_flavor(rf))
+
+
+def admit_local_queue(lq: LocalQueue) -> None:
+    _admit(validate_local_queue(lq))
+
+
+def admit_workload(wl: Workload, store: Optional[Store] = None) -> None:
+    default_workload(wl, store)
+    _admit(validate_workload(wl))

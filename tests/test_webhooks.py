@@ -1,0 +1,190 @@
+"""CRD webhook validation tests.
+
+Scenario shapes mirror pkg/webhooks/*_webhook_test.go.
+"""
+
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    BorrowWithinCohort,
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetTopologyRequest,
+    PreemptionPolicy,
+    PreemptionPolicyValue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Taint,
+    Workload,
+    WorkloadConditionType,
+    WorkloadPriorityClass,
+)
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.webhooks import (
+    ValidationError,
+    admit_cluster_queue,
+    admit_workload,
+    default_workload,
+    validate_cluster_queue,
+    validate_cohort,
+    validate_local_queue_update,
+    validate_resource_flavor,
+    validate_workload,
+    validate_workload_update,
+)
+
+
+def make_cq(**kw):
+    defaults = dict(
+        name="cq",
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=1000)])])],
+    )
+    defaults.update(kw)
+    return ClusterQueue(**defaults)
+
+
+def test_valid_cluster_queue():
+    assert validate_cluster_queue(make_cq()) == []
+
+
+def test_cq_bad_name():
+    assert validate_cluster_queue(make_cq(name="Bad_Name"))
+    assert validate_cluster_queue(make_cq(name=""))
+
+
+def test_cq_flavor_resources_must_match_covered():
+    cq = make_cq(resource_groups=[ResourceGroup(
+        covered_resources=["cpu", "memory"],
+        flavors=[FlavorQuotas(name="default", resources=[
+            ResourceQuota(name="cpu", nominal=1000)])])])
+    errs = validate_cluster_queue(cq)
+    assert any("must match coveredResources" in e for e in errs)
+
+
+def test_cq_negative_quota_rejected():
+    cq = make_cq(resource_groups=[ResourceGroup(
+        covered_resources=["cpu"],
+        flavors=[FlavorQuotas(name="default", resources=[
+            ResourceQuota(name="cpu", nominal=-5)])])])
+    assert any("nominalQuota" in e for e in validate_cluster_queue(cq))
+
+
+def test_cq_lending_limit_exceeds_nominal():
+    cq = make_cq(resource_groups=[ResourceGroup(
+        covered_resources=["cpu"],
+        flavors=[FlavorQuotas(name="default", resources=[
+            ResourceQuota(name="cpu", nominal=100, lending_limit=200)])])])
+    assert any("lendingLimit" in e for e in validate_cluster_queue(cq))
+
+
+def test_cq_resource_in_two_groups():
+    rg = ResourceGroup(
+        covered_resources=["cpu"],
+        flavors=[FlavorQuotas(name="f1", resources=[
+            ResourceQuota(name="cpu", nominal=1)])])
+    rg2 = ResourceGroup(
+        covered_resources=["cpu"],
+        flavors=[FlavorQuotas(name="f2", resources=[
+            ResourceQuota(name="cpu", nominal=1)])])
+    errs = validate_cluster_queue(make_cq(resource_groups=[rg, rg2]))
+    assert any("covered by resourceGroups" in e for e in errs)
+
+
+def test_cq_invalid_preemption_values():
+    cq = make_cq(preemption=PreemptionPolicy(within_cluster_queue="Sometimes"))
+    assert any("withinClusterQueue" in e for e in validate_cluster_queue(cq))
+    cq = make_cq(preemption=PreemptionPolicy(
+        borrow_within_cohort=BorrowWithinCohort(
+            policy=PreemptionPolicyValue.NEVER, max_priority_threshold=5)))
+    assert any("maxPriorityThreshold" in e for e in validate_cluster_queue(cq))
+
+
+def test_admit_cluster_queue_raises():
+    with pytest.raises(ValidationError):
+        admit_cluster_queue(make_cq(name="-bad-"))
+
+
+def test_cohort_cycle_detection():
+    store = Store()
+    store.upsert_cohort(Cohort(name="a", parent="b"))
+    store.upsert_cohort(Cohort(name="b", parent="c"))
+    # closing the loop: c -> a would cycle
+    errs = validate_cohort(Cohort(name="c", parent="a"), store)
+    assert any("cycle" in e for e in errs)
+    # a fresh root is fine
+    assert validate_cohort(Cohort(name="c", parent="root"), store) == []
+    # self-parent
+    assert any("own parent" in e
+               for e in validate_cohort(Cohort(name="x", parent="x")))
+
+
+def test_resource_flavor_taints():
+    rf = ResourceFlavor(name="f", node_taints=[Taint(key="", effect="NoSchedule")])
+    assert any("taint key" in e for e in validate_resource_flavor(rf))
+    rf = ResourceFlavor(name="f", node_taints=[Taint(key="k", effect="Wrong")])
+    assert any("invalid effect" in e for e in validate_resource_flavor(rf))
+
+
+def test_local_queue_cluster_queue_immutable():
+    old = LocalQueue(name="lq", cluster_queue="cq-a")
+    new = LocalQueue(name="lq", cluster_queue="cq-b")
+    assert any("immutable" in e for e in validate_local_queue_update(old, new))
+
+
+def test_workload_validation():
+    wl = Workload(name="w", podsets=[
+        PodSet(name="a", count=1), PodSet(name="a", count=1)])
+    assert any("duplicate" in e for e in validate_workload(wl))
+    wl = Workload(name="w", podsets=[
+        PodSet(name="a", count=2, min_count=5)])
+    assert any("minCount" in e for e in validate_workload(wl))
+    wl = Workload(name="w", podsets=[PodSet(
+        name="a", count=1,
+        topology_request=PodSetTopologyRequest(required="rack",
+                                               preferred="block"))])
+    assert any("mutually exclusive" in e for e in validate_workload(wl))
+
+
+def test_workload_defaulting_priority_class():
+    store = Store()
+    store.upsert_priority_class(WorkloadPriorityClass(name="high", value=50))
+    wl = Workload(name="w", priority_class="high",
+                  podsets=[PodSet(name="", count=1)])
+    default_workload(wl, store)
+    assert wl.priority == 50
+    assert wl.podsets[0].name == "main"
+
+
+def test_workload_immutability_while_reserved():
+    old = Workload(name="w", queue_name="lq",
+                   podsets=[PodSet(name="main", count=2,
+                                   requests={"cpu": 100})])
+    old.set_condition(WorkloadConditionType.QUOTA_RESERVED, True)
+    new = Workload(name="w", queue_name="lq2",
+                   podsets=[PodSet(name="main", count=3,
+                                   requests={"cpu": 100})])
+    errs = validate_workload_update(old, new)
+    assert any("podSets are immutable" in e for e in errs)
+    assert any("queueName is immutable" in e for e in errs)
+
+    # without reservation the update is allowed
+    old2 = Workload(name="w", queue_name="lq",
+                    podsets=[PodSet(name="main", count=2)])
+    assert validate_workload_update(old2, new) == []
+
+
+def test_admit_workload_defaults_then_validates():
+    store = Store()
+    wl = Workload(name="w", podsets=[PodSet(name="", count=1)])
+    admit_workload(wl, store)
+    assert wl.podsets[0].name == "main"
+    with pytest.raises(ValidationError):
+        admit_workload(Workload(name="w", podsets=[
+            PodSet(name="x", count=-1)]), store)
