@@ -444,6 +444,18 @@ class Workload:
     uid: int = 0
     #: maximum execution time in seconds; None = unlimited
     max_execution_time: Optional[float] = None
+    #: owning job identity "Kind/namespace/name" (jobframework ownership)
+    owner: Optional[str] = None
+    #: key of the workload slice this one replaces on scale-up
+    #: (reference: kueue.x-k8s.io/workload-slice-replacement-for annotation)
+    replacement_for: Optional[str] = None
+    #: concurrent admission (KEP-8691): parent marker, the variant's parent
+    #: key, and the single ResourceFlavor this variant may assign
+    #: (reference: ConcurrentAdmissionParentLabelKey, owner ref,
+    #: WorkloadAllowedResourceFlavorAnnotation)
+    ca_parent: bool = False
+    parent_workload: Optional[str] = None
+    allowed_flavor: Optional[str] = None
     status: WorkloadStatus = field(default_factory=WorkloadStatus)
 
     def __post_init__(self) -> None:

@@ -24,6 +24,10 @@ _DEFAULTS: dict[str, bool] = {
     "TASFailedNodeReplacement": True,  # tas/snapshot.py replacement path
     # misc controllers
     "WaitForPodsReady": True,          # workload controller PodsReady path
+    # elastic jobs (KEP-77; reference default off)
+    "ElasticJobsViaWorkloadSlices": False,  # workloadslicing + scheduler hooks
+    # concurrent admission variants (KEP-8691; reference default off)
+    "ConcurrentAdmission": False,      # variant fan-out + migration hooks
 }
 
 _lock = threading.Lock()

@@ -61,9 +61,15 @@ class JobReconciler:
 
     def delete_job(self, job: GenericJob, now: float = 0.0) -> None:
         self.jobs.pop((job.kind, job.key), None)
-        key = f"{job.namespace}/{workload_name_for(job)}"
-        wl = self.store.workloads.get(key)
-        if wl is not None:
+        owner = f"{job.kind}/{job.key}"
+        # All workloads owned by the job — the base workload and, for
+        # elastic jobs, every slice (suffixIndexed names).
+        keys = [wl.key for wl in self.store.workloads.values()
+                if wl.owner == owner]
+        base = f"{job.namespace}/{workload_name_for(job)}"
+        if base in self.store.workloads and base not in keys:
+            keys.append(base)
+        for key in keys:
             self.scheduler.evict_workload(
                 key, reason="WorkloadDeleted", message="owner job deleted",
                 now=now, requeue=False)
@@ -81,7 +87,13 @@ class JobReconciler:
 
     def reconcile(self, job: GenericJob, now: float) -> None:
         """One pass of ReconcileGenericJob (reconciler.go:281)."""
+        from kueue_oss_tpu import workloadslicing
+
         if not job.queue_name and not self.manage_jobs_without_queue_name:
+            return
+
+        if workloadslicing.enabled(job):
+            self._reconcile_elastic(job, now)
             return
 
         wl = self.workload_for(job)
@@ -109,27 +121,78 @@ class JobReconciler:
             self.store.delete_workload(wl.key)
             wl = self._create_workload(job, podsets, now)
 
-        # 3. Not admitted → the job must be suspended.
+        self._sync_running_state(job, wl, now)
+
+    def _sync_running_state(self, job: GenericJob, wl: Workload,
+                            now: float) -> None:
+        # Not admitted → the job must be suspended.
         if not wl.is_admitted:
             if not job.is_suspended():
                 self._stop_job(job, wl, StopReason.NOT_ADMITTED, now)
             return
 
-        # 4. Admitted → run with injected podset infos.
+        # Admitted → run with injected podset infos.
         if job.is_suspended():
             job.run_with_podsets_info(self._podset_infos(wl))
 
-        # 5. Propagate pod readiness to the Workload condition.
+        # Propagate pod readiness to the Workload condition.
         if self.workload_reconciler is not None:
             self.workload_reconciler.set_pods_ready(
                 wl.key, job.pods_ready(), now)
 
+    # -- elastic jobs (workload slices, KEP-77) -----------------------------
+
+    def _reconcile_elastic(self, job: GenericJob, now: float) -> None:
+        """Slice-aware reconcile: scale-up creates a replacement slice
+        instead of recreating the workload (workloadslicing.go
+        EnsureWorkloadSlices)."""
+        from kueue_oss_tpu import workloadslicing
+
+        owner = f"{job.kind}/{job.key}"
+        msg, success, finished = job.finished()
+        if finished:
+            for wl in workloadslicing.find_not_finished_workloads(
+                    self.store, owner):
+                self.scheduler.finish_workload(wl.key, now=now)
+            return
+
+        def create(podsets, replacement_for, index):
+            wl = self._create_workload(job, podsets, now,
+                                       name_suffix=f"-{index}")
+            wl.replacement_for = replacement_for
+            self.store.update_workload(wl)
+            return wl
+
+        wl, compatible = workloadslicing.ensure_workload_slices(
+            self.store, self.scheduler, job, job.pod_sets(), owner, now,
+            create)
+        if not compatible or wl is None:
+            return
+        # The job keeps running on whichever slice currently holds
+        # admission; a pending replacement slice must not suspend it.
+        running = next(
+            (w for w in workloadslicing.find_not_finished_workloads(
+                self.store, owner) if w.is_admitted), None)
+        target = running if running is not None else wl
+        if (running is not None and not job.is_suspended()
+                and job.injected is not None):
+            admitted_counts = {
+                psa.name: psa.count
+                for psa in (running.status.admission.podset_assignments
+                            if running.status.admission else [])}
+            injected_counts = {i.name: i.count for i in job.injected}
+            if admitted_counts != injected_counts:
+                # New slice took over: re-inject so the scaled pods start
+                # (workloadslicing.go StartWorkloadSlicePods analog).
+                job.run_with_podsets_info(self._podset_infos(running))
+        self._sync_running_state(job, target, now)
+
     # -- helpers ------------------------------------------------------------
 
     def _create_workload(self, job: GenericJob, podsets: list[PodSet],
-                         now: float) -> Workload:
+                         now: float, name_suffix: str = "") -> Workload:
         wl = Workload(
-            name=workload_name_for(job),
+            name=workload_name_for(job) + name_suffix,
             namespace=job.namespace,
             queue_name=job.queue_name,
             priority=getattr(job, "priority", 0),
@@ -144,6 +207,7 @@ class JobReconciler:
             ) for ps in podsets],
             creation_time=getattr(job, "creation_time", now) or now,
         )
+        wl.owner = f"{job.kind}/{job.key}"
         self.store.add_workload(wl)
         return wl
 

@@ -507,6 +507,14 @@ class FlavorAssigner:
         for idx in range(start, num_flavors):
             attempted_idx = idx
             f_name = rg.flavors[idx].name
+            # A concurrent-admission variant is pinned to its flavor
+            # (reference: WorkloadAllowedResourceFlavorAnnotation,
+            # flavorassigner IsFlavorAllowedForVariant check).
+            if (self.wl.obj.allowed_flavor is not None
+                    and f_name != self.wl.obj.allowed_flavor):
+                reasons.append(
+                    f"flavor {f_name} not allowed for this variant")
+                continue
             flavor = self.resource_flavors.get(f_name)
             if flavor is None:
                 reasons.append(f"flavor {f_name} not found")

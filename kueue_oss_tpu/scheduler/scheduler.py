@@ -123,6 +123,7 @@ class Scheduler:
         now = now if now is not None else start
         self.cycle_count += 1
         stats = CycleStats(cycle=self.cycle_count)
+        self.queues.current_time = now  # AFS decay reference point
         self.requeue_due(now)
 
         heads = self.queues.heads()
@@ -130,7 +131,12 @@ class Scheduler:
         if not heads:
             # Still flush gauges for CQs touched by out-of-cycle evictions
             # or finishes, so an idle scheduler doesn't report stale usage.
-            if self._cycle_touched_cqs or self.queues.dirty_cqs:
+            # Pending counts need no snapshot; build one only when usage
+            # gauges actually have CQs to report.
+            for cq_name, counts in (
+                    self.queues.drain_dirty_pending_counts().items()):
+                metrics.report_pending_workloads(cq_name, *counts)
+            if self._cycle_touched_cqs:
                 self._flush_metrics(build_snapshot(self.store), entries=[])
             return stats
 
@@ -246,7 +252,24 @@ class Scheduler:
     def _get_assignments(self, info: WorkloadInfo, snapshot: Snapshot,
                          now: float) -> tuple[Assignment, list[Target]]:
         """scheduler.go getInitialAssignments: full fit, else preempt,
-        else partial admission."""
+        else partial admission. A scaled-up workload slice assigns with the
+        replaced slice's usage removed (delta accounting) and carries the
+        old slice as a pseudo preemption target (scheduler.go:705)."""
+        from kueue_oss_tpu import workloadslicing
+
+        slice_targets, replaced = workloadslicing.replaced_workload_slice(
+            info, snapshot)
+        if replaced is not None:
+            revert = snapshot.simulate_workload_removal([replaced])
+            try:
+                assignment, targets = self._assign(info, snapshot, now)
+            finally:
+                revert()
+            return assignment, slice_targets + targets
+        return self._assign(info, snapshot, now)
+
+    def _assign(self, info: WorkloadInfo, snapshot: Snapshot,
+                now: float) -> tuple[Assignment, list[Target]]:
         cq = snapshot.cluster_queue(info.cluster_queue)
         assert cq is not None
         assigner = FlavorAssigner(
@@ -328,8 +351,21 @@ class Scheduler:
     def _process_entry(self, e: Entry, snapshot: Snapshot,
                        preempted_workloads: dict[str, WorkloadInfo],
                        stats: CycleStats, now: float) -> None:
+        from kueue_oss_tpu import features
+
         cq = e.cq_snapshot
         assert cq is not None
+
+        is_variant = (features.enabled("ConcurrentAdmission")
+                      and e.info.obj.parent_workload is not None)
+        if is_variant and self._find_admitted_sibling(
+                e.info, cq, less_favorable=False) is not None:
+            # A more favorable flavor already won (scheduler.go:386-392).
+            e.status = SKIPPED
+            e.inadmissible_msg = "A more favorable variant is already admitted"
+            stats.skipped += 1
+            return
+
         mode = e.assignment.representative_mode()
         if mode == fa.NO_FIT:
             stats.skipped += 1
@@ -363,15 +399,83 @@ class Scheduler:
             preempted_workloads[t.info.key] = t.info
         cq.add_usage(usage)
 
+        # The old workload slice rides the target list for accounting but
+        # is finished (replaced), never evicted (scheduler.go:437-454).
+        from kueue_oss_tpu import workloadslicing
+
+        e.preemption_targets, old_slice = (
+            workloadslicing.find_replaced_slice_target(
+                e.info.obj, e.preemption_targets))
+
         if mode == fa.PREEMPT:
             self._issue_preemptions(e, now)
             stats.preempted += len(e.preemption_targets)
             return
 
+        if old_slice is not None:
+            workloadslicing.finish_slice(
+                self.store, self, old_slice.info.obj,
+                workloadslicing.REASON_SLICE_REPLACED,
+                f"Replaced to accommodate scaled-up slice {e.info.key}",
+                now)
+            snapshot.remove_workload(old_slice.info)
+            metrics.replaced_workload_slices_total.inc(e.info.cluster_queue)
+
+        if is_variant:
+            sibling = self._find_admitted_sibling(
+                e.info, cq, less_favorable=True)
+            if sibling is not None:
+                # Migration up the flavor order: evict the less favorable
+                # sibling now; this variant re-attempts next cycle with the
+                # freed quota (scheduler.go issueMigration, :488).
+                self.evict_workload(
+                    sibling.key, reason="Migrated",
+                    message=f"Migrated to more favorable variant {e.info.key}",
+                    now=now)
+                e.inadmissible_msg = (
+                    "Pending the migration eviction of a less favorable "
+                    "variant")
+                e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                # Reset the flavor cursor like the preemption path: the
+                # next attempt must start from the best flavor again.
+                e.info.last_assignment = None
+                stats.preempted += 1
+                return
+
         self._assume_tas_usage(e, snapshot)
         e.status = NOMINATED
         self._admit(e, now)
         stats.admitted += 1
+
+    def _find_admitted_sibling(self, info: WorkloadInfo,
+                               cq: ClusterQueueSnapshot,
+                               less_favorable: bool) -> Optional[WorkloadInfo]:
+        """An admitted variant of the same parent on a (less/more) favorable
+        flavor — favorability is the flavor's index in the CQ's first
+        resource group (scheduler.go findAdmittedSibling, :1111-1187)."""
+        from kueue_oss_tpu.controllers.concurrent_admission import (
+            flavor_order_of,
+        )
+
+        parent = info.obj.parent_workload
+        if parent is None or not cq.spec.resource_groups:
+            return None
+        order = flavor_order_of(cq.spec)
+        my_idx = order.get(info.obj.allowed_flavor or "")
+        if my_idx is None:
+            return None
+        for other in cq.workloads.values():
+            obj = other.obj
+            if obj.uid == info.obj.uid or obj.parent_workload != parent:
+                continue
+            if not obj.is_admitted:
+                continue
+            other_idx = order.get(obj.allowed_flavor or "")
+            if other_idx is None:
+                continue
+            if (other_idx > my_idx) == less_favorable and other_idx != my_idx:
+                return other
+        return None
 
     @staticmethod
     def _assume_tas_usage(e: Entry, snapshot: Snapshot) -> None:
@@ -516,6 +620,15 @@ class Scheduler:
                                         now - wl.creation_time)
         self.admitted_total[e.info.cluster_queue] = (
             self.admitted_total.get(e.info.cluster_queue, 0) + 1)
+        if (self.queues.afs is not None
+                and cq_spec.admission_scope is not None):
+            # Entry penalty: charge the admitted usage to the LocalQueue
+            # immediately (afs/entry_penalties.go).
+            by_resource: dict[str, int] = {}
+            for (_, r), q in e.assignment.usage_quota.items():
+                by_resource[r] = by_resource.get(r, 0) + q
+            self.queues.afs.record_admission(
+                f"{wl.namespace}/{wl.queue_name}", by_resource, now)
 
     def _issue_preemptions(self, e: Entry, now: float) -> None:
         for target in e.preemption_targets:

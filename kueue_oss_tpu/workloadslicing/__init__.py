@@ -1,0 +1,183 @@
+"""Elastic jobs via workload slices (KEP-77).
+
+Reference parity: pkg/workloadslicing/workloadslicing.go — scale-up of an
+admitted job creates a *new slice* workload annotated as the replacement
+for the old one; the scheduler treats the old slice's usage as removable
+during flavor assignment (delta-only accounting, flavorassigner.go:779-787)
+and, on admission of the new slice, marks the old slice Finished with
+reason WorkloadSliceReplaced instead of preempting it (scheduler.go:441,
+1045-1061).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import Workload, WorkloadConditionType
+from kueue_oss_tpu.core.snapshot import Snapshot
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.core.workload_info import WorkloadInfo
+from kueue_oss_tpu.scheduler.preemption import Target
+
+#: annotation key/value enabling slicing on a job
+ENABLED_ANNOTATION_KEY = "kueue.x-k8s.io/elastic-job"
+ENABLED_ANNOTATION_VALUE = "true"
+
+#: Finished-condition reason for a replaced slice
+REASON_SLICE_REPLACED = "WorkloadSliceReplaced"
+REASON_OUT_OF_SYNC = "OutOfSync"
+
+#: Target.reason marker carried through the preemption-target list
+TARGET_REASON = "WorkloadSliceReplacement"
+
+
+def enabled(job) -> bool:
+    """True when the job opts into slicing (workloadslicing.go Enabled)."""
+    if not features.enabled("ElasticJobsViaWorkloadSlices"):
+        return False
+    return (getattr(job, "annotations", {}).get(ENABLED_ANNOTATION_KEY)
+            == ENABLED_ANNOTATION_VALUE)
+
+
+def is_elastic_workload(wl: Workload) -> bool:
+    return wl.replacement_for is not None
+
+
+def is_replaced(wl: Workload) -> bool:
+    """workloadslicing.go IsReplaced: Finished with WorkloadSliceReplaced."""
+    c = wl.condition(WorkloadConditionType.FINISHED)
+    return c is not None and c.status and c.reason == REASON_SLICE_REPLACED
+
+
+def find_not_finished_workloads(store: Store, owner: str) -> list[Workload]:
+    """Active slices for a job, oldest first (workloadslicing.go
+    FindNotFinishedWorkloads sorts by creation timestamp)."""
+    out = [wl for wl in store.workloads.values()
+           if wl.owner == owner and not wl.is_finished and wl.active]
+    out.sort(key=lambda w: (w.creation_time, w.uid))
+    return out
+
+
+def replaced_workload_slice(
+    info: WorkloadInfo, snapshot: Snapshot
+) -> tuple[list[Target], Optional[WorkloadInfo]]:
+    """The old slice this workload replaces, as a preemption target, if it
+    currently holds quota in the same CQ (workloadslicing.go:333-355)."""
+    if not features.enabled("ElasticJobsViaWorkloadSlices"):
+        return [], None
+    slice_key = info.obj.replacement_for
+    if slice_key is None:
+        return [], None
+    cq = snapshot.cluster_queue(info.cluster_queue)
+    if cq is None:
+        return [], None
+    replaced = cq.workloads.get(slice_key)
+    if replaced is None:
+        return [], None
+    return [Target(info=replaced, reason=TARGET_REASON, cq=cq)], replaced
+
+
+def find_replaced_slice_target(
+    preemptor: Workload, targets: list[Target]
+) -> tuple[list[Target], Optional[Target]]:
+    """Pull the old-slice target out of the preemption targets: it is
+    finished (replaced), never evicted (workloadslicing.go:376-391)."""
+    if not features.enabled("ElasticJobsViaWorkloadSlices"):
+        return targets, None
+    slice_key = preemptor.replacement_for
+    if slice_key is None:
+        return targets, None
+    for i, t in enumerate(targets):
+        if t.info.key == slice_key:
+            return targets[:i] + targets[i + 1:], t
+    return targets, None
+
+
+def scaled_down(old_counts: dict[str, int], new_counts: dict[str, int]) -> bool:
+    """Strictly-fewer-replicas in at least one podset, none grew."""
+    return (any(new_counts[k] < old_counts[k] for k in old_counts)
+            and all(new_counts[k] <= old_counts[k] for k in old_counts))
+
+
+def _podset_counts(podsets) -> dict[str, int]:
+    return {ps.name: ps.count for ps in podsets}
+
+
+def ensure_workload_slices(store: Store, scheduler, job, job_podsets,
+                           owner: str, now: float,
+                           create) -> tuple[Optional[Workload], bool]:
+    """The 0/1/2-active-slices state machine (workloadslicing.go:160-277).
+
+    `create` is a callback (podsets, replacement_for, index) -> Workload
+    supplied by the job reconciler (it owns naming and store insertion).
+    Returns (workload-to-track, compatible); compatible=False means the
+    existing workload has different podset keys and nothing was done.
+    """
+    job_counts = _podset_counts(job_podsets)
+    slices = find_not_finished_workloads(store, owner)
+
+    if len(slices) == 0:
+        return create(job_podsets, None, _next_index(store, owner)), True
+
+    if len(slices) == 2:
+        old_wl, new_wl = slices
+        admitted_as_replacement = (
+            new_wl.is_quota_reserved
+            and new_wl.replacement_for == old_wl.key)
+        if (not old_wl.is_quota_reserved or old_wl.is_evicted
+                or admitted_as_replacement):
+            finish_slice(store, scheduler, old_wl, REASON_OUT_OF_SYNC,
+                         "The workload slice is out of sync with its "
+                         "parent job", now)
+            slices = [new_wl]
+        else:
+            slices = [new_wl]  # evaluate the job against the newest slice
+        wl = slices[0]
+    else:
+        wl = slices[0]
+
+    wl_counts = _podset_counts(wl.podsets)
+    if set(wl_counts) != set(job_counts):
+        return None, False  # incompatible shapes; leave untouched
+    if wl_counts == job_counts:
+        return wl, True
+    if not wl.is_quota_reserved or scaled_down(wl_counts, job_counts):
+        apply_podset_counts(wl, job_counts)
+        store.update_workload(wl)
+        return wl, True
+    # scale-up on an admitted slice → new replacement slice
+    return create(job_podsets, wl.key, _next_index(store, owner)), True
+
+
+def _next_index(store: Store, owner: str) -> int:
+    n = sum(1 for w in store.workloads.values() if w.owner == owner)
+    return n + 1
+
+
+def apply_podset_counts(wl: Workload, counts: dict[str, int]) -> None:
+    """In-place count update (+ shrink the recorded admission usage on an
+    admitted scale-down so the caches release the freed quota)."""
+    for ps in wl.podsets:
+        if ps.name in counts:
+            ps.count = counts[ps.name]
+    if wl.status.admission is not None:
+        for psa in wl.status.admission.podset_assignments:
+            new_count = counts.get(psa.name)
+            if new_count is None or psa.count in (0, new_count):
+                continue
+            ratio = new_count / psa.count
+            psa.resource_usage = {
+                r: int(q * ratio) for r, q in psa.resource_usage.items()}
+            psa.count = new_count
+
+
+def finish_slice(store: Store, scheduler, wl: Workload, reason: str,
+                 message: str, now: float) -> None:
+    """Finish (not evict) a replaced/out-of-sync slice, releasing quota."""
+    if wl.is_finished:
+        return
+    wl.set_condition(WorkloadConditionType.FINISHED, True, reason=reason,
+                     message=message, now=now)
+    store.update_workload(wl)
+    scheduler.queues.report_workload_finished(wl)

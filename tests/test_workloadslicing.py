@@ -1,0 +1,210 @@
+"""Elastic jobs / workload slices (KEP-77) tests.
+
+Scenario shapes mirror pkg/workloadslicing/workloadslicing_test.go and the
+elastic-jobs integration tests: scale-up creates a replacement slice
+admitted with delta-only quota accounting; the old slice is Finished with
+reason WorkloadSliceReplaced, never preempted; scale-down updates in place.
+"""
+
+import pytest
+
+from kueue_oss_tpu import features, metrics, workloadslicing
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    WorkloadConditionType,
+)
+from kueue_oss_tpu.controllers import WorkloadReconciler
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.jobframework import JobReconciler
+from kueue_oss_tpu.jobs import StatefulSet
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+@pytest.fixture(autouse=True)
+def _elastic_gate():
+    features.set_gates({"ElasticJobsViaWorkloadSlices": True})
+    metrics.reset_all()
+    yield
+    features.reset()
+
+
+class Env:
+    def __init__(self, nominal=10_000):
+        self.store = Store()
+        self.store.upsert_resource_flavor(ResourceFlavor(name="default"))
+        self.store.upsert_cluster_queue(ClusterQueue(
+            name="cq", resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources=[
+                    ResourceQuota(name="cpu", nominal=nominal)])])]))
+        self.store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        self.queues = QueueManager(self.store)
+        self.scheduler = Scheduler(self.store, self.queues)
+        self.wr = WorkloadReconciler(self.store, self.scheduler)
+        self.jobs = JobReconciler(self.store, self.scheduler,
+                                  workload_reconciler=self.wr)
+        self.t = 0.0
+
+    def tick(self):
+        self.t += 1.0
+        self.scheduler.schedule(self.t)
+        self.jobs.reconcile_all(self.t)
+        return self.t
+
+
+def make_elastic_sts(replicas=2):
+    return StatefulSet(
+        name="db", queue_name="lq", replicas=replicas,
+        requests={"cpu": 1000},
+        annotations={workloadslicing.ENABLED_ANNOTATION_KEY:
+                     workloadslicing.ENABLED_ANNOTATION_VALUE})
+
+
+def slices_of(env, job):
+    return workloadslicing.find_not_finished_workloads(
+        env.store, f"{job.kind}/{job.key}")
+
+
+def test_elastic_scale_up_creates_replacement_slice():
+    env = Env()
+    job = make_elastic_sts(replicas=2)
+    env.jobs.upsert_job(job)
+    env.jobs.reconcile(job, env.t)
+    env.tick()
+    (wl1,) = slices_of(env, job)
+    assert wl1.is_admitted
+    assert not job.is_suspended()
+    job.mark_running()
+
+    # scale up 2 → 5
+    job.replicas = 5
+    env.jobs.reconcile(job, env.t)
+    active = slices_of(env, job)
+    assert len(active) == 2, "scale-up must add a pending replacement slice"
+    old_wl, new_wl = active
+    assert new_wl.replacement_for == old_wl.key
+    assert not job.is_suspended(), "job keeps running on the old slice"
+
+    env.tick()
+    active = slices_of(env, job)
+    assert len(active) == 1
+    assert active[0].podsets[0].count == 5
+    assert active[0].is_admitted
+    # old slice Finished with the replacement reason, NOT evicted
+    old = env.store.workloads[old_wl.key]
+    fin = old.condition(WorkloadConditionType.FINISHED)
+    assert fin is not None and fin.status
+    assert fin.reason == workloadslicing.REASON_SLICE_REPLACED
+    assert not old.is_evicted
+    assert metrics.replaced_workload_slices_total.value("cq") == 1
+    # job re-injected with the new count
+    assert job.injected[0].count == 5
+
+
+def test_elastic_scale_up_requires_delta_only():
+    """10k quota, old slice 6 cpu; scaled to 9 needs only the delta —
+    admission succeeds because old usage is discounted."""
+    env = Env(nominal=9_000)
+    job = make_elastic_sts(replicas=6)
+    env.jobs.upsert_job(job)
+    env.jobs.reconcile(job, env.t)
+    env.tick()
+    job.mark_running()
+    job.replicas = 9  # full re-admission would need 9k while 6k is held
+    env.jobs.reconcile(job, env.t)
+    env.tick()
+    (wl,) = slices_of(env, job)
+    assert wl.is_admitted and wl.podsets[0].count == 9
+
+
+def test_elastic_scale_down_updates_in_place():
+    env = Env()
+    job = make_elastic_sts(replicas=4)
+    env.jobs.upsert_job(job)
+    env.jobs.reconcile(job, env.t)
+    env.tick()
+    (wl,) = slices_of(env, job)
+    usage_before = wl.status.admission.podset_assignments[0].resource_usage["cpu"]
+    assert usage_before == 4000
+
+    job.replicas = 2
+    env.jobs.reconcile(job, env.t)
+    active = slices_of(env, job)
+    assert len(active) == 1 and active[0].key == wl.key, "no new slice"
+    psa = active[0].status.admission.podset_assignments[0]
+    assert psa.count == 2 and psa.resource_usage["cpu"] == 2000
+
+
+def test_elastic_pending_scale_up_no_new_slice():
+    """Scaling a not-yet-admitted slice updates it in place."""
+    env = Env(nominal=1000)
+    job = make_elastic_sts(replicas=3)  # 3 cpu > 1 cpu quota: stays pending
+    env.jobs.upsert_job(job)
+    env.jobs.reconcile(job, env.t)
+    env.tick()
+    job.replicas = 5
+    env.jobs.reconcile(job, env.t)
+    active = slices_of(env, job)
+    assert len(active) == 1
+    assert active[0].podsets[0].count == 5
+
+
+def test_elastic_job_finish_finishes_all_slices():
+    env = Env()
+    job = make_elastic_sts(replicas=2)
+    env.jobs.upsert_job(job)
+    env.jobs.reconcile(job, env.t)
+    env.tick()
+    job.replicas = 4
+    env.jobs.reconcile(job, env.t)
+    job.mark_finished()
+    env.jobs.reconcile(job, env.t)
+    assert slices_of(env, job) == []
+
+
+def test_gate_off_falls_back_to_recreate():
+    features.set_gates({"ElasticJobsViaWorkloadSlices": False})
+    env = Env()
+    job = make_elastic_sts(replicas=2)
+    env.jobs.upsert_job(job)
+    env.jobs.reconcile(job, env.t)
+    env.tick()
+    job.replicas = 5
+    env.jobs.reconcile(job, env.t)
+    # non-elastic path: single workload recreated pending
+    wls = [w for w in env.store.workloads.values() if not w.is_finished]
+    assert len(wls) == 1
+    assert wls[0].podsets[0].count == 5
+    assert not wls[0].is_quota_reserved
+
+
+def test_delete_elastic_job_releases_all_slices():
+    """Regression: deleting an elastic job must evict+delete every slice
+    (suffixed names), not just the unsuffixed base workload."""
+    env = Env()
+    job = make_elastic_sts(replicas=2)
+    env.jobs.upsert_job(job)
+    env.jobs.reconcile(job, env.t)
+    env.tick()
+    job.mark_running()
+    job.replicas = 4
+    env.jobs.reconcile(job, env.t)  # second slice pending
+    assert len(slices_of(env, job)) == 2
+    env.jobs.delete_job(job, now=env.t)
+    assert slices_of(env, job) == []
+    assert all(w.owner != f"StatefulSet/{job.key}"
+               for w in env.store.workloads.values())
+    # quota released: a full-size newcomer admits immediately
+    from kueue_oss_tpu.jobs import BatchJob
+    big = BatchJob(name="big", queue_name="lq", parallelism=10,
+                   requests={"cpu": 1000})
+    env.jobs.upsert_job(big)
+    env.jobs.reconcile(big, env.t)
+    env.tick()
+    assert env.jobs.workload_for(big).is_admitted
