@@ -2,5 +2,12 @@ from kueue_oss_tpu.controllers.workload_controller import (
     EvictionReason,
     WorkloadReconciler,
 )
+from kueue_oss_tpu.controllers.concurrent_admission import (
+    ConcurrentAdmissionReconciler,
+)
 
-__all__ = ["EvictionReason", "WorkloadReconciler"]
+__all__ = [
+    "EvictionReason",
+    "WorkloadReconciler",
+    "ConcurrentAdmissionReconciler",
+]

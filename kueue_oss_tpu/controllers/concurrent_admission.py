@@ -1,0 +1,197 @@
+"""Concurrent admission variants (KEP-8691, fork-new).
+
+Reference parity: pkg/controller/concurrentadmission/controller.go — a
+parent workload fans out one *variant* workload per ResourceFlavor of its
+ClusterQueue; each variant is pinned to its flavor. The scheduler admits
+whichever variant fits first; the controller then deactivates variants
+pinned to less favorable flavors (higher flavor index) and keeps more
+favorable ones active so a later migration can move the job up the flavor
+order (scheduler.go:386-392,456-461 hooks, implemented in
+Scheduler._process_entry).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_oss_tpu.api.types import (
+    PodSet,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_oss_tpu.core.store import Store
+
+
+def variant_name(parent: Workload, flavor: str) -> str:
+    """jobframework.GetWorkloadNameForVariant analog."""
+    return f"{parent.name}-{flavor}"
+
+
+def flavor_order_of(cq) -> dict[str, int]:
+    """Favorability = index in the CQ's first resource group's flavor list
+    (lower = more favorable)."""
+    if not cq.resource_groups:
+        return {}
+    return {fq.name: i for i, fq in enumerate(cq.resource_groups[0].flavors)}
+
+
+def variants_for(store: Store, parent: Workload) -> list[Workload]:
+    return [wl for wl in store.workloads.values()
+            if wl.parent_workload == parent.key]
+
+
+def admitted_variant(variants: list[Workload]) -> Optional[Workload]:
+    for v in variants:
+        if v.is_admitted and v.active:
+            return v
+    return None
+
+
+class ConcurrentAdmissionReconciler:
+    """Drives the parent↔variant state machine over the store."""
+
+    def __init__(self, store: Store, scheduler) -> None:
+        self.store = store
+        self.scheduler = scheduler
+
+    def reconcile_all(self, now: float) -> None:
+        for wl in list(self.store.workloads.values()):
+            if wl.ca_parent:
+                self.reconcile(wl.key, now)
+
+    def reconcile(self, parent_key: str, now: float) -> None:
+        parent = self.store.workloads.get(parent_key)
+        if parent is None or not parent.ca_parent:
+            return
+        cq_name = self.store.cluster_queue_for(parent)
+        cq = self.store.cluster_queues.get(cq_name) if cq_name else None
+        if cq is None:
+            return
+        order = flavor_order_of(cq)
+        variants = sorted(
+            variants_for(self.store, parent),
+            key=lambda v: order.get(v.allowed_flavor or "", len(order)))
+
+        have = {v.allowed_flavor for v in variants}
+        missing = [f for f in sorted(order, key=order.get) if f not in have]
+        if missing and parent.active and not parent.is_finished:
+            self._create_variants(parent, missing, now)
+            variants = sorted(
+                variants_for(self.store, parent),
+                key=lambda v: order.get(v.allowed_flavor or "", len(order)))
+
+        if self._sync_variant_eviction(parent, variants, now):
+            return
+
+        if not parent.active or parent.is_finished:
+            reason = ("ParentFinished" if parent.is_finished
+                      else "ParentDeactivated")
+            for v in variants:
+                self._deactivate_variant(v, reason, now)
+            return
+
+        admitted = admitted_variant(variants)
+        if admitted is not None:
+            admitted_idx = order.get(admitted.allowed_flavor or "", 0)
+            for v in variants:
+                idx = order.get(v.allowed_flavor or "", len(order))
+                if idx > admitted_idx:
+                    # Less favorable than the winner: stand down.
+                    self._deactivate_variant(
+                        v, "DeactivatedVariant", now,
+                        message=f"Less favorable than admitted variant "
+                                f"{admitted.name}")
+                elif idx < admitted_idx and not v.active:
+                    # More favorable: stays in the race for migration.
+                    self._activate_variant(v, now)
+        else:
+            for v in variants:
+                if not v.active and not v.is_finished:
+                    self._activate_variant(v, now)
+
+        self._sync_parent_status(parent, admitted, now)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _create_variants(self, parent: Workload, flavors: list[str],
+                         now: float) -> None:
+        for flavor in flavors:
+            v = Workload(
+                name=variant_name(parent, flavor),
+                namespace=parent.namespace,
+                queue_name=parent.queue_name,
+                priority=parent.priority,
+                priority_class=parent.priority_class,
+                podsets=[PodSet(
+                    name=ps.name, count=ps.count,
+                    requests=dict(ps.requests), min_count=ps.min_count,
+                    topology_request=ps.topology_request,
+                    node_selector=dict(ps.node_selector),
+                    tolerations=list(ps.tolerations),
+                ) for ps in parent.podsets],
+                creation_time=parent.creation_time or now,
+                parent_workload=parent.key,
+                allowed_flavor=flavor,
+                owner=parent.owner,
+            )
+            self.store.add_workload(v)
+
+    def _sync_variant_eviction(self, parent: Workload,
+                               variants: list[Workload], now: float) -> bool:
+        """A parent that mirrors an admission whose variant lost it gets
+        evicted too (controller.go syncVariantEvictionStatus). The winning
+        variant's eviction clears its quota in the same step here, so the
+        trigger is 'parent reserved but no variant currently admitted'."""
+        if not parent.is_quota_reserved:
+            return False
+        if admitted_variant(variants) is not None:
+            return False
+        parent.set_condition(
+            WorkloadConditionType.EVICTED, True,
+            reason="VariantEvicted",
+            message="Admitted variant was evicted", now=now)
+        parent.set_condition(
+            WorkloadConditionType.QUOTA_RESERVED, False,
+            reason="VariantEvicted", now=now)
+        parent.set_condition(
+            WorkloadConditionType.ADMITTED, False,
+            reason="VariantEvicted", now=now)
+        parent.status.admission = None
+        self.store.update_workload(parent)
+        return False  # continue: variants may need re-activation
+
+    def _deactivate_variant(self, v: Workload, reason: str, now: float,
+                            message: str = "") -> None:
+        if not v.active:
+            return
+        v.active = False
+        if v.is_quota_reserved:
+            self.scheduler.evict_workload(
+                v.key, reason=reason, message=message or reason, now=now,
+                requeue=False)
+        else:
+            self.store.update_workload(v)
+            self.scheduler.queues.delete_workload(v)
+
+    def _activate_variant(self, v: Workload, now: float) -> None:
+        v.active = True
+        v.set_condition(WorkloadConditionType.EVICTED, False,
+                        reason="ActivatedVariant", now=now)
+        self.store.update_workload(v)
+
+    def _sync_parent_status(self, parent: Workload,
+                            admitted: Optional[Workload], now: float) -> None:
+        """Mirror the winning variant's admission onto the parent
+        (controller.go syncAdmissionStatus)."""
+        if admitted is None:
+            return
+        if not parent.is_admitted:
+            parent.status.admission = admitted.status.admission
+            parent.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                                 reason="VariantAdmitted", now=now)
+            parent.set_condition(WorkloadConditionType.ADMITTED, True,
+                                 reason="VariantAdmitted", now=now)
+            self.store.update_workload(parent)
+        elif parent.status.admission is not admitted.status.admission:
+            parent.status.admission = admitted.status.admission
+            self.store.update_workload(parent)

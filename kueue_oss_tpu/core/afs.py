@@ -1,0 +1,84 @@
+"""Admission fair sharing (KEP-4136).
+
+Reference parity: pkg/cache/queue/afs + pkg/util/admissionfairsharing —
+LocalQueues accumulate *historical* resource usage that decays with a
+configurable half-life; within a ClusterQueue whose admissionScope is
+UsageBasedAdmissionFairSharing, pending workloads from lighter-usage
+LocalQueues are admitted first (cluster_queue.go queueOrderingFunc AFS
+branch). Admissions immediately charge an *entry penalty* equal to the
+admitted usage so back-to-back admissions from one LQ can't outrun the
+usage sampling (afs/entry_penalties.go; scheduler.go:1105 subtracts the
+penalty once sampling catches up — here the penalty IS the sample).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from kueue_oss_tpu.config.configuration import AdmissionFairSharingConfig
+
+#: resources counted when no explicit weights are configured
+_DEFAULT_WEIGHT = 1.0
+
+
+class AfsManager:
+    """Decayed per-LocalQueue usage store."""
+
+    def __init__(self, config: Optional[AdmissionFairSharingConfig] = None,
+                 lq_weights: Optional[dict[str, float]] = None) -> None:
+        self.config = config or AdmissionFairSharingConfig()
+        #: lq key -> (resource -> decayed quantity, last decay timestamp)
+        self._usage: dict[str, tuple[dict[str, float], float]] = {}
+        #: optional per-LQ fair-sharing weight (localqueue fairSharing.weight)
+        self.lq_weights = lq_weights or {}
+
+    # -- decay model --------------------------------------------------------
+
+    def _decay_factor(self, dt: float) -> float:
+        hl = self.config.usage_half_life_time_seconds
+        if hl <= 0:
+            return 0.0
+        return math.pow(0.5, max(dt, 0.0) / hl)
+
+    def _decayed(self, lq_key: str, now: float) -> dict[str, float]:
+        entry = self._usage.get(lq_key)
+        if entry is None:
+            return {}
+        usage, t0 = entry
+        f = self._decay_factor(now - t0)
+        return {r: q * f for r, q in usage.items()}
+
+    # -- writes -------------------------------------------------------------
+
+    def record_admission(self, lq_key: str, usage: dict[str, int],
+                         now: float) -> None:
+        """Charge an admitted workload's usage to its LocalQueue (entry
+        penalty + sampled usage in one step)."""
+        current = self._decayed(lq_key, now)
+        for r, q in usage.items():
+            current[r] = current.get(r, 0.0) + float(q)
+        self._usage[lq_key] = (current, now)
+
+    def reset_lq(self, lq_key: str) -> None:
+        self._usage.pop(lq_key, None)
+
+    # -- reads --------------------------------------------------------------
+
+    def lq_usage(self, lq_key: str, now: float) -> dict[str, float]:
+        return self._decayed(lq_key, now)
+
+    def weighted_usage(self, lq_key: str, now: float) -> float:
+        """Scalarized usage: sum of weight[r] * usage[r], divided by the
+        LQ's fair-sharing weight (admissionfairsharing.go)."""
+        weights = self.config.resource_weights
+        total = 0.0
+        for r, q in self._decayed(lq_key, now).items():
+            total += weights.get(r, _DEFAULT_WEIGHT) * q
+        lq_w = self.lq_weights.get(lq_key, 1.0)
+        if lq_w <= 0:
+            return math.inf if total > 0 else 0.0
+        return total / lq_w
+
+    def ordering_key(self, lq_key: str, now: float) -> float:
+        return self.weighted_usage(lq_key, now)

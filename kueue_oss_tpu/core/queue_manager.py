@@ -55,6 +55,9 @@ class ClusterQueuePendingQueue:
         #: manager uses it to keep a dirty set so metric reporting is
         #: O(changed CQs), not O(all CQs))
         self._on_change = on_change or (lambda name: None)
+        #: admission-fair-sharing rank fn (info -> decayed LQ usage);
+        #: set by the manager for CQs with UsageBasedAdmissionFairSharing
+        self.afs_key = None
 
     def __len__(self) -> int:
         return len(self._heap) + len(self.inadmissible)
@@ -77,6 +80,16 @@ class ClusterQueuePendingQueue:
         self._on_change(self.name)
 
     def pop_head(self) -> Optional[WorkloadInfo]:
+        if self.afs_key is not None and self._in_heap:
+            # Admission fair sharing: the head is the entry whose
+            # LocalQueue has the lowest decayed usage (KEP-4136); the
+            # static heap order is the tie-break. O(n) scan — usage decays
+            # between cycles, so the rank can't be baked into the heap.
+            info = min(self._in_heap.values(),
+                       key=lambda i: (self.afs_key(i), _order_key(i)))
+            del self._in_heap[info.key]
+            self._on_change(self.name)
+            return info
         while self._heap:
             _, _, info = heapq.heappop(self._heap)
             if self._in_heap.get(info.key) is info:
@@ -143,12 +156,16 @@ class ClusterQueuePendingQueue:
 class QueueManager:
     """Reference parity: pkg/cache/queue/manager.go."""
 
-    def __init__(self, store: Store) -> None:
+    def __init__(self, store: Store, afs=None) -> None:
         self.store = store
         self.queues: dict[str, ClusterQueuePendingQueue] = {}
         self.cycle = 0
         #: CQs whose pending counts changed since the last drain
         self.dirty_cqs: set[str] = set()
+        #: optional AfsManager (admission fair sharing, KEP-4136)
+        self.afs = afs
+        #: wall-clock of the current scheduling cycle, used by AFS decay
+        self.current_time = 0.0
         for cq in store.cluster_queues.values():
             self.add_cluster_queue(cq.name)
         # Initial LIST: enqueue pending workloads already in the store
@@ -168,6 +185,14 @@ class QueueManager:
         q = self.queues[name]
         q.strategy = spec.queueing_strategy
         q.active = spec.stop_policy == StopPolicy.NONE
+        if (self.afs is not None and spec.admission_scope is not None
+                and spec.admission_scope.admission_mode
+                == "UsageBasedAdmissionFairSharing"):
+            q.afs_key = lambda info: self.afs.ordering_key(
+                f"{info.obj.namespace}/{info.obj.queue_name}",
+                self.current_time)
+        else:
+            q.afs_key = None
 
     def _on_event(self, event) -> None:
         verb, kind, obj = event
@@ -210,7 +235,9 @@ class QueueManager:
         if cq is None:
             return False
         if (not wl.active or wl.is_quota_reserved or wl.is_finished
-                or self._local_queue_stopped(wl)):
+                or wl.ca_parent or self._local_queue_stopped(wl)):
+            # A concurrent-admission parent never schedules directly; its
+            # variants do (concurrentadmission controller fan-out).
             self.queues[cq].delete(wl.key)
             return False
         rs = wl.status.requeue_state

@@ -134,11 +134,11 @@ class SolverEngine:
             info = WorkloadInfo(wl, cluster_queue=cq_name)
             if oracle_forest is not None:
                 node = oracle_forest.cqs[cq_name]
-                plan_usage = {
-                    (flavor, r): q
-                    for psr in info.total_requests
-                    for r, q in psr.requests.items()
-                }
+                plan_usage: dict[tuple[str, str], int] = {}
+                for psr in info.total_requests:
+                    for r, q in psr.requests.items():
+                        fr = (flavor, r)
+                        plan_usage[fr] = plan_usage.get(fr, 0) + q
                 if not node.fits(plan_usage):
                     # Verify-then-fallback (scheduler.go:427 fits re-check):
                     # a plan entry the oracle rejects is not committed — the

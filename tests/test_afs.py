@@ -1,0 +1,142 @@
+"""Admission fair sharing (KEP-4136) tests.
+
+Scenario shapes mirror the reference's admission-fair-sharing scheduler
+integration tests: within a CQ with UsageBasedAdmissionFairSharing scope,
+pending workloads from the LocalQueue with lower decayed historical usage
+are admitted first, regardless of FIFO order; usage decays with the
+configured half-life.
+"""
+
+import math
+
+from kueue_oss_tpu.api.types import (
+    AdmissionScope,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.config.configuration import AdmissionFairSharingConfig
+from kueue_oss_tpu.core.afs import AfsManager
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+class Env:
+    def __init__(self, nominal=1000, half_life=300.0):
+        self.store = Store()
+        self.store.upsert_resource_flavor(ResourceFlavor(name="default"))
+        self.store.upsert_cluster_queue(ClusterQueue(
+            name="cq",
+            admission_scope=AdmissionScope(),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources=[
+                    ResourceQuota(name="cpu", nominal=nominal)])])]))
+        for lq in ("lq-a", "lq-b"):
+            self.store.upsert_local_queue(
+                LocalQueue(name=lq, cluster_queue="cq"))
+        self.afs = AfsManager(AdmissionFairSharingConfig(
+            usage_half_life_time_seconds=half_life))
+        self.queues = QueueManager(self.store, afs=self.afs)
+        self.scheduler = Scheduler(self.store, self.queues)
+        self.t = 0.0
+
+    def submit(self, name, lq, cpu=1000):
+        self.t += 1.0
+        self.store.add_workload(Workload(
+            name=name, queue_name=lq, creation_time=self.t,
+            podsets=[PodSet(count=1, requests={"cpu": cpu})]))
+
+    def run_cycle(self):
+        self.t += 1.0
+        return self.scheduler.schedule(self.t)
+
+
+def admitted_order(env, n):
+    """Admit n workloads one at a time, finishing each so quota frees."""
+    order = []
+    for _ in range(n):
+        env.run_cycle()
+        newly = [w for w in env.store.workloads.values()
+                 if w.is_admitted and w.key not in order and not w.is_finished]
+        for w in newly:
+            order.append(w.key)
+            env.scheduler.finish_workload(w.key, env.t)
+    return order
+
+
+def test_decay_half_life():
+    afs = AfsManager(AdmissionFairSharingConfig(
+        usage_half_life_time_seconds=100.0))
+    afs.record_admission("default/lq", {"cpu": 1000}, now=0.0)
+    assert afs.weighted_usage("default/lq", 0.0) == 1000.0
+    assert math.isclose(afs.weighted_usage("default/lq", 100.0), 500.0)
+    assert math.isclose(afs.weighted_usage("default/lq", 200.0), 250.0)
+
+
+def test_resource_weights():
+    afs = AfsManager(AdmissionFairSharingConfig(
+        resource_weights={"cpu": 0.0, "gpu": 2.0}))
+    afs.record_admission("default/lq", {"cpu": 5000, "gpu": 4}, now=0.0)
+    assert afs.weighted_usage("default/lq", 0.0) == 8.0
+
+
+def test_lighter_local_queue_admitted_first():
+    """lq-a already used capacity; fresh lq-b submissions jump the line
+    even though lq-a's workloads are older (FIFO would pick them)."""
+    env = Env()
+    env.afs.record_admission("default/lq-a", {"cpu": 5000}, now=0.0)
+    env.submit("a1", "lq-a")
+    env.submit("a2", "lq-a")
+    env.submit("b1", "lq-b")
+    env.submit("b2", "lq-b")
+    order = admitted_order(env, 4)
+    assert order[0] == "default/b1"
+    # after b1 admits, lq-b carries its entry penalty (1000) but is still
+    # lighter than lq-a (5000 barely decayed): b2 goes next
+    assert order[1] == "default/b2"
+    assert set(order[2:]) == {"default/a1", "default/a2"}
+
+
+def test_entry_penalty_alternates_equal_queues():
+    """Equal starting usage: admissions alternate between LQs because each
+    admission penalizes its own LQ."""
+    env = Env()
+    for i in range(3):
+        env.submit(f"a{i}", "lq-a")
+    for i in range(3):
+        env.submit(f"b{i}", "lq-b")
+    order = admitted_order(env, 6)
+    lqs = [k.split("/")[1][0] for k in order]
+    # strict alternation a,b,a,b,... or b,a,b,a,...
+    assert all(lqs[i] != lqs[i + 1] for i in range(5)), lqs
+
+
+def test_usage_decays_back_to_fifo():
+    """With a tiny half-life, historical usage evaporates and FIFO order
+    reasserts itself."""
+    env = Env(half_life=0.001)
+    env.afs.record_admission("default/lq-b", {"cpu": 10_000}, now=0.0)
+    env.submit("a1", "lq-a")
+    env.submit("b1", "lq-b")
+    env.t += 10.0
+    order = admitted_order(env, 2)
+    assert order == ["default/a1", "default/b1"]
+
+
+def test_no_admission_scope_keeps_fifo():
+    env = Env()
+    cq = env.store.cluster_queues["cq"]
+    cq.admission_scope = None
+    env.store.upsert_cluster_queue(cq)
+    env.afs.record_admission("default/lq-a", {"cpu": 50_000}, now=0.0)
+    env.submit("a1", "lq-a")
+    env.submit("b1", "lq-b")
+    order = admitted_order(env, 2)
+    assert order == ["default/a1", "default/b1"], "FIFO without AFS scope"

@@ -1,0 +1,166 @@
+"""Concurrent admission variant tests (KEP-8691).
+
+Scenario shapes mirror the reference's concurrentadmission integration
+tests: a parent fans out per-flavor variants, the scheduler admits the
+most favorable that fits, less favorable variants are deactivated, and a
+freed better flavor triggers migration.
+"""
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.controllers import ConcurrentAdmissionReconciler
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+@pytest.fixture(autouse=True)
+def _gate():
+    features.set_gates({"ConcurrentAdmission": True})
+    yield
+    features.reset()
+
+
+class Env:
+    """Two flavors: 'fast' (preferred, small) and 'slow' (big)."""
+
+    def __init__(self, fast=2000, slow=100_000):
+        self.store = Store()
+        for f in ("fast", "slow"):
+            self.store.upsert_resource_flavor(ResourceFlavor(name=f))
+        self.store.upsert_cluster_queue(ClusterQueue(
+            name="cq", resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[
+                    FlavorQuotas(name="fast", resources=[
+                        ResourceQuota(name="cpu", nominal=fast)]),
+                    FlavorQuotas(name="slow", resources=[
+                        ResourceQuota(name="cpu", nominal=slow)]),
+                ])]))
+        self.store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        self.queues = QueueManager(self.store)
+        self.scheduler = Scheduler(self.store, self.queues)
+        self.ca = ConcurrentAdmissionReconciler(self.store, self.scheduler)
+        self.t = 0.0
+
+    def submit_parent(self, name="parent", cpu=1000):
+        self.t += 1.0
+        wl = Workload(name=name, queue_name="lq", ca_parent=True,
+                      creation_time=self.t,
+                      podsets=[PodSet(count=1, requests={"cpu": cpu})])
+        self.store.add_workload(wl)
+        return wl
+
+    def tick(self):
+        self.t += 1.0
+        self.ca.reconcile_all(self.t)
+        self.scheduler.schedule(self.t)
+        self.ca.reconcile_all(self.t)
+        return self.t
+
+
+def test_parent_fans_out_variants_and_best_flavor_wins():
+    env = Env()
+    parent = env.submit_parent(cpu=1000)
+    env.tick()
+    variants = {w.allowed_flavor: w for w in env.store.workloads.values()
+                if w.parent_workload == parent.key}
+    assert set(variants) == {"fast", "slow"}
+    fast, slow = variants["fast"], variants["slow"]
+    assert fast.is_admitted, "preferred flavor fits and must win"
+    assert fast.status.admission.podset_assignments[0].flavors["cpu"] == "fast"
+    # less favorable variant deactivated; parent mirrors the admission
+    assert not slow.active
+    assert not slow.is_quota_reserved
+    assert parent.is_admitted
+    assert parent.status.admission.podset_assignments[0].flavors["cpu"] == "fast"
+
+
+def test_fallback_to_less_favorable_flavor():
+    env = Env(fast=500)  # fast cannot hold the workload
+    parent = env.submit_parent(cpu=1000)
+    env.tick()
+    env.tick()
+    variants = {w.allowed_flavor: w for w in env.store.workloads.values()
+                if w.parent_workload == parent.key}
+    assert variants["slow"].is_admitted
+    assert not variants["fast"].is_admitted
+    # the more favorable variant stays active, racing for migration
+    assert variants["fast"].active
+    assert parent.is_admitted
+
+
+def test_migration_to_better_flavor_when_freed():
+    env = Env(fast=500)
+    parent = env.submit_parent(cpu=1000)
+    env.tick()
+    env.tick()
+    variants = {w.allowed_flavor: w for w in env.store.workloads.values()
+                if w.parent_workload == parent.key}
+    assert variants["slow"].is_admitted
+
+    # capacity opens on the preferred flavor
+    cq = env.store.cluster_queues["cq"]
+    cq.resource_groups[0].flavors[0].resources[0].nominal = 4000
+    env.store.upsert_cluster_queue(cq)
+    for _ in range(4):
+        env.tick()
+    assert variants["fast"].is_admitted, "must migrate up the flavor order"
+    slow = env.store.workloads[variants["slow"].key]
+    assert not slow.is_quota_reserved, "migrated-away variant releases quota"
+    assert slow.condition("Evicted") is not None
+
+
+def test_parent_not_scheduled_directly():
+    env = Env()
+    parent = env.submit_parent()
+    # without the CA reconciler the parent must not be admitted by the
+    # scheduler (it is not even queued)
+    env.scheduler.schedule(1.0)
+    assert not parent.is_quota_reserved
+
+
+def test_parent_finish_deactivates_variants():
+    env = Env()
+    parent = env.submit_parent()
+    env.tick()
+    env.scheduler.finish_workload(parent.key, env.t)
+    env.tick()
+    for v in (w for w in env.store.workloads.values()
+              if w.parent_workload == parent.key):
+        assert not v.active or v.is_finished or not v.is_quota_reserved
+
+
+def test_variant_eviction_propagates_to_parent():
+    """Regression: when the winning variant is evicted, the parent mirror
+    must lose its admission too (controller.go syncVariantEvictionStatus)."""
+    env = Env()
+    parent = env.submit_parent(cpu=1000)
+    env.tick()
+    assert parent.is_admitted
+    variants = {w.allowed_flavor: w for w in env.store.workloads.values()
+                if w.parent_workload == parent.key}
+    env.scheduler.evict_workload(
+        variants["fast"].key, reason="Preempted", message="test",
+        now=env.t, preemption_reason="InCohort")
+    env.ca.reconcile_all(env.t)
+    assert not parent.is_admitted
+    assert parent.is_evicted
+    assert parent.status.admission is None
+    # a variant gets re-admitted (slow first, then migration back to
+    # fast) → parent mirror restored on the preferred flavor
+    for _ in range(5):
+        env.tick()
+    assert parent.is_admitted
+    assert parent.status.admission.podset_assignments[0].flavors["cpu"] == "fast"
