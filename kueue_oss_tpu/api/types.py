@@ -425,6 +425,11 @@ class WorkloadStatus:
     #: unhealthy (reference: workload_types.go UnhealthyNodes, KEP TAS
     #: failed-node replacement)
     unhealthy_nodes: list[str] = field(default_factory=list)
+    #: MultiKueue dispatch (KEP-693): worker clusters nominated for this
+    #: workload, and the one that won the admission race
+    #: (workload_types.go:686-706 NominatedClusterNames / ClusterName)
+    nominated_cluster_names: list[str] = field(default_factory=list)
+    cluster_name: Optional[str] = None
 
 
 _uid_counter = itertools.count(1)
@@ -456,6 +461,10 @@ class Workload:
     ca_parent: bool = False
     parent_workload: Optional[str] = None
     allowed_flavor: Optional[str] = None
+    #: open preemption gates (KEP-8303 MultiKueue orchestrated preemption):
+    #: while non-empty, the scheduler must not issue preemptions for this
+    #: workload (workload_types.go:604,899-917; scheduler.go:411-416)
+    preemption_gates: list[str] = field(default_factory=list)
     status: WorkloadStatus = field(default_factory=WorkloadStatus)
 
     def __post_init__(self) -> None:

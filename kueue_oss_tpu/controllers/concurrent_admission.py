@@ -129,7 +129,7 @@ class ConcurrentAdmissionReconciler:
                     node_selector=dict(ps.node_selector),
                     tolerations=list(ps.tolerations),
                 ) for ps in parent.podsets],
-                creation_time=parent.creation_time or now,
+                creation_time=parent.creation_time,
                 parent_workload=parent.key,
                 allowed_flavor=flavor,
                 owner=parent.owner,
@@ -158,7 +158,9 @@ class ConcurrentAdmissionReconciler:
             reason="VariantEvicted", now=now)
         parent.status.admission = None
         self.store.update_workload(parent)
-        return False  # continue: variants may need re-activation
+        # Stop this pass (reference: "return to wait for parent to lose
+        # quota"); the next reconcile re-activates variants as needed.
+        return True
 
     def _deactivate_variant(self, v: Workload, reason: str, now: float,
                             message: str = "") -> None:
@@ -170,8 +172,9 @@ class ConcurrentAdmissionReconciler:
                 v.key, reason=reason, message=message or reason, now=now,
                 requeue=False)
         else:
+            # The store update event already removes the now-inactive
+            # variant from the pending queues.
             self.store.update_workload(v)
-            self.scheduler.queues.delete_workload(v)
 
     def _activate_variant(self, v: Workload, now: float) -> None:
         v.active = True

@@ -88,6 +88,12 @@ class ClusterQueuePendingQueue:
             info = min(self._in_heap.values(),
                        key=lambda i: (self.afs_key(i), _order_key(i)))
             del self._in_heap[info.key]
+            # The AFS path never pops _heap, so stale tuples would pile up
+            # forever; rebuild once they dominate (amortized O(1)).
+            if len(self._heap) > 2 * len(self._in_heap):
+                self._heap = [(k, c, i) for k, c, i in self._heap
+                              if self._in_heap.get(i.key) is i]
+                heapq.heapify(self._heap)
             self._on_change(self.name)
             return info
         while self._heap:
@@ -234,10 +240,15 @@ class QueueManager:
         cq = self._cq_for(wl)
         if cq is None:
             return False
+        from kueue_oss_tpu import features
+
+        # A concurrent-admission parent never schedules directly; its
+        # variants do (concurrentadmission controller fan-out). With the
+        # gate off the parent falls back to normal scheduling.
+        is_ca_parent = (wl.ca_parent
+                        and features.enabled("ConcurrentAdmission"))
         if (not wl.active or wl.is_quota_reserved or wl.is_finished
-                or wl.ca_parent or self._local_queue_stopped(wl)):
-            # A concurrent-admission parent never schedules directly; its
-            # variants do (concurrentadmission controller fan-out).
+                or is_ca_parent or self._local_queue_stopped(wl)):
             self.queues[cq].delete(wl.key)
             return False
         rs = wl.status.requeue_state

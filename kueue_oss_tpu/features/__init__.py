@@ -22,12 +22,15 @@ _DEFAULTS: dict[str, bool] = {
     # topology-aware scheduling
     "TopologyAwareScheduling": True,   # core/snapshot.py TAS snapshot build
     "TASFailedNodeReplacement": True,  # tas/snapshot.py replacement path
+    "TASFailedNodeReplacementFailFast": False,  # failure_recovery eviction
     # misc controllers
     "WaitForPodsReady": True,          # workload controller PodsReady path
     # elastic jobs (KEP-77; reference default off)
     "ElasticJobsViaWorkloadSlices": False,  # workloadslicing + scheduler hooks
     # concurrent admission variants (KEP-8691; reference default off)
     "ConcurrentAdmission": False,      # variant fan-out + migration hooks
+    # MultiKueue orchestrated preemption (KEP-8303)
+    "MultiKueueOrchestratedPreemption": False,  # scheduler gate check
 }
 
 _lock = threading.Lock()

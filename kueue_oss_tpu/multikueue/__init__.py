@@ -1,0 +1,41 @@
+"""MultiKueue multi-cluster dispatch (KEP-693).
+
+Reference parity: pkg/controller/admissionchecks/multikueue — a hub
+cluster mirrors quota-reserved workloads to worker clusters, races their
+admissions (first worker to admit wins, the rest are cleaned up), copies
+worker status back, and re-dispatches when a worker is lost past a
+timeout (multikueuecluster.go remote clients/watchers; workload.go
+mirroring). Dispatchers decide which workers to nominate: AllAtOnce or
+Incremental (up to 3 per round with a round timeout,
+workloaddispatcher/incrementaldispatcher.go:162).
+
+A "worker cluster" here is a full in-process environment (Store + queues
++ scheduler), matching the reference's multiple-envtest-control-planes
+test recipe (SURVEY.md §4).
+"""
+
+from kueue_oss_tpu.multikueue.cluster import (
+    MultiKueueCluster,
+    WorkerEnvironment,
+)
+from kueue_oss_tpu.multikueue.dispatcher import (
+    AllAtOnceDispatcher,
+    DISPATCHER_ALL_AT_ONCE,
+    DISPATCHER_INCREMENTAL,
+    IncrementalDispatcher,
+)
+from kueue_oss_tpu.multikueue.controller import (
+    MULTIKUEUE_CONTROLLER_NAME,
+    MultiKueueController,
+)
+
+__all__ = [
+    "MultiKueueCluster",
+    "WorkerEnvironment",
+    "AllAtOnceDispatcher",
+    "IncrementalDispatcher",
+    "DISPATCHER_ALL_AT_ONCE",
+    "DISPATCHER_INCREMENTAL",
+    "MULTIKUEUE_CONTROLLER_NAME",
+    "MultiKueueController",
+]

@@ -1,0 +1,45 @@
+"""Worker-cluster handles.
+
+Reference parity: pkg/controller/admissionchecks/multikueue/
+multikueuecluster.go — a remoteClient per worker built from kubeconfig
+Secrets, with an Active condition and reconnect handling. Here a worker is
+an in-process environment; `active` models connectivity and `last_seen`
+drives the worker-lost timeout (controllers.go:111).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+class WorkerEnvironment:
+    """A self-contained worker cluster: store + queues + scheduler."""
+
+    def __init__(self, name: str, store: Optional[Store] = None) -> None:
+        self.name = name
+        self.store = store or Store()
+        self.queues = QueueManager(self.store)
+        self.scheduler = Scheduler(self.store, self.queues)
+
+    def run_cycle(self, now: float):
+        """One worker scheduling cycle (the driver/test advances workers)."""
+        return self.scheduler.schedule(now)
+
+
+@dataclass
+class MultiKueueCluster:
+    """MultiKueueCluster CRD analog: names a worker and its connection."""
+
+    name: str
+    environment: WorkerEnvironment
+    #: connectivity (reference: cluster Active condition)
+    active: bool = True
+    last_seen: float = 0.0
+
+    def mark_seen(self, now: float) -> None:
+        self.last_seen = now

@@ -1,0 +1,182 @@
+"""The MultiKueue admission-check controller.
+
+Reference parity: pkg/controller/admissionchecks/multikueue/workload.go —
+for every hub workload whose CQ carries a MultiKueue admission check:
+mirror it to nominated workers, race remote admissions (first wins,
+losers are cleaned), flip the check Ready, copy worker status back on
+finish, and re-dispatch when the admitting worker is lost past
+workerLostTimeout (controllers.go:111).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_oss_tpu.api.types import (
+    CheckState,
+    PodSet,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.multikueue.cluster import MultiKueueCluster
+from kueue_oss_tpu.multikueue.dispatcher import AllAtOnceDispatcher
+
+MULTIKUEUE_CONTROLLER_NAME = "kueue.x-k8s.io/multikueue"
+
+#: prefix marking a mirrored workload on a worker (reference uses the
+#: kueue.x-k8s.io/multikueue-origin label)
+ORIGIN_LABEL = "multikueue-origin"
+
+
+class MultiKueueController:
+    def __init__(self, hub_store: Store, hub_scheduler,
+                 clusters: list[MultiKueueCluster],
+                 dispatcher=None,
+                 worker_lost_timeout_s: float = 900.0,
+                 check_name: str = "multikueue") -> None:
+        self.store = hub_store
+        self.scheduler = hub_scheduler
+        self.clusters = {c.name: c for c in clusters}
+        self.dispatcher = dispatcher or AllAtOnceDispatcher()
+        self.worker_lost_timeout_s = worker_lost_timeout_s
+        self.check_name = check_name
+
+    # -- main loop ----------------------------------------------------------
+
+    def reconcile_all(self, now: float) -> None:
+        for c in self.clusters.values():
+            if c.active:
+                c.mark_seen(now)
+        for wl in list(self.store.workloads.values()):
+            # Eviction clears the admission-check states, so a workload
+            # that still has remote state (nominations or a winner) must
+            # keep reconciling until its mirrors are withdrawn.
+            if (self.check_name in wl.status.admission_checks
+                    or wl.status.cluster_name is not None
+                    or wl.status.nominated_cluster_names):
+                self.reconcile(wl, now)
+
+    def reconcile(self, wl: Workload, now: float) -> None:
+        if (wl.is_finished or not wl.active
+                or not wl.is_quota_reserved):
+            # Finished, deactivated, or reservation lost on the hub:
+            # withdraw all mirrors and reset remote state.
+            self._cleanup_remotes(wl, keep=None)
+            wl.status.nominated_cluster_names = []
+            wl.status.cluster_name = None
+            return
+        state = wl.status.admission_checks.get(self.check_name)
+        if state is None:
+            return
+
+        winner = wl.status.cluster_name
+        if winner is not None:
+            self._sync_winner(wl, winner, state, now)
+            return
+
+        # Race phase: ensure mirrors exist on nominated workers.
+        active_names = [c.name for c in self.clusters.values() if c.active]
+        new = self.dispatcher.nominate(wl, active_names, now)
+        if new:
+            wl.status.nominated_cluster_names.extend(new)
+        for name in wl.status.nominated_cluster_names:
+            cluster = self.clusters.get(name)
+            if cluster is None or not cluster.active:
+                continue
+            self._ensure_mirror(wl, cluster)
+
+        # Did any worker admit its mirror?
+        for name in wl.status.nominated_cluster_names:
+            cluster = self.clusters.get(name)
+            if cluster is None or not cluster.active:
+                continue
+            mirror = cluster.environment.store.workloads.get(wl.key)
+            if mirror is not None and mirror.is_admitted:
+                wl.status.cluster_name = name
+                wl.status.nominated_cluster_names = []
+                state.state = CheckState.READY
+                state.message = f"The workload got reservation on \"{name}\""
+                self._cleanup_remotes(wl, keep=name)
+                if hasattr(self.dispatcher, "clear"):
+                    self.dispatcher.clear(wl.key)
+                self.store.update_workload(wl)
+                return
+
+    # -- winner tracking ----------------------------------------------------
+
+    def _sync_winner(self, wl: Workload, winner: str, state, now: float) -> None:
+        cluster = self.clusters.get(winner)
+        lost = (cluster is None or not cluster.active
+                and now - (cluster.last_seen if cluster else 0.0)
+                >= self.worker_lost_timeout_s)
+        if cluster is not None and not cluster.active:
+            lost = now - cluster.last_seen >= self.worker_lost_timeout_s
+        if lost:
+            # Worker lost past the timeout: redo the admission process
+            # (workload.go remote-lost handling).
+            wl.status.cluster_name = None
+            wl.status.nominated_cluster_names = []
+            state.state = CheckState.RETRY
+            state.message = f"Worker cluster \"{winner}\" is lost"
+            self.store.update_workload(wl)
+            return
+        if cluster is None or not cluster.active:
+            return  # transiently unreachable; wait for the timeout
+        mirror = cluster.environment.store.workloads.get(wl.key)
+        if mirror is None:
+            # Mirror vanished on the worker: retry admission.
+            wl.status.cluster_name = None
+            state.state = CheckState.RETRY
+            state.message = f"Mirror lost on worker \"{winner}\""
+            self.store.update_workload(wl)
+            return
+        if mirror.is_finished and not wl.is_finished:
+            # Copy terminal status back to the hub (workload.go status sync).
+            fin = mirror.condition(WorkloadConditionType.FINISHED)
+            wl.set_condition(WorkloadConditionType.FINISHED, True,
+                             reason=fin.reason if fin else "JobFinished",
+                             message=fin.message if fin else "", now=now)
+            self.store.update_workload(wl)
+            self.scheduler.queues.report_workload_finished(wl)
+            self._cleanup_remotes(wl, keep=None)
+
+    # -- mirroring ----------------------------------------------------------
+
+    def _ensure_mirror(self, wl: Workload,
+                       cluster: MultiKueueCluster) -> None:
+        wstore = cluster.environment.store
+        if wl.key in wstore.workloads:
+            return
+        mirror = Workload(
+            name=wl.name,
+            namespace=wl.namespace,
+            queue_name=wl.queue_name,
+            priority=wl.priority,
+            priority_class=None,  # priority already resolved on the hub
+            podsets=[PodSet(
+                name=ps.name, count=ps.count, requests=dict(ps.requests),
+                min_count=ps.min_count,
+                topology_request=ps.topology_request,
+                node_selector=dict(ps.node_selector),
+                tolerations=list(ps.tolerations),
+            ) for ps in wl.podsets],
+            creation_time=wl.creation_time,
+            owner=f"{ORIGIN_LABEL}/{wl.key}",
+        )
+        mirror.priority = wl.priority
+        wstore.add_workload(mirror)
+
+    def _cleanup_remotes(self, wl: Workload, keep: Optional[str]) -> None:
+        for name, cluster in self.clusters.items():
+            if name == keep or not cluster.active:
+                continue
+            wstore = cluster.environment.store
+            mirror = wstore.workloads.get(wl.key)
+            if mirror is None:
+                continue
+            cluster.environment.scheduler.evict_workload(
+                mirror.key, reason="MultiKueueCleanup",
+                message="another worker won the admission race",
+                now=cluster.last_seen, requeue=False)
+            wstore.delete_workload(mirror.key)

@@ -1,0 +1,52 @@
+"""Worker-nomination dispatchers.
+
+Reference parity: pkg/controller/workloaddispatcher — AllAtOnce nominates
+every active worker immediately; Incremental nominates up to 3 new
+workers per round and opens the next round after a timeout without
+admission (incrementaldispatcher.go:130-197).
+"""
+
+from __future__ import annotations
+
+from kueue_oss_tpu.api.types import Workload
+
+DISPATCHER_ALL_AT_ONCE = "AllAtOnce"
+DISPATCHER_INCREMENTAL = "Incremental"
+
+INCREMENTAL_WORKERS_PER_ROUND = 3
+INCREMENTAL_ROUND_TIMEOUT_S = 300.0
+
+
+class AllAtOnceDispatcher:
+    name = DISPATCHER_ALL_AT_ONCE
+
+    def nominate(self, wl: Workload, clusters: list[str],
+                 now: float) -> list[str]:
+        return [c for c in clusters if c not in wl.status.nominated_cluster_names]
+
+
+class IncrementalDispatcher:
+    name = DISPATCHER_INCREMENTAL
+
+    def __init__(self,
+                 per_round: int = INCREMENTAL_WORKERS_PER_ROUND,
+                 round_timeout_s: float = INCREMENTAL_ROUND_TIMEOUT_S) -> None:
+        self.per_round = per_round
+        self.round_timeout_s = round_timeout_s
+        self._round_start: dict[str, float] = {}
+
+    def nominate(self, wl: Workload, clusters: list[str],
+                 now: float) -> list[str]:
+        nominated = wl.status.nominated_cluster_names
+        remaining = [c for c in clusters if c not in nominated]
+        if not remaining:
+            return []
+        started = self._round_start.get(wl.key)
+        if nominated and started is not None:
+            if now - started < self.round_timeout_s:
+                return []  # current round still racing
+        self._round_start[wl.key] = now
+        return remaining[:self.per_round]
+
+    def clear(self, wl_key: str) -> None:
+        self._round_start.pop(wl_key, None)

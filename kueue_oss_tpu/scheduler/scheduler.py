@@ -379,6 +379,16 @@ class Scheduler:
             stats.skipped += 1
             return
 
+        if (mode == fa.PREEMPT
+                and features.enabled("MultiKueueOrchestratedPreemption")
+                and e.info.obj.preemption_gates):
+            # Orchestrated preemption (KEP-8303): a gated workload must not
+            # preempt until MultiKueue opens the gate (scheduler.go:411-416).
+            e.status = SKIPPED
+            e.inadmissible_msg = "Workload requires preemption, but it's gated"
+            stats.skipped += 1
+            return
+
         # One cohort-conflicting admission per cycle: skip overlapping targets.
         if any(t.info.key in preempted_workloads for t in e.preemption_targets):
             e.status = SKIPPED

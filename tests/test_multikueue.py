@@ -1,0 +1,222 @@
+"""MultiKueue multi-cluster dispatch tests.
+
+Recipe mirrors the reference's multi-envtest setup (SURVEY.md §4): a hub
+environment plus worker environments in one process. Scenario shapes
+follow test/integration/multikueue: admission race, loser cleanup, status
+copy-back, worker-lost re-dispatch, and the Incremental dispatcher.
+"""
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import (
+    AdmissionCheck,
+    CheckState,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    PreemptionPolicyValue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.controllers import WorkloadReconciler
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.multikueue import (
+    IncrementalDispatcher,
+    MULTIKUEUE_CONTROLLER_NAME,
+    MultiKueueCluster,
+    MultiKueueController,
+    WorkerEnvironment,
+)
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+def _setup_store(store, nominal):
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq",
+        admission_checks=["multikueue"] if store_is_hub(store) else [],
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=nominal)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+
+
+_HUBS = set()
+
+
+def store_is_hub(store):
+    return id(store) in _HUBS
+
+
+class MkEnv:
+    def __init__(self, worker_quotas=(8000, 8000), hub_quota=8000,
+                 dispatcher=None):
+        self.hub_store = Store()
+        _HUBS.add(id(self.hub_store))
+        _setup_store(self.hub_store, hub_quota)
+        self.hub_store.upsert_admission_check(AdmissionCheck(
+            name="multikueue", controller_name=MULTIKUEUE_CONTROLLER_NAME))
+        self.hub_queues = QueueManager(self.hub_store)
+        self.hub_scheduler = Scheduler(self.hub_store, self.hub_queues)
+        self.hub_wr = WorkloadReconciler(self.hub_store, self.hub_scheduler)
+
+        self.workers = []
+        for i, quota in enumerate(worker_quotas):
+            env = WorkerEnvironment(f"worker{i+1}")
+            _setup_store(env.store, quota)
+            self.workers.append(MultiKueueCluster(
+                name=env.name, environment=env))
+        self.mk = MultiKueueController(
+            self.hub_store, self.hub_scheduler, self.workers,
+            dispatcher=dispatcher, worker_lost_timeout_s=100.0)
+        self.t = 0.0
+
+    def submit(self, name="wl", cpu=1000):
+        self.t += 1.0
+        self.hub_store.add_workload(Workload(
+            name=name, queue_name="lq", creation_time=self.t,
+            podsets=[PodSet(count=1, requests={"cpu": cpu})]))
+
+    def tick(self, run_workers=True):
+        self.t += 1.0
+        self.hub_scheduler.schedule(self.t)
+        self.mk.reconcile_all(self.t)
+        if run_workers:
+            for w in self.workers:
+                if w.active:
+                    w.environment.run_cycle(self.t)
+        self.mk.reconcile_all(self.t)
+        self.hub_wr.reconcile_all(self.t)
+        return self.t
+
+    def wl(self, name="wl"):
+        return self.hub_store.workloads[f"default/{name}"]
+
+
+def test_race_first_worker_wins_and_losers_cleaned():
+    env = MkEnv()
+    env.submit()
+    env.tick()
+    wl = env.wl()
+    assert wl.status.cluster_name in ("worker1", "worker2")
+    winner = wl.status.cluster_name
+    assert wl.status.admission_checks["multikueue"].state == CheckState.READY
+    env.tick()
+    assert wl.is_admitted, "check Ready → hub workload admitted"
+    # the loser's mirror is gone
+    for w in env.workers:
+        mirror = w.environment.store.workloads.get(wl.key)
+        if w.name == winner:
+            assert mirror is not None and mirror.is_admitted
+        else:
+            assert mirror is None
+
+
+def test_worker_finish_copied_back_to_hub():
+    env = MkEnv()
+    env.submit()
+    env.tick()
+    env.tick()
+    wl = env.wl()
+    winner = env.mk.clusters[wl.status.cluster_name]
+    winner.environment.scheduler.finish_workload(wl.key, env.t)
+    env.tick()
+    assert wl.is_finished
+
+
+def test_worker_lost_triggers_retry_and_redispatch():
+    env = MkEnv()
+    env.submit()
+    env.tick()
+    env.tick()
+    wl = env.wl()
+    winner = env.mk.clusters[wl.status.cluster_name]
+    winner.active = False
+    lost_at = env.t
+    # within the timeout: still waiting
+    env.tick()
+    assert wl.status.cluster_name == winner.name
+    # past the timeout: retry → eviction → re-dispatch to the other worker
+    env.t = lost_at + 150.0
+    for _ in range(4):
+        env.tick()
+    assert wl.status.cluster_name is not None
+    assert wl.status.cluster_name != winner.name
+    assert wl.is_admitted
+
+
+def test_reservation_lost_on_hub_withdraws_mirrors():
+    env = MkEnv()
+    env.submit()
+    env.tick()
+    env.tick()
+    wl = env.wl()
+    env.hub_scheduler.evict_workload(
+        wl.key, reason="Preempted", message="hub preemption", now=env.t,
+        preemption_reason="InClusterQueue")
+    env.mk.reconcile_all(env.t)
+    for w in env.workers:
+        assert wl.key not in w.environment.store.workloads
+    assert wl.status.cluster_name is None
+
+
+def test_incremental_dispatcher_nominates_in_rounds():
+    disp = IncrementalDispatcher(per_round=1, round_timeout_s=50.0)
+    env = MkEnv(worker_quotas=(500, 8000), dispatcher=disp)  # w1 too small
+    env.submit()  # needs 1000 cpu
+    env.tick(run_workers=False)
+    wl = env.wl()
+    assert wl.status.nominated_cluster_names == ["worker1"]
+    # worker1 can't admit; before the round times out nothing new happens
+    env.tick()
+    assert wl.status.cluster_name is None
+    # round timeout passes → worker2 nominated and wins
+    env.t += 60.0
+    for _ in range(3):
+        env.tick()
+    assert wl.status.cluster_name == "worker2"
+
+
+def test_preemption_gate_blocks_preemption_until_opened():
+    features.set_gates({"MultiKueueOrchestratedPreemption": True})
+    try:
+        store = Store()
+        store.upsert_resource_flavor(ResourceFlavor(name="default"))
+        store.upsert_cluster_queue(ClusterQueue(
+            name="cq",
+            preemption=PreemptionPolicy(
+                within_cluster_queue=PreemptionPolicyValue.LOWER_PRIORITY),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources=[
+                    ResourceQuota(name="cpu", nominal=1000)])])]))
+        store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        store.add_workload(Workload(
+            name="low", queue_name="lq", priority=0, creation_time=1.0,
+            podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+        sched.schedule(2.0)
+        gated = Workload(
+            name="high", queue_name="lq", priority=10, creation_time=3.0,
+            preemption_gates=["kueue.x-k8s.io/multikueue-preemption"],
+            podsets=[PodSet(count=1, requests={"cpu": 1000})])
+        store.add_workload(gated)
+        for t in (4.0, 5.0):
+            sched.schedule(t)
+        assert not store.workloads["default/low"].is_evicted, \
+            "gated workload must not preempt"
+        gated.preemption_gates.clear()
+        for t in (6.0, 7.0, 8.0):
+            sched.schedule(t)
+        assert store.workloads["default/low"].is_evicted
+        assert store.workloads["default/high"].is_quota_reserved
+    finally:
+        features.reset()
