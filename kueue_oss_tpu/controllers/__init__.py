@@ -5,9 +5,13 @@ from kueue_oss_tpu.controllers.workload_controller import (
 from kueue_oss_tpu.controllers.concurrent_admission import (
     ConcurrentAdmissionReconciler,
 )
+from kueue_oss_tpu.controllers.failure_recovery import (
+    NodeFailureController,
+)
 
 __all__ = [
     "EvictionReason",
     "WorkloadReconciler",
     "ConcurrentAdmissionReconciler",
+    "NodeFailureController",
 ]

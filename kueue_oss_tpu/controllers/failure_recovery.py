@@ -1,0 +1,166 @@
+"""Node-failure detection and recovery.
+
+Reference parity: the TAS node watchers marking workload nodes unhealthy
+(pkg/controller/tas, gates TASFailedNodeReplacement*) plus
+pkg/controller/failurerecovery/pod_termination_controller.go:60-263 —
+pods stuck Terminating on NotReady/unreachable nodes are force-released
+after a grace period so the workload can reschedule.
+
+Flow: a node NotReady (or deleted) past the grace period is appended to
+the UnhealthyNodes of every admitted workload whose topology assignment
+uses it. With TASFailedNodeReplacement on, an in-place single-node
+replacement is attempted against a fresh snapshot (the second-pass
+analog, tas_flavor_snapshot.go:614-758); when replacement is impossible
+the workload is evicted — immediately under TASFailedNodeReplacementFailFast,
+otherwise after the recovery timeout — releasing its quota the way the
+reference's force-deletion releases stuck pods.
+"""
+
+from __future__ import annotations
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import Workload
+from kueue_oss_tpu.core.snapshot import build_snapshot
+from kueue_oss_tpu.core.store import Store
+
+
+class NodeFailureController:
+    def __init__(self, store: Store, scheduler,
+                 grace_period_s: float = 30.0,
+                 recovery_timeout_s: float = 300.0) -> None:
+        self.store = store
+        self.scheduler = scheduler
+        self.grace_period_s = grace_period_s
+        self.recovery_timeout_s = recovery_timeout_s
+        #: node name -> first time it was observed NotReady/missing
+        self._not_ready_since: dict[str, float] = {}
+        #: workload key -> time its node was declared unhealthy
+        self._unhealthy_since: dict[str, float] = {}
+
+    # -- node health tracking ----------------------------------------------
+
+    def _failed_nodes(self, now: float) -> set[str]:
+        """Nodes NotReady (or referenced by assignments but deleted) for
+        longer than the grace period."""
+        observed: set[str] = set()
+        for node in self.store.nodes.values():
+            if not node.ready:
+                observed.add(node.name)
+        for wl in self.store.admitted_workloads():
+            for name in self._assigned_nodes(wl):
+                if name not in self.store.nodes:
+                    observed.add(name)
+        for name in observed:
+            self._not_ready_since.setdefault(name, now)
+        for name in list(self._not_ready_since):
+            if name not in observed:
+                del self._not_ready_since[name]  # recovered
+        return {name for name, since in self._not_ready_since.items()
+                if now - since >= self.grace_period_s}
+
+    @staticmethod
+    def _assigned_nodes(wl: Workload) -> set[str]:
+        out: set[str] = set()
+        if wl.status.admission is None:
+            return out
+        for psa in wl.status.admission.podset_assignments:
+            ta = psa.topology_assignment
+            if ta is None:
+                continue
+            for dom in ta.domains:
+                if dom.values:
+                    out.add(dom.values[-1])  # host level is last
+        return out
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile(self, now: float) -> None:
+        failed = self._failed_nodes(now)
+        if not failed:
+            return
+        for wl in list(self.store.admitted_workloads()):
+            bad = self._assigned_nodes(wl) & failed
+            new = sorted(bad - set(wl.status.unhealthy_nodes))
+            if new:
+                wl.status.unhealthy_nodes.extend(new)
+                self._unhealthy_since.setdefault(wl.key, now)
+                self.store.update_workload(wl)
+            if not wl.status.unhealthy_nodes:
+                continue
+            self._try_recover(wl, now)
+
+    def _try_recover(self, wl: Workload, now: float) -> None:
+        replaced = False
+        if (features.enabled("TASFailedNodeReplacement")
+                and len(wl.status.unhealthy_nodes) == 1):
+            replaced = self._attempt_replacement(wl, now)
+        if replaced:
+            wl.status.unhealthy_nodes = []
+            self._unhealthy_since.pop(wl.key, None)
+            self.store.update_workload(wl)
+            return
+        fail_fast = features.enabled("TASFailedNodeReplacementFailFast")
+        waited = now - self._unhealthy_since.get(wl.key, now)
+        if fail_fast or waited >= self.recovery_timeout_s:
+            # Stuck on a dead node: release the workload so it can be
+            # rescheduled (failurerecovery force-delete analog).
+            self._unhealthy_since.pop(wl.key, None)
+            self.scheduler.evict_workload(
+                wl.key, reason="NodeFailures",
+                message=f"node(s) {wl.status.unhealthy_nodes} failed and "
+                        "no replacement was possible",
+                now=now, underlying_cause="NodeFailures")
+
+    # -- in-place replacement (second-pass analog) --------------------------
+
+    def _attempt_replacement(self, wl: Workload, now: float) -> bool:
+        cq_name = (wl.status.admission.cluster_queue
+                   if wl.status.admission is not None else None)
+        if cq_name is None:
+            return False
+        snapshot = build_snapshot(self.store)
+        cq = snapshot.cluster_queue(cq_name)
+        if cq is None:
+            return False
+        # Build placement requests from the recorded admission (the
+        # Assignment object only exists during scheduling cycles).
+        from kueue_oss_tpu.tas.snapshot import TASPodSetRequest
+
+        podsets = {ps.name: ps for ps in wl.podsets}
+        tas_requests: dict[str, list[TASPodSetRequest]] = {}
+        for psa in wl.status.admission.podset_assignments:
+            if psa.topology_assignment is None:
+                continue
+            ps = podsets.get(psa.name)
+            if ps is None:
+                continue
+            tas_flavor = next((f for f in psa.flavors.values()
+                               if f in cq.tas_flavors), None)
+            if tas_flavor is None:
+                continue
+            tas_requests.setdefault(tas_flavor, []).append(TASPodSetRequest(
+                podset=ps,
+                single_pod_requests=dict(ps.requests),
+                count=psa.count,
+                flavor=tas_flavor,
+                implied=ps.topology_request is None,
+                podset_group_name=(
+                    ps.topology_request.podset_group_name
+                    if ps.topology_request is not None else None),
+            ))
+        if not tas_requests:
+            return False
+        # Current usage (own included) stays charged: _replace_unhealthy
+        # re-places only the failed node's pods, and the surviving domains
+        # must keep occupying their capacity.
+        result = cq.find_topology_assignments_for_workload(
+            tas_requests, workload=wl)
+        by_name = {}
+        for ps_name, res in result.items():
+            if res.failure:
+                return False
+            by_name[ps_name] = res.assignment
+        for psa in wl.status.admission.podset_assignments:
+            if psa.topology_assignment is not None and psa.name in by_name:
+                psa.topology_assignment = by_name[psa.name]
+        return True

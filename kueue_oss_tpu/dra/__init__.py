@@ -1,0 +1,134 @@
+"""Dynamic Resource Allocation support (KEP-2941).
+
+Reference parity: pkg/dra — pods reference ResourceClaim(Template)s whose
+device requests name a DeviceClass; the configured deviceClassMappings
+translate device-class counts into *logical* resource names that flow
+through the ordinary quota math (mapper.go:32-74, claims.go:56-244). Only
+Exactly+ExactCount device requests are supported, like the reference's
+step-1 scope; unsupported shapes are rejected with field errors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu.api.types import PodSet, Workload
+
+
+class DRAError(ValueError):
+    pass
+
+
+ALLOCATION_EXACT_COUNT = "ExactCount"
+ALLOCATION_ALL = "All"
+
+
+@dataclass
+class DeviceRequest:
+    """resourcev1.DeviceRequest (Exactly form)."""
+
+    name: str
+    device_class: str
+    count: int = 1
+    allocation_mode: str = ALLOCATION_EXACT_COUNT
+    admin_access: bool = False
+    #: attribute equality selectors evaluated against DeviceSlice devices
+    #: (stand-in for the reference's CEL selectors)
+    selectors: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceClaimTemplate:
+    name: str
+    requests: list[DeviceRequest] = field(default_factory=list)
+
+
+@dataclass
+class DeviceSlice:
+    """resourcev1.ResourceSlice analog: devices published by a driver."""
+
+    device_class: str
+    count: int
+    attributes: dict[str, str] = field(default_factory=dict)
+
+
+def count_devices_per_class(claim: ResourceClaimTemplate) -> dict[str, int]:
+    """Device-class → count for one claim (claims.go countDevicesPerClass).
+
+    Raises DRAError on the shapes the reference rejects.
+    """
+    out: dict[str, int] = {}
+    for req in claim.requests:
+        if req.admin_access:
+            raise DRAError(f"claim {claim.name}/{req.name}: "
+                           "AdminAccess is not supported")
+        if req.allocation_mode == ALLOCATION_ALL:
+            raise DRAError(f"claim {claim.name}/{req.name}: "
+                           "AllocationMode 'All' is not supported")
+        if req.allocation_mode != ALLOCATION_EXACT_COUNT:
+            raise DRAError(f"claim {claim.name}/{req.name}: unsupported "
+                           f"allocation mode {req.allocation_mode!r}")
+        if not req.device_class:
+            continue
+        out[req.device_class] = out.get(req.device_class, 0) + req.count
+    return out
+
+
+def selector_matches(req: DeviceRequest, dev_slice: DeviceSlice) -> bool:
+    """Attribute-equality evaluation of a request against a slice
+    (claims.go CEL selector evaluation, restricted to equality)."""
+    if req.device_class != dev_slice.device_class:
+        return False
+    return all(dev_slice.attributes.get(k) == v
+               for k, v in req.selectors.items())
+
+
+def claim_satisfiable(claim: ResourceClaimTemplate,
+                      slices: list[DeviceSlice]) -> bool:
+    """Whether published ResourceSlices could satisfy the claim at all."""
+    for req in claim.requests:
+        available = sum(s.count for s in slices if selector_matches(req, s))
+        if available < req.count:
+            return False
+    return True
+
+
+class DeviceClassMapper:
+    """deviceClassMappings from the Configuration (mapper.go:32-74)."""
+
+    def __init__(self, mappings: dict[str, str]) -> None:
+        #: device class name -> logical resource name
+        self.mappings = dict(mappings)
+
+    def logical_resource(self, device_class: str) -> Optional[str]:
+        return self.mappings.get(device_class)
+
+    def resolve_claims(
+        self, claims: list[ResourceClaimTemplate]
+    ) -> dict[str, int]:
+        """Claims → logical resource requests; unmapped classes error the
+        way the reference marks the workload inadmissible."""
+        out: dict[str, int] = {}
+        for claim in claims:
+            for dc, count in count_devices_per_class(claim).items():
+                logical = self.logical_resource(dc)
+                if logical is None:
+                    raise DRAError(
+                        f"device class {dc!r} has no deviceClassMapping")
+                out[logical] = out.get(logical, 0) + count
+        return out
+
+    def apply_to_podset(self, ps: PodSet,
+                        claims: list[ResourceClaimTemplate]) -> None:
+        """Fold per-pod claim devices into the podset's requests."""
+        for resource, count in self.resolve_claims(claims).items():
+            ps.requests[resource] = ps.requests.get(resource, 0) + count
+
+    def apply_to_workload(self, wl: Workload,
+                          claims_by_podset: dict[str, list[ResourceClaimTemplate]]
+                          ) -> None:
+        for ps in wl.podsets:
+            claims = claims_by_podset.get(ps.name)
+            if claims:
+                self.apply_to_podset(ps, claims)

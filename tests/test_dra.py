@@ -1,0 +1,110 @@
+"""DRA (KEP-2941) tests: device-class counting, mapping to logical
+resources, selector evaluation, and admission through the quota path.
+
+Scenario shapes mirror pkg/dra/claims_test.go and the DRA integration
+tests.
+"""
+
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.dra import (
+    ALLOCATION_ALL,
+    DeviceClassMapper,
+    DeviceRequest,
+    DeviceSlice,
+    DRAError,
+    ResourceClaimTemplate,
+    claim_satisfiable,
+    count_devices_per_class,
+)
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+def test_count_devices_per_class():
+    claim = ResourceClaimTemplate(name="gpus", requests=[
+        DeviceRequest(name="a", device_class="gpu.nvidia.com", count=2),
+        DeviceRequest(name="b", device_class="gpu.nvidia.com", count=1),
+        DeviceRequest(name="c", device_class="tpu.google.com", count=4),
+    ])
+    assert count_devices_per_class(claim) == {
+        "gpu.nvidia.com": 3, "tpu.google.com": 4}
+
+
+def test_unsupported_shapes_rejected():
+    with pytest.raises(DRAError, match="AdminAccess"):
+        count_devices_per_class(ResourceClaimTemplate(name="c", requests=[
+            DeviceRequest(name="a", device_class="x", admin_access=True)]))
+    with pytest.raises(DRAError, match="'All'"):
+        count_devices_per_class(ResourceClaimTemplate(name="c", requests=[
+            DeviceRequest(name="a", device_class="x",
+                          allocation_mode=ALLOCATION_ALL)]))
+
+
+def test_mapper_resolves_to_logical_resources():
+    mapper = DeviceClassMapper({"gpu.nvidia.com": "gpus",
+                                "tpu.google.com": "tpus"})
+    claims = [ResourceClaimTemplate(name="c", requests=[
+        DeviceRequest(name="a", device_class="gpu.nvidia.com", count=2)])]
+    assert mapper.resolve_claims(claims) == {"gpus": 2}
+    with pytest.raises(DRAError, match="deviceClassMapping"):
+        mapper.resolve_claims([ResourceClaimTemplate(name="c", requests=[
+            DeviceRequest(name="a", device_class="unknown.dev", count=1)])])
+
+
+def test_selector_evaluation_against_slices():
+    claim = ResourceClaimTemplate(name="c", requests=[
+        DeviceRequest(name="a", device_class="gpu", count=4,
+                      selectors={"memory": "80Gi"})])
+    big = DeviceSlice(device_class="gpu", count=8,
+                      attributes={"memory": "80Gi"})
+    small = DeviceSlice(device_class="gpu", count=8,
+                        attributes={"memory": "40Gi"})
+    assert claim_satisfiable(claim, [big])
+    assert not claim_satisfiable(claim, [small])
+    assert claim_satisfiable(claim, [small, big])
+
+
+def test_dra_workload_admitted_through_quota_path():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu", "gpus"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=8000),
+                ResourceQuota(name="gpus", nominal=4)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+
+    mapper = DeviceClassMapper({"gpu.nvidia.com": "gpus"})
+    wl = Workload(name="train", queue_name="lq",
+                  podsets=[PodSet(count=2, requests={"cpu": 1000})])
+    mapper.apply_to_workload(wl, {"main": [
+        ResourceClaimTemplate(name="c", requests=[
+            DeviceRequest(name="a", device_class="gpu.nvidia.com", count=2)])]})
+    assert wl.podsets[0].requests == {"cpu": 1000, "gpus": 2}
+    store.add_workload(wl)
+    sched.schedule(1.0)
+    assert wl.is_admitted
+    psa = wl.status.admission.podset_assignments[0]
+    assert psa.resource_usage["gpus"] == 4  # 2 per pod x 2 pods
+
+    # a second identical workload exceeds the 4-gpu quota
+    wl2 = Workload(name="train2", queue_name="lq",
+                   podsets=[PodSet(count=2, requests={"cpu": 1000, "gpus": 2})])
+    store.add_workload(wl2)
+    sched.schedule(2.0)
+    assert not wl2.is_quota_reserved

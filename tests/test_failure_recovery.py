@@ -1,0 +1,171 @@
+"""Node-failure detection and recovery tests.
+
+Scenario shapes mirror the reference's TAS failed-node-replacement
+integration tests and failurerecovery/pod_termination_controller_test.go:
+a NotReady node past the grace period marks workloads unhealthy; a single
+failed node is replaced in place; impossible replacement evicts (fail-fast
+or after the recovery timeout) so the workload reschedules.
+"""
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    Node,
+    PodSet,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    Workload,
+)
+from kueue_oss_tpu.controllers import NodeFailureController
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+HOST = "kubernetes.io/hostname"
+BLOCK = "cloud/block"
+RACK = "cloud/rack"
+
+
+class Env:
+    def __init__(self, racks=2, hosts=2, cpu=4000, grace=30.0,
+                 recovery_timeout=300.0):
+        self.store = Store()
+        self.store.upsert_topology(Topology(name="default",
+                                            levels=[BLOCK, RACK, HOST]))
+        self.store.upsert_resource_flavor(ResourceFlavor(
+            name="tas-flavor", topology_name="default"))
+        for r in range(racks):
+            for h in range(hosts):
+                self.store.upsert_node(Node(
+                    name=f"n-{r}-{h}",
+                    labels={BLOCK: "b0", RACK: f"r{r}"},
+                    allocatable={"cpu": cpu}))
+        self.store.upsert_cluster_queue(ClusterQueue(
+            name="cq", resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="tas-flavor", resources=[
+                    ResourceQuota(name="cpu", nominal=racks * hosts * cpu)])])]))
+        self.store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        self.queues = QueueManager(self.store)
+        self.scheduler = Scheduler(self.store, self.queues)
+        self.nfc = NodeFailureController(
+            self.store, self.scheduler, grace_period_s=grace,
+            recovery_timeout_s=recovery_timeout)
+        self.t = 0.0
+
+    def submit_and_admit(self, name="wl", count=2, cpu=1000):
+        self.t += 1.0
+        wl = Workload(name=name, queue_name="lq", creation_time=self.t,
+                      podsets=[PodSet(
+                          name="main", count=count,
+                          requests={"cpu": cpu},
+                          topology_request=PodSetTopologyRequest(
+                              required=BLOCK))])
+        self.store.add_workload(wl)
+        self.scheduler.schedule(self.t)
+        assert wl.is_admitted
+        return wl
+
+    def assigned_hosts(self, wl):
+        ta = wl.status.admission.podset_assignments[0].topology_assignment
+        return {d.values[-1] for d in ta.domains}
+
+    def fail_node(self, name):
+        node = self.store.nodes[name]
+        node.ready = False
+        self.store.upsert_node(node)
+
+
+def test_grace_period_respected():
+    env = Env(grace=30.0)
+    wl = env.submit_and_admit()
+    victim = sorted(env.assigned_hosts(wl))[0]
+    env.fail_node(victim)
+    env.nfc.reconcile(env.t + 1)
+    assert wl.status.unhealthy_nodes == []
+    env.nfc.reconcile(env.t + 31)
+    # past the grace period the node is declared unhealthy; with the
+    # replacement gate on (default) a spare host absorbs the pods
+    assert victim not in env.assigned_hosts(wl)
+    assert wl.status.unhealthy_nodes == []
+    assert wl.is_admitted and not wl.is_evicted
+
+
+def test_single_node_replaced_in_place():
+    env = Env(racks=2, hosts=2)
+    wl = env.submit_and_admit(count=4, cpu=1000)
+    hosts_before = env.assigned_hosts(wl)
+    victim = sorted(hosts_before)[0]
+    env.fail_node(victim)
+    env.nfc.reconcile(env.t + 1)    # starts the NotReady clock
+    env.nfc.reconcile(env.t + 100)  # past grace: replace
+    hosts_after = env.assigned_hosts(wl)
+    assert victim not in hosts_after
+    total = sum(
+        d.count for d in
+        wl.status.admission.podset_assignments[0].topology_assignment.domains)
+    assert total == 4, "replacement keeps the full pod count"
+    assert wl.is_admitted
+
+
+def test_impossible_replacement_evicts_after_timeout():
+    # single rack, both hosts full: no spare capacity to replace onto
+    env = Env(racks=1, hosts=2, cpu=4000, recovery_timeout=300.0)
+    wl = env.submit_and_admit(count=8, cpu=1000)  # fills both hosts
+    victim = sorted(env.assigned_hosts(wl))[0]
+    env.fail_node(victim)
+    env.nfc.reconcile(env.t + 1)   # starts the NotReady clock
+    t_failed = env.t + 60
+    env.nfc.reconcile(t_failed)    # past grace: marked unhealthy
+    assert wl.status.unhealthy_nodes == [victim]
+    assert not wl.is_evicted, "waits for the recovery timeout"
+    env.nfc.reconcile(t_failed + 400)  # past recovery timeout
+    assert wl.is_evicted
+    assert not wl.is_quota_reserved
+
+
+def test_fail_fast_evicts_immediately():
+    features.set_gates({"TASFailedNodeReplacementFailFast": True,
+                        "TASFailedNodeReplacement": False})
+    try:
+        env = Env(racks=1, hosts=2)
+        wl = env.submit_and_admit(count=8, cpu=1000)
+        victim = sorted(env.assigned_hosts(wl))[0]
+        env.fail_node(victim)
+        env.nfc.reconcile(env.t + 1)   # starts the NotReady clock
+        env.nfc.reconcile(env.t + 60)  # past grace: fail-fast evicts
+        assert wl.is_evicted
+    finally:
+        features.reset()
+
+
+def test_deleted_node_counts_as_failed():
+    env = Env(racks=2, hosts=2)
+    wl = env.submit_and_admit(count=2, cpu=1000)
+    victim = sorted(env.assigned_hosts(wl))[0]
+    env.store.delete_node(victim)
+    env.nfc.reconcile(env.t + 10)   # starts the clock
+    env.nfc.reconcile(env.t + 100)  # past grace: replaced
+    assert victim not in env.assigned_hosts(wl)
+    assert wl.is_admitted
+
+
+def test_node_recovery_clears_tracking():
+    env = Env()
+    wl = env.submit_and_admit()
+    victim = sorted(env.assigned_hosts(wl))[0]
+    env.fail_node(victim)
+    env.nfc.reconcile(env.t + 1)
+    node = env.store.nodes[victim]
+    node.ready = True
+    env.store.upsert_node(node)
+    env.nfc.reconcile(env.t + 100)
+    assert wl.status.unhealthy_nodes == []
+    assert victim in env.assigned_hosts(wl), "no replacement after recovery"
