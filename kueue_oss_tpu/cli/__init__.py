@@ -1,0 +1,281 @@
+"""kueuectl-style CLI (KEP-2076).
+
+Reference parity: cmd/kueuectl — create/list/stop/resume/delete for
+ClusterQueues and LocalQueues, workload listing/stop, resource-flavor
+listing, version. Commands operate on a Store (the in-memory control
+plane) and return the rendered text, so the same functions serve tests,
+a REPL, or a thin __main__ wrapper.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+
+from kueue_oss_tpu import __version__ as _pkg_version
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceGroup,
+    ResourceQuota,
+    StopPolicy,
+)
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.webhooks import (
+    ValidationError,
+    admit_cluster_queue,
+    admit_local_queue,
+)
+
+
+class CliError(ValueError):
+    pass
+
+
+def _fmt_table(headers: list[str], rows: list[list[str]]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    out = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+class Kueuectl:
+    def __init__(self, store: Store) -> None:
+        self.store = store
+
+    # -- entry point --------------------------------------------------------
+
+    def run(self, argv: list[str]) -> str:
+        parser = self._build_parser()
+        try:
+            ns = parser.parse_args(argv)
+        except SystemExit as e:  # argparse error/help
+            raise CliError(f"invalid arguments: {argv}") from e
+        return ns.func(ns)
+
+    def _build_parser(self) -> argparse.ArgumentParser:
+        p = argparse.ArgumentParser(prog="kueuectl", exit_on_error=False)
+        sub = p.add_subparsers(required=True)
+
+        v = sub.add_parser("version")
+        v.set_defaults(func=lambda ns: f"kueuectl version {_pkg_version}")
+
+        create = sub.add_parser("create").add_subparsers(required=True)
+        ccq = create.add_parser("clusterqueue")
+        ccq.add_argument("name")
+        ccq.add_argument("--cohort", default=None)
+        ccq.add_argument("--nominal-quota", default="",
+                         help="flavor:resource=qty[,resource=qty...][;...]")
+        ccq.set_defaults(func=self._create_cq)
+        clq = create.add_parser("localqueue")
+        clq.add_argument("name")
+        clq.add_argument("-c", "--clusterqueue", required=True)
+        clq.add_argument("-n", "--namespace", default="default")
+        clq.set_defaults(func=self._create_lq)
+
+        lst = sub.add_parser("list").add_subparsers(required=True)
+        lst.add_parser("clusterqueue").set_defaults(func=self._list_cq)
+        llq = lst.add_parser("localqueue")
+        llq.add_argument("-n", "--namespace", default=None)
+        llq.set_defaults(func=self._list_lq)
+        lwl = lst.add_parser("workload")
+        lwl.add_argument("-n", "--namespace", default=None)
+        lwl.set_defaults(func=self._list_wl)
+        lst.add_parser("resourceflavor").set_defaults(func=self._list_rf)
+
+        for verb, policy in (("stop", StopPolicy.HOLD_AND_DRAIN),
+                             ("resume", StopPolicy.NONE)):
+            sp = sub.add_parser(verb).add_subparsers(required=True)
+            scq = sp.add_parser("clusterqueue")
+            scq.add_argument("name")
+            scq.add_argument("--keep-already-running", action="store_true")
+            scq.set_defaults(func=self._set_cq_stop_policy, policy=policy)
+            slq = sp.add_parser("localqueue")
+            slq.add_argument("name")
+            slq.add_argument("-n", "--namespace", default="default")
+            slq.add_argument("--keep-already-running", action="store_true")
+            slq.set_defaults(func=self._set_lq_stop_policy, policy=policy)
+            swl = sp.add_parser("workload")
+            swl.add_argument("name")
+            swl.add_argument("-n", "--namespace", default="default")
+            swl.set_defaults(func=self._set_wl_active,
+                             active=(verb == "resume"))
+
+        dele = sub.add_parser("delete").add_subparsers(required=True)
+        dcq = dele.add_parser("clusterqueue")
+        dcq.add_argument("name")
+        dcq.set_defaults(func=self._delete_cq)
+        dlq = dele.add_parser("localqueue")
+        dlq.add_argument("name")
+        dlq.add_argument("-n", "--namespace", default="default")
+        dlq.set_defaults(func=self._delete_lq)
+        dwl = dele.add_parser("workload")
+        dwl.add_argument("name")
+        dwl.add_argument("-n", "--namespace", default="default")
+        dwl.set_defaults(func=self._delete_wl)
+        return p
+
+    # -- create -------------------------------------------------------------
+
+    def _create_cq(self, ns) -> str:
+        if ns.name in self.store.cluster_queues:
+            raise CliError(f"clusterqueue {ns.name!r} already exists")
+        groups = []
+        if ns.nominal_quota:
+            for group in ns.nominal_quota.split(";"):
+                flavor, _, rest = group.partition(":")
+                quotas = []
+                for pair in rest.split(","):
+                    resource, _, qty = pair.partition("=")
+                    if not qty:
+                        raise CliError(f"bad --nominal-quota entry {pair!r}")
+                    quotas.append(ResourceQuota(name=resource,
+                                                nominal=int(qty)))
+                groups.append(ResourceGroup(
+                    covered_resources=[q.name for q in quotas],
+                    flavors=[FlavorQuotas(name=flavor, resources=quotas)]))
+        cq = ClusterQueue(name=ns.name, cohort=ns.cohort,
+                          resource_groups=groups)
+        try:
+            admit_cluster_queue(cq)
+        except ValidationError as e:
+            raise CliError(str(e)) from e
+        self.store.upsert_cluster_queue(cq)
+        return f"clusterqueue.kueue.x-k8s.io/{ns.name} created"
+
+    def _create_lq(self, ns) -> str:
+        key = f"{ns.namespace}/{ns.name}"
+        if key in self.store.local_queues:
+            raise CliError(f"localqueue {key!r} already exists")
+        if ns.clusterqueue not in self.store.cluster_queues:
+            raise CliError(f"clusterqueue {ns.clusterqueue!r} not found")
+        lq = LocalQueue(name=ns.name, namespace=ns.namespace,
+                        cluster_queue=ns.clusterqueue)
+        try:
+            admit_local_queue(lq)
+        except ValidationError as e:
+            raise CliError(str(e)) from e
+        self.store.upsert_local_queue(lq)
+        return f"localqueue.kueue.x-k8s.io/{ns.name} created in {ns.namespace}"
+
+    # -- list ---------------------------------------------------------------
+
+    def _list_cq(self, ns) -> str:
+        rows = []
+        for cq in sorted(self.store.cluster_queues.values(),
+                         key=lambda c: c.name):
+            pending = admitted = 0
+            for wl in self.store.workloads.values():
+                if self.store.cluster_queue_for(wl) != cq.name:
+                    continue
+                if wl.is_finished:
+                    continue
+                if wl.is_quota_reserved:
+                    admitted += 1
+                elif wl.active:
+                    pending += 1
+            rows.append([cq.name, cq.cohort or "", cq.queueing_strategy,
+                         str(pending), str(admitted),
+                         cq.stop_policy])
+        return _fmt_table(
+            ["NAME", "COHORT", "STRATEGY", "PENDING", "ADMITTED", "STOP"],
+            rows)
+
+    def _list_lq(self, ns) -> str:
+        rows = [[lq.namespace, lq.name, lq.cluster_queue, lq.stop_policy]
+                for lq in sorted(self.store.local_queues.values(),
+                                 key=lambda l: l.key)
+                if ns.namespace is None or lq.namespace == ns.namespace]
+        return _fmt_table(["NAMESPACE", "NAME", "CLUSTERQUEUE", "STOP"], rows)
+
+    def _list_wl(self, ns) -> str:
+        rows = []
+        for wl in sorted(self.store.workloads.values(), key=lambda w: w.key):
+            if ns.namespace is not None and wl.namespace != ns.namespace:
+                continue
+            if wl.is_finished:
+                status = "Finished"
+            elif wl.is_admitted:
+                status = "Admitted"
+            elif wl.is_quota_reserved:
+                status = "QuotaReserved"
+            elif not wl.active:
+                status = "Inactive"
+            else:
+                status = "Pending"
+            rows.append([wl.namespace, wl.name, wl.queue_name,
+                         str(wl.priority), status])
+        return _fmt_table(
+            ["NAMESPACE", "NAME", "LOCALQUEUE", "PRIORITY", "STATUS"], rows)
+
+    def _list_rf(self, ns) -> str:
+        rows = [[rf.name,
+                 ",".join(f"{k}={v}" for k, v in sorted(rf.node_labels.items())),
+                 rf.topology_name or ""]
+                for rf in sorted(self.store.resource_flavors.values(),
+                                 key=lambda r: r.name)]
+        return _fmt_table(["NAME", "NODELABELS", "TOPOLOGY"], rows)
+
+    # -- stop/resume --------------------------------------------------------
+
+    def _set_cq_stop_policy(self, ns) -> str:
+        cq = self.store.cluster_queues.get(ns.name)
+        if cq is None:
+            raise CliError(f"clusterqueue {ns.name!r} not found")
+        policy = ns.policy
+        if policy != StopPolicy.NONE and getattr(
+                ns, "keep_already_running", False):
+            policy = StopPolicy.HOLD
+        cq.stop_policy = policy
+        self.store.upsert_cluster_queue(cq)
+        verb = "resumed" if policy == StopPolicy.NONE else "stopped"
+        return f"clusterqueue.kueue.x-k8s.io/{ns.name} {verb}"
+
+    def _set_lq_stop_policy(self, ns) -> str:
+        lq = self.store.local_queues.get(f"{ns.namespace}/{ns.name}")
+        if lq is None:
+            raise CliError(f"localqueue {ns.name!r} not found")
+        policy = ns.policy
+        if policy != StopPolicy.NONE and getattr(
+                ns, "keep_already_running", False):
+            policy = StopPolicy.HOLD
+        lq.stop_policy = policy
+        self.store.upsert_local_queue(lq)
+        verb = "resumed" if policy == StopPolicy.NONE else "stopped"
+        return f"localqueue.kueue.x-k8s.io/{ns.name} {verb}"
+
+    def _set_wl_active(self, ns) -> str:
+        wl = self.store.workloads.get(f"{ns.namespace}/{ns.name}")
+        if wl is None:
+            raise CliError(f"workload {ns.name!r} not found")
+        wl.active = ns.active
+        self.store.update_workload(wl)
+        verb = "resumed" if ns.active else "stopped"
+        return f"workload.kueue.x-k8s.io/{ns.name} {verb}"
+
+    # -- delete -------------------------------------------------------------
+
+    def _delete_cq(self, ns) -> str:
+        if ns.name not in self.store.cluster_queues:
+            raise CliError(f"clusterqueue {ns.name!r} not found")
+        del self.store.cluster_queues[ns.name]
+        from kueue_oss_tpu import metrics
+
+        metrics.clear_cluster_queue_metrics(ns.name)
+        return f"clusterqueue.kueue.x-k8s.io/{ns.name} deleted"
+
+    def _delete_lq(self, ns) -> str:
+        key = f"{ns.namespace}/{ns.name}"
+        if key not in self.store.local_queues:
+            raise CliError(f"localqueue {ns.name!r} not found")
+        del self.store.local_queues[key]
+        return f"localqueue.kueue.x-k8s.io/{ns.name} deleted"
+
+    def _delete_wl(self, ns) -> str:
+        key = f"{ns.namespace}/{ns.name}"
+        if self.store.delete_workload(key) is None:
+            raise CliError(f"workload {ns.name!r} not found")
+        return f"workload.kueue.x-k8s.io/{ns.name} deleted"

@@ -76,17 +76,31 @@ class NodeFailureController:
 
     def reconcile(self, now: float) -> None:
         failed = self._failed_nodes(now)
-        if not failed:
-            return
         for wl in list(self.store.admitted_workloads()):
+            # Prune nodes that recovered (Ready again) from the unhealthy
+            # list before acting — a flapping node must not strand the
+            # workload in a permanently-unhealthy state.
+            if wl.status.unhealthy_nodes:
+                still_bad = [
+                    n for n in wl.status.unhealthy_nodes
+                    if n not in self.store.nodes
+                    or not self.store.nodes[n].ready]
+                if still_bad != wl.status.unhealthy_nodes:
+                    wl.status.unhealthy_nodes = still_bad
+                    self.store.update_workload(wl)
+                if not still_bad:
+                    self._unhealthy_since.pop(wl.key, None)
             bad = self._assigned_nodes(wl) & failed
             new = sorted(bad - set(wl.status.unhealthy_nodes))
             if new:
                 wl.status.unhealthy_nodes.extend(new)
-                self._unhealthy_since.setdefault(wl.key, now)
                 self.store.update_workload(wl)
             if not wl.status.unhealthy_nodes:
                 continue
+            # Anchor the recovery-timeout clock at first observation by
+            # this controller instance (covers pre-existing unhealthy
+            # state after a controller restart).
+            self._unhealthy_since.setdefault(wl.key, now)
             self._try_recover(wl, now)
 
     def _try_recover(self, wl: Workload, now: float) -> None:

@@ -1,0 +1,69 @@
+"""Cache/queue dumper.
+
+Reference parity: pkg/debugger/debugger.go:33-50 — SIGUSR2 dumps the
+scheduler cache and pending queues to the log; pkg/cache/queue/dumper.go
+formats the queue contents. The dump here is a plain dict/text so tests
+and operators can snapshot state without a debugger.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+from typing import Optional, TextIO
+
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+
+
+class Dumper:
+    def __init__(self, store: Store, queues: QueueManager) -> None:
+        self.store = store
+        self.queues = queues
+
+    def dump(self) -> dict:
+        """Structured snapshot of admitted usage + pending queues."""
+        admitted = {}
+        for wl in self.store.admitted_workloads():
+            adm = wl.status.admission
+            admitted.setdefault(adm.cluster_queue if adm else "?", []).append({
+                "workload": wl.key,
+                "priority": wl.priority,
+                "usage": {
+                    f"{psa.name}/{r}": q
+                    for psa in (adm.podset_assignments if adm else [])
+                    for r, q in psa.resource_usage.items()},
+            })
+        pending = {}
+        for name, q in self.queues.queues.items():
+            pending[name] = {
+                "active": [i.key for i in q.snapshot_order()],
+                "inadmissible": sorted(q.inadmissible),
+            }
+        return {
+            "cluster_queues": sorted(self.store.cluster_queues),
+            "cohorts": sorted(self.store.cohorts),
+            "admitted_workloads": admitted,
+            "pending_workloads": pending,
+        }
+
+    def dump_text(self, out: Optional[TextIO] = None) -> str:
+        out = out or sys.stderr
+        lines = ["=== kueue_oss_tpu dump ==="]
+        d = self.dump()
+        for cq in d["cluster_queues"]:
+            lines.append(f"ClusterQueue {cq}:")
+            for item in d["admitted_workloads"].get(cq, []):
+                lines.append(f"  admitted {item['workload']} "
+                             f"priority={item['priority']} "
+                             f"usage={item['usage']}")
+            p = d["pending_workloads"].get(cq, {})
+            lines.append(f"  pending active={p.get('active', [])} "
+                         f"inadmissible={p.get('inadmissible', [])}")
+        text = "\n".join(lines)
+        print(text, file=out)
+        return text
+
+    def listen_for_signal(self) -> None:
+        """Install the SIGUSR2 handler (debugger.go ListenForSignal)."""
+        signal.signal(signal.SIGUSR2, lambda *_: self.dump_text())

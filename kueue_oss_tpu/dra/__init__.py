@@ -86,10 +86,22 @@ def selector_matches(req: DeviceRequest, dev_slice: DeviceSlice) -> bool:
 
 def claim_satisfiable(claim: ResourceClaimTemplate,
                       slices: list[DeviceSlice]) -> bool:
-    """Whether published ResourceSlices could satisfy the claim at all."""
+    """Whether published ResourceSlices could satisfy the claim at all.
+
+    Requests draw from a shared pool: devices granted to one request are
+    not available to the next (greedy first-fit over the slices).
+    """
+    remaining = [s.count for s in slices]
     for req in claim.requests:
-        available = sum(s.count for s in slices if selector_matches(req, s))
-        if available < req.count:
+        need = req.count
+        for i, s in enumerate(slices):
+            if need <= 0:
+                break
+            if selector_matches(req, s) and remaining[i] > 0:
+                take = min(need, remaining[i])
+                remaining[i] -= take
+                need -= take
+        if need > 0:
             return False
     return True
 

@@ -1,0 +1,118 @@
+"""Batch importer for pre-existing pods.
+
+Reference parity: cmd/importer — two phases over running cluster pods
+that predate kueue: **check** validates each pod maps to a LocalQueue
+(by the queue label) whose ClusterQueue exists and covers the pod's
+requests; **import** creates an already-admitted Workload per pod so the
+quota books reflect reality (cmd/importer/README:1-25, pod/import.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu.api.types import (
+    Admission,
+    PodSet,
+    PodSetAssignment,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_oss_tpu.core.store import Store
+
+QUEUE_LABEL = "kueue.x-k8s.io/queue-name"
+
+
+@dataclass
+class ExistingPod:
+    """A running, un-managed pod found in the cluster."""
+
+    name: str
+    namespace: str = "default"
+    labels: dict[str, str] = field(default_factory=dict)
+    requests: dict[str, int] = field(default_factory=dict)
+    priority: int = 0
+
+
+@dataclass
+class ImportResult:
+    checked: int = 0
+    importable: int = 0
+    imported: int = 0
+    errors: list[str] = field(default_factory=list)
+
+
+class Importer:
+    def __init__(self, store: Store) -> None:
+        self.store = store
+
+    def _check_pod(self, pod: ExistingPod) -> tuple[Optional[str], Optional[str]]:
+        """Returns (cq_name, error)."""
+        queue = pod.labels.get(QUEUE_LABEL)
+        if not queue:
+            return None, f"pod {pod.namespace}/{pod.name}: no queue label"
+        lq = self.store.local_queues.get(f"{pod.namespace}/{queue}")
+        if lq is None:
+            return None, (f"pod {pod.namespace}/{pod.name}: "
+                          f"LocalQueue {queue!r} not found")
+        cq = self.store.cluster_queues.get(lq.cluster_queue)
+        if cq is None:
+            return None, (f"pod {pod.namespace}/{pod.name}: ClusterQueue "
+                          f"{lq.cluster_queue!r} not found")
+        covered = {r for rg in cq.resource_groups
+                   for r in rg.covered_resources}
+        missing = set(pod.requests) - covered
+        if missing:
+            return None, (f"pod {pod.namespace}/{pod.name}: resources "
+                          f"{sorted(missing)} not covered by "
+                          f"ClusterQueue {cq.name!r}")
+        return cq.name, None
+
+    def check(self, pods: list[ExistingPod]) -> ImportResult:
+        res = ImportResult()
+        for pod in pods:
+            res.checked += 1
+            _, err = self._check_pod(pod)
+            if err:
+                res.errors.append(err)
+            else:
+                res.importable += 1
+        return res
+
+    def run(self, pods: list[ExistingPod], now: float = 0.0) -> ImportResult:
+        """Check then import: each valid pod becomes an admitted Workload
+        charged against the first flavor that defines its resources."""
+        res = self.check(pods)
+        if res.errors:
+            return res  # all-or-nothing like the importer's check phase
+        for pod in pods:
+            cq_name, _ = self._check_pod(pod)
+            cq = self.store.cluster_queues[cq_name]
+            flavors: dict[str, str] = {}
+            for r in pod.requests:
+                for rg in cq.resource_groups:
+                    if r in rg.covered_resources and rg.flavors:
+                        flavors[r] = rg.flavors[0].name
+                        break
+            wl = Workload(
+                name=f"pod-{pod.name}",
+                namespace=pod.namespace,
+                queue_name=pod.labels[QUEUE_LABEL],
+                priority=pod.priority,
+                podsets=[PodSet(name="main", count=1,
+                                requests=dict(pod.requests))],
+                creation_time=now,
+            )
+            wl.status.admission = Admission(
+                cluster_queue=cq_name,
+                podset_assignments=[PodSetAssignment(
+                    name="main", flavors=flavors,
+                    resource_usage=dict(pod.requests), count=1)])
+            wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                             reason="Imported", now=now)
+            wl.set_condition(WorkloadConditionType.ADMITTED, True,
+                             reason="Imported", now=now)
+            self.store.add_workload(wl)
+            res.imported += 1
+        return res

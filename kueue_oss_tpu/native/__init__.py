@@ -1,0 +1,179 @@
+"""Native (C++) host-runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA; these are the *host-side* hot loops around
+it — currently the sequential quota-oracle verify used when committing
+solver plans (oracle.cpp). The library is compiled on first use with the
+system toolchain and cached next to the source; every entry point has a
+pure-Python fallback so the framework works without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from kueue_oss_tpu.api.types import FlavorResource
+from kueue_oss_tpu.core.quota import QuotaNode
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "oracle.cpp")
+_LIB = os.path.join(_DIR, "_oracle.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _compile() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The compiled library, building it if stale; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        stale = (not os.path.exists(_LIB)
+                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        if stale and not _compile():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.verify_plan.restype = ctypes.c_int64
+        lib.verify_plan.argtypes = [
+            ctypes.c_int32, ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ]
+        _lib = lib
+        return _lib
+
+
+class BatchOracle:
+    """Flattened quota forest for batch verify-and-charge.
+
+    Built once per drain from the oracle forest; `verify_and_apply`
+    checks a sequence of (cq_name, {FlavorResource: qty}) admissions in
+    order, charging the ones that fit — semantically identical to calling
+    QuotaNode.fits + add_usage per admission (the Python fallback does
+    exactly that), but in native code when available.
+    """
+
+    def __init__(self, cqs: dict[str, QuotaNode]) -> None:
+        # Collect every node reachable from the CQ leaves, parents-first.
+        roots = []
+        seen = set()
+        for node in cqs.values():
+            root = node.root()
+            if id(root) not in seen:
+                seen.add(id(root))
+                roots.append(root)
+        nodes: list[QuotaNode] = []
+        for root in roots:
+            stack = [root]
+            while stack:
+                n = stack.pop()
+                nodes.append(n)
+                stack.extend(n.children.values())
+        self._nodes = nodes
+        self._index = {id(n): i for i, n in enumerate(nodes)}
+        self._cq_node = {name: self._index[id(n)] for name, n in cqs.items()}
+        self._cqs = cqs
+
+        frs: set[FlavorResource] = set()
+        for n in nodes:
+            frs.update(n.quotas)
+            frs.update(n.subtree_quota)
+            frs.update(n.usage)
+        self._fr_list = sorted(frs)
+        self._fr_index = {fr: i for i, fr in enumerate(self._fr_list)}
+
+        N, F = len(nodes), max(1, len(self._fr_list))
+        self.F = F
+        self.parent = np.full(N, -1, dtype=np.int32)
+        self.local_quota = np.zeros((N, F), dtype=np.int64)
+        self.subtree = np.zeros((N, F), dtype=np.int64)
+        self.has_borrow = np.zeros((N, F), dtype=np.uint8)
+        self.borrow_limit = np.zeros((N, F), dtype=np.int64)
+        self.usage = np.zeros((N, F), dtype=np.int64)
+        for i, n in enumerate(nodes):
+            if n.parent is not None:
+                self.parent[i] = self._index[id(n.parent)]
+            for fr, q in n.quotas.items():
+                j = self._fr_index[fr]
+                if q.borrowing_limit is not None:
+                    self.has_borrow[i, j] = 1
+                    self.borrow_limit[i, j] = q.borrowing_limit
+            for fr, val in n.subtree_quota.items():
+                self.subtree[i, self._fr_index[fr]] = val
+            for fr, val in n.usage.items():
+                self.usage[i, self._fr_index[fr]] = val
+            for j, fr in enumerate(self._fr_list):
+                self.local_quota[i, j] = n.local_quota(fr)
+
+    def verify_and_apply(
+        self, admissions: list[tuple[str, dict[FlavorResource, int]]],
+        force_python: bool = False,
+    ) -> np.ndarray:
+        """ok[i] per admission; fitting admissions charge usage in order."""
+        ok = np.zeros(len(admissions), dtype=np.uint8)
+        lib = None if force_python else load()
+        if lib is None:
+            return self._python_verify(admissions, ok)
+        node_idx = np.zeros(len(admissions), dtype=np.int32)
+        ptr = np.zeros(len(admissions) + 1, dtype=np.int64)
+        fr_l: list[int] = []
+        qty_l: list[int] = []
+        for i, (cq_name, usage) in enumerate(admissions):
+            node_idx[i] = self._cq_node[cq_name]
+            for fr, q in usage.items():
+                fr_l.append(self._fr_index[fr])
+                qty_l.append(q)
+            ptr[i + 1] = len(fr_l)
+        lib.verify_plan(
+            np.int32(len(self._nodes)), np.int32(self.F),
+            self.parent, self.local_quota.ravel(), self.subtree.ravel(),
+            self.has_borrow.ravel(), self.borrow_limit.ravel(),
+            self.usage.ravel(),
+            np.int64(len(admissions)), node_idx, ptr,
+            np.asarray(fr_l, dtype=np.int32),
+            np.asarray(qty_l, dtype=np.int64), ok)
+        return ok
+
+    def _python_verify(self, admissions, ok: np.ndarray) -> np.ndarray:
+        for i, (cq_name, usage) in enumerate(admissions):
+            node = self._cqs[cq_name]
+            if node.fits(usage):
+                ok[i] = 1
+                for fr, q in usage.items():
+                    node.add_usage(fr, q)
+        return ok

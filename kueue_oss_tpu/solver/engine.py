@@ -113,15 +113,11 @@ class SolverEngine:
                     opt: np.ndarray, admit_round: np.ndarray,
                     parked: np.ndarray, now: float,
                     result: DrainResult, verify: bool = False) -> None:
-        # Optional safety net: replay the plan through the scalar quota
-        # oracle, checking each admission fits before it is committed
-        # (SURVEY.md §7 step 4 verify-then-assume pattern).
-        oracle_forest = None
-        if verify:
-            from kueue_oss_tpu.core.snapshot import build_snapshot
-            oracle_forest = build_snapshot(self.store).forest
-
+        # Collect the committed plan entries in admission order first, so
+        # the optional oracle verification can run as one batched native
+        # call (SURVEY.md §7 step 4 verify-then-assume pattern).
         order = np.argsort(admit_round[:-1], kind="stable")
+        candidates = []
         for w in order:
             if not admitted[w]:
                 continue
@@ -132,21 +128,34 @@ class SolverEngine:
             cq_name = problem.cq_names[problem.wl_cqid[w]]
             flavor = problem.cq_option_flavors[cq_name][opt[w]]
             info = WorkloadInfo(wl, cluster_queue=cq_name)
-            if oracle_forest is not None:
-                node = oracle_forest.cqs[cq_name]
-                plan_usage: dict[tuple[str, str], int] = {}
-                for psr in info.total_requests:
-                    for r, q in psr.requests.items():
-                        fr = (flavor, r)
-                        plan_usage[fr] = plan_usage.get(fr, 0) + q
-                if not node.fits(plan_usage):
-                    # Verify-then-fallback (scheduler.go:427 fits re-check):
-                    # a plan entry the oracle rejects is not committed — the
-                    # workload stays queued for the host scheduler path.
-                    metrics.solver_plan_fallbacks_total.inc()
-                    continue
-                for fr, q in plan_usage.items():
-                    node.add_usage(fr, q)
+            plan_usage: dict[tuple[str, str], int] = {}
+            for psr in info.total_requests:
+                for r, q in psr.requests.items():
+                    fr = (flavor, r)
+                    plan_usage[fr] = plan_usage.get(fr, 0) + q
+            candidates.append((wl, cq_name, flavor, info, plan_usage))
+
+        if verify and candidates:
+            # Verify-then-fallback (scheduler.go:427 fits re-check): plan
+            # entries the oracle rejects are not committed — those
+            # workloads stay queued for the host scheduler path. The
+            # sequential fits/add_usage walk runs in native code when the
+            # toolchain is available (kueue_oss_tpu/native/oracle.cpp).
+            from kueue_oss_tpu.core.snapshot import build_snapshot
+            from kueue_oss_tpu.native import BatchOracle
+
+            oracle = BatchOracle(build_snapshot(self.store).forest.cqs)
+            ok = oracle.verify_and_apply(
+                [(cq_name, usage)
+                 for _, cq_name, _, _, usage in candidates])
+        else:
+            ok = np.ones(len(candidates), dtype=np.uint8)
+
+        for passed, (wl, cq_name, flavor, info, _) in zip(ok, candidates):
+            if not passed:
+                metrics.solver_plan_fallbacks_total.inc()
+                continue
+            key = wl.key
             admission = Admission(
                 cluster_queue=cq_name,
                 podset_assignments=[

@@ -1,0 +1,130 @@
+"""Visibility API: on-demand pending-workload summaries.
+
+Reference parity: pkg/visibility (extension API server serving
+apis/visibility/v1beta2 PendingWorkloadsSummary straight from the queue
+manager, pkg/visibility/storage). Here the server surface is a plain
+object API plus an optional stdlib HTTP endpoint; positions are computed
+from the live heaps exactly like the reference's snapshot-order walk.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict, dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kueue_oss_tpu.core.queue_manager import QueueManager
+
+
+@dataclass
+class PendingWorkload:
+    """apis/visibility/v1beta2/types.go:66-80."""
+
+    name: str
+    namespace: str
+    priority: int
+    local_queue_name: str
+    position_in_cluster_queue: int
+    position_in_local_queue: int
+
+
+@dataclass
+class PendingWorkloadsSummary:
+    items: list[PendingWorkload] = field(default_factory=list)
+
+
+class VisibilityService:
+    def __init__(self, queues: QueueManager) -> None:
+        self.queues = queues
+
+    def pending_workloads_in_cq(
+        self, cq_name: str, limit: Optional[int] = None, offset: int = 0
+    ) -> PendingWorkloadsSummary:
+        """Pending workloads of a ClusterQueue in admission order
+        (active heap order first, then parked inadmissible)."""
+        q = self.queues.queues.get(cq_name)
+        if q is None:
+            return PendingWorkloadsSummary()
+        lq_positions: dict[str, int] = {}
+        items: list[PendingWorkload] = []
+        ordered = q.snapshot_order() + sorted(
+            q.inadmissible.values(), key=lambda i: i.key)
+        for pos, info in enumerate(ordered):
+            wl = info.obj
+            lq_pos = lq_positions.get(wl.queue_name, 0)
+            lq_positions[wl.queue_name] = lq_pos + 1
+            items.append(PendingWorkload(
+                name=wl.name, namespace=wl.namespace,
+                priority=wl.priority,
+                local_queue_name=wl.queue_name,
+                position_in_cluster_queue=pos,
+                position_in_local_queue=lq_pos,
+            ))
+        end = None if limit is None else offset + limit
+        return PendingWorkloadsSummary(items=items[offset:end])
+
+    def pending_workloads_in_lq(
+        self, namespace: str, lq_name: str,
+        limit: Optional[int] = None, offset: int = 0
+    ) -> PendingWorkloadsSummary:
+        cq_name = None
+        lq = self.queues.store.local_queues.get(f"{namespace}/{lq_name}")
+        if lq is not None:
+            cq_name = lq.cluster_queue
+        if cq_name is None:
+            return PendingWorkloadsSummary()
+        all_cq = self.pending_workloads_in_cq(cq_name)
+        items = [i for i in all_cq.items
+                 if i.local_queue_name == lq_name and i.namespace == namespace]
+        end = None if limit is None else offset + limit
+        return PendingWorkloadsSummary(items=items[offset:end])
+
+
+class VisibilityServer:
+    """Optional stdlib HTTP wrapper:
+    GET /apis/visibility/v1beta2/clusterqueues/<cq>/pendingworkloads
+    GET /apis/visibility/v1beta2/namespaces/<ns>/localqueues/<lq>/pendingworkloads
+    """
+
+    def __init__(self, service: VisibilityService, port: int = 0) -> None:
+        svc = service
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self) -> None:
+                parts = [p for p in self.path.split("/") if p]
+                summary = None
+                if (len(parts) >= 5 and parts[3] == "clusterqueues"
+                        and parts[-1] == "pendingworkloads"):
+                    summary = svc.pending_workloads_in_cq(parts[4])
+                elif (len(parts) >= 7 and parts[3] == "namespaces"
+                        and parts[5] == "localqueues"
+                        and parts[-1] == "pendingworkloads"):
+                    summary = svc.pending_workloads_in_lq(parts[4], parts[6])
+                if summary is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(asdict(summary)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -108,3 +108,16 @@ def test_dra_workload_admitted_through_quota_path():
     store.add_workload(wl2)
     sched.schedule(2.0)
     assert not wl2.is_quota_reserved
+
+
+def test_claim_requests_share_slice_pool():
+    """Regression: two requests drawing from the same slices must not
+    double-count availability."""
+    claim = ResourceClaimTemplate(name="c", requests=[
+        DeviceRequest(name="a", device_class="gpu", count=3),
+        DeviceRequest(name="b", device_class="gpu", count=3),
+    ])
+    one = DeviceSlice(device_class="gpu", count=4)
+    assert not claim_satisfiable(claim, [one])
+    assert claim_satisfiable(claim, [one, DeviceSlice(device_class="gpu",
+                                                      count=2)])

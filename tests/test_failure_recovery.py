@@ -169,3 +169,47 @@ def test_node_recovery_clears_tracking():
     env.nfc.reconcile(env.t + 100)
     assert wl.status.unhealthy_nodes == []
     assert victim in env.assigned_hosts(wl), "no replacement after recovery"
+
+
+def test_flapping_node_pruned_from_unhealthy_list():
+    """Regression: a node that recovers must leave unhealthy_nodes so a
+    later unrelated failure doesn't mis-handle the workload."""
+    features.set_gates({"TASFailedNodeReplacement": False})
+    try:
+        env = Env(racks=1, hosts=2, recovery_timeout=300.0)
+        wl = env.submit_and_admit(count=8, cpu=1000)
+        victim = sorted(env.assigned_hosts(wl))[0]
+        env.fail_node(victim)
+        env.nfc.reconcile(env.t + 1)
+        env.nfc.reconcile(env.t + 60)
+        assert wl.status.unhealthy_nodes == [victim]
+        node = env.store.nodes[victim]
+        node.ready = True
+        env.store.upsert_node(node)
+        env.nfc.reconcile(env.t + 120)
+        assert wl.status.unhealthy_nodes == []
+        env.nfc.reconcile(env.t + 1000)
+        assert not wl.is_evicted, "recovered node must not cause eviction"
+    finally:
+        features.reset()
+
+
+def test_preexisting_unhealthy_state_times_out_after_restart():
+    """Regression: a restarted controller must still evict a workload whose
+    unhealthy_nodes pre-date it, once the recovery timeout elapses."""
+    features.set_gates({"TASFailedNodeReplacement": False})
+    try:
+        env = Env(racks=1, hosts=2, recovery_timeout=100.0)
+        wl = env.submit_and_admit(count=8, cpu=1000)
+        victim = sorted(env.assigned_hosts(wl))[0]
+        env.fail_node(victim)
+        wl.status.unhealthy_nodes = [victim]  # state from a prior instance
+        fresh = NodeFailureController(env.store, env.scheduler,
+                                      grace_period_s=30.0,
+                                      recovery_timeout_s=100.0)
+        fresh.reconcile(1000.0)   # first observation anchors the clock
+        assert not wl.is_evicted
+        fresh.reconcile(1150.0)   # past the recovery timeout
+        assert wl.is_evicted
+    finally:
+        features.reset()

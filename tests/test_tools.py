@@ -1,0 +1,205 @@
+"""Visibility API, debugger dump, kueuectl CLI, and importer tests.
+
+Scenario shapes mirror pkg/visibility tests, pkg/debugger, the kueuectl
+command tests (cmd/kueuectl), and cmd/importer's check/import phases.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.cli import CliError, Kueuectl
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.debugger import Dumper
+from kueue_oss_tpu.importer import QUEUE_LABEL, ExistingPod, Importer
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.visibility import VisibilityServer, VisibilityService
+
+
+def make_env(nominal=2000):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=nominal)])])]))
+    for lq in ("lq-a", "lq-b"):
+        store.upsert_local_queue(LocalQueue(name=lq, cluster_queue="cq"))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    return store, queues, sched
+
+
+def submit(store, name, lq, cpu=1000, priority=0, t=0.0):
+    store.add_workload(Workload(
+        name=name, queue_name=lq, priority=priority, creation_time=t,
+        podsets=[PodSet(count=1, requests={"cpu": cpu})]))
+
+
+# -- visibility --------------------------------------------------------------
+
+
+def test_pending_workloads_positions():
+    store, queues, sched = make_env(nominal=1000)
+    submit(store, "w1", "lq-a", t=1.0)
+    submit(store, "w2", "lq-a", t=2.0)
+    submit(store, "w3", "lq-b", t=3.0, priority=5)  # admitted (priority)
+    submit(store, "w4", "lq-a", t=4.0)
+    sched.schedule(5.0)
+    svc = VisibilityService(queues)
+    summary = svc.pending_workloads_in_cq("cq")
+    names = [i.name for i in summary.items]
+    assert names == ["w1", "w2", "w4"], "FIFO among equal priorities"
+    w4 = next(i for i in summary.items if i.name == "w4")
+    assert w4.local_queue_name == "lq-a"
+    assert w4.position_in_local_queue == 2
+    assert w4.position_in_cluster_queue == 2
+
+    lq_summary = svc.pending_workloads_in_lq("default", "lq-a")
+    assert [i.name for i in lq_summary.items] == ["w1", "w2", "w4"]
+
+
+def test_visibility_http_server():
+    store, queues, sched = make_env(nominal=0)
+    submit(store, "w1", "lq-a")
+    sched.schedule(1.0)
+    srv = VisibilityServer(VisibilityService(queues))
+    srv.start()
+    try:
+        url = (f"http://127.0.0.1:{srv.port}/apis/visibility/v1beta2/"
+               f"clusterqueues/cq/pendingworkloads")
+        data = json.loads(urllib.request.urlopen(url, timeout=5).read())
+        assert [i["name"] for i in data["items"]] == ["w1"]
+        url2 = (f"http://127.0.0.1:{srv.port}/apis/visibility/v1beta2/"
+                f"namespaces/default/localqueues/lq-a/pendingworkloads")
+        data2 = json.loads(urllib.request.urlopen(url2, timeout=5).read())
+        assert len(data2["items"]) == 1
+    finally:
+        srv.stop()
+
+
+# -- debugger ----------------------------------------------------------------
+
+
+def test_dumper_snapshot():
+    store, queues, sched = make_env(nominal=1000)
+    submit(store, "running", "lq-a", t=1.0)
+    submit(store, "waiting", "lq-b", t=2.0)
+    sched.schedule(3.0)
+    d = Dumper(store, queues).dump()
+    assert d["cluster_queues"] == ["cq"]
+    assert [w["workload"] for w in d["admitted_workloads"]["cq"]] == [
+        "default/running"]
+    pend = d["pending_workloads"]["cq"]
+    assert pend["active"] == ["default/waiting"] or \
+        pend["inadmissible"] == ["default/waiting"]
+    text = Dumper(store, queues).dump_text(out=open("/dev/null", "w"))
+    assert "ClusterQueue cq" in text
+
+
+# -- kueuectl ----------------------------------------------------------------
+
+
+def test_cli_create_list_stop_resume_delete():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    ctl = Kueuectl(store)
+    out = ctl.run(["create", "clusterqueue", "team-a",
+                   "--nominal-quota", "default:cpu=4000"])
+    assert "created" in out
+    assert store.cluster_queues["team-a"].quota_for(
+        ("default", "cpu")).nominal == 4000
+    ctl.run(["create", "localqueue", "lq", "-c", "team-a"])
+    assert "default/lq" in store.local_queues
+
+    submit(store, "w1", "lq")
+    listing = ctl.run(["list", "workload"])
+    assert "w1" in listing and "Pending" in listing
+    listing = ctl.run(["list", "clusterqueue"])
+    assert "team-a" in listing
+
+    assert "stopped" in ctl.run(["stop", "clusterqueue", "team-a"])
+    assert store.cluster_queues["team-a"].stop_policy == "HoldAndDrain"
+    assert "resumed" in ctl.run(["resume", "clusterqueue", "team-a"])
+    assert store.cluster_queues["team-a"].stop_policy == "None"
+
+    assert "stopped" in ctl.run(["stop", "workload", "w1"])
+    assert not store.workloads["default/w1"].active
+    assert "resumed" in ctl.run(["resume", "workload", "w1"])
+
+    assert "deleted" in ctl.run(["delete", "workload", "w1"])
+    assert "deleted" in ctl.run(["delete", "localqueue", "lq"])
+    assert "deleted" in ctl.run(["delete", "clusterqueue", "team-a"])
+    assert store.cluster_queues == {}
+
+
+def test_cli_errors():
+    store = Store()
+    ctl = Kueuectl(store)
+    with pytest.raises(CliError):
+        ctl.run(["create", "localqueue", "lq", "-c", "missing"])
+    with pytest.raises(CliError):
+        ctl.run(["delete", "clusterqueue", "nope"])
+    with pytest.raises(CliError):
+        ctl.run(["create", "clusterqueue", "Bad_Name"])
+    assert "version" in ctl.run(["version"])
+
+
+def test_cli_stop_keep_already_running_maps_to_hold():
+    store = Store()
+    store.upsert_cluster_queue(ClusterQueue(name="cq"))
+    ctl = Kueuectl(store)
+    ctl.run(["stop", "clusterqueue", "cq", "--keep-already-running"])
+    assert store.cluster_queues["cq"].stop_policy == "Hold"
+
+
+# -- importer ----------------------------------------------------------------
+
+
+def test_importer_check_and_import():
+    store, queues, sched = make_env(nominal=4000)
+    pods = [
+        ExistingPod(name="p1", labels={QUEUE_LABEL: "lq-a"},
+                    requests={"cpu": 1000}),
+        ExistingPod(name="p2", labels={QUEUE_LABEL: "lq-b"},
+                    requests={"cpu": 500}, priority=3),
+    ]
+    imp = Importer(store)
+    res = imp.run(pods, now=1.0)
+    assert res.imported == 2 and not res.errors
+    wl = store.workloads["default/pod-p1"]
+    assert wl.is_admitted
+    assert wl.status.admission.cluster_queue == "cq"
+    # imported usage is charged: only 2500 of 4000 left
+    submit(store, "newcomer", "lq-a", cpu=3000)
+    sched.schedule(2.0)
+    assert not store.workloads["default/newcomer"].is_quota_reserved
+
+
+def test_importer_rejects_unmapped_pods():
+    store, *_ = make_env()
+    imp = Importer(store)
+    res = imp.run([
+        ExistingPod(name="ok", labels={QUEUE_LABEL: "lq-a"},
+                    requests={"cpu": 100}),
+        ExistingPod(name="orphan", labels={}, requests={"cpu": 100}),
+        ExistingPod(name="badq", labels={QUEUE_LABEL: "ghost"},
+                    requests={"cpu": 100}),
+        ExistingPod(name="badres", labels={QUEUE_LABEL: "lq-a"},
+                    requests={"tpu": 4}),
+    ])
+    assert res.imported == 0, "check phase failures abort the import"
+    assert len(res.errors) == 3
