@@ -52,7 +52,9 @@ class Kueuectl:
         parser = self._build_parser()
         try:
             ns = parser.parse_args(argv)
-        except SystemExit as e:  # argparse error/help
+        except (SystemExit, argparse.ArgumentError) as e:
+            # exit_on_error=False raises ArgumentError for bad flags;
+            # SystemExit still fires for --help and subparser errors.
             raise CliError(f"invalid arguments: {argv}") from e
         return ns.func(ns)
 
@@ -192,22 +194,14 @@ class Kueuectl:
         return _fmt_table(["NAMESPACE", "NAME", "CLUSTERQUEUE", "STOP"], rows)
 
     def _list_wl(self, ns) -> str:
+        from kueue_oss_tpu.core.workload_info import workload_status
+
         rows = []
         for wl in sorted(self.store.workloads.values(), key=lambda w: w.key):
             if ns.namespace is not None and wl.namespace != ns.namespace:
                 continue
-            if wl.is_finished:
-                status = "Finished"
-            elif wl.is_admitted:
-                status = "Admitted"
-            elif wl.is_quota_reserved:
-                status = "QuotaReserved"
-            elif not wl.active:
-                status = "Inactive"
-            else:
-                status = "Pending"
             rows.append([wl.namespace, wl.name, wl.queue_name,
-                         str(wl.priority), status])
+                         str(wl.priority), workload_status(wl)])
         return _fmt_table(
             ["NAMESPACE", "NAME", "LOCALQUEUE", "PRIORITY", "STATUS"], rows)
 
@@ -259,9 +253,8 @@ class Kueuectl:
     # -- delete -------------------------------------------------------------
 
     def _delete_cq(self, ns) -> str:
-        if ns.name not in self.store.cluster_queues:
+        if self.store.delete_cluster_queue(ns.name) is None:
             raise CliError(f"clusterqueue {ns.name!r} not found")
-        del self.store.cluster_queues[ns.name]
         from kueue_oss_tpu import metrics
 
         metrics.clear_cluster_queue_metrics(ns.name)
@@ -269,9 +262,8 @@ class Kueuectl:
 
     def _delete_lq(self, ns) -> str:
         key = f"{ns.namespace}/{ns.name}"
-        if key not in self.store.local_queues:
+        if self.store.delete_local_queue(key) is None:
             raise CliError(f"localqueue {ns.name!r} not found")
-        del self.store.local_queues[key]
         return f"localqueue.kueue.x-k8s.io/{ns.name} deleted"
 
     def _delete_wl(self, ns) -> str:

@@ -136,32 +136,9 @@ class NodeFailureController:
         cq = snapshot.cluster_queue(cq_name)
         if cq is None:
             return False
-        # Build placement requests from the recorded admission (the
-        # Assignment object only exists during scheduling cycles).
-        from kueue_oss_tpu.tas.snapshot import TASPodSetRequest
+        from kueue_oss_tpu import tas as tas_pkg
 
-        podsets = {ps.name: ps for ps in wl.podsets}
-        tas_requests: dict[str, list[TASPodSetRequest]] = {}
-        for psa in wl.status.admission.podset_assignments:
-            if psa.topology_assignment is None:
-                continue
-            ps = podsets.get(psa.name)
-            if ps is None:
-                continue
-            tas_flavor = next((f for f in psa.flavors.values()
-                               if f in cq.tas_flavors), None)
-            if tas_flavor is None:
-                continue
-            tas_requests.setdefault(tas_flavor, []).append(TASPodSetRequest(
-                podset=ps,
-                single_pod_requests=dict(ps.requests),
-                count=psa.count,
-                flavor=tas_flavor,
-                implied=ps.topology_request is None,
-                podset_group_name=(
-                    ps.topology_request.podset_group_name
-                    if ps.topology_request is not None else None),
-            ))
+        tas_requests = tas_pkg.requests_from_admission(wl, cq)
         if not tas_requests:
             return False
         # Current usage (own included) stays charged: _replace_unhealthy

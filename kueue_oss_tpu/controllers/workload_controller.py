@@ -59,6 +59,14 @@ class WorkloadReconciler:
         self.config = config or Configuration()
         #: keys deleted by retention GC (observability/tests)
         self.gc_deleted: list[str] = []
+    @staticmethod
+    def _has_pending_topology(wl: Workload) -> bool:
+        """workload.go HasTopologyAssignmentsPending."""
+        if wl.status.admission is None:
+            return False
+        return any(psa.topology_assignment is None
+                   and psa.delayed_topology_request == "Pending"
+                   for psa in wl.status.admission.podset_assignments)
 
     # -- public entry points ------------------------------------------------
 
@@ -246,6 +254,16 @@ class WorkloadReconciler:
         # reservation) — admitting on the vacuous all() mirrors the
         # reference, where zero pending checks means Admitted.
         if all(s.state == CheckState.READY for s in states):
+            if self._has_pending_topology(wl):
+                # Delayed TAS: all checks Ready but the topology is still
+                # unassigned — admission waits for the scheduler's second
+                # pass (workload.go NeedsSecondPass). The queue manager's
+                # iteration map is the dedup: it clears when the pass
+                # succeeds or the workload drops out, so re-admissions
+                # re-queue cleanly.
+                if not self.scheduler.queues.second_pass_pending(wl.key):
+                    self.scheduler.queues.queue_second_pass(wl.key, now)
+                return False
             if not wl.is_admitted and wl.is_quota_reserved:
                 wl.set_condition(WorkloadConditionType.ADMITTED, True,
                                  reason="Admitted", now=now)

@@ -172,6 +172,11 @@ class QueueManager:
         self.afs = afs
         #: wall-clock of the current scheduling cycle, used by AFS decay
         self.current_time = 0.0
+        #: second-pass queue (second_pass_queue.go): min-heap of
+        #: (ready_at, workload key) plus per-key attempt counts driving
+        #: the 1s -> 30s exponential backoff
+        self._second_pass_heap: list[tuple[float, str]] = []
+        self._second_pass_iteration: dict[str, int] = {}
         for cq in store.cluster_queues.values():
             self.add_cluster_queue(cq.name)
         # Initial LIST: enqueue pending workloads already in the store
@@ -179,6 +184,39 @@ class QueueManager:
         for wl in store.workloads.values():
             self.add_or_update_workload(wl)
         store.watch(self._on_event)
+
+    # -- second pass (TAS delayed assignment; second_pass_queue.go) ---------
+
+    SECOND_PASS_INITIAL_BACKOFF_S = 1.0
+    SECOND_PASS_MAX_BACKOFF_S = 30.0
+
+    def queue_second_pass(self, key: str, now: float) -> float:
+        """Schedule a workload for a second scheduling pass with
+        exponential delay (manager.go:868 QueueSecondPassIfNeeded).
+        Returns the ready-at time."""
+        it = self._second_pass_iteration.get(key, 0) + 1
+        self._second_pass_iteration[key] = it
+        delay = min(self.SECOND_PASS_INITIAL_BACKOFF_S * (2 ** (it - 1)),
+                    self.SECOND_PASS_MAX_BACKOFF_S)
+        ready_at = now + delay
+        heapq.heappush(self._second_pass_heap, (ready_at, key))
+        return ready_at
+
+    def take_second_pass_ready(self, now: float) -> list[str]:
+        out = []
+        while self._second_pass_heap and self._second_pass_heap[0][0] <= now:
+            _, key = heapq.heappop(self._second_pass_heap)
+            out.append(key)
+        return out
+
+    def clear_second_pass(self, key: str) -> None:
+        self._second_pass_iteration.pop(key, None)
+
+    def second_pass_pending(self, key: str) -> bool:
+        return key in self._second_pass_iteration
+
+    def next_second_pass_at(self) -> Optional[float]:
+        return self._second_pass_heap[0][0] if self._second_pass_heap else None
 
     # -- CQ lifecycle ------------------------------------------------------
 
@@ -203,9 +241,23 @@ class QueueManager:
     def _on_event(self, event) -> None:
         verb, kind, obj = event
         if kind == "ClusterQueue":
+            if verb == "delete":
+                q = self.queues.pop(obj.name, None)
+                if q is not None:
+                    self.dirty_cqs.add(obj.name)
+                return
             self.add_cluster_queue(obj.name)
             self.queues[obj.name].queue_inadmissible(self.cycle)
         elif kind == "LocalQueue":
+            if verb == "delete":
+                # Workloads of a deleted LQ are no longer schedulable.
+                q = self.queues.get(obj.cluster_queue)
+                if q is not None:
+                    for wl in self.store.workloads.values():
+                        if (wl.namespace == obj.namespace
+                                and wl.queue_name == obj.name):
+                            q.delete(wl.key)
+                return
             # Resume/stop of an LQ re-evaluates its pending workloads.
             for wl in self.store.workloads.values():
                 if (wl.namespace == obj.namespace

@@ -23,7 +23,10 @@ from kueue_oss_tpu.core.quota import (
     dominant_resource_share,
 )
 from kueue_oss_tpu.core.store import Store
-from kueue_oss_tpu.core.workload_info import WorkloadInfo
+from kueue_oss_tpu.core.workload_info import (
+    WorkloadInfo,
+    effective_per_pod_requests,
+)
 from kueue_oss_tpu.tas.snapshot import (
     TASAssignmentResult,
     TASFlavorSnapshot,
@@ -285,7 +288,8 @@ class Snapshot:
             if flavor is None:
                 continue
             ps = podsets.get(psa.name)
-            per_pod = dict(ps.requests) if ps is not None else {}
+            per_pod = (effective_per_pod_requests(ps, wl.namespace)
+                       if ps is not None else {})
             for dom in ta.domains:
                 yield flavor, tuple(dom.values), per_pod, dom.count
 

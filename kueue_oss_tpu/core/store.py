@@ -63,6 +63,21 @@ class Store:
             self.cq_generation[cq.name] = self.cq_generation.get(cq.name, 0) + 1
         self._emit(verb, "ClusterQueue", cq)
 
+    def delete_cluster_queue(self, name: str) -> Optional[ClusterQueue]:
+        with self._lock:
+            cq = self.cluster_queues.pop(name, None)
+            self.cq_generation.pop(name, None)
+        if cq is not None:
+            self._emit("delete", "ClusterQueue", cq)
+        return cq
+
+    def delete_local_queue(self, key: str) -> Optional[LocalQueue]:
+        with self._lock:
+            lq = self.local_queues.pop(key, None)
+        if lq is not None:
+            self._emit("delete", "LocalQueue", lq)
+        return lq
+
     def upsert_cohort(self, cohort: Cohort) -> None:
         with self._lock:
             self.cohorts[cohort.name] = cohort

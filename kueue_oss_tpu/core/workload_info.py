@@ -15,6 +15,52 @@ from kueue_oss_tpu.api.types import (
     WorkloadConditionType,
 )
 
+#: process-wide ResourcesConfig applied when computing workload totals
+#: (reference: the Configuration's Resources section consulted by
+#: pkg/workload/resources.go). None = no transformations.
+_active_resources_config = None
+#: namespace -> per-pod default requests (LimitRange defaultRequest)
+_limit_ranges: dict[str, dict[str, int]] = {}
+
+
+def set_resources_config(cfg) -> None:
+    """Install Configuration.resources for request transformation
+    (config.load callers wire this; None clears)."""
+    global _active_resources_config
+    _active_resources_config = cfg
+
+
+def set_limit_ranges(by_namespace: dict[str, dict[str, int]]) -> None:
+    """Install namespace LimitRange default-requests (pkg/workload/
+    resources.go LimitRange adjustment; pkg/util/limitrange)."""
+    global _limit_ranges
+    _limit_ranges = dict(by_namespace)
+
+
+def effective_per_pod_requests(ps, namespace: str) -> dict[str, int]:
+    """Per-pod requests after LimitRange defaulting and resource
+    transformations — the request shape every accounting and placement
+    path must agree on (pkg/workload/resources.go)."""
+    per_pod = dict(ps.requests)
+    defaults = _limit_ranges.get(namespace)
+    if defaults:
+        for r, q in defaults.items():
+            per_pod.setdefault(r, q)
+    if _active_resources_config is not None:
+        from kueue_oss_tpu.config.configuration import (
+            apply_resource_transformations,
+        )
+
+        per_pod = apply_resource_transformations(
+            per_pod, _active_resources_config)
+    return per_pod
+
+
+def _effective_requests(ps, namespace: str) -> dict[str, int]:
+    """Per-podset totals of the effective per-pod requests."""
+    return {r: q * ps.count
+            for r, q in effective_per_pod_requests(ps, namespace).items()}
+
 
 @dataclass
 class PodSetResources:
@@ -52,6 +98,19 @@ class AssignmentClusterQueueState:
         return 0
 
 
+def workload_status(wl: Workload) -> str:
+    """Human-facing lifecycle status (shared by CLI and dashboard)."""
+    if wl.is_finished:
+        return "Finished"
+    if wl.is_admitted:
+        return "Admitted"
+    if wl.is_quota_reserved:
+        return "QuotaReserved"
+    if not wl.active:
+        return "Inactive"
+    return "Pending"
+
+
 class WorkloadInfo:
     """A Workload enriched with totals and scheduling state."""
 
@@ -62,7 +121,7 @@ class WorkloadInfo:
         self.total_requests: list[PodSetResources] = [
             PodSetResources(
                 name=ps.name,
-                requests=ps.total_requests(),
+                requests=_effective_requests(ps, obj.namespace),
                 count=ps.count,
             )
             for ps in obj.podsets

@@ -88,19 +88,30 @@ def claim_satisfiable(claim: ResourceClaimTemplate,
                       slices: list[DeviceSlice]) -> bool:
     """Whether published ResourceSlices could satisfy the claim at all.
 
-    Requests draw from a shared pool: devices granted to one request are
-    not available to the next (greedy first-fit over the slices).
+    Requests draw from a shared pool. Allocation is greedy but ordered to
+    avoid the obvious mis-assignments: most-constrained requests (fewest
+    matching slices) allocate first, and each request prefers slices that
+    fewer other requests could use (exact feasibility is bipartite
+    matching; this heuristic covers the practical shapes).
     """
-    remaining = [s.count for s in slices]
+    matches = {id(req): [i for i, s in enumerate(slices)
+                         if selector_matches(req, s)]
+               for req in claim.requests}
+    demand_per_slice = [0] * len(slices)
     for req in claim.requests:
+        for i in matches[id(req)]:
+            demand_per_slice[i] += 1
+    remaining = [s.count for s in slices]
+    ordered = sorted(claim.requests,
+                     key=lambda r: (len(matches[id(r)]), -r.count))
+    for req in ordered:
         need = req.count
-        for i, s in enumerate(slices):
+        for i in sorted(matches[id(req)], key=lambda i: demand_per_slice[i]):
             if need <= 0:
                 break
-            if selector_matches(req, s) and remaining[i] > 0:
-                take = min(need, remaining[i])
-                remaining[i] -= take
-                need -= take
+            take = min(need, remaining[i])
+            remaining[i] -= take
+            need -= take
         if need > 0:
             return False
     return True

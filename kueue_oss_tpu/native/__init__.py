@@ -149,24 +149,35 @@ class BatchOracle:
         lib = None if force_python else load()
         if lib is None:
             return self._python_verify(admissions, ok)
-        node_idx = np.zeros(len(admissions), dtype=np.int32)
-        ptr = np.zeros(len(admissions) + 1, dtype=np.int64)
+        # Admissions naming a (flavor, resource) with no quota anywhere
+        # can never fit (available() over an unknown fr is <= 0); reject
+        # them up front instead of indexing them into the CSR arrays.
+        valid = [i for i, (_, usage) in enumerate(admissions)
+                 if all(q <= 0 or fr in self._fr_index
+                        for fr, q in usage.items())]
+        node_idx = np.zeros(len(valid), dtype=np.int32)
+        ptr = np.zeros(len(valid) + 1, dtype=np.int64)
         fr_l: list[int] = []
         qty_l: list[int] = []
-        for i, (cq_name, usage) in enumerate(admissions):
-            node_idx[i] = self._cq_node[cq_name]
+        for j, i in enumerate(valid):
+            cq_name, usage = admissions[i]
+            node_idx[j] = self._cq_node[cq_name]
             for fr, q in usage.items():
+                if q <= 0:
+                    continue
                 fr_l.append(self._fr_index[fr])
                 qty_l.append(q)
-            ptr[i + 1] = len(fr_l)
+            ptr[j + 1] = len(fr_l)
+        ok_valid = np.zeros(len(valid), dtype=np.uint8)
         lib.verify_plan(
             np.int32(len(self._nodes)), np.int32(self.F),
             self.parent, self.local_quota.ravel(), self.subtree.ravel(),
             self.has_borrow.ravel(), self.borrow_limit.ravel(),
             self.usage.ravel(),
-            np.int64(len(admissions)), node_idx, ptr,
+            np.int64(len(valid)), node_idx, ptr,
             np.asarray(fr_l, dtype=np.int32),
-            np.asarray(qty_l, dtype=np.int64), ok)
+            np.asarray(qty_l, dtype=np.int64), ok_valid)
+        ok[valid] = ok_valid
         return ok
 
     def _python_verify(self, admissions, ok: np.ndarray) -> np.ndarray:

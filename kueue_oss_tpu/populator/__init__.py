@@ -1,0 +1,48 @@
+"""kueue-populator (experimental).
+
+Reference parity: cmd/experimental/kueue-populator — automatically
+creates a LocalQueue in every namespace whose labels match a
+ClusterQueue's namespace selector, so teams don't hand-provision LQs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu.api.types import LocalQueue
+from kueue_oss_tpu.core.store import Store
+
+
+@dataclass
+class PopulatorResult:
+    created: list[str] = field(default_factory=list)  # "namespace/name"
+    skipped: list[str] = field(default_factory=list)
+
+
+class Populator:
+    def __init__(self, store: Store,
+                 local_queue_name: str = "default") -> None:
+        self.store = store
+        self.local_queue_name = local_queue_name
+
+    def _matches(self, selector, labels: dict[str, str]) -> bool:
+        if selector is None:
+            return False  # populator requires an explicit selector
+        return all(labels.get(k) == v for k, v in selector.items())
+
+    def reconcile(self) -> PopulatorResult:
+        """Create missing LocalQueues for matching namespaces."""
+        res = PopulatorResult()
+        for ns, labels in self.store.namespaces.items():
+            for cq in self.store.cluster_queues.values():
+                if not self._matches(cq.namespace_selector, labels):
+                    continue
+                key = f"{ns}/{self.local_queue_name}"
+                if key in self.store.local_queues:
+                    res.skipped.append(key)
+                    continue
+                self.store.upsert_local_queue(LocalQueue(
+                    name=self.local_queue_name, namespace=ns,
+                    cluster_queue=cq.name))
+                res.created.append(key)
+        return res

@@ -27,6 +27,7 @@ from kueue_oss_tpu.core.snapshot import ClusterQueueSnapshot
 from kueue_oss_tpu.core.workload_info import (
     AssignmentClusterQueueState,
     WorkloadInfo,
+    effective_per_pod_requests,
 )
 from kueue_oss_tpu.tas.snapshot import TASPodSetRequest
 
@@ -306,7 +307,8 @@ def workload_topology_requests(
             continue
         out.setdefault(tas_flavor, []).append(TASPodSetRequest(
             podset=ps,
-            single_pod_requests=dict(ps.requests),
+            single_pod_requests=effective_per_pod_requests(
+                ps, wl.obj.namespace),
             count=psa.count,
             flavor=tas_flavor,
             implied=ps.topology_request is None,

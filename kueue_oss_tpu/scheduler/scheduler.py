@@ -30,6 +30,7 @@ from kueue_oss_tpu.core.store import Store
 from kueue_oss_tpu import metrics
 from kueue_oss_tpu.core.workload_info import (
     WorkloadInfo,
+    effective_per_pod_requests,
     effective_priority,
     queue_order_timestamp,
 )
@@ -125,6 +126,7 @@ class Scheduler:
         stats = CycleStats(cycle=self.cycle_count)
         self.queues.current_time = now  # AFS decay reference point
         self.requeue_due(now)
+        self._run_second_pass(now)
 
         heads = self.queues.heads()
         stats.heads = len(heads)
@@ -452,10 +454,22 @@ class Scheduler:
                 stats.preempted += 1
                 return
 
-        self._assume_tas_usage(e, snapshot)
+        # Delayed topology assignment: on a CQ gated by admission checks
+        # the topology is computed in a second pass after the checks turn
+        # Ready (provisioned capacity may change the tree), so the TAS
+        # usage must not be assumed now (KEP-2724 delayed assignment).
+        if not self._delays_topology(e):
+            self._assume_tas_usage(e, snapshot)
         e.status = NOMINATED
         self._admit(e, now)
         stats.admitted += 1
+
+    @staticmethod
+    def _delays_topology(e: Entry) -> bool:
+        cq = e.cq_snapshot
+        return (cq is not None and bool(cq.spec.admission_checks)
+                and any(psa.topology_assignment is not None
+                        for psa in e.assignment.podsets))
 
     def _find_admitted_sibling(self, info: WorkloadInfo,
                                cq: ClusterQueueSnapshot,
@@ -503,7 +517,8 @@ class Scheduler:
             if flavor is None:
                 continue
             ps = podsets.get(psa.name)
-            per_pod = dict(ps.requests) if ps is not None else {}
+            per_pod = (effective_per_pod_requests(ps, e.info.obj.namespace)
+                       if ps is not None else {})
             for dom in ta.domains:
                 snapshot.tas_flavors[flavor].add_tas_usage(
                     dom.values, per_pod, dom.count)
@@ -542,7 +557,8 @@ class Scheduler:
             if flavor is None:
                 continue
             ps = podsets.get(psa.name)
-            per_pod = dict(ps.requests) if ps is not None else {}
+            per_pod = (effective_per_pod_requests(ps, e.info.obj.namespace)
+                       if ps is not None else {})
             for dom in ta.domains:
                 bucket = demand.setdefault((flavor, tuple(dom.values)), {})
                 for r, q in per_pod.items():
@@ -586,6 +602,7 @@ class Scheduler:
         if wl is None:
             e.status = SKIPPED
             return
+        delay_tas = self._delays_topology(e)
         admission = Admission(
             cluster_queue=e.info.cluster_queue,
             podset_assignments=[
@@ -594,7 +611,11 @@ class Scheduler:
                     flavors={r: rec.name for r, rec in psa.flavors.items()},
                     resource_usage=dict(psa.requests),
                     count=psa.count,
-                    topology_assignment=psa.topology_assignment,
+                    topology_assignment=(
+                        None if delay_tas else psa.topology_assignment),
+                    delayed_topology_request=(
+                        "Pending" if delay_tas
+                        and psa.topology_assignment is not None else None),
                 )
                 for psa in e.assignment.podsets
             ],
@@ -762,6 +783,56 @@ class Scheduler:
                 continue
             return due_at
         return None
+
+    def _run_second_pass(self, now: float) -> None:
+        """Compute delayed topology assignments for quota-reserved
+        workloads whose admission checks turned Ready (scheduler second
+        pass, second_pass_queue.go + scheduler.go delayed TAS)."""
+        keys = self.queues.take_second_pass_ready(now)
+        if not keys:
+            return
+        from kueue_oss_tpu import tas as tas_pkg
+
+        snapshot = build_snapshot(self.store)
+        for key in keys:
+            wl = self.store.workloads.get(key)
+            if (wl is None or not wl.is_quota_reserved or wl.is_evicted
+                    or wl.is_finished or wl.status.admission is None):
+                self.queues.clear_second_pass(key)
+                continue
+            cq = snapshot.cluster_queue(wl.status.admission.cluster_queue)
+            if cq is None:
+                self.queues.queue_second_pass(key, now)
+                continue
+            tas_requests = tas_pkg.requests_from_admission(
+                wl, cq, only_pending=True)
+            if not tas_requests:
+                self.queues.clear_second_pass(key)
+                continue
+            result = cq.find_topology_assignments_for_workload(tas_requests)
+            if any(res.failure for res in result.values()):
+                # Capacity not there yet: retry with backoff (1s -> 30s).
+                self.queues.queue_second_pass(key, now)
+                continue
+            podsets = {ps.name: ps for ps in wl.podsets}
+            for psa in wl.status.admission.podset_assignments:
+                res = result.get(psa.name)
+                if res is not None and res.assignment is not None:
+                    psa.topology_assignment = res.assignment
+                    psa.delayed_topology_request = "Ready"
+                    # Charge the new placement so later workloads in this
+                    # batch see the domain usage.
+                    flavor = next((f for f in psa.flavors.values()
+                                   if f in snapshot.tas_flavors), None)
+                    ps = podsets.get(psa.name)
+                    if flavor is not None and ps is not None:
+                        for dom in res.assignment.domains:
+                            snapshot.tas_flavors[flavor].add_tas_usage(
+                                dom.values,
+                                effective_per_pod_requests(ps, wl.namespace),
+                                dom.count)
+            self.queues.clear_second_pass(key)
+            self.store.update_workload(wl)
 
     def finish_workload(self, key: str, now: float = 0.0) -> None:
         """Mark Finished and release quota (jobframework Finished path)."""

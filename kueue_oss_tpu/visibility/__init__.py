@@ -47,14 +47,15 @@ class VisibilityService:
         q = self.queues.queues.get(cq_name)
         if q is None:
             return PendingWorkloadsSummary()
-        lq_positions: dict[str, int] = {}
+        lq_positions: dict[tuple[str, str], int] = {}
         items: list[PendingWorkload] = []
         ordered = q.snapshot_order() + sorted(
             q.inadmissible.values(), key=lambda i: i.key)
         for pos, info in enumerate(ordered):
             wl = info.obj
-            lq_pos = lq_positions.get(wl.queue_name, 0)
-            lq_positions[wl.queue_name] = lq_pos + 1
+            lq_key = (wl.namespace, wl.queue_name)
+            lq_pos = lq_positions.get(lq_key, 0)
+            lq_positions[lq_key] = lq_pos + 1
             items.append(PendingWorkload(
                 name=wl.name, namespace=wl.namespace,
                 priority=wl.priority,

@@ -1,0 +1,139 @@
+"""kueueviz-style dashboard backend.
+
+Reference parity: cmd/kueueviz (Go/gin backend streaming cluster state to
+a React frontend over websockets). The dashboard surface here is a JSON
+snapshot API — the same aggregate views the kueueviz frontend renders
+(cluster queues with usage/pending, cohort tree, workload listing) served
+from the store, pollable over HTTP or consumed directly by tooling.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kueue_oss_tpu.api.types import iter_quotas
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+
+
+class Dashboard:
+    def __init__(self, store: Store, queues: QueueManager) -> None:
+        self.store = store
+        self.queues = queues
+
+    # -- views (kueueviz backend endpoints) ---------------------------------
+
+    def cluster_queues_view(self) -> list[dict]:
+        usage: dict[str, dict[str, int]] = {}
+        counts: dict[str, dict[str, int]] = {}
+        for wl in self.store.workloads.values():
+            adm = wl.status.admission
+            if adm is None or wl.is_finished:
+                continue
+            cq = adm.cluster_queue
+            c = counts.setdefault(cq, {"admitted": 0, "reserved": 0})
+            if wl.is_admitted:
+                c["admitted"] += 1
+            if wl.is_quota_reserved:
+                c["reserved"] += 1
+                u = usage.setdefault(cq, {})
+                for psa in adm.podset_assignments:
+                    for r, q in psa.resource_usage.items():
+                        key = f"{psa.flavors.get(r, '?')}/{r}"
+                        u[key] = u.get(key, 0) + q
+        out = []
+        for name, cq in sorted(self.store.cluster_queues.items()):
+            q = self.queues.queues.get(name)
+            nominal = {f"{fl}/{r}": rq.nominal
+                       for (fl, r), rq in iter_quotas(cq.resource_groups)}
+            out.append({
+                "name": name,
+                "cohort": cq.cohort,
+                "strategy": cq.queueing_strategy,
+                "stopPolicy": cq.stop_policy,
+                "nominalQuota": nominal,
+                "usage": usage.get(name, {}),
+                "pending": (q.pending_active if q else 0),
+                "inadmissible": (q.pending_inadmissible if q else 0),
+                **counts.get(name, {"admitted": 0, "reserved": 0}),
+            })
+        return out
+
+    def cohorts_view(self) -> list[dict]:
+        out = []
+        for name, cohort in sorted(self.store.cohorts.items()):
+            members = sorted(
+                cq.name for cq in self.store.cluster_queues.values()
+                if cq.cohort == name)
+            out.append({"name": name, "parent": cohort.parent,
+                        "clusterQueues": members})
+        return out
+
+    def workloads_view(self, namespace: Optional[str] = None) -> list[dict]:
+        from kueue_oss_tpu.core.workload_info import workload_status
+
+        out = []
+        for wl in sorted(self.store.workloads.values(), key=lambda w: w.key):
+            if namespace is not None and wl.namespace != namespace:
+                continue
+            out.append({
+                "namespace": wl.namespace,
+                "name": wl.name,
+                "localQueue": wl.queue_name,
+                "priority": wl.priority,
+                "status": workload_status(wl),
+                "clusterQueue": (wl.status.admission.cluster_queue
+                                 if wl.status.admission else None),
+            })
+        return out
+
+    def overview(self) -> dict:
+        return {
+            "clusterQueues": self.cluster_queues_view(),
+            "cohorts": self.cohorts_view(),
+            "workloads": self.workloads_view(),
+        }
+
+
+class DashboardServer:
+    """GET /api/clusterqueues | /api/cohorts | /api/workloads | /api/overview"""
+
+    def __init__(self, dashboard: Dashboard, port: int = 0) -> None:
+        dash = dashboard
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self) -> None:
+                routes = {
+                    "/api/clusterqueues": dash.cluster_queues_view,
+                    "/api/cohorts": dash.cohorts_view,
+                    "/api/workloads": dash.workloads_view,
+                    "/api/overview": dash.overview,
+                }
+                fn = routes.get(self.path.rstrip("/"))
+                if fn is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(fn()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+
+    def start(self) -> None:
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

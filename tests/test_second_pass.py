@@ -1,0 +1,199 @@
+"""Second-pass scheduling tests: delayed topology assignment (KEP-2724)
+behind admission checks, with 1s→30s exponential backoff; plus resource
+transformations and LimitRange defaulting in workload totals.
+
+Scenario shapes mirror the reference's delayed-admission scheduler
+integration tests and second_pass_queue.go.
+"""
+
+import pytest
+
+from kueue_oss_tpu.admissionchecks.provisioning import (
+    CONTROLLER_NAME,
+    ProvisioningController,
+)
+from kueue_oss_tpu.api.types import (
+    AdmissionCheck,
+    CheckState,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    Node,
+    PodSet,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    Workload,
+)
+from kueue_oss_tpu.config.configuration import (
+    ResourcesConfig,
+    ResourceTransformation,
+)
+from kueue_oss_tpu.controllers import WorkloadReconciler
+from kueue_oss_tpu.core import workload_info as wlinfo
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+HOST = "kubernetes.io/hostname"
+RACK = "cloud/rack"
+
+
+class Env:
+    def __init__(self, racks=2, hosts=2, cpu=4000):
+        self.store = Store()
+        self.store.upsert_topology(Topology(name="t", levels=[RACK, HOST]))
+        self.store.upsert_resource_flavor(ResourceFlavor(
+            name="tas", topology_name="t"))
+        for r in range(racks):
+            for h in range(hosts):
+                self.store.upsert_node(Node(
+                    name=f"n-{r}-{h}", labels={RACK: f"r{r}"},
+                    allocatable={"cpu": cpu}))
+        self.store.upsert_cluster_queue(ClusterQueue(
+            name="cq", admission_checks=["prov"],
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="tas", resources=[
+                    ResourceQuota(name="cpu",
+                                  nominal=racks * hosts * cpu)])])]))
+        self.store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        self.store.upsert_admission_check(AdmissionCheck(
+            name="prov", controller_name=CONTROLLER_NAME))
+        self.queues = QueueManager(self.store)
+        self.scheduler = Scheduler(self.store, self.queues)
+        self.wr = WorkloadReconciler(self.store, self.scheduler)
+        self.prov = ProvisioningController(self.store)
+        self.t = 0.0
+
+    def submit(self, name="wl", count=2, cpu=1000):
+        self.t += 1.0
+        self.store.add_workload(Workload(
+            name=name, queue_name="lq", creation_time=self.t,
+            podsets=[PodSet(name="main", count=count,
+                            requests={"cpu": cpu},
+                            topology_request=PodSetTopologyRequest(
+                                required=RACK))]))
+        return self.store.workloads[f"default/{name}"]
+
+    def tick(self, dt=1.0):
+        self.t += dt
+        self.scheduler.schedule(self.t)
+        self.prov.reconcile(self.t)
+        self.wr.reconcile_all(self.t)
+        return self.t
+
+
+def test_delayed_topology_assigned_after_checks_ready():
+    env = Env()
+    wl = env.submit()
+    env.tick()  # quota reserved; topology delayed behind the check
+    assert wl.is_quota_reserved and not wl.is_admitted
+    psa = wl.status.admission.podset_assignments[0]
+    assert psa.topology_assignment is None
+    assert psa.delayed_topology_request == "Pending"
+
+    env.tick()  # provisioning flips Ready -> second pass queued
+    env.tick(dt=2.0)  # past the 1s backoff: second pass assigns topology
+    env.tick()
+    psa = wl.status.admission.podset_assignments[0]
+    assert psa.delayed_topology_request == "Ready"
+    assert psa.topology_assignment is not None
+    assert sum(d.count for d in psa.topology_assignment.domains) == 2
+    assert wl.is_admitted
+
+
+def test_second_pass_backoff_until_capacity():
+    """Topology full at second-pass time: retries with backoff and
+    succeeds once capacity frees."""
+    env = Env(racks=1, hosts=1, cpu=4000)
+    blocker = env.submit(name="blocker", count=4, cpu=1000)
+    env.tick()
+    env.tick()
+    env.tick(dt=2.0)
+    env.tick()
+    assert blocker.is_admitted
+
+    wl = env.submit(name="late", count=4, cpu=1000)
+    # no quota left: stays pending until blocker finishes
+    env.tick()
+    assert not wl.is_quota_reserved
+    env.scheduler.finish_workload(blocker.key, env.t)
+    env.tick()  # reserves; delayed topology
+    assert wl.is_quota_reserved
+    for _ in range(6):
+        env.tick(dt=5.0)
+    assert wl.is_admitted
+    ta = wl.status.admission.podset_assignments[0].topology_assignment
+    assert ta is not None
+
+
+def test_second_pass_backoff_grows_and_caps():
+    q = QueueManager(Store())
+    t0 = 100.0
+    delays = []
+    for _ in range(8):
+        ready = q.queue_second_pass("default/x", t0)
+        delays.append(ready - t0)
+    assert delays[0] == 1.0
+    assert delays == sorted(delays)
+    assert delays[-1] == 30.0, "caps at 30s"
+    q.clear_second_pass("default/x")
+    assert q.queue_second_pass("default/x", t0) - t0 == 1.0
+
+
+def test_non_tas_checked_workload_unaffected():
+    """A checks-gated workload without TAS admits straight away once the
+    checks are Ready (no second pass involved)."""
+    env = Env()
+    env.t += 1.0
+    env.store.add_workload(Workload(
+        name="plain", queue_name="lq", creation_time=env.t,
+        podsets=[PodSet(name="main", count=1, requests={"cpu": 1000})]))
+    wl = env.store.workloads["default/plain"]
+    env.tick()
+    env.tick()
+    assert wl.is_admitted
+    # implied TAS on a TAS-only CQ still computed eagerly? No: the CQ has
+    # checks, so even implied placement is delayed; but a workload with no
+    # topology assignment at all must not be stuck waiting.
+    assert wl.status.admission is not None
+
+
+# -- resource transformations / limit ranges ---------------------------------
+
+
+def test_resource_transformations_applied_to_totals():
+    cfg = ResourcesConfig(
+        exclude_resource_prefixes=["ephemeral-"],
+        transformations=[ResourceTransformation(
+            input="vendor.com/accelerator", strategy="Replace",
+            outputs={"gpus": 2.0})])
+    wlinfo.set_resources_config(cfg)
+    try:
+        wl = Workload(name="w", podsets=[PodSet(
+            count=2, requests={"cpu": 500, "vendor.com/accelerator": 1,
+                               "ephemeral-storage": 10})])
+        info = wlinfo.WorkloadInfo(wl)
+        assert info.total_requests[0].requests == {"cpu": 1000, "gpus": 4}
+    finally:
+        wlinfo.set_resources_config(None)
+
+
+def test_limit_range_defaults_fill_missing_requests():
+    wlinfo.set_limit_ranges({"team-ns": {"cpu": 250, "memory": 1 << 20}})
+    try:
+        wl = Workload(name="w", namespace="team-ns", podsets=[PodSet(
+            count=2, requests={"cpu": 500})])
+        info = wlinfo.WorkloadInfo(wl)
+        # cpu kept (explicit), memory defaulted per pod
+        assert info.total_requests[0].requests == {
+            "cpu": 1000, "memory": 2 << 20}
+        other = Workload(name="w2", namespace="other-ns", podsets=[PodSet(
+            count=1, requests={"cpu": 500})])
+        assert wlinfo.WorkloadInfo(other).total_requests[0].requests == {
+            "cpu": 500}
+    finally:
+        wlinfo.set_limit_ranges({})

@@ -203,3 +203,54 @@ def test_importer_rejects_unmapped_pods():
     ])
     assert res.imported == 0, "check phase failures abort the import"
     assert len(res.errors) == 3
+
+
+# -- populator + kueueviz dashboard ------------------------------------------
+
+
+def test_populator_creates_matching_local_queues():
+    from kueue_oss_tpu.populator import Populator
+
+    store = Store()
+    store.namespaces["team-a"] = {"team": "a"}
+    store.namespaces["team-b"] = {"team": "b"}
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq-a", namespace_selector={"team": "a"}))
+    pop = Populator(store)
+    res = pop.reconcile()
+    assert res.created == ["team-a/default"]
+    assert store.local_queues["team-a/default"].cluster_queue == "cq-a"
+    # idempotent
+    res2 = pop.reconcile()
+    assert res2.created == [] and res2.skipped == ["team-a/default"]
+    # no selector -> no auto-creation
+    store.upsert_cluster_queue(ClusterQueue(name="cq-all"))
+    assert pop.reconcile().created == []
+
+
+def test_dashboard_views_and_server():
+    from kueue_oss_tpu.viz import Dashboard, DashboardServer
+
+    store, queues, sched = make_env(nominal=1000)
+    submit(store, "running", "lq-a", t=1.0)
+    submit(store, "waiting", "lq-b", t=2.0)
+    sched.schedule(3.0)
+    dash = Dashboard(store, queues)
+    cqs = dash.cluster_queues_view()
+    assert cqs[0]["name"] == "cq"
+    assert cqs[0]["admitted"] == 1
+    assert cqs[0]["pending"] + cqs[0]["inadmissible"] == 1
+    assert cqs[0]["usage"] == {"default/cpu": 1000}
+    wls = dash.workloads_view()
+    statuses = {w["name"]: w["status"] for w in wls}
+    assert statuses == {"running": "Admitted", "waiting": "Pending"}
+
+    srv = DashboardServer(dash)
+    srv.start()
+    try:
+        data = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/api/overview", timeout=5).read())
+        assert data["clusterQueues"][0]["name"] == "cq"
+        assert len(data["workloads"]) == 2
+    finally:
+        srv.stop()
