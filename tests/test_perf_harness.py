@@ -87,3 +87,47 @@ class TestGraftEntry:
         import __graft_entry__ as g
 
         g.dryrun_multichip(8)
+
+
+class TestChecker:
+    """Rangespec-checker analog (test/performance/scheduler/checker)."""
+
+    def test_passing_run_has_no_violations(self):
+        from kueue_oss_tpu.perf.checker import RangeSpec, check
+        from kueue_oss_tpu.perf.runner import SimStats
+
+        stats = SimStats(total_workloads=100, admitted=100, finished=100,
+                         sim_wall_ms=1000.0,
+                         tta_ms_by_class={"large": 50.0},
+                         admissions_per_real_second=500.0)
+        spec = RangeSpec(max_wall_ms=2000.0,
+                         max_tta_ms_by_class={"large": 100.0},
+                         min_admissions_per_second=100.0)
+        assert check(stats, spec) == []
+
+    def test_violations_reported_individually(self):
+        from kueue_oss_tpu.perf.checker import RangeSpec, check
+        from kueue_oss_tpu.perf.runner import SimStats
+
+        stats = SimStats(total_workloads=100, admitted=90,
+                         sim_wall_ms=5000.0,
+                         tta_ms_by_class={"large": 500.0},
+                         admissions_per_real_second=10.0)
+        spec = RangeSpec(max_wall_ms=2000.0,
+                         max_tta_ms_by_class={"large": 100.0,
+                                              "medium": 100.0},
+                         min_admissions_per_second=100.0)
+        v = check(stats, spec)
+        assert len(v) == 5, v  # wall, large TTA, missing medium, admitted, throughput
+
+    def test_baseline_spec_passes_on_real_run(self):
+        """The simulator beats the reference thresholds on the baseline
+        shape (scaled down 10x for test runtime)."""
+        from kueue_oss_tpu.perf.checker import BASELINE_SPEC, check
+        from kueue_oss_tpu.perf.generator import GeneratorConfig, generate
+        from kueue_oss_tpu.perf.runner import Simulator
+
+        cfg = GeneratorConfig(n_cohorts=2, cqs_per_cohort=3)
+        store, schedule = generate(cfg)
+        stats = Simulator(store, schedule).run()
+        assert check(stats, BASELINE_SPEC) == []
