@@ -58,6 +58,18 @@ class ClusterQueuePendingQueue:
         #: admission-fair-sharing rank fn (info -> decayed LQ usage);
         #: set by the manager for CQs with UsageBasedAdmissionFairSharing
         self.afs_key = None
+        #: scheduling-equivalence classes known NoFit since the last
+        #: capacity-freed flush (cluster_queue.go noFitSchedulingHashes)
+        self.no_fit_hashes: set = set()
+        #: XOR accumulator over (key, heap|inadmissible) membership —
+        #: mutated O(1) on every queue transition so run_until_quiet can
+        #: detect quiescence without walking queue internals
+        self.state_hash = 0
+
+    _HEAP, _INADM = 1, 2
+
+    def _hx(self, key: str, state: int) -> None:
+        self.state_hash ^= hash((key, state))
 
     def __len__(self) -> int:
         return len(self._heap) + len(self.inadmissible)
@@ -70,12 +82,31 @@ class ClusterQueuePendingQueue:
     def pending_inadmissible(self) -> int:
         return len(self.inadmissible)
 
-    def push(self, info: WorkloadInfo) -> None:
-        self.inadmissible.pop(info.key, None)
+    def push(self, info: WorkloadInfo, check_no_fit: bool = False) -> None:
+        """Insert into the heap. With check_no_fit (the PushOrUpdate path,
+        cluster_queue.go:371), a BestEffortFIFO queue parks workloads whose
+        scheduling-equivalence class is already known NoFit."""
+        from kueue_oss_tpu import features
+
+        if (check_no_fit
+                and self.strategy == QueueingStrategy.BEST_EFFORT_FIFO
+                and info.key not in self._in_heap
+                and self.no_fit_hashes
+                and features.enabled("SchedulingEquivalenceHashing")
+                and info.scheduling_hash() in self.no_fit_hashes):
+            if info.key not in self.inadmissible:
+                self._hx(info.key, self._INADM)
+            self.inadmissible[info.key] = info
+            self._on_change(self.name)
+            return
+        if info.key in self.inadmissible:
+            del self.inadmissible[info.key]
+            self._hx(info.key, self._INADM)
         if info.key in self._in_heap:
             # Re-push with fresh ordering (priority/timestamps may change).
             self.delete(info.key)
         self._in_heap[info.key] = info
+        self._hx(info.key, self._HEAP)
         heapq.heappush(self._heap, (_order_key(info), next(self._counter), info))
         self._on_change(self.name)
 
@@ -88,6 +119,7 @@ class ClusterQueuePendingQueue:
             info = min(self._in_heap.values(),
                        key=lambda i: (self.afs_key(i), _order_key(i)))
             del self._in_heap[info.key]
+            self._hx(info.key, self._HEAP)
             # The AFS path never pops _heap, so stale tuples would pile up
             # forever; rebuild once they dominate (amortized O(1)).
             if len(self._heap) > 2 * len(self._in_heap):
@@ -100,12 +132,17 @@ class ClusterQueuePendingQueue:
             _, _, info = heapq.heappop(self._heap)
             if self._in_heap.get(info.key) is info:
                 del self._in_heap[info.key]
+                self._hx(info.key, self._HEAP)
                 self._on_change(self.name)
                 return info
         return None
 
     def delete(self, key: str) -> None:
-        if key in self._in_heap or key in self.inadmissible:
+        if key in self._in_heap:
+            self._hx(key, self._HEAP)
+            self._on_change(self.name)
+        if key in self.inadmissible:
+            self._hx(key, self._INADM)
             self._on_change(self.name)
         self._in_heap.pop(key, None)
         self.inadmissible.pop(key, None)
@@ -120,6 +157,7 @@ class ClusterQueuePendingQueue:
         if info is not None:
             self.delete(key)
             self.inadmissible[key] = info
+            self._hx(key, self._INADM)
             self._on_change(self.name)
 
     def requeue_if_not_present(self, info: WorkloadInfo, reason: str,
@@ -142,17 +180,40 @@ class ClusterQueuePendingQueue:
             self.push(info)
             return True
         self.inadmissible[info.key] = info
+        self._hx(info.key, self._INADM)
         self._on_change(self.name)
+        self._handle_inadmissible_hash(info)
         return False
 
+    def _handle_inadmissible_hash(self, info: WorkloadInfo) -> None:
+        """Record the parked workload's equivalence class as NoFit and
+        bulk-move equivalent heap entries to inadmissible, so the scheduler
+        never pays a nomination cycle for a shape it just rejected
+        (cluster_queue.go handleInadmissibleHash, :559-575)."""
+        from kueue_oss_tpu import features
+
+        if (self.strategy != QueueingStrategy.BEST_EFFORT_FIFO
+                or not features.enabled("SchedulingEquivalenceHashing")):
+            return
+        h = info.scheduling_hash()
+        self.no_fit_hashes.add(h)
+        equivalent = [k for k, i in self._in_heap.items()
+                      if i.scheduling_hash() == h]
+        for k in equivalent:
+            self.park(k)
+
     def queue_inadmissible(self, cycle: int) -> bool:
-        """Move all parked workloads back into the heap."""
+        """Move all parked workloads back into the heap. Known-NoFit
+        classes reset: freed capacity may fit them now
+        (inadmissible_workloads.go:174)."""
+        self.no_fit_hashes.clear()
         if not self.inadmissible:
             self.queue_inadmissible_cycle = cycle
             return False
         parked = list(self.inadmissible.values())
         self.inadmissible.clear()
         for info in parked:
+            self._hx(info.key, self._INADM)
             self.push(info)
         self.queue_inadmissible_cycle = cycle
         self._on_change(self.name)
@@ -310,7 +371,8 @@ class QueueManager:
             # a gated workload can't still be popped.
             self.queues[cq].delete(wl.key)
             return False
-        self.queues[cq].push(WorkloadInfo(wl, cluster_queue=cq))
+        self.queues[cq].push(WorkloadInfo(wl, cluster_queue=cq),
+                             check_no_fit=True)
         return True
 
     def requeue_workload(self, info: WorkloadInfo, reason: str) -> bool:
@@ -353,6 +415,15 @@ class QueueManager:
 
     def has_pending(self) -> bool:
         return any(len(q._in_heap) > 0 for q in self.queues.values() if q.active)
+
+    def membership_fingerprint(self) -> int:
+        """Order-insensitive digest of every queue's (key, heap|parked)
+        membership, maintained O(1) per transition — the scheduler's
+        run_until_quiet quiescence probe (replaces walking queue internals)."""
+        acc = 0
+        for name, q in self.queues.items():
+            acc ^= hash((name, q.state_hash))
+        return acc
 
     def drain_dirty_pending_counts(self) -> dict[str, tuple[int, int]]:
         """Pending counts for CQs that changed since the last drain —

@@ -368,17 +368,10 @@ def build_snapshot(store: Store, profile_mixed: bool = False) -> Snapshot:
         )
     snapshot._node_to_cq = {id(cq.node): cq for cq in cqs.values()}
 
-    for wl in store.admitted_workloads():
-        # Admitted usage is charged to the CQ recorded in the admission,
-        # not the LocalQueue's current target (reference: workload.go:299) —
-        # repointing a LocalQueue must not move already-admitted usage.
-        cq_name = None
-        if wl.status.admission is not None:
-            cq_name = wl.status.admission.cluster_queue
-        if cq_name is None:
-            cq_name = store.cluster_queue_for(wl)
-        if cq_name is None or cq_name not in cqs:
+    for info in store.admitted_infos():
+        # CQ targeting + WorkloadInfo construction live in the store's
+        # admitted index (cached across cycles); skip CQs deleted since.
+        if info.cluster_queue not in cqs:
             continue
-        info = WorkloadInfo(wl, cluster_queue=cq_name)
         snapshot.add_workload(info)
     return snapshot

@@ -44,6 +44,16 @@ class Store:
         #: bumped whenever a CQ's quota config changes; invalidates flavor cursors
         self.cq_generation: dict[str, int] = {}
         self._watchers: list[Callable[[Event], None]] = []
+        #: index of workloads currently holding quota, maintained on every
+        #: workload write so per-cycle snapshot builds are O(admitted), not
+        #: O(all workloads) (the reference keeps admitted usage in a
+        #: dedicated cache fed by watches, pkg/cache/scheduler/cache.go)
+        self._admitted: dict[str, Workload] = {}
+        #: cached WorkloadInfo for admitted workloads; invalidated on write
+        self._admitted_infos: dict[str, object] = {}
+        #: generation of the global request-shaping config (LimitRanges /
+        #: resource transformations) the info cache was computed under
+        self._info_cache_gen = -1
 
     # -- watch -------------------------------------------------------------
 
@@ -126,19 +136,31 @@ class Store:
                 if pc is not None:
                     wl.priority = pc.value
             self.workloads[wl.key] = wl
+            self._index_workload(wl)
         self._emit("add", "Workload", wl)
 
     def update_workload(self, wl: Workload) -> None:
         with self._lock:
             self.workloads[wl.key] = wl
+            self._index_workload(wl)
         self._emit("update", "Workload", wl)
 
     def delete_workload(self, key: str) -> Optional[Workload]:
         with self._lock:
             wl = self.workloads.pop(key, None)
+            self._admitted.pop(key, None)
+            self._admitted_infos.pop(key, None)
         if wl is not None:
             self._emit("delete", "Workload", wl)
         return wl
+
+    def _index_workload(self, wl: Workload) -> None:
+        if wl.is_quota_reserved and not wl.is_finished:
+            self._admitted[wl.key] = wl
+        else:
+            self._admitted.pop(wl.key, None)
+        # The cached info reflects pre-write state; rebuild lazily.
+        self._admitted_infos.pop(wl.key, None)
 
     # -- readers -----------------------------------------------------------
 
@@ -148,6 +170,40 @@ class Store:
 
     def admitted_workloads(self) -> Iterable[Workload]:
         """Workloads holding quota (reserved and not finished)."""
-        for wl in self.workloads.values():
-            if wl.is_quota_reserved and not wl.is_finished:
-                yield wl
+        return list(self._admitted.values())
+
+    def admitted_infos(self) -> list:
+        """Cached WorkloadInfo for every admitted workload.
+
+        The cache is invalidated per workload on write and wholesale when
+        the request-shaping config (LimitRanges, transformations) changes,
+        so repeated snapshot builds don't recompute effective requests.
+        """
+        from kueue_oss_tpu.core import workload_info as wli
+
+        with self._lock:
+            gen = wli.requests_config_generation()
+            if gen != self._info_cache_gen:
+                self._admitted_infos.clear()
+                self._info_cache_gen = gen
+            out = []
+            for key, wl in self._admitted.items():
+                info = self._admitted_infos.get(key)
+                if info is None:
+                    # Usage is charged to the CQ recorded in the admission,
+                    # not the LocalQueue's current target (workload.go:299).
+                    if wl.status.admission is not None:
+                        info = wli.WorkloadInfo(
+                            wl,
+                            cluster_queue=wl.status.admission.cluster_queue)
+                        self._admitted_infos[key] = info
+                    else:
+                        # No recorded admission: the CQ comes from the
+                        # LocalQueue, which may be repointed at any time —
+                        # resolve fresh every call, never cache.
+                        cq_name = self.cluster_queue_for(wl)
+                        if cq_name is None:
+                            continue
+                        info = wli.WorkloadInfo(wl, cluster_queue=cq_name)
+                out.append(info)
+            return out

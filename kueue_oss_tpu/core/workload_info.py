@@ -23,18 +23,28 @@ _active_resources_config = None
 _limit_ranges: dict[str, dict[str, int]] = {}
 
 
+#: bumped on every config change; caches of computed requests key on it
+_requests_config_generation = 0
+
+
+def requests_config_generation() -> int:
+    return _requests_config_generation
+
+
 def set_resources_config(cfg) -> None:
     """Install Configuration.resources for request transformation
     (config.load callers wire this; None clears)."""
-    global _active_resources_config
+    global _active_resources_config, _requests_config_generation
     _active_resources_config = cfg
+    _requests_config_generation += 1
 
 
 def set_limit_ranges(by_namespace: dict[str, dict[str, int]]) -> None:
     """Install namespace LimitRange default-requests (pkg/workload/
     resources.go LimitRange adjustment; pkg/util/limitrange)."""
-    global _limit_ranges
+    global _limit_ranges, _requests_config_generation
     _limit_ranges = dict(by_namespace)
+    _requests_config_generation += 1
 
 
 def effective_per_pod_requests(ps, namespace: str) -> dict[str, int]:
@@ -141,6 +151,7 @@ class WorkloadInfo:
         #: queue-manager cycle at which this head was popped (for the
         #: mid-cycle capacity-freed flush check on requeue)
         self.pop_cycle = -1
+        self._scheduling_hash: Optional[tuple] = None
 
     @property
     def key(self) -> str:
@@ -162,11 +173,33 @@ class WorkloadInfo:
         return any(ps.min_count is not None for ps in self.obj.podsets)
 
     def scheduling_hash(self) -> tuple:
-        """Shape key for BestEffortFIFO NoFit dedup (workload.go:227-230)."""
-        return tuple(
-            (psr.name, psr.count, tuple(sorted(psr.requests.items())))
-            for psr in self.total_requests
-        )
+        """Shape key for BestEffortFIFO NoFit dedup: two workloads with the
+        same podset shapes, priority, and CQ are scheduling-equivalent — if
+        one got NoFit this cycle the other will too (workload.go:227-230,
+        computeSchedulingHash)."""
+        if self._scheduling_hash is None:
+            podsets = {ps.name: ps for ps in self.obj.podsets}
+
+            def ps_shape(psr: PodSetResources) -> tuple:
+                ps = podsets.get(psr.name)
+                topo = None
+                if ps is not None and ps.topology_request is not None:
+                    tr = ps.topology_request
+                    topo = (tr.required, tr.preferred, tr.unconstrained,
+                            tr.podset_group_name,
+                            tr.podset_slice_required_topology,
+                            tr.podset_slice_size)
+                return (psr.name, psr.count,
+                        ps.min_count if ps is not None else None,
+                        topo, tuple(sorted(psr.requests.items())))
+
+            self._scheduling_hash = (
+                self.cluster_queue,
+                effective_priority(self.obj),
+                self.obj.allowed_flavor,
+                tuple(ps_shape(psr) for psr in self.total_requests),
+            )
+        return self._scheduling_hash
 
     def __repr__(self) -> str:
         return f"WorkloadInfo({self.key}@{self.cluster_queue})"

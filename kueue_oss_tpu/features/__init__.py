@@ -31,6 +31,8 @@ _DEFAULTS: dict[str, bool] = {
     "ConcurrentAdmission": False,      # variant fan-out + migration hooks
     # MultiKueue orchestrated preemption (KEP-8303)
     "MultiKueueOrchestratedPreemption": False,  # scheduler gate check
+    # BestEffortFIFO NoFit equivalence-class dedup (kube_features.go)
+    "SchedulingEquivalenceHashing": True,  # queue_manager no-fit hashes
 }
 
 _lock = threading.Lock()

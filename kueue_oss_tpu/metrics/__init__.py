@@ -308,7 +308,19 @@ def report_cluster_queue_quotas(cq: str, quotas) -> None:
                 cq, flavor, resource, value=rq.lending_limit)
 
 
-def report_cluster_queue_usage(cq: str, usage: dict) -> None:
+def report_cluster_queue_usage(cq: str, usage: dict, spec_frs=None) -> None:
+    """spec_frs: every (flavor, resource) pair in the CQ's spec. Pairs whose
+    usage dropped to zero are absent from the snapshot usage dict but must
+    still report 0 — the reference emits a sample for every configured pair
+    (metrics.go ReportClusterQueueQuotas/usage, :733+)."""
+    if spec_frs is not None:
+        for fr in spec_frs:
+            if fr not in usage:
+                flavor, resource = fr
+                cluster_queue_resource_usage.set(
+                    cq, flavor, resource, value=0)
+                cluster_queue_resource_reservation.set(
+                    cq, flavor, resource, value=0)
     for (flavor, resource), q in usage.items():
         cluster_queue_resource_usage.set(cq, flavor, resource, value=q)
         cluster_queue_resource_reservation.set(cq, flavor, resource, value=q)

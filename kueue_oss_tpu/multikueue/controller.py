@@ -107,11 +107,17 @@ class MultiKueueController:
 
     def _sync_winner(self, wl: Workload, winner: str, state, now: float) -> None:
         cluster = self.clusters.get(winner)
-        lost = (cluster is None or not cluster.active
-                and now - (cluster.last_seen if cluster else 0.0)
-                >= self.worker_lost_timeout_s)
-        if cluster is not None and not cluster.active:
+        if cluster is None:
+            # Removed from config: the remote client is gone for good, so
+            # the workload is lost immediately — no workerLostTimeout grace
+            # (the timeout covers transient disconnects only;
+            # multikueuecluster.go removal vs watcher-reconnect handling).
+            lost = True
+        elif not cluster.active:
+            # Transiently unreachable: lost only past the grace window.
             lost = now - cluster.last_seen >= self.worker_lost_timeout_s
+        else:
+            lost = False
         if lost:
             # Worker lost past the timeout: redo the admission process
             # (workload.go remote-lost handling).

@@ -84,8 +84,10 @@ class BatchOracle:
     Built once per drain from the oracle forest; `verify_and_apply`
     checks a sequence of (cq_name, {FlavorResource: qty}) admissions in
     order, charging the ones that fit — semantically identical to calling
-    QuotaNode.fits + add_usage per admission (the Python fallback does
-    exactly that), but in native code when available.
+    QuotaNode.fits + add_usage per admission, but against the oracle's
+    OWN flattened state. Neither the native nor the Python path mutates
+    the QuotaNode objects passed to __init__; callers needing the charged
+    state read it from the oracle (or re-apply to their forest).
     """
 
     def __init__(self, cqs: dict[str, QuotaNode]) -> None:
@@ -149,12 +151,15 @@ class BatchOracle:
         lib = None if force_python else load()
         if lib is None:
             return self._python_verify(admissions, ok)
-        # Admissions naming a (flavor, resource) with no quota anywhere
-        # can never fit (available() over an unknown fr is <= 0); reject
-        # them up front instead of indexing them into the CSR arrays.
-        valid = [i for i, (_, usage) in enumerate(admissions)
-                 if all(q <= 0 or fr in self._fr_index
-                        for fr, q in usage.items())]
+        # Admissions naming a (flavor, resource) with no quota anywhere can
+        # never fit (available() over an unknown fr is <= 0), and a CQ
+        # absent from the forest (deleted since plan construction) cannot
+        # be charged; reject both up front instead of indexing them into
+        # the CSR arrays — mirrored by _python_verify.
+        valid = [i for i, (cq_name, usage) in enumerate(admissions)
+                 if cq_name in self._cq_node
+                 and all(q <= 0 or fr in self._fr_index
+                         for fr, q in usage.items())]
         node_idx = np.zeros(len(valid), dtype=np.int32)
         ptr = np.zeros(len(valid) + 1, dtype=np.int64)
         fr_l: list[int] = []
@@ -181,10 +186,50 @@ class BatchOracle:
         return ok
 
     def _python_verify(self, admissions, ok: np.ndarray) -> np.ndarray:
+        """Pure-Python mirror of oracle.cpp verify_plan over the same
+        flattened arrays — both paths charge ONLY the oracle's internal
+        state, never the QuotaNode objects passed to __init__ (callers that
+        reuse the forest after verification see identical state either way).
+        """
         for i, (cq_name, usage) in enumerate(admissions):
-            node = self._cqs[cq_name]
-            if node.fits(usage):
+            n = self._cq_node.get(cq_name)
+            if n is None:
+                continue
+            items = [(self._fr_index[fr], q) for fr, q in usage.items()
+                     if q > 0 and fr in self._fr_index]
+            if any(q > 0 and fr not in self._fr_index
+                   for fr, q in usage.items()):
+                continue  # unknown fr can never fit (available() <= 0)
+            if all(q <= self._available(n, j) for j, q in items):
                 ok[i] = 1
-                for fr, q in usage.items():
-                    node.add_usage(fr, q)
+                for j, q in items:
+                    self._add_usage(n, j, q)
         return ok
+
+    def _available(self, n: int, f: int) -> int:
+        """quota.py QuotaNode.available over the flattened arrays
+        (resource_node.go:104-118)."""
+        if self.parent[n] < 0:
+            return int(self.subtree[n, f] - self.usage[n, f])
+        parent_avail = self._available(int(self.parent[n]), f)
+        if self.has_borrow[n, f]:
+            stored_in_parent = int(self.subtree[n, f] - self.local_quota[n, f])
+            used_in_parent = max(
+                0, int(self.usage[n, f] - self.local_quota[n, f]))
+            with_max = (stored_in_parent - used_in_parent
+                        + int(self.borrow_limit[n, f]))
+            parent_avail = min(with_max, parent_avail)
+        local_avail = max(0, int(self.local_quota[n, f] - self.usage[n, f]))
+        return local_avail + parent_avail
+
+    def _add_usage(self, n: int, f: int, val: int) -> None:
+        """quota.py QuotaNode.add_usage bubbling (resource_node.go:137-146)."""
+        while True:
+            local_avail = max(
+                0, int(self.local_quota[n, f] - self.usage[n, f]))
+            self.usage[n, f] += val
+            p = int(self.parent[n])
+            if p < 0 or val <= local_avail:
+                return
+            val -= local_avail
+            n = p

@@ -183,7 +183,8 @@ class Scheduler:
             cq = snapshot.cluster_queues.get(name)
             if cq is None:
                 continue
-            metrics.report_cluster_queue_usage(cq.name, cq.node.usage)
+            metrics.report_cluster_queue_usage(
+                cq.name, cq.node.usage, spec_frs=cq.spec.flavor_resources())
             metrics.reserving_active_workloads.set(
                 cq.name, value=len(cq.workloads))
             if self.enable_fair_sharing:
@@ -207,10 +208,7 @@ class Scheduler:
         return cycles
 
     def _queue_fingerprint(self):
-        return tuple(sorted(
-            (name, tuple(sorted(q._in_heap)), tuple(sorted(q.inadmissible)))
-            for name, q in self.queues.queues.items()
-        ))
+        return self.queues.membership_fingerprint()
 
     # ------------------------------------------------------------------
     # Nomination
@@ -652,7 +650,9 @@ class Scheduler:
         self.admitted_total[e.info.cluster_queue] = (
             self.admitted_total.get(e.info.cluster_queue, 0) + 1)
         if (self.queues.afs is not None
-                and cq_spec.admission_scope is not None):
+                and cq_spec.admission_scope is not None
+                and cq_spec.admission_scope.admission_mode
+                == "UsageBasedAdmissionFairSharing"):
             # Entry penalty: charge the admitted usage to the LocalQueue
             # immediately (afs/entry_penalties.go).
             by_resource: dict[str, int] = {}

@@ -64,21 +64,19 @@ def test_usage_state_matches_after_batch(lending, borrowing):
     py_forest = build_forest(lending, borrowing)
     oracle = BatchOracle(native_forest.cqs)
     ok_n = oracle.verify_and_apply(plans)
-    ok_p = BatchOracle(py_forest.cqs).verify_and_apply(
-        plans, force_python=True)
+    oracle_py = BatchOracle(py_forest.cqs)
+    ok_p = oracle_py.verify_and_apply(plans, force_python=True)
     assert ok_n.tolist() == ok_p.tolist()
-    # the native flat usage must equal the python nodes' usage
-    for name, node in py_forest.cqs.items():
-        i = oracle._cq_node[name]
-        j = oracle._fr_index[("f", "cpu")]
-        assert oracle.usage[i, j] == node.usage.get(("f", "cpu"), 0), name
-        # and the cohort bubbling too
-        parent = node.parent
-        pi = oracle.parent[i]
-        while parent is not None:
-            assert oracle.usage[pi, j] == parent.usage.get(("f", "cpu"), 0)
-            parent = parent.parent
-            pi = oracle.parent[pi]
+    # Both paths charge the oracle's internal state identically (including
+    # cohort bubbling), and neither mutates the QuotaNodes.
+    assert oracle.usage.tolist() == oracle_py.usage.tolist()
+    for forest in (native_forest, py_forest):
+        for node in forest.cqs.values():
+            assert node.usage.get(("f", "cpu"), 0) == 0
+            parent = node.parent
+            while parent is not None:
+                assert parent.usage.get(("f", "cpu"), 0) == 0
+                parent = parent.parent
 
 
 def test_solver_drain_verify_uses_native(monkeypatch):
