@@ -51,14 +51,30 @@ class SolverEngine:
         self.queues = queues
 
     def supported(self) -> bool:
-        """The jitted drain models Fit/borrow admission; CQs with
-        preemption enabled need the oracle's target search."""
+        """Whether the drain can run on-device.
+
+        The full kernel covers classical preemption and multiple resource
+        groups; still host-only: admission fair sharing (LocalQueue-usage
+        queue ordering) and fair-sharing preemption (DRS tournament).
+        TAS shapes are rejected at export (UnsupportedProblem).
+        """
         for cq in self.store.cluster_queues.values():
-            if cq.preemption.any_enabled:
+            if cq.admission_scope is not None:
                 return False
-            if len(cq.resource_groups) > 1:
+            if (cq.fair_sharing is not None
+                    and cq.fair_sharing.weight != 1.0):
                 return False
         return True
+
+    def needs_full_kernel(self) -> bool:
+        """Preemption or multi-RG shapes run the unified-axis kernel; the
+        lean fit-only kernel stays for the uncontended case."""
+        for cq in self.store.cluster_queues.values():
+            if cq.preemption.any_enabled:
+                return True
+            if len(cq.resource_groups) > 1:
+                return True
+        return False
 
     def pending_backlog(self) -> dict[str, list[WorkloadInfo]]:
         """Current heap contents per CQ in rank (pop) order."""

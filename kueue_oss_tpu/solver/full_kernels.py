@@ -1,0 +1,1020 @@
+"""Preemption-capable jitted drain kernel (unified workload axis).
+
+Extends the fit-only drain (kernels.py) with the reference's preemption
+semantics, fully on-device:
+
+- batched classical candidate generation: legality masks from the
+  within-CQ / reclaim-within-cohort / borrowWithinCohort policies
+  (classical/candidate_generator.go:34-160), hierarchical-advantage rings
+  (hierarchical_preemption.go collectCandidatesForHierarchicalReclaim),
+  and the candidate ordering (common/ordering.go) as lexsort keys;
+- the remove-then-fill-back victim search (preemption.go:271-341) as a
+  masked lax.scan per preemptor, vmapped over the round's preempt-mode
+  heads;
+- the cycle contract of scheduler.go:286-467: entry ordering, one
+  overlapping-preemption skip, fits re-check under simulated removal of
+  already-preempted workloads, reserve-and-park for Preempt/NoCandidates.
+
+Admitted workloads live on the same axis as pending ones: eviction flips
+them back to pending (ordered by a per-round eviction timestamp rank,
+workload.Ordering semantics) so preemptors re-attempt the next round
+against the freed capacity, exactly like the host Simulator.
+
+Static caps (compile-time constants baked into the program):
+- H_MAX preempt-mode heads are searched per round; later ones wait a
+  round (the reference searches all, but its cycle admits at most one
+  conflicting entry anyway, so extra searches mostly re-run next cycle).
+- P_MAX candidates considered per search; a victim set needing more
+  candidates fails the search (NoCandidates semantics). The engine sizes
+  these from the problem.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kueue_oss_tpu.solver.kernels import (
+    M_FIT,
+    M_NOFIT,
+    M_PREEMPT,
+    _add_usage_along_path,
+    _avail_along_path,
+    available_all,
+    borrow_levels,
+    potential_available_all,
+    refresh_cohort_usage,
+)
+from kueue_oss_tpu.solver.tensors import (
+    BIG,
+    POLICY_ANY,
+    POLICY_LOWER_OR_NEWER_EQUAL,
+    POLICY_LOWER_PRIORITY,
+    POLICY_NEVER,
+    NO_THRESHOLD,
+    SolverProblem,
+)
+
+# candidate variants (classical/candidate_generator.go)
+V_NEVER = 0
+V_WITHIN_CQ = 1
+V_HIERARCHICAL_RECLAIM = 2
+V_RECLAIM_WITHOUT_BORROWING = 3
+V_RECLAIM_WHILE_BORROWING = 4
+
+
+class FullTensors(NamedTuple):
+    """Device-side mirror of the extended SolverProblem."""
+
+    parent: jnp.ndarray
+    depth: jnp.ndarray
+    height: jnp.ndarray
+    has_parent: jnp.ndarray
+    is_cq: jnp.ndarray
+    path: jnp.ndarray
+    subtree: jnp.ndarray
+    local_quota: jnp.ndarray
+    nominal: jnp.ndarray
+    has_borrow: jnp.ndarray
+    borrow_limit: jnp.ndarray
+    usage0: jnp.ndarray
+    cq_node: jnp.ndarray
+    cq_strict: jnp.ndarray
+    cq_try_next: jnp.ndarray
+    cq_nflavors: jnp.ndarray
+    cq_within_policy: jnp.ndarray
+    cq_reclaim_policy: jnp.ndarray
+    cq_bwc_forbidden: jnp.ndarray
+    cq_bwc_threshold: jnp.ndarray
+    cq_preempt_try_next: jnp.ndarray
+    cq_fair_weight: jnp.ndarray
+    cq_root: jnp.ndarray
+    cq_opt_group: jnp.ndarray    # [C, K]
+    cq_opt_pos: jnp.ndarray      # [C, K] position of option within its group
+    cq_ngroups: jnp.ndarray
+    wl_cqid: jnp.ndarray
+    wl_prio: jnp.ndarray
+    wl_ts0: jnp.ndarray
+    wl_uid: jnp.ndarray
+    wl_req: jnp.ndarray
+    wl_valid: jnp.ndarray
+    wl_parked0: jnp.ndarray
+    wl_admitted0: jnp.ndarray
+    wl_evicted0: jnp.ndarray
+    wl_admit_rank0: jnp.ndarray
+    ad_usage: jnp.ndarray
+    ts_evict_base: jnp.ndarray   # scalar int32
+    admit_rank_base: jnp.ndarray  # scalar int32
+
+
+def to_device_full(p: SolverProblem) -> FullTensors:
+    import numpy as np
+
+    is_cq = np.zeros(p.parent.shape[0], dtype=bool)
+    is_cq[p.cq_node] = True
+    # position of option k within its group, for per-group flavor cursors
+    C, K = p.cq_opt_group.shape if p.cq_opt_group is not None else (0, 1)
+    opt_pos = np.zeros((C, K), dtype=np.int32)
+    for c in range(C):
+        counts: dict[int, int] = {}
+        for k in range(K):
+            g = int(p.cq_opt_group[c, k])
+            if g < 0:
+                continue
+            opt_pos[c, k] = counts.get(g, 0)
+            counts[g] = counts.get(g, 0) + 1
+    return FullTensors(
+        parent=jnp.asarray(p.parent),
+        depth=jnp.asarray(p.depth),
+        height=jnp.asarray(p.height),
+        has_parent=jnp.asarray(p.has_parent),
+        is_cq=jnp.asarray(is_cq),
+        path=jnp.asarray(p.path),
+        subtree=jnp.asarray(p.subtree),
+        local_quota=jnp.asarray(p.local_quota),
+        nominal=jnp.asarray(p.nominal),
+        has_borrow=jnp.asarray(p.has_borrow),
+        borrow_limit=jnp.asarray(p.borrow_limit),
+        usage0=jnp.asarray(p.usage0),
+        cq_node=jnp.asarray(p.cq_node),
+        cq_strict=jnp.asarray(p.cq_strict),
+        cq_try_next=jnp.asarray(p.cq_try_next),
+        cq_nflavors=jnp.asarray(p.cq_nflavors),
+        cq_within_policy=jnp.asarray(p.cq_within_policy),
+        cq_reclaim_policy=jnp.asarray(p.cq_reclaim_policy),
+        cq_bwc_forbidden=jnp.asarray(p.cq_bwc_forbidden),
+        cq_bwc_threshold=jnp.asarray(p.cq_bwc_threshold),
+        cq_preempt_try_next=jnp.asarray(p.cq_preempt_try_next),
+        cq_fair_weight=jnp.asarray(p.cq_fair_weight),
+        cq_root=jnp.asarray(p.cq_root),
+        cq_opt_group=jnp.asarray(p.cq_opt_group),
+        cq_opt_pos=jnp.asarray(opt_pos),
+        cq_ngroups=jnp.asarray(p.cq_ngroups),
+        wl_cqid=jnp.asarray(p.wl_cqid),
+        wl_prio=jnp.asarray(p.wl_prio),
+        wl_ts0=jnp.asarray(p.wl_ts),
+        wl_uid=jnp.asarray(p.wl_uid),
+        wl_req=jnp.asarray(p.wl_req),
+        wl_valid=jnp.asarray(p.wl_valid),
+        wl_parked0=jnp.asarray(p.wl_parked0),
+        wl_admitted0=jnp.asarray(p.wl_admitted0),
+        wl_evicted0=jnp.asarray(p.wl_evicted0),
+        wl_admit_rank0=jnp.asarray(p.wl_admit_rank),
+        ad_usage=jnp.asarray(p.ad_usage),
+        ts_evict_base=jnp.asarray(p.ts_evict_base, dtype=jnp.int32),
+        admit_rank_base=jnp.asarray(p.admit_rank_base, dtype=jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# path helpers
+# ---------------------------------------------------------------------------
+
+
+def _remove_usage_along_path(t, usage: jnp.ndarray, cq_node: jnp.ndarray,
+                             val: jnp.ndarray) -> jnp.ndarray:
+    """removeUsage with bubbling (resource_node.go:147-158) along one path:
+    the parent's share shrinks by min(val, usage stored in parent)."""
+    path = t.path[cq_node]
+    null = t.parent.shape[0] - 1
+    for d in range(path.shape[0]):
+        node = path[d]
+        is_valid = node != null
+        stored = usage[node] - t.local_quota[node]
+        usage = usage.at[node].add(jnp.where(is_valid, -val, 0))
+        val = jnp.where(stored > 0, jnp.minimum(val, stored), 0)
+    return usage
+
+
+# ---------------------------------------------------------------------------
+# head selection: per-CQ min by (-priority, ts, uid) over the pending set
+# ---------------------------------------------------------------------------
+
+
+def select_heads_full(t: FullTensors, admitted, parked, ts):
+    C = t.cq_node.shape[0]
+    W1 = t.wl_cqid.shape[0]
+    W_null = W1 - 1
+    pending = ~admitted & ~parked
+    seg = t.wl_cqid[:-1]
+    prio_eff = jnp.where(pending[:-1], t.wl_prio[:-1], -BIG)
+    max_prio = jax.ops.segment_max(prio_eff, seg, num_segments=C + 1)[:C]
+    c1 = pending[:-1] & (t.wl_prio[:-1] == max_prio[seg])
+    ts_eff = jnp.where(c1, ts[:-1], BIG)
+    min_ts = jax.ops.segment_min(ts_eff, seg, num_segments=C + 1)[:C]
+    c2 = c1 & (ts[:-1] == min_ts[seg])
+    uid_eff = jnp.where(c2, t.wl_uid[:-1], BIG)
+    min_uid = jax.ops.segment_min(uid_eff, seg, num_segments=C + 1)[:C]
+    c3 = c2 & (t.wl_uid[:-1] == min_uid[seg])
+    w_idx = jnp.arange(W1 - 1, dtype=jnp.int32)
+    head_w = jax.ops.segment_min(
+        jnp.where(c3, w_idx, W_null), seg, num_segments=C + 1)[:C]
+    has_head = max_prio > -BIG
+    return jnp.where(has_head, head_w, W_null).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# per-group nomination
+# ---------------------------------------------------------------------------
+
+
+def nominate_full(t: FullTensors, usage, avail, pot, cand_w, cursor,
+                  g_max: int):
+    """Classify each CQ's head across (group, flavor) options.
+
+    Per resource group the walk mirrors findFlavorForPodSets: start at the
+    group's flavor cursor, prefer Fit per the whenCanBorrow policy, fall
+    back to Preempt. The entry's mode is the worst group mode; its usage
+    is the sum of the chosen options' requests. Returns (mode [C],
+    k_chosen [C, G], req_total [C, F], borrow [C], next_cursor [C, G]).
+    """
+    C, K = t.cq_opt_group.shape
+    req = t.wl_req[cand_w]                       # [C,K,F]
+    grp = t.cq_opt_group                         # [C,K]
+    pos = t.cq_opt_pos                           # [C,K]
+    cursor_k = jnp.take_along_axis(
+        cursor[cand_w], jnp.maximum(grp, 0), axis=1)  # [C,K]
+    valid = (t.wl_valid[cand_w] & (grp >= 0)
+             & (pos >= cursor_k))                # [C,K]
+
+    avail_cq = avail[t.cq_node][:, None, :]
+    pot_cq = pot[t.cq_node][:, None, :]
+    nominal_cq = t.nominal[t.cq_node][:, None, :]
+    level, may_reclaim = borrow_levels(t, usage, cand_w)
+
+    nonzero = req > 0
+    fit_fr = (~nonzero) | (req <= avail_cq)
+    within_cap = (~nonzero) | (req <= pot_cq)
+    # flavorassigner.go:1071-1108: preemption is considered when the value
+    # is within nominal, a higher subtree could reclaim, or the CQ may
+    # preempt while borrowing (borrowWithinCohort enabled)
+    can_pwb = (~t.cq_bwc_forbidden)[:, None, None]
+    preemptish_fr = (~nonzero) | (
+        within_cap & ((req <= nominal_cq) | may_reclaim | can_pwb))
+    opt_fit = valid & jnp.all(fit_fr, axis=-1)
+    opt_preempt = valid & jnp.all(fit_fr | preemptish_fr, axis=-1)
+    opt_level = jnp.max(jnp.where(nonzero, level, 0), axis=-1)  # [C,K]
+
+    k_idx = jnp.arange(K, dtype=jnp.int32)[None, :]
+    group_active = jnp.zeros((C, g_max), dtype=bool)
+    mode = jnp.full((C,), M_FIT, dtype=jnp.int32)
+    k_chosen = jnp.zeros((C, g_max), dtype=jnp.int32)
+    next_cursor = jnp.zeros((C, g_max), dtype=jnp.int32)
+    req_total = jnp.zeros((C, req.shape[2]), dtype=req.dtype)
+    borrow = jnp.zeros((C,), dtype=jnp.int32)
+
+    for g in range(g_max):
+        in_g = grp == g                          # [C,K]
+        has_g = jnp.any(in_g, axis=1)
+        active = jnp.any(in_g & jnp.any(nonzero, axis=-1), axis=1)
+        group_active = group_active.at[:, g].set(active)
+        fit_g = opt_fit & in_g
+        pre_g = opt_preempt & in_g & ~opt_fit
+
+        def first_true(mask):
+            return jnp.min(jnp.where(mask, k_idx, K), axis=1)
+
+        k_default = first_true(fit_g)
+        k_nonborrow = first_true(fit_g & (opt_level == 0))
+        lvl_key = jnp.where(fit_g, opt_level * K + k_idx, BIG)
+        k_bestlvl = jnp.argmin(lvl_key, axis=1).astype(jnp.int32)
+        k_try_next = jnp.where(
+            k_nonborrow < K, k_nonborrow,
+            jnp.where(jnp.any(fit_g, axis=1), k_bestlvl, K))
+        k_fit = jnp.where(t.cq_try_next, k_try_next, k_default)
+        any_fit = k_fit < K
+        k_preempt = first_true(pre_g)
+        any_preempt = k_preempt < K
+        k_g = jnp.where(any_fit, k_fit,
+                        jnp.where(any_preempt, k_preempt,
+                                  first_true(in_g))).astype(jnp.int32)
+        k_g = jnp.minimum(k_g, K - 1)
+        mode_g = jnp.where(any_fit, M_FIT,
+                           jnp.where(any_preempt, M_PREEMPT, M_NOFIT))
+        # Inactive groups (no requested resources) are vacuous fits.
+        mode_g = jnp.where(active & has_g, mode_g, M_FIT)
+        mode = jnp.minimum(mode, mode_g)
+        k_chosen = k_chosen.at[:, g].set(jnp.where(active, k_g, 0))
+        take = jnp.take_along_axis
+        req_g = take(req, k_g[:, None, None], axis=1)[:, 0, :]
+        req_total = req_total + jnp.where(active[:, None], req_g, 0)
+        borrow_g = take(opt_level, k_g[:, None], axis=1)[:, 0]
+        borrow = jnp.maximum(borrow, jnp.where(active, borrow_g, 0))
+        # flavor cursor per group (flavorassigner.go:843 LastTriedFlavorIdx)
+        early_break = jnp.where(t.cq_try_next, k_nonborrow < K, any_fit)
+        pos_g = take(pos, k_g[:, None], axis=1)[:, 0]
+        n_in_g = jnp.sum(in_g, axis=1)
+        nc = jnp.where(early_break & (pos_g < n_in_g - 1), pos_g + 1, 0)
+        next_cursor = next_cursor.at[:, g].set(
+            jnp.where(active, nc, 0).astype(jnp.int32))
+
+    return (mode, k_chosen, req_total, borrow, next_cursor,
+            opt_fit, opt_preempt, opt_level, group_active)
+
+
+def refine_preempt_option(t: FullTensors, usage, over_all, wl_usage,
+                          admitted, ts, head_w, avail_cq, opt_fit_row,
+                          opt_preempt_row, opt_level_row, k_chosen_row,
+                          group_active_row, g_max: int):
+    """Re-pick preempt-mode flavors skipping options with no candidates.
+
+    Mirrors SimulatePreemption's NoCandidates feeding shouldTryNextFlavor
+    (flavorassigner.go:1000-1017 + preemption_oracle.go): a flavor whose
+    candidate set is empty is skipped in favor of a later flavor with
+    candidates; if none has candidates the first preempt-capable flavor
+    is kept (reserve + park follows). Runs per preempt-mode head (vmap).
+
+    Returns (k_chosen [G], req [F], borrow).
+    """
+    W1 = t.wl_cqid.shape[0]
+    C = t.cq_node.shape[0]
+    K = t.cq_opt_group.shape[1]
+    null_node = t.parent.shape[0] - 1
+    D = t.path.shape[1]
+    cqid = t.wl_cqid[head_w]
+    cqi = jnp.minimum(cqid, C - 1)
+    cq_node = t.cq_node[cqi]
+    my_path = t.path[cq_node]
+
+    req_k = t.wl_req[head_w]                     # [K, F]
+    frs_k = (req_k > 0) & (req_k > avail_cq[None, :])  # [K, F]
+
+    # policy-legal candidates (frs-independent part)
+    cand_cqid = t.wl_cqid[:-1]
+    cand_node = t.cq_node[jnp.minimum(cand_cqid, C - 1)]
+    is_adm = admitted[:-1] & (jnp.arange(W1 - 1) != head_w)
+    same_cq = cand_cqid == cqid
+    prio_p = t.wl_prio[head_w]
+    ts_p = ts[head_w]
+    lower = prio_p > t.wl_prio[:-1]
+    newer_eq = (prio_p == t.wl_prio[:-1]) & (ts_p < ts[:-1])
+    policy = jnp.where(same_cq, t.cq_within_policy[cqi],
+                       t.cq_reclaim_policy[cqi])
+    sat = jnp.where(
+        policy == POLICY_NEVER, False,
+        jnp.where(policy == POLICY_LOWER_PRIORITY, lower,
+                  jnp.where(policy == POLICY_LOWER_OR_NEWER_EQUAL,
+                            lower | newer_eq, policy == POLICY_ANY)))
+    cand_path = t.path[cand_node]
+    anc = (cand_path[:, :, None] == my_path[None, None, :])
+    is_anc = jnp.any(anc, axis=1) & (my_path[None, :] != null_node)
+    d_idx = jnp.arange(D, dtype=jnp.int32)[None, :]
+    lca_d = jnp.min(jnp.where(is_anc, d_idx, D), axis=1)
+    other_ok = (lca_d >= 1) & (lca_d < D)
+    legal0 = is_adm & sat
+
+    # per-option masks, factorized over the FR axis
+    used = (wl_usage[:-1] > 0).astype(jnp.int32)        # [W, F]
+    uses_k = (used @ frs_k.T.astype(jnp.int32)) > 0     # [W, K]
+    over_cand = over_all[cand_node].astype(jnp.int32)   # [W, F]
+    cq_over_k = (over_cand @ frs_k.T.astype(jnp.int32)) > 0  # [W, K]
+    # path-below-LCA: every cohort strictly below the LCA must be over
+    # nominal on some needed fr
+    lca_node = my_path[jnp.minimum(lca_d, D - 1)]
+    seen_lca = jnp.cumsum(
+        (cand_path == lca_node[:, None]).astype(jnp.int32), axis=1) > 0
+    below = (~seen_lca) & (cand_path != null_node)
+    below = below.at[:, 0].set(False)                   # [W, D]
+    over_path = over_all[cand_path].astype(jnp.int32)   # [W, D, F]
+    node_over_k = jnp.einsum("wdf,kf->wdk", over_path,
+                             frs_k.astype(jnp.int32)) > 0
+    path_ok_k = jnp.all(~below[:, :, None] | node_over_k, axis=1)  # [W, K]
+
+    legal_k = (legal0[:, None] & uses_k
+               & (same_cq[:, None]
+                  | (other_ok[:, None] & cq_over_k & path_ok_k)))
+    has_cand = jnp.any(legal_k, axis=0)                 # [K]
+
+    grp = t.cq_opt_group[cqi]                           # [K]
+    k_idx = jnp.arange(K, dtype=jnp.int32)
+    k_out = jnp.zeros((g_max,), dtype=jnp.int32)
+    req = jnp.zeros((req_k.shape[1],), dtype=req_k.dtype)
+    borrow = jnp.zeros((), dtype=jnp.int32)
+    for g in range(g_max):
+        in_g = grp == g
+        keep_fit = opt_fit_row[k_chosen_row[g]] & (grp[k_chosen_row[g]] == g)
+        pre_cand = jnp.min(jnp.where(
+            in_g & opt_preempt_row & has_cand, k_idx, K))
+        pre_any = jnp.min(jnp.where(in_g & opt_preempt_row, k_idx, K))
+        k_pre = jnp.where(pre_cand < K, pre_cand,
+                          jnp.minimum(pre_any, K - 1))
+        k_g = jnp.where(keep_fit, k_chosen_row[g], k_pre).astype(jnp.int32)
+        k_out = k_out.at[g].set(jnp.where(group_active_row[g], k_g, 0))
+        req = req + jnp.where(group_active_row[g], req_k[k_g], 0)
+        borrow = jnp.maximum(
+            borrow, jnp.where(group_active_row[g], opt_level_row[k_g], 0))
+    return k_out, req, borrow
+
+
+# ---------------------------------------------------------------------------
+# classical preemption search (one preemptor; vmapped over lanes)
+# ---------------------------------------------------------------------------
+
+
+def _within_nominal_frs(t, usage, node, frs_mask):
+    """is_within_nominal over the masked FRs at one node."""
+    return jnp.all(~frs_mask | (usage[node] <= t.subtree[node]))
+
+
+def _workload_fits(t, usage, cq_node, req, allow_borrow):
+    """_workload_fits (preemption.py:555): every requested fr must fit
+    available(), and without allow_borrow must not push the CQ above its
+    subtree quota."""
+    avail = _avail_along_path(t, usage, cq_node)
+    nz = req > 0
+    fits_avail = jnp.all(~nz | (req <= avail))
+    no_borrow_ok = jnp.all(
+        ~nz | (usage[cq_node] + req <= t.subtree[cq_node]))
+    return fits_avail & (allow_borrow | no_borrow_ok)
+
+
+def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
+                     evicted_f, ts, admit_rank, head_w, req, avail_cq,
+                     p_max: int):
+    """Victim search for ONE preemptor (vmap over lanes).
+
+    Returns (success, victim_w [P] int32 (W_null padded), victim_valid [P]
+    bool, victim_reason [P] int8). Mirrors Preemptor._classical_preemptions:
+    candidate generation + ordering, two allow-borrowing attempts of the
+    remove-until-fits scan, then fillBackWorkloads.
+    """
+    W1 = t.wl_cqid.shape[0]
+    W_null = W1 - 1
+    null_node = t.parent.shape[0] - 1
+    D = t.path.shape[1]
+    cqid = t.wl_cqid[head_w]
+    cq_node = t.cq_node[jnp.minimum(cqid, t.cq_node.shape[0] - 1)]
+    my_path = t.path[cq_node]                    # [D]
+
+    # FRs needing preemption: requested and not fitting current avail
+    frs_mask = (req > 0) & (req > avail_cq)      # [F]
+
+    # ---- candidate legality (candidate_generator.go:34-160) -------------
+    cand_cqid = t.wl_cqid[:-1]
+    cand_node = t.cq_node[jnp.minimum(cand_cqid, t.cq_node.shape[0] - 1)]
+    is_adm = admitted[:-1] & (jnp.arange(W1 - 1) != head_w)
+    uses = jnp.any(wl_usage[:-1] * frs_mask[None, :] > 0, axis=1)
+    same_cq = cand_cqid == cqid
+
+    prio_p = t.wl_prio[head_w]
+    ts_p = ts[head_w]
+    lower = prio_p > t.wl_prio[:-1]
+    newer_eq = (prio_p == t.wl_prio[:-1]) & (ts_p < ts[:-1])
+    policy = jnp.where(same_cq, t.cq_within_policy[jnp.minimum(
+        cqid, t.cq_node.shape[0] - 1)], t.cq_reclaim_policy[jnp.minimum(
+            cqid, t.cq_node.shape[0] - 1)])
+    sat = jnp.where(
+        policy == POLICY_NEVER, False,
+        jnp.where(policy == POLICY_LOWER_PRIORITY, lower,
+                  jnp.where(policy == POLICY_LOWER_OR_NEWER_EQUAL,
+                            lower | newer_eq, policy == POLICY_ANY)))
+    legal = is_adm & uses & sat
+
+    # ---- LCA ring + hierarchical advantage ------------------------------
+    # lca_d[a] = first index on MY path that is an ancestor of cand's CQ
+    cand_path = t.path[cand_node]                # [W, D]
+    anc = (cand_path[:, :, None] == my_path[None, None, :])  # [W, Dc, Dp]
+    is_anc = jnp.any(anc, axis=1)                # [W, Dp]
+    is_anc = is_anc & (my_path[None, :] != null_node)
+    d_idx = jnp.arange(D, dtype=jnp.int32)[None, :]
+    lca_d = jnp.min(jnp.where(is_anc, d_idx, D), axis=1)  # [W]
+    other_ok = (lca_d >= 1) & (lca_d < D)        # shares a cohort tree
+
+    # advantage chain along my path (hierarchical_preemption.go)
+    adv_at = jnp.zeros((D,), dtype=bool)
+    adv = jnp.all(usage0_round[cq_node] + req <= t.subtree[cq_node])
+    rem = jnp.maximum(
+        0, req - jnp.maximum(0, t.local_quota[cq_node]
+                             - usage0_round[cq_node]))
+    for d in range(1, D):
+        node = my_path[d]
+        ok = node != null_node
+        adv_at = adv_at.at[d].set(adv)
+        fits_d = jnp.all(usage0_round[node] + rem <= t.subtree[node]) & ok
+        rem = jnp.maximum(
+            0, rem - jnp.maximum(0, t.local_quota[node]
+                                 - usage0_round[node]))
+        adv = adv | fits_d
+    hier_adv = adv_at[jnp.minimum(lca_d, D - 1)]  # [W]
+
+    # collection-time within-nominal pruning (round-start usage): the
+    # candidate's CQ and every cohort strictly below the LCA must be
+    # over nominal for some needed fr (_collect_in_subtree)
+    def not_within(node):
+        return ~jnp.all(
+            ~frs_mask[None, :]
+            | (usage0_round[node] <= t.subtree[node]))
+
+    cand_over = not_within(cand_node)            # [W]
+    # cohorts on cand's path strictly below the LCA: path entries before
+    # the one equal to my_path[lca_d]
+    lca_node = my_path[jnp.minimum(lca_d, D - 1)]            # [W]
+    seen_lca = jnp.cumsum(
+        (cand_path == lca_node[:, None]).astype(jnp.int32), axis=1) > 0
+    strictly_below = (~seen_lca) & (cand_path != null_node)
+    # skip position 0 (the CQ itself, checked via cand_over)
+    strictly_below = strictly_below.at[:, 0].set(False)
+    path_over = jnp.all(
+        ~strictly_below
+        | ~jnp.all(~frs_mask[None, None, :]
+                   | (usage0_round[cand_path]
+                      <= t.subtree[cand_path]), axis=2),
+        axis=1)                                   # [W]
+    other_legal = legal & ~same_cq & other_ok & cand_over & path_over
+    same_legal = legal & same_cq
+    legal_all = other_legal | same_legal
+
+    # ---- variants & groups ----------------------------------------------
+    cqi = jnp.minimum(cqid, t.cq_node.shape[0] - 1)
+    thr = t.cq_bwc_threshold[cqi]
+    above_thr = (t.wl_prio[:-1] >= prio_p) | (
+        (thr != NO_THRESHOLD) & (t.wl_prio[:-1] > thr))
+    variant = jnp.where(
+        same_cq, V_WITHIN_CQ,
+        jnp.where(hier_adv, V_HIERARCHICAL_RECLAIM,
+                  jnp.where(t.cq_bwc_forbidden[cqi] | above_thr,
+                            V_RECLAIM_WITHOUT_BORROWING,
+                            V_RECLAIM_WHILE_BORROWING)))
+    group_rank = jnp.where(same_cq, 2, jnp.where(hier_adv, 0, 1))
+
+    # ---- ordering (common/ordering.go CandidatesOrdering) ---------------
+    not_evicted = ~evicted_f[:-1]
+    order = jnp.lexsort((
+        t.wl_uid[:-1],
+        -admit_rank[:-1],        # more recently admitted first
+        t.wl_prio[:-1],          # lower priority first
+        group_rank,
+        not_evicted,             # evicted first
+        ~legal_all,              # legal candidates to the front
+    ))
+    sorted_legal = legal_all[order]
+    pos = jnp.cumsum(sorted_legal.astype(jnp.int32)) - 1
+    cand_w = jnp.full((p_max,), W_null, dtype=jnp.int32)
+    cand_w = cand_w.at[jnp.where(sorted_legal, pos, p_max)].set(
+        order.astype(jnp.int32), mode="drop")
+    cand_valid = cand_w != W_null
+    cand_variant = jnp.where(cand_valid, variant[
+        jnp.minimum(cand_w, W1 - 2)], V_NEVER)
+    cand_lca = jnp.where(cand_valid,
+                         lca_d[jnp.minimum(cand_w, W1 - 2)], 0)
+
+    # ---- attempt schedule (preemption.py:508-515) -----------------------
+    no_other = ~jnp.any(other_legal)
+    no_hier = ~jnp.any(other_legal & hier_adv)
+    under_nominal = jnp.all(
+        ~frs_mask | (usage0_round[cq_node] < t.nominal[cq_node]))
+    bwc_forbidden = t.cq_bwc_forbidden[cqi]
+    single = no_other | (bwc_forbidden & ~under_nominal)
+    f_then_t = ~single & bwc_forbidden & no_hier
+    first_borrow = jnp.where(single, True, jnp.where(f_then_t, False, True))
+    second_borrow = jnp.where(f_then_t, True, False)
+    has_second = ~single
+
+    # ---- the remove-until-fits scan (one attempt) -----------------------
+    def attempt(allow_borrow, run):
+        def step(carry, i):
+            usage_l, victims, fitted = carry
+            a = cand_w[i]
+            a_cqid = t.wl_cqid[a]
+            a_node = t.cq_node[jnp.minimum(a_cqid, t.cq_node.shape[0] - 1)]
+            var = cand_variant[i]
+            # pop-time validity (_valid, candidate_generator.go)
+            vb = ~(allow_borrow & (var == V_RECLAIM_WITHOUT_BORROWING))
+            is_same = a_cqid == cqid
+            cq_over = ~jnp.all(
+                ~frs_mask | (usage_l[a_node] <= t.subtree[a_node]))
+            a_path = t.path[a_node]
+            lnode = my_path[jnp.minimum(cand_lca[i], D - 1)]
+            seen = jnp.cumsum(
+                (a_path == lnode).astype(jnp.int32)) > 0
+            below = (~seen) & (a_path != null_node)
+            below = below.at[0].set(False)
+            path_ok = jnp.all(
+                ~below | ~jnp.all(
+                    ~frs_mask[None, :]
+                    | (usage_l[a_path] <= t.subtree[a_path]), axis=1))
+            valid = cand_valid[i] & vb & (
+                is_same | (cq_over & path_ok))
+            do = valid & ~fitted & run
+            u_row = jnp.where(do, wl_usage[a], 0)
+            usage_l = _remove_usage_along_path(t, usage_l, a_node, u_row)
+            victims = victims.at[i].set(do)
+            fitted = fitted | (do & _workload_fits(
+                t, usage_l, cq_node, req, allow_borrow))
+            return (usage_l, victims, fitted), None
+
+        init = (usage0_round, jnp.zeros((p_max,), dtype=bool),
+                jnp.zeros((), dtype=bool))
+        (usage_l, victims, fitted), _ = jax.lax.scan(
+            step, init, jnp.arange(p_max))
+
+        # fillBackWorkloads: re-add earlier victims (excluding the last
+        # removed) newest-first while the preemptor still fits
+        last_idx = jnp.max(jnp.where(victims, jnp.arange(p_max), -1))
+
+        def fb_step(carry, i):
+            usage_l, victims = carry
+            j = p_max - 1 - i
+            a = cand_w[j]
+            a_node = t.cq_node[jnp.minimum(
+                t.wl_cqid[a], t.cq_node.shape[0] - 1)]
+            tryit = victims[j] & (j < last_idx) & fitted
+            u_row = jnp.where(tryit, wl_usage[a], 0)
+            usage_l = _add_usage_along_path(t, usage_l, a_node, u_row)
+            still = _workload_fits(t, usage_l, cq_node, req, allow_borrow)
+            # fit held -> the candidate stays re-added (not a victim);
+            # fit broke -> undo the re-add, it remains a victim
+            usage_l = _remove_usage_along_path(
+                t, usage_l, a_node, jnp.where(tryit & ~still, u_row, 0))
+            victims = victims.at[j].set(victims[j] & ~(tryit & still))
+            return (usage_l, victims), None
+
+        (usage_l, victims), _ = jax.lax.scan(
+            fb_step, (usage_l, victims), jnp.arange(p_max))
+        return fitted, victims
+
+    ok1, v1 = attempt(first_borrow, jnp.ones((), dtype=bool))
+    ok2, v2 = attempt(second_borrow, has_second & ~ok1)
+    success = ok1 | ok2
+    victims = jnp.where(ok1, v1, jnp.where(ok2, v2, False))
+    reason = jnp.where(victims, cand_variant, V_NEVER).astype(jnp.int8)
+    return success, cand_w, victims, reason
+
+
+# ---------------------------------------------------------------------------
+# round scan: entry processing with preemption issue (scheduler.go:337-467)
+# ---------------------------------------------------------------------------
+
+
+def _quota_to_reserve(t, usage, cq_node, req, borrow):
+    """scheduler.go quotaResourcesToReserve for Preempt/NoCandidates."""
+    usage_cq = usage[cq_node]
+    nominal_cq = t.nominal[cq_node]
+    bl = t.borrow_limit[cq_node]
+    reserve_borrowing = jnp.where(
+        t.has_borrow[cq_node],
+        jnp.minimum(req, nominal_cq + bl - usage_cq), req)
+    reserve_nominal = jnp.minimum(req, nominal_cq - usage_cq)
+    return jnp.maximum(
+        0, jnp.where(borrow > 0, reserve_borrowing, reserve_nominal))
+
+
+def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
+                    borrow, lane_of_entry, lane_success, lane_cand_w,
+                    lane_victims, p_max: int):
+    """Process the round's entries in order; returns updated state parts.
+
+    state: (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
+            victims_all)
+    """
+    C = cand_w.shape[0]
+    W1 = t.wl_cqid.shape[0]
+    W_null = W1 - 1
+
+    prio = t.wl_prio[cand_w]
+    ts_o = state["ts"][cand_w]
+    uid = t.wl_uid[cand_w]
+    active = (cand_w != W_null) & (mode != M_NOFIT)
+    sort_borrow = jnp.where(active, borrow, BIG)
+    order = jnp.lexsort((uid, ts_o, -prio, sort_borrow))
+
+    def step(carry, slot):
+        (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
+         victims_all, any_adm, any_evict) = carry
+        w, cqid, m, req, brw, lane = slot
+        cq_node = t.cq_node[jnp.minimum(cqid, C - 1)]
+        is_active = (w != W_null) & (m != M_NOFIT)
+        searched = lane >= 0
+        lane_i = jnp.maximum(lane, 0)
+        has_targets = searched & lane_success[lane_i]
+
+        # --- Preempt / NoCandidates: reserve entitled capacity & park ----
+        is_reserve = is_active & (m == M_PREEMPT) & searched & ~has_targets
+        reserve = jnp.where(
+            is_reserve,
+            _quota_to_reserve(t, usage_full, cq_node, req, brw), 0)
+        usage_full = _add_usage_along_path(t, usage_full, cq_node, reserve)
+        usage_net = _add_usage_along_path(t, usage_net, cq_node, reserve)
+        parked = parked.at[w].set(
+            parked[w] | (is_reserve & ~t.cq_strict[jnp.minimum(cqid, C - 1)]))
+
+        # --- overlap check (one conflicting preemption per cycle) --------
+        vm = lane_victims[lane_i]                       # [P]
+        vw = lane_cand_w[lane_i]                        # [P]
+        overlap = jnp.any(vm & victims_all[vw])
+        is_preempt = is_active & (m == M_PREEMPT) & has_targets & ~overlap
+
+        # --- fits re-check under removal of own targets (the preempted
+        # set is already excluded from usage_net by earlier steps) --------
+        def remove_victims(u, flag):
+            def rv(u_c, i):
+                a = vw[i]
+                a_node = t.cq_node[jnp.minimum(t.wl_cqid[a], C - 1)]
+                row = jnp.where(flag & vm[i], wl_usage[a], 0)
+                return _remove_usage_along_path(t, u_c, a_node, row), None
+
+            u, _ = jax.lax.scan(rv, u, jnp.arange(p_max))
+            return u
+
+        usage_probe = remove_victims(usage_net, is_preempt)
+        avail_now = _avail_along_path(t, usage_probe, cq_node)
+        still_fits = jnp.all((req == 0) | (req <= avail_now))
+
+        # --- issue preemptions (scheduler.go issuePreemptions) -----------
+        do_preempt = is_preempt & still_fits
+        usage_net = jnp.where(do_preempt, usage_probe, usage_net)
+        evict_now = do_preempt & vm                     # [P]
+        victims_all = victims_all.at[vw].max(evict_now, mode="drop")
+        victims_all = victims_all.at[W_null].set(False)
+        admitted = admitted.at[vw].min(~evict_now, mode="drop")
+        # durable rows: victims' usage leaves their CQ row (P-sized scatter)
+        v_nodes = t.cq_node[jnp.minimum(t.wl_cqid[vw], C - 1)]
+        cq_rows = cq_rows.at[v_nodes].add(
+            -jnp.where(evict_now[:, None], wl_usage[vw], 0), mode="drop")
+        # the preemptor charges its assignment usage for the rest of the
+        # round (scheduler.go:434 cq.add_usage before issuePreemptions)
+        entry_usage = jnp.where(do_preempt, req, 0)
+        usage_full = _add_usage_along_path(t, usage_full, cq_node, entry_usage)
+        usage_net = _add_usage_along_path(t, usage_net, cq_node, entry_usage)
+        any_evict = any_evict | do_preempt
+
+        # --- Fit: re-check then admit ------------------------------------
+        avail_fit = _avail_along_path(t, usage_net, cq_node)
+        fit_ok = jnp.all((req == 0) | (req <= avail_fit))
+        do_admit = is_active & (m == M_FIT) & fit_ok
+        admit_vec = jnp.where(do_admit, req, 0)
+        usage_full = _add_usage_along_path(t, usage_full, cq_node, admit_vec)
+        usage_net = _add_usage_along_path(t, usage_net, cq_node, admit_vec)
+        cq_rows = cq_rows.at[cq_node].add(admit_vec)
+        admitted = admitted.at[w].set(admitted[w] | do_admit)
+        wl_usage = wl_usage.at[w].set(
+            jnp.where(do_admit, req, wl_usage[w]))
+        any_adm = any_adm | do_admit
+        return (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
+                victims_all, any_adm, any_evict), do_admit
+
+    slots = (cand_w[order], jnp.arange(C, dtype=jnp.int32)[order],
+             mode[order], req_c[order], borrow[order], lane_of_entry[order])
+    init = (state["usage_full"], state["usage_net"], state["cq_rows"],
+            state["admitted"], state["parked"], state["wl_usage"],
+            state["victims_all"], jnp.zeros((), dtype=bool),
+            jnp.zeros((), dtype=bool))
+    (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
+     victims_all, any_adm, any_evict), admitted_slot = jax.lax.scan(
+        step, init, slots)
+    # map per-slot admit flags back to entry order
+    adm_entry = jnp.zeros((C,), dtype=bool).at[order].set(admitted_slot)
+    return {
+        "usage_full": usage_full, "usage_net": usage_net,
+        "cq_rows": cq_rows, "admitted": admitted, "parked": parked,
+        "wl_usage": wl_usage, "victims_all": victims_all,
+    }, adm_entry, any_adm, any_evict
+
+
+# ---------------------------------------------------------------------------
+# the drain loop
+# ---------------------------------------------------------------------------
+
+
+def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
+               p_max: int):
+    """One reference cycle (shared by the jitted loop and debug_drain)."""
+    W1 = t.wl_cqid.shape[0]
+    C = t.cq_node.shape[0]
+    N1 = t.parent.shape[0]
+    W_null = W1 - 1
+
+    rounds = state["rounds"]
+    admitted = state["admitted"]
+    parked = state["parked"]
+    ts = state["ts"]
+    usage = state["usage"]          # round-start (victims charged)
+    wl_usage = state["wl_usage"]
+    parked_before = parked
+    cursor_before = state["cursor"]
+
+    cand_w = select_heads_full(t, admitted, parked, ts)
+    avail = available_all(t, usage)
+    (mode, k_chosen, req_c, borrow, next_cursor,
+     opt_fit, opt_preempt, opt_level, group_active) = nominate_full(
+        t, usage, avail, pot, cand_w, state["cursor"], g_max)
+
+    # park NoFit heads of BestEffortFIFO queues
+    is_head = cand_w != W_null
+    park_now = is_head & (mode == M_NOFIT) & ~t.cq_strict
+    parked = parked.at[cand_w].set(parked[cand_w] | park_now)
+
+    # ---- compact preempt-mode heads into H_MAX search lanes -----
+    preempt_head = is_head & (mode == M_PREEMPT)
+    ekey = jnp.lexsort((
+        t.wl_uid[cand_w], ts[cand_w], -t.wl_prio[cand_w],
+        jnp.where(preempt_head, borrow, BIG), ~preempt_head))
+    pe_sorted = preempt_head[ekey]
+    pos = jnp.cumsum(pe_sorted.astype(jnp.int32)) - 1
+    lane_cq = jnp.full((h_max,), C, dtype=jnp.int32)
+    lane_cq = lane_cq.at[jnp.where(pe_sorted, pos, h_max)].set(
+        ekey.astype(jnp.int32), mode="drop")
+    lane_valid = lane_cq < C
+    lane_cqc = jnp.minimum(lane_cq, C - 1)
+    lane_w = jnp.where(lane_valid, cand_w[lane_cqc], W_null)
+    lane_avail = avail[t.cq_node[lane_cqc]]
+    lane_of_entry = jnp.full((C,), -1, dtype=jnp.int32)
+    lane_of_entry = lane_of_entry.at[
+        jnp.where(lane_valid, lane_cq, C)].set(
+        jnp.arange(h_max, dtype=jnp.int32), mode="drop")
+
+    # re-pick flavors for preempt heads, skipping NoCandidates options
+    over_all = usage > t.subtree
+    refine = jax.vmap(
+        lambda hw, av, of, op, ol, kc, ga: refine_preempt_option(
+            t, usage, over_all, wl_usage, admitted, ts, hw, av, of, op,
+            ol, kc, ga, g_max))
+    lane_k, lane_req_r, lane_borrow = refine(
+        lane_w, lane_avail, opt_fit[lane_cqc], opt_preempt[lane_cqc],
+        opt_level[lane_cqc], k_chosen[lane_cqc], group_active[lane_cqc])
+    lane_req = jnp.where(lane_valid[:, None], lane_req_r, 0)
+    # the refined choice replaces the entry's requests/borrow for the scan
+    lane_target = jnp.where(lane_valid, lane_cq, C)
+    req_c = req_c.at[lane_target].set(lane_req, mode="drop")
+    borrow = borrow.at[lane_target].set(lane_borrow, mode="drop")
+
+    search = jax.vmap(
+        lambda hw, rq, av: classical_search(
+            t, usage, wl_usage, admitted, state["evicted"], ts,
+            state["admit_rank"], hw, rq, av, p_max))
+    lane_success, lane_cand_w, lane_victims, lane_reason = search(
+        lane_w, lane_req, lane_avail)
+    lane_success = lane_success & lane_valid
+
+    # ---- entry scan ---------------------------------------------
+    scan_state = {
+        "usage_full": usage, "usage_net": usage,
+        "cq_rows": state["cq_rows"], "admitted": admitted,
+        "parked": parked, "wl_usage": wl_usage,
+        "victims_all": jnp.zeros((W1,), dtype=bool), "ts": ts,
+    }
+    out, adm_entry, any_adm, any_evict = full_round_scan(
+        t, scan_state, cand_w, mode, k_chosen, req_c, borrow,
+        lane_of_entry, lane_success, lane_cand_w, lane_victims,
+        p_max)
+    admitted = out["admitted"]
+    parked = out["parked"]
+    wl_usage = out["wl_usage"]
+    victims = out["victims_all"]
+
+    # ---- bookkeeping for evicted victims ------------------------
+    ts = jnp.where(victims, t.ts_evict_base + rounds, ts)
+    evicted_f = state["evicted"] | victims
+    admit_rank = jnp.where(victims, 0, state["admit_rank"])
+    # re-admissions: clear Evicted, stamp reservation rank
+    newly = adm_entry & (cand_w != W_null)
+    adm_w = jnp.where(newly, cand_w, W_null)
+    evicted_f = evicted_f.at[adm_w].set(
+        jnp.where(newly, False, evicted_f[adm_w]), mode="drop")
+    admit_rank = admit_rank.at[adm_w].set(
+        jnp.where(newly, t.admit_rank_base + rounds,
+                  admit_rank[adm_w]), mode="drop")
+    evicted_f = evicted_f.at[W_null].set(False)
+
+    # record chosen options + admit round for decode
+    opt = state["opt"]
+    admit_round = state["admit_round"]
+    opt = opt.at[adm_w].set(
+        jnp.where(newly[:, None], k_chosen, opt[adm_w]), mode="drop")
+    admit_round = admit_round.at[adm_w].set(
+        jnp.where(newly, rounds, admit_round[adm_w]), mode="drop")
+
+    # flavor cursors: heads still pending resume their walk
+    keep = is_head & ~admitted[cand_w]
+    cursor = state["cursor"].at[cand_w].set(
+        jnp.where(keep[:, None], next_cursor,
+                  state["cursor"][cand_w]), mode="drop")
+    # an evicted workload restarts its flavor walk
+    cursor = jnp.where(victims[:, None], 0, cursor)
+
+    # ---- capacity-freed flush: unpark cohort roots with evictions
+    freed_root = jnp.zeros((N1,), dtype=bool)
+    victim_roots = t.cq_root[jnp.minimum(t.wl_cqid[:-1], C - 1)]
+    freed_root = freed_root.at[victim_roots].max(victims[:-1])
+    wl_root = t.cq_root[jnp.minimum(t.wl_cqid, C - 1)]
+    parked = parked & ~freed_root[wl_root]
+
+    # ---- durable usage for next round ---------------------------
+    usage_next = refresh_cohort_usage(t, out["cq_rows"])
+
+    progress = (any_adm | any_evict
+                | jnp.any(parked & ~parked_before)
+                | jnp.any(cursor != cursor_before))
+    new_state = {
+        "usage": usage_next, "cq_rows": out["cq_rows"],
+        "admitted": admitted, "parked": parked, "ts": ts,
+        "evicted": evicted_f, "admit_rank": admit_rank,
+        "wl_usage": wl_usage, "cursor": cursor, "opt": opt,
+        "admit_round": admit_round, "progress": progress,
+        "rounds": rounds + 1,
+    }
+    debug = {
+        "cand_w": cand_w, "mode": mode, "req_c": req_c,
+        "victims": victims, "adm_entry": adm_entry,
+        "lane_w": lane_w, "lane_success": lane_success,
+        "lane_cand_w": lane_cand_w, "lane_victims": lane_victims,
+    }
+    return new_state, debug
+
+
+def _init_state(t: FullTensors, g_max: int):
+    W1 = t.wl_cqid.shape[0]
+    return {
+        "usage": t.usage0,
+        "cq_rows": jnp.where(t.is_cq[:, None], t.usage0, 0),
+        "admitted": t.wl_admitted0,
+        "parked": t.wl_parked0,
+        "ts": t.wl_ts0,
+        "evicted": t.wl_evicted0,
+        "admit_rank": t.wl_admit_rank0,
+        "wl_usage": t.ad_usage,
+        "cursor": jnp.zeros((W1, g_max), dtype=jnp.int32),
+        "opt": jnp.zeros((W1, g_max), dtype=jnp.int32),
+        "admit_round": jnp.full((W1,), -1, dtype=jnp.int32),
+        "progress": jnp.ones((), dtype=bool),
+        "rounds": jnp.zeros((), dtype=jnp.int32),
+    }
+
+
+def make_full_solver(g_max: int, h_max: int, p_max: int):
+    """Build the jitted preemption-capable drain for static caps."""
+
+    @jax.jit
+    def solve(t: FullTensors):
+        W1 = t.wl_cqid.shape[0]
+        C = t.cq_node.shape[0]
+        W_null = W1 - 1
+        pot = potential_available_all(t)
+
+        def cond(state):
+            return state["progress"] & (state["rounds"] < 2 * W1 + C + 5)
+
+        def body(state):
+            new_state, _ = round_body(t, state, pot, g_max, h_max, p_max)
+            return new_state
+
+        final = jax.lax.while_loop(cond, body, _init_state(t, g_max))
+        admitted = final["admitted"].at[W_null].set(False)
+        parked = final["parked"].at[W_null].set(False)
+        return (admitted, final["opt"], final["admit_round"], parked,
+                final["rounds"], final["usage"], final["wl_usage"])
+
+    return solve
+
+
+def debug_drain(problem: SolverProblem, g_max: int, h_max: int = 8,
+                p_max: int = 32, max_rounds: int = 64, verbose: bool = True):
+    """Python-loop drain printing per-round events (development aid)."""
+    import numpy as np
+
+    t = to_device_full(problem)
+    pot = potential_available_all(t)
+    state = _init_state(t, g_max)
+    W_null = t.wl_cqid.shape[0] - 1
+    step = jax.jit(lambda tt, st: round_body(tt, st, pot, g_max, h_max,
+                                             p_max))
+
+    def name(w):
+        w = int(w)
+        return problem.wl_keys[w] if w < W_null else "-"
+
+    for r in range(max_rounds):
+        state, dbg = step(t, state)
+        if verbose:
+            heads = [(name(w), int(m), int(b))
+                     for w, m, b in zip(np.asarray(dbg["cand_w"]),
+                                        np.asarray(dbg["mode"]),
+                                        np.asarray(dbg["req_c"]).sum(1))
+                     if int(w) != W_null]
+            evs = [name(i) for i, v in
+                   enumerate(np.asarray(dbg["victims"])[:-1]) if v]
+            adms = [name(w) for w, a in zip(np.asarray(dbg["cand_w"]),
+                                            np.asarray(dbg["adm_entry"]))
+                    if a and int(w) != W_null]
+            print(f"round {r}: heads(mode,req)={heads} "
+                  f"admitted={adms} evicted={evs}")
+        if not bool(state["progress"]):
+            break
+    return state
+
+
+_solver_cache: dict = {}
+
+
+def solve_backlog_full(t: FullTensors, g_max: int, h_max: int = 32,
+                       p_max: int = 128):
+    """Cached-jit entry point; (g_max, h_max, p_max) are compile-time."""
+    key = (g_max, h_max, p_max)
+    fn = _solver_cache.get(key)
+    if fn is None:
+        fn = make_full_solver(g_max, h_max, p_max)
+        _solver_cache[key] = fn
+    return fn(t)

@@ -21,6 +21,7 @@ import numpy as np
 from kueue_oss_tpu.api.types import (
     FlavorFungibilityPolicy,
     FlavorResource,
+    PreemptionPolicyValue,
     QueueingStrategy,
     ResourceFlavor,
 )
@@ -30,6 +31,7 @@ from kueue_oss_tpu.core.workload_info import (
     WorkloadInfo,
     effective_priority,
     queue_order_timestamp,
+    quota_reservation_time,
 )
 from kueue_oss_tpu.scheduler.flavor_assigner import (
     _selector_matches,
@@ -46,10 +48,38 @@ class UnsupportedProblem(Exception):
     """Raised when a scenario needs the oracle path (solver fallback)."""
 
 
+#: preemption-policy encoding shared with the kernels
+POLICY_NEVER = 0
+POLICY_LOWER_PRIORITY = 1
+POLICY_LOWER_OR_NEWER_EQUAL = 2
+POLICY_ANY = 3
+
+_POLICY_CODE = {
+    "Never": POLICY_NEVER,
+    "LowerPriority": POLICY_LOWER_PRIORITY,
+    "LowerOrNewerEqualPriority": POLICY_LOWER_OR_NEWER_EQUAL,
+    "Any": POLICY_ANY,
+}
+
+#: sentinel for "no borrowWithinCohort maxPriorityThreshold"
+NO_THRESHOLD = np.int32(-(1 << 31) + 1)
+
+
 @dataclass
 class SolverProblem:
     """Dense problem instance. Node axis is [N+1] (last row = null node);
-    workload axis is [W+1] (last row = null workload)."""
+    workload axis is [W+1] (last row = null workload).
+
+    The workload axis unifies pending and (with include_admitted) admitted
+    workloads: admitted rows carry their admission usage in ``ad_usage``
+    and are eviction candidates for the preemption kernel; on eviction
+    they re-enter the pending set and re-assign through their option rows.
+    The flavor-option axis K spans (resource group, flavor) pairs:
+    ``cq_opt_group[c, k]`` names option k's group, and a workload's
+    assignment picks one option per group (groups cover disjoint
+    (flavor, resource) columns, so they are independent subproblems —
+    flavorassigner.go:599-765 assigns each group its own flavor walk).
+    """
 
     # --- node (CQ + cohort) arrays, parents-first topo order -------------
     parent: np.ndarray        # [N+1] int32, null node index N for roots
@@ -69,7 +99,7 @@ class SolverProblem:
     cq_strict: np.ndarray     # [C] bool (StrictFIFO)
     cq_try_next: np.ndarray   # [C] bool (whenCanBorrow == TryNextFlavor)
     cq_root_height: np.ndarray  # [C] int32 height of the CQ's root cohort
-    cq_nflavors: np.ndarray   # [C] int32 number of flavors in the CQ's RG
+    cq_nflavors: np.ndarray   # [C] int32 number of flavor options (all groups)
 
     # --- workload arrays --------------------------------------------------
     wl_cqid: np.ndarray       # [W+1] int32 CQ id (C for null)
@@ -80,13 +110,37 @@ class SolverProblem:
     wl_req: np.ndarray        # [W+1, K, F] int32 request under flavor-option k
     wl_valid: np.ndarray      # [W+1, K] bool option exists & taints/selector ok
 
+    # --- preemption extension (zero-sized/empty on fit-only exports) ------
+    wl_parked0: Optional[np.ndarray] = None    # [W+1] bool initially parked
+    wl_admitted0: Optional[np.ndarray] = None  # [W+1] bool initially admitted
+    wl_evicted0: Optional[np.ndarray] = None   # [W+1] bool Evicted condition
+    wl_admit_rank: Optional[np.ndarray] = None  # [W+1] int32 reservation rank
+    ad_usage: Optional[np.ndarray] = None      # [W+1, F] int32 admission usage
+    cq_within_policy: Optional[np.ndarray] = None   # [C] int32 POLICY_*
+    cq_reclaim_policy: Optional[np.ndarray] = None  # [C] int32 POLICY_*
+    cq_bwc_forbidden: Optional[np.ndarray] = None   # [C] bool
+    cq_bwc_threshold: Optional[np.ndarray] = None   # [C] int32 (NO_THRESHOLD)
+    cq_preempt_try_next: Optional[np.ndarray] = None  # [C] bool
+    cq_fair_weight: Optional[np.ndarray] = None     # [C] float32
+    cq_root: Optional[np.ndarray] = None            # [C] int32 root node idx
+    cq_opt_group: Optional[np.ndarray] = None       # [C, K] int32 (-1 none)
+    cq_ngroups: Optional[np.ndarray] = None         # [C] int32
+    fr_resource: Optional[np.ndarray] = None        # [F] int32 resource id
+    n_resources: int = 1
+    #: timestamp rank assigned to round-r evictions: ts_evict_base + r
+    ts_evict_base: int = 0
+    #: reservation rank for round-r re-admissions: admit_rank_base + r
+    admit_rank_base: int = 0
+
     # --- host-side decode tables -----------------------------------------
     fr_list: list[FlavorResource] = field(default_factory=list)
     node_names: list[str] = field(default_factory=list)
     cq_names: list[str] = field(default_factory=list)
     wl_keys: list[str] = field(default_factory=list)
-    #: per CQ: ordered flavor names (option k -> flavor)
+    #: per CQ: ordered flavor names (option k -> flavor, spanning groups)
     cq_option_flavors: dict[str, list[str]] = field(default_factory=dict)
+    #: per CQ: resource name -> group index (admission decode)
+    cq_resource_group: dict[str, dict[str, int]] = field(default_factory=dict)
     scale: int = 1
 
     @property
@@ -116,13 +170,22 @@ def export_problem(
     store: Store,
     pending: dict[str, list[WorkloadInfo]],
     snapshot: Optional[Snapshot] = None,
+    include_admitted: bool = False,
+    parked: Optional[dict[str, list[WorkloadInfo]]] = None,
 ) -> SolverProblem:
     """Build a SolverProblem from the store and the pending backlog.
 
     ``pending`` maps CQ name -> workloads in FIFO-heap order (rank order).
-    Raises UnsupportedProblem for shapes the solver doesn't model yet
-    (multiple resource groups per CQ, per-podset topology groups) so the
-    caller can fall back to the oracle.
+    ``parked`` maps CQ name -> inadmissible (parked) workloads; they export
+    with ``wl_parked0`` set so the kernel re-tries them when an in-drain
+    eviction frees capacity in their cohort (the queue manager's
+    capacity-freed flush). With ``include_admitted``, admitted workloads
+    are appended to the same workload axis as eviction candidates (their
+    admission usage rides ``ad_usage``; the node ``usage0`` still
+    includes them — the kernel subtracts on eviction). Raises
+    UnsupportedProblem for shapes the solver doesn't model yet
+    (per-podset topology groups) so the caller can fall back to the
+    oracle.
     """
     snapshot = snapshot or build_snapshot(store)
     forest = snapshot.forest
@@ -212,7 +275,18 @@ def export_problem(
     cq_try_next = np.zeros(C, dtype=bool)
     cq_root_height = np.zeros(C, dtype=np.int32)
     cq_nflavors = np.zeros(C, dtype=np.int32)
+    cq_within_policy = np.zeros(C, dtype=np.int32)
+    cq_reclaim_policy = np.zeros(C, dtype=np.int32)
+    cq_bwc_forbidden = np.zeros(C, dtype=bool)
+    cq_bwc_threshold = np.full(C, NO_THRESHOLD, dtype=np.int32)
+    cq_preempt_try_next = np.zeros(C, dtype=bool)
+    cq_fair_weight = np.ones(C, dtype=np.float32)
+    cq_root = np.zeros(C, dtype=np.int32)
+    cq_ngroups = np.ones(C, dtype=np.int32)
     cq_option_flavors: dict[str, list[str]] = {}
+    cq_resource_group: dict[str, dict[str, int]] = {}
+    #: per CQ: option k -> (group idx, FlavorQuotas)
+    cq_options: dict[str, list[tuple[int, str]]] = {}
     K = 1
     for cid, name in enumerate(cq_names):
         spec = store.cluster_queues[name]
@@ -222,15 +296,39 @@ def export_problem(
         cq_try_next[cid] = (
             spec.flavor_fungibility.when_can_borrow
             == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR)
+        cq_preempt_try_next[cid] = (
+            spec.flavor_fungibility.when_can_preempt
+            == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR)
         cq_root_height[cid] = height[index[id(node.root())]]
-        if len(spec.resource_groups) > 1:
-            raise UnsupportedProblem(
-                f"CQ {name} has multiple resource groups")
-        flavors = ([fq.name for fq in spec.resource_groups[0].flavors]
-                   if spec.resource_groups else [])
-        cq_option_flavors[name] = flavors
-        cq_nflavors[cid] = len(flavors)
-        K = max(K, len(flavors))
+        cq_root[cid] = index[id(node.root())]
+        cq_within_policy[cid] = _POLICY_CODE[
+            spec.preemption.within_cluster_queue]
+        cq_reclaim_policy[cid] = _POLICY_CODE[
+            spec.preemption.reclaim_within_cohort]
+        bwc = spec.preemption.borrow_within_cohort
+        cq_bwc_forbidden[cid] = bwc.policy == PreemptionPolicyValue.NEVER
+        if bwc.max_priority_threshold is not None:
+            cq_bwc_threshold[cid] = bwc.max_priority_threshold
+        cq_fair_weight[cid] = spec.fair_sharing.weight
+        options: list[tuple[int, str]] = []
+        rg_of_resource: dict[str, int] = {}
+        for g, rg in enumerate(spec.resource_groups):
+            for r in rg.covered_resources:
+                rg_of_resource[r] = g
+            for fq in rg.flavors:
+                options.append((g, fq.name))
+        cq_options[name] = options
+        cq_option_flavors[name] = [f for _, f in options]
+        cq_resource_group[name] = rg_of_resource
+        cq_ngroups[cid] = max(1, len(spec.resource_groups))
+        cq_nflavors[cid] = len(options)
+        K = max(K, len(options))
+
+    G_MAX = int(cq_ngroups.max()) if C else 1
+    cq_opt_group = np.full((C, K), -1, dtype=np.int32)
+    for cid, name in enumerate(cq_names):
+        for k, (g, _) in enumerate(cq_options[name]):
+            cq_opt_group[cid, k] = g
 
     cq_id = {name: i for i, name in enumerate(cq_names)}
 
@@ -242,6 +340,22 @@ def export_problem(
             all_infos.append(info)
             wl_cqid_l.append(cq_id[info.cluster_queue])
             wl_rank_l.append(rank)
+    n_heap = len(all_infos)
+    if parked:
+        for name, infos in parked.items():
+            for info in infos:
+                all_infos.append(info)
+                wl_cqid_l.append(cq_id[info.cluster_queue])
+                wl_rank_l.append(int(BIG))
+    n_pending = len(all_infos)
+    admitted_infos: list[WorkloadInfo] = []
+    if include_admitted:
+        for info in store.admitted_infos():
+            if info.cluster_queue in cq_id:
+                admitted_infos.append(info)
+                all_infos.append(info)
+                wl_cqid_l.append(cq_id[info.cluster_queue])
+                wl_rank_l.append(int(BIG))
     W = len(all_infos)
 
     wl_cqid = np.concatenate(
@@ -253,63 +367,84 @@ def export_problem(
     wl_uid = np.zeros(W + 1, dtype=np.int32)
     wl_req = np.zeros((W + 1, K, F), dtype=np.int64)
     wl_valid = np.zeros((W + 1, K), dtype=bool)
+    wl_admitted0 = np.zeros(W + 1, dtype=bool)
+    wl_admitted0[n_pending:W] = True
+    wl_parked0 = np.zeros(W + 1, dtype=bool)
+    wl_parked0[n_heap:n_pending] = True
+    wl_evicted0 = np.zeros(W + 1, dtype=bool)
+    wl_admit_rank = np.zeros(W + 1, dtype=np.int32)
+    ad_usage = np.zeros((W + 1, F), dtype=np.int64)
 
     # Timestamps are exported as dense ranks: only relative order matters
     # for entry sorting, and float32 would collapse epoch-scale values
     # less than ~128s apart (ties must stay ties for the uid tiebreak).
     raw_ts = [queue_order_timestamp(i.obj) for i in all_infos]
     ts_rank = {ts: r for r, ts in enumerate(sorted(set(raw_ts)))}
+    raw_admit = [quota_reservation_time(i.obj, 0.0) for i in admitted_infos]
+    admit_rank = {ts: r + 1 for r, ts in enumerate(sorted(set(raw_admit)))}
 
     for w, info in enumerate(all_infos):
         wl_prio[w] = effective_priority(info.obj)
         wl_ts[w] = ts_rank[raw_ts[w]]
         wl_uid[w] = info.obj.uid
+        wl_evicted0[w] = info.obj.is_evicted
+        if w >= n_pending:
+            wl_admit_rank[w] = admit_rank[raw_admit[w - n_pending]]
+            for fr, q in info.usage().items():
+                if fr in fr_index:
+                    ad_usage[w, fr_index[fr]] = q
         spec = store.cluster_queues[info.cluster_queue]
         if not spec.resource_groups:
             continue
-        rg = spec.resource_groups[0]
-        groups = {
+        ps_groups = {
             ps.topology_request.podset_group_name
             for ps in info.obj.podsets
             if ps.topology_request is not None
             and ps.topology_request.podset_group_name
         }
-        if groups:
+        if ps_groups:
             raise UnsupportedProblem(
                 f"workload {info.key} uses podset topology groups")
         totals: dict[str, int] = {}
         for psr in info.total_requests:
             for r, q in psr.requests.items():
                 totals[r] = totals.get(r, 0) + q
-        for r in totals:
-            if r not in rg.covered_resources and totals[r] > 0:
-                # Undeclared resource: no option can ever fit; leave all
-                # options invalid so the solver parks it (oracle parity).
-                totals = None
-                break
-        if totals is None:
+        covered = {r for rg in spec.resource_groups
+                   for r in rg.covered_resources}
+        if any(q > 0 and r not in covered for r, q in totals.items()):
+            # Undeclared resource: no option can ever fit; leave all
+            # options invalid so the solver parks it (oracle parity).
             continue
-        allowed_keys = frozenset(
-            k for fq in rg.flavors
-            for k in store.resource_flavors.get(
-                fq.name, ResourceFlavor(name=fq.name)).node_labels)
-        for k, fq in enumerate(rg.flavors):
-            flavor = store.resource_flavors.get(fq.name)
-            if flavor is None:
-                continue
-            if not _flavor_compatible(info, flavor, allowed_keys):
-                continue
-            wl_valid[w, k] = True
-            for r, q in totals.items():
-                if r in rg.covered_resources:
-                    wl_req[w, k, fr_index[(fq.name, r)]] = q
+        k = -1
+        for g, rg in enumerate(spec.resource_groups):
+            allowed_keys = frozenset(
+                key for fq in rg.flavors
+                for key in store.resource_flavors.get(
+                    fq.name, ResourceFlavor(name=fq.name)).node_labels)
+            for fq in rg.flavors:
+                k += 1
+                flavor = store.resource_flavors.get(fq.name)
+                if flavor is None:
+                    continue
+                # A concurrent-admission variant is pinned to one flavor
+                # (flavorassigner IsFlavorAllowedForVariant).
+                if (info.obj.allowed_flavor is not None
+                        and fq.name != info.obj.allowed_flavor):
+                    continue
+                if not _flavor_compatible(info, flavor, allowed_keys):
+                    continue
+                wl_valid[w, k] = True
+                for r, q in totals.items():
+                    if r in rg.covered_resources:
+                        wl_req[w, k, fr_index[(fq.name, r)]] = q
 
     # ---- unit scaling ----------------------------------------------------
     # The gcd must cover every quantity that gets divided — including the
     # lending-limit-derived local_quota and subtree sums, which otherwise
     # truncate and change availability.
     quantities = [int(x) for arr in (nominal, borrow_limit[has_borrow],
-                                     usage0, wl_req, subtree, local_quota)
+                                     usage0, wl_req, subtree, local_quota,
+                                     ad_usage)
                   for x in np.asarray(arr).ravel() if x > 0]
     scale = 0
     for q in quantities:
@@ -322,6 +457,12 @@ def export_problem(
             raise UnsupportedProblem(
                 "quantities too large for int32 solver tensors")
         return out.astype(np.int32)
+
+    # resource-name vocabulary (fair-sharing DRS groups borrow by resource)
+    resources = sorted({fr[1] for fr in fr_list}) or ["_"]
+    res_index = {r: i for i, r in enumerate(resources)}
+    fr_resource = np.asarray([res_index[fr[1]] for fr in fr_list]
+                             or [0], dtype=np.int32)
 
     return SolverProblem(
         parent=parent,
@@ -348,10 +489,29 @@ def export_problem(
         wl_uid=wl_uid,
         wl_req=scaled(wl_req),
         wl_valid=wl_valid,
+        wl_parked0=wl_parked0,
+        wl_admitted0=wl_admitted0,
+        wl_evicted0=wl_evicted0,
+        wl_admit_rank=wl_admit_rank,
+        ad_usage=scaled(ad_usage),
+        cq_within_policy=cq_within_policy,
+        cq_reclaim_policy=cq_reclaim_policy,
+        cq_bwc_forbidden=cq_bwc_forbidden,
+        cq_bwc_threshold=cq_bwc_threshold,
+        cq_preempt_try_next=cq_preempt_try_next,
+        cq_fair_weight=cq_fair_weight,
+        cq_root=cq_root,
+        cq_opt_group=cq_opt_group,
+        cq_ngroups=cq_ngroups,
+        fr_resource=fr_resource,
+        n_resources=len(resources),
+        ts_evict_base=len(ts_rank) + 1,
+        admit_rank_base=len(admit_rank) + 2,
         fr_list=fr_list,
         node_names=[n.name for n in nodes],
         cq_names=cq_names,
         wl_keys=[i.key for i in all_infos],
         cq_option_flavors=cq_option_flavors,
+        cq_resource_group=cq_resource_group,
         scale=scale,
     )

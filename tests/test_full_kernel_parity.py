@@ -1,0 +1,231 @@
+"""Randomized drain parity: preemption-capable TPU kernel vs host scheduler.
+
+Both sides start from an identical store (same construction sequence) with
+some workloads already admitted, then drain the same contended backlog.
+Parity asserted on the final admitted set, the victim set (initially
+admitted workloads that lost quota), and the assigned flavors.
+
+Reference parity targets: pkg/scheduler/preemption/preemption.go:271-341
+(classical search), classical/candidate_generator.go:34-160 (ordering /
+legality), scheduler.go:286-467 (cycle contract).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    BorrowWithinCohort,
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    PreemptionPolicyValue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.solver.full_kernels import (
+    solve_backlog_full,
+    to_device_full,
+)
+from kueue_oss_tpu.solver.tensors import export_problem
+
+
+def build_scenario(seed: int):
+    """Deterministic store + workload schedule for one random scenario."""
+    rng = random.Random(seed)
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="f1"))
+    store.upsert_resource_flavor(ResourceFlavor(name="f2"))
+
+    n_cohorts = rng.choice([1, 2])
+    n_cqs = rng.randint(2, 5)
+    two_level = rng.random() < 0.3
+    if two_level:
+        store.upsert_cohort(Cohort(name="root"))
+        for i in range(n_cohorts):
+            store.upsert_cohort(Cohort(name=f"co{i}", parent="root"))
+    else:
+        for i in range(n_cohorts):
+            store.upsert_cohort(Cohort(name=f"co{i}"))
+
+    within_choices = [PreemptionPolicyValue.NEVER,
+                      PreemptionPolicyValue.LOWER_PRIORITY,
+                      PreemptionPolicyValue.LOWER_OR_NEWER_EQUAL_PRIORITY]
+    reclaim_choices = [PreemptionPolicyValue.NEVER,
+                       PreemptionPolicyValue.LOWER_PRIORITY,
+                       PreemptionPolicyValue.ANY]
+
+    for c in range(n_cqs):
+        flavors = []
+        for fname in ("f1", "f2")[:rng.choice([1, 2])]:
+            resources = [ResourceQuota(
+                name="cpu", nominal=rng.choice([1000, 2000]),
+                borrowing_limit=rng.choice([None, 1000, 2000]),
+                lending_limit=rng.choice([None, 500, 1000]))]
+            flavors.append(FlavorQuotas(name=fname, resources=resources))
+        bwc_policy = rng.choice([PreemptionPolicyValue.NEVER,
+                                 PreemptionPolicyValue.LOWER_PRIORITY])
+        bwc = BorrowWithinCohort(
+            policy=bwc_policy,
+            max_priority_threshold=(rng.choice([None, 0, 1])
+                                    if bwc_policy != "Never" else None))
+        cq = ClusterQueue(
+            name=f"cq{c}",
+            cohort=f"co{c % n_cohorts}",
+            preemption=PreemptionPolicy(
+                within_cluster_queue=rng.choice(within_choices),
+                reclaim_within_cohort=rng.choice(reclaim_choices),
+                borrow_within_cohort=bwc,
+            ),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"], flavors=flavors)])
+        store.upsert_cluster_queue(cq)
+        store.upsert_local_queue(
+            LocalQueue(name=f"lq{c}", cluster_queue=f"cq{c}"))
+
+    phase1, phase2 = [], []
+    n_initial = rng.randint(2, 8)
+    n_arriving = rng.randint(2, 8)
+    for i in range(n_initial):
+        phase1.append(dict(
+            name=f"init{i}", queue_name=f"lq{rng.randrange(n_cqs)}",
+            priority=rng.randint(0, 2), creation_time=float(i),
+            cpu=rng.choice([400, 700, 1000, 1500])))
+    for i in range(n_arriving):
+        phase2.append(dict(
+            name=f"new{i}", queue_name=f"lq{rng.randrange(n_cqs)}",
+            priority=rng.randint(0, 3),
+            creation_time=100.0 + i,
+            cpu=rng.choice([400, 700, 1000, 1500, 2500])))
+    return store, phase1, phase2
+
+
+def _mk_wl(spec, uid):
+    return Workload(
+        name=spec["name"], queue_name=spec["queue_name"],
+        priority=spec["priority"], creation_time=spec["creation_time"],
+        uid=uid,
+        podsets=[PodSet(name="main", count=1,
+                        requests={"cpu": spec["cpu"]})])
+
+
+def run_host(seed: int):
+    store, phase1, phase2 = build_scenario(seed)
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    uid = 1
+    for spec in phase1:
+        store.add_workload(_mk_wl(spec, uid))
+        uid += 1
+    sched.run_until_quiet(now=50.0)
+    initially_admitted = {k for k, w in store.workloads.items()
+                         if w.is_quota_reserved}
+    for spec in phase2:
+        store.add_workload(_mk_wl(spec, uid))
+        uid += 1
+    cycles = sched.run_until_quiet(now=200.0, max_cycles=300)
+    if cycles >= 300:
+        # Preemption ping-pong livelock (a borrower re-admits into the
+        # capacity its preemptor freed, forever). Inherited from the
+        # reference's cycle semantics; no stable outcome to compare.
+        pytest.skip(f"seed {seed}: host scheduler does not quiesce")
+    admitted = {k for k, w in store.workloads.items() if w.is_quota_reserved}
+    flavors = {
+        k: {r: f for psa in w.status.admission.podset_assignments
+            for r, f in psa.flavors.items()}
+        for k, w in store.workloads.items() if w.is_quota_reserved
+    }
+    return initially_admitted, admitted, flavors
+
+
+def run_kernel(seed: int):
+    store, phase1, phase2 = build_scenario(seed)
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    uid = 1
+    for spec in phase1:
+        store.add_workload(_mk_wl(spec, uid))
+        uid += 1
+    # identical starting state: the host scheduler admits phase 1
+    sched.run_until_quiet(now=50.0)
+    initially_admitted = {k for k, w in store.workloads.items()
+                         if w.is_quota_reserved}
+    for spec in phase2:
+        store.add_workload(_mk_wl(spec, uid))
+        uid += 1
+
+    pending = {}
+    parked = {}
+    for name, q in queues.queues.items():
+        infos = q.snapshot_order()
+        if infos:
+            pending[name] = infos
+        if q.inadmissible:
+            parked[name] = list(q.inadmissible.values())
+    problem = export_problem(store, pending, include_admitted=True,
+                             parked=parked)
+    t = to_device_full(problem)
+    g_max = int(problem.cq_ngroups.max())
+    admitted_a, opt, admit_round, parked, rounds, usage, wl_usage = (
+        solve_backlog_full(t, g_max=g_max, h_max=8, p_max=32))
+    admitted_a = np.asarray(admitted_a)
+    opt = np.asarray(opt)
+    admitted = {problem.wl_keys[w] for w in range(problem.n_workloads)
+                if admitted_a[w]}
+    flavors = {}
+    for w in range(problem.n_workloads):
+        if not admitted_a[w]:
+            continue
+        key = problem.wl_keys[w]
+        cq_name = problem.cq_names[problem.wl_cqid[w]]
+        if problem.wl_admitted0[w] and np.asarray(admit_round)[w] < 0:
+            # kept its original admission
+            wl = store.workloads[key]
+            flavors[key] = {
+                r: f for psa in wl.status.admission.podset_assignments
+                for r, f in psa.flavors.items()}
+            continue
+        rg_of = problem.cq_resource_group[cq_name]
+        opts = problem.cq_option_flavors[cq_name]
+        # option index within the CQ's flat option list, per group
+        wl = store.workloads[key]
+        fl = {}
+        for ps in wl.podsets:
+            for r in ps.requests:
+                g = rg_of[r]
+                # k_chosen is the flat option index
+                fl[r] = opts[opt[w, g]]
+        flavors[key] = fl
+    return initially_admitted, admitted, flavors, int(rounds)
+
+
+SEEDS = list(range(30))
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_drain_parity(seed):
+    init_h, admitted_h, flavors_h = run_host(seed)
+    init_k, admitted_k, flavors_k, rounds = run_kernel(seed)
+    assert init_h == init_k, "setup must be identical"
+    victims_h = init_h - admitted_h
+    victims_k = init_k - admitted_k
+    assert admitted_k == admitted_h, (
+        f"seed {seed}: admitted mismatch\n host-only: "
+        f"{sorted(admitted_h - admitted_k)}\n kernel-only: "
+        f"{sorted(admitted_k - admitted_h)}")
+    assert victims_k == victims_h, (
+        f"seed {seed}: victim mismatch host={sorted(victims_h)} "
+        f"kernel={sorted(victims_k)}")
+    for k in admitted_h:
+        assert flavors_k.get(k) == flavors_h.get(k), (
+            f"seed {seed}: flavor mismatch for {k}: "
+            f"host={flavors_h.get(k)} kernel={flavors_k.get(k)}")
