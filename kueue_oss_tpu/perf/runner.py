@@ -52,12 +52,13 @@ class Simulator:
     """
 
     def __init__(self, store: Store, schedule: list[GeneratedWorkload],
-                 enable_fair_sharing: bool = False) -> None:
+                 enable_fair_sharing: bool = False, solver=None) -> None:
         self.store = store
         self.schedule = schedule
         self.queues = QueueManager(store)
         self.scheduler = Scheduler(store, self.queues,
-                                   enable_fair_sharing=enable_fair_sharing)
+                                   enable_fair_sharing=enable_fair_sharing,
+                                   solver=solver)
         self.by_key = {g.workload.key: g for g in schedule}
         #: workload keys touched since the last admission/eviction sweep —
         #: keeps the sweep O(changed) instead of O(all workloads)

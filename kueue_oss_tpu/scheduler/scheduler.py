@@ -97,8 +97,15 @@ class Scheduler:
         self.clock = clock
         self.preemptor = Preemptor(enable_fair_sharing=enable_fair_sharing)
         self.cycle_count = 0
-        #: optional batched TPU solver implementing nominate() acceleration
+        #: batched TPU solver backend: None (host-only cycles), "auto"
+        #: (build a SolverEngine over this store/queues), or a
+        #: SolverEngine instance. When set, run_until_quiet() drains
+        #: solver-supported backlogs on-device with verify-then-assume
+        #: (each admission re-checked against the scalar oracle before
+        #: committing — scheduler.go:427 fits re-check parity) and falls
+        #: back to host cycles for unsupported shapes / rejected entries.
         self.solver = solver
+        self._solver_instance = None
         #: Preemption/generic evictions requeue immediately (ordered by
         #: eviction time, reference workload.Ordering). Only controller
         #: evictions that pass an explicit backoff_base_s (PodsReady
@@ -192,9 +199,55 @@ class Scheduler:
                 metrics.cluster_queue_weighted_share.set(
                     cq.name, value=drs.rounded_weighted_share())
 
+    def _solver_engine(self):
+        if self.solver is None:
+            return None
+        if self.solver == "auto":
+            if self._solver_instance is None:
+                from kueue_oss_tpu.solver.engine import SolverEngine
+
+                self._solver_instance = SolverEngine(
+                    self.store, self.queues, scheduler=self)
+            return self._solver_instance
+        return self.solver
+
+    def _solver_drain(self, now: Optional[float]) -> bool:
+        """Drain the backlog on-device when the solver supports it.
+
+        Returns True if a drain ran. Unsupported shapes (TAS podset
+        groups, admission-scope CQs, weighted fair sharing, oversized
+        quantities) fall through to the host cycle loop.
+        """
+        engine = self._solver_engine()
+        if engine is None or not self.queues.has_pending():
+            return False
+        from kueue_oss_tpu.solver.tensors import UnsupportedProblem
+
+        if not engine.supported():
+            return False
+        try:
+            result = engine.drain(now=now if now is not None else 0.0,
+                                  verify=True)
+        except UnsupportedProblem:
+            return False
+        for key in result.admitted_keys:
+            wl = self.store.workloads.get(key)
+            if wl is not None and wl.status.admission is not None:
+                cq = wl.status.admission.cluster_queue
+                self.admitted_total[cq] = self.admitted_total.get(cq, 0) + 1
+                self._cycle_touched_cqs.add(cq)
+        return True
+
     def run_until_quiet(self, max_cycles: int = 10_000,
                         now: Optional[float] = None) -> int:
-        """Run cycles until the pending state stops changing."""
+        """Run cycles until the pending state stops changing.
+
+        With a solver backend configured, the backlog first drains through
+        the TPU kernel (one batched invocation replacing many host
+        cycles); host cycles then mop up anything the solver could not
+        model or verify.
+        """
+        self._solver_drain(now)
         cycles = 0
         while cycles < max_cycles:
             pre = self._queue_fingerprint()

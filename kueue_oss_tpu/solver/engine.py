@@ -30,25 +30,40 @@ from kueue_oss_tpu.solver.tensors import (
     SolverProblem,
     UnsupportedProblem,
     export_problem,
+    pad_workloads,
 )
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
 
 
 @dataclass
 class DrainResult:
     admitted: int = 0
+    evicted: int = 0
     rounds: int = 0
     solver_time_s: float = 0.0
     apply_time_s: float = 0.0
     #: workload keys admitted, in (round, entry-order) sequence
     admitted_keys: list[str] = field(default_factory=list)
+    #: initially-admitted workload keys preempted by the drain
+    evicted_keys: list[str] = field(default_factory=list)
 
 
 class SolverEngine:
     """Drains pending backlogs through the jitted TPU kernel."""
 
-    def __init__(self, store: Store, queues: QueueManager) -> None:
+    def __init__(self, store: Store, queues: QueueManager,
+                 scheduler=None) -> None:
         self.store = store
         self.queues = queues
+        #: host scheduler whose eviction state machine applies the plan's
+        #: preemptions (metrics/backoff parity); built lazily if absent
+        self.scheduler = scheduler
 
     def supported(self) -> bool:
         """Whether the drain can run on-device.
@@ -93,14 +108,23 @@ class SolverEngine:
         return problem, pending
 
     def drain(self, now: float = 0.0, verify: bool = False) -> DrainResult:
-        """Solve the whole backlog on-device and commit the plan."""
+        """Solve the whole backlog on-device and commit the plan.
+
+        Preemption-capable and multi-resource-group stores route through
+        the full kernel (solve_backlog_full) so preemption shapes are
+        never silently solved fit-only; the lean kernel keeps the
+        uncontended fast path.
+        """
         if not self.supported():
             raise UnsupportedProblem(
-                "preemption-enabled or multi-RG ClusterQueues present")
+                "admission-scope or weighted fair-sharing CQs present")
+        if self.needs_full_kernel():
+            return self._drain_full(now, verify=verify)
         result = DrainResult()
         problem, pending = self.export()
         if problem.n_workloads == 0:
             return result
+        problem = pad_workloads(problem, _pow2(problem.n_workloads))
 
         t0 = time.monotonic()
         tensors = to_device(problem)
@@ -171,49 +195,217 @@ class SolverEngine:
             if not passed:
                 metrics.solver_plan_fallbacks_total.inc()
                 continue
-            key = wl.key
-            admission = Admission(
-                cluster_queue=cq_name,
-                podset_assignments=[
-                    PodSetAssignment(
-                        name=psr.name,
-                        flavors={r: flavor for r in psr.requests},
-                        resource_usage=dict(psr.requests),
-                        count=psr.count,
-                    )
-                    for psr in info.total_requests
-                ],
-            )
-            wl.status.admission = admission
-            wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
-                             reason="QuotaReserved", now=now)
-            if wl.is_evicted:
-                wl.set_condition(WorkloadConditionType.EVICTED, False,
-                                 reason="QuotaReserved", now=now)
-            # Keep the requeue count across re-admissions (mirrors
-            # Scheduler._admit): only the backoff gate is cleared so
-            # RequeuingStrategy.backoffLimitCount can still trip.
-            if wl.status.requeue_state is not None:
-                wl.status.requeue_state.requeue_at = None
-            cq_spec = self.store.cluster_queues[cq_name]
-            if cq_spec.admission_checks:
-                from kueue_oss_tpu.api.types import AdmissionCheckState
-                for ac_name in cq_spec.admission_checks:
-                    wl.status.admission_checks.setdefault(
-                        ac_name, AdmissionCheckState(name=ac_name))
-            else:
-                wl.set_condition(WorkloadConditionType.ADMITTED, True,
-                                 reason="Admitted", now=now)
-            self.store.update_workload(wl)
-            self.queues.queues[cq_name].delete(key)
-            metrics.quota_reserved_workload(cq_name, now - wl.creation_time)
-            if wl.is_admitted:
-                metrics.admitted_workload(cq_name, now - wl.creation_time)
-            result.admitted += 1
-            result.admitted_keys.append(key)
+            flavor_of = {r: flavor for psr in info.total_requests
+                         for r in psr.requests}
+            self._commit_admission(wl, cq_name, flavor_of, info, now,
+                                   result)
         # Mirror the solver's inadmissible-parking decisions host-side;
         # StrictFIFO blocked heads (not parked) stay in their heaps.
         for w in range(problem.n_workloads):
             if parked[w]:
                 cq_name = problem.cq_names[problem.wl_cqid[w]]
                 self.queues.queues[cq_name].park(problem.wl_keys[w])
+
+    # -- full (preemption-capable) drain -----------------------------------
+
+    def _size_caps(self, problem: SolverProblem) -> tuple[int, int]:
+        """Size the full kernel's static caps from the problem.
+
+        h_max bounds victim searches per round: capping it only delays
+        later preempt-mode heads a round, so a modest cap is safe. p_max
+        bounds candidates per search and MUST cover the largest possible
+        candidate set (all workloads sharing a cohort tree with the
+        preemptor) — too small would wrongly produce NoCandidates where
+        the reference iterates every candidate (preemption.go:311).
+        Rounded up to powers of two to reuse compiled kernels.
+        """
+        C = problem.n_cqs
+        h_max = max(1, min(C, 64))
+        root_of_cq = problem.cq_root
+        wl_root = root_of_cq[np.minimum(problem.wl_cqid[:-1], C - 1)]
+        counts = np.bincount(wl_root, minlength=problem.n_nodes + 1)
+        p_max = int(counts.max()) if counts.size else 1
+        return h_max, _pow2(max(8, p_max))
+
+    def _drain_full(self, now: float, verify: bool = False) -> DrainResult:
+        """Drain a preemption-enabled store through solve_backlog_full.
+
+        Reference cycle contract: scheduler.go:286-467 — the kernel
+        replays nominate → search → admit/preempt rounds on-device; this
+        applies the net plan: evictions first (releasing quota exactly
+        like Scheduler._issue_preemptions → evict_workload), then
+        admissions in (round, entry-order), then parking decisions.
+        """
+        from kueue_oss_tpu.solver.full_kernels import (
+            solve_backlog_full,
+            to_device_full,
+        )
+
+        result = DrainResult()
+        pending = self.pending_backlog()
+        parked_map: dict[str, list[WorkloadInfo]] = {}
+        for name, q in self.queues.queues.items():
+            if q.inadmissible:
+                parked_map[name] = list(q.inadmissible.values())
+        problem = export_problem(self.store, pending,
+                                 include_admitted=True, parked=parked_map)
+        if problem.n_workloads == 0:
+            return result
+        g_max = int(problem.cq_ngroups.max())
+        h_max, p_max = self._size_caps(problem)
+        problem = pad_workloads(problem, _pow2(problem.n_workloads))
+
+        t0 = time.monotonic()
+        tensors = to_device_full(problem)
+        (admitted, opt, admit_round, parked, rounds, _usage,
+         _wl_usage, victim_reason) = solve_backlog_full(
+            tensors, g_max, h_max, p_max)
+        admitted = np.asarray(admitted)
+        opt = np.asarray(opt)
+        admit_round = np.asarray(admit_round)
+        parked = np.asarray(parked)
+        victim_reason = np.asarray(victim_reason)
+        result.rounds = int(rounds)
+        result.solver_time_s = time.monotonic() - t0
+        metrics.solver_cycle_duration_seconds.observe(
+            "solve", value=result.solver_time_s)
+
+        t1 = time.monotonic()
+        self._apply_full_plan(problem, admitted, opt, admit_round, parked,
+                              victim_reason, now, result, verify=verify)
+        result.apply_time_s = time.monotonic() - t1
+        metrics.solver_cycle_duration_seconds.observe(
+            "apply", value=result.apply_time_s)
+        return result
+
+    def _evictor(self):
+        """Host scheduler used purely for its eviction state machine."""
+        if self.scheduler is None:
+            from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+            self.scheduler = Scheduler(self.store, self.queues)
+        return self.scheduler
+
+    def _apply_full_plan(self, problem: SolverProblem, admitted: np.ndarray,
+                         opt: np.ndarray, admit_round: np.ndarray,
+                         parked: np.ndarray, victim_reason: np.ndarray,
+                         now: float, result: DrainResult,
+                         verify: bool = False) -> None:
+        from kueue_oss_tpu.scheduler.preemption import (
+            _VARIANT_REASON,
+            IN_CLUSTER_QUEUE,
+        )
+
+        W = problem.n_workloads
+        wl_admitted0 = problem.wl_admitted0
+
+        # 1) evictions: initially-admitted workloads that lost their
+        #    admission, or were evicted mid-drain and re-admitted with a
+        #    (possibly different) flavor (admit_round >= 0).
+        evictor = self._evictor()
+        for w in range(W):
+            if not wl_admitted0[w]:
+                continue
+            if admitted[w] and admit_round[w] < 0:
+                continue  # kept its original admission untouched
+            key = problem.wl_keys[w]
+            wl = self.store.workloads.get(key)
+            if wl is None or not wl.is_quota_reserved:
+                continue
+            reason = _VARIANT_REASON.get(int(victim_reason[w]),
+                                         IN_CLUSTER_QUEUE)
+            evictor.evict_workload(
+                key, reason="Preempted",
+                message="Preempted by the solver drain plan",
+                now=now, preemption_reason=reason)
+            if not admitted[w]:
+                result.evicted += 1
+                result.evicted_keys.append(key)
+
+        # 2) admissions in (round, entry-order); per-group flavor decode.
+        order = np.argsort(admit_round[:W], kind="stable")
+        candidates = []
+        for w in order:
+            if not admitted[w] or admit_round[w] < 0:
+                continue
+            key = problem.wl_keys[w]
+            wl = self.store.workloads.get(key)
+            if wl is None or wl.is_quota_reserved or not wl.active:
+                continue
+            cq_name = problem.cq_names[problem.wl_cqid[w]]
+            rg_of = problem.cq_resource_group[cq_name]
+            opts = problem.cq_option_flavors[cq_name]
+            info = WorkloadInfo(wl, cluster_queue=cq_name)
+            flavor_of = {
+                r: opts[opt[w, g]] for r, g in rg_of.items()}
+            plan_usage: dict[tuple[str, str], int] = {}
+            for psr in info.total_requests:
+                for r, q in psr.requests.items():
+                    fr = (flavor_of[r], r)
+                    plan_usage[fr] = plan_usage.get(fr, 0) + q
+            candidates.append((wl, cq_name, flavor_of, info, plan_usage))
+
+        if verify and candidates:
+            from kueue_oss_tpu.core.snapshot import build_snapshot
+            from kueue_oss_tpu.native import BatchOracle
+
+            oracle = BatchOracle(build_snapshot(self.store).forest.cqs)
+            ok = oracle.verify_and_apply(
+                [(cq_name, usage)
+                 for _, cq_name, _, _, usage in candidates])
+        else:
+            ok = np.ones(len(candidates), dtype=np.uint8)
+
+        for passed, (wl, cq_name, flavor_of, info, _) in zip(ok, candidates):
+            if not passed:
+                metrics.solver_plan_fallbacks_total.inc()
+                continue
+            self._commit_admission(wl, cq_name, flavor_of, info, now,
+                                   result)
+
+        # 3) parking decisions (inadmissible backoff parity).
+        for w in range(W):
+            if parked[w] and not admitted[w]:
+                cq_name = problem.cq_names[problem.wl_cqid[w]]
+                self.queues.queues[cq_name].park(problem.wl_keys[w])
+
+    def _commit_admission(self, wl, cq_name: str,
+                          flavor_of: dict[str, str], info: WorkloadInfo,
+                          now: float, result: DrainResult) -> None:
+        key = wl.key
+        admission = Admission(
+            cluster_queue=cq_name,
+            podset_assignments=[
+                PodSetAssignment(
+                    name=psr.name,
+                    flavors={r: flavor_of[r] for r in psr.requests},
+                    resource_usage=dict(psr.requests),
+                    count=psr.count,
+                )
+                for psr in info.total_requests
+            ],
+        )
+        wl.status.admission = admission
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                         reason="QuotaReserved", now=now)
+        if wl.is_evicted:
+            wl.set_condition(WorkloadConditionType.EVICTED, False,
+                             reason="QuotaReserved", now=now)
+        if wl.status.requeue_state is not None:
+            wl.status.requeue_state.requeue_at = None
+        cq_spec = self.store.cluster_queues[cq_name]
+        if cq_spec.admission_checks:
+            from kueue_oss_tpu.api.types import AdmissionCheckState
+            for ac_name in cq_spec.admission_checks:
+                wl.status.admission_checks.setdefault(
+                    ac_name, AdmissionCheckState(name=ac_name))
+        else:
+            wl.set_condition(WorkloadConditionType.ADMITTED, True,
+                             reason="Admitted", now=now)
+        self.store.update_workload(wl)
+        self.queues.queues[cq_name].delete(key)
+        metrics.quota_reserved_workload(cq_name, now - wl.creation_time)
+        if wl.is_admitted:
+            metrics.admitted_workload(cq_name, now - wl.creation_time)
+        result.admitted += 1
+        result.admitted_keys.append(key)

@@ -65,6 +65,18 @@ V_HIERARCHICAL_RECLAIM = 2
 V_RECLAIM_WITHOUT_BORROWING = 3
 V_RECLAIM_WHILE_BORROWING = 4
 
+# preemption-mode lattice (flavorassigner.go:429-437); mirrors the host
+# flavor_assigner P_* constants so granular modes compare identically.
+P_NOFIT = 0
+P_NO_CANDIDATES = 1
+P_PREEMPT = 2
+P_RECLAIM = 3
+P_FIT = 4
+
+#: cap on borrow levels when packing granular modes into one sort key
+#: (levels are cohort-tree heights, far below this)
+B_CAP = 64
+
 
 class FullTensors(NamedTuple):
     """Device-side mirror of the extended SolverProblem."""
@@ -90,6 +102,7 @@ class FullTensors(NamedTuple):
     cq_bwc_forbidden: jnp.ndarray
     cq_bwc_threshold: jnp.ndarray
     cq_preempt_try_next: jnp.ndarray
+    cq_pref_pob: jnp.ndarray
     cq_fair_weight: jnp.ndarray
     cq_root: jnp.ndarray
     cq_opt_group: jnp.ndarray    # [C, K]
@@ -148,6 +161,7 @@ def to_device_full(p: SolverProblem) -> FullTensors:
         cq_bwc_forbidden=jnp.asarray(p.cq_bwc_forbidden),
         cq_bwc_threshold=jnp.asarray(p.cq_bwc_threshold),
         cq_preempt_try_next=jnp.asarray(p.cq_preempt_try_next),
+        cq_pref_pob=jnp.asarray(p.cq_pref_pob),
         cq_fair_weight=jnp.asarray(p.cq_fair_weight),
         cq_root=jnp.asarray(p.cq_root),
         cq_opt_group=jnp.asarray(p.cq_opt_group),
@@ -187,6 +201,37 @@ def _remove_usage_along_path(t, usage: jnp.ndarray, cq_node: jnp.ndarray,
         usage = usage.at[node].add(jnp.where(is_valid, -val, 0))
         val = jnp.where(stored > 0, jnp.minimum(val, stored), 0)
     return usage
+
+
+def _height_along_path(t, usage, cq_node, req):
+    """FindHeightOfLowestSubtreeThatFits for one CQ under ``usage``.
+
+    Elementwise over the FR axis; returns (level [F] int32,
+    may_reclaim [F] bool). Reference parity:
+    classical/hierarchical_preemption.go:221-243 — same walk as
+    borrow_levels (kernels.py) but along a single CQ path so it can run
+    on mid-search usage (simulate_preemption's borrow-after-removal).
+    """
+    path = t.path[cq_node]
+    null = t.parent.shape[0] - 1
+    found = req == 0
+    level = jnp.zeros_like(req)
+    may_reclaim = jnp.zeros(req.shape, dtype=bool)
+    rem = req
+    root = cq_node
+    for d in range(path.shape[0]):
+        node = path[d]
+        valid = node != null
+        root = jnp.where(valid, node, root)
+        not_borrowing = usage[node] + rem <= t.subtree[node]
+        newly = (~found) & not_borrowing & valid
+        level = jnp.where(newly, t.height[node], level)
+        may_reclaim = jnp.where(newly, t.has_parent[node], may_reclaim)
+        found = found | newly
+        la = jnp.maximum(0, t.local_quota[node] - usage[node])
+        rem = jnp.where(found | ~valid, rem, rem - la)
+    level = jnp.where(found, level, t.height[root])
+    return level, may_reclaim
 
 
 # ---------------------------------------------------------------------------
@@ -312,101 +357,84 @@ def nominate_full(t: FullTensors, usage, avail, pot, cand_w, cursor,
             jnp.where(active, nc, 0).astype(jnp.int32))
 
     return (mode, k_chosen, req_total, borrow, next_cursor,
-            opt_fit, opt_preempt, opt_level, group_active)
+            opt_fit, opt_preempt, opt_level, group_active, valid)
 
 
-def refine_preempt_option(t: FullTensors, usage, over_all, wl_usage,
-                          admitted, ts, head_w, avail_cq, opt_fit_row,
-                          opt_preempt_row, opt_level_row, k_chosen_row,
-                          group_active_row, g_max: int):
-    """Re-pick preempt-mode flavors skipping options with no candidates.
+def walk_assign(t: FullTensors, head_w, pmode_k, borrow_k, valid_k,
+                group_active_row, g_max: int):
+    """The assigner's flavor walk over granular modes, for ONE head (vmap).
 
-    Mirrors SimulatePreemption's NoCandidates feeding shouldTryNextFlavor
-    (flavorassigner.go:1000-1017 + preemption_oracle.go): a flavor whose
-    candidate set is empty is skipped in favor of a later flavor with
-    candidates; if none has candidates the first preempt-capable flavor
-    is kept (reserve + park follows). Runs per preempt-mode head (vmap).
+    Emulates _find_flavor_for_podsets (flavorassigner.go:812-951): per
+    resource group, walk options in order; the first option where
+    should_try_next_flavor is false wins (early break); otherwise the best
+    option by is_preferred — (pmode desc, borrow asc, index asc) under
+    BorrowingOverPreemption, (borrow asc, pmode desc, index asc) under
+    PreemptionOverBorrowing (flavorassigner.go:439-470). ``pmode_k`` /
+    ``borrow_k`` carry the per-option granular modes, with preempt-mode
+    options already classified by an actual victim-search simulation
+    (P_NO_CANDIDATES / P_PREEMPT / P_RECLAIM with borrow-after levels —
+    preemption_oracle.go SimulatePreemption).
 
-    Returns (k_chosen [G], req [F], borrow).
+    Returns (mode, k_out [G], req [F], borrow, next_cursor [G],
+    pmode_sel [G]).
     """
-    W1 = t.wl_cqid.shape[0]
     C = t.cq_node.shape[0]
     K = t.cq_opt_group.shape[1]
-    null_node = t.parent.shape[0] - 1
-    D = t.path.shape[1]
-    cqid = t.wl_cqid[head_w]
-    cqi = jnp.minimum(cqid, C - 1)
-    cq_node = t.cq_node[cqi]
-    my_path = t.path[cq_node]
-
+    cqi = jnp.minimum(t.wl_cqid[head_w], C - 1)
+    grp = t.cq_opt_group[cqi]                    # [K]
+    pos = t.cq_opt_pos[cqi]                      # [K]
     req_k = t.wl_req[head_w]                     # [K, F]
-    frs_k = (req_k > 0) & (req_k > avail_cq[None, :])  # [K, F]
-
-    # policy-legal candidates (frs-independent part)
-    cand_cqid = t.wl_cqid[:-1]
-    cand_node = t.cq_node[jnp.minimum(cand_cqid, C - 1)]
-    is_adm = admitted[:-1] & (jnp.arange(W1 - 1) != head_w)
-    same_cq = cand_cqid == cqid
-    prio_p = t.wl_prio[head_w]
-    ts_p = ts[head_w]
-    lower = prio_p > t.wl_prio[:-1]
-    newer_eq = (prio_p == t.wl_prio[:-1]) & (ts_p < ts[:-1])
-    policy = jnp.where(same_cq, t.cq_within_policy[cqi],
-                       t.cq_reclaim_policy[cqi])
-    sat = jnp.where(
-        policy == POLICY_NEVER, False,
-        jnp.where(policy == POLICY_LOWER_PRIORITY, lower,
-                  jnp.where(policy == POLICY_LOWER_OR_NEWER_EQUAL,
-                            lower | newer_eq, policy == POLICY_ANY)))
-    cand_path = t.path[cand_node]
-    anc = (cand_path[:, :, None] == my_path[None, None, :])
-    is_anc = jnp.any(anc, axis=1) & (my_path[None, :] != null_node)
-    d_idx = jnp.arange(D, dtype=jnp.int32)[None, :]
-    lca_d = jnp.min(jnp.where(is_anc, d_idx, D), axis=1)
-    other_ok = (lca_d >= 1) & (lca_d < D)
-    legal0 = is_adm & sat
-
-    # per-option masks, factorized over the FR axis
-    used = (wl_usage[:-1] > 0).astype(jnp.int32)        # [W, F]
-    uses_k = (used @ frs_k.T.astype(jnp.int32)) > 0     # [W, K]
-    over_cand = over_all[cand_node].astype(jnp.int32)   # [W, F]
-    cq_over_k = (over_cand @ frs_k.T.astype(jnp.int32)) > 0  # [W, K]
-    # path-below-LCA: every cohort strictly below the LCA must be over
-    # nominal on some needed fr
-    lca_node = my_path[jnp.minimum(lca_d, D - 1)]
-    seen_lca = jnp.cumsum(
-        (cand_path == lca_node[:, None]).astype(jnp.int32), axis=1) > 0
-    below = (~seen_lca) & (cand_path != null_node)
-    below = below.at[:, 0].set(False)                   # [W, D]
-    over_path = over_all[cand_path].astype(jnp.int32)   # [W, D, F]
-    node_over_k = jnp.einsum("wdf,kf->wdk", over_path,
-                             frs_k.astype(jnp.int32)) > 0
-    path_ok_k = jnp.all(~below[:, :, None] | node_over_k, axis=1)  # [W, K]
-
-    legal_k = (legal0[:, None] & uses_k
-               & (same_cq[:, None]
-                  | (other_ok[:, None] & cq_over_k & path_ok_k)))
-    has_cand = jnp.any(legal_k, axis=0)                 # [K]
-
-    grp = t.cq_opt_group[cqi]                           # [K]
+    pmode_k = jnp.where(valid_k, pmode_k, P_NOFIT)
+    is_pre_pm = (pmode_k == P_PREEMPT) | (pmode_k == P_RECLAIM)
+    stn = ((pmode_k == P_NOFIT) | (pmode_k == P_NO_CANDIDATES)
+           | (is_pre_pm & t.cq_preempt_try_next[cqi])
+           | ((borrow_k != 0) & t.cq_try_next[cqi]))
+    brk = valid_k & ~stn
     k_idx = jnp.arange(K, dtype=jnp.int32)
+    bor = jnp.minimum(borrow_k, B_CAP - 1)
+    key_bop = ((P_FIT - pmode_k) * B_CAP + bor) * K + k_idx
+    key_pob = (bor * (P_FIT + 1) + (P_FIT - pmode_k)) * K + k_idx
+    key = jnp.where(t.cq_pref_pob[cqi], key_pob, key_bop)
+    eligible = valid_k & (pmode_k > P_NOFIT)
+
     k_out = jnp.zeros((g_max,), dtype=jnp.int32)
+    next_cursor = jnp.zeros((g_max,), dtype=jnp.int32)
     req = jnp.zeros((req_k.shape[1],), dtype=req_k.dtype)
     borrow = jnp.zeros((), dtype=jnp.int32)
+    mode = jnp.full((), M_FIT, dtype=jnp.int32)
+    pmode_sel = jnp.full((g_max,), P_FIT, dtype=jnp.int32)
     for g in range(g_max):
         in_g = grp == g
-        keep_fit = opt_fit_row[k_chosen_row[g]] & (grp[k_chosen_row[g]] == g)
-        pre_cand = jnp.min(jnp.where(
-            in_g & opt_preempt_row & has_cand, k_idx, K))
-        pre_any = jnp.min(jnp.where(in_g & opt_preempt_row, k_idx, K))
-        k_pre = jnp.where(pre_cand < K, pre_cand,
-                          jnp.minimum(pre_any, K - 1))
-        k_g = jnp.where(keep_fit, k_chosen_row[g], k_pre).astype(jnp.int32)
-        k_out = k_out.at[g].set(jnp.where(group_active_row[g], k_g, 0))
-        req = req + jnp.where(group_active_row[g], req_k[k_g], 0)
-        borrow = jnp.maximum(
-            borrow, jnp.where(group_active_row[g], opt_level_row[k_g], 0))
-    return k_out, req, borrow
+        has_g = jnp.any(in_g)
+        active = group_active_row[g]
+        k_brk = jnp.min(jnp.where(brk & in_g, k_idx, K))
+        elig_g = eligible & in_g
+        any_elig = jnp.any(elig_g)
+        k_best = jnp.argmin(jnp.where(elig_g, key, BIG)).astype(jnp.int32)
+        k_first = jnp.min(jnp.where(in_g, k_idx, K))
+        k_g = jnp.where(k_brk < K, k_brk,
+                        jnp.where(any_elig, k_best,
+                                  jnp.minimum(k_first, K - 1)))
+        k_g = k_g.astype(jnp.int32)
+        pm_g = jnp.where((k_brk < K) | any_elig, pmode_k[k_g], P_NOFIT)
+        m_g = jnp.where(pm_g == P_FIT, M_FIT,
+                        jnp.where(pm_g == P_NOFIT, M_NOFIT, M_PREEMPT))
+        # Inactive groups (no requested resources) are vacuous fits.
+        m_g = jnp.where(active & has_g, m_g, M_FIT)
+        mode = jnp.minimum(mode, m_g)
+        k_out = k_out.at[g].set(jnp.where(active, k_g, 0))
+        pmode_sel = pmode_sel.at[g].set(
+            jnp.where(active & has_g, pm_g, P_FIT))
+        req = req + jnp.where(active, req_k[k_g], 0)
+        borrow = jnp.maximum(borrow, jnp.where(active, borrow_k[k_g], 0))
+        # flavor cursor (flavorassigner.go:843,939-947): next attempt
+        # resumes after the break position; walking off the end resets.
+        pos_brk = pos[jnp.minimum(k_brk, K - 1)]
+        n_in_g = jnp.sum(in_g)
+        nc = jnp.where((k_brk < K) & (pos_brk < n_in_g - 1), pos_brk + 1, 0)
+        next_cursor = next_cursor.at[g].set(
+            jnp.where(active, nc, 0).astype(jnp.int32))
+    return mode, k_out, req, borrow, next_cursor, pmode_sel
 
 
 # ---------------------------------------------------------------------------
@@ -437,9 +465,16 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
     """Victim search for ONE preemptor (vmap over lanes).
 
     Returns (success, victim_w [P] int32 (W_null padded), victim_valid [P]
-    bool, victim_reason [P] int8). Mirrors Preemptor._classical_preemptions:
-    candidate generation + ordering, two allow-borrowing attempts of the
-    remove-until-fits scan, then fillBackWorkloads.
+    bool, victim_reason [P] int8, any_same_cq bool, borrow_after int32).
+    Mirrors Preemptor._classical_preemptions: candidate generation +
+    ordering, two allow-borrowing attempts of the remove-until-fits scan,
+    then fillBackWorkloads. ``borrow_after`` is the
+    FindHeightOfLowestSubtreeThatFits level computed on the usage with the
+    chosen victims removed (round-start usage when the search fails),
+    maxed over the FRs needing preemption — simulate_preemption's
+    borrow-after that ranks preempt flavors in the assigner's granular
+    mode; ``any_same_cq`` distinguishes Preempt from Reclaim possibilities
+    (preemption_oracle.go).
     """
     W1 = t.wl_cqid.shape[0]
     W_null = W1 - 1
@@ -634,14 +669,19 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
 
         (usage_l, victims), _ = jax.lax.scan(
             fb_step, (usage_l, victims), jnp.arange(p_max))
-        return fitted, victims
+        return fitted, victims, usage_l
 
-    ok1, v1 = attempt(first_borrow, jnp.ones((), dtype=bool))
-    ok2, v2 = attempt(second_borrow, has_second & ~ok1)
+    ok1, v1, u1 = attempt(first_borrow, jnp.ones((), dtype=bool))
+    ok2, v2, u2 = attempt(second_borrow, has_second & ~ok1)
     success = ok1 | ok2
     victims = jnp.where(ok1, v1, jnp.where(ok2, v2, False))
+    usage_after = jnp.where(ok1, u1, jnp.where(ok2, u2, usage0_round))
+    level_f, _ = _height_along_path(t, usage_after, cq_node, req)
+    borrow_after = jnp.max(jnp.where(frs_mask, level_f, 0))
     reason = jnp.where(victims, cand_variant, V_NEVER).astype(jnp.int8)
-    return success, cand_w, victims, reason
+    victim_same = victims & (t.wl_cqid[cand_w] == cqid)
+    any_same_cq = jnp.any(victim_same & cand_valid)
+    return success, cand_w, victims, reason, any_same_cq, borrow_after
 
 
 # ---------------------------------------------------------------------------
@@ -664,11 +704,11 @@ def _quota_to_reserve(t, usage, cq_node, req, borrow):
 
 def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
                     borrow, lane_of_entry, lane_success, lane_cand_w,
-                    lane_victims, p_max: int):
+                    lane_victims, lane_reason, p_max: int):
     """Process the round's entries in order; returns updated state parts.
 
     state: (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
-            victims_all)
+            victims_all, victim_reason)
     """
     C = cand_w.shape[0]
     W1 = t.wl_cqid.shape[0]
@@ -683,7 +723,7 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
 
     def step(carry, slot):
         (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
-         victims_all, any_adm, any_evict) = carry
+         victims_all, victim_reason, any_adm, any_evict) = carry
         w, cqid, m, req, brw, lane = slot
         cq_node = t.cq_node[jnp.minimum(cqid, C - 1)]
         is_active = (w != W_null) & (m != M_NOFIT)
@@ -729,6 +769,10 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
         evict_now = do_preempt & vm                     # [P]
         victims_all = victims_all.at[vw].max(evict_now, mode="drop")
         victims_all = victims_all.at[W_null].set(False)
+        # record each victim's candidate variant (preemption reason)
+        victim_reason = victim_reason.at[vw].max(
+            jnp.where(evict_now, lane_reason[lane_i], 0), mode="drop")
+        victim_reason = victim_reason.at[W_null].set(0)
         admitted = admitted.at[vw].min(~evict_now, mode="drop")
         # durable rows: victims' usage leaves their CQ row (P-sized scatter)
         v_nodes = t.cq_node[jnp.minimum(t.wl_cqid[vw], C - 1)]
@@ -754,23 +798,24 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
             jnp.where(do_admit, req, wl_usage[w]))
         any_adm = any_adm | do_admit
         return (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
-                victims_all, any_adm, any_evict), do_admit
+                victims_all, victim_reason, any_adm, any_evict), do_admit
 
     slots = (cand_w[order], jnp.arange(C, dtype=jnp.int32)[order],
              mode[order], req_c[order], borrow[order], lane_of_entry[order])
     init = (state["usage_full"], state["usage_net"], state["cq_rows"],
             state["admitted"], state["parked"], state["wl_usage"],
-            state["victims_all"], jnp.zeros((), dtype=bool),
-            jnp.zeros((), dtype=bool))
+            state["victims_all"], state["victim_reason"],
+            jnp.zeros((), dtype=bool), jnp.zeros((), dtype=bool))
     (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
-     victims_all, any_adm, any_evict), admitted_slot = jax.lax.scan(
-        step, init, slots)
+     victims_all, victim_reason, any_adm, any_evict), admitted_slot = (
+        jax.lax.scan(step, init, slots))
     # map per-slot admit flags back to entry order
     adm_entry = jnp.zeros((C,), dtype=bool).at[order].set(admitted_slot)
     return {
         "usage_full": usage_full, "usage_net": usage_net,
         "cq_rows": cq_rows, "admitted": admitted, "parked": parked,
         "wl_usage": wl_usage, "victims_all": victims_all,
+        "victim_reason": victim_reason,
     }, adm_entry, any_adm, any_evict
 
 
@@ -799,20 +844,29 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
     cand_w = select_heads_full(t, admitted, parked, ts)
     avail = available_all(t, usage)
     (mode, k_chosen, req_c, borrow, next_cursor,
-     opt_fit, opt_preempt, opt_level, group_active) = nominate_full(
-        t, usage, avail, pot, cand_w, state["cursor"], g_max)
+     opt_fit, opt_preempt, opt_level, group_active, opt_valid) = (
+        nominate_full(t, usage, avail, pot, cand_w, state["cursor"], g_max))
 
-    # park NoFit heads of BestEffortFIFO queues
     is_head = cand_w != W_null
-    park_now = is_head & (mode == M_NOFIT) & ~t.cq_strict
-    parked = parked.at[cand_w].set(parked[cand_w] | park_now)
+    K = t.cq_opt_group.shape[1]
 
-    # ---- compact preempt-mode heads into H_MAX search lanes -----
-    preempt_head = is_head & (mode == M_PREEMPT)
+    # ---- which heads need victim-search simulation? ------------------
+    # A head with preempt-capable options needs per-option simulation to
+    # pick its flavor (the granular-mode walk depends on NoCandidates /
+    # Preempt / Reclaim and borrow-after, preemption_oracle.go) — except
+    # when the provisional choice is a Fit under default fungibility
+    # (whenCanPreempt=TryNextFlavor, BorrowingOverPreemption): there a
+    # fit option always beats every preempt option in the walk.
+    any_preemptish = jnp.any(opt_preempt & ~opt_fit, axis=1)  # [C]
+    fit_wins = (mode == M_FIT) & t.cq_preempt_try_next & ~t.cq_pref_pob
+    needs_search = (is_head & any_preemptish & ~fit_wins
+                    & (mode != M_NOFIT))
+
+    # ---- compact searching heads into H_MAX lanes (entry order) ------
     ekey = jnp.lexsort((
         t.wl_uid[cand_w], ts[cand_w], -t.wl_prio[cand_w],
-        jnp.where(preempt_head, borrow, BIG), ~preempt_head))
-    pe_sorted = preempt_head[ekey]
+        jnp.where(needs_search, borrow, BIG), ~needs_search))
+    pe_sorted = needs_search[ekey]
     pos = jnp.cumsum(pe_sorted.astype(jnp.int32)) - 1
     lane_cq = jnp.full((h_max,), C, dtype=jnp.int32)
     lane_cq = lane_cq.at[jnp.where(pe_sorted, pos, h_max)].set(
@@ -826,40 +880,80 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
         jnp.where(lane_valid, lane_cq, C)].set(
         jnp.arange(h_max, dtype=jnp.int32), mode="drop")
 
-    # re-pick flavors for preempt heads, skipping NoCandidates options
-    over_all = usage > t.subtree
-    refine = jax.vmap(
-        lambda hw, av, of, op, ol, kc, ga: refine_preempt_option(
-            t, usage, over_all, wl_usage, admitted, ts, hw, av, of, op,
-            ol, kc, ga, g_max))
-    lane_k, lane_req_r, lane_borrow = refine(
-        lane_w, lane_avail, opt_fit[lane_cqc], opt_preempt[lane_cqc],
-        opt_level[lane_cqc], k_chosen[lane_cqc], group_active[lane_cqc])
-    lane_req = jnp.where(lane_valid[:, None], lane_req_r, 0)
-    # the refined choice replaces the entry's requests/borrow for the scan
-    lane_target = jnp.where(lane_valid, lane_cq, C)
-    req_c = req_c.at[lane_target].set(lane_req, mode="drop")
-    borrow = borrow.at[lane_target].set(lane_borrow, mode="drop")
-
+    # ---- per-option victim-search simulation over [H, K] -------------
+    # One classical search per (lane, option): SimulatePreemption parity
+    # (the host runs _get_targets per flavor during assignment).
     search = jax.vmap(
         lambda hw, rq, av: classical_search(
             t, usage, wl_usage, admitted, state["evicted"], ts,
             state["admit_rank"], hw, rq, av, p_max))
-    lane_success, lane_cand_w, lane_victims, lane_reason = search(
-        lane_w, lane_req, lane_avail)
-    lane_success = lane_success & lane_valid
+    flat_w = jnp.repeat(lane_w, K)
+    flat_req = t.wl_req[lane_w].reshape(h_max * K, -1)
+    flat_avail = jnp.repeat(lane_avail, K, axis=0)
+    (s_succ, s_cand_w, s_victims, s_reason, s_same, s_borrow) = search(
+        flat_w, flat_req, flat_avail)
+
+    # granular-mode table per (lane, option)
+    sim_pmode = jnp.where(
+        s_succ, jnp.where(s_same, P_PREEMPT, P_RECLAIM),
+        P_NO_CANDIDATES).reshape(h_max, K)
+    sim_borrow = s_borrow.reshape(h_max, K)
+    fit_l = opt_fit[lane_cqc]                     # [H, K]
+    pre_l = (opt_preempt & ~opt_fit)[lane_cqc]
+    pmode_k = jnp.where(fit_l, P_FIT,
+                        jnp.where(pre_l, sim_pmode, P_NOFIT))
+    borrow_k = jnp.where(fit_l, opt_level[lane_cqc],
+                         jnp.where(pre_l, sim_borrow, 0))
+
+    # ---- the assigner's walk picks each lane's final assignment ------
+    walk = jax.vmap(
+        lambda hw, pm, bo, va, ga: walk_assign(t, hw, pm, bo, va, ga,
+                                               g_max))
+    (l_mode, l_k, l_req, l_borrow, l_next_cursor, l_pmode_sel) = walk(
+        lane_w, pmode_k, borrow_k, opt_valid[lane_cqc],
+        group_active[lane_cqc])
+    l_req = jnp.where(lane_valid[:, None], l_req, 0)
+
+    lane_target = jnp.where(lane_valid, lane_cq, C)
+    mode = mode.at[lane_target].set(l_mode, mode="drop")
+    k_chosen = k_chosen.at[lane_target].set(l_k, mode="drop")
+    req_c = req_c.at[lane_target].set(l_req, mode="drop")
+    borrow = borrow.at[lane_target].set(l_borrow, mode="drop")
+    next_cursor = next_cursor.at[lane_target].set(
+        l_next_cursor, mode="drop")
+
+    # ---- final victim set for each preempting lane -------------------
+    if g_max == 1:
+        # single group: the chosen option's simulation IS the final
+        # search (same request vector, same FRs)
+        idx = jnp.arange(h_max, dtype=jnp.int32) * K + l_k[:, 0]
+        lane_success = s_succ[idx]
+        lane_cand_w = s_cand_w[idx]
+        lane_victims = s_victims[idx]
+        lane_reason = s_reason[idx]
+    else:
+        # multi-group: GetTargets re-runs on the combined assignment
+        # usage (preemption.py get_targets with all preempt-mode frs)
+        (lane_success, lane_cand_w, lane_victims, lane_reason,
+         _s, _b) = search(lane_w, l_req, lane_avail)
+    lane_success = (lane_success & lane_valid & (l_mode == M_PREEMPT))
+
+    # park NoFit heads of BestEffortFIFO queues (post-walk modes)
+    park_now = is_head & (mode == M_NOFIT) & ~t.cq_strict
+    parked = parked.at[cand_w].set(parked[cand_w] | park_now)
 
     # ---- entry scan ---------------------------------------------
     scan_state = {
         "usage_full": usage, "usage_net": usage,
         "cq_rows": state["cq_rows"], "admitted": admitted,
         "parked": parked, "wl_usage": wl_usage,
-        "victims_all": jnp.zeros((W1,), dtype=bool), "ts": ts,
+        "victims_all": jnp.zeros((W1,), dtype=bool),
+        "victim_reason": state["victim_reason"], "ts": ts,
     }
     out, adm_entry, any_adm, any_evict = full_round_scan(
         t, scan_state, cand_w, mode, k_chosen, req_c, borrow,
         lane_of_entry, lane_success, lane_cand_w, lane_victims,
-        p_max)
+        lane_reason, p_max)
     admitted = out["admitted"]
     parked = out["parked"]
     wl_usage = out["wl_usage"]
@@ -913,7 +1007,8 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
         "admitted": admitted, "parked": parked, "ts": ts,
         "evicted": evicted_f, "admit_rank": admit_rank,
         "wl_usage": wl_usage, "cursor": cursor, "opt": opt,
-        "admit_round": admit_round, "progress": progress,
+        "admit_round": admit_round,
+        "victim_reason": out["victim_reason"], "progress": progress,
         "rounds": rounds + 1,
     }
     debug = {
@@ -939,6 +1034,7 @@ def _init_state(t: FullTensors, g_max: int):
         "cursor": jnp.zeros((W1, g_max), dtype=jnp.int32),
         "opt": jnp.zeros((W1, g_max), dtype=jnp.int32),
         "admit_round": jnp.full((W1,), -1, dtype=jnp.int32),
+        "victim_reason": jnp.zeros((W1,), dtype=jnp.int8),
         "progress": jnp.ones((), dtype=bool),
         "rounds": jnp.zeros((), dtype=jnp.int32),
     }
@@ -965,7 +1061,8 @@ def make_full_solver(g_max: int, h_max: int, p_max: int):
         admitted = final["admitted"].at[W_null].set(False)
         parked = final["parked"].at[W_null].set(False)
         return (admitted, final["opt"], final["admit_round"], parked,
-                final["rounds"], final["usage"], final["wl_usage"])
+                final["rounds"], final["usage"], final["wl_usage"],
+                final["victim_reason"])
 
     return solve
 

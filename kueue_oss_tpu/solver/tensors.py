@@ -20,6 +20,7 @@ import numpy as np
 
 from kueue_oss_tpu.api.types import (
     FlavorFungibilityPolicy,
+    FlavorFungibilityPreference,
     FlavorResource,
     PreemptionPolicyValue,
     QueueingStrategy,
@@ -121,6 +122,7 @@ class SolverProblem:
     cq_bwc_forbidden: Optional[np.ndarray] = None   # [C] bool
     cq_bwc_threshold: Optional[np.ndarray] = None   # [C] int32 (NO_THRESHOLD)
     cq_preempt_try_next: Optional[np.ndarray] = None  # [C] bool
+    cq_pref_pob: Optional[np.ndarray] = None        # [C] bool (PreemptionOverBorrowing)
     cq_fair_weight: Optional[np.ndarray] = None     # [C] float32
     cq_root: Optional[np.ndarray] = None            # [C] int32 root node idx
     cq_opt_group: Optional[np.ndarray] = None       # [C, K] int32 (-1 none)
@@ -154,6 +156,49 @@ class SolverProblem:
     @property
     def n_workloads(self) -> int:
         return self.wl_cqid.shape[0] - 1
+
+
+def pad_workloads(problem: SolverProblem, target_w: int) -> SolverProblem:
+    """Pad the workload axis to ``target_w`` rows (plus the null row).
+
+    Padding rows carry the null CQ id (C) so head selection's segment
+    reduction drops them, no valid options, and no initial state — they
+    are inert. Power-of-two bucketing keeps the jitted kernels' shape
+    cache small when drains run repeatedly over a changing backlog
+    (the Simulator drains after every event batch).
+    """
+    import dataclasses
+
+    W = problem.n_workloads
+    if target_w <= W:
+        return problem
+    pad = target_w - W
+    C = problem.n_cqs
+
+    def pad1(arr, fill, dtype=None):
+        if arr is None:
+            return None
+        body, null_row = arr[:-1], arr[-1:]
+        pad_shape = (pad,) + arr.shape[1:]
+        filler = np.full(pad_shape, fill, dtype=arr.dtype)
+        return np.concatenate([body, filler, null_row])
+
+    return dataclasses.replace(
+        problem,
+        wl_cqid=pad1(problem.wl_cqid, C),
+        wl_rank=pad1(problem.wl_rank, BIG),
+        wl_prio=pad1(problem.wl_prio, 0),
+        wl_ts=pad1(problem.wl_ts, 0),
+        wl_uid=pad1(problem.wl_uid, 0),
+        wl_req=pad1(problem.wl_req, 0),
+        wl_valid=pad1(problem.wl_valid, False),
+        wl_parked0=pad1(problem.wl_parked0, False),
+        wl_admitted0=pad1(problem.wl_admitted0, False),
+        wl_evicted0=pad1(problem.wl_evicted0, False),
+        wl_admit_rank=pad1(problem.wl_admit_rank, 0),
+        ad_usage=pad1(problem.ad_usage, 0),
+        wl_keys=list(problem.wl_keys) + [""] * pad,
+    )
 
 
 def _flavor_compatible(info: WorkloadInfo, flavor: ResourceFlavor,
@@ -280,6 +325,7 @@ def export_problem(
     cq_bwc_forbidden = np.zeros(C, dtype=bool)
     cq_bwc_threshold = np.full(C, NO_THRESHOLD, dtype=np.int32)
     cq_preempt_try_next = np.zeros(C, dtype=bool)
+    cq_pref_pob = np.zeros(C, dtype=bool)
     cq_fair_weight = np.ones(C, dtype=np.float32)
     cq_root = np.zeros(C, dtype=np.int32)
     cq_ngroups = np.ones(C, dtype=np.int32)
@@ -299,6 +345,9 @@ def export_problem(
         cq_preempt_try_next[cid] = (
             spec.flavor_fungibility.when_can_preempt
             == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR)
+        cq_pref_pob[cid] = (
+            spec.flavor_fungibility.preference
+            == FlavorFungibilityPreference.PREEMPTION_OVER_BORROWING)
         cq_root_height[cid] = height[index[id(node.root())]]
         cq_root[cid] = index[id(node.root())]
         cq_within_policy[cid] = _POLICY_CODE[
@@ -499,6 +548,7 @@ def export_problem(
         cq_bwc_forbidden=cq_bwc_forbidden,
         cq_bwc_threshold=cq_bwc_threshold,
         cq_preempt_try_next=cq_preempt_try_next,
+        cq_pref_pob=cq_pref_pob,
         cq_fair_weight=cq_fair_weight,
         cq_root=cq_root,
         cq_opt_group=cq_opt_group,

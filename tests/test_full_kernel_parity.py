@@ -175,7 +175,7 @@ def run_kernel(seed: int):
                              parked=parked)
     t = to_device_full(problem)
     g_max = int(problem.cq_ngroups.max())
-    admitted_a, opt, admit_round, parked, rounds, usage, wl_usage = (
+    admitted_a, opt, admit_round, parked, rounds, usage, wl_usage, _vr = (
         solve_backlog_full(t, g_max=g_max, h_max=8, p_max=32))
     admitted_a = np.asarray(admitted_a)
     opt = np.asarray(opt)
