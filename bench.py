@@ -31,6 +31,15 @@ import subprocess
 import sys
 import time
 
+if os.environ.get("BENCH_CPU") == "1":
+    # force the host platform BEFORE jax initializes (the ambient TPU
+    # PJRT plugin otherwise overrides JAX_PLATFORMS and blocks on the
+    # tunneled device)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
 #: reference implied admission throughput (BASELINE.md: 15k wl / 351.1s)
 BASELINE_ADMISSIONS_PER_SEC = 42.7
 
@@ -49,6 +58,10 @@ def _build(preemption: bool, small: bool):
         config.nominal_quota = 200  # >= per-CQ demand: everything fits
     if small:
         config.n_cohorts, config.cqs_per_cohort = 2, 10
+    if os.environ.get("BENCH_COHORTS"):
+        config.n_cohorts = int(os.environ["BENCH_COHORTS"])
+    if os.environ.get("BENCH_CQS"):
+        config.cqs_per_cohort = int(os.environ["BENCH_CQS"])
     store, schedule = generate(config)
     for g in schedule:
         store.add_workload(g.workload)
@@ -97,11 +110,17 @@ def run_scenario(scenario: str) -> dict:
         problem = export_problem(store, pending, include_admitted=True)
         g_max = int(problem.cq_ngroups.max())
         h_max, p_max = engine._size_caps(problem)
+        if os.environ.get("BENCH_HMAX"):
+            h_max = int(os.environ["BENCH_HMAX"])
+        if os.environ.get("BENCH_PMAX"):
+            p_max = int(os.environ["BENCH_PMAX"])
+        round_cap = int(os.environ.get("BENCH_ROUND_CAP", "2048"))
         log(f"[preempt] W={problem.n_workloads} C={problem.n_cqs} "
-            f"g_max={g_max} h_max={h_max} p_max={p_max}")
+            f"g_max={g_max} h_max={h_max} p_max={p_max} cap={round_cap}")
         tensors = to_device_full(problem)
         jax.block_until_ready(tensors)
-        solver = make_full_solver(g_max, h_max, p_max)
+        solver = make_full_solver(g_max, h_max, p_max,
+                                  round_cap=round_cap)
         compiled = solver.lower(tensors).compile()
         t0 = time.monotonic()
         out = compiled(tensors)
@@ -135,6 +154,10 @@ def run_scenario(scenario: str) -> dict:
         problem = export_problem(store, pending, include_admitted=True)
         g_max = int(problem.cq_ngroups.max())
         h_max, p_max = engine._size_caps(problem)
+        if os.environ.get("BENCH_HMAX"):
+            h_max = int(os.environ["BENCH_HMAX"])
+        log(f"[cycles] W={problem.n_workloads} C={problem.n_cqs} "
+            f"h_max={h_max} p_max={p_max}")
         t = to_device_full(problem)
         pot = potential_available_all(t)
         step = jax.jit(lambda tt, st: round_body(tt, st, pot, g_max,
@@ -167,7 +190,7 @@ def run_scenario(scenario: str) -> dict:
 
         sched = Scheduler(store_h, queues_h)
         t0 = time.monotonic()
-        sched.run_until_quiet(now=0.0, max_cycles=20000)
+        sched.run_until_quiet(now=0.0, max_cycles=20000, tick=1.0)
         host_s = time.monotonic() - t0
         admitted_h = {k for k, w in store_h.workloads.items()
                       if w.is_quota_reserved}
@@ -192,12 +215,15 @@ def run_scenario(scenario: str) -> dict:
     raise SystemExit(f"unknown scenario {scenario}")
 
 
-def measure(scenario: str) -> dict:
+def measure(scenario: str, extra_env: dict | None = None,
+            timeout: int = 1800) -> dict:
     """Run one scenario in a fresh subprocess (AOT compile inside)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--scenario", scenario]
+    env = dict(os.environ)
+    env.update(extra_env or {})
     t0 = time.monotonic()
     proc = subprocess.run(cmd, capture_output=True, text=True,
-                          env=dict(os.environ), timeout=3600)
+                          env=env, timeout=timeout)
     if proc.returncode != 0:
         log(proc.stderr[-3000:])
         raise RuntimeError(f"scenario {scenario} failed")
@@ -207,6 +233,18 @@ def measure(scenario: str) -> dict:
     return result
 
 
+#: preempt-scenario scale ladder: (label, env, subprocess timeout). The
+#: tunneled TPU stalls on device programs beyond ~100 CQs / 5k workloads
+#: (remote compile/execution never returns); the bench reports the
+#: largest scale that completes and says so.
+SCALES = [
+    ("50k_wl_1000_cqs", {}, 2400),
+    ("25k_wl_500_cqs", {"BENCH_COHORTS": "10", "BENCH_CQS": "50"}, 1500),
+    ("10k_wl_200_cqs", {"BENCH_COHORTS": "4", "BENCH_CQS": "50"}, 1200),
+    ("5k_wl_100_cqs", {"BENCH_COHORTS": "4", "BENCH_CQS": "25"}, 900),
+]
+
+
 def main() -> None:
     if "--scenario" in sys.argv:
         scenario = sys.argv[sys.argv.index("--scenario") + 1]
@@ -214,27 +252,45 @@ def main() -> None:
         return
 
     t_start = time.monotonic()
-    preempt = measure("preempt")
-    cycles = measure("cycles")
-    parity = measure("parity")
-    lean = measure("lean")
+    preempt = None
+    scale_label = None
+    for label, env, tmo in SCALES:
+        try:
+            preempt = measure("preempt", extra_env=env, timeout=tmo)
+            scale_label = label
+            break
+        except Exception as e:  # timeout / device stall: try smaller
+            log(f"[preempt@{label}] did not complete: {e}")
+    if preempt is None:
+        raise RuntimeError("preempt scenario failed at every scale")
+
+    # per-cycle latency on the host CPU backend at the largest shape the
+    # tunnel's stepped path cannot serve (honest label: cpu backend)
+    cycles = measure("cycles", extra_env={
+        "BENCH_CPU": "1", "BENCH_COHORTS": "10", "BENCH_CQS": "50",
+        "BENCH_CYCLES": "10"}, timeout=1800)
+    parity = measure("parity", timeout=1800)
+    lean = measure("lean", timeout=1800)
     log(f"total bench time {time.monotonic() - t_start:.1f}s")
 
     value = preempt["admitted"] / preempt["seconds"]
     lean_value = lean["admitted"] / lean["seconds"]
     print(json.dumps({
-        "metric": "preempt_drain_admissions_50k_backlog_1k_cqs",
+        "metric": f"preempt_drain_admissions_{scale_label}",
         "value": round(value, 1),
         "unit": "admissions/s",
         "vs_baseline": round(value / BASELINE_ADMISSIONS_PER_SEC, 1),
         "admitted": preempt["admitted"],
         "workloads": preempt["workloads"],
         "rounds": preempt["rounds"],
-        "drain_seconds": round(preempt["seconds"], 3),
-        "cycle_ms_p50": round(cycles["cycle_ms_p50"], 2),
-        "cycle_ms_p99": round(cycles["cycle_ms_p99"], 2),
+        "drain_seconds": round(preempt["seconds"], 6),
+        "cycle_ms_p50_cpu_25k": round(cycles["cycle_ms_p50"], 2),
+        "cycle_ms_p99_cpu_25k": round(cycles["cycle_ms_p99"], 2),
         "plan_agreement_small": round(parity["plan_agreement"], 4),
-        "lean_admissions_per_s": round(lean_value, 1),
+        "lean_admissions_per_s_50k": round(lean_value, 1),
+        "note": ("full kernel timed on TPU at the largest scale the "
+                 "tunneled device completes; larger shapes stall in "
+                 "remote compile/execution"),
     }), flush=True)
 
 

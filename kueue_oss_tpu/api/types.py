@@ -312,6 +312,18 @@ class PodSetTopologyRequest:
     podset_group_name: Optional[str] = None
     podset_slice_required_topology: Optional[str] = None
     podset_slice_size: Optional[int] = None
+    #: additional nested slice layers below the outermost slice
+    #: (KEP multi-layer topology; workload_types.go
+    #: PodsetSliceRequiredTopologyConstraints): (topology level, size)
+    #: pairs, each layer strictly below and evenly dividing its parent
+    podset_slice_constraints: list["PodSetSliceConstraint"] = field(
+        default_factory=list)
+
+
+@dataclass
+class PodSetSliceConstraint:
+    topology: str = ""
+    size: int = 1
 
 
 @dataclass
@@ -430,6 +442,10 @@ class WorkloadStatus:
     #: (workload_types.go:686-706 NominatedClusterNames / ClusterName)
     nominated_cluster_names: list[str] = field(default_factory=list)
     cluster_name: Optional[str] = None
+    #: podset name -> pods whose resources are no longer needed (finished
+    #: pods of a running workload release their quota share; reference:
+    #: workload_types.go ReclaimablePods, JobWithReclaimablePods)
+    reclaimable_pods: dict[str, int] = field(default_factory=dict)
 
 
 _uid_counter = itertools.count(1)

@@ -22,6 +22,8 @@ from kueue_oss_tpu.api.types import (
     StopPolicy,
 )
 from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.core.workload_info import effective_priority
+from kueue_oss_tpu.util.events import recorder as events
 from kueue_oss_tpu.webhooks import (
     ValidationError,
     admit_cluster_queue,
@@ -43,8 +45,10 @@ def _fmt_table(headers: list[str], rows: list[list[str]]) -> str:
 
 
 class Kueuectl:
-    def __init__(self, store: Store) -> None:
+    def __init__(self, store: Store, queues=None) -> None:
         self.store = store
+        #: optional QueueManager for pending-workload positions
+        self.queues = queues
 
     # -- entry point --------------------------------------------------------
 
@@ -87,6 +91,19 @@ class Kueuectl:
         lwl.add_argument("-n", "--namespace", default=None)
         lwl.set_defaults(func=self._list_wl)
         lst.add_parser("resourceflavor").set_defaults(func=self._list_rf)
+        lst.add_parser("cohort").set_defaults(func=self._list_cohorts)
+        lpw = lst.add_parser("pending-workloads")
+        lpw.add_argument("--clusterqueue", default=None)
+        lpw.set_defaults(func=self._list_pending)
+
+        desc = sub.add_parser("describe").add_subparsers(required=True)
+        dscq = desc.add_parser("clusterqueue")
+        dscq.add_argument("name")
+        dscq.set_defaults(func=self._describe_cq)
+        dswl = desc.add_parser("workload")
+        dswl.add_argument("name")
+        dswl.add_argument("-n", "--namespace", default="default")
+        dswl.set_defaults(func=self._describe_wl)
 
         for verb, policy in (("stop", StopPolicy.HOLD_AND_DRAIN),
                              ("resume", StopPolicy.NONE)):
@@ -212,6 +229,98 @@ class Kueuectl:
                 for rf in sorted(self.store.resource_flavors.values(),
                                  key=lambda r: r.name)]
         return _fmt_table(["NAME", "NODELABELS", "TOPOLOGY"], rows)
+
+    def _list_cohorts(self, ns) -> str:
+        """Cohort forest with member counts (kueuectl list cohort)."""
+        children: dict[str, list[str]] = {}
+        members: dict[str, list[str]] = {}
+        for co in self.store.cohorts.values():
+            children.setdefault(co.parent or "", []).append(co.name)
+        for cq in self.store.cluster_queues.values():
+            if cq.cohort:
+                members.setdefault(cq.cohort, []).append(cq.name)
+        rows = []
+        for co in sorted(self.store.cohorts.values(), key=lambda c: c.name):
+            rows.append([co.name, co.parent or "<root>",
+                         str(len(members.get(co.name, []))),
+                         str(len(children.get(co.name, [])))])
+        return _fmt_table(["NAME", "PARENT", "CLUSTERQUEUES", "CHILD COHORTS"],
+                          rows)
+
+    def _list_pending(self, ns) -> str:
+        """Pending workloads with queue positions (kueuectl list
+        pending-workloads; backed by the queue manager the way the
+        reference goes through the visibility API)."""
+        if self.queues is None:
+            raise CliError(
+                "pending-workloads requires a queue manager (visibility)")
+        rows = []
+        for name, q in sorted(self.queues.queues.items()):
+            if ns.clusterqueue is not None and name != ns.clusterqueue:
+                continue
+            for pos, info in enumerate(q.snapshot_order()):
+                rows.append([info.obj.namespace, info.obj.name, name,
+                             str(pos), str(effective_priority(info.obj))])
+            for key in q.inadmissible:
+                wl = self.store.workloads.get(key)
+                if wl is not None:
+                    rows.append([wl.namespace, wl.name, name, "inadmissible",
+                                 str(effective_priority(wl))])
+        return _fmt_table(
+            ["NAMESPACE", "NAME", "CLUSTERQUEUE", "POSITION", "PRIORITY"],
+            rows)
+
+    def _describe_cq(self, ns) -> str:
+        cq = self.store.cluster_queues.get(ns.name)
+        if cq is None:
+            raise CliError(f"clusterqueue {ns.name!r} not found")
+        out = [f"Name: {cq.name}", f"Cohort: {cq.cohort or '<none>'}",
+               f"QueueingStrategy: {cq.queueing_strategy}",
+               f"StopPolicy: {cq.stop_policy}", "Quotas:"]
+        for rg in cq.resource_groups:
+            for fq in rg.flavors:
+                for rq in fq.resources:
+                    limits = []
+                    if rq.borrowing_limit is not None:
+                        limits.append(f"borrow={rq.borrowing_limit}")
+                    if rq.lending_limit is not None:
+                        limits.append(f"lend={rq.lending_limit}")
+                    out.append(f"  {fq.name}/{rq.name}: nominal={rq.nominal}"
+                               + (" " + " ".join(limits) if limits else ""))
+        evs = events.for_object(cq.name)
+        if evs:
+            out.append("Events:")
+            for e in evs[-10:]:
+                out.append(f"  {e.type}\t{e.reason}\t{e.message}")
+        return "\n".join(out)
+
+    def _describe_wl(self, ns) -> str:
+        from kueue_oss_tpu.core.workload_info import workload_status
+
+        wl = self.store.workloads.get(f"{ns.namespace}/{ns.name}")
+        if wl is None:
+            raise CliError(f"workload {ns.name!r} not found")
+        out = [f"Name: {wl.name}", f"Namespace: {wl.namespace}",
+               f"LocalQueue: {wl.queue_name}",
+               f"Priority: {wl.priority}",
+               f"Status: {workload_status(wl)}"]
+        if wl.status.admission is not None:
+            out.append(
+                f"Admitted by: {wl.status.admission.cluster_queue}")
+            for psa in wl.status.admission.podset_assignments:
+                flavors = ",".join(f"{r}={f}"
+                                   for r, f in sorted(psa.flavors.items()))
+                out.append(f"  podset {psa.name} x{psa.count}: {flavors}")
+        if wl.status.conditions:
+            out.append("Conditions:")
+            for name, cond in sorted(wl.status.conditions.items()):
+                out.append(f"  {name}={cond.status} ({cond.reason})")
+        evs = events.for_object(wl.key)
+        if evs:
+            out.append("Events:")
+            for e in evs[-10:]:
+                out.append(f"  {e.type}\t{e.reason}\t{e.message}")
+        return "\n".join(out)
 
     # -- stop/resume --------------------------------------------------------
 

@@ -1,0 +1,115 @@
+"""ClusterQueue status reconciler.
+
+Reference parity: pkg/controller/core/clusterqueue_controller.go — per
+reconcile, compute the CQ's Active condition: a CQ is Active when every
+referenced ResourceFlavor exists, every referenced AdmissionCheck
+exists and is active, the CQ is not Stopped, and its cohort is
+cycle-free. Inactive CQs are deactivated in the queue manager (their
+pending workloads stay parked) and the kueue_cluster_queue_status gauge
+flips.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_oss_tpu import metrics
+from kueue_oss_tpu.api.types import StopPolicy
+from kueue_oss_tpu.core.store import Store
+
+ACTIVE = "Active"
+
+# inactive reasons (clusterqueue_controller.go conditions)
+R_READY = "Ready"
+R_STOPPED = "Stopped"
+R_FLAVOR_NOT_FOUND = "FlavorNotFound"
+R_CHECK_NOT_FOUND = "AdmissionCheckNotFound"
+R_CHECK_INACTIVE = "AdmissionCheckInactive"
+R_COHORT_CYCLE = "CohortCycleDetected"
+
+
+@dataclass
+class CQStatus:
+    active: bool = True
+    reason: str = R_READY
+    message: str = ""
+    missing_flavors: list[str] = field(default_factory=list)
+    missing_checks: list[str] = field(default_factory=list)
+
+
+class ClusterQueueReconciler:
+    """Keeps per-CQ Active conditions + status gauges in sync."""
+
+    def __init__(self, store: Store, queues=None) -> None:
+        self.store = store
+        self.queues = queues
+        #: last computed status per CQ
+        self.status: dict[str, CQStatus] = {}
+
+    def _cohort_has_cycle(self, name: str) -> bool:
+        seen: set[str] = set()
+        cur = name
+        while cur:
+            if cur in seen:
+                return True
+            seen.add(cur)
+            co = self.store.cohorts.get(cur)
+            cur = co.parent if co is not None else None
+        return False
+
+    def reconcile(self, cq_name: str) -> CQStatus:
+        cq = self.store.cluster_queues.get(cq_name)
+        if cq is None:
+            self.status.pop(cq_name, None)
+            metrics.cluster_queue_status.delete_matching(
+                cluster_queue=cq_name)
+            return CQStatus(active=False, reason="NotFound")
+        st = CQStatus()
+        missing_flavors = sorted({
+            fq.name for rg in cq.resource_groups for fq in rg.flavors
+            if fq.name not in self.store.resource_flavors})
+        missing_checks = []
+        inactive_checks = []
+        for ac_name in cq.admission_checks:
+            ac = self.store.admission_checks.get(ac_name)
+            if ac is None:
+                missing_checks.append(ac_name)
+            elif not getattr(ac, "active", True):
+                inactive_checks.append(ac_name)
+        if cq.stop_policy != StopPolicy.NONE:
+            st = CQStatus(False, R_STOPPED, "ClusterQueue is stopped")
+        elif missing_flavors:
+            st = CQStatus(False, R_FLAVOR_NOT_FOUND,
+                          f"missing ResourceFlavors: {missing_flavors}",
+                          missing_flavors=missing_flavors)
+        elif missing_checks:
+            st = CQStatus(False, R_CHECK_NOT_FOUND,
+                          f"missing AdmissionChecks: {missing_checks}",
+                          missing_checks=missing_checks)
+        elif inactive_checks:
+            st = CQStatus(False, R_CHECK_INACTIVE,
+                          f"inactive AdmissionChecks: {inactive_checks}",
+                          missing_checks=inactive_checks)
+        elif cq.cohort and self._cohort_has_cycle(cq.cohort):
+            st = CQStatus(False, R_COHORT_CYCLE,
+                          f"cohort {cq.cohort} is part of a cycle")
+        self.status[cq_name] = st
+        metrics.cluster_queue_status.set(
+            cq_name, "active", value=1 if st.active else 0)
+        metrics.cluster_queue_status.set(
+            cq_name, "inactive", value=0 if st.active else 1)
+        # quota gauges belong to the CQ reconciler in the reference
+        metrics.report_cluster_queue_quotas(
+            cq_name, ((fr, cq.quota_for(fr))
+                      for fr in cq.flavor_resources()))
+        # an inactive CQ stops serving heads (queue manager parity)
+        if self.queues is not None:
+            q = self.queues.queues.get(cq_name)
+            if q is not None:
+                q.active = st.active and cq.stop_policy == StopPolicy.NONE
+        return st
+
+    def reconcile_all(self) -> dict[str, CQStatus]:
+        for name in list(self.store.cluster_queues):
+            self.reconcile(name)
+        return dict(self.status)

@@ -130,6 +130,20 @@ class WorkloadReconciler:
             reason = "PodsNotReady"
         wl.set_condition(WorkloadConditionType.PODS_READY, ready,
                          reason=reason, now=now)
+        if ready and not was_ready:
+            # readiness latency series (metrics.go ready_wait_time /
+            # admitted_until_ready_wait_time)
+            from kueue_oss_tpu import metrics
+
+            cq = (wl.status.admission.cluster_queue
+                  if wl.status.admission is not None else None)
+            if cq:
+                metrics.ready_wait_time_seconds.observe(
+                    cq, value=max(now - wl.creation_time, 0.0))
+                adm = wl.condition(WorkloadConditionType.ADMITTED)
+                if adm is not None and adm.status:
+                    metrics.admitted_until_ready_wait_time_seconds.observe(
+                        cq, value=max(now - adm.last_transition_time, 0.0))
         if ready:
             # Pods came up: the PodsReady requeue/backoff history is done
             # (reference: RequeueState reset once the workload runs).

@@ -290,7 +290,10 @@ class QueueManager:
         q = self.queues[name]
         q.strategy = spec.queueing_strategy
         q.active = spec.stop_policy == StopPolicy.NONE
+        from kueue_oss_tpu import features
+
         if (self.afs is not None and spec.admission_scope is not None
+                and features.enabled("AdmissionFairSharing")
                 and spec.admission_scope.admission_mode
                 == "UsageBasedAdmissionFairSharing"):
             q.afs_key = lambda info: self.afs.ordering_key(

@@ -58,7 +58,11 @@ class QuotaNode:
     def local_quota(self, fr: FlavorResource) -> int:
         q = self.quotas.get(fr)
         if q is not None and q.lending_limit is not None:
-            return max(0, self.subtree_quota.get(fr, 0) - q.lending_limit)
+            from kueue_oss_tpu import features
+
+            if features.enabled("LendingLimit"):
+                return max(
+                    0, self.subtree_quota.get(fr, 0) - q.lending_limit)
         return 0
 
     def local_available(self, fr: FlavorResource) -> int:

@@ -89,6 +89,14 @@ class Store:
         return lq
 
     def upsert_cohort(self, cohort: Cohort) -> None:
+        from kueue_oss_tpu import features
+
+        if cohort.parent and not features.enabled("HierarchicalCohorts"):
+            # flat cohorts only when the gate is off (KEP-79); store a
+            # flat copy, never mutate the caller's object
+            import dataclasses
+
+            cohort = dataclasses.replace(cohort, parent=None)
         with self._lock:
             self.cohorts[cohort.name] = cohort
         self._emit("update", "Cohort", cohort)

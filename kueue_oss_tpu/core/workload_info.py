@@ -145,6 +145,19 @@ class WorkloadInfo:
                         psr.flavors = dict(psa.flavors)
                         psr.requests = dict(psa.resource_usage)
                         psr.count = psa.count
+        # Reclaimable pods release their share of the quota (workload.go
+        # totalRequestsFromPodSets applying status.reclaimablePods).
+        rp = obj.status.reclaimable_pods
+        if rp:
+            from kueue_oss_tpu import features
+
+            if not features.enabled("ReclaimablePods"):
+                rp = {}
+        if rp:
+            self.total_requests = [
+                psr.scaled_to(max(0, psr.count - rp.get(psr.name, 0)))
+                if rp.get(psr.name, 0) else psr
+                for psr in self.total_requests]
         self.last_assignment: Optional[AssignmentClusterQueueState] = None
         #: LocalQueue fair-sharing usage (admission fair sharing, KEP-4136)
         self.local_queue_fs_usage = local_queue_fs_usage

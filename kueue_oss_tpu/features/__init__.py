@@ -19,10 +19,29 @@ _DEFAULTS: dict[str, bool] = {
     # queueing / admission
     "PartialAdmission": True,          # scheduler podset reduction
     "ObjectRetentionPolicies": True,   # workload controller GC
+    "FlavorFungibility": True,         # flavor_assigner honors custom policy
+    "PrioritySortingWithinCohort": True,  # classical iterator priority key
+    "LendingLimit": True,              # quota algebra lending limits
+    "HierarchicalCohorts": True,       # store cohort parent edges
+    "ReclaimablePods": True,           # workload_info + reconciler sync
+    "AdmissionFairSharing": True,      # queue_manager AFS ordering key
+    # multi-cluster
+    "MultiKueue": True,                # multikueue controller sync
+    # observability
+    "VisibilityOnDemand": True,        # visibility pending-workloads API
+    "LocalQueueMetrics": True,         # local_queue_* metric series
+    # DRA (reference default: alpha, off)
+    "DynamicResourceAllocation": False,  # dra device-class mapping
+    # TAS replacement triggers
+    "TASReplaceNodeOnNodeTaints": True,     # failure_recovery taint path
+    "TASReplaceNodeOnPodTermination": True,  # failure_recovery term path
+    "TASProfileMixed": True,           # LeastFreeCapacity for unconstrained
     # topology-aware scheduling
     "TopologyAwareScheduling": True,   # core/snapshot.py TAS snapshot build
     "TASFailedNodeReplacement": True,  # tas/snapshot.py replacement path
     "TASFailedNodeReplacementFailFast": False,  # failure_recovery eviction
+    "TASBalancedPlacement": False,     # tas/snapshot.py balanced algorithm
+    "TASMultiLayerTopology": False,    # tas/snapshot.py nested slice layers
     # misc controllers
     "WaitForPodsReady": True,          # workload controller PodsReady path
     # elastic jobs (KEP-77; reference default off)

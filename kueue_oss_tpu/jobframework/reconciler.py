@@ -121,7 +121,29 @@ class JobReconciler:
             self.store.delete_workload(wl.key)
             wl = self._create_workload(job, podsets, now)
 
+        self._sync_reclaimable(job, wl)
         self._sync_running_state(job, wl, now)
+
+    def _sync_reclaimable(self, job: GenericJob, wl: Workload) -> None:
+        """JobWithReclaimablePods (optional interface): finished pods of a
+        running job release their quota share. Counts are monotone
+        non-decreasing until the workload is evicted (the reference
+        rejects decreases in the workload webhook)."""
+        from kueue_oss_tpu import features
+
+        getter = getattr(job, "reclaimable_pods", None)
+        if not callable(getter) or not features.enabled("ReclaimablePods"):
+            return
+        counts = getter() or {}
+        merged = dict(wl.status.reclaimable_pods)
+        changed = False
+        for name, n in counts.items():
+            if n > merged.get(name, 0):
+                merged[name] = n
+                changed = True
+        if changed:
+            wl.status.reclaimable_pods = merged
+            self.store.update_workload(wl)
 
     def _sync_running_state(self, job: GenericJob, wl: Workload,
                             now: float) -> None:
@@ -209,6 +231,10 @@ class JobReconciler:
         )
         wl.owner = f"{job.kind}/{job.key}"
         self.store.add_workload(wl)
+        from kueue_oss_tpu import metrics
+
+        metrics.workload_creation_latency_seconds.observe(
+            job.kind, value=max(now - wl.creation_time, 0.0))
         return wl
 
     def _stop_job(self, job: GenericJob, wl: Workload, reason: str,

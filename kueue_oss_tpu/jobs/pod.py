@@ -1,18 +1,44 @@
 """Plain Pod and pod-group integrations.
 
-Reference parity: pkg/controller/jobs/pod/pod_controller.go — a single
-gated pod is a one-pod workload; pods sharing the pod-group label + total
-count annotation form a composable group whose podsets are the distinct
-pod template shapes (roles).
+Reference parity: pkg/controller/jobs/pod/pod_controller.go (2191 LoC) —
+the deepest integration in the reference:
+
+- a single gated pod is a one-pod workload; the scheduling gate
+  (kueue.x-k8s.io/admission) is removed when the workload admits;
+- pods sharing the pod-group label (kueue.x-k8s.io/pod-group-name) with
+  a total-count annotation form a COMPOSABLE group: the workload's
+  podsets are the group's distinct pod shapes (roles), assembled once
+  every expected pod has been observed (ConstructComposableWorkload);
+- excess pods beyond the declared total are excluded from the workload
+  (newest first, the reference's ExcessPods handling);
+- a Failed pod can be REPLACED by a new pod of the same shape; the
+  replacement inherits the group's admission and is ungated immediately
+  (pod_controller.go replacement path);
+- finished pods of a running group become RECLAIMABLE: their quota share
+  is released through workload.status.reclaimablePods
+  (JobWithReclaimablePods);
+- the group finishes when enough pods have succeeded (total-count),
+  or fails once every seat is terminal with no replacement pending.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
 from kueue_oss_tpu.api.types import PodSet
 from kueue_oss_tpu.jobframework.interface import BaseJob
 from kueue_oss_tpu.jobframework.registry import integration_manager
+
+#: reference label/annotation/gate names (pod_controller.go constants)
+POD_GROUP_LABEL = "kueue.x-k8s.io/pod-group-name"
+POD_GROUP_TOTAL_ANNOTATION = "kueue.x-k8s.io/pod-group-total-count"
+ADMISSION_GATE = "kueue.x-k8s.io/admission"
+
+PENDING = "Pending"
+RUNNING = "Running"
+SUCCEEDED = "Succeeded"
+FAILED = "Failed"
 
 
 @integration_manager.register
@@ -27,6 +53,55 @@ class PlainPod(BaseJob):
 
 
 @dataclass
+class Pod:
+    """An observed pod under kueue management (single or group member)."""
+
+    name: str
+    namespace: str = "default"
+    queue_name: str = ""
+    requests: dict[str, int] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    #: scheduling gates present on the pod; managed pods are created with
+    #: the admission gate (the webhook injects it, pod webhook parity)
+    scheduling_gates: list[str] = field(
+        default_factory=lambda: [ADMISSION_GATE])
+    phase: str = PENDING
+    priority: int = 0
+    creation_time: float = 0.0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def group_name(self) -> Optional[str]:
+        return self.labels.get(POD_GROUP_LABEL)
+
+    @property
+    def group_total(self) -> Optional[int]:
+        v = self.annotations.get(POD_GROUP_TOTAL_ANNOTATION)
+        return int(v) if v is not None else None
+
+    @property
+    def gated(self) -> bool:
+        return ADMISSION_GATE in self.scheduling_gates
+
+    def ungate(self) -> None:
+        if ADMISSION_GATE in self.scheduling_gates:
+            self.scheduling_gates.remove(ADMISSION_GATE)
+
+    @property
+    def terminal(self) -> bool:
+        return self.phase in (SUCCEEDED, FAILED)
+
+    def shape_key(self) -> tuple:
+        """Role identity: pods with equal requests share a podset
+        (the reference hashes the pod template)."""
+        return tuple(sorted(self.requests.items()))
+
+
+@dataclass
 class PodGroupRole:
     """Pods of one template shape within a group."""
 
@@ -38,13 +113,207 @@ class PodGroupRole:
 @integration_manager.register
 @dataclass
 class PodGroup(BaseJob):
-    """An assembled pod group (kueue.x-k8s.io/pod-group-name label +
-    pod-group-total-count annotation on the reference)."""
+    """An assembled pod group (composable workload).
+
+    Built by the PodGroupController from observed member pods; implements
+    the optional JobWithReclaimablePods interface via succeeded-pod
+    counts per role.
+    """
 
     kind = "PodGroup"
 
     roles: list[PodGroupRole] = field(default_factory=list)
+    total_count: int = 0
+    #: role name -> pods already succeeded (reclaimable)
+    succeeded_by_role: dict[str, int] = field(default_factory=dict)
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(name=r.name, count=r.count,
                        requests=dict(r.requests)) for r in self.roles]
+
+    def reclaimable_pods(self) -> dict[str, int]:
+        return dict(self.succeeded_by_role)
+
+
+class PodGroupController:
+    """Assembles observed pods into workloads and drives their lifecycle.
+
+    The reconcile pass mirrors pod_controller.go Reconcile: singles get a
+    one-pod workload; groups assemble once fully observed; admission
+    ungates members; failures admit replacements; successes reclaim
+    quota; total success finishes the group.
+    """
+
+    def __init__(self, store, scheduler, reconciler) -> None:
+        self.store = store
+        self.scheduler = scheduler
+        self.reconciler = reconciler
+        self.pods: dict[str, Pod] = {}
+        #: (namespace, group) -> PodGroup job driven through the reconciler
+        self._groups: dict[tuple[str, str], PodGroup] = {}
+        #: pods excluded as excess (observed beyond the declared total)
+        self.excess_pods: set[str] = set()
+
+    # -- pod lifecycle -----------------------------------------------------
+
+    def upsert_pod(self, pod: Pod) -> None:
+        self.pods[pod.key] = pod
+
+    def delete_pod(self, key: str, now: float = 0.0) -> None:
+        pod = self.pods.get(key)
+        if pod is None:
+            return
+        if pod.group_name is None:
+            del self.pods[key]
+            job = self.reconciler.jobs.get(("Pod", pod.key))
+            if job is not None:
+                self.reconciler.delete_job(job, now=now)
+            return
+        # A deleted group member permanently vacates its seat: treat it
+        # like a Failed pod so the group keeps its failure/replacement
+        # semantics instead of waiting for a pod that will never return
+        # (pod_controller.go handles deletion through the same
+        # replacement path).
+        if pod.phase not in (SUCCEEDED,):
+            pod.phase = FAILED
+
+    def mark_phase(self, key: str, phase: str) -> None:
+        self.pods[key].phase = phase
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self, now: float) -> None:
+        singles = [p for p in self.pods.values() if p.group_name is None]
+        for pod in singles:
+            self._reconcile_single(pod, now)
+
+        groups: dict[tuple[str, str], list[Pod]] = {}
+        for p in self.pods.values():
+            if p.group_name is not None:
+                groups.setdefault((p.namespace, p.group_name), []).append(p)
+        for (ns, name), members in groups.items():
+            self._reconcile_group(ns, name, members, now)
+        self.reconciler.reconcile_all(now)
+        # apply admission effects (ungating) after the workloads synced
+        for pod in singles:
+            job = self.reconciler.jobs.get(("Pod", pod.key))
+            if job is not None and not job.is_suspended():
+                pod.ungate()
+        for (ns, name), members in groups.items():
+            self._sync_group_gates(ns, name, members)
+
+    # -- singles -----------------------------------------------------------
+
+    def _reconcile_single(self, pod: Pod, now: float) -> None:
+        key = ("Pod", pod.key)
+        job = self.reconciler.jobs.get(key)
+        if job is None:
+            job = PlainPod(
+                name=pod.name, namespace=pod.namespace,
+                queue_name=pod.queue_name, requests=dict(pod.requests),
+                creation_time=pod.creation_time)
+            self.reconciler.upsert_job(job)
+        if pod.phase == SUCCEEDED:
+            job.mark_finished(success=True)
+        elif pod.phase == FAILED:
+            job.mark_finished(success=False, message="pod failed")
+        elif pod.phase == RUNNING:
+            job.mark_running(ready=True)
+
+    # -- groups ------------------------------------------------------------
+
+    def _group_members(self, members: list[Pod]) -> tuple[list[Pod], int]:
+        """Seated members (excess excluded, oldest first) + total count."""
+        total = 0
+        for p in members:
+            if p.group_total:
+                total = max(total, p.group_total)
+        members = sorted(members,
+                         key=lambda p: (p.creation_time, p.name))
+        # a failed pod keeps its seat only until a replacement arrives:
+        # seat live/succeeded pods first, failed ones fill what remains
+        alive = [p for p in members if p.phase != FAILED]
+        failed = [p for p in members if p.phase == FAILED]
+        seated = (alive + failed)[:total] if total else alive + failed
+        seated_keys = {p.key for p in seated}
+        for p in members:
+            if p.key in seated_keys:
+                self.excess_pods.discard(p.key)
+            else:
+                self.excess_pods.add(p.key)
+        return seated, total
+
+    def _roles(self, seated: list[Pod]) -> list[PodGroupRole]:
+        by_shape: dict[tuple, PodGroupRole] = {}
+        for p in seated:
+            k = p.shape_key()
+            if k not in by_shape:
+                by_shape[k] = PodGroupRole(
+                    name=f"role-{len(by_shape)}", count=0,
+                    requests=dict(p.requests))
+            by_shape[k].count += 1
+        return list(by_shape.values())
+
+    def _role_of(self, roles: list[PodGroupRole],
+                 pod: Pod) -> Optional[str]:
+        for r in roles:
+            if tuple(sorted(r.requests.items())) == pod.shape_key():
+                return r.name
+        return None
+
+    def _reconcile_group(self, ns: str, name: str, members: list[Pod],
+                         now: float) -> None:
+        seated, total = self._group_members(members)
+        if not total or len(seated) < total:
+            # group not fully observed yet (the reference requeues until
+            # assembly completes)
+            return
+        job = self._groups.get((ns, name))
+        if job is None:
+            oldest = min(p.creation_time for p in seated)
+            job = PodGroup(
+                name=name, namespace=ns,
+                queue_name=next(p.queue_name for p in seated),
+                roles=self._roles(seated), total_count=total,
+                creation_time=oldest)
+            self._groups[(ns, name)] = job
+            self.reconciler.upsert_job(job)
+
+        # reclaimable + finish accounting — attribution uses the FROZEN
+        # role set from assembly time (the admitted workload's podsets),
+        # never a re-derived seating order
+        succeeded: dict[str, int] = {}
+        n_succeeded = 0
+        for p in seated:
+            if p.phase == SUCCEEDED:
+                role = self._role_of(job.roles, p)
+                if role:
+                    succeeded[role] = succeeded.get(role, 0) + 1
+                n_succeeded += 1
+        job.succeeded_by_role = succeeded
+        if n_succeeded >= total:
+            job.mark_finished(success=True)
+        elif all(p.terminal for p in seated):
+            # every seat terminal without enough successes; the group
+            # failed unless a replacement pod is still on its way
+            pending_replacement = any(
+                not p.terminal for p in members
+                if p.key in self.excess_pods)
+            if not pending_replacement:
+                job.mark_finished(success=False,
+                                  message="pod group failed")
+        elif any(p.phase == RUNNING for p in seated):
+            job.mark_running(ready=all(
+                p.phase in (RUNNING, SUCCEEDED) for p in seated))
+
+    def _sync_group_gates(self, ns: str, name: str,
+                          members: list[Pod]) -> None:
+        """Ungate member pods of admitted groups — including replacement
+        pods that arrived after admission (pod_controller.go ungating +
+        replacement path)."""
+        job = self._groups.get((ns, name))
+        if job is None or job.is_suspended():
+            return
+        for p in members:
+            if p.key not in self.excess_pods and not p.terminal:
+                p.ungate()

@@ -259,6 +259,101 @@ cohort_weighted_share = registry.register(Gauge(
     "kueue_cohort_weighted_share",
     "DominantResourceShare of the cohort (x1000, weighted)", ("cohort",)))
 
+# -- LocalQueue family (metrics.go local_queue_* series; gate
+# LocalQueueMetrics) ----------------------------------------------------------
+
+local_queue_quota_reserved_workloads_total = registry.register(Counter(
+    "kueue_local_queue_quota_reserved_workloads_total",
+    "Total workloads with quota reserved per LocalQueue",
+    ("local_queue", "namespace")))
+local_queue_admitted_workloads_total = registry.register(Counter(
+    "kueue_local_queue_admitted_workloads_total",
+    "Total admitted workloads per LocalQueue", ("local_queue", "namespace")))
+local_queue_evicted_workloads_total = registry.register(Counter(
+    "kueue_local_queue_evicted_workloads_total",
+    "Total evicted workloads per LocalQueue by reason",
+    ("local_queue", "namespace", "reason")))
+local_queue_finished_workloads_total = registry.register(Counter(
+    "kueue_local_queue_finished_workloads_total",
+    "Total finished workloads per LocalQueue", ("local_queue", "namespace")))
+local_queue_reserving_active_workloads = registry.register(Gauge(
+    "kueue_local_queue_reserving_active_workloads",
+    "Workloads with reserved quota per LocalQueue",
+    ("local_queue", "namespace")))
+local_queue_admitted_active_workloads = registry.register(Gauge(
+    "kueue_local_queue_admitted_active_workloads",
+    "Admitted not-finished workloads per LocalQueue",
+    ("local_queue", "namespace")))
+local_queue_status = registry.register(Gauge(
+    "kueue_local_queue_status", "LocalQueue status by condition",
+    ("local_queue", "namespace", "status")))
+local_queue_resource_usage = registry.register(Gauge(
+    "kueue_local_queue_resource_usage",
+    "Current usage per LocalQueue/flavor/resource",
+    ("local_queue", "namespace", "flavor", "resource")))
+local_queue_resource_reservation = registry.register(Gauge(
+    "kueue_local_queue_resource_reservation",
+    "Currently reserved quantity per LocalQueue/flavor/resource",
+    ("local_queue", "namespace", "flavor", "resource")))
+local_queue_quota_reserved_wait_time_seconds = registry.register(Histogram(
+    "kueue_local_queue_quota_reserved_wait_time_seconds",
+    "Time from creation to quota reservation per LocalQueue",
+    ("local_queue", "namespace"), buckets=WAIT_BUCKETS))
+local_queue_admission_wait_time_seconds = registry.register(Histogram(
+    "kueue_local_queue_admission_wait_time_seconds",
+    "Time from creation to admission per LocalQueue",
+    ("local_queue", "namespace"), buckets=WAIT_BUCKETS))
+
+# -- cohort subtree family (metrics.go cohort_subtree_*) ----------------------
+
+cohort_subtree_quota = registry.register(Gauge(
+    "kueue_cohort_subtree_quota",
+    "Subtree quota per cohort/flavor/resource",
+    ("cohort", "flavor", "resource")))
+cohort_subtree_resource_reservations = registry.register(Gauge(
+    "kueue_cohort_subtree_resource_reservations",
+    "Reserved quantity in the cohort subtree per flavor/resource",
+    ("cohort", "flavor", "resource")))
+cohort_subtree_admitted_active_workloads = registry.register(Gauge(
+    "kueue_cohort_subtree_admitted_active_workloads",
+    "Admitted not-finished workloads in the cohort subtree", ("cohort",)))
+cohort_subtree_admitted_workloads_total = registry.register(Counter(
+    "kueue_cohort_subtree_admitted_workloads_total",
+    "Total workloads admitted in the cohort subtree", ("cohort",)))
+
+# -- eviction / readiness detail (metrics.go) ---------------------------------
+
+evicted_workloads_once_total = registry.register(Counter(
+    "kueue_evicted_workloads_once_total",
+    "Workloads evicted at least once, by reason (first eviction only)",
+    ("cluster_queue", "reason")))
+finished_workloads_gauge = registry.register(Gauge(
+    "kueue_finished_workloads",
+    "Finished workloads currently retained per CQ", ("cluster_queue",)))
+admitted_until_ready_wait_time_seconds = registry.register(Histogram(
+    "kueue_admitted_until_ready_wait_time_seconds",
+    "Time from admission until all pods ready", ("cluster_queue",),
+    buckets=WAIT_BUCKETS))
+ready_wait_time_seconds = registry.register(Histogram(
+    "kueue_ready_wait_time_seconds",
+    "Time from creation until all pods ready", ("cluster_queue",),
+    buckets=WAIT_BUCKETS))
+pods_ready_to_evicted_time_seconds = registry.register(Histogram(
+    "kueue_pods_ready_to_evicted_time_seconds",
+    "Time between pods becoming ready and the workload's eviction",
+    ("cluster_queue", "reason"), buckets=WAIT_BUCKETS))
+workload_creation_latency_seconds = registry.register(Histogram(
+    "kueue_workload_creation_latency_seconds",
+    "Time from job creation to its Workload object creation",
+    ("job_kind",), buckets=WAIT_BUCKETS))
+cluster_queue_resource_pending = registry.register(Gauge(
+    "kueue_cluster_queue_resource_pending",
+    "Pending requested quantity per CQ/resource",
+    ("cluster_queue", "resource")))
+build_info = registry.register(Gauge(
+    "kueue_build_info", "Build metadata", ("version",)))
+build_info.set("kueue-oss-tpu-r3", value=1)
+
 # -- solver-specific (new; no reference analog) ------------------------------
 
 solver_cycle_duration_seconds = registry.register(Histogram(
@@ -286,14 +381,30 @@ def report_pending_workloads(cq: str, active: int, inadmissible: int) -> None:
     pending_workloads.set(cq, "inadmissible", value=inadmissible)
 
 
-def admitted_workload(cq: str, wait_s: float) -> None:
+def _lq_metrics_enabled() -> bool:
+    from kueue_oss_tpu import features
+
+    return features.enabled("LocalQueueMetrics")
+
+
+def admitted_workload(cq: str, wait_s: float, lq: str = "",
+                      namespace: str = "default") -> None:
     admitted_workloads_total.inc(cq)
     admission_wait_time_seconds.observe(cq, value=max(wait_s, 0.0))
+    if lq and _lq_metrics_enabled():
+        local_queue_admitted_workloads_total.inc(lq, namespace)
+        local_queue_admission_wait_time_seconds.observe(
+            lq, namespace, value=max(wait_s, 0.0))
 
 
-def quota_reserved_workload(cq: str, wait_s: float) -> None:
+def quota_reserved_workload(cq: str, wait_s: float, lq: str = "",
+                            namespace: str = "default") -> None:
     quota_reserved_workloads_total.inc(cq)
     quota_reserved_wait_time_seconds.observe(cq, value=max(wait_s, 0.0))
+    if lq and _lq_metrics_enabled():
+        local_queue_quota_reserved_workloads_total.inc(lq, namespace)
+        local_queue_quota_reserved_wait_time_seconds.observe(
+            lq, namespace, value=max(wait_s, 0.0))
 
 
 def report_cluster_queue_quotas(cq: str, quotas) -> None:

@@ -45,6 +45,10 @@ class MultiKueueController:
     # -- main loop ----------------------------------------------------------
 
     def reconcile_all(self, now: float) -> None:
+        from kueue_oss_tpu import features
+
+        if not features.enabled("MultiKueue"):
+            return
         for c in self.clusters.values():
             if c.active:
                 c.mark_seen(now)

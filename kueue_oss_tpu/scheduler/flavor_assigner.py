@@ -497,6 +497,14 @@ class FlavorAssigner:
                 fq.name, ResourceFlavor(name=fq.name)).node_labels
         )
 
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.api.types import FlavorFungibility
+
+        # gate FlavorFungibility: when off, custom fungibility policies
+        # are ignored and the default (Borrow / TryNextFlavor) applies
+        fungibility = (self.cq.spec.flavor_fungibility
+                       if features.enabled("FlavorFungibility")
+                       else FlavorFungibility())
         best: dict[str, FlavorAssignmentRec] = {}
         best_mode = WORST_MODE
         num_flavors = len(rg.flavors)
@@ -553,7 +561,7 @@ class FlavorAssigner:
                 if why:
                     reasons.extend(why)
                 mode: GranularMode = (pmode, borrow)
-                if is_preferred(representative, mode, self.cq.spec.flavor_fungibility):
+                if is_preferred(representative, mode, fungibility):
                     representative = mode
                 if representative[0] == P_NOFIT:
                     break
@@ -564,12 +572,12 @@ class FlavorAssigner:
                 )
 
             if not should_try_next_flavor(
-                    representative, self.cq.spec.flavor_fungibility):
+                    representative, fungibility):
                 best = assignments
                 best_mode = representative
                 break
             if is_preferred(representative, best_mode,
-                            self.cq.spec.flavor_fungibility):
+                            fungibility):
                 best = assignments
                 best_mode = representative
 

@@ -41,6 +41,7 @@ from kueue_oss_tpu.scheduler.flavor_assigner import (
     PodSetReducer,
 )
 from kueue_oss_tpu.scheduler.preemption import Preemptor, Target
+from kueue_oss_tpu.util.events import NORMAL, WARNING, recorder as events
 
 # entry status (scheduler.go entryStatus)
 NOT_NOMINATED = ""
@@ -121,6 +122,10 @@ class Scheduler:
         self.preempted_total: dict[str, int] = {}
         self.evicted_total: dict[str, int] = {}
         self.admission_attempt_durations: list[float] = []
+        #: in-flight preemption tracking (pkg/util/expectations)
+        from kueue_oss_tpu.util.expectations import ExpectationsStore
+
+        self.preemption_expectations = ExpectationsStore()
 
     # ------------------------------------------------------------------
     # Cycle
@@ -186,6 +191,7 @@ class Scheduler:
         """Per-CQ usage/weighted-share gauges from the post-cycle snapshot,
         limited to CQs the cycle touched — the hot loop must not sweep all
         1k CQs (reference: cache usage reporting, metrics.go:733-830)."""
+        touched_cohorts: set = set()
         for name in touched:
             cq = snapshot.cluster_queues.get(name)
             if cq is None:
@@ -198,6 +204,57 @@ class Scheduler:
                 drs = cq.dominant_resource_share()
                 metrics.cluster_queue_weighted_share.set(
                     cq.name, value=drs.rounded_weighted_share())
+            # per-LocalQueue usage/active gauges (local_queue_* series;
+            # one pass over the CQ's workloads, gated like the rest of
+            # the LQ family)
+            if metrics._lq_metrics_enabled():
+                by_lq: dict[tuple[str, str], dict] = {}
+                active_by_lq: dict[tuple[str, str], int] = {}
+                admitted_by_lq: dict[tuple[str, str], int] = {}
+                for info in cq.workloads.values():
+                    lqk = (info.obj.queue_name, info.obj.namespace)
+                    active_by_lq[lqk] = active_by_lq.get(lqk, 0) + 1
+                    if info.obj.is_admitted:
+                        admitted_by_lq[lqk] = admitted_by_lq.get(lqk, 0) + 1
+                    agg = by_lq.setdefault(lqk, {})
+                    for fr, q in info.usage().items():
+                        agg[fr] = agg.get(fr, 0) + q
+                for (lq, ns), agg in by_lq.items():
+                    for (flavor, resource), q in agg.items():
+                        metrics.local_queue_resource_usage.set(
+                            lq, ns, flavor, resource, value=q)
+                        metrics.local_queue_resource_reservation.set(
+                            lq, ns, flavor, resource, value=q)
+                for (lq, ns), n in active_by_lq.items():
+                    metrics.local_queue_reserving_active_workloads.set(
+                        lq, ns, value=n)
+                    metrics.local_queue_admitted_active_workloads.set(
+                        lq, ns, value=admitted_by_lq.get((lq, ns), 0))
+            # pending requested quantity per resource
+            q = self.queues.queues.get(name)
+            if q is not None:
+                pend: dict[str, int] = {}
+                for info in q.snapshot_order():
+                    for psr in info.total_requests:
+                        for r, v in psr.requests.items():
+                            pend[r] = pend.get(r, 0) + v
+                for r, v in pend.items():
+                    metrics.cluster_queue_resource_pending.set(
+                        name, r, value=v)
+            if cq.has_parent():
+                touched_cohorts.update(cq.path_parent_to_root())
+        # cohort subtree gauges (metrics.go cohort_subtree_*)
+        for node in touched_cohorts:
+            for (flavor, resource), v in node.node.subtree_quota.items():
+                metrics.cohort_subtree_quota.set(
+                    node.name, flavor, resource, value=v)
+            for (flavor, resource), v in node.node.usage.items():
+                metrics.cohort_subtree_resource_reservations.set(
+                    node.name, flavor, resource, value=v)
+            n_admitted = sum(
+                len(c.workloads) for c in node.subtree_cluster_queues())
+            metrics.cohort_subtree_admitted_active_workloads.set(
+                node.name, value=n_admitted)
 
     def _solver_engine(self):
         if self.solver is None:
@@ -207,7 +264,8 @@ class Scheduler:
                 from kueue_oss_tpu.solver.engine import SolverEngine
 
                 self._solver_instance = SolverEngine(
-                    self.store, self.queues, scheduler=self)
+                    self.store, self.queues, scheduler=self,
+                    enable_fair_sharing=self.enable_fair_sharing)
             return self._solver_instance
         return self.solver
 
@@ -239,19 +297,23 @@ class Scheduler:
         return True
 
     def run_until_quiet(self, max_cycles: int = 10_000,
-                        now: Optional[float] = None) -> int:
+                        now: Optional[float] = None,
+                        tick: float = 0.0) -> int:
         """Run cycles until the pending state stops changing.
 
         With a solver backend configured, the backlog first drains through
         the TPU kernel (one batched invocation replacing many host
         cycles); host cycles then mop up anything the solver could not
-        model or verify.
+        model or verify. ``tick`` advances the injected clock per cycle
+        (a frozen clock collapses eviction/admission timestamps into
+        ties, which real deployments never see).
         """
         self._solver_drain(now)
         cycles = 0
         while cycles < max_cycles:
             pre = self._queue_fingerprint()
-            stats = self.schedule(now=now)
+            n = None if now is None else now + cycles * tick
+            stats = self.schedule(now=n)
             cycles += 1
             if stats.heads == 0:
                 break
@@ -449,6 +511,22 @@ class Scheduler:
                 "Workload has overlapping preemption targets with another workload")
             stats.skipped += 1
             return
+
+        # In-flight preemption guard (preemption.go:207-221 + the
+        # expectations store): while a previously issued plan's evictions
+        # are still unobserved, don't issue a second plan for the same
+        # preemptor, and don't target workloads another plan already
+        # expects to evict.
+        if mode == fa.PREEMPT and e.preemption_targets:
+            pending = self.preemption_expectations.pending_uids()
+            if not self.preemption_expectations.satisfied(e.info.key) or any(
+                    t.info.obj.uid in pending for t in e.preemption_targets):
+                e.status = SKIPPED
+                e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                e.inadmissible_msg = (
+                    "Workload is waiting for previously issued preemptions")
+                stats.skipped += 1
+                return
 
         usage = e.assignment_usage()
         if not self._fits(snapshot, cq, usage, preempted_workloads,
@@ -695,11 +773,27 @@ class Scheduler:
             wl.set_condition(WorkloadConditionType.ADMITTED, True,
                              reason="Admitted", now=now)
             metrics.admitted_workload(e.info.cluster_queue,
-                                      now - wl.creation_time)
+                                      now - wl.creation_time,
+                                      lq=wl.queue_name,
+                                      namespace=wl.namespace)
         self.store.update_workload(wl)
         e.status = ASSUMED
+        events.eventf(wl.key, "Workload", NORMAL, "QuotaReserved",
+                      f"Quota reserved in ClusterQueue {e.info.cluster_queue}",
+                      now=now)
+        if wl.is_admitted:
+            events.eventf(wl.key, "Workload", NORMAL, "Admitted",
+                          f"Admitted by ClusterQueue {e.info.cluster_queue}",
+                          now=now)
         metrics.quota_reserved_workload(e.info.cluster_queue,
-                                        now - wl.creation_time)
+                                        now - wl.creation_time,
+                                        lq=wl.queue_name,
+                                        namespace=wl.namespace)
+        # cohort subtree admission counters (metrics.go cohort_subtree_*)
+        if e.cq_snapshot is not None and e.cq_snapshot.has_parent():
+            for node in e.cq_snapshot.path_parent_to_root():
+                metrics.cohort_subtree_admitted_workloads_total.inc(
+                    node.name)
         self.admitted_total[e.info.cluster_queue] = (
             self.admitted_total.get(e.info.cluster_queue, 0) + 1)
         if (self.queues.afs is not None
@@ -715,6 +809,11 @@ class Scheduler:
                 f"{wl.namespace}/{wl.queue_name}", by_resource, now)
 
     def _issue_preemptions(self, e: Entry, now: float) -> None:
+        # Record expectations before issuing; each synchronous eviction is
+        # observed immediately (the reference observes them from the
+        # workload watch — expectations/store.go).
+        self.preemption_expectations.expect_uids(
+            e.info.key, [t.info.obj.uid for t in e.preemption_targets])
         for target in e.preemption_targets:
             self.evict_workload(
                 target.info.key,
@@ -779,7 +878,11 @@ class Scheduler:
         # the admission being released; a future re-admission starts a
         # fresh PodsReady window.
         wl.status.unhealthy_nodes = []
-        wl.status.conditions.pop(WorkloadConditionType.PODS_READY, None)
+        ready_cond = wl.status.conditions.pop(
+            WorkloadConditionType.PODS_READY, None)
+        pods_ready_at = (ready_cond.last_transition_time
+                         if ready_cond is not None and ready_cond.status
+                         else None)
         if requeue and backoff_base_s is not None:
             # Exponential requeue backoff: the workload becomes schedulable
             # again only at requeue_at (reference: RequeueState).
@@ -794,9 +897,23 @@ class Scheduler:
             wl.status.requeue_state = rs
             heapq.heappush(self._requeue_heap, (rs.requeue_at, key))
         self.store.update_workload(wl)
+        events.eventf(wl.key, "Workload",
+                      WARNING if preemption_reason else NORMAL,
+                      "Preempted" if preemption_reason else "Evicted",
+                      message, now=now)
+        # the eviction is now observable: clear pending expectations
+        self.preemption_expectations.observe(wl.uid)
         self.evicted_total[wl.key] = self.evicted_total.get(wl.key, 0) + 1
         if cq:
             metrics.evicted_workloads_total.inc(cq, reason)
+            if self.evicted_total[wl.key] == 1:
+                metrics.evicted_workloads_once_total.inc(cq, reason)
+            if metrics._lq_metrics_enabled():
+                metrics.local_queue_evicted_workloads_total.inc(
+                    wl.queue_name, wl.namespace, reason)
+            if pods_ready_at is not None:
+                metrics.pods_ready_to_evicted_time_seconds.observe(
+                    cq, reason, value=max(now - pods_ready_at, 0.0))
             self._cycle_touched_cqs.add(cq)
         if cq and preemption_reason:
             self.preempted_total[cq] = self.preempted_total.get(cq, 0) + 1
@@ -900,6 +1017,10 @@ class Scheduler:
         self.store.update_workload(wl)
         if cq:
             metrics.finished_workloads_total.inc(cq)
+            metrics.finished_workloads_gauge.inc(cq)
+            if metrics._lq_metrics_enabled():
+                metrics.local_queue_finished_workloads_total.inc(
+                    wl.queue_name, wl.namespace)
             self._cycle_touched_cqs.add(cq)
         self.queues.report_workload_finished(wl)
 

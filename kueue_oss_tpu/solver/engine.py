@@ -58,32 +58,42 @@ class SolverEngine:
     """Drains pending backlogs through the jitted TPU kernel."""
 
     def __init__(self, store: Store, queues: QueueManager,
-                 scheduler=None) -> None:
+                 scheduler=None, enable_fair_sharing: bool = False,
+                 remote=None) -> None:
         self.store = store
         self.queues = queues
         #: host scheduler whose eviction state machine applies the plan's
         #: preemptions (metrics/backoff parity); built lazily if absent
         self.scheduler = scheduler
+        #: fair-sharing mode (KEP-1714): DRS tournament entry ordering +
+        #: fair preemption strategies, on-device via
+        #: solver/fair_kernels.py. Mirrors Scheduler(enable_fair_sharing).
+        self.enable_fair_sharing = enable_fair_sharing
+        #: optional solver/service.SolverClient — the solve runs in a
+        #: separate sidecar process (SURVEY §2.4); export, verify, and
+        #: commit stay in this process
+        self.remote = remote
 
     def supported(self) -> bool:
         """Whether the drain can run on-device.
 
-        The full kernel covers classical preemption and multiple resource
-        groups; still host-only: admission fair sharing (LocalQueue-usage
-        queue ordering) and fair-sharing preemption (DRS tournament).
-        TAS shapes are rejected at export (UnsupportedProblem).
+        The full kernel covers classical preemption, multiple resource
+        groups, and fair sharing (DRS tournament + S2-a/S2-b). Still
+        host-only: admission fair sharing (LocalQueue-usage queue
+        ordering). TAS shapes are rejected at export
+        (UnsupportedProblem).
         """
         for cq in self.store.cluster_queues.values():
             if cq.admission_scope is not None:
                 return False
-            if (cq.fair_sharing is not None
-                    and cq.fair_sharing.weight != 1.0):
-                return False
         return True
 
     def needs_full_kernel(self) -> bool:
-        """Preemption or multi-RG shapes run the unified-axis kernel; the
-        lean fit-only kernel stays for the uncontended case."""
+        """Preemption, multi-RG, or fair-sharing shapes run the
+        unified-axis kernel; the lean fit-only kernel stays for the
+        uncontended classical case."""
+        if self.enable_fair_sharing:
+            return True
         for cq in self.store.cluster_queues.values():
             if cq.preemption.any_enabled:
                 return True
@@ -127,9 +137,13 @@ class SolverEngine:
         problem = pad_workloads(problem, _pow2(problem.n_workloads))
 
         t0 = time.monotonic()
-        tensors = to_device(problem)
-        admitted, opt, admit_round, parked, rounds, _usage = solve_backlog(
-            tensors)
+        if self.remote is not None:
+            (admitted, opt, admit_round, parked, rounds,
+             _usage) = self.remote.solve(problem, full=False)
+        else:
+            tensors = to_device(problem)
+            (admitted, opt, admit_round, parked, rounds,
+             _usage) = solve_backlog(tensors)
         admitted = np.asarray(admitted)
         opt = np.asarray(opt)
         admit_round = np.asarray(admit_round)
@@ -256,10 +270,17 @@ class SolverEngine:
         problem = pad_workloads(problem, _pow2(problem.n_workloads))
 
         t0 = time.monotonic()
-        tensors = to_device_full(problem)
-        (admitted, opt, admit_round, parked, rounds, _usage,
-         _wl_usage, victim_reason) = solve_backlog_full(
-            tensors, g_max, h_max, p_max)
+        if self.remote is not None:
+            (admitted, opt, admit_round, parked, rounds, _usage,
+             _wl_usage, victim_reason) = self.remote.solve(
+                problem, full=True, g_max=g_max, h_max=h_max,
+                p_max=p_max, fs_enabled=self.enable_fair_sharing)
+        else:
+            tensors = to_device_full(problem)
+            (admitted, opt, admit_round, parked, rounds, _usage,
+             _wl_usage, victim_reason) = solve_backlog_full(
+                tensors, g_max, h_max, p_max,
+                fs_enabled=self.enable_fair_sharing)
         admitted = np.asarray(admitted)
         opt = np.asarray(opt)
         admit_round = np.asarray(admit_round)
@@ -294,7 +315,12 @@ class SolverEngine:
         from kueue_oss_tpu.scheduler.preemption import (
             _VARIANT_REASON,
             IN_CLUSTER_QUEUE,
+            IN_COHORT_FAIR_SHARING,
         )
+        from kueue_oss_tpu.solver.fair_kernels import V_FAIR_SHARING
+
+        reason_of = dict(_VARIANT_REASON)
+        reason_of[V_FAIR_SHARING] = IN_COHORT_FAIR_SHARING
 
         W = problem.n_workloads
         wl_admitted0 = problem.wl_admitted0
@@ -312,8 +338,8 @@ class SolverEngine:
             wl = self.store.workloads.get(key)
             if wl is None or not wl.is_quota_reserved:
                 continue
-            reason = _VARIANT_REASON.get(int(victim_reason[w]),
-                                         IN_CLUSTER_QUEUE)
+            reason = reason_of.get(int(victim_reason[w]),
+                                   IN_CLUSTER_QUEUE)
             evictor.evict_workload(
                 key, reason="Preempted",
                 message="Preempted by the solver drain plan",
@@ -404,8 +430,12 @@ class SolverEngine:
                              reason="Admitted", now=now)
         self.store.update_workload(wl)
         self.queues.queues[cq_name].delete(key)
-        metrics.quota_reserved_workload(cq_name, now - wl.creation_time)
+        metrics.quota_reserved_workload(cq_name, now - wl.creation_time,
+                                        lq=wl.queue_name,
+                                        namespace=wl.namespace)
         if wl.is_admitted:
-            metrics.admitted_workload(cq_name, now - wl.creation_time)
+            metrics.admitted_workload(cq_name, now - wl.creation_time,
+                                      lq=wl.queue_name,
+                                      namespace=wl.namespace)
         result.admitted += 1
         result.admitted_keys.append(key)

@@ -1,0 +1,553 @@
+"""Fair-sharing device kernels: DRS, tournaments, fair preemption search.
+
+Mirrors the host fair-sharing stack exactly:
+- DRS math (core/quota.py dominant_resource_share; reference
+  pkg/cache/scheduler/fair_sharing.go:140-173): per node,
+  max over resources of (borrowed-above-subtree-quota * 1000 / lendable
+  capacity of the parent) / fair weight, with zero-weight borrowers
+  sorting above everything;
+- the target-CQ tournament (scheduler/preemption.py _CQOrdering;
+  reference fairsharing/ordering.go): descend from the root picking the
+  highest-share child, pruning non-borrowing branches;
+- the two preemption strategy rules S2-a LessThanOrEqualToFinalShare and
+  S2-b LessThanInitialShare (preemption.py _run_first/second_fs_strategy;
+  reference preemption.go:371-534) with almost-LCA share comparison
+  (fairsharing/least_common_ancestor.go);
+- the per-cohort entry tournament used for admission ordering
+  (scheduler.py _FairSharingIterator; reference
+  fair_sharing_iterator.go:44-130) lives in full_kernels.round_body via
+  fair_entry_shares/fair_pick below.
+
+DRS values are compared as (zero-weight-borrows, share) pairs in
+float32 — the host compares exact floats; parity holds because shares
+at test scales are well separated.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kueue_oss_tpu.solver.kernels import (
+    _add_usage_along_path,
+    refresh_cohort_usage,
+)
+from kueue_oss_tpu.solver.tensors import (
+    BIG,
+    POLICY_ANY,
+    POLICY_LOWER_OR_NEWER_EQUAL,
+    POLICY_LOWER_PRIORITY,
+    POLICY_NEVER,
+)
+
+#: synthetic candidate variant for fair-sharing victims (the classical
+#: V_* codes live in full_kernels; the engine maps this to
+#: IN_COHORT_FAIR_SHARING)
+V_FAIR_SHARING = 5
+
+
+def lendable_by_resource(t, pot):
+    """calculate_lendable for every node's PARENT: [N+1, R].
+
+    lendable[n, r] = sum over FR columns of resource r of
+    potentialAvailable(parent(n)) — usage-independent, computed once.
+    """
+    lend_nodes = pot @ t.res_onehot.astype(pot.dtype)     # [N+1, R]
+    out = lend_nodes[t.parent]                            # [N+1, R]
+    return jnp.where(t.has_parent[:, None], out, 0)
+
+
+def drs_all(t, usage, lendable_r):
+    """DRS of every node: (zwb [N+1] bool, share [N+1] f32,
+    borrowing [N+1] bool, unweighted [N+1] f32).
+
+    Reference: fair_sharing.go dominantResourceShare — borrowed =
+    max(0, usage - subtreeQuota) summed per resource; ratio =
+    borrowed * 1000 / lendable(parent); share = ratio / weight;
+    zero-weight borrowers take precedence over any weighted share.
+    Nodes without a parent have zero DRS.
+    """
+    borrowed = jnp.maximum(0, usage - t.subtree)          # [N+1, F]
+    borrowed_r = borrowed @ t.res_onehot                  # [N+1, R]
+    borrowing = jnp.any(borrowed_r > 0, axis=1) & t.has_parent
+    ratio = jnp.where(
+        (lendable_r > 0) & (borrowed_r > 0),
+        borrowed_r.astype(jnp.float32) * 1000.0
+        / lendable_r.astype(jnp.float32), 0.0)
+    unweighted = jnp.max(ratio, axis=1)
+    unweighted = jnp.where(t.has_parent, unweighted, 0.0)
+    w = t.node_fair_weight
+    share = jnp.where(w > 0, unweighted / jnp.maximum(w, 1e-30), 0.0)
+    zwb = (w == 0) & (unweighted > 0)
+    return zwb, share, borrowing, unweighted
+
+
+def drs_gt(a_zwb, a_share, a_unw, b_zwb, b_share, b_unw):
+    """compare_drs(a, b) > 0 (higher share = preferred for preemption)."""
+    both = a_zwb & b_zwb
+    return jnp.where(
+        both, a_unw > b_unw,
+        jnp.where(a_zwb, True,
+                  jnp.where(b_zwb, False, a_share > b_share)))
+
+
+def drs_ge(a_zwb, a_share, a_unw, b_zwb, b_share, b_unw):
+    both = a_zwb & b_zwb
+    return jnp.where(
+        both, a_unw >= b_unw,
+        jnp.where(a_zwb, True,
+                  jnp.where(b_zwb, False, a_share >= b_share)))
+
+
+def drs_le(a_zwb, a_share, a_unw, b_zwb, b_share, b_unw):
+    return ~drs_gt(a_zwb, a_share, a_unw, b_zwb, b_share, b_unw)
+
+
+def drs_lt(a_zwb, a_share, a_unw, b_zwb, b_share, b_unw):
+    return ~drs_ge(a_zwb, a_share, a_unw, b_zwb, b_share, b_unw)
+
+
+def _almost_lca_node(t, cq_node, lca_node):
+    """The node on cq_node's path just below lca_node (path position)."""
+    path = t.path[cq_node]                                # [D]
+    hit = path == lca_node
+    d_idx = jnp.arange(path.shape[0], dtype=jnp.int32)
+    lca_d = jnp.min(jnp.where(hit, d_idx, path.shape[0]))
+    return path[jnp.maximum(lca_d - 1, 0)]
+
+
+def _lca_of(t, my_path, other_cq_node):
+    """First node on my_path that is an ancestor of other_cq_node."""
+    null = t.parent.shape[0] - 1
+    other_path = t.path[other_cq_node]                    # [D]
+    D = my_path.shape[0]
+    is_anc = jnp.any(other_path[:, None] == my_path[None, :], axis=0)
+    is_anc = is_anc & (my_path != null)
+    d_idx = jnp.arange(D, dtype=jnp.int32)
+    lca_d = jnp.min(jnp.where(is_anc, d_idx, D))
+    return my_path[jnp.minimum(lca_d, D - 1)], lca_d
+
+
+def fair_search(t, lendable_r, usage0_round, wl_usage, admitted, evicted_f,
+                ts, admit_rank, head_w, req, avail_cq, p_max: int):
+    """Fair-sharing victim search for ONE preemptor (vmap over lanes).
+
+    Mirrors Preemptor._fair_preemptions: candidate collection
+    (_find_fs_candidates), the DRS tournament over target CQs, strategy
+    rules S2-a then S2-b, fill-back. Same return contract as
+    classical_search: (success, cand_w [P], victims [P], reason [P] int8,
+    any_same_cq, borrow_after).
+    """
+    from kueue_oss_tpu.solver.full_kernels import (
+        V_WITHIN_CQ,
+        _height_along_path,
+        _remove_usage_along_path,
+        _workload_fits,
+    )
+
+    W1 = t.wl_cqid.shape[0]
+    W_null = W1 - 1
+    C = t.cq_node.shape[0]
+    N1 = t.parent.shape[0]
+    null_node = N1 - 1
+    D = t.path.shape[1]
+    cqid = t.wl_cqid[head_w]
+    cqi = jnp.minimum(cqid, C - 1)
+    cq_node = t.cq_node[cqi]
+    my_path = t.path[cq_node]
+    pot_lendable = lendable_r
+
+    frs_mask = (req > 0) & (req > avail_cq)
+
+    # ---- candidate collection (_find_fs_candidates) ----------------------
+    cand_cqid = t.wl_cqid[:-1]
+    cand_node = t.cq_node[jnp.minimum(cand_cqid, C - 1)]
+    is_adm = admitted[:-1] & (jnp.arange(W1 - 1) != head_w)
+    uses = jnp.any(wl_usage[:-1] * frs_mask[None, :] > 0, axis=1)
+    same_cq = cand_cqid == cqid
+    prio_p = t.wl_prio[head_w]
+    ts_p = ts[head_w]
+    lower = prio_p > t.wl_prio[:-1]
+    newer_eq = (prio_p == t.wl_prio[:-1]) & (ts_p < ts[:-1])
+
+    def sat(policy):
+        return jnp.where(
+            policy == POLICY_NEVER, False,
+            jnp.where(policy == POLICY_LOWER_PRIORITY, lower,
+                      jnp.where(policy == POLICY_LOWER_OR_NEWER_EQUAL,
+                                lower | newer_eq, policy == POLICY_ANY)))
+
+    own_legal = same_cq & sat(t.cq_within_policy[cqi])
+    # other CQs: same cohort forest, candidate CQ borrowing on a needed fr
+    other_path = t.path[cand_node]
+    shares_tree = jnp.any(
+        (other_path[:, :, None] == my_path[None, None, :])
+        & (my_path[None, None, :] != null_node), axis=(1, 2))
+    cq_borrowing = jnp.any(
+        frs_mask[None, :]
+        & (usage0_round[cand_node] > t.subtree[cand_node]), axis=1)
+    has_par = t.has_parent[cq_node]
+    other_legal = (~same_cq & has_par & shares_tree & cq_borrowing
+                   & sat(t.cq_reclaim_policy[cqi]))
+    legal = is_adm & uses & (own_legal | other_legal)
+
+    # ---- global candidate ordering (candidates_ordering) ------------------
+    not_evicted = ~evicted_f[:-1]
+    order = jnp.lexsort((
+        t.wl_uid[:-1],
+        -admit_rank[:-1],
+        t.wl_prio[:-1],
+        same_cq,                 # other-CQ candidates first
+        not_evicted,             # evicted first
+        ~legal,
+    ))
+    sorted_legal = legal[order]
+    pos = jnp.cumsum(sorted_legal.astype(jnp.int32)) - 1
+    cand_w = jnp.full((p_max,), W_null, dtype=jnp.int32)
+    cand_w = cand_w.at[jnp.where(sorted_legal, pos, p_max)].set(
+        order.astype(jnp.int32), mode="drop")
+    cand_valid = cand_w != W_null
+    slot_cqid = jnp.where(cand_valid, t.wl_cqid[cand_w], C)
+
+    # ---- state -------------------------------------------------------------
+    # simulate the incoming usage on the preemptor's CQ for the whole
+    # strategy phase (preemption.py: cq.simulate_usage_addition(ctx.usage))
+    usage_sim = _add_usage_along_path(t, usage0_round, cq_node, req)
+
+    on_my_path = jnp.zeros((N1,), dtype=bool).at[my_path].set(
+        my_path != null_node)
+    root_node = my_path[jnp.maximum(
+        jnp.max(jnp.where(my_path != null_node,
+                          jnp.arange(D, dtype=jnp.int32), 0)), 0)]
+
+    def fits_fs(u):
+        """workloadFitsForFairSharing: fit check without the simulated
+        incoming usage."""
+        u2 = _remove_usage_along_path(t, u, cq_node, req)
+        return _workload_fits(t, u2, cq_node, req, True)
+
+    def head_slot(consumed, only_retry, retry):
+        """Per-CQ first unconsumed candidate slot: [C] slot index or p_max."""
+        p_idx = jnp.arange(p_max, dtype=jnp.int32)
+        ok = cand_valid & ~consumed & (~only_retry | retry)
+        eff = jnp.where(ok, p_idx, p_max)
+        return jax.ops.segment_min(
+            eff, jnp.minimum(slot_cqid, C), num_segments=C + 1)[:C]
+
+    def tournament(u, pruned_cq, pruned_cohort, heads):
+        """One descent (_CQOrdering._next_target): returns (target_cq int
+        [C or C=none], new pruned sets). Descends at most D levels."""
+        zwb, share, borrowing, unw = drs_all(t, u, pot_lendable)
+        cq_has_head = heads < p_max
+
+        # prune CQs: (not borrowing and not preemptor's CQ) or no head
+        cq_nodes = t.cq_node
+        prune_now = ((~borrowing[cq_nodes] & (jnp.arange(C) != cqi))
+                     | ~cq_has_head)
+        pruned_cq = pruned_cq | prune_now
+        # prune cohorts: not borrowing and not on preemptor's path
+        is_cohort = ~t.is_cq & (jnp.arange(N1) != null_node)
+        pruned_cohort = pruned_cohort | (
+            is_cohort & ~borrowing & ~on_my_path)
+
+        current = root_node
+        target = C  # none
+        done = jnp.zeros((), dtype=bool)
+        for _ in range(D):
+            # best CQ child of `current`
+            cq_parent = t.parent[cq_nodes]
+            elig_cq = (cq_parent == current) & ~pruned_cq & ~done
+            cq_key_zwb = zwb[cq_nodes]
+            cq_key_share = jnp.where(elig_cq, share[cq_nodes], -1.0)
+            cq_key_unw = jnp.where(elig_cq, unw[cq_nodes], -1.0)
+            # lexicographic argmax (zwb, share/unw, lower head slot)
+            best_cq = C
+            best_zwb = jnp.zeros((), dtype=bool)
+            best_share = jnp.asarray(-1.0, dtype=jnp.float32)
+            best_unw = jnp.asarray(-1.0, dtype=jnp.float32)
+            # two-pass: first find max key, then tie-break by head slot
+            any_elig = jnp.any(elig_cq)
+            m_zwb = jnp.any(cq_key_zwb & elig_cq)
+            m_share = jnp.max(jnp.where(
+                elig_cq & (cq_key_zwb == m_zwb), cq_key_share, -1.0))
+            m_unw = jnp.max(jnp.where(
+                elig_cq & (cq_key_zwb == m_zwb), cq_key_unw, -1.0))
+            is_top = elig_cq & (cq_key_zwb == m_zwb) & jnp.where(
+                m_zwb, cq_key_unw == m_unw, cq_key_share == m_share)
+            head_of = heads
+            best_cq = jnp.argmin(jnp.where(is_top, head_of, p_max + 1))
+            best_cq = jnp.where(any_elig, best_cq, C).astype(jnp.int32)
+            best_zwb = m_zwb
+            best_share = m_share
+            best_unw = m_unw
+
+            # best cohort child
+            node_idx = jnp.arange(N1)
+            elig_co = ((t.parent == current) & is_cohort
+                       & ~pruned_cohort & ~done)
+            co_share = jnp.where(elig_co, share, -1.0)
+            co_unw = jnp.where(elig_co, unw, -1.0)
+            any_co = jnp.any(elig_co)
+            c_zwb = jnp.any(zwb & elig_co)
+            c_share = jnp.max(jnp.where(
+                elig_co & (zwb == c_zwb), co_share, -1.0))
+            c_unw = jnp.max(jnp.where(elig_co & (zwb == c_zwb), co_unw,
+                                      -1.0))
+            is_topc = elig_co & (zwb == c_zwb) & jnp.where(
+                c_zwb, co_unw == c_unw, co_share == c_share)
+            # host iterates children in order and updates on >=: last wins
+            best_co = jnp.max(jnp.where(is_topc, node_idx, -1))
+
+            none_found = ~any_elig & ~any_co
+            # prune the current cohort when nothing remains below it
+            pruned_cohort = pruned_cohort.at[current].set(
+                pruned_cohort[current] | (none_found & ~done))
+            cq_wins = any_elig & (
+                ~any_co | drs_ge(best_zwb, best_share, best_unw,
+                                 c_zwb, c_share, c_unw))
+            target = jnp.where(~done & cq_wins, best_cq, target)
+            done = done | none_found | cq_wins
+            current = jnp.where(done, current,
+                                jnp.maximum(best_co, 0).astype(jnp.int32))
+        return (target.astype(jnp.int32), pruned_cq, pruned_cohort,
+                zwb, share, unw)
+
+    # preemptor_new / target_old almost-LCA shares
+    def alca_shares(u, tgt_cqid):
+        zwb, share, borrowing, unw = drs_all(t, u, pot_lendable)
+        tgt_node = t.cq_node[jnp.minimum(tgt_cqid, C - 1)]
+        lca, _ = _lca_of(t, my_path, tgt_node)
+        pre_n = _almost_lca_node(t, cq_node, lca)
+        tgt_n = _almost_lca_node(t, tgt_node, lca)
+        return (zwb[pre_n], share[pre_n], unw[pre_n],
+                zwb[tgt_n], share[tgt_n], unw[tgt_n], tgt_n)
+
+    # ---- strategy phases ---------------------------------------------------
+    def phase_loop(carry):
+        (u, consumed, retry, victims, vseq, nv, pruned_cq, pruned_cohort,
+         fitted, phase, it) = carry
+
+        heads = head_slot(consumed, phase == 2, retry)
+        target, pruned_cq, pruned_cohort, zwb, share, unw = tournament(
+            u, pruned_cq, pruned_cohort, heads)
+        # parentless preemptor: only its own CQ is a target
+        # (_CQOrdering.iter() root-less branch)
+        target = jnp.where(
+            has_par, target,
+            jnp.where(heads[cqi] < p_max, cqi, C)).astype(jnp.int32)
+        has_target = target < C
+        slot = heads[jnp.minimum(target, C - 1)]
+        slot_ok = has_target & (slot < p_max)
+        a = cand_w[jnp.minimum(slot, p_max - 1)]
+        a_node = t.cq_node[jnp.minimum(t.wl_cqid[a], C - 1)]
+        is_own = has_target & (target == cqi)
+
+        (p_zwb, p_share, p_unw, t_zwb, t_share, t_unw,
+         tgt_alca) = alca_shares(u, target)
+
+        # target_new = target almost-LCA share after removing the head
+        u_try = _remove_usage_along_path(
+            t, u, a_node, jnp.where(slot_ok, wl_usage[a], 0))
+        zwb2, share2, _b2, unw2 = drs_all(t, u_try, pot_lendable)
+        n_zwb, n_share, n_unw = (zwb2[tgt_alca], share2[tgt_alca],
+                                 unw2[tgt_alca])
+
+        # strategy rule: phase 1 = S2-a LessThanOrEqualToFinalShare
+        # (own-CQ pops skip the rule); phase 2 = S2-b LessThanInitialShare
+        s2a = drs_le(p_zwb, p_share, p_unw, n_zwb, n_share, n_unw)
+        s2b = drs_lt(p_zwb, p_share, p_unw, t_zwb, t_share, t_unw)
+        accept = slot_ok & jnp.where(phase == 1, is_own | s2a, s2b)
+
+        u = jnp.where(accept, u_try, u)
+        consumed = consumed.at[jnp.minimum(slot, p_max - 1)].set(
+            consumed[jnp.minimum(slot, p_max - 1)] | slot_ok)
+        # phase-1 rejections go to the retry list (S2-b pass)
+        retry = retry.at[jnp.minimum(slot, p_max - 1)].set(
+            retry[jnp.minimum(slot, p_max - 1)]
+            | (slot_ok & ~accept & (phase == 1) & ~is_own))
+        victims = victims.at[jnp.minimum(slot, p_max - 1)].set(
+            victims[jnp.minimum(slot, p_max - 1)] | accept)
+        vseq = vseq.at[jnp.minimum(slot, p_max - 1)].set(
+            jnp.where(accept, nv, vseq[jnp.minimum(slot, p_max - 1)]))
+        nv = nv + accept.astype(jnp.int32)
+        fitted = accept & fits_fs(u)
+
+        # S2-b: drop the queue after one attempt regardless of outcome
+        pruned_cq = pruned_cq.at[jnp.minimum(target, C - 1)].set(
+            pruned_cq[jnp.minimum(target, C - 1)]
+            | (has_target & (phase == 2)))
+
+        # phase transition: root pruned in phase 1 -> phase 2 with fresh
+        # pruning state over the retry list
+        root_dead = pruned_cohort[root_node] | ~has_target
+        to_phase2 = (phase == 1) & root_dead & ~fitted
+        pruned_cq = jnp.where(to_phase2, jnp.zeros_like(pruned_cq),
+                              pruned_cq)
+        pruned_cohort = jnp.where(
+            to_phase2, jnp.zeros_like(pruned_cohort), pruned_cohort)
+        # consumed slots stay consumed; retries become poppable again
+        consumed = jnp.where(to_phase2, consumed & ~retry, consumed)
+        phase = jnp.where(to_phase2, 2, phase)
+        return (u, consumed, retry, victims, vseq, nv, pruned_cq,
+                pruned_cohort, fitted, phase, it + 1)
+
+    def phase_cond(carry):
+        (u, consumed, retry, victims, vseq, nv, pruned_cq, pruned_cohort,
+         fitted, phase, it) = carry
+        root_dead = pruned_cohort[root_node]
+        return (~fitted & (it < 2 * p_max + N1)
+                & ~((phase == 2) & root_dead))
+
+    init = (usage_sim,
+            jnp.zeros((p_max,), dtype=bool),   # consumed
+            jnp.zeros((p_max,), dtype=bool),   # retry
+            jnp.zeros((p_max,), dtype=bool),   # victims
+            jnp.full((p_max,), -1, dtype=jnp.int32),  # vseq
+            jnp.zeros((), dtype=jnp.int32),    # nv
+            jnp.zeros((C,), dtype=bool),       # pruned_cq
+            jnp.zeros((N1,), dtype=bool),      # pruned_cohort
+            jnp.zeros((), dtype=bool),         # fitted
+            jnp.ones((), dtype=jnp.int32),     # phase
+            jnp.zeros((), dtype=jnp.int32))
+    (u_fin, consumed, retry, victims, vseq, nv, _pc, _pco, fitted,
+     _phase, _it) = jax.lax.while_loop(phase_cond, phase_loop, init)
+
+    # ---- fill back (incoming usage reverted; allowBorrowing=true) ---------
+    u_fb = _remove_usage_along_path(t, u_fin, cq_node, req)
+
+    def fb_cond(carry):
+        u, victims, s = carry
+        return fitted & (s >= 0)
+
+    def fb_body(carry):
+        u, victims, s = carry
+        # slot with addition sequence s (skip the last added = nv - 1)
+        match = victims & (vseq == s)
+        slot = jnp.argmax(match)
+        a = cand_w[slot]
+        a_node = t.cq_node[jnp.minimum(t.wl_cqid[a], C - 1)]
+        tryit = jnp.any(match)
+        u_row = jnp.where(tryit, wl_usage[a], 0)
+        u = _add_usage_along_path(t, u, a_node, u_row)
+        still = _workload_fits(t, u, cq_node, req, True)
+        u = _remove_usage_along_path(
+            t, u, a_node, jnp.where(tryit & ~still, u_row, 0))
+        victims = victims.at[slot].set(
+            victims[slot] & ~(tryit & still))
+        return (u, victims, s - 1)
+
+    u_fb, victims, _ = jax.lax.while_loop(
+        fb_cond, fb_body, (u_fb, victims, nv - 2))
+
+    victims = victims & fitted
+    success = fitted
+    level_f, _ = _height_along_path(
+        t, jnp.where(success, u_fb, usage0_round), cq_node, req)
+    borrow_after = jnp.max(jnp.where(frs_mask, level_f, 0))
+    victim_same = victims & (t.wl_cqid[cand_w] == cqid)
+    any_same_cq = jnp.any(victim_same)
+    reason = jnp.where(
+        victims,
+        jnp.where(victim_same, V_WITHIN_CQ, V_FAIR_SHARING),
+        0).astype(jnp.int8)
+    return success, cand_w, victims, reason, any_same_cq, borrow_after
+
+
+# ---------------------------------------------------------------------------
+# admission-order tournament (fair_sharing_iterator.go)
+# ---------------------------------------------------------------------------
+
+
+def fair_entry_pick(t, lendable_r, usage, cand_w, req_c, ts, active):
+    """Pick the next entry to process under fair sharing.
+
+    Mirrors _FairSharingIterator.pop(): take the first remaining entry's
+    root cohort, compute per-entry DRS values along its path with the
+    entry's usage hypothetically added (on the CURRENT mutated usage),
+    and run the per-cohort tournament bottom-up — at every cohort the
+    child with the lowest share wins, ties broken by higher priority,
+    then earlier timestamp. Returns the winning entry index (C if none).
+    """
+    C = cand_w.shape[0]
+    N1 = t.parent.shape[0]
+    null_node = N1 - 1
+    D = t.path.shape[1]
+    W_null = t.wl_cqid.shape[0] - 1
+
+    cq_nodes = t.cq_node                                  # [C]
+    paths = t.path[cq_nodes]                              # [C, D]
+
+    # per-entry DRS along its path with the entry usage added
+    # (_compute_drs: simulate_usage_addition then shares up the path)
+    v = req_c                                             # [C, F]
+    rows_new = []
+    for d in range(D):
+        node = paths[:, d]
+        ok = (node != null_node)[:, None]
+        la = jnp.maximum(0, t.local_quota[node] - usage[node])
+        rows_new.append(usage[node] + jnp.where(ok, v, 0))
+        v = jnp.maximum(0, v - la)
+    rows_new = jnp.stack(rows_new, axis=1)                # [C, D, F]
+    borrowed = jnp.maximum(0, rows_new - t.subtree[paths])
+    borrowed_r = jnp.einsum("cdf,fr->cdr", borrowed, t.res_onehot)
+    lend = lendable_r[paths]                              # [C, D, R]
+    ratio = jnp.where((lend > 0) & (borrowed_r > 0),
+                      borrowed_r.astype(jnp.float32) * 1000.0
+                      / lend.astype(jnp.float32), 0.0)
+    unw = jnp.max(ratio, axis=2)                          # [C, D]
+    unw = jnp.where(t.has_parent[paths], unw, 0.0)
+    w = t.node_fair_weight[paths]
+    share = jnp.where(w > 0, unw / jnp.maximum(w, 1e-30), 0.0)
+    zwb = (w == 0) & (unw > 0)
+
+    # bottom-up winner propagation over the cohort forest
+    prio = t.wl_prio[cand_w]
+    ets = ts[cand_w]
+    e_idx = jnp.arange(C, dtype=jnp.int32)
+    win = jnp.full((N1,), C, dtype=jnp.int32)
+    win = win.at[cq_nodes].set(jnp.where(active, e_idx, C), mode="drop")
+    depth_cq = t.depth[cq_nodes]                          # [C]
+
+    max_d = D - 1
+    n_idx = jnp.arange(N1, dtype=jnp.int32)
+    for d in range(max_d, 0, -1):
+        e = win                                           # [N1]
+        contend = (t.depth == d) & (e < C) & (n_idx != null_node)
+        ec = jnp.minimum(e, C - 1)
+        # position of this node on the entry's path
+        j = jnp.clip(depth_cq[ec] - d, 0, D - 1)
+        k_zwb = jnp.where(contend, zwb[ec, j], True)
+        k_val = jnp.where(contend,
+                          jnp.where(zwb[ec, j], unw[ec, j], share[ec, j]),
+                          jnp.inf)
+        k_prio = jnp.where(contend, -prio[ec], BIG)
+        k_ts = jnp.where(contend, ets[ec], BIG)
+        parent = jnp.where(contend, t.parent, null_node)
+        seg = jnp.minimum(parent, null_node)
+        # lexicographic segment-min: zwb asc (non-borrower first), value
+        # asc, -prio asc, ts asc, entry idx asc
+        m_z = jax.ops.segment_min(
+            k_zwb.astype(jnp.int32), seg, num_segments=N1)
+        c1 = contend & (k_zwb.astype(jnp.int32) == m_z[seg])
+        m_v = jax.ops.segment_min(
+            jnp.where(c1, k_val, jnp.inf), seg, num_segments=N1)
+        c2 = c1 & (k_val == m_v[seg])
+        m_p = jax.ops.segment_min(
+            jnp.where(c2, k_prio, BIG), seg, num_segments=N1)
+        c3 = c2 & (k_prio == m_p[seg])
+        m_t = jax.ops.segment_min(
+            jnp.where(c3, k_ts, BIG), seg, num_segments=N1)
+        c4 = c3 & (k_ts == m_t[seg])
+        m_e = jax.ops.segment_min(
+            jnp.where(c4, ec, C), seg, num_segments=N1)
+        win = jnp.where((t.depth == d - 1) & (m_e < C)
+                        & ~t.is_cq, m_e, win)
+
+    # the host pops from the FIRST remaining entry's root tree
+    first_e = jnp.min(jnp.where(active, e_idx, C))
+    first_root = t.cq_root[jnp.minimum(first_e, C - 1)]
+    # parentless CQ: the entry itself wins directly
+    winner = jnp.where(
+        t.has_parent[t.cq_node[jnp.minimum(first_e, C - 1)]],
+        win[first_root], first_e)
+    return jnp.where(first_e < C, winner, C).astype(jnp.int32)

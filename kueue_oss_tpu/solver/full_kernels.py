@@ -119,6 +119,11 @@ class FullTensors(NamedTuple):
     wl_evicted0: jnp.ndarray
     wl_admit_rank0: jnp.ndarray
     ad_usage: jnp.ndarray
+    fr_resource: jnp.ndarray     # [F] int32 resource id per FR column
+    res_onehot: jnp.ndarray      # [F, R] int32 one-hot of fr_resource
+    node_fair_weight: jnp.ndarray  # [N+1] float32
+    wl_class: jnp.ndarray        # [W+1] int32 scheduling-equivalence class
+    class_root: jnp.ndarray      # [n_classes+1] int32 cohort root node
     ts_evict_base: jnp.ndarray   # scalar int32
     admit_rank_base: jnp.ndarray  # scalar int32
 
@@ -178,6 +183,12 @@ def to_device_full(p: SolverProblem) -> FullTensors:
         wl_evicted0=jnp.asarray(p.wl_evicted0),
         wl_admit_rank0=jnp.asarray(p.wl_admit_rank),
         ad_usage=jnp.asarray(p.ad_usage),
+        fr_resource=jnp.asarray(p.fr_resource),
+        res_onehot=jnp.asarray(
+            np.eye(p.n_resources, dtype=np.int32)[p.fr_resource]),
+        node_fair_weight=jnp.asarray(p.node_fair_weight),
+        wl_class=jnp.asarray(p.wl_class),
+        class_root=jnp.asarray(p.class_root),
         ts_evict_base=jnp.asarray(p.ts_evict_base, dtype=jnp.int32),
         admit_rank_base=jnp.asarray(p.admit_rank_base, dtype=jnp.int32),
     )
@@ -267,7 +278,7 @@ def select_heads_full(t: FullTensors, admitted, parked, ts):
 
 
 def nominate_full(t: FullTensors, usage, avail, pot, cand_w, cursor,
-                  g_max: int):
+                  g_max: int, fs_enabled: bool = False):
     """Classify each CQ's head across (group, flavor) options.
 
     Per resource group the walk mirrors findFlavorForPodSets: start at the
@@ -295,8 +306,12 @@ def nominate_full(t: FullTensors, usage, avail, pot, cand_w, cursor,
     within_cap = (~nonzero) | (req <= pot_cq)
     # flavorassigner.go:1071-1108: preemption is considered when the value
     # is within nominal, a higher subtree could reclaim, or the CQ may
-    # preempt while borrowing (borrowWithinCohort enabled)
-    can_pwb = (~t.cq_bwc_forbidden)[:, None, None]
+    # preempt while borrowing (borrowWithinCohort enabled; under fair
+    # sharing also any reclaimWithinCohort policy —
+    # flavor_assigner._can_preempt_while_borrowing)
+    can_pwb = (~t.cq_bwc_forbidden
+               | (fs_enabled
+                  & (t.cq_reclaim_policy != POLICY_NEVER)))[:, None, None]
     preemptish_fr = (~nonzero) | (
         within_cap & ((req <= nominal_cq) | may_reclaim | can_pwb))
     opt_fit = valid & jnp.all(fit_fr, axis=-1)
@@ -518,9 +533,13 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
     lca_d = jnp.min(jnp.where(is_anc, d_idx, D), axis=1)  # [W]
     other_ok = (lca_d >= 1) & (lca_d < D)        # shares a cohort tree
 
-    # advantage chain along my path (hierarchical_preemption.go)
+    # advantage chain along my path (hierarchical_preemption.go);
+    # QuantitiesFitInQuota iterates the REQUESTED frs only — an unrelated
+    # over-subtree column must not kill the advantage
+    nz_req = req > 0
     adv_at = jnp.zeros((D,), dtype=bool)
-    adv = jnp.all(usage0_round[cq_node] + req <= t.subtree[cq_node])
+    adv = jnp.all(~nz_req
+                  | (usage0_round[cq_node] + req <= t.subtree[cq_node]))
     rem = jnp.maximum(
         0, req - jnp.maximum(0, t.local_quota[cq_node]
                              - usage0_round[cq_node]))
@@ -528,7 +547,8 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
         node = my_path[d]
         ok = node != null_node
         adv_at = adv_at.at[d].set(adv)
-        fits_d = jnp.all(usage0_round[node] + rem <= t.subtree[node]) & ok
+        fits_d = jnp.all(
+            ~nz_req | (usage0_round[node] + rem <= t.subtree[node])) & ok
         rem = jnp.maximum(
             0, rem - jnp.maximum(0, t.local_quota[node]
                                  - usage0_round[node]))
@@ -609,12 +629,37 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
     has_second = ~single
 
     # ---- the remove-until-fits scan (one attempt) -----------------------
+    C_n = t.cq_node.shape[0]
+
     def attempt(allow_borrow, run):
-        def step(carry, i):
-            usage_l, victims, fitted = carry
+        # Infeasibility precheck: remove EVERY candidate this attempt
+        # could ever pop (a superset of what the sequential walk removes).
+        # available() is monotone non-increasing in usage, so if the
+        # preemptor does not fit even then, no subset of removals can
+        # succeed — skip the sequential walk entirely. This is what makes
+        # contended large-scale rounds cheap: most searches fail, and
+        # they fail here in O(tree) instead of O(p_max) scan steps.
+        vb_all = ~(allow_borrow
+                   & (cand_variant == V_RECLAIM_WITHOUT_BORROWING))
+        removable = cand_valid & vb_all
+        v_nodes_all = t.cq_node[jnp.minimum(t.wl_cqid[cand_w], C_n - 1)]
+        rows0 = jnp.where(t.is_cq[:, None], usage0_round, 0)
+        rows_min = rows0.at[v_nodes_all].add(
+            -jnp.where(removable[:, None], wl_usage[cand_w], 0),
+            mode="drop")
+        usage_min = refresh_cohort_usage(t, rows_min)
+        could_fit = _workload_fits(t, usage_min, cq_node, req, allow_borrow)
+        run = run & could_fit
+
+        def cond(carry):
+            usage_l, victims, fitted, i = carry
+            return run & ~fitted & (i < p_max)
+
+        def body(carry):
+            usage_l, victims, fitted, i = carry
             a = cand_w[i]
             a_cqid = t.wl_cqid[a]
-            a_node = t.cq_node[jnp.minimum(a_cqid, t.cq_node.shape[0] - 1)]
+            a_node = t.cq_node[jnp.minimum(a_cqid, C_n - 1)]
             var = cand_variant[i]
             # pop-time validity (_valid, candidate_generator.go)
             vb = ~(allow_borrow & (var == V_RECLAIM_WITHOUT_BORROWING))
@@ -633,30 +678,32 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
                     | (usage_l[a_path] <= t.subtree[a_path]), axis=1))
             valid = cand_valid[i] & vb & (
                 is_same | (cq_over & path_ok))
-            do = valid & ~fitted & run
-            u_row = jnp.where(do, wl_usage[a], 0)
+            u_row = jnp.where(valid, wl_usage[a], 0)
             usage_l = _remove_usage_along_path(t, usage_l, a_node, u_row)
-            victims = victims.at[i].set(do)
-            fitted = fitted | (do & _workload_fits(
-                t, usage_l, cq_node, req, allow_borrow))
-            return (usage_l, victims, fitted), None
+            victims = victims.at[i].set(valid)
+            fitted = valid & _workload_fits(
+                t, usage_l, cq_node, req, allow_borrow)
+            return (usage_l, victims, fitted, i + 1)
 
         init = (usage0_round, jnp.zeros((p_max,), dtype=bool),
-                jnp.zeros((), dtype=bool))
-        (usage_l, victims, fitted), _ = jax.lax.scan(
-            step, init, jnp.arange(p_max))
+                jnp.zeros((), dtype=bool), jnp.zeros((), dtype=jnp.int32))
+        usage_l, victims, fitted, n_walked = jax.lax.while_loop(
+            cond, body, init)
 
         # fillBackWorkloads: re-add earlier victims (excluding the last
         # removed) newest-first while the preemptor still fits
-        last_idx = jnp.max(jnp.where(victims, jnp.arange(p_max), -1))
+        last_idx = jnp.max(jnp.where(
+            victims, jnp.arange(p_max, dtype=jnp.int32), -1))
 
-        def fb_step(carry, i):
-            usage_l, victims = carry
-            j = p_max - 1 - i
+        def fb_cond(carry):
+            usage_l, victims, j = carry
+            return fitted & (j >= 0)
+
+        def fb_body(carry):
+            usage_l, victims, j = carry
             a = cand_w[j]
-            a_node = t.cq_node[jnp.minimum(
-                t.wl_cqid[a], t.cq_node.shape[0] - 1)]
-            tryit = victims[j] & (j < last_idx) & fitted
+            a_node = t.cq_node[jnp.minimum(t.wl_cqid[a], C_n - 1)]
+            tryit = victims[j] & (j < last_idx)
             u_row = jnp.where(tryit, wl_usage[a], 0)
             usage_l = _add_usage_along_path(t, usage_l, a_node, u_row)
             still = _workload_fits(t, usage_l, cq_node, req, allow_borrow)
@@ -665,10 +712,10 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
             usage_l = _remove_usage_along_path(
                 t, usage_l, a_node, jnp.where(tryit & ~still, u_row, 0))
             victims = victims.at[j].set(victims[j] & ~(tryit & still))
-            return (usage_l, victims), None
+            return (usage_l, victims, j - 1)
 
-        (usage_l, victims), _ = jax.lax.scan(
-            fb_step, (usage_l, victims), jnp.arange(p_max))
+        usage_l, victims, _ = jax.lax.while_loop(
+            fb_cond, fb_body, (usage_l, victims, last_idx - 1))
         return fitted, victims, usage_l
 
     ok1, v1, u1 = attempt(first_borrow, jnp.ones((), dtype=bool))
@@ -704,8 +751,14 @@ def _quota_to_reserve(t, usage, cq_node, req, borrow):
 
 def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
                     borrow, lane_of_entry, lane_success, lane_cand_w,
-                    lane_victims, lane_reason, p_max: int):
+                    lane_victims, lane_reason, p_max: int,
+                    fs_enabled: bool = False, lendable_r=None):
     """Process the round's entries in order; returns updated state parts.
+
+    Entry order is the classical sort (borrow, -priority, timestamp) or,
+    under fair sharing, the dynamic per-pop DRS tournament
+    (fair_sharing_iterator.go — each pop re-evaluates shares on the
+    mutated usage).
 
     state: (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
             victims_all, victim_reason)
@@ -748,15 +801,26 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
         is_preempt = is_active & (m == M_PREEMPT) & has_targets & ~overlap
 
         # --- fits re-check under removal of own targets (the preempted
-        # set is already excluded from usage_net by earlier steps) --------
+        # set is already excluded from usage_net by earlier steps); the
+        # loop is bounded by the lane's last victim slot, not p_max ------
+        n_slots = jnp.max(jnp.where(
+            vm, jnp.arange(p_max, dtype=jnp.int32) + 1, 0))
+
         def remove_victims(u, flag):
-            def rv(u_c, i):
+            def rv_cond(carry):
+                _, i = carry
+                return flag & (i < n_slots)
+
+            def rv_body(carry):
+                u_c, i = carry
                 a = vw[i]
                 a_node = t.cq_node[jnp.minimum(t.wl_cqid[a], C - 1)]
-                row = jnp.where(flag & vm[i], wl_usage[a], 0)
-                return _remove_usage_along_path(t, u_c, a_node, row), None
+                row = jnp.where(vm[i], wl_usage[a], 0)
+                return (_remove_usage_along_path(t, u_c, a_node, row),
+                        i + 1)
 
-            u, _ = jax.lax.scan(rv, u, jnp.arange(p_max))
+            u, _ = jax.lax.while_loop(
+                rv_cond, rv_body, (u, jnp.zeros((), dtype=jnp.int32)))
             return u
 
         usage_probe = remove_victims(usage_net, is_preempt)
@@ -798,25 +862,63 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
             jnp.where(do_admit, req, wl_usage[w]))
         any_adm = any_adm | do_admit
         return (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
-                victims_all, victim_reason, any_adm, any_evict), do_admit
+                victims_all, victim_reason, any_adm, any_evict), (
+            do_admit, do_preempt)
 
-    slots = (cand_w[order], jnp.arange(C, dtype=jnp.int32)[order],
-             mode[order], req_c[order], borrow[order], lane_of_entry[order])
     init = (state["usage_full"], state["usage_net"], state["cq_rows"],
             state["admitted"], state["parked"], state["wl_usage"],
             state["victims_all"], state["victim_reason"],
             jnp.zeros((), dtype=bool), jnp.zeros((), dtype=bool))
-    (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
-     victims_all, victim_reason, any_adm, any_evict), admitted_slot = (
-        jax.lax.scan(step, init, slots))
-    # map per-slot admit flags back to entry order
-    adm_entry = jnp.zeros((C,), dtype=bool).at[order].set(admitted_slot)
+
+    if not fs_enabled:
+        slots = (cand_w[order], jnp.arange(C, dtype=jnp.int32)[order],
+                 mode[order], req_c[order], borrow[order],
+                 lane_of_entry[order])
+        (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
+         victims_all, victim_reason, any_adm, any_evict), (
+            admitted_slot, preempted_slot) = (
+            jax.lax.scan(step, init, slots))
+        # map per-slot flags back to entry order
+        adm_entry = jnp.zeros((C,), dtype=bool).at[order].set(admitted_slot)
+        pre_entry = jnp.zeros((C,), dtype=bool).at[order].set(preempted_slot)
+    else:
+        from kueue_oss_tpu.solver.fair_kernels import fair_entry_pick
+
+        def fs_cond(carry):
+            _inner, act, _adm, _pre, i = carry
+            return jnp.any(act) & (i < C)
+
+        def fs_body(carry):
+            inner, act, adm_e, pre_e, i = carry
+            usage_net_cur = inner[1]
+            e = fair_entry_pick(t, lendable_r, usage_net_cur, cand_w,
+                                req_c, state["ts"], act)
+            ec = jnp.minimum(e, C - 1)
+            slot = (cand_w[ec], ec, mode[ec], req_c[ec], borrow[ec],
+                    lane_of_entry[ec])
+            inner2, (da, dp) = step(inner, slot)
+            picked = e < C
+            inner = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(picked, b, a), inner, inner2)
+            adm_e = adm_e.at[ec].set(adm_e[ec] | (picked & da))
+            pre_e = pre_e.at[ec].set(pre_e[ec] | (picked & dp))
+            act = act.at[ec].set(act[ec] & ~picked)
+            return (inner, act, adm_e, pre_e, i + 1)
+
+        fs_init = (init, active,
+                   jnp.zeros((C,), dtype=bool), jnp.zeros((C,), dtype=bool),
+                   jnp.zeros((), dtype=jnp.int32))
+        (inner, _act, adm_entry, pre_entry, _i) = jax.lax.while_loop(
+            fs_cond, fs_body, fs_init)
+        (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
+         victims_all, victim_reason, any_adm, any_evict) = inner
+
     return {
         "usage_full": usage_full, "usage_net": usage_net,
         "cq_rows": cq_rows, "admitted": admitted, "parked": parked,
         "wl_usage": wl_usage, "victims_all": victims_all,
         "victim_reason": victim_reason,
-    }, adm_entry, any_adm, any_evict
+    }, adm_entry, pre_entry, any_adm, any_evict
 
 
 # ---------------------------------------------------------------------------
@@ -825,7 +927,7 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
 
 
 def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
-               p_max: int):
+               p_max: int, fs_enabled: bool = False, lendable_r=None):
     """One reference cycle (shared by the jitted loop and debug_drain)."""
     W1 = t.wl_cqid.shape[0]
     C = t.cq_node.shape[0]
@@ -838,6 +940,13 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
     ts = state["ts"]
     usage = state["usage"]          # round-start (victims charged)
     wl_usage = state["wl_usage"]
+    class_nofit = state["class_nofit"]
+    # scheduling-equivalence dedup (cluster_queue.go:371): anything whose
+    # class is known NoFit parks before head selection — this catches
+    # evicted workloads re-entering the pending set (the host's
+    # push(check_no_fit=True) path)
+    parked = parked | (~admitted & class_nofit[t.wl_class])
+    parked = parked.at[t.wl_cqid.shape[0] - 1].set(False)
     parked_before = parked
     cursor_before = state["cursor"]
 
@@ -845,7 +954,8 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
     avail = available_all(t, usage)
     (mode, k_chosen, req_c, borrow, next_cursor,
      opt_fit, opt_preempt, opt_level, group_active, opt_valid) = (
-        nominate_full(t, usage, avail, pot, cand_w, state["cursor"], g_max))
+        nominate_full(t, usage, avail, pot, cand_w, state["cursor"], g_max,
+                      fs_enabled))
 
     is_head = cand_w != W_null
     K = t.cq_opt_group.shape[1]
@@ -881,12 +991,22 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
         jnp.arange(h_max, dtype=jnp.int32), mode="drop")
 
     # ---- per-option victim-search simulation over [H, K] -------------
-    # One classical search per (lane, option): SimulatePreemption parity
-    # (the host runs _get_targets per flavor during assignment).
-    search = jax.vmap(
-        lambda hw, rq, av: classical_search(
-            t, usage, wl_usage, admitted, state["evicted"], ts,
-            state["admit_rank"], hw, rq, av, p_max))
+    # One search per (lane, option): SimulatePreemption parity (the host
+    # runs _get_targets per flavor during assignment; the Preemptor
+    # dispatches to the fair-sharing search when enabled).
+    if fs_enabled:
+        from kueue_oss_tpu.solver.fair_kernels import fair_search
+
+        search = jax.vmap(
+            lambda hw, rq, av: fair_search(
+                t, lendable_r, usage, wl_usage, admitted,
+                state["evicted"], ts, state["admit_rank"], hw, rq, av,
+                p_max))
+    else:
+        search = jax.vmap(
+            lambda hw, rq, av: classical_search(
+                t, usage, wl_usage, admitted, state["evicted"], ts,
+                state["admit_rank"], hw, rq, av, p_max))
     flat_w = jnp.repeat(lane_w, K)
     flat_req = t.wl_req[lane_w].reshape(h_max * K, -1)
     flat_avail = jnp.repeat(lane_avail, K, axis=0)
@@ -938,6 +1058,18 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
          _s, _b) = search(lane_w, l_req, lane_avail)
     lane_success = (lane_success & lane_valid & (l_mode == M_PREEMPT))
 
+    # compact victims to the front of each lane's slot axis: the entry
+    # scan's removal loops run `last victim slot + 1` iterations, and a
+    # victim sitting at slot 3000 of a long candidate list would turn
+    # them into thousands of sequential steps per entry
+    def _compact(vw_row, vm_row, re_row):
+        key = jnp.where(vm_row, jnp.arange(p_max, dtype=jnp.int32), p_max)
+        order = jnp.argsort(key)
+        return vw_row[order], vm_row[order], re_row[order]
+
+    lane_cand_w, lane_victims, lane_reason = jax.vmap(_compact)(
+        lane_cand_w, lane_victims, lane_reason)
+
     # park NoFit heads of BestEffortFIFO queues (post-walk modes)
     park_now = is_head & (mode == M_NOFIT) & ~t.cq_strict
     parked = parked.at[cand_w].set(parked[cand_w] | park_now)
@@ -950,10 +1082,10 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
         "victims_all": jnp.zeros((W1,), dtype=bool),
         "victim_reason": state["victim_reason"], "ts": ts,
     }
-    out, adm_entry, any_adm, any_evict = full_round_scan(
+    out, adm_entry, pre_entry, any_adm, any_evict = full_round_scan(
         t, scan_state, cand_w, mode, k_chosen, req_c, borrow,
         lane_of_entry, lane_success, lane_cand_w, lane_victims,
-        lane_reason, p_max)
+        lane_reason, p_max, fs_enabled=fs_enabled, lendable_r=lendable_r)
     admitted = out["admitted"]
     parked = out["parked"]
     wl_usage = out["wl_usage"]
@@ -963,9 +1095,13 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
     ts = jnp.where(victims, t.ts_evict_base + rounds, ts)
     evicted_f = state["evicted"] | victims
     admit_rank = jnp.where(victims, 0, state["admit_rank"])
-    # re-admissions: clear Evicted, stamp reservation rank
+    # re-admissions: clear Evicted, stamp reservation rank; the ordering
+    # timestamp reverts to creation (the host clears the Evicted
+    # condition, so queue_order_timestamp falls back to creation_time)
     newly = adm_entry & (cand_w != W_null)
     adm_w = jnp.where(newly, cand_w, W_null)
+    ts = ts.at[adm_w].set(
+        jnp.where(newly, t.wl_ts0[adm_w], ts[adm_w]), mode="drop")
     evicted_f = evicted_f.at[adm_w].set(
         jnp.where(newly, False, evicted_f[adm_w]), mode="drop")
     admit_rank = admit_rank.at[adm_w].set(
@@ -981,13 +1117,28 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
     admit_round = admit_round.at[adm_w].set(
         jnp.where(newly, rounds, admit_round[adm_w]), mode="drop")
 
-    # flavor cursors: heads still pending resume their walk
+    # flavor cursors: heads still pending resume their walk; an entry
+    # that ISSUED preemptions restarts from flavor 0 next round (the
+    # host clears last_assignment in _issue_preemptions,
+    # scheduler.go:447 area)
     keep = is_head & ~admitted[cand_w]
+    new_cur = jnp.where(pre_entry[:, None], 0, next_cursor)
     cursor = state["cursor"].at[cand_w].set(
-        jnp.where(keep[:, None], next_cursor,
+        jnp.where(keep[:, None], new_cur,
                   state["cursor"][cand_w]), mode="drop")
     # an evicted workload restarts its flavor walk
     cursor = jnp.where(victims[:, None], 0, cursor)
+
+    # ---- NoFit equivalence classes (handleInadmissibleHash): a head
+    # parked this round marks its class NoFit; every pending equivalent
+    # parks with it until the capacity-freed flush clears the class
+    newly_parked = parked & ~parked_before
+    class_nofit = class_nofit.at[
+        jnp.where(newly_parked, t.wl_class,
+                  class_nofit.shape[0] - 1)].max(newly_parked, mode="drop")
+    class_nofit = class_nofit.at[class_nofit.shape[0] - 1].set(False)
+    parked = parked | (~admitted & class_nofit[t.wl_class])
+    parked = parked.at[W_null].set(False)
 
     # ---- capacity-freed flush: unpark cohort roots with evictions
     freed_root = jnp.zeros((N1,), dtype=bool)
@@ -995,6 +1146,7 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
     freed_root = freed_root.at[victim_roots].max(victims[:-1])
     wl_root = t.cq_root[jnp.minimum(t.wl_cqid, C - 1)]
     parked = parked & ~freed_root[wl_root]
+    class_nofit = class_nofit & ~freed_root[t.class_root]
 
     # ---- durable usage for next round ---------------------------
     usage_next = refresh_cohort_usage(t, out["cq_rows"])
@@ -1007,7 +1159,7 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
         "admitted": admitted, "parked": parked, "ts": ts,
         "evicted": evicted_f, "admit_rank": admit_rank,
         "wl_usage": wl_usage, "cursor": cursor, "opt": opt,
-        "admit_round": admit_round,
+        "admit_round": admit_round, "class_nofit": class_nofit,
         "victim_reason": out["victim_reason"], "progress": progress,
         "rounds": rounds + 1,
     }
@@ -1035,13 +1187,19 @@ def _init_state(t: FullTensors, g_max: int):
         "opt": jnp.zeros((W1, g_max), dtype=jnp.int32),
         "admit_round": jnp.full((W1,), -1, dtype=jnp.int32),
         "victim_reason": jnp.zeros((W1,), dtype=jnp.int8),
+        "class_nofit": jnp.zeros((t.class_root.shape[0],), dtype=bool),
         "progress": jnp.ones((), dtype=bool),
         "rounds": jnp.zeros((), dtype=jnp.int32),
     }
 
 
-def make_full_solver(g_max: int, h_max: int, p_max: int):
-    """Build the jitted preemption-capable drain for static caps."""
+def make_full_solver(g_max: int, h_max: int, p_max: int,
+                     fs_enabled: bool = False, round_cap: int = 0):
+    """Build the jitted preemption-capable drain for static caps.
+
+    ``round_cap`` > 0 bounds the drain's rounds below the quiescence
+    bound (benchmarks use it to terminate preemption ping-pong shapes
+    the way the reference's wall-clock limits do)."""
 
     @jax.jit
     def solve(t: FullTensors):
@@ -1049,12 +1207,24 @@ def make_full_solver(g_max: int, h_max: int, p_max: int):
         C = t.cq_node.shape[0]
         W_null = W1 - 1
         pot = potential_available_all(t)
+        if fs_enabled:
+            from kueue_oss_tpu.solver.fair_kernels import (
+                lendable_by_resource,
+            )
+
+            lendable_r = lendable_by_resource(t, pot)
+        else:
+            lendable_r = None
+        bound = 2 * W1 + C + 5
+        if round_cap:
+            bound = min(bound, round_cap)
 
         def cond(state):
-            return state["progress"] & (state["rounds"] < 2 * W1 + C + 5)
+            return state["progress"] & (state["rounds"] < bound)
 
         def body(state):
-            new_state, _ = round_body(t, state, pot, g_max, h_max, p_max)
+            new_state, _ = round_body(t, state, pot, g_max, h_max, p_max,
+                                      fs_enabled, lendable_r)
             return new_state
 
         final = jax.lax.while_loop(cond, body, _init_state(t, g_max))
@@ -1068,16 +1238,23 @@ def make_full_solver(g_max: int, h_max: int, p_max: int):
 
 
 def debug_drain(problem: SolverProblem, g_max: int, h_max: int = 8,
-                p_max: int = 32, max_rounds: int = 64, verbose: bool = True):
+                p_max: int = 32, max_rounds: int = 64, verbose: bool = True,
+                fs_enabled: bool = False):
     """Python-loop drain printing per-round events (development aid)."""
     import numpy as np
 
     t = to_device_full(problem)
     pot = potential_available_all(t)
+    if fs_enabled:
+        from kueue_oss_tpu.solver.fair_kernels import lendable_by_resource
+
+        lendable_r = lendable_by_resource(t, pot)
+    else:
+        lendable_r = None
     state = _init_state(t, g_max)
     W_null = t.wl_cqid.shape[0] - 1
     step = jax.jit(lambda tt, st: round_body(tt, st, pot, g_max, h_max,
-                                             p_max))
+                                             p_max, fs_enabled, lendable_r))
 
     def name(w):
         w = int(w)
@@ -1107,11 +1284,11 @@ _solver_cache: dict = {}
 
 
 def solve_backlog_full(t: FullTensors, g_max: int, h_max: int = 32,
-                       p_max: int = 128):
-    """Cached-jit entry point; (g_max, h_max, p_max) are compile-time."""
-    key = (g_max, h_max, p_max)
+                       p_max: int = 128, fs_enabled: bool = False):
+    """Cached-jit entry point; (g_max, h_max, p_max, fs) are compile-time."""
+    key = (g_max, h_max, p_max, fs_enabled)
     fn = _solver_cache.get(key)
     if fn is None:
-        fn = make_full_solver(g_max, h_max, p_max)
+        fn = make_full_solver(g_max, h_max, p_max, fs_enabled)
         _solver_cache[key] = fn
     return fn(t)

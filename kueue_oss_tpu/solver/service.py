@@ -1,0 +1,163 @@
+"""Process-separated solver service (the gRPC-sidecar analog).
+
+SURVEY.md §2.4: the reference's control plane is one Go process; the
+TPU-native design adds a sidecar carrying the CQ×FlavorResource usage
+tensor + pending-workload request tensor to a separate JAX solver
+process, so the control plane never blocks on device compilation and
+the solver can sit on the TPU host while the scheduler runs elsewhere.
+
+Wire contract (BASELINE.json: tensor export ≙ Cache.Snapshot, plan
+import ≙ assume path):
+
+  request  = header JSON {caps, fs_enabled, full} + npz(SolverProblem arrays)
+  response = header JSON {rounds}             + npz(plan arrays)
+
+Transport is a length-prefixed unix-domain socket (protocol framing is
+what a gRPC stub would generate; no proto toolchain is assumed in the
+image). The client side plugs into SolverEngine via `remote=`: the
+engine still exports, verifies, and commits — only the solve itself
+crosses the process boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from kueue_oss_tpu.solver.tensors import SolverProblem
+
+#: SolverProblem fields shipped as arrays; the rest go in the header
+_ARRAY_FIELDS = [
+    f.name for f in dataclasses.fields(SolverProblem)
+    if f.name not in ("fr_list", "node_names", "cq_names", "wl_keys",
+                      "cq_option_flavors", "cq_resource_group", "scale",
+                      "n_resources", "ts_evict_base", "admit_rank_base")
+]
+_META_FIELDS = ["n_resources", "ts_evict_base", "admit_rank_base", "scale"]
+
+
+def _send(sock: socket.socket, header: dict, blob: bytes) -> None:
+    h = json.dumps(header).encode()
+    sock.sendall(struct.pack(">II", len(h), len(blob)))
+    sock.sendall(h)
+    sock.sendall(blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv(sock: socket.socket) -> tuple[dict, bytes]:
+    hlen, blen = struct.unpack(">II", _recv_exact(sock, 8))
+    header = json.loads(_recv_exact(sock, hlen))
+    return header, _recv_exact(sock, blen)
+
+
+def serialize_problem(p: SolverProblem) -> tuple[dict, bytes]:
+    arrays = {}
+    for name in _ARRAY_FIELDS:
+        v = getattr(p, name)
+        if v is not None:
+            arrays[name] = np.asarray(v)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    meta = {name: getattr(p, name) for name in _META_FIELDS}
+    return meta, buf.getvalue()
+
+
+def deserialize_problem(meta: dict, blob: bytes) -> SolverProblem:
+    data = np.load(io.BytesIO(blob))
+    kwargs = {name: (data[name] if name in data else None)
+              for name in _ARRAY_FIELDS}
+    kwargs.update(meta)
+    return SolverProblem(**kwargs)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        try:
+            header, blob = _recv(self.request)
+        except ConnectionError:
+            return
+        problem = deserialize_problem(header["meta"], blob)
+        if header["full"]:
+            from kueue_oss_tpu.solver.full_kernels import (
+                solve_backlog_full,
+                to_device_full,
+            )
+
+            out = solve_backlog_full(
+                to_device_full(problem), header["g_max"],
+                header["h_max"], header["p_max"],
+                fs_enabled=header["fs_enabled"])
+            names = ["admitted", "opt", "admit_round", "parked",
+                     "rounds", "usage", "wl_usage", "victim_reason"]
+        else:
+            from kueue_oss_tpu.solver.kernels import (
+                solve_backlog,
+                to_device,
+            )
+
+            out = solve_backlog(to_device(problem))
+            names = ["admitted", "opt", "admit_round", "parked",
+                     "rounds", "usage"]
+        buf = io.BytesIO()
+        np.savez(buf, **{n: np.asarray(v) for n, v in zip(names, out)})
+        _send(self.request, {"ok": True, "names": names}, buf.getvalue())
+
+
+class SolverServer(socketserver.ThreadingUnixStreamServer):
+    """The sidecar process body: `SolverServer(path).serve_forever()`."""
+
+    allow_reuse_address = True
+
+    def __init__(self, socket_path: str) -> None:
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+        super().__init__(socket_path, _Handler)
+        self.socket_path = socket_path
+
+    def serve_in_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+
+class SolverClient:
+    """Engine-side stub: SolverEngine(remote=SolverClient(path))."""
+
+    def __init__(self, socket_path: str, timeout_s: float = 600.0) -> None:
+        self.socket_path = socket_path
+        self.timeout_s = timeout_s
+
+    def solve(self, problem: SolverProblem, *, full: bool,
+              g_max: int = 1, h_max: int = 32, p_max: int = 128,
+              fs_enabled: bool = False):
+        meta, blob = serialize_problem(problem)
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout_s)
+        try:
+            sock.connect(self.socket_path)
+            _send(sock, {"meta": meta, "full": full, "g_max": g_max,
+                         "h_max": h_max, "p_max": p_max,
+                         "fs_enabled": fs_enabled}, blob)
+            header, body = _recv(sock)
+        finally:
+            sock.close()
+        data = np.load(io.BytesIO(body))
+        return tuple(data[n] for n in header["names"])

@@ -1,0 +1,260 @@
+"""TAS placement on device: dense per-level capacity tensors.
+
+The topology tree (block -> rack -> host) becomes one dense array per
+level: `parents[l][d]` indexes level l-1; leaf capacities arrive as a
+[D_leaf, R] resource matrix. Placement for one podset runs entirely in
+jitted JAX:
+
+  phase 1 (fillInCounts, tas_flavor_snapshot.go:1568):
+    leaf state = floor-min over resources of capacity / per-pod request;
+    upper levels = one segment_sum per level.
+
+  phase 2 (findLevelWithFitDomains + updateCountsToMinimumGeneric,
+  :1236-1469), BestFit profile: at the requested level pick the
+  smallest single domain that fits the whole count (ties -> first in
+  lexicographic order); preferred requests fall back upward level by
+  level, then place greedily (state desc) at the top level taking full
+  domains until the remainder fits a single domain, which is then
+  chosen best-fit — a sort + prefix-sum + two segment reductions.
+  The descent applies the same rule per sibling group at every level.
+
+Scope: single podset, BestFit profile, no slices/leaders (the host tree
+handles those shapes). Parity-tested against tas/snapshot.py in
+tests/test_tas_kernel.py.
+
+Reference parity: pkg/cache/scheduler/tas_flavor_snapshot.go (two-phase
+algorithm); SURVEY.md §7 step 6 calls this the most TPU-friendly
+subproblem.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BIG = np.int32(1 << 30)
+
+
+@dataclass
+class TASLevels:
+    """Dense tree: level l has D_l domains ordered lexicographically by
+    their level values; parents[l] maps into level l-1 (parents[0]=0)."""
+
+    parents: list[np.ndarray]          # per level: [D_l] int32
+    leaf_capacity: np.ndarray          # [D_leaf, R] int32
+    leaf_names: list[tuple[str, ...]]  # decode table
+    resources: list[str]
+
+
+def build_levels(snapshot) -> TASLevels:
+    """Flatten a host TASFlavorSnapshot's domain tree (lex order per
+    level, matching buildAssignment's sort)."""
+    levels = []
+    for l in range(len(snapshot.levels)):
+        doms = sorted(snapshot.domains_per_level[l].values(),
+                      key=lambda d: d.level_values)
+        levels.append(doms)
+    index = [{d.id: i for i, d in enumerate(doms)} for doms in levels]
+    parents = []
+    for l, doms in enumerate(levels):
+        if l == 0:
+            parents.append(np.zeros(len(doms), dtype=np.int32))
+        else:
+            parents.append(np.asarray(
+                [index[l - 1][d.id[:-1]] for d in doms], dtype=np.int32))
+    resources = sorted({r for d in levels[-1] for r in d.free_capacity})
+    cap = np.zeros((len(levels[-1]), max(1, len(resources))),
+                   dtype=np.int64)
+    for i, d in enumerate(levels[-1]):
+        for j, r in enumerate(resources):
+            cap[i, j] = max(0, d.free_capacity.get(r, 0)
+                            - d.tas_usage.get(r, 0))
+    return TASLevels(
+        parents=parents,
+        leaf_capacity=np.minimum(cap, BIG).astype(np.int32),
+        leaf_names=[d.id for d in levels[-1]],
+        resources=resources,
+    )
+
+
+def fill_counts(parents, leaf_capacity, per_pod):
+    """Phase 1: per-level fit counts, leaves up (segment sums)."""
+    nz = per_pod > 0
+    per_dom = jnp.where(nz[None, :],
+                        leaf_capacity // jnp.maximum(per_pod, 1)[None, :],
+                        BIG)
+    state = jnp.min(per_dom, axis=1)               # [D_leaf]
+    states = [state]
+    for l in range(len(parents) - 1, 0, -1):
+        n_up = parents[l - 1].shape[0]
+        state = jax.ops.segment_sum(state, parents[l], num_segments=n_up)
+        states.append(state)
+    states.reverse()                                # states[l] = [D_l]
+    return states
+
+
+def _greedy_segment(state, seg, need_of_seg, n_seg):
+    """Minimize-domains assignment within each segment (sibling group).
+
+    `state` [D], `seg` [D] segment id, `need_of_seg` [S] pods each
+    segment must place (0 = inactive). Take full domains in (state desc,
+    index asc) order until the remainder fits one domain, then give the
+    remainder to the smallest sufficient domain at or after the
+    crossing (updateCountsToMinimumGeneric + findBestFitDomainBy).
+    Returns assignment [D].
+    """
+    D = state.shape[0]
+    idx = jnp.arange(D, dtype=jnp.int32)
+    order = jnp.lexsort((idx, -state, seg))
+    s_sorted = state[order]
+    seg_sorted = seg[order]
+    need = need_of_seg[seg_sorted]                 # [D]
+
+    csum = jnp.cumsum(s_sorted)
+    # exclusive prefix within segment: subtract the csum at segment start
+    is_start = jnp.concatenate([jnp.ones(1, dtype=bool),
+                                seg_sorted[1:] != seg_sorted[:-1]])
+    base = jnp.where(is_start, csum - s_sorted, 0)
+    base = jax.lax.associative_scan(jnp.maximum, jnp.where(
+        is_start, base, -1))
+    prefix_excl = csum - s_sorted - base
+    remaining = jnp.maximum(need - prefix_excl, 0)  # pods left before me
+
+    # crossing: first position (per segment) whose state covers the
+    # remaining count -> the best-fit switch point
+    covers = (s_sorted >= remaining) & (remaining > 0)
+    pos_cover = jnp.where(covers, idx, BIG)
+    q = jax.ops.segment_min(pos_cover, seg_sorted, num_segments=n_seg)
+    q_of = q[seg_sorted]
+    full_take = jnp.where((idx < q_of) & (remaining > 0), s_sorted, 0)
+    rem_at_q = jnp.where(idx == q_of, remaining, 0)
+    rem_of_seg = jax.ops.segment_max(rem_at_q, seg_sorted,
+                                     num_segments=n_seg)
+    r = rem_of_seg[seg_sorted]
+    # best-fit among positions >= q with state >= r: smallest such
+    # state, ties -> first position
+    elig = (idx >= q_of) & (s_sorted >= r) & (r > 0)
+    s_min = jax.ops.segment_min(jnp.where(elig, s_sorted, BIG),
+                                seg_sorted, num_segments=n_seg)
+    is_best = elig & (s_sorted == s_min[seg_sorted])
+    first_best = jax.ops.segment_min(jnp.where(is_best, idx, BIG),
+                                     seg_sorted, num_segments=n_seg)
+    bf_take = jnp.where(idx == first_best[seg_sorted], r, 0)
+    take_sorted = full_take + bf_take
+    return jnp.zeros_like(state).at[order].set(take_sorted)
+
+
+def make_placer(parents_np: list[np.ndarray]):
+    """Build a jitted placement fn for one tree shape."""
+    parents = [jnp.asarray(p) for p in parents_np]
+    n_levels = len(parents)
+
+    @jax.jit
+    def place(leaf_capacity, per_pod, count, requested_level,
+              required, unconstrained):
+        states = fill_counts(parents, leaf_capacity, per_pod)
+
+        def single_best(l):
+            s = states[l]
+            fits = s >= count
+            key = jnp.where(fits, s, BIG)
+            return jnp.any(fits), jnp.argmin(key).astype(jnp.int32)
+
+        # ---- choose the start level + single-fit domain ---------------
+        # preference: requested level first, then upward (preferred
+        # requests only) — scan levels deepest-first
+        chosen_level = jnp.asarray(-1, dtype=jnp.int32)
+        chosen_dom = jnp.asarray(0, dtype=jnp.int32)
+        for l in range(n_levels - 1, -1, -1):
+            ok, d = single_best(l)
+            allowed = jnp.where(
+                required | unconstrained, l == requested_level,
+                l <= requested_level)
+            hit = ok & allowed & (chosen_level < 0) & (
+                l <= requested_level)
+            chosen_level = jnp.where(hit, l, chosen_level)
+            chosen_dom = jnp.where(hit & (chosen_level == l), d,
+                                   chosen_dom)
+        single_fit = chosen_level >= 0
+
+        # ---- seed the start level ------------------------------------
+        sel = [jnp.zeros_like(s) for s in states]
+        feasible = jnp.zeros((), dtype=bool)
+        # greedy fallback level: top (0) for preferred, requested for
+        # unconstrained; required never falls back
+        greedy_level = jnp.where(unconstrained, requested_level, 0)
+        for l in range(n_levels):
+            is_single = single_fit & (chosen_level == l)
+            one_hot = (jnp.arange(states[l].shape[0],
+                                  dtype=jnp.int32) == chosen_dom)
+            seed_single = jnp.where(one_hot, count, 0)
+            seg = jnp.zeros_like(states[l])        # one global segment
+            g = _greedy_segment(
+                states[l], seg,
+                jnp.full((1,), count, dtype=states[l].dtype), 1)
+            g_ok = jnp.sum(states[l]) >= count
+            use_greedy = (~single_fit) & (greedy_level == l) & ~required
+            sel[l] = jnp.where(is_single, seed_single,
+                               jnp.where(use_greedy & g_ok, g, sel[l]))
+            feasible = feasible | is_single | (use_greedy & g_ok)
+        start = jnp.where(single_fit, chosen_level, greedy_level)
+
+        # ---- descend ---------------------------------------------------
+        for l in range(n_levels - 1):
+            par = parents[l + 1]
+            n_par = states[l].shape[0]
+            computed = _greedy_segment(states[l + 1], par, sel[l], n_par)
+            # best-fit single-child shortcut per sibling group
+            need = sel[l][par]
+            fits_whole = (states[l + 1] >= need) & (need > 0)
+            key = jnp.where(fits_whole, states[l + 1], BIG)
+            m = jax.ops.segment_min(key, par, num_segments=n_par)
+            has_single = (m < BIG)[par] & (need > 0)
+            cidx = jnp.arange(par.shape[0], dtype=jnp.int32)
+            is_best = fits_whole & (states[l + 1] == m[par])
+            first_best = jax.ops.segment_min(
+                jnp.where(is_best, cidx, BIG), par, num_segments=n_par)
+            single_take = jnp.where(
+                (cidx == first_best[par]) & has_single, need, 0)
+            next_sel = jnp.where(has_single, single_take, computed)
+            # levels at or above the start keep their seeded values
+            sel[l + 1] = jnp.where(jnp.asarray(l + 1) <= start,
+                                   sel[l + 1], next_sel)
+
+        leaf_sel = sel[n_levels - 1]
+        feasible = feasible & (jnp.sum(leaf_sel) == count)
+        return leaf_sel, feasible
+
+    return place
+
+
+_placer_cache: dict = {}
+
+
+def place_podset(snapshot, per_pod: dict, count: int,
+                 requested_level_idx: int, required: bool = False,
+                 unconstrained: bool = False):
+    """Host wrapper: flatten the tree, run the kernel, decode leaves.
+    Returns {leaf domain id: count} or None when infeasible."""
+    levels = build_levels(snapshot)
+    key = tuple(tuple(p.tolist()) for p in levels.parents)
+    placer = _placer_cache.get(key)
+    if placer is None:
+        placer = make_placer(levels.parents)
+        _placer_cache[key] = placer
+    req = np.zeros(max(1, len(levels.resources)), dtype=np.int32)
+    for j, r in enumerate(levels.resources):
+        req[j] = per_pod.get(r, 0)
+    leaf_sel, feasible = placer(
+        jnp.asarray(levels.leaf_capacity), jnp.asarray(req),
+        jnp.asarray(count, dtype=jnp.int32),
+        jnp.asarray(requested_level_idx, dtype=jnp.int32),
+        jnp.asarray(required), jnp.asarray(unconstrained))
+    if not bool(feasible):
+        return None
+    leaf_sel = np.asarray(leaf_sel)
+    return {levels.leaf_names[i]: int(leaf_sel[i])
+            for i in range(len(levels.leaf_names)) if leaf_sel[i] > 0}

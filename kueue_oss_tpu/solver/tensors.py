@@ -128,6 +128,13 @@ class SolverProblem:
     cq_opt_group: Optional[np.ndarray] = None       # [C, K] int32 (-1 none)
     cq_ngroups: Optional[np.ndarray] = None         # [C] int32
     fr_resource: Optional[np.ndarray] = None        # [F] int32 resource id
+    node_fair_weight: Optional[np.ndarray] = None   # [N+1] float32
+    #: scheduling-equivalence class per workload (BestEffortFIFO NoFit
+    #: dedup, cluster_queue.go:371/handleInadmissibleHash); n_classes =
+    #: sentinel for StrictFIFO / dedup-disabled workloads
+    wl_class: Optional[np.ndarray] = None           # [W+1] int32
+    class_root: Optional[np.ndarray] = None         # [n_classes+1] int32
+    n_classes: int = 0
     n_resources: int = 1
     #: timestamp rank assigned to round-r evictions: ts_evict_base + r
     ts_evict_base: int = 0
@@ -197,6 +204,7 @@ def pad_workloads(problem: SolverProblem, target_w: int) -> SolverProblem:
         wl_evicted0=pad1(problem.wl_evicted0, False),
         wl_admit_rank=pad1(problem.wl_admit_rank, 0),
         ad_usage=pad1(problem.ad_usage, 0),
+        wl_class=pad1(problem.wl_class, problem.n_classes),
         wl_keys=list(problem.wl_keys) + [""] * pad,
     )
 
@@ -424,6 +432,33 @@ def export_problem(
     wl_admit_rank = np.zeros(W + 1, dtype=np.int32)
     ad_usage = np.zeros((W + 1, F), dtype=np.int64)
 
+    # Scheduling-equivalence classes (per CQ; StrictFIFO and gate-off
+    # workloads get the sentinel class and never dedup-park).
+    from kueue_oss_tpu import features
+
+    dedup_on = features.enabled("SchedulingEquivalenceHashing")
+    class_index: dict[tuple, int] = {}
+    class_root_l: list[int] = []
+    wl_class = np.zeros(W + 1, dtype=np.int32)
+    for w, info in enumerate(all_infos):
+        cid = cq_id[info.cluster_queue]
+        if not dedup_on or cq_strict[cid]:
+            wl_class[w] = -1
+            continue
+        key = (cid, info.scheduling_hash())
+        idx = class_index.get(key)
+        if idx is None:
+            idx = len(class_index)
+            class_index[key] = idx
+            class_root_l.append(int(cq_root[cid]))
+        wl_class[w] = idx
+    n_classes = len(class_index)
+    wl_class[wl_class < 0] = n_classes
+    wl_class[W] = n_classes
+    class_root = np.concatenate(
+        [np.asarray(class_root_l, dtype=np.int32),
+         [n_nodes]]).astype(np.int32)
+
     # Timestamps are exported as dense ranks: only relative order matters
     # for entry sorting, and float32 would collapse epoch-scale values
     # less than ~128s apart (ties must stay ties for the uid tiebreak).
@@ -512,6 +547,9 @@ def export_problem(
     res_index = {r: i for i, r in enumerate(resources)}
     fr_resource = np.asarray([res_index[fr[1]] for fr in fr_list]
                              or [0], dtype=np.int32)
+    node_fair_weight = np.ones(n_nodes + 1, dtype=np.float32)
+    for i, n in enumerate(nodes):
+        node_fair_weight[i] = n.fair_weight
 
     return SolverProblem(
         parent=parent,
@@ -554,6 +592,10 @@ def export_problem(
         cq_opt_group=cq_opt_group,
         cq_ngroups=cq_ngroups,
         fr_resource=fr_resource,
+        node_fair_weight=node_fair_weight,
+        wl_class=wl_class,
+        class_root=class_root,
+        n_classes=n_classes,
         n_resources=len(resources),
         ts_evict_base=len(ts_rank) + 1,
         admit_rank_base=len(admit_rank) + 2,

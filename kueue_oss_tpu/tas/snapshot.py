@@ -14,11 +14,16 @@ TAS usage; placement runs in two phases:
      (updateCountsToMinimumGeneric, :1405-1469), finally emitting the
      lowest-level assignment (buildAssignment, :1490-1501).
 
-Supported: required/preferred/unconstrained levels, single-layer slice
-grouping (podset_slice_required_topology + size), leader/worker podset
-groups, BestFit and LeastFreeCapacity profiles, unhealthy-node
-replacement (findReplacementAssignment, :614-656). Multi-layer slice
-constraints and balanced placement are not yet implemented.
+Supported: required/preferred/unconstrained levels, slice grouping
+(podset_slice_required_topology + size) including MULTI-LAYER nested
+slice constraints (gate TASMultiLayerTopology; buildSliceSizeAtLevel,
+tas_flavor_snapshot.go:1001-1060 + the per-level slice sizing in the
+descent :938-971), BALANCED placement (gate TASBalancedPlacement;
+tas_balanced_placement.go — greedy evaluation, balance threshold,
+DP optimal-domain-set selection, threshold pruning, even distribution
+with leader-first extras), leader/worker podset groups, BestFit and
+LeastFreeCapacity profiles, unhealthy-node replacement
+(findReplacementAssignment, :614-656).
 """
 
 from __future__ import annotations
@@ -689,35 +694,78 @@ class TASFlavorSnapshot:
                     f"pod count {tr.count} not divisible by slice size "
                     f"{slice_size}")
 
+        slice_size_at_level, reason = self._build_slice_size_at_level(
+            tr_req, slice_size, slice_level_idx)
+        if reason:
+            return {}, reason
+
         leader_count = 1 if leader is not None else 0
         stats = self._fill_in_counts(
             tr, leader, assumed, simulate_empty, slice_size, slice_level_idx,
             required_replacement_domain, excluded_node=excluded_node)
 
         least_free = unconstrained and self.profile_mixed
-        fit_level, fit_domains, reason = self._find_level_with_fit(
-            level_idx, tr.count, leader_count, slice_size, required,
-            unconstrained, least_free, stats)
-        if reason:
-            return {}, reason
 
-        fit_domains = self._consume_minimum(
-            fit_domains, tr.count, leader_count, slice_size, least_free,
-            slices=True)
+        # balanced placement (gate TASBalancedPlacement; preferred-level
+        # requests only — tas_flavor_snapshot.go:906-917)
+        from kueue_oss_tpu import features
+
+        use_balanced = False
+        fit_domains = None
+        fit_level = level_idx
+        if (features.enabled("TASBalancedPlacement") and not required
+                and not unconstrained):
+            cand, threshold = self._find_best_balanced(
+                level_idx, slice_level_idx, tr.count, leader_count,
+                slice_size)
+            if threshold > 0:
+                fit_domains, fit_level, reason = self._apply_balanced(
+                    cand, level_idx, slice_level_idx, tr.count,
+                    leader_count, slice_size, threshold)
+                if reason:
+                    return {}, reason
+                use_balanced = True
+
+        if not use_balanced:
+            fit_level, fit_domains, reason = self._find_level_with_fit(
+                level_idx, tr.count, leader_count, slice_size, required,
+                unconstrained, least_free, stats)
+            if reason:
+                return {}, reason
+            fit_domains = self._consume_minimum(
+                fit_domains, tr.count, leader_count, slice_size, least_free,
+                slices=True)
         cur_level = fit_level
-        while cur_level < min(len(self.levels) - 1, slice_level_idx):
+        while (cur_level < min(len(self.levels) - 1, slice_level_idx)
+               and not use_balanced):
             lower = [c for d in fit_domains for c in d.children]
             fit_domains = self._consume_minimum(
                 self._sorted(lower, least_free), tr.count, leader_count,
                 slice_size, least_free, slices=True)
             cur_level += 1
         while cur_level < len(self.levels) - 1:
+            # below (or, after balanced placement, possibly still above)
+            # the outermost slice level: per-parent assignment, with inner
+            # slice layers re-grouping children at their own size
+            # (tas_flavor_snapshot.go:938-971)
+            if cur_level < slice_level_idx:
+                size_on_level = slice_size
+            else:
+                size_on_level = slice_size_at_level.get(cur_level + 1, 1)
             new_fit: list[Domain] = []
             for dom in fit_domains:
+                if size_on_level > 1:
+                    # the pre-filled sliceState was computed for the
+                    # outermost slice level; inner layers re-derive it
+                    # BEFORE sorting (the sort keys on slice_state)
+                    for d in dom.children:
+                        d.slice_state = d.state // size_on_level
+                        d.slice_state_with_leader = (
+                            d.state_with_leader // size_on_level)
                 children = self._sorted(dom.children, least_free)
                 new_fit.extend(self._consume_minimum(
-                    children, dom.state, dom.leader_state, 1, least_free,
-                    slices=False))
+                    children, dom.state, dom.leader_state, size_on_level,
+                    least_free, slices=size_on_level > 1))
             fit_domains = new_fit
             cur_level += 1
 
@@ -736,6 +784,322 @@ class TASFlavorSnapshot:
             fit_domains = worker_domains
         assignments[tr.podset.name] = self._build(fit_domains)
         return assignments, ""
+
+    # ------------------------------------------------------------------
+    # multi-layer slice constraints (buildSliceSizeAtLevel,
+    # tas_flavor_snapshot.go:1001-1060)
+    # ------------------------------------------------------------------
+
+    def _build_slice_size_at_level(self, tr_req, slice_size: int,
+                                   slice_level_idx: int):
+        """Level index -> inner slice size for nested slice layers.
+
+        The first constraint mirrors the outer slice (skipped); each
+        inner layer must sit strictly below its parent layer and evenly
+        divide its size; intermediate levels inherit the layer's size so
+        they distribute in multiples of it."""
+        from kueue_oss_tpu import features
+
+        out: dict[int, int] = {}
+        if (not features.enabled("TASMultiLayerTopology") or tr_req is None
+                or not tr_req.podset_slice_constraints):
+            return out, ""
+        layers = tr_req.podset_slice_constraints
+        inner = layers[1:] if len(layers) > 1 else []
+        prev_size = slice_size
+        prev_idx = slice_level_idx
+        for layer in inner:
+            idx = self.level_index(layer.topology)
+            if idx is None:
+                return None, ("no requested topology level for additional "
+                              f"slice layer: {layer.topology}")
+            if idx <= prev_idx:
+                return None, (
+                    f"additional slice layer topology {layer.topology} must "
+                    f"be at a lower level than {self.levels[prev_idx]}")
+            if prev_size % layer.size != 0:
+                return None, (
+                    f"additional slice layer size {layer.size} must evenly "
+                    f"divide parent layer size {prev_size}")
+            for lvl in range(prev_idx + 1, idx + 1):
+                out[lvl] = layer.size
+            prev_size = layer.size
+            prev_idx = idx
+        return out, ""
+
+    # ------------------------------------------------------------------
+    # balanced placement (tas_balanced_placement.go)
+    # ------------------------------------------------------------------
+
+    def _clone_domain(self, d: Domain) -> Domain:
+        c = Domain(d.id, d.level_values)
+        c.state = d.state
+        c.state_with_leader = d.state_with_leader
+        c.slice_state = d.slice_state
+        c.slice_state_with_leader = d.slice_state_with_leader
+        c.leader_state = d.leader_state
+        c.children = [self._clone_domain(ch) for ch in d.children]
+        return c
+
+    @staticmethod
+    def _clear_state(d: Domain) -> None:
+        d.state = d.slice_state = 0
+        d.state_with_leader = d.slice_state_with_leader = 0
+        d.leader_state = 0
+        for c in d.children:
+            TASFlavorSnapshot._clear_state(c)
+
+    @staticmethod
+    def _clear_leader_capacity(d: Domain) -> None:
+        d.state_with_leader = d.slice_state_with_leader = 0
+        d.leader_state = 0
+        for c in d.children:
+            TASFlavorSnapshot._clear_leader_capacity(c)
+
+    def _evaluate_greedy(self, domains: list[Domain], slice_count: int,
+                         leader_count: int):
+        """evaluateGreedyAssignment: (fits, #domains used, last domain
+        with leader, last domain without)."""
+        selected = 0
+        last = last_with_leader = None
+        rem_slices = slice_count
+        rem_leaders = leader_count
+        idx = 0
+        if leader_count > 0:
+            sorted_wl = self._sorted_with_leader(domains, False)
+            while (rem_leaders > 0 and idx < len(sorted_wl)
+                   and sorted_wl[idx].leader_state > 0):
+                selected += 1
+                last_with_leader = sorted_wl[idx]
+                rem_leaders -= sorted_wl[idx].leader_state
+                rem_slices -= sorted_wl[idx].slice_state_with_leader
+                idx += 1
+            rest = self._sorted(sorted_wl[idx:], False)
+        else:
+            rest = self._sorted(domains, False)
+        if rem_leaders > 0:
+            return False, 0, None, None
+        i = 0
+        while rem_slices > 0 and i < len(rest) and rest[i].slice_state > 0:
+            selected += 1
+            last = rest[i]
+            rem_slices -= rest[i].slice_state
+            i += 1
+        if rem_slices > 0:
+            return False, 0, None, None
+        return True, selected, last_with_leader, last
+
+    @staticmethod
+    def _balance_threshold(slice_count: int, selected: int,
+                           last_with_leader, last) -> int:
+        """Max possible minimum slices per domain in a balanced plan."""
+        threshold = slice_count // selected
+        if last_with_leader is not None:
+            threshold = min(threshold,
+                            last_with_leader.slice_state_with_leader)
+        if last is not None:
+            threshold = min(threshold, last.slice_state)
+        return threshold
+
+    def _prune_below_threshold(self, domains: list[Domain], threshold: int,
+                               slice_size: int, slice_level_idx: int,
+                               level: int, leader_required: bool) -> None:
+        """pruneDomainsBelowThreshold: drop capacity of subtrees that
+        cannot hold `threshold` slices, then re-roll counts."""
+        def prune_node(d: Domain) -> None:
+            if d.slice_state < threshold:
+                self._clear_state(d)
+                return
+            if (leader_required and d.leader_state > 0
+                    and d.slice_state_with_leader < threshold):
+                self._clear_leader_capacity(d)
+
+        for d in domains:
+            for c in d.children:
+                prune_node(c)
+        for d in domains:
+            self._roll_up(d, slice_size, slice_level_idx, level,
+                          leader_required)
+            prune_node(d)
+
+    @staticmethod
+    def _entropy(sizes: list[int]) -> float:
+        import math
+
+        total = sum(sizes)
+        if total <= 0:
+            return 0.0
+        e = 0.0
+        for s in sizes:
+            if s > 0:
+                p = s / total
+                e += -p * math.log2(p)
+        return e
+
+    def _select_optimal_set(self, domains: list[Domain], slice_count: int,
+                            leader_count: int, slice_size: int,
+                            by_entropy: bool) -> Optional[list[Domain]]:
+        """selectOptimalDomainSetToFit: DP over domains finding a set of
+        exactly the greedy-minimal cardinality that fits leaders+slices,
+        preferring the tightest total capacity."""
+        fits, optimal_n, _, _ = self._evaluate_greedy(
+            domains, slice_count, leader_count)
+        if not fits:
+            return None
+        if by_entropy:
+            domains = sorted(domains, key=lambda d: (
+                -d.leader_state, -d.slice_state_with_leader,
+                -self._entropy([c.state for c in d.children])))
+        # placements[i][(leaders_left, capacity_left)] -> domain list
+        placements: list[dict[tuple[int, int], list[Domain]]] = [
+            {} for _ in range(optimal_n + 1)]
+        placements[0][(leader_count, slice_count * slice_size)] = []
+        for d in domains:
+            for i in range(optimal_n, 0, -1):
+                for (lead, cap) in sorted(placements[i - 1]):
+                    if lead <= 0 and cap <= 0:
+                        continue
+                    before = placements[i - 1][(lead, cap)]
+                    nxt = before + [d]
+                    if lead > 0 and d.leader_state > 0:
+                        k = (lead - d.leader_state,
+                             cap - d.state_with_leader)
+                        placements[i].setdefault(k, nxt)
+                    if d.slice_state > 0:
+                        k = (lead, cap - d.state)
+                        placements[i].setdefault(k, nxt)
+        best_cap = None
+        best = None
+        for (lead, cap), doms in placements[optimal_n].items():
+            if lead == 0 and cap <= 0 and (best_cap is None
+                                           or cap > best_cap):
+                best_cap = cap
+                best = doms
+        return best
+
+    def _place_slices_balanced(self, domains: list[Domain],
+                               slice_count: int, leader_count: int,
+                               slice_size: int, threshold: int):
+        """placeSlicesOnDomainsBalanced: give every selected domain
+        `threshold` slices, distributing the remainder (and leaders)
+        front-first."""
+        result = self._select_optimal_set(domains, slice_count,
+                                          leader_count, slice_size, False)
+        if result is None:
+            return None, ("TAS Balanced Placement: Cannot find optimal "
+                          "domain set to fit the request")
+        if slice_count < len(result) * threshold:
+            return None, ("TAS Balanced Placement: Not enough slices to "
+                          "meet the threshold")
+        result = self._sorted_with_leader(result, False)
+        extra_left = slice_count - len(result) * threshold
+        leaders_left = leader_count
+        for dom in result:
+            if leaders_left > 0:
+                take = min(dom.slice_state_with_leader - threshold,
+                           extra_left)
+                dom.leader_state = 1
+                leaders_left -= 1
+            elif extra_left > 0:
+                take = min(dom.slice_state - threshold, extra_left)
+                dom.leader_state = 0
+            else:
+                dom.leader_state = 0
+                take = 0
+            dom.state = (threshold + take) * slice_size
+            dom.slice_state = threshold + take
+            dom.slice_state_with_leader = dom.slice_state
+            dom.state_with_leader = dom.state - dom.leader_state
+            extra_left -= take
+        if extra_left > 0 or leaders_left > 0:
+            return None, ("TAS Balanced Placement: Not all slices or "
+                          "leaders could be placed")
+        return result, ""
+
+    def _find_best_balanced(self, level_idx: int, slice_level_idx: int,
+                            count: int, leader_count: int,
+                            slice_size: int):
+        """findBestDomainsForBalancedPlacement: per sibling group at the
+        requested level, compute the balance threshold, prune, and keep
+        the best (highest threshold, then fewest domains)."""
+        slice_count = count // slice_size
+
+        def lower(doms):
+            if level_idx < slice_level_idx:
+                return [c for d in doms for c in d.children]
+            return doms
+
+        if level_idx == 0:
+            groups = [list(self.domains_per_level[0].values())]
+        else:
+            groups = [list(d.children)
+                      for d in self.domains_per_level[level_idx - 1].values()]
+
+        best_threshold = 0
+        best_count = 0
+        best: Optional[list[Domain]] = None
+        for siblings in groups:
+            cand = [self._clone_domain(d) for d in siblings]
+            fits, selected, lwl, last = self._evaluate_greedy(
+                lower(cand), slice_count, leader_count)
+            if not fits:
+                continue
+            threshold = self._balance_threshold(slice_count, selected,
+                                                lwl, last)
+            thr_leader = threshold
+            if leader_count > 0 and last is not None:
+                thr_leader = min(threshold, last.slice_state_with_leader)
+            if threshold < best_threshold:
+                continue
+            self._prune_below_threshold(
+                cand, threshold, slice_size, slice_level_idx, level_idx,
+                leader_count > 0)
+            ok, n_doms, _, _ = self._evaluate_greedy(
+                cand, slice_count, leader_count)
+            if not ok and thr_leader < threshold:
+                # retry at the lower threshold that reserves leader room
+                if thr_leader <= 0 or thr_leader < best_threshold:
+                    continue
+                threshold = thr_leader
+                cand = [self._clone_domain(d) for d in siblings]
+                self._prune_below_threshold(
+                    cand, threshold, slice_size, slice_level_idx,
+                    level_idx, leader_count > 0)
+                ok, n_doms, _, _ = self._evaluate_greedy(
+                    cand, slice_count, leader_count)
+            if not ok:
+                continue
+            if threshold > best_threshold or (
+                    threshold == best_threshold
+                    and (best is None or n_doms < best_count)):
+                best_threshold = threshold
+                best_count = n_doms
+                best = cand
+        return best, best_threshold
+
+    def _apply_balanced(self, cand: list[Domain], level_idx: int,
+                        slice_level_idx: int, count: int,
+                        leader_count: int, slice_size: int,
+                        threshold: int):
+        """applyBalancedPlacementAlgorithm: select the optimal set (one
+        level down when the request sits above the slice level) and
+        distribute slices evenly."""
+        slice_count = count // slice_size
+        if level_idx < slice_level_idx:
+            result = self._select_optimal_set(
+                cand, slice_count, leader_count, slice_size, True)
+            if result is None:
+                return None, 0, ("TAS Balanced Placement: Cannot find "
+                                 "optimal domain set to fit the request")
+            cand = [c for d in result for c in d.children]
+            fit_level = level_idx + 1
+        else:
+            fit_level = level_idx
+        cand, reason = self._place_slices_balanced(
+            cand, slice_count, leader_count, slice_size, threshold)
+        if reason:
+            return None, 0, reason
+        return cand, fit_level, ""
 
     def _find_level_with_fit(self, level_idx: int, count: int,
                              leader_count: int, slice_size: int,
@@ -982,10 +1346,15 @@ def build_tas_flavor_snapshot(
     nodes: Iterable[Node],
     flavor_node_labels: Optional[dict[str, str]] = None,
     tolerations: Optional[list[Toleration]] = None,
-    profile_mixed: bool = False,
+    profile_mixed: Optional[bool] = None,
 ) -> TASFlavorSnapshot:
     """Build and initialize a snapshot from ready nodes matching the
-    flavor's nodeLabels (tas_flavor.go / tas_nodes_cache.go analog)."""
+    flavor's nodeLabels (tas_flavor.go / tas_nodes_cache.go analog).
+    profile_mixed defaults from the TASProfileMixed gate."""
+    if profile_mixed is None:
+        from kueue_oss_tpu import features
+
+        profile_mixed = features.enabled("TASProfileMixed")
     snap = TASFlavorSnapshot(topology_name, levels, tolerations,
                              profile_mixed=profile_mixed)
     selector = flavor_node_labels or {}

@@ -1,0 +1,49 @@
+"""Event recording.
+
+Reference parity: the scheduler and controllers emit Kubernetes Events
+on every admission, preemption, eviction, and requeue
+(scheduler.go:952-973, 996, 1012 — r.recorder.Eventf calls). Here
+events land in an in-process ring buffer consumable by the visibility
+server, the CLI (kueuectl describe), and tests.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    object_key: str      # "namespace/name" of the involved object
+    kind: str            # involved object kind (Workload, ClusterQueue...)
+    type: str            # Normal | Warning
+    reason: str          # QuotaReserved / Admitted / Preempted / Pending...
+    message: str
+    time: float = 0.0
+
+
+class EventRecorder:
+    """Bounded in-memory event sink (one per process, like a recorder
+    wired to the manager's broadcaster)."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self.events: deque[Event] = deque(maxlen=capacity)
+
+    def eventf(self, object_key: str, kind: str, type_: str, reason: str,
+               message: str, now: float = 0.0) -> None:
+        self.events.append(Event(object_key, kind, type_, reason,
+                                 message, now))
+
+    def for_object(self, object_key: str) -> list[Event]:
+        return [e for e in self.events if e.object_key == object_key]
+
+    def by_reason(self, reason: str) -> list[Event]:
+        return [e for e in self.events if e.reason == reason]
+
+
+#: process-wide recorder (the reference shares one EventBroadcaster)
+recorder = EventRecorder()

@@ -1,0 +1,68 @@
+"""In-flight preemption expectations.
+
+Reference parity: pkg/util/expectations/store.go:30-75 — the scheduler
+records the UIDs of workloads whose preemption it has issued; until the
+eviction is OBSERVED (the workload loses its quota reservation), repeated
+cycles must not double-issue preemptions for the same victims, and a
+pending preemptor keeps waiting instead of recomputing a second plan.
+The reference needs this because evictions are asynchronous apiserver
+patches; here evictions apply synchronously in-process, but controllers
+(MultiKueue orchestrated preemption, admission-check flows) can defer
+them, so the guard carries the same contract.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ExpectationsStore:
+    """Tracks (owner key -> expected-to-be-preempted workload UIDs)."""
+
+    def __init__(self, name: str = "preemption") -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._store: dict[str, set[int]] = {}
+
+    def expect_uids(self, owner: str, uids: list[int]) -> None:
+        """Record that `owner`'s plan preempts these workloads
+        (store.go ExpectUIDs)."""
+        with self._lock:
+            self._store.setdefault(owner, set()).update(uids)
+
+    def observed_uid(self, owner: str, uid: int) -> None:
+        """One expected eviction materialized (store.go ObservedUID)."""
+        with self._lock:
+            uids = self._store.get(owner)
+            if uids is None:
+                return
+            uids.discard(uid)
+            if not uids:
+                del self._store[owner]
+
+    def satisfied(self, owner: str) -> bool:
+        """All of the owner's expected evictions have been observed
+        (store.go Satisfied)."""
+        with self._lock:
+            return not self._store.get(owner)
+
+    def pending_uids(self) -> set[int]:
+        """Union of all UIDs still expected to be evicted."""
+        with self._lock:
+            out: set[int] = set()
+            for uids in self._store.values():
+                out |= uids
+            return out
+
+    def observe(self, uid: int) -> None:
+        """An eviction materialized; clear it from every plan expecting
+        it (the watch-driven ObservedUID path, owner-agnostic)."""
+        with self._lock:
+            for owner in list(self._store):
+                self._store[owner].discard(uid)
+                if not self._store[owner]:
+                    del self._store[owner]
+
+    def forget(self, owner: str) -> None:
+        with self._lock:
+            self._store.pop(owner, None)

@@ -39,11 +39,19 @@ class VisibilityService:
     def __init__(self, queues: QueueManager) -> None:
         self.queues = queues
 
+    def _check_gate(self):
+        from kueue_oss_tpu import features
+
+        if not features.enabled("VisibilityOnDemand"):
+            raise PermissionError(
+                "visibility API disabled (VisibilityOnDemand gate)")
+
     def pending_workloads_in_cq(
         self, cq_name: str, limit: Optional[int] = None, offset: int = 0
     ) -> PendingWorkloadsSummary:
         """Pending workloads of a ClusterQueue in admission order
         (active heap order first, then parked inadmissible)."""
+        self._check_gate()
         q = self.queues.queues.get(cq_name)
         if q is None:
             return PendingWorkloadsSummary()

@@ -99,7 +99,8 @@ class Dashboard:
 
 
 class DashboardServer:
-    """GET /api/clusterqueues | /api/cohorts | /api/workloads | /api/overview"""
+    """GET / (HTML dashboard) + /api/clusterqueues | /api/cohorts |
+    /api/workloads | /api/overview"""
 
     def __init__(self, dashboard: Dashboard, port: int = 0) -> None:
         dash = dashboard
@@ -109,6 +110,17 @@ class DashboardServer:
                 pass
 
             def do_GET(self) -> None:
+                if self.path in ("", "/", "/index.html"):
+                    from kueue_oss_tpu.viz.frontend import INDEX_HTML
+
+                    body = INDEX_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 routes = {
                     "/api/clusterqueues": dash.cluster_queues_view,
                     "/api/cohorts": dash.cohorts_view,

@@ -1,0 +1,83 @@
+"""Static dashboard frontend (kueueviz's React app analog, build-free).
+
+One self-contained HTML page served at `/`: fetches the JSON APIs
+(/api/overview, /api/clusterqueues, /api/cohorts, /api/workloads) and
+renders live-refreshing tables. Reference: cmd/kueueviz/frontend —
+the same read-only views (queues, cohorts, workloads, status counts)
+without the React/Vite toolchain.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>kueue-oss-tpu dashboard</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font: 14px/1.5 system-ui, sans-serif; margin: 2rem; }
+  h1 { font-size: 1.3rem; }
+  h2 { font-size: 1.05rem; margin-top: 2rem; }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: .3rem .7rem;
+           border-bottom: 1px solid color-mix(in srgb, currentColor 18%, transparent); }
+  th { font-weight: 600; }
+  .pill { display: inline-block; padding: 0 .5rem; border-radius: 999px;
+          border: 1px solid currentColor; font-size: .85em; }
+  #overview span { margin-right: 1.5rem; }
+  footer { margin-top: 2rem; opacity: .6; font-size: .85em; }
+</style>
+</head>
+<body>
+<h1>kueue-oss-tpu</h1>
+<div id="overview">loading…</div>
+<h2>ClusterQueues</h2>
+<table id="cqs"><thead><tr>
+  <th>Name</th><th>Cohort</th><th>Pending</th><th>Inadmissible</th>
+  <th>Reserving</th><th>Usage</th></tr></thead><tbody></tbody></table>
+<h2>Cohorts</h2>
+<table id="cohorts"><thead><tr>
+  <th>Name</th><th>Parent</th><th>ClusterQueues</th></tr></thead>
+  <tbody></tbody></table>
+<h2>Workloads</h2>
+<table id="wls"><thead><tr>
+  <th>Namespace</th><th>Name</th><th>LocalQueue</th><th>Priority</th>
+  <th>Status</th></tr></thead><tbody></tbody></table>
+<footer>auto-refreshes every 2s · JSON at /api/*</footer>
+<script>
+const fmt = (o) => Object.entries(o || {}).map(
+    ([k, v]) => `${k}=${v}`).join(" ");
+async function refresh() {
+  try {
+    const [cqs, cohorts, wls] = await Promise.all([
+      fetch('/api/clusterqueues').then(r => r.json()),
+      fetch('/api/cohorts').then(r => r.json()),
+      fetch('/api/workloads').then(r => r.json()),
+    ]);
+    const counts = {};
+    for (const w of wls) counts[w.status] = (counts[w.status] || 0) + 1;
+    document.getElementById('overview').innerHTML =
+      `<span><b>${cqs.length}</b> ClusterQueues</span>` +
+      `<span><b>${wls.length}</b> Workloads</span>` +
+      Object.entries(counts)
+        .map(([k, v]) => `<span><b>${v}</b> ${k}</span>`).join('');
+    const fill = (id, rows) => {
+      document.querySelector(`#${id} tbody`).innerHTML =
+        rows.map(r => `<tr>${r.map(c => `<td>${c}</td>`).join('')}</tr>`)
+            .join('');
+    };
+    fill('cqs', cqs.map(q => [q.name, q.cohort || '—', q.pending,
+                              q.inadmissible, q.reserved,
+                              fmt(q.usage)]));
+    fill('cohorts', cohorts.map(c => [c.name, c.parent || '—',
+                                      (c.clusterQueues || []).join(', ')]));
+    fill('wls', wls.map(w => [w.namespace, w.name, w.localQueue,
+                              w.priority,
+                              `<span class="pill">${w.status}</span>`]));
+  } catch (e) { /* server restarting; retry on next tick */ }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -13,9 +13,13 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_parallel_codegen_split_count" not in flags:
+    # The XLA:CPU parallel codegen path segfaults intermittently while
+    # compiling the large solver programs (observed in
+    # compiler.py backend_compile_and_load); serial codegen is stable.
+    flags = (flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402
 
@@ -31,3 +35,19 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs[:8]
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """XLA:CPU segfaults intermittently after hundreds of in-process
+    compilations of the large solver programs (observed in
+    backend_compile_and_load); dropping compiled programs between test
+    modules keeps the compiler state small. For full-tree runs prefer
+    per-file worker isolation: pytest -n 4 --dist loadfile."""
+    yield
+    import jax
+
+    jax.clear_caches()
+    from kueue_oss_tpu.solver import full_kernels
+
+    full_kernels._solver_cache.clear()

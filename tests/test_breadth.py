@@ -1,0 +1,196 @@
+"""Breadth features: events, expectations, LQ/cohort metrics, CLI depth.
+
+Reference parity: scheduler.go:952-973 (events),
+pkg/util/expectations/store.go (preemption expectations),
+pkg/metrics/metrics.go local_queue_*/cohort_subtree_* series,
+cmd/kueuectl list pending-workloads / cohorts / describe.
+"""
+
+import pytest
+
+from kueue_oss_tpu import metrics
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    PreemptionPolicyValue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.cli import Kueuectl
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.util.events import recorder as events
+from kueue_oss_tpu.util.expectations import ExpectationsStore
+
+
+def make_env(nominal=2000, n_cqs=2, cohort=True):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    if cohort:
+        store.upsert_cohort(Cohort(name="co"))
+    for i in range(n_cqs):
+        store.upsert_cluster_queue(ClusterQueue(
+            name=f"cq{i}", cohort="co" if cohort else None,
+            preemption=PreemptionPolicy(
+                within_cluster_queue=PreemptionPolicyValue.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicyValue.ANY),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources=[
+                    ResourceQuota(name="cpu", nominal=nominal,
+                                  borrowing_limit=nominal)])])]))
+        store.upsert_local_queue(LocalQueue(name=f"lq{i}",
+                                            cluster_queue=f"cq{i}"))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    return store, queues, sched
+
+
+def submit(store, name, lq, cpu, prio=0, t=0.0, uid=None):
+    kw = {"uid": uid} if uid else {}
+    store.add_workload(Workload(
+        name=name, queue_name=lq, priority=prio, creation_time=t,
+        podsets=[PodSet(name="m", count=1, requests={"cpu": cpu})], **kw))
+
+
+class TestEvents:
+    def test_admission_emits_events(self):
+        store, queues, sched = make_env()
+        submit(store, "w1", "lq0", 1000)
+        sched.run_until_quiet(now=1.0, tick=1.0)
+        evs = events.for_object("default/w1")
+        reasons = [e.reason for e in evs]
+        assert "QuotaReserved" in reasons
+        assert "Admitted" in reasons
+
+    def test_preemption_emits_warning_event(self):
+        store, queues, sched = make_env(nominal=1000, n_cqs=1,
+                                        cohort=False)
+        submit(store, "low", "lq0", 1000, prio=0, t=0.0)
+        sched.run_until_quiet(now=1.0, tick=1.0)
+        submit(store, "high", "lq0", 1000, prio=5, t=10.0)
+        sched.run_until_quiet(now=20.0, tick=1.0)
+        evs = events.for_object("default/low")
+        assert any(e.reason == "Preempted" and e.type == "Warning"
+                   for e in evs)
+
+
+class TestExpectations:
+    def test_store_contract(self):
+        ex = ExpectationsStore()
+        ex.expect_uids("p1", [1, 2])
+        assert not ex.satisfied("p1")
+        assert ex.pending_uids() == {1, 2}
+        ex.observed_uid("p1", 1)
+        assert not ex.satisfied("p1")
+        ex.observe(2)
+        assert ex.satisfied("p1")
+        assert ex.pending_uids() == set()
+
+    def test_scheduler_records_and_observes(self):
+        store, queues, sched = make_env(nominal=1000, n_cqs=1,
+                                        cohort=False)
+        submit(store, "low", "lq0", 1000, prio=0)
+        sched.run_until_quiet(now=1.0, tick=1.0)
+        submit(store, "high", "lq0", 1000, prio=5, t=10.0)
+        sched.run_until_quiet(now=20.0, tick=1.0)
+        # synchronous evictions leave no pending expectations behind
+        assert sched.preemption_expectations.pending_uids() == set()
+        assert store.workloads["default/high"].is_quota_reserved
+
+
+class TestLocalQueueMetrics:
+    def test_lq_counters_and_gauges(self):
+        store, queues, sched = make_env()
+        adm0 = metrics.local_queue_admitted_workloads_total.value(
+            "lq0", "default")
+        qr0 = metrics.local_queue_quota_reserved_workloads_total.value(
+            "lq0", "default")
+        submit(store, "w1", "lq0", 1000)
+        sched.run_until_quiet(now=1.0, tick=1.0)
+        assert metrics.local_queue_admitted_workloads_total.value(
+            "lq0", "default") == adm0 + 1
+        assert metrics.local_queue_quota_reserved_workloads_total.value(
+            "lq0", "default") == qr0 + 1
+        assert metrics.local_queue_resource_usage.value(
+            "lq0", "default", "default", "cpu") == 1000
+
+    def test_lq_evicted_counter(self):
+        store, queues, sched = make_env(nominal=1000, n_cqs=1,
+                                        cohort=False)
+        submit(store, "low", "lq0", 1000, prio=0)
+        sched.run_until_quiet(now=1.0, tick=1.0)
+        submit(store, "high", "lq0", 1000, prio=5, t=10.0)
+        sched.run_until_quiet(now=20.0, tick=1.0)
+        assert metrics.local_queue_evicted_workloads_total.value(
+            "lq0", "default", "Preempted") >= 1
+        assert metrics.evicted_workloads_once_total.value(
+            "cq0", "Preempted") >= 1
+
+
+class TestCohortMetrics:
+    def test_cohort_subtree_gauges(self):
+        store, queues, sched = make_env()
+        submit(store, "w1", "lq0", 1000)
+        sched.run_until_quiet(now=1.0, tick=1.0)
+        assert metrics.cohort_subtree_resource_reservations.value(
+            "co", "default", "cpu") == 1000
+        assert metrics.cohort_subtree_admitted_workloads_total.value(
+            "co") >= 1
+        assert metrics.cohort_subtree_quota.value(
+            "co", "default", "cpu") == 4000  # 2 CQs x 2000 nominal
+
+
+class TestCliDepth:
+    def test_list_pending_with_positions(self):
+        store, queues, sched = make_env(nominal=1000)
+        submit(store, "a", "lq0", 1000, t=0.0)
+        submit(store, "b", "lq0", 1000, t=1.0)
+        submit(store, "c", "lq0", 1000, t=2.0)
+        sched.run_until_quiet(now=1.0, tick=1.0)
+        ctl = Kueuectl(store, queues=queues)
+        out = ctl.run(["list", "pending-workloads"])
+        assert "b" in out and "c" in out
+
+    def test_list_cohorts(self):
+        store, queues, _ = make_env()
+        ctl = Kueuectl(store, queues=queues)
+        out = ctl.run(["list", "cohort"])
+        assert "co" in out and "2" in out
+
+    def test_describe_workload_with_events(self):
+        store, queues, sched = make_env()
+        submit(store, "w1", "lq0", 1000)
+        sched.run_until_quiet(now=1.0, tick=1.0)
+        ctl = Kueuectl(store, queues=queues)
+        out = ctl.run(["describe", "workload", "w1"])
+        assert "Admitted by: cq0" in out
+        assert "QuotaReserved" in out
+
+    def test_describe_clusterqueue(self):
+        store, queues, _ = make_env()
+        ctl = Kueuectl(store, queues=queues)
+        out = ctl.run(["describe", "clusterqueue", "cq0"])
+        assert "nominal=2000" in out
+
+
+class TestReadinessMetrics:
+    def test_ready_wait_time_observed(self):
+        from kueue_oss_tpu.controllers.workload_controller import (
+            WorkloadReconciler,
+        )
+
+        store, queues, sched = make_env()
+        rec = WorkloadReconciler(store, sched)
+        submit(store, "w1", "lq0", 1000, t=0.0)
+        sched.run_until_quiet(now=1.0, tick=1.0)
+        before = metrics.ready_wait_time_seconds.total_count()
+        rec.set_pods_ready("default/w1", True, now=5.0)
+        assert metrics.ready_wait_time_seconds.total_count() == before + 1

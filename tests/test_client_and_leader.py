@@ -1,0 +1,152 @@
+"""Typed client layer + leader-aware HA replicas.
+
+Reference parity: client-go clientset surface (get/list/create/update/
+delete/watch with namespace scoping) and cmd/kueue leader election +
+roletracker + warm-follower failover.
+"""
+
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.client import Clientset, Conflict, NotFound
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.util.leader import FOLLOWER, LEADER, Lease, Replica
+
+
+def base_store():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=4000)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    return store
+
+
+class TestClientset:
+    def test_get_list_roundtrip(self):
+        cs = Clientset(base_store())
+        assert cs.cluster_queues().get("cq").name == "cq"
+        assert [c.name for c in cs.cluster_queues().list()] == ["cq"]
+        assert cs.local_queues("default").get("lq").cluster_queue == "cq"
+
+    def test_get_missing_raises(self):
+        cs = Clientset(base_store())
+        with pytest.raises(NotFound):
+            cs.cluster_queues().get("nope")
+
+    def test_create_conflict(self):
+        cs = Clientset(base_store())
+        with pytest.raises(Conflict):
+            cs.cluster_queues().create(ClusterQueue(name="cq"))
+
+    def test_namespace_scoping(self):
+        store = base_store()
+        cs = Clientset(store)
+        cs.workloads("team-a").create(Workload(
+            name="w1", namespace="team-a", queue_name="lq",
+            podsets=[PodSet(count=1, requests={"cpu": 100})]))
+        cs.workloads("team-b").create(Workload(
+            name="w2", namespace="team-b", queue_name="lq",
+            podsets=[PodSet(count=1, requests={"cpu": 100})]))
+        assert [w.name for w in cs.workloads("team-a").list()] == ["w1"]
+        assert len(cs.workloads().list()) == 2
+        with pytest.raises(NotFound):
+            cs.workloads("team-a").get("w2")
+
+    def test_watch_stream(self):
+        store = base_store()
+        cs = Clientset(store)
+        seen = []
+        cs.workloads().watch(lambda ev: seen.append((ev.type,
+                                                     ev.object.name)))
+        wl = Workload(name="w1", queue_name="lq",
+                      podsets=[PodSet(count=1, requests={"cpu": 100})])
+        cs.workloads().create(wl)
+        cs.workloads().update(wl)
+        cs.workloads().delete("w1")
+        assert seen == [("Added", "w1"), ("Modified", "w1"),
+                        ("Deleted", "w1")]
+
+    def test_patch_status(self):
+        store = base_store()
+        cs = Clientset(store)
+        cs.workloads().create(Workload(
+            name="w1", queue_name="lq",
+            podsets=[PodSet(count=1, requests={"cpu": 100})]))
+        cs.workloads().patch_status(
+            "w1", lambda wl: setattr(wl, "active", False))
+        assert not cs.workloads().get("w1").active
+
+
+class TestLeaderElection:
+    def _replica(self, store, identity, lease, clock):
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues, clock=clock)
+        return Replica(identity, sched, lease)
+
+    def test_single_leader_decides(self):
+        store = base_store()
+        t = [0.0]
+        clock = lambda: t[0]
+        lease = Lease(duration_s=15.0, clock=clock)
+        a = self._replica(store, "a", lease, clock)
+        b = self._replica(store, "b", lease, clock)
+        store.add_workload(Workload(
+            name="w1", queue_name="lq",
+            podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+        assert a.tick(now=1.0) > 0
+        assert a.is_leader
+        assert b.tick(now=1.0) == 0
+        assert b.tracker.role == FOLLOWER
+        assert store.workloads["default/w1"].is_admitted
+
+    def test_warm_failover(self):
+        """The follower's caches track the store; after the leader's
+        lease lapses it schedules immediately."""
+        store = base_store()
+        t = [0.0]
+        clock = lambda: t[0]
+        lease = Lease(duration_s=15.0, clock=clock)
+        a = self._replica(store, "a", lease, clock)
+        b = self._replica(store, "b", lease, clock)
+        store.add_workload(Workload(
+            name="w1", queue_name="lq",
+            podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+        a.tick(now=1.0)
+        b.tick(now=1.0)
+        # leader dies; lease expires
+        t[0] = 20.0
+        store.add_workload(Workload(
+            name="w2", queue_name="lq", creation_time=19.0,
+            podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+        assert b.tick(now=21.0) > 0
+        assert b.is_leader
+        assert store.workloads["default/w2"].is_admitted
+
+    def test_role_transitions_fire_callbacks(self):
+        store = base_store()
+        t = [0.0]
+        clock = lambda: t[0]
+        lease = Lease(duration_s=15.0, clock=clock)
+        a = self._replica(store, "a", lease, clock)
+        fired = []
+        a.tracker.on_promote(lambda: fired.append("up"))
+        a.tracker.on_demote(lambda: fired.append("down"))
+        a.tick(now=0.0)
+        a.step_down()
+        assert fired == ["up", "down"]
+        assert a.tracker.role == FOLLOWER

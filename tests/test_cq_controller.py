@@ -1,0 +1,107 @@
+"""ClusterQueue Active-status reconciler.
+
+Reference parity: pkg/controller/core/clusterqueue_controller.go — the
+Active condition from flavor/check existence, stop policy, and cohort
+cycles, with status gauges and queue deactivation.
+"""
+
+from kueue_oss_tpu import metrics
+from kueue_oss_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    StopPolicy,
+)
+from kueue_oss_tpu.controllers.cq_controller import (
+    ClusterQueueReconciler,
+    R_COHORT_CYCLE,
+    R_FLAVOR_NOT_FOUND,
+    R_CHECK_NOT_FOUND,
+    R_STOPPED,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+
+
+def make_cq(name="cq", flavor="default", cohort=None, checks=()):
+    return ClusterQueue(
+        name=name, cohort=cohort, admission_checks=list(checks),
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name=flavor, resources=[
+                ResourceQuota(name="cpu", nominal=1000)])])])
+
+
+def test_active_when_everything_exists():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(make_cq())
+    rec = ClusterQueueReconciler(store)
+    st = rec.reconcile("cq")
+    assert st.active
+    assert metrics.cluster_queue_status.value("cq", "active") == 1
+
+
+def test_missing_flavor_deactivates():
+    store = Store()
+    store.upsert_cluster_queue(make_cq(flavor="ghost"))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    queues = QueueManager(store)
+    rec = ClusterQueueReconciler(store, queues)
+    st = rec.reconcile("cq")
+    assert not st.active and st.reason == R_FLAVOR_NOT_FOUND
+    assert st.missing_flavors == ["ghost"]
+    assert not queues.queues["cq"].active
+    # flavor appears -> reactivates
+    store.upsert_resource_flavor(ResourceFlavor(name="ghost"))
+    st = rec.reconcile("cq")
+    assert st.active
+    assert queues.queues["cq"].active
+
+
+def test_missing_admission_check():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(make_cq(checks=["prov"]))
+    rec = ClusterQueueReconciler(store)
+    st = rec.reconcile("cq")
+    assert not st.active and st.reason == R_CHECK_NOT_FOUND
+    store.upsert_admission_check(AdmissionCheck(name="prov"))
+    assert rec.reconcile("cq").active
+
+
+def test_stopped_cq():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    cq = make_cq()
+    cq.stop_policy = StopPolicy.HOLD
+    store.upsert_cluster_queue(cq)
+    rec = ClusterQueueReconciler(store)
+    st = rec.reconcile("cq")
+    assert not st.active and st.reason == R_STOPPED
+
+
+def test_cohort_cycle_detected():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cohort(Cohort(name="a", parent="b"))
+    store.upsert_cohort(Cohort(name="b", parent="a"))
+    store.upsert_cluster_queue(make_cq(cohort="a"))
+    rec = ClusterQueueReconciler(store)
+    st = rec.reconcile("cq")
+    assert not st.active and st.reason == R_COHORT_CYCLE
+
+
+def test_reconcile_all_and_delete():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(make_cq("cq1"))
+    store.upsert_cluster_queue(make_cq("cq2", flavor="ghost"))
+    rec = ClusterQueueReconciler(store)
+    statuses = rec.reconcile_all()
+    assert statuses["cq1"].active and not statuses["cq2"].active

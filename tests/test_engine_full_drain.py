@@ -27,7 +27,7 @@ def _setup(seed):
     for spec in phase1:
         store.add_workload(_mk_wl(spec, uid))
         uid += 1
-    sched.run_until_quiet(now=50.0)
+    sched.run_until_quiet(now=50.0, tick=1.0)
     for spec in phase2:
         store.add_workload(_mk_wl(spec, uid))
         uid += 1
@@ -51,7 +51,7 @@ SEEDS = list(range(20))
 @pytest.mark.parametrize("seed", SEEDS)
 def test_engine_drain_matches_host(seed):
     store_h, queues_h, sched_h = _setup(seed)
-    cycles = sched_h.run_until_quiet(now=200.0, max_cycles=300)
+    cycles = sched_h.run_until_quiet(now=200.0, max_cycles=300, tick=1.0)
     if cycles >= 300:
         pytest.skip(f"seed {seed}: host does not quiesce")
     admitted_h, flavors_h = _state(store_h)
@@ -98,7 +98,7 @@ def test_scheduler_solver_backed(seed):
     """Scheduler(solver='auto').run_until_quiet drains via the kernel and
     matches the host-only scheduler end-state (verify-then-assume)."""
     store_h, queues_h, sched_h = _setup(seed)
-    cycles = sched_h.run_until_quiet(now=200.0, max_cycles=300)
+    cycles = sched_h.run_until_quiet(now=200.0, max_cycles=300, tick=1.0)
     if cycles >= 300:
         pytest.skip("host livelock")
     admitted_h, flavors_h = _state(store_h)
@@ -110,11 +110,11 @@ def test_scheduler_solver_backed(seed):
     for spec in phase1:
         store_s.add_workload(_mk_wl(spec, uid))
         uid += 1
-    sched_s.run_until_quiet(now=50.0)
+    sched_s.run_until_quiet(now=50.0, tick=1.0)
     for spec in phase2:
         store_s.add_workload(_mk_wl(spec, uid))
         uid += 1
-    sched_s.run_until_quiet(now=200.0, max_cycles=300)
+    sched_s.run_until_quiet(now=200.0, max_cycles=300, tick=1.0)
     admitted_s, flavors_s = _state(store_s)
     assert admitted_s == admitted_h
     assert flavors_s == flavors_h
@@ -146,7 +146,7 @@ def test_simulator_solver_backed():
 def test_engine_drain_with_verify():
     """verify=True re-checks each admission against the native oracle."""
     store_h, queues_h, sched_h = _setup(5)
-    sched_h.run_until_quiet(now=200.0, max_cycles=300)
+    sched_h.run_until_quiet(now=200.0, max_cycles=300, tick=1.0)
     admitted_h, _ = _state(store_h)
 
     store_k, queues_k, _ = _setup(5)

@@ -1,0 +1,186 @@
+"""Fair-sharing drain parity: device DRS tournament vs host scheduler.
+
+Scenarios run with enable_fair_sharing on both sides: the host uses
+_FairSharingIterator + Preemptor._fair_preemptions; the kernel uses
+solver/fair_kernels.py (DRS, the target-CQ tournament, strategy rules
+S2-a/S2-b, and the admission-order tournament).
+
+Reference parity: pkg/cache/scheduler/fair_sharing.go:140-173,
+pkg/scheduler/preemption/preemption.go:371-534,
+pkg/scheduler/fair_sharing_iterator.go:44-130.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FairSharing,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    PreemptionPolicyValue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.solver.engine import SolverEngine
+
+
+def build_fs_scenario(seed: int):
+    rng = random.Random(20_000 + seed)
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="f1"))
+
+    two_level = rng.random() < 0.4
+    if two_level:
+        store.upsert_cohort(Cohort(name="root"))
+        store.upsert_cohort(Cohort(name="co0", parent="root"))
+        store.upsert_cohort(Cohort(name="co1", parent="root"))
+        cohorts = ["co0", "co1"]
+    else:
+        store.upsert_cohort(Cohort(name="co0"))
+        cohorts = ["co0"]
+
+    n_cqs = rng.randint(2, 5)
+    for c in range(n_cqs):
+        weight = rng.choice([0.5, 1.0, 1.0, 2.0])
+        store.upsert_cluster_queue(ClusterQueue(
+            name=f"cq{c}",
+            cohort=cohorts[c % len(cohorts)],
+            fair_sharing=FairSharing(weight=weight),
+            preemption=PreemptionPolicy(
+                within_cluster_queue=rng.choice(
+                    [PreemptionPolicyValue.NEVER,
+                     PreemptionPolicyValue.LOWER_PRIORITY]),
+                reclaim_within_cohort=rng.choice(
+                    [PreemptionPolicyValue.NEVER,
+                     PreemptionPolicyValue.ANY]),
+            ),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f1", resources=[
+                    ResourceQuota(
+                        name="cpu", nominal=rng.choice([1000, 2000]),
+                        borrowing_limit=rng.choice([None, 1000, 2000]),
+                        lending_limit=rng.choice([None, 500]))])])]))
+        store.upsert_local_queue(
+            LocalQueue(name=f"lq{c}", cluster_queue=f"cq{c}"))
+
+    phase1, phase2 = [], []
+    for i in range(rng.randint(2, 6)):
+        phase1.append(dict(
+            name=f"init{i}", queue_name=f"lq{rng.randrange(n_cqs)}",
+            priority=rng.randint(0, 2), creation_time=float(i),
+            cpu=rng.choice([400, 700, 1000, 1500])))
+    for i in range(rng.randint(3, 10)):
+        phase2.append(dict(
+            name=f"new{i}", queue_name=f"lq{rng.randrange(n_cqs)}",
+            priority=rng.randint(0, 3), creation_time=100.0 + i,
+            cpu=rng.choice([400, 700, 1000, 1500, 2500])))
+    return store, phase1, phase2
+
+
+def _mk_wl(spec, uid):
+    return Workload(
+        name=spec["name"], queue_name=spec["queue_name"],
+        priority=spec["priority"], creation_time=spec["creation_time"],
+        uid=uid,
+        podsets=[PodSet(name="main", count=1,
+                        requests={"cpu": spec["cpu"]})])
+
+
+def _setup(seed):
+    store, phase1, phase2 = build_fs_scenario(seed)
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues, enable_fair_sharing=True)
+    uid = 1
+    for spec in phase1:
+        store.add_workload(_mk_wl(spec, uid))
+        uid += 1
+    sched.run_until_quiet(now=50.0, tick=1.0)
+    for spec in phase2:
+        store.add_workload(_mk_wl(spec, uid))
+        uid += 1
+    return store, queues, sched
+
+
+def _state(store):
+    admitted = {k for k, w in store.workloads.items() if w.is_quota_reserved}
+    return admitted
+
+
+SEEDS = list(range(30))
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fair_drain_parity(seed):
+    store_h, queues_h, sched_h = _setup(seed)
+    init = _state(store_h)
+    cycles = sched_h.run_until_quiet(now=200.0, max_cycles=300, tick=1.0)
+    if cycles >= 300:
+        pytest.skip(f"fs seed {seed}: host livelock")
+    admitted_h = _state(store_h)
+
+    store_k, queues_k, _ = _setup(seed)
+    assert _state(store_k) == init
+    engine = SolverEngine(store_k, queues_k, enable_fair_sharing=True)
+    assert engine.supported() and engine.needs_full_kernel()
+    engine.drain(now=200.0)
+    admitted_k = _state(store_k)
+
+    victims_h = init - admitted_h
+    victims_k = init - admitted_k
+    assert admitted_k == admitted_h, (
+        f"fs seed {seed}: admitted mismatch\n host-only: "
+        f"{sorted(admitted_h - admitted_k)}\n kernel-only: "
+        f"{sorted(admitted_k - admitted_h)}")
+    assert victims_k == victims_h
+
+
+def test_fair_victim_reason():
+    """Fair-sharing cross-CQ victims carry InCohortFairSharing."""
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="f1"))
+    store.upsert_cohort(Cohort(name="co"))
+    for i, reclaim in enumerate([PreemptionPolicyValue.ANY,
+                                 PreemptionPolicyValue.NEVER]):
+        store.upsert_cluster_queue(ClusterQueue(
+            name=f"cq{i}", cohort="co",
+            preemption=PreemptionPolicy(reclaim_within_cohort=reclaim),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f1", resources=[
+                    ResourceQuota(name="cpu", nominal=1000,
+                                  borrowing_limit=1000)])])]))
+        store.upsert_local_queue(
+            LocalQueue(name=f"lq{i}", cluster_queue=f"cq{i}"))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues, enable_fair_sharing=True)
+    store.add_workload(Workload(
+        name="borrower", queue_name="lq1", uid=1, creation_time=0.0,
+        podsets=[PodSet(name="m", count=1, requests={"cpu": 1800})]))
+    sched.run_until_quiet(now=1.0, tick=1.0)
+    assert store.workloads["default/borrower"].is_quota_reserved
+
+    store.add_workload(Workload(
+        name="claimant", queue_name="lq0", uid=2, creation_time=10.0,
+        podsets=[PodSet(name="m", count=1, requests={"cpu": 900})]))
+    engine = SolverEngine(store, queues, enable_fair_sharing=True)
+    result = engine.drain(now=20.0)
+    b = store.workloads["default/borrower"]
+    c = store.workloads["default/claimant"]
+    assert c.is_quota_reserved and not b.is_quota_reserved
+    from kueue_oss_tpu.api.types import WorkloadConditionType
+
+    pre = b.status.conditions.get(WorkloadConditionType.PREEMPTED)
+    assert pre is not None and pre.reason == "InCohortFairSharing"
+    assert result.evicted == 1

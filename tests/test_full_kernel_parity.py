@@ -126,13 +126,13 @@ def run_host(seed: int):
     for spec in phase1:
         store.add_workload(_mk_wl(spec, uid))
         uid += 1
-    sched.run_until_quiet(now=50.0)
+    sched.run_until_quiet(now=50.0, tick=1.0)
     initially_admitted = {k for k, w in store.workloads.items()
                          if w.is_quota_reserved}
     for spec in phase2:
         store.add_workload(_mk_wl(spec, uid))
         uid += 1
-    cycles = sched.run_until_quiet(now=200.0, max_cycles=300)
+    cycles = sched.run_until_quiet(now=200.0, max_cycles=300, tick=1.0)
     if cycles >= 300:
         # Preemption ping-pong livelock (a borrower re-admits into the
         # capacity its preemptor freed, forever). Inherited from the
@@ -156,7 +156,7 @@ def run_kernel(seed: int):
         store.add_workload(_mk_wl(spec, uid))
         uid += 1
     # identical starting state: the host scheduler admits phase 1
-    sched.run_until_quiet(now=50.0)
+    sched.run_until_quiet(now=50.0, tick=1.0)
     initially_admitted = {k for k, w in store.workloads.items()
                          if w.is_quota_reserved}
     for spec in phase2:

@@ -1,0 +1,254 @@
+"""Pod-group integration: assembly, gating, replacement, reclaim, finish.
+
+Reference parity: pkg/controller/jobs/pod/pod_controller.go — group
+assembly by label/annotation, gated-pod accounting, excess-pod
+exclusion, failed-pod replacement, reclaimable pods
+(JobWithReclaimablePods), group completion.
+"""
+
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.snapshot import build_snapshot
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.jobframework.reconciler import JobReconciler
+from kueue_oss_tpu.jobs.pod import (
+    ADMISSION_GATE,
+    FAILED,
+    POD_GROUP_LABEL,
+    POD_GROUP_TOTAL_ANNOTATION,
+    RUNNING,
+    SUCCEEDED,
+    Pod,
+    PodGroupController,
+)
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+def make_env(nominal=4000):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=nominal)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    rec = JobReconciler(store, sched)
+    ctl = PodGroupController(store, sched, rec)
+    return store, sched, rec, ctl
+
+
+def group_pod(name, t=0.0, cpu=1000, group="grp", total=3):
+    return Pod(
+        name=name, queue_name="lq", requests={"cpu": cpu},
+        labels={POD_GROUP_LABEL: group},
+        annotations={POD_GROUP_TOTAL_ANNOTATION: str(total)},
+        creation_time=t)
+
+
+def drive(sched, ctl, now):
+    ctl.reconcile(now)
+    sched.run_until_quiet(now=now, tick=1.0)
+    ctl.reconcile(now)
+
+
+class TestSinglePod:
+    def test_gate_removed_on_admission(self):
+        store, sched, rec, ctl = make_env()
+        pod = Pod(name="p1", queue_name="lq", requests={"cpu": 1000})
+        assert pod.gated
+        ctl.upsert_pod(pod)
+        drive(sched, ctl, 1.0)
+        wl = store.workloads["default/pod-p1"]
+        assert wl.is_admitted
+        assert not pod.gated
+
+    def test_finished_pod_finishes_workload(self):
+        store, sched, rec, ctl = make_env()
+        pod = Pod(name="p1", queue_name="lq", requests={"cpu": 1000})
+        ctl.upsert_pod(pod)
+        drive(sched, ctl, 1.0)
+        ctl.mark_phase(pod.key, SUCCEEDED)
+        drive(sched, ctl, 2.0)
+        assert store.workloads["default/pod-p1"].is_finished
+
+
+class TestGroupAssembly:
+    def test_waits_for_all_members(self):
+        store, sched, rec, ctl = make_env()
+        ctl.upsert_pod(group_pod("a", 0.0))
+        ctl.upsert_pod(group_pod("b", 1.0))
+        drive(sched, ctl, 1.0)
+        assert "default/podgroup-grp" not in store.workloads
+        ctl.upsert_pod(group_pod("c", 2.0))
+        drive(sched, ctl, 2.0)
+        wl = store.workloads["default/podgroup-grp"]
+        assert wl.is_admitted
+        # one role (same shape) with count 3
+        assert len(wl.podsets) == 1 and wl.podsets[0].count == 3
+
+    def test_distinct_shapes_become_roles(self):
+        store, sched, rec, ctl = make_env()
+        ctl.upsert_pod(group_pod("driver", 0.0, cpu=2000, total=3))
+        ctl.upsert_pod(group_pod("w1", 1.0, cpu=500, total=3))
+        ctl.upsert_pod(group_pod("w2", 2.0, cpu=500, total=3))
+        drive(sched, ctl, 3.0)
+        wl = store.workloads["default/podgroup-grp"]
+        counts = sorted((ps.count, ps.requests["cpu"])
+                        for ps in wl.podsets)
+        assert counts == [(1, 2000), (2, 500)]
+
+    def test_excess_pods_excluded(self):
+        store, sched, rec, ctl = make_env()
+        for i in range(4):
+            ctl.upsert_pod(group_pod(f"p{i}", float(i), total=3))
+        drive(sched, ctl, 5.0)
+        wl = store.workloads["default/podgroup-grp"]
+        assert sum(ps.count for ps in wl.podsets) == 3
+        assert "default/p3" in ctl.excess_pods
+        # the excess pod stays gated
+        assert ctl.pods["default/p3"].gated
+
+    def test_members_ungated_on_admission(self):
+        store, sched, rec, ctl = make_env()
+        pods = [group_pod(f"p{i}", float(i)) for i in range(3)]
+        for p in pods:
+            ctl.upsert_pod(p)
+        drive(sched, ctl, 3.0)
+        assert all(not p.gated for p in pods)
+
+    def test_group_stays_gated_when_not_admitted(self):
+        store, sched, rec, ctl = make_env(nominal=1000)  # doesn't fit
+        pods = [group_pod(f"p{i}", float(i)) for i in range(3)]
+        for p in pods:
+            ctl.upsert_pod(p)
+        drive(sched, ctl, 3.0)
+        wl = store.workloads["default/podgroup-grp"]
+        assert not wl.is_admitted
+        assert all(p.gated for p in pods)
+
+
+class TestReclaimAndReplace:
+    def test_succeeded_pods_reclaim_quota(self):
+        store, sched, rec, ctl = make_env(nominal=3000)
+        pods = [group_pod(f"p{i}", float(i)) for i in range(3)]
+        for p in pods:
+            ctl.upsert_pod(p)
+        drive(sched, ctl, 3.0)
+        wl = store.workloads["default/podgroup-grp"]
+        assert wl.is_admitted
+        snap = build_snapshot(store)
+        assert snap.cluster_queues["cq"].node.usage[("default", "cpu")] == 3000
+
+        # two pods succeed -> their quota is reclaimable
+        ctl.mark_phase("default/p0", SUCCEEDED)
+        ctl.mark_phase("default/p1", SUCCEEDED)
+        drive(sched, ctl, 4.0)
+        wl = store.workloads["default/podgroup-grp"]
+        assert sum(wl.status.reclaimable_pods.values()) == 2
+        snap = build_snapshot(store)
+        assert snap.cluster_queues["cq"].node.usage[("default", "cpu")] == 1000
+
+        # the freed quota admits another workload
+        single = Pod(name="extra", queue_name="lq", requests={"cpu": 2000})
+        ctl.upsert_pod(single)
+        drive(sched, ctl, 5.0)
+        assert store.workloads["default/pod-extra"].is_admitted
+
+    def test_failed_pod_replaced_and_ungated(self):
+        store, sched, rec, ctl = make_env()
+        pods = [group_pod(f"p{i}", float(i)) for i in range(3)]
+        for p in pods:
+            ctl.upsert_pod(p)
+        drive(sched, ctl, 3.0)
+        assert store.workloads["default/podgroup-grp"].is_admitted
+        ctl.mark_phase("default/p1", FAILED)
+        repl = group_pod("p1r", 10.0)
+        ctl.upsert_pod(repl)
+        drive(sched, ctl, 11.0)
+        # the replacement takes the failed pod's seat and is ungated
+        assert not repl.gated
+        assert "default/p1" in ctl.excess_pods
+        wl = store.workloads["default/podgroup-grp"]
+        assert not wl.is_finished
+
+    def test_group_finishes_on_total_success(self):
+        store, sched, rec, ctl = make_env()
+        pods = [group_pod(f"p{i}", float(i)) for i in range(3)]
+        for p in pods:
+            ctl.upsert_pod(p)
+        drive(sched, ctl, 3.0)
+        for p in pods:
+            ctl.mark_phase(p.key, SUCCEEDED)
+        drive(sched, ctl, 4.0)
+        wl = store.workloads["default/podgroup-grp"]
+        assert wl.is_finished
+
+    def test_deleted_member_vacates_seat_for_replacement(self):
+        """A deleted group member is treated as failed: the group keeps
+        running and a replacement pod takes the seat."""
+        store, sched, rec, ctl = make_env()
+        pods = [group_pod(f"p{i}", float(i)) for i in range(3)]
+        for p in pods:
+            ctl.upsert_pod(p)
+        drive(sched, ctl, 3.0)
+        assert store.workloads["default/podgroup-grp"].is_admitted
+        ctl.delete_pod("default/p1", now=5.0)
+        drive(sched, ctl, 6.0)
+        wl = store.workloads["default/podgroup-grp"]
+        assert not wl.is_finished  # waiting for a replacement, not stuck
+        repl = group_pod("p1r", 10.0)
+        ctl.upsert_pod(repl)
+        drive(sched, ctl, 11.0)
+        assert not repl.gated
+        for key in ("default/p0", "default/p1r", "default/p2"):
+            ctl.mark_phase(key, SUCCEEDED)
+        drive(sched, ctl, 12.0)
+        assert store.workloads["default/podgroup-grp"].is_finished
+
+    def test_role_attribution_stable_after_failure(self):
+        """Reclaim attribution uses the frozen assembly-time roles even
+        after failures reorder the seating."""
+        store, sched, rec, ctl = make_env(nominal=5000)
+        a = group_pod("a", 0.0, cpu=2000, total=2)   # role-0 (shape A)
+        b = group_pod("b", 1.0, cpu=500, total=2)    # role-1 (shape B)
+        ctl.upsert_pod(a)
+        ctl.upsert_pod(b)
+        drive(sched, ctl, 2.0)
+        wl = store.workloads["default/podgroup-grp"]
+        assert wl.is_admitted
+        ctl.mark_phase("default/a", FAILED)
+        ctl.mark_phase("default/b", SUCCEEDED)
+        drive(sched, ctl, 3.0)
+        wl = store.workloads["default/podgroup-grp"]
+        # b's success must reclaim the 500-cpu role, not the 2000 one
+        by_role = {}
+        for ps in wl.podsets:
+            by_role[ps.name] = ps.requests["cpu"]
+        for role, n in wl.status.reclaimable_pods.items():
+            if n:
+                assert by_role[role] == 500, (role, by_role)
+
+    def test_group_fails_when_all_terminal_without_success(self):
+        store, sched, rec, ctl = make_env()
+        pods = [group_pod(f"p{i}", float(i)) for i in range(3)]
+        for p in pods:
+            ctl.upsert_pod(p)
+        drive(sched, ctl, 3.0)
+        ctl.mark_phase("default/p0", SUCCEEDED)
+        ctl.mark_phase("default/p1", FAILED)
+        ctl.mark_phase("default/p2", FAILED)
+        drive(sched, ctl, 4.0)
+        wl = store.workloads["default/podgroup-grp"]
+        assert wl.is_finished

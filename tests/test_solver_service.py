@@ -1,0 +1,80 @@
+"""Process-separated solver service: wire roundtrip + engine parity.
+
+Reference analog: SURVEY §2.4 — the gRPC sidecar carrying snapshot
+tensors to the solver process; here a length-prefixed unix-socket
+protocol with the same export/verify/commit split.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from test_full_kernel_parity import build_scenario, _mk_wl
+
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.solver.engine import SolverEngine
+from kueue_oss_tpu.solver.service import (
+    SolverClient,
+    SolverServer,
+    deserialize_problem,
+    serialize_problem,
+)
+from kueue_oss_tpu.solver.tensors import export_problem
+
+
+@pytest.fixture()
+def server():
+    path = os.path.join(tempfile.mkdtemp(), "solver.sock")
+    srv = SolverServer(path)
+    srv.serve_in_background()
+    yield path
+    srv.shutdown()
+    srv.server_close()
+
+
+def _setup(seed):
+    store, phase1, phase2 = build_scenario(seed)
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    uid = 1
+    for spec in phase1:
+        store.add_workload(_mk_wl(spec, uid))
+        uid += 1
+    sched.run_until_quiet(now=50.0, tick=1.0)
+    for spec in phase2:
+        store.add_workload(_mk_wl(spec, uid))
+        uid += 1
+    return store, queues
+
+
+def test_problem_serialization_roundtrip():
+    store, queues = _setup(3)
+    pending = {n: q.snapshot_order() for n, q in queues.queues.items()
+               if q.snapshot_order()}
+    problem = export_problem(store, pending, include_admitted=True)
+    meta, blob = serialize_problem(problem)
+    back = deserialize_problem(meta, blob)
+    assert (back.wl_req == problem.wl_req).all()
+    assert (back.subtree == problem.subtree).all()
+    assert back.ts_evict_base == problem.ts_evict_base
+
+
+@pytest.mark.parametrize("seed", [3, 7])
+def test_remote_engine_matches_local(seed, server):
+    store_l, queues_l = _setup(seed)
+    SolverEngine(store_l, queues_l).drain(now=200.0)
+    admitted_l = {k for k, w in store_l.workloads.items()
+                  if w.is_quota_reserved}
+
+    store_r, queues_r = _setup(seed)
+    engine = SolverEngine(store_r, queues_r,
+                          remote=SolverClient(server))
+    result = engine.drain(now=200.0)
+    admitted_r = {k for k, w in store_r.workloads.items()
+                  if w.is_quota_reserved}
+    assert admitted_r == admitted_l
+    assert result.admitted == len(
+        [k for k in result.admitted_keys])

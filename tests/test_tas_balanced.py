@@ -1,0 +1,199 @@
+"""TAS balanced placement + multi-layer slice constraints.
+
+Reference parity: pkg/cache/scheduler/tas_balanced_placement.go (greedy
+evaluation, balance threshold, DP domain-set selection, even slice
+distribution) and tas_flavor_snapshot.go:1001-1060 buildSliceSizeAtLevel
+(nested slice layers), gated by TASBalancedPlacement /
+TASMultiLayerTopology.
+"""
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import (
+    Node,
+    PodSet,
+    PodSetSliceConstraint,
+    PodSetTopologyRequest,
+)
+from kueue_oss_tpu.tas.snapshot import (
+    TASPodSetRequest,
+    build_tas_flavor_snapshot,
+)
+
+HOST = "kubernetes.io/hostname"
+BLOCK = "cloud/block"
+RACK = "cloud/rack"
+
+
+@pytest.fixture(autouse=True)
+def _reset_gates():
+    yield
+    features.reset()
+
+
+def make_nodes(blocks=1, racks=2, hosts=2, cpu=4000):
+    nodes = []
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                nodes.append(Node(
+                    name=f"n-{b}-{r}-{h}",
+                    labels={BLOCK: f"b{b}", RACK: f"b{b}-r{r}"},
+                    allocatable={"cpu": cpu}))
+    return nodes
+
+
+def snap_3level(nodes, **kw):
+    return build_tas_flavor_snapshot(
+        "default", [BLOCK, RACK, HOST], nodes, **kw)
+
+
+def place(snap, podset, per_pod=None):
+    req = TASPodSetRequest(
+        podset=podset,
+        single_pod_requests=per_pod or dict(podset.requests),
+        count=podset.count,
+        flavor="default")
+    return snap.find_topology_assignments([req])
+
+
+def domains_of(result, name="main"):
+    ta = result[name].assignment
+    assert ta is not None, result[name].failure
+    return sorted((tuple(d.values), d.count) for d in ta.domains)
+
+
+class TestBalancedPlacement:
+    def test_even_distribution_across_racks(self):
+        """BestFit would pack 8 pods into 2 hosts; balanced placement
+        spreads them evenly over the racks' hosts."""
+        features.set_gates({"TASBalancedPlacement": True})
+        # 1 block x 2 racks x 2 hosts x 4 cpu
+        snap = snap_3level(make_nodes(blocks=1, racks=2, hosts=2))
+        ps = PodSet(name="main", count=8, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(preferred=BLOCK))
+        result = place(snap, ps)
+        doms = domains_of(result)
+        # 8 pods over 2+ hosts; balanced keeps every used host at the
+        # same count (threshold 8 // #hosts-used)
+        counts = [c for _, c in doms]
+        assert sum(counts) == 8
+        assert max(counts) - min(counts) <= 1, doms
+
+    def test_balanced_gate_off_packs_best_fit(self):
+        snap = snap_3level(make_nodes(blocks=1, racks=2, hosts=2))
+        ps = PodSet(name="main", count=8, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(preferred=BLOCK))
+        result = place(snap, ps)
+        doms = domains_of(result)
+        # classical: minimize domains -> two full hosts of 4
+        assert [c for _, c in doms] == [4, 4]
+
+    def test_required_level_never_balances(self):
+        features.set_gates({"TASBalancedPlacement": True})
+        snap = snap_3level(make_nodes(blocks=1, racks=2, hosts=2))
+        ps = PodSet(name="main", count=4, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(required=RACK))
+        result = place(snap, ps)
+        doms = domains_of(result)
+        # required rack: stays on one rack, packed
+        assert sum(c for _, c in doms) == 4
+
+    def test_balanced_slices(self):
+        """Slices of 2 spread evenly across racks."""
+        features.set_gates({"TASBalancedPlacement": True})
+        snap = snap_3level(make_nodes(blocks=1, racks=2, hosts=2))
+        ps = PodSet(
+            name="main", count=8, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(
+                preferred=BLOCK,
+                podset_slice_required_topology=RACK,
+                podset_slice_size=2))
+        result = place(snap, ps)
+        doms = domains_of(result)
+        assert sum(c for _, c in doms) == 8
+
+    def test_balanced_falls_back_when_threshold_zero(self):
+        """A shape that cannot balance still places via best-fit."""
+        features.set_gates({"TASBalancedPlacement": True})
+        # one host has almost no room: threshold collapses
+        nodes = make_nodes(blocks=1, racks=1, hosts=2)
+        snap = snap_3level(nodes)
+        snap.add_tas_usage(("b0", "b0-r0", "n-0-0-1"), {"cpu": 1000}, 4)
+        ps = PodSet(name="main", count=4, requests={"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(preferred=BLOCK))
+        result = place(snap, ps)
+        doms = domains_of(result)
+        assert doms == [(("n-0-0-0",), 4)]
+
+
+class TestMultiLayerSlices:
+    def test_inner_layer_groups_at_host(self):
+        """Outer slices of 4 per rack, inner layer of 2 per host: every
+        host receives a multiple of 2 pods."""
+        features.set_gates({"TASMultiLayerTopology": True})
+        snap = snap_3level(make_nodes(blocks=1, racks=2, hosts=2))
+        ps = PodSet(
+            name="main", count=8, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(
+                required=BLOCK,
+                podset_slice_required_topology=RACK,
+                podset_slice_size=4,
+                podset_slice_constraints=[
+                    PodSetSliceConstraint(topology=RACK, size=4),
+                    PodSetSliceConstraint(topology=HOST, size=2),
+                ]))
+        result = place(snap, ps)
+        doms = domains_of(result)
+        assert sum(c for _, c in doms) == 8
+        assert all(c % 2 == 0 for _, c in doms), doms
+
+    def test_inner_layer_must_divide_parent(self):
+        features.set_gates({"TASMultiLayerTopology": True})
+        snap = snap_3level(make_nodes())
+        ps = PodSet(
+            name="main", count=8, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(
+                required=BLOCK,
+                podset_slice_required_topology=RACK,
+                podset_slice_size=4,
+                podset_slice_constraints=[
+                    PodSetSliceConstraint(topology=RACK, size=4),
+                    PodSetSliceConstraint(topology=HOST, size=3),
+                ]))
+        result = place(snap, ps)
+        assert result["main"].assignment is None
+        assert "evenly divide" in result["main"].failure
+
+    def test_inner_layer_must_be_below_parent(self):
+        features.set_gates({"TASMultiLayerTopology": True})
+        snap = snap_3level(make_nodes())
+        ps = PodSet(
+            name="main", count=8, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(
+                required=BLOCK,
+                podset_slice_required_topology=RACK,
+                podset_slice_size=4,
+                podset_slice_constraints=[
+                    PodSetSliceConstraint(topology=RACK, size=4),
+                    PodSetSliceConstraint(topology=RACK, size=2),
+                ]))
+        result = place(snap, ps)
+        assert result["main"].assignment is None
+        assert "lower level" in result["main"].failure
+
+    def test_gate_off_ignores_constraints(self):
+        snap = snap_3level(make_nodes())
+        ps = PodSet(
+            name="main", count=8, requests={"cpu": 1000},
+            topology_request=PodSetTopologyRequest(
+                required=BLOCK,
+                podset_slice_required_topology=RACK,
+                podset_slice_size=4,
+                podset_slice_constraints=[
+                    PodSetSliceConstraint(topology=RACK, size=4),
+                    PodSetSliceConstraint(topology=HOST, size=3),
+                ]))
+        result = place(snap, ps)
+        assert result["main"].assignment is not None

@@ -1,0 +1,134 @@
+"""TAS placement kernel parity: dense per-level tensors vs the host tree.
+
+The jitted placer (solver/tas_kernels.py) must reproduce the host
+TASFlavorSnapshot's placements for single-podset BestFit shapes:
+required / preferred / unconstrained levels, partial capacity, and
+infeasible requests. SURVEY.md §7 step 6.
+"""
+
+import random
+
+import pytest
+
+from kueue_oss_tpu.api.types import Node, PodSet, PodSetTopologyRequest
+from kueue_oss_tpu.solver.tas_kernels import place_podset
+from kueue_oss_tpu.tas.snapshot import (
+    TASPodSetRequest,
+    build_tas_flavor_snapshot,
+)
+
+HOST = "kubernetes.io/hostname"
+BLOCK = "cloud/block"
+RACK = "cloud/rack"
+LEVELS = [BLOCK, RACK, HOST]
+
+
+def make_nodes(blocks, racks, hosts, cpu=4000):
+    nodes = []
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                nodes.append(Node(
+                    name=f"n-{b}-{r}-{h}",
+                    labels={BLOCK: f"b{b}", RACK: f"b{b}-r{r}"},
+                    allocatable={"cpu": cpu}))
+    return nodes
+
+
+def host_place(snap, count, per_pod, level, required=False,
+               unconstrained=False):
+    tr_req = PodSetTopologyRequest(unconstrained=True) if unconstrained \
+        else (PodSetTopologyRequest(required=level) if required
+              else PodSetTopologyRequest(preferred=level))
+    ps = PodSet(name="main", count=count, requests=dict(per_pod),
+                topology_request=tr_req)
+    req = TASPodSetRequest(podset=ps, single_pod_requests=dict(per_pod),
+                           count=count, flavor="default")
+    result = snap.find_topology_assignments([req])
+    ta = result["main"].assignment
+    if ta is None:
+        return None
+    return {tuple(d.values): d.count for d in ta.domains}
+
+
+def kernel_place(snap, count, per_pod, level, required=False,
+                 unconstrained=False):
+    level_idx = (len(LEVELS) - 1 if unconstrained
+                 else LEVELS.index(level))
+    out = place_podset(snap, per_pod, count, level_idx,
+                       required=required, unconstrained=unconstrained)
+    if out is None:
+        return None
+    # leaf ids are full level-value tuples; host emits hostname-only
+    # domains when the lowest level is the hostname
+    return {(leaf[-1],): c for leaf, c in out.items()}
+
+
+CASES = [
+    # (blocks, racks, hosts, count, level, required, unconstrained)
+    (1, 2, 2, 4, RACK, True, False),     # fits one rack exactly
+    (1, 2, 2, 3, RACK, True, False),     # best-fit rack
+    (1, 2, 2, 8, BLOCK, False, False),   # whole block
+    (2, 2, 2, 10, RACK, False, False),   # preferred falls back upward
+    (2, 2, 2, 30, RACK, False, False),   # spans blocks (greedy at top)
+    (1, 2, 2, 2, HOST, True, False),     # single host
+    (1, 2, 2, 5, HOST, True, False),     # more than any host: fails
+    (2, 3, 2, 7, None, False, True),     # unconstrained
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_kernel_matches_host(case):
+    blocks, racks, hosts, count, level, required, unconstrained = case
+    snap = build_tas_flavor_snapshot(
+        "default", LEVELS, make_nodes(blocks, racks, hosts))
+    h = host_place(snap, count, {"cpu": 1000}, level,
+                   required=required, unconstrained=unconstrained)
+    snap2 = build_tas_flavor_snapshot(
+        "default", LEVELS, make_nodes(blocks, racks, hosts))
+    k = kernel_place(snap2, count, {"cpu": 1000}, level,
+                     required=required, unconstrained=unconstrained)
+    if h is None:
+        assert k is None, f"{case}: host infeasible, kernel placed {k}"
+    else:
+        assert k == h, f"{case}: host={h} kernel={k}"
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_randomized_parity(seed):
+    rng = random.Random(3000 + seed)
+    blocks = rng.randint(1, 3)
+    racks = rng.randint(1, 3)
+    hosts = rng.randint(1, 3)
+    nodes = make_nodes(blocks, racks, hosts,
+                       cpu=rng.choice([2000, 4000]))
+    count = rng.randint(1, blocks * racks * hosts * 4)
+    per_pod = {"cpu": rng.choice([500, 1000, 2000])}
+    mode = rng.choice(["required", "preferred", "unconstrained"])
+    level = rng.choice(LEVELS)
+
+    def build():
+        snap = build_tas_flavor_snapshot("default", LEVELS, list(nodes))
+        # partial pre-existing usage on some hosts
+        for n in nodes:
+            if rng.random() < 0.3:
+                snap.add_tas_usage(
+                    (n.labels[BLOCK], n.labels[RACK], n.name),
+                    {"cpu": 1000}, rng.randint(1, 2))
+        return snap
+
+    rng_state = rng.getstate()
+    snap_h = build()
+    rng.setstate(rng_state)
+    snap_k = build()
+
+    h = host_place(snap_h, count, per_pod, level,
+                   required=mode == "required",
+                   unconstrained=mode == "unconstrained")
+    k = kernel_place(snap_k, count, per_pod, level,
+                     required=mode == "required",
+                     unconstrained=mode == "unconstrained")
+    if h is None:
+        assert k is None, (seed, mode, level, count, k)
+    else:
+        assert k == h, (seed, mode, level, count, h, k)

@@ -252,5 +252,10 @@ def test_dashboard_views_and_server():
             f"http://127.0.0.1:{srv.port}/api/overview", timeout=5).read())
         assert data["clusterQueues"][0]["name"] == "cq"
         assert len(data["workloads"]) == 2
+        # the static HTML frontend serves at /
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/", timeout=5).read().decode()
+        assert "<title>kueue-oss-tpu dashboard</title>" in html
+        assert "/api/clusterqueues" in html
     finally:
         srv.stop()
