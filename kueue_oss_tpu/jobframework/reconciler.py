@@ -110,7 +110,7 @@ class JobReconciler:
         podsets = job.pod_sets()
         if wl is None:
             wl = self._create_workload(job, podsets, now)
-        elif not _equivalent(wl, podsets):
+        elif not _equivalent(wl, podsets, running=not job.is_suspended()):
             if wl.is_quota_reserved:
                 # Shape changed under an admitted workload: release quota
                 # and rebuild (the reference stops the job and recreates).
@@ -265,12 +265,27 @@ class JobReconciler:
         return infos
 
 
-def _equivalent(wl: Workload, podsets: list[PodSet]) -> bool:
-    """Shape equality of workload vs job podsets (name/count/requests)."""
+def _equivalent(wl: Workload, podsets: list[PodSet],
+                running: bool = False) -> bool:
+    """Shape equality of workload vs job podsets (name/count/requests).
+
+    For a RUNNING job the expected counts are the ADMITTED counts, not
+    the spec counts: partial admission shrinks the job (parallelism /
+    executor.instances) below the workload's declared podsets, and that
+    must not read as a shape change (reference
+    jobframework/reconciler.go equivalentToWorkload compares against the
+    admission's counts for unsuspended jobs)."""
     if len(wl.podsets) != len(podsets):
         return False
+    admitted_counts = {}
+    if running and wl.status.admission is not None:
+        admitted_counts = {psa.name: psa.count
+                           for psa in wl.status.admission.podset_assignments}
     for a, b in zip(wl.podsets, podsets):
-        if (a.name, a.count, sorted(a.requests.items())) != (
-                b.name, b.count, sorted(b.requests.items())):
+        expect = admitted_counts.get(a.name, a.count)
+        if (a.name, sorted(a.requests.items())) != (
+                b.name, sorted(b.requests.items())):
+            return False
+        if b.count not in (a.count, expect):
             return False
     return True

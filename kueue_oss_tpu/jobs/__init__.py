@@ -10,7 +10,11 @@ from kueue_oss_tpu.jobs.job_set import JobSet, ReplicatedJob
 from kueue_oss_tpu.jobs.pod import PlainPod, PodGroup, PodGroupRole
 from kueue_oss_tpu.jobs.deployment import Deployment
 from kueue_oss_tpu.jobs.stateful_set import StatefulSet
-from kueue_oss_tpu.jobs.leader_worker_set import LeaderWorkerSet
+from kueue_oss_tpu.jobs.leader_worker_set import (
+    LeaderWorkerSet,
+    LeaderWorkerSetReconciler,
+    LWSGroup,
+)
 from kueue_oss_tpu.jobs.mpi_job import MPIJob
 from kueue_oss_tpu.jobs.ray import RayCluster, RayJob, RayService, WorkerGroup
 from kueue_oss_tpu.jobs.kubeflow import (
@@ -21,14 +25,20 @@ from kueue_oss_tpu.jobs.kubeflow import (
     TFJob,
     XGBoostJob,
 )
-from kueue_oss_tpu.jobs.train_job import TrainJob
+from kueue_oss_tpu.jobs.train_job import (
+    TrainingRuntime,
+    TrainJob,
+    runtime_registry,
+)
 from kueue_oss_tpu.jobs.app_wrapper import AppWrapper
-from kueue_oss_tpu.jobs.spark import SparkApplication
+from kueue_oss_tpu.jobs.spark import SparkApplication, SparkRoleSpec
 
 __all__ = [
     "BatchJob", "JobSet", "ReplicatedJob", "PlainPod", "PodGroup",
-    "PodGroupRole", "Deployment", "StatefulSet", "LeaderWorkerSet", "MPIJob",
+    "PodGroupRole", "Deployment", "StatefulSet", "LeaderWorkerSet",
+    "LeaderWorkerSetReconciler", "LWSGroup", "MPIJob",
     "RayCluster", "RayJob", "RayService", "WorkerGroup", "TFJob",
     "PyTorchJob", "XGBoostJob", "PaddleJob", "JAXJob", "ReplicaSpec",
-    "TrainJob", "AppWrapper", "SparkApplication",
+    "TrainJob", "TrainingRuntime", "runtime_registry", "AppWrapper",
+    "SparkApplication", "SparkRoleSpec",
 ]

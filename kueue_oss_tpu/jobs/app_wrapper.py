@@ -1,16 +1,28 @@
 """AppWrapper integration.
 
-Reference parity: pkg/controller/jobs/appwrapper — the wrapper's component
-podsets are concatenated into one workload.
+Reference parity: pkg/controller/jobs/appwrapper/appwrapper_controller.go
+(222 LoC) — an AppWrapper bundles heterogeneous component resources into
+ONE workload: PodSets(): the components' declared podsets are
+concatenated in component order (each component contributes the podsets
+of the resource it wraps), and RunWithPodSetsInfo slices the injected
+infos back to the owning component in the same order. Suspension drives
+the wrapper's own suspend field; the wrapped components inherit it.
+
+Components are either raw shapes `(name, count, requests)` or wrapped
+GenericJob children (their pod_sets() are flattened in, names prefixed
+with the child's name to stay unique across components).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Union
 
 from kueue_oss_tpu.api.types import PodSet
-from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.interface import BaseJob, GenericJob, PodSetInfo
 from kueue_oss_tpu.jobframework.registry import integration_manager
+
+Component = Union[tuple, GenericJob]
 
 
 @integration_manager.register
@@ -18,10 +30,80 @@ from kueue_oss_tpu.jobframework.registry import integration_manager
 class AppWrapper(BaseJob):
     kind = "AppWrapper"
 
-    #: (component name, count, per-pod requests)
-    components: list[tuple[str, int, dict[str, int]]] = field(
-        default_factory=list)
+    #: (component name, count, per-pod requests) | wrapped GenericJob
+    components: list[Component] = field(default_factory=list)
+
+    def _component_podsets(self) -> list[tuple[Component, list[PodSet]]]:
+        out: list[tuple[Component, list[PodSet]]] = []
+        for comp in self.components:
+            if isinstance(comp, tuple):
+                name, count, requests = comp
+                out.append((comp, [PodSet(
+                    name=name, count=count, requests=dict(requests))]))
+            else:
+                prefixed = [PodSet(
+                    name=f"{comp.name}-{ps.name}", count=ps.count,
+                    requests=dict(ps.requests), min_count=ps.min_count,
+                    topology_request=ps.topology_request,
+                    node_selector=dict(ps.node_selector),
+                    tolerations=list(ps.tolerations),
+                ) for ps in comp.pod_sets()]
+                out.append((comp, prefixed))
+        return out
 
     def pod_sets(self) -> list[PodSet]:
-        return [PodSet(name=name, count=count, requests=dict(requests))
-                for name, count, requests in self.components]
+        return [ps for _, podsets in self._component_podsets()
+                for ps in podsets]
+
+    def run_with_podsets_info(self, infos: list[PodSetInfo]) -> None:
+        """Distribute infos back to wrapped children in component order
+        (appwrapper_controller.go RunWithPodSetsInfo)."""
+        super().run_with_podsets_info(infos)
+        i = 0
+        for comp, podsets in self._component_podsets():
+            n = len(podsets)
+            if isinstance(comp, GenericJob):
+                # strip the component prefix so children that match infos
+                # by their own podset names (e.g. Spark's "executor"
+                # partial-admission hook) see the names they emitted
+                prefix = f"{comp.name}-"
+                child_infos = [PodSetInfo(
+                    name=info.name.removeprefix(prefix), count=info.count,
+                    node_selector=dict(info.node_selector),
+                    tolerations=list(info.tolerations),
+                    scheduling_gates=list(info.scheduling_gates),
+                ) for info in infos[i:i + n]]
+                comp.run_with_podsets_info(child_infos)
+            i += n
+
+    def restore_podsets_info(self, infos: list[PodSetInfo]) -> bool:
+        changed = super().restore_podsets_info(infos)
+        for comp in self.components:
+            if isinstance(comp, GenericJob):
+                changed = comp.restore_podsets_info([]) or changed
+        return changed
+
+    def do_suspend(self) -> None:
+        super().do_suspend()
+        for comp in self.components:
+            if isinstance(comp, GenericJob) and not comp.is_suspended():
+                comp.do_suspend()
+
+    def finished(self) -> tuple[str, bool, bool]:
+        children = [c for c in self.components
+                    if isinstance(c, GenericJob)]
+        if children:
+            results = [c.finished() for c in children]
+            if all(done for _, _, done in results):
+                success = all(ok for _, ok, _ in results)
+                return ("all components finished", success, True)
+            if any(done and not ok for _, ok, done in results):
+                return ("component failed", False, True)
+        return super().finished()
+
+    def pods_ready(self) -> bool:
+        children = [c for c in self.components
+                    if isinstance(c, GenericJob)]
+        if children:
+            return all(c.pods_ready() for c in children)
+        return super().pods_ready()

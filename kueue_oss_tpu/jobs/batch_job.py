@@ -1,8 +1,13 @@
 """batch/v1 Job integration.
 
-Reference parity: pkg/controller/jobs/job/job_controller.go — one "main"
-podset sized by parallelism; partial admission maps to min_parallelism
-(KEP-420, the reference's minimum parallelism annotation).
+Reference parity: pkg/controller/jobs/job/job_controller.go (381 LoC) —
+one "main" podset sized by parallelism; partial admission maps to
+min_parallelism (KEP-420, the reference's minimum parallelism
+annotation) and RunWithPodSetsInfo shrinks parallelism to the admitted
+count; ReclaimablePods releases the seats that completions math proves
+will never be needed again (:213-227); PodsReady counts succeeded +
+ready against parallelism (:322-329); Finished follows the
+Complete/Failed job conditions (:312-320).
 """
 
 from __future__ import annotations
@@ -29,6 +34,8 @@ class BatchJob(BaseJob):
     #: minimum parallelism acceptable for partial admission (KEP-420)
     min_parallelism: Optional[int] = None
     topology_request: Optional[PodSetTopologyRequest] = None
+    #: live status counters (job.Status)
+    succeeded: int = 0
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(
@@ -47,3 +54,26 @@ class BatchJob(BaseJob):
         # (job_controller.go RunWithPodSetsInfo).
         if infos and infos[0].count:
             self.parallelism = infos[0].count
+
+    def reclaimable_pods(self) -> dict[str, int]:
+        """job_controller.go:213-227: once remaining completions drop
+        below parallelism, the surplus seats are reclaimable."""
+        if self.parallelism == 1 or self.succeeded == 0:
+            return {}
+        remaining = (self.completions or self.parallelism) - self.succeeded
+        if remaining >= self.parallelism:
+            return {}
+        return {"main": self.parallelism - max(remaining, 0)}
+
+    def pods_ready(self) -> bool:
+        """job_controller.go:322-329."""
+        return self.succeeded + self.ready_pods >= self.parallelism
+
+    def mark_succeeded(self, n: int = 1) -> None:
+        """Simulator helper: n more pods completed successfully."""
+        self.succeeded += n
+        self.active_pods = max(self.active_pods - n, 0)
+        self.ready_pods = max(self.ready_pods - n, 0)
+        target = self.completions or self.parallelism
+        if self.succeeded >= target:
+            self.mark_finished(success=True, message="JobComplete")

@@ -1,7 +1,16 @@
 """Deployment integration (serving workloads).
 
-Reference parity: pkg/controller/jobs/deployment — a Deployment's pods are
-admitted as a single podset sized by replicas.
+Reference parity: pkg/controller/jobs/deployment — webhook-only in the
+reference: the Deployment webhook propagates the queue-name label onto
+the pod template (deployment_webhook.go Default), and each replica pod
+is then admitted INDIVIDUALLY as a plain single-pod workload through the
+pod integration (serving semantics: replicas admit and preempt
+independently; rolling updates surge pods just queue as new singletons).
+
+The `Deployment` dataclass keeps a GenericJob form (one replicas-sized
+podset) for aggregate quota views, and `expand_pods()` produces the
+per-replica singleton pods matching the reference's actual admission
+unit.
 """
 
 from __future__ import annotations
@@ -11,6 +20,7 @@ from dataclasses import dataclass, field
 from kueue_oss_tpu.api.types import PodSet
 from kueue_oss_tpu.jobframework.interface import BaseJob
 from kueue_oss_tpu.jobframework.registry import integration_manager
+from kueue_oss_tpu.jobs.pod import Pod
 
 
 @integration_manager.register
@@ -20,7 +30,29 @@ class Deployment(BaseJob):
 
     replicas: int = 1
     requests: dict[str, int] = field(default_factory=dict)
+    #: live status
+    ready_replicas: int = 0
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(name="main", count=self.replicas,
                        requests=dict(self.requests))]
+
+    def pods_ready(self) -> bool:
+        return self.ready_replicas >= self.replicas
+
+    def mark_running(self, ready: bool = True) -> None:
+        super().mark_running(ready=ready)
+        self.ready_replicas = self.replicas if ready else 0
+
+    def expand_pods(self) -> list[Pod]:
+        """Per-replica singleton pods (deployment_webhook.go Default
+        stamps the queue label; no pod-group labels — each pod is its
+        own workload)."""
+        return [Pod(
+            name=f"{self.name}-{i}",
+            namespace=self.namespace,
+            queue_name=self.queue_name,
+            requests=dict(self.requests),
+            priority=self.priority,
+            creation_time=self.creation_time,
+        ) for i in range(self.replicas)]

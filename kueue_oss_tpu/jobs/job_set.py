@@ -1,7 +1,12 @@
 """JobSet integration.
 
-Reference parity: pkg/controller/jobs/jobset/jobset_controller.go — one
-podset per replicated job, count = replicas * parallelism.
+Reference parity: pkg/controller/jobs/jobset/jobset_controller.go (245
+LoC) — one podset per replicated job, count = replicas * parallelism;
+PodsReady when every replicated job's ready+succeeded replicas reach its
+declared replicas (:178-188); ReclaimablePods releases whole replicated
+jobs as they succeed (:190-205); Finished follows the
+JobSetCompleted/JobSetFailed conditions (:168-176); RunWithPodSetsInfo
+merges admission node selectors per replicated job template.
 """
 
 from __future__ import annotations
@@ -10,7 +15,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from kueue_oss_tpu.api.types import PodSet, PodSetTopologyRequest
-from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.interface import BaseJob, PodSetInfo
 from kueue_oss_tpu.jobframework.registry import integration_manager
 
 
@@ -21,6 +26,10 @@ class ReplicatedJob:
     parallelism: int = 1
     requests: dict[str, int] = field(default_factory=dict)
     topology_request: Optional[PodSetTopologyRequest] = None
+    node_selector: dict[str, str] = field(default_factory=dict)
+    #: live status (jobset ReplicatedJobStatus)
+    ready_replicas: int = 0
+    succeeded_replicas: int = 0
 
 
 @integration_manager.register
@@ -36,4 +45,52 @@ class JobSet(BaseJob):
             count=rj.replicas * rj.parallelism,
             requests=dict(rj.requests),
             topology_request=rj.topology_request,
+            node_selector=dict(rj.node_selector),
         ) for rj in self.replicated_jobs]
+
+    def run_with_podsets_info(self, infos: list[PodSetInfo]) -> None:
+        if len(infos) != len(self.replicated_jobs):
+            raise ValueError(
+                f"expected {len(self.replicated_jobs)} podset infos, "
+                f"got {len(infos)}")
+        super().run_with_podsets_info(infos)
+        # keep the FIRST (pristine) selectors across re-injections (the
+        # elastic slice takeover calls this again while running)
+        if getattr(self, "_saved_selectors", None) is None:
+            self._saved_selectors = [dict(rj.node_selector)
+                                     for rj in self.replicated_jobs]
+        for rj, info in zip(self.replicated_jobs, infos):
+            rj.node_selector.update(info.node_selector)
+
+    def restore_podsets_info(self, infos: list[PodSetInfo]) -> bool:
+        changed = super().restore_podsets_info(infos)
+        saved = getattr(self, "_saved_selectors", None)
+        if saved:
+            for rj, sel in zip(self.replicated_jobs, saved):
+                rj.node_selector = dict(sel)
+            self._saved_selectors = None
+        return changed
+
+    def pods_ready(self) -> bool:
+        """jobset_controller.go:178-188."""
+        return all(rj.ready_replicas + rj.succeeded_replicas >= rj.replicas
+                   for rj in self.replicated_jobs)
+
+    def reclaimable_pods(self) -> dict[str, int]:
+        """jobset_controller.go:190-205: succeeded replicas of a
+        replicated job free their parallelism-sized share."""
+        out = {}
+        for rj in self.replicated_jobs:
+            if 0 < rj.succeeded_replicas <= rj.replicas:
+                out[rj.name] = rj.succeeded_replicas * rj.parallelism
+        return out
+
+    def mark_running(self, ready: bool = True) -> None:
+        super().mark_running(ready=ready)
+        for rj in self.replicated_jobs:
+            rj.ready_replicas = rj.replicas if ready else 0
+
+    def do_suspend(self) -> None:
+        super().do_suspend()
+        for rj in self.replicated_jobs:
+            rj.ready_replicas = 0

@@ -1,65 +1,170 @@
 """Kubeflow training-operator integrations.
 
-Reference parity: pkg/controller/jobs/kubeflow/jobs/{tfjob,pytorchjob,
-xgboostjob,paddlejob,jaxjob} — one podset per replica spec role, ordered
-with the master/chief role first (kubeflowjob.go OrderedReplicaTypes).
+Reference parity: pkg/controller/jobs/kubeflow/kubeflowjob/
+kubeflowjob_controller.go (shared KubeflowJob control, 240 LoC) plus the
+per-framework glue in pkg/controller/jobs/kubeflow/jobs/{tfjob,pytorchjob,
+xgboostjob,paddlejob,jaxjob}. Semantics carried over:
+
+- podsets are built in the framework's canonical replica-type order
+  (OrderedReplicaTypes, kubeflowjob_controller.go:174-181); replica types
+  absent from the spec are dropped, the remainder keeps canonical order;
+- workload priority class resolves runPolicy.schedulingPolicy first, then
+  the first replica type that sets one
+  (kubeflowjob_controller.go:153-171);
+- RunWithPodSetsInfo merges the admission node selectors into each
+  replica template in the same order and rejects a length mismatch
+  (kubeflowjob_controller.go:57-75); RestorePodSetsInfo undoes it;
+- PodsReady requires every replica type's ready count to reach its
+  declared replicas (kubeflowjob_controller.go:133-151).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
-from kueue_oss_tpu.api.types import PodSet
-from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.api.types import PodSet, PodSetTopologyRequest, Toleration
+from kueue_oss_tpu.jobframework.interface import BaseJob, PodSetInfo
 from kueue_oss_tpu.jobframework.registry import integration_manager
 
 
 @dataclass
 class ReplicaSpec:
-    role: str  # e.g. "Master", "Worker", "PS", "Chief"
+    """One replica type's template (kftraining ReplicaSpec analog)."""
+
+    role: str  # e.g. "Master", "Worker", "PS", "Chief", "Launcher"
     replicas: int = 1
     requests: dict[str, int] = field(default_factory=dict)
+    priority_class: Optional[str] = None
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: list[Toleration] = field(default_factory=list)
+    topology_request: Optional[PodSetTopologyRequest] = None
+    #: live status (maintained by the simulator / tests)
+    ready_replicas: int = 0
 
 
+#: fallback rank for roles outside a framework's canonical order
 _ROLE_ORDER = {"Master": 0, "Chief": 0, "Launcher": 0}
 
 
 @dataclass
 class _KubeflowJob(BaseJob):
+    """Shared control for the training-operator job kinds.
+
+    Subclasses fix `kind` and `replica_order` (the framework's canonical
+    replica-type sequence). `replica_specs` keeps list form for API
+    stability; ordering always resolves through `ordered_replica_specs`.
+    """
+
+    #: canonical replica-type order; () = master-ish roles first, by name
+    replica_order: tuple[str, ...] = ()
+
     replica_specs: list[ReplicaSpec] = field(default_factory=list)
+    #: runPolicy.schedulingPolicy.priorityClass
+    scheduling_priority_class: Optional[str] = None
+
+    def ordered_replica_specs(self) -> list[ReplicaSpec]:
+        if self.replica_order:
+            rank = {t: i for i, t in enumerate(self.replica_order)}
+            key = lambda rs: (rank.get(rs.role, len(rank)), rs.role)
+        else:
+            key = lambda rs: (_ROLE_ORDER.get(rs.role, 1), rs.role)
+        return sorted(self.replica_specs, key=key)
+
+    def effective_priority_class(self) -> Optional[str]:
+        """kubeflowjob_controller.go:161-171 PriorityClass()."""
+        if self.scheduling_priority_class:
+            return self.scheduling_priority_class
+        for rs in self.ordered_replica_specs():
+            if rs.priority_class:
+                return rs.priority_class
+        return None
 
     def pod_sets(self) -> list[PodSet]:
-        ordered = sorted(self.replica_specs,
-                         key=lambda rs: (_ROLE_ORDER.get(rs.role, 1), rs.role))
-        return [PodSet(name=rs.role.lower(), count=rs.replicas,
-                       requests=dict(rs.requests)) for rs in ordered]
+        return [PodSet(
+            name=rs.role.lower(),
+            count=rs.replicas,
+            requests=dict(rs.requests),
+            node_selector=dict(rs.node_selector),
+            tolerations=list(rs.tolerations),
+            topology_request=rs.topology_request,
+        ) for rs in self.ordered_replica_specs()]
+
+    def run_with_podsets_info(self, infos: list[PodSetInfo]) -> None:
+        ordered = self.ordered_replica_specs()
+        if len(infos) != len(ordered):
+            raise ValueError(
+                f"expected {len(ordered)} podset infos, got {len(infos)}")
+        super().run_with_podsets_info(infos)
+        # keep the FIRST (pristine) selectors across re-injections (the
+        # elastic slice takeover calls this again while running)
+        if getattr(self, "_saved_selectors", None) is None:
+            self._saved_selectors = {
+                rs.role: dict(rs.node_selector) for rs in ordered}
+        for rs, info in zip(ordered, infos):
+            rs.node_selector.update(info.node_selector)
+
+    def restore_podsets_info(self, infos: list[PodSetInfo]) -> bool:
+        changed = super().restore_podsets_info(infos)
+        saved = getattr(self, "_saved_selectors", None)
+        if saved:
+            for rs in self.replica_specs:
+                if rs.role in saved:
+                    rs.node_selector = dict(saved[rs.role])
+            self._saved_selectors = None
+        return changed
+
+    def pods_ready(self) -> bool:
+        return all(rs.ready_replicas >= rs.replicas
+                   for rs in self.replica_specs)
+
+    # -- simulator helpers --------------------------------------------------
+
+    def mark_running(self, ready: bool = True) -> None:
+        super().mark_running(ready=ready)
+        for rs in self.replica_specs:
+            rs.ready_replicas = rs.replicas if ready else 0
+
+    def do_suspend(self) -> None:
+        super().do_suspend()
+        for rs in self.replica_specs:
+            rs.ready_replicas = 0
 
 
 @integration_manager.register
 @dataclass
 class TFJob(_KubeflowJob):
+    """tfjob_controller.go OrderedReplicaTypes: Chief, Master, PS,
+    Worker (then Evaluator)."""
+
     kind = "TFJob"
+    replica_order: tuple[str, ...] = (
+        "Chief", "Master", "PS", "Worker", "Evaluator")
 
 
 @integration_manager.register
 @dataclass
 class PyTorchJob(_KubeflowJob):
     kind = "PyTorchJob"
+    replica_order: tuple[str, ...] = ("Master", "Worker")
 
 
 @integration_manager.register
 @dataclass
 class XGBoostJob(_KubeflowJob):
     kind = "XGBoostJob"
+    replica_order: tuple[str, ...] = ("Master", "Worker")
 
 
 @integration_manager.register
 @dataclass
 class PaddleJob(_KubeflowJob):
     kind = "PaddleJob"
+    replica_order: tuple[str, ...] = ("Master", "Worker")
 
 
 @integration_manager.register
 @dataclass
 class JAXJob(_KubeflowJob):
     kind = "JAXJob"
+    replica_order: tuple[str, ...] = ("Worker",)

@@ -1,27 +1,49 @@
 """LeaderWorkerSet integration.
 
-Reference parity: pkg/controller/jobs/leaderworkerset — per replica group:
-one leader pod + (size-1) workers; modeled as two podsets across replicas.
+Reference parity: pkg/controller/jobs/leaderworkerset/
+leaderworkerset_reconciler.go (454 LoC) — unlike the GenericJob kinds,
+an LWS is served by a CUSTOM reconciler that maintains ONE WORKLOAD PER
+REPLICA GROUP: group i gets workload "<name>-<i>" with a leader podset
+(count 1) and, when size > 1, a worker podset (count size-1)
+(:leaderPodSetName/workerPodSetName). Scaling replicas up creates the
+missing group workloads; scaling down deletes the orphaned ones
+(filterWorkloads → toCreate/toUpdate/toDelete, :140-170). Each group's
+pods are gated/ungated with its own workload's admission, so groups
+admit independently.
+
+The aggregated `LeaderWorkerSet` dataclass is the spec object; the
+`LWSGroup` jobs it expands into are what flow through the generic
+JobReconciler (the reference analog builds Workloads directly; routing
+through the job framework keeps eviction/suspend semantics shared).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
-from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.api.types import PodSet, PodSetTopologyRequest
 from kueue_oss_tpu.jobframework.interface import BaseJob
 from kueue_oss_tpu.jobframework.registry import integration_manager
+
+#: reference label set on every pod of a group (lwsNameLabel)
+LWS_NAME_LABEL = "leaderworkerset.sigs.k8s.io/name"
+GROUP_INDEX_LABEL = "leaderworkerset.sigs.k8s.io/group-index"
 
 
 @integration_manager.register
 @dataclass
 class LeaderWorkerSet(BaseJob):
+    """The LWS spec. `pod_sets()` gives the aggregate shape (used for
+    quota summaries); admission flows through per-group LWSGroup jobs."""
+
     kind = "LeaderWorkerSet"
 
     replicas: int = 1
     size: int = 1  # pods per replica group (leader + workers)
     leader_requests: dict[str, int] = field(default_factory=dict)
     worker_requests: dict[str, int] = field(default_factory=dict)
+    topology_request: Optional[PodSetTopologyRequest] = None
 
     def pod_sets(self) -> list[PodSet]:
         podsets = [PodSet(name="leader", count=self.replicas,
@@ -32,3 +54,92 @@ class LeaderWorkerSet(BaseJob):
                 name="workers", count=self.replicas * workers_per_group,
                 requests=dict(self.worker_requests)))
         return podsets
+
+    def group_pod_sets(self) -> list[PodSet]:
+        """One group's shape (leaderworkerset_reconciler.go podsets)."""
+        podsets = [PodSet(name="leader", count=1,
+                          requests=dict(self.leader_requests),
+                          topology_request=self.topology_request)]
+        if self.size > 1:
+            podsets.append(PodSet(
+                name="workers", count=self.size - 1,
+                requests=dict(self.worker_requests),
+                topology_request=self.topology_request))
+        return podsets
+
+
+@integration_manager.register
+@dataclass
+class LWSGroup(BaseJob):
+    """One replica group of a LeaderWorkerSet — the unit of admission."""
+
+    kind = "LWSGroup"
+
+    group_index: int = 0
+    podsets: list[PodSet] = field(default_factory=list)
+
+    def pod_sets(self) -> list[PodSet]:
+        return list(self.podsets)
+
+
+class LeaderWorkerSetReconciler:
+    """Expands LWS specs into per-group jobs and keeps them in step with
+    spec.replicas (leaderworkerset_reconciler.go Reconcile)."""
+
+    def __init__(self, reconciler) -> None:
+        self.reconciler = reconciler  # the generic JobReconciler
+        self.sets: dict[str, LeaderWorkerSet] = {}
+
+    def upsert(self, lws: LeaderWorkerSet) -> None:
+        self.sets[lws.key] = lws
+
+    def delete(self, key: str, now: float = 0.0) -> None:
+        lws = self.sets.pop(key, None)
+        if lws is None:
+            return
+        # delete the ACTUALLY managed groups, not the current spec's
+        # replica range — a pre-delete scale-down must not leak groups
+        for job in self.groups_of(lws):
+            self.reconciler.delete_job(job, now=now)
+
+    def _groups(self, lws: LeaderWorkerSet) -> list[LWSGroup]:
+        return [LWSGroup(
+            name=f"{lws.name}-{i}", namespace=lws.namespace,
+            queue_name=lws.queue_name, priority=lws.priority,
+            creation_time=lws.creation_time, group_index=i,
+            labels={LWS_NAME_LABEL: lws.name, GROUP_INDEX_LABEL: str(i)},
+            podsets=lws.group_pod_sets(),
+        ) for i in range(lws.replicas)]
+
+    def reconcile(self, now: float) -> None:
+        for lws in self.sets.values():
+            wanted = {j.key: j for j in self._groups(lws)}
+            # existing groups of this LWS under management
+            existing = {
+                key: job for (kind, key), job in self.reconciler.jobs.items()
+                if kind == "LWSGroup"
+                and job.labels.get(LWS_NAME_LABEL) == lws.name
+                and job.namespace == lws.namespace}
+            # toDelete: scale-down removed the group (reconciler.go:168)
+            for key, job in existing.items():
+                if key not in wanted:
+                    self.reconciler.delete_job(job, now=now)
+            # toCreate/toUpdate (reconciler.go:151-166): new groups enter
+            # management; existing ones refresh their podset shape so a
+            # size/requests change rebuilds the group workload
+            for key, job in wanted.items():
+                cur = existing.get(key)
+                if cur is None:
+                    self.reconciler.upsert_job(job)
+                else:
+                    cur.podsets = job.podsets
+                    cur.queue_name = lws.queue_name
+        self.reconciler.reconcile_all(now)
+
+    def groups_of(self, lws: LeaderWorkerSet) -> list[LWSGroup]:
+        """Managed group jobs for an LWS, by group index."""
+        out = [job for (kind, _), job in self.reconciler.jobs.items()
+               if kind == "LWSGroup"
+               and job.labels.get(LWS_NAME_LABEL) == lws.name
+               and job.namespace == lws.namespace]
+        return sorted(out, key=lambda j: j.group_index)

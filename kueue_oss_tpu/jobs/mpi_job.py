@@ -1,14 +1,22 @@
 """MPIJob integration.
 
-Reference parity: pkg/controller/jobs/mpijob — launcher + worker podsets.
+Reference parity: pkg/controller/jobs/mpijob/mpijob_controller.go (238
+LoC) — Launcher + Worker podsets in that order (:223-228), priority class
+from runPolicy.schedulingPolicy, then the Launcher template, then the
+Worker template (:178-190), and the kubeflow-style podset-info
+merge/restore. `run_launcher_as_worker` mirrors the MPIJob v2
+runLauncherAsWorker spec field: the launcher participates in the
+computation, so its podset carries the worker resource shape when it has
+no explicit requests.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
-from kueue_oss_tpu.api.types import PodSet
-from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.api.types import PodSet, PodSetTopologyRequest
+from kueue_oss_tpu.jobframework.interface import BaseJob, PodSetInfo
 from kueue_oss_tpu.jobframework.registry import integration_manager
 
 
@@ -20,11 +28,52 @@ class MPIJob(BaseJob):
     launcher_requests: dict[str, int] = field(default_factory=dict)
     worker_count: int = 1
     worker_requests: dict[str, int] = field(default_factory=dict)
+    #: MPIJob v2 spec.runLauncherAsWorker
+    run_launcher_as_worker: bool = False
+    launcher_priority_class: Optional[str] = None
+    worker_priority_class: Optional[str] = None
+    scheduling_priority_class: Optional[str] = None
+    worker_topology_request: Optional[PodSetTopologyRequest] = None
+    #: live status
+    ready_launchers: int = 0
+    ready_workers: int = 0
+
+    def effective_priority_class(self) -> Optional[str]:
+        """mpijob_controller.go:178-190 PriorityClass()."""
+        return (self.scheduling_priority_class
+                or self.launcher_priority_class
+                or self.worker_priority_class)
 
     def pod_sets(self) -> list[PodSet]:
-        return [
-            PodSet(name="launcher", count=1,
-                   requests=dict(self.launcher_requests)),
-            PodSet(name="worker", count=self.worker_count,
-                   requests=dict(self.worker_requests)),
-        ]
+        launcher_requests = dict(self.launcher_requests)
+        if self.run_launcher_as_worker and not launcher_requests:
+            launcher_requests = dict(self.worker_requests)
+        sets = [PodSet(name="launcher", count=1,
+                       requests=launcher_requests)]
+        if self.worker_count > 0:
+            sets.append(PodSet(
+                name="worker", count=self.worker_count,
+                requests=dict(self.worker_requests),
+                topology_request=self.worker_topology_request))
+        return sets
+
+    def run_with_podsets_info(self, infos: list[PodSetInfo]) -> None:
+        expected = 1 + (1 if self.worker_count > 0 else 0)
+        if len(infos) != expected:
+            raise ValueError(
+                f"expected {expected} podset infos, got {len(infos)}")
+        super().run_with_podsets_info(infos)
+
+    def pods_ready(self) -> bool:
+        return (self.ready_launchers >= 1
+                and self.ready_workers >= self.worker_count)
+
+    def mark_running(self, ready: bool = True) -> None:
+        super().mark_running(ready=ready)
+        self.ready_launchers = 1 if ready else 0
+        self.ready_workers = self.worker_count if ready else 0
+
+    def do_suspend(self) -> None:
+        super().do_suspend()
+        self.ready_launchers = 0
+        self.ready_workers = 0

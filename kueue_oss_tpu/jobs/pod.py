@@ -211,7 +211,7 @@ class PodGroupController:
             job = PlainPod(
                 name=pod.name, namespace=pod.namespace,
                 queue_name=pod.queue_name, requests=dict(pod.requests),
-                creation_time=pod.creation_time)
+                priority=pod.priority, creation_time=pod.creation_time)
             self.reconciler.upsert_job(job)
         if pod.phase == SUCCEEDED:
             job.mark_finished(success=True)
@@ -274,6 +274,7 @@ class PodGroupController:
             job = PodGroup(
                 name=name, namespace=ns,
                 queue_name=next(p.queue_name for p in seated),
+                priority=max(p.priority for p in seated),
                 roles=self._roles(seated), total_count=total,
                 creation_time=oldest)
             self._groups[(ns, name)] = job

@@ -1,16 +1,40 @@
 """Ray integrations: RayJob, RayCluster, RayService.
 
-Reference parity: pkg/controller/jobs/{rayjob,raycluster,rayservice} —
-head podset + one podset per worker group.
+Reference parity:
+- pkg/controller/jobs/raycluster/common.go BuildPodSets (:55-100): head
+  podset (count 1) + one podset per worker group with
+  count = replicas * numOfHosts (:78-83);
+- common.go UpdatePodSets (:102-160): with in-tree autoscaling enabled,
+  worker counts track the LIVE cluster's replicas (the autoscaler owns
+  the replica count; kueue admits what is actually running), and
+  autoscaling without workload slices is rejected at the webhook
+  (:208-216, raycluster_webhook.go);
+- pkg/controller/jobs/rayjob/rayjob_controller.go: a submitter podset is
+  appended when submissionMode=K8sJobMode (:305-330; default submitter
+  requests 500m CPU / 200Mi, :276-300); jobs with a clusterSelector are
+  skipped — not managed by kueue (:155-159); Finished maps
+  JobDeploymentStatus Complete/Failed (:246-251); PodsReady is the
+  cluster reaching Ready state (:253-255);
+- pkg/controller/jobs/rayservice/rayservice_controller.go: podsets from
+  the service's cluster spec; ready when the service is Running.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
-from kueue_oss_tpu.api.types import PodSet
+from kueue_oss_tpu.api.types import PodSet, PodSetTopologyRequest
 from kueue_oss_tpu.jobframework.interface import BaseJob
 from kueue_oss_tpu.jobframework.registry import integration_manager
+
+#: default submitter-job requests (rayjob_controller.go:276-300, the
+#: kuberay default submitter template) in canonical units
+DEFAULT_SUBMITTER_REQUESTS = {"cpu": 500, "memory": 200 * 1024 * 1024}
+
+#: RayJob submission modes (rayv1.JobSubmissionMode)
+K8S_JOB_MODE = "K8sJobMode"
+HTTP_MODE = "HTTPMode"
 
 
 @dataclass
@@ -18,20 +42,60 @@ class WorkerGroup:
     name: str
     replicas: int = 1
     requests: dict[str, int] = field(default_factory=dict)
+    #: TPU/multi-host groups run numOfHosts pods per replica
+    num_of_hosts: int = 1
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    #: autoscaler-owned live replica count (None = not yet scaled)
+    live_replicas: Optional[int] = None
+    topology_request: Optional[PodSetTopologyRequest] = None
+
+    def count(self, autoscaling: bool) -> int:
+        """common.go:78-83 + UpdatePodSets:141-149."""
+        replicas = self.replicas
+        if autoscaling and self.live_replicas is not None:
+            # the autoscaler owns the count, but never beyond the
+            # group's declared bounds
+            replicas = self.live_replicas
+            if self.max_replicas is not None:
+                replicas = min(replicas, self.max_replicas)
+            if self.min_replicas is not None:
+                replicas = max(replicas, self.min_replicas)
+        return replicas * max(self.num_of_hosts, 1)
 
 
 @dataclass
 class _RayBase(BaseJob):
     head_requests: dict[str, int] = field(default_factory=dict)
     worker_groups: list[WorkerGroup] = field(default_factory=list)
+    #: rayClusterSpec.enableInTreeAutoscaling
+    autoscaling: bool = False
+    #: live RayCluster state ("", "Ready", ...)
+    cluster_state: str = ""
 
-    def pod_sets(self) -> list[PodSet]:
+    def cluster_pod_sets(self) -> list[PodSet]:
         podsets = [PodSet(name="head", count=1,
                           requests=dict(self.head_requests))]
-        podsets.extend(PodSet(name=wg.name, count=wg.replicas,
-                              requests=dict(wg.requests))
-                       for wg in self.worker_groups)
+        podsets.extend(PodSet(
+            name=wg.name, count=wg.count(self.autoscaling),
+            requests=dict(wg.requests),
+            topology_request=wg.topology_request)
+            for wg in self.worker_groups)
         return podsets
+
+    def pod_sets(self) -> list[PodSet]:
+        return self.cluster_pod_sets()
+
+    def pods_ready(self) -> bool:
+        return self.cluster_state == "Ready"
+
+    def mark_running(self, ready: bool = True) -> None:
+        super().mark_running(ready=ready)
+        self.cluster_state = "Ready" if ready else ""
+
+    def do_suspend(self) -> None:
+        super().do_suspend()
+        self.cluster_state = ""
 
 
 @integration_manager.register
@@ -39,14 +103,56 @@ class _RayBase(BaseJob):
 class RayJob(_RayBase):
     kind = "RayJob"
 
+    submission_mode: str = HTTP_MODE
+    submitter_requests: dict[str, int] = field(default_factory=dict)
+    #: non-empty = references an existing cluster; kueue skips the job
+    #: (rayjob_controller.go:155-159 Skip())
+    cluster_selector: dict[str, str] = field(default_factory=dict)
+    #: live status (rayv1.JobDeploymentStatus)
+    deployment_status: str = "New"
+    job_status: str = ""
+
+    def skip(self) -> bool:
+        return bool(self.cluster_selector)
+
+    def pod_sets(self) -> list[PodSet]:
+        podsets = self.cluster_pod_sets()
+        if self.submission_mode == K8S_JOB_MODE:
+            podsets.append(PodSet(
+                name="submitter", count=1,
+                requests=dict(self.submitter_requests
+                              or DEFAULT_SUBMITTER_REQUESTS)))
+        return podsets
+
+    def finished(self) -> tuple[str, bool, bool]:
+        if self.deployment_status in ("Complete", "Failed"):
+            return (self.finish_message,
+                    self.job_status == "SUCCEEDED", True)
+        return super().finished()
+
+    def is_active(self) -> bool:
+        # rayjob_controller.go:146-149: no pods while Suspended or New
+        return self.deployment_status not in ("Suspended", "New")
+
 
 @integration_manager.register
 @dataclass
 class RayCluster(_RayBase):
     kind = "RayCluster"
 
+    def finished(self) -> tuple[str, bool, bool]:
+        # a bare cluster never self-terminates (raycluster_controller.go
+        # Finished always false until deletion)
+        return self.finish_message, self.finish_success, self.is_finished
+
 
 @integration_manager.register
 @dataclass
 class RayService(_RayBase):
     kind = "RayService"
+
+    #: live status (rayservice ServiceStatus)
+    service_status: str = ""
+
+    def pods_ready(self) -> bool:
+        return self.service_status == "Running" or super().pods_ready()

@@ -1,16 +1,63 @@
 """SparkApplication integration.
 
-Reference parity: pkg/controller/jobs/sparkapplication — driver + executor
-podsets.
+Reference parity: pkg/controller/jobs/sparkapplication/ — the controller
+(322 LoC) + podset builder (498 LoC) + webhook (192 LoC):
+
+- podsets are driver (count 1) + executor (count =
+  spec.executor.instances, sparkapplication_podset.go:52-54 /
+  sparkapplication_controller.go:140);
+- per-role resources derive from the Spark resource model
+  (mutateSparkPod: cores → cpu request, memory + memoryOverhead →
+  memory request, GPU quantity onto the gpu resource name,
+  sparkapplication_podset.go:343-500) — `effective_requests` mirrors
+  that derivation when the spark-style fields are used, while raw
+  `*_requests` dicts pass through untouched;
+- dynamic allocation is REJECTED at the webhook: kueue cannot manage a
+  fleet the spark operator resizes on its own
+  (sparkapplication_webhook.go:129-134);
+- partial admission writes the admitted count back to
+  executor.instances (sparkapplication_controller.go:281).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
 from kueue_oss_tpu.api.types import PodSet
-from kueue_oss_tpu.jobframework.interface import BaseJob
+from kueue_oss_tpu.jobframework.interface import BaseJob, PodSetInfo
 from kueue_oss_tpu.jobframework.registry import integration_manager
+
+MIB = 1024 * 1024
+
+#: default memoryOverheadFactor when unset (spark-operator defaults)
+DEFAULT_MEMORY_OVERHEAD_FACTOR = 0.1
+MIN_MEMORY_OVERHEAD = 384 * MIB
+
+
+@dataclass
+class SparkRoleSpec:
+    """Spark driver/executor resource model (sparkv1beta2 SparkPodSpec)."""
+
+    cores: Optional[int] = None          # whole cores → cpu milli
+    memory_mib: Optional[int] = None     # spark memory string, in MiB
+    memory_overhead_mib: Optional[int] = None
+    gpu_name: Optional[str] = None
+    gpu_quantity: int = 0
+
+    def requests(self, overhead_factor: float) -> dict[str, int]:
+        out: dict[str, int] = {}
+        if self.cores is not None:
+            out["cpu"] = self.cores * 1000
+        if self.memory_mib is not None:
+            overhead = self.memory_overhead_mib
+            if overhead is None:
+                overhead = max(int(self.memory_mib * overhead_factor),
+                               MIN_MEMORY_OVERHEAD // MIB)
+            out["memory"] = (self.memory_mib + overhead) * MIB
+        if self.gpu_name and self.gpu_quantity:
+            out[self.gpu_name] = self.gpu_quantity
+        return out
 
 
 @integration_manager.register
@@ -21,11 +68,65 @@ class SparkApplication(BaseJob):
     driver_requests: dict[str, int] = field(default_factory=dict)
     executor_instances: int = 1
     executor_requests: dict[str, int] = field(default_factory=dict)
+    #: spark-style resource specs (used when the raw dicts are empty)
+    driver_spec: Optional[SparkRoleSpec] = None
+    executor_spec: Optional[SparkRoleSpec] = None
+    memory_overhead_factor: float = DEFAULT_MEMORY_OVERHEAD_FACTOR
+    #: spec.dynamicAllocation.enabled — invalid under kueue management
+    dynamic_allocation: bool = False
+    #: live status (sparkv1beta2 ApplicationStateType)
+    application_state: str = ""
+
+    def effective_requests(self, role: str) -> dict[str, int]:
+        raw = self.driver_requests if role == "driver" \
+            else self.executor_requests
+        if raw:
+            return dict(raw)
+        spec = self.driver_spec if role == "driver" else self.executor_spec
+        if spec is not None:
+            return spec.requests(self.memory_overhead_factor)
+        return {}
 
     def pod_sets(self) -> list[PodSet]:
         return [
             PodSet(name="driver", count=1,
-                   requests=dict(self.driver_requests)),
+                   requests=self.effective_requests("driver")),
             PodSet(name="executor", count=self.executor_instances,
-                   requests=dict(self.executor_requests)),
+                   requests=self.effective_requests("executor")),
         ]
+
+    def validate(self) -> list[str]:
+        """sparkapplication_webhook.go:129-134."""
+        if self.dynamic_allocation:
+            return ["spec.dynamicAllocation.enabled must be false: kueue "
+                    "cannot manage dynamically allocated executors"]
+        return []
+
+    def run_with_podsets_info(self, infos: list[PodSetInfo]) -> None:
+        super().run_with_podsets_info(infos)
+        # partial admission shrinks executor.instances
+        # (sparkapplication_controller.go:281); keep the spec value so
+        # RestorePodSetsInfo can undo the shrink after eviction
+        if getattr(self, "_spec_instances", None) is None:
+            self._spec_instances = self.executor_instances
+        for info in infos:
+            if info.name == "executor" and info.count:
+                self.executor_instances = info.count
+
+    def restore_podsets_info(self, infos: list[PodSetInfo]) -> bool:
+        changed = super().restore_podsets_info(infos)
+        saved = getattr(self, "_spec_instances", None)
+        if saved is not None:
+            changed = changed or saved != self.executor_instances
+            self.executor_instances = saved
+            self._spec_instances = None
+        return changed
+
+    def finished(self) -> tuple[str, bool, bool]:
+        if self.application_state in ("COMPLETED", "FAILED"):
+            return (self.finish_message,
+                    self.application_state == "COMPLETED", True)
+        return super().finished()
+
+    def pods_ready(self) -> bool:
+        return self.application_state == "RUNNING" or super().pods_ready()

@@ -1,7 +1,19 @@
 """StatefulSet integration.
 
-Reference parity: pkg/controller/jobs/statefulset — replicas-sized single
-podset; scale handled by workload-slice replacement (elastic jobs).
+Reference parity: pkg/controller/jobs/statefulset/
+statefulset_reconciler.go (447 LoC) + statefulset_webhook.go (222) +
+statefulset_pod_reconciler.go (196). The reference does NOT reconcile the
+StatefulSet as a GenericJob: its webhook stamps the pod template with the
+queue label and the POD-GROUP labels (group name = sts name, total count
+= replicas), so the sts's pods are admitted as one composable pod group
+through the pod integration; the sts reconciler only tracks scale
+changes (updating the group's total) and cleans up on deletion.
+
+Both forms are provided here: the `StatefulSet` dataclass is a
+GenericJob (one replicas-sized podset — used directly by the elastic
+workload-slice path, which is how scaling admits without re-queueing the
+whole group), and `expand_pods()` produces the gated member pods that
+drive the PodGroupController exactly as the webhook-stamped pods do.
 """
 
 from __future__ import annotations
@@ -11,6 +23,11 @@ from dataclasses import dataclass, field
 from kueue_oss_tpu.api.types import PodSet
 from kueue_oss_tpu.jobframework.interface import BaseJob
 from kueue_oss_tpu.jobframework.registry import integration_manager
+from kueue_oss_tpu.jobs.pod import (
+    POD_GROUP_LABEL,
+    POD_GROUP_TOTAL_ANNOTATION,
+    Pod,
+)
 
 
 @integration_manager.register
@@ -20,7 +37,30 @@ class StatefulSet(BaseJob):
 
     replicas: int = 1
     requests: dict[str, int] = field(default_factory=dict)
+    #: live status
+    ready_replicas: int = 0
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(name="main", count=self.replicas,
                        requests=dict(self.requests))]
+
+    def pods_ready(self) -> bool:
+        return self.ready_replicas >= self.replicas
+
+    def mark_running(self, ready: bool = True) -> None:
+        super().mark_running(ready=ready)
+        self.ready_replicas = self.replicas if ready else 0
+
+    def expand_pods(self) -> list[Pod]:
+        """The webhook-stamped member pods (statefulset_webhook.go
+        Default): ordinal-named, gated, carrying the pod-group labels."""
+        return [Pod(
+            name=f"{self.name}-{i}",
+            namespace=self.namespace,
+            queue_name=self.queue_name,
+            requests=dict(self.requests),
+            labels={POD_GROUP_LABEL: self.name},
+            annotations={POD_GROUP_TOTAL_ANNOTATION: str(self.replicas)},
+            priority=self.priority,
+            creation_time=self.creation_time,
+        ) for i in range(self.replicas)]

@@ -1,17 +1,58 @@
 """TrainJob (kubeflow trainer v2) integration.
 
-Reference parity: pkg/controller/jobs/trainjob — podsets derived from the
-training runtime's pod-group shapes.
+Reference parity: pkg/controller/jobs/trainjob/trainjob_controller.go
+(430 LoC) — a TrainJob's podsets come from its RUNTIME, not its own
+spec: the runtimeRef resolves against the (Cluster)TrainingRuntime
+registry (:169-176), the runtime's template materializes a child JobSet,
+and the TrainJob's overrides (trainer.numNodes → the trainer job's
+parallelism, per-node resources) are patched in before podsets are
+derived from the resulting replicated jobs.
+
+Modeled here: `TrainingRuntime` templates register in a process-wide
+registry (the Runtimes() analog); a `TrainJob` with a `runtime_ref`
+derives its replica specs from the template with num_nodes /
+resources-per-node overrides applied to the trainer step. Direct
+`replica_specs` (no runtime) stay supported for ad-hoc jobs.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
 from kueue_oss_tpu.api.types import PodSet
 from kueue_oss_tpu.jobframework.interface import BaseJob
 from kueue_oss_tpu.jobframework.registry import integration_manager
 from kueue_oss_tpu.jobs.kubeflow import ReplicaSpec
+
+#: the runtime step that numNodes/resourcesPerNode overrides target
+TRAINER_STEP = "Node"
+
+
+@dataclass
+class TrainingRuntime:
+    """A (Cluster)TrainingRuntime template: ordered steps, each a
+    replica shape (e.g. dataset-initializer, model-initializer, Node)."""
+
+    name: str
+    steps: list[ReplicaSpec] = field(default_factory=list)
+
+
+class RuntimeRegistry:
+    """kftrainerruntimecore.Runtimes() analog."""
+
+    def __init__(self) -> None:
+        self._runtimes: dict[str, TrainingRuntime] = {}
+
+    def register(self, runtime: TrainingRuntime) -> TrainingRuntime:
+        self._runtimes[runtime.name] = runtime
+        return runtime
+
+    def get(self, name: str) -> Optional[TrainingRuntime]:
+        return self._runtimes.get(name)
+
+
+runtime_registry = RuntimeRegistry()
 
 
 @integration_manager.register
@@ -19,10 +60,43 @@ from kueue_oss_tpu.jobs.kubeflow import ReplicaSpec
 class TrainJob(BaseJob):
     kind = "TrainJob"
 
-    #: pod groups from the referenced TrainingRuntime
+    #: direct pod groups (used when no runtime_ref)
     replica_specs: list[ReplicaSpec] = field(default_factory=list)
+    #: name of a registered TrainingRuntime
+    runtime_ref: Optional[str] = None
+    #: spec.trainer.numNodes override onto the runtime's trainer step
+    num_nodes: Optional[int] = None
+    #: spec.trainer.resourcesPerNode override
+    resources_per_node: Optional[dict[str, int]] = None
+
+    def resolved_replica_specs(self) -> list[ReplicaSpec]:
+        if self.runtime_ref is None:
+            return list(self.replica_specs)
+        runtime = runtime_registry.get(self.runtime_ref)
+        if runtime is None:
+            raise ValueError(
+                f"TrainJob {self.key}: unknown runtime {self.runtime_ref!r}")
+        out = []
+        for step in runtime.steps:
+            replicas = step.replicas
+            requests = dict(step.requests)
+            if step.role == TRAINER_STEP:
+                if self.num_nodes is not None:
+                    replicas = self.num_nodes
+                if self.resources_per_node is not None:
+                    requests = dict(self.resources_per_node)
+            out.append(ReplicaSpec(
+                role=step.role, replicas=replicas, requests=requests,
+                priority_class=step.priority_class,
+                node_selector=dict(step.node_selector),
+                tolerations=list(step.tolerations),
+                topology_request=step.topology_request))
+        return out
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(name=rs.role.lower(), count=rs.replicas,
-                       requests=dict(rs.requests))
-                for rs in self.replica_specs]
+                       requests=dict(rs.requests),
+                       node_selector=dict(rs.node_selector),
+                       tolerations=list(rs.tolerations),
+                       topology_request=rs.topology_request)
+                for rs in self.resolved_replica_specs()]

@@ -73,6 +73,24 @@ class Gauge(Counter):
         with self._lock:
             self._values[key] = float(value)
 
+    def replace_prefix(self, prefix: tuple[str, ...],
+                       updates: dict[tuple, float]) -> None:
+        """Set every (prefix + suffix) sample from `updates`; stale
+        samples sharing the prefix first report one scrape of 0, then
+        drop off entirely — a drained gauge must not keep its last
+        value, and churned label sets must not accumulate forever
+        (reference metrics.go zero-fill + DeleteLabelValues)."""
+        n = len(prefix)
+        with self._lock:
+            for key in list(self._values):
+                if key[:n] == prefix and key[n:] not in updates:
+                    if self._values[key] == 0.0:
+                        del self._values[key]
+                    else:
+                        self._values[key] = 0.0
+        for suffix, v in updates.items():
+            self.set(*(prefix + tuple(suffix)), value=v)
+
 
 class Histogram(_Series):
     kind = "histogram"

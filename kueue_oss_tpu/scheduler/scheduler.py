@@ -117,6 +117,8 @@ class Scheduler:
         self._requeue_heap: list[tuple[float, str]] = []
         #: CQs whose usage changed outside entry processing (evictions)
         self._cycle_touched_cqs: set[str] = set()
+        #: cq -> (lq, ns) label sets last reported, for gauge zero-fill
+        self._lq_reported: dict[str, set] = {}
         # metrics
         self.admitted_total: dict[str, int] = {}
         self.preempted_total: dict[str, int] = {}
@@ -219,12 +221,26 @@ class Scheduler:
                     agg = by_lq.setdefault(lqk, {})
                     for fr, q in info.usage().items():
                         agg[fr] = agg.get(fr, 0) + q
+                # zero-fill LQ samples whose last workload left this CQ
+                # so drained queues report 0 instead of a stale value
+                prev = self._lq_reported.get(name, set())
+                stale = prev - set(active_by_lq)
+                for lq, ns in stale:
+                    metrics.local_queue_reserving_active_workloads.set(
+                        lq, ns, value=0)
+                    metrics.local_queue_admitted_active_workloads.set(
+                        lq, ns, value=0)
+                self._lq_reported[name] = set(active_by_lq)
                 for (lq, ns), agg in by_lq.items():
-                    for (flavor, resource), q in agg.items():
-                        metrics.local_queue_resource_usage.set(
-                            lq, ns, flavor, resource, value=q)
-                        metrics.local_queue_resource_reservation.set(
-                            lq, ns, flavor, resource, value=q)
+                    metrics.local_queue_resource_usage.replace_prefix(
+                        (lq, ns), {fr: q for fr, q in agg.items()})
+                    metrics.local_queue_resource_reservation.replace_prefix(
+                        (lq, ns), {fr: q for fr, q in agg.items()})
+                for lq, ns in stale:
+                    metrics.local_queue_resource_usage.replace_prefix(
+                        (lq, ns), {})
+                    metrics.local_queue_resource_reservation.replace_prefix(
+                        (lq, ns), {})
                 for (lq, ns), n in active_by_lq.items():
                     metrics.local_queue_reserving_active_workloads.set(
                         lq, ns, value=n)
@@ -238,9 +254,8 @@ class Scheduler:
                     for psr in info.total_requests:
                         for r, v in psr.requests.items():
                             pend[r] = pend.get(r, 0) + v
-                for r, v in pend.items():
-                    metrics.cluster_queue_resource_pending.set(
-                        name, r, value=v)
+                metrics.cluster_queue_resource_pending.replace_prefix(
+                    (name,), {(r,): v for r, v in pend.items()})
             if cq.has_parent():
                 touched_cohorts.update(cq.path_parent_to_root())
         # cohort subtree gauges (metrics.go cohort_subtree_*)

@@ -1,0 +1,572 @@
+"""Deep per-framework integration semantics.
+
+Mirrors the reference's per-framework controller tests under
+pkg/controller/jobs/* : kubeflow replica ordering + priority resolution,
+MPI launcher-as-worker, Ray multi-host counts / autoscaling / submitter
+mode, LeaderWorkerSet per-group workloads, StatefulSet pod groups,
+Deployment per-pod workloads, AppWrapper component aggregation, Spark
+resource model + dynamic-allocation rejection, TrainJob runtime
+resolution, and Job/JobSet reclaimable-pod math.
+"""
+
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.controllers import WorkloadReconciler
+from kueue_oss_tpu.jobframework import JobReconciler
+from kueue_oss_tpu.jobframework.interface import PodSetInfo
+from kueue_oss_tpu.jobs import (
+    AppWrapper,
+    BatchJob,
+    JobSet,
+    LeaderWorkerSet,
+    LeaderWorkerSetReconciler,
+    MPIJob,
+    PyTorchJob,
+    RayJob,
+    ReplicaSpec,
+    ReplicatedJob,
+    SparkApplication,
+    SparkRoleSpec,
+    StatefulSet,
+    TFJob,
+    TrainingRuntime,
+    TrainJob,
+    WorkerGroup,
+    runtime_registry,
+)
+from kueue_oss_tpu.jobs.pod import PodGroupController
+from kueue_oss_tpu.jobs.ray import DEFAULT_SUBMITTER_REQUESTS, K8S_JOB_MODE
+from kueue_oss_tpu.jobs.spark import MIB
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+class Env:
+    def __init__(self, nominal=16000):
+        self.store = Store()
+        self.store.upsert_resource_flavor(ResourceFlavor(
+            name="default", node_labels={"pool": "tpu"}))
+        self.store.upsert_cluster_queue(ClusterQueue(
+            name="cq", resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources=[
+                    ResourceQuota(name="cpu", nominal=nominal)])])]))
+        self.store.upsert_local_queue(LocalQueue(name="lq",
+                                                 cluster_queue="cq"))
+        self.queues = QueueManager(self.store)
+        self.scheduler = Scheduler(self.store, self.queues)
+        self.wl_reconciler = WorkloadReconciler(self.store, self.scheduler)
+        self.jobs = JobReconciler(self.store, self.scheduler,
+                                  workload_reconciler=self.wl_reconciler)
+        self.t = 0.0
+
+    def tick(self):
+        self.t += 1.0
+        self.scheduler.schedule(self.t)
+        self.jobs.reconcile_all(self.t)
+        return self.t
+
+
+# -- kubeflow family ---------------------------------------------------------
+
+
+def test_tfjob_canonical_replica_order():
+    job = TFJob(name="tf", replica_specs=[
+        ReplicaSpec(role="Worker", replicas=4),
+        ReplicaSpec(role="PS", replicas=2),
+        ReplicaSpec(role="Chief", replicas=1),
+    ])
+    assert [ps.name for ps in job.pod_sets()] == ["chief", "ps", "worker"]
+
+
+def test_kubeflow_priority_class_resolution():
+    # scheduling policy wins over replica templates
+    job = PyTorchJob(name="pt", scheduling_priority_class="high",
+                     replica_specs=[
+                         ReplicaSpec(role="Master", priority_class="mid")])
+    assert job.effective_priority_class() == "high"
+    # else the first canonical replica type that sets one
+    job = PyTorchJob(name="pt", replica_specs=[
+        ReplicaSpec(role="Worker", replicas=2, priority_class="low"),
+        ReplicaSpec(role="Master", priority_class="mid"),
+    ])
+    assert job.effective_priority_class() == "mid"
+    job = PyTorchJob(name="pt", replica_specs=[
+        ReplicaSpec(role="Worker", replicas=2, priority_class="low")])
+    assert job.effective_priority_class() == "low"
+
+
+def test_kubeflow_podset_info_merge_and_restore():
+    job = PyTorchJob(name="pt", queue_name="lq", replica_specs=[
+        ReplicaSpec(role="Master", node_selector={"zone": "a"}),
+        ReplicaSpec(role="Worker", replicas=2),
+    ])
+    infos = [PodSetInfo(name="master", count=1,
+                        node_selector={"pool": "tpu"}),
+             PodSetInfo(name="worker", count=2,
+                        node_selector={"pool": "tpu"})]
+    job.run_with_podsets_info(infos)
+    master = next(rs for rs in job.replica_specs if rs.role == "Master")
+    assert master.node_selector == {"zone": "a", "pool": "tpu"}
+    job.restore_podsets_info(infos)
+    assert master.node_selector == {"zone": "a"}
+
+
+def test_kubeflow_podset_info_length_mismatch():
+    job = PyTorchJob(name="pt", replica_specs=[
+        ReplicaSpec(role="Master"), ReplicaSpec(role="Worker")])
+    with pytest.raises(ValueError):
+        job.run_with_podsets_info([PodSetInfo(name="master", count=1)])
+
+
+def test_kubeflow_pods_ready_per_replica_type():
+    job = PyTorchJob(name="pt", replica_specs=[
+        ReplicaSpec(role="Master", replicas=1),
+        ReplicaSpec(role="Worker", replicas=4),
+    ])
+    job.replica_specs[0].ready_replicas = 1
+    job.replica_specs[1].ready_replicas = 3
+    assert not job.pods_ready()
+    job.replica_specs[1].ready_replicas = 4
+    assert job.pods_ready()
+
+
+# -- MPIJob ------------------------------------------------------------------
+
+
+def test_mpi_launcher_as_worker_inherits_shape():
+    job = MPIJob(name="mpi", worker_count=4,
+                 worker_requests={"cpu": 2000},
+                 run_launcher_as_worker=True)
+    launcher = job.pod_sets()[0]
+    assert launcher.requests == {"cpu": 2000}
+    # explicit launcher requests win
+    job.launcher_requests = {"cpu": 100}
+    assert job.pod_sets()[0].requests == {"cpu": 100}
+
+
+def test_mpi_priority_class_order():
+    job = MPIJob(name="mpi", launcher_priority_class="l",
+                 worker_priority_class="w")
+    assert job.effective_priority_class() == "l"
+    job.scheduling_priority_class = "s"
+    assert job.effective_priority_class() == "s"
+    job = MPIJob(name="mpi", worker_priority_class="w")
+    assert job.effective_priority_class() == "w"
+
+
+def test_mpi_zero_workers_single_podset():
+    job = MPIJob(name="mpi", worker_count=0,
+                 launcher_requests={"cpu": 100})
+    assert len(job.pod_sets()) == 1
+    job.run_with_podsets_info([PodSetInfo(name="launcher", count=1)])
+    assert not job.is_suspended()
+
+
+# -- Ray ---------------------------------------------------------------------
+
+
+def test_ray_num_of_hosts_multiplies_count():
+    job = RayJob(name="ray", worker_groups=[
+        WorkerGroup(name="tpu", replicas=4, num_of_hosts=8)])
+    assert job.pod_sets()[1].count == 32
+
+
+def test_ray_autoscaling_tracks_live_replicas():
+    wg = WorkerGroup(name="wg", replicas=4, live_replicas=7)
+    job = RayJob(name="ray", worker_groups=[wg], autoscaling=True)
+    assert job.pod_sets()[1].count == 7
+    job.autoscaling = False
+    assert job.pod_sets()[1].count == 4
+
+
+def test_rayjob_submitter_podset_k8s_mode():
+    job = RayJob(name="ray", submission_mode=K8S_JOB_MODE,
+                 worker_groups=[WorkerGroup(name="wg", replicas=2)])
+    names = [ps.name for ps in job.pod_sets()]
+    assert names == ["head", "wg", "submitter"]
+    assert job.pod_sets()[2].requests == DEFAULT_SUBMITTER_REQUESTS
+
+
+def test_rayjob_cluster_selector_skipped():
+    job = RayJob(name="ray", cluster_selector={"ray.io/cluster": "c"})
+    assert job.skip()
+    assert not RayJob(name="ray2").skip()
+
+
+def test_rayjob_finished_from_deployment_status():
+    job = RayJob(name="ray")
+    assert job.finished()[2] is False
+    job.deployment_status = "Complete"
+    job.job_status = "SUCCEEDED"
+    msg, success, done = job.finished()
+    assert done and success
+    job.deployment_status = "Failed"
+    job.job_status = "FAILED"
+    assert job.finished()[1] is False
+
+
+# -- LeaderWorkerSet ---------------------------------------------------------
+
+
+def test_lws_per_group_workloads_and_scaling():
+    env = Env()
+    lws = LeaderWorkerSet(name="serve", queue_name="lq", replicas=3,
+                          size=4, leader_requests={"cpu": 1000},
+                          worker_requests={"cpu": 500})
+    ctl = LeaderWorkerSetReconciler(env.jobs)
+    ctl.upsert(lws)
+    ctl.reconcile(env.t)
+
+    groups = ctl.groups_of(lws)
+    assert [g.name for g in groups] == ["serve-0", "serve-1", "serve-2"]
+    # each group is its own workload with leader + workers podsets
+    for g in groups:
+        wl = env.jobs.workload_for(g)
+        assert wl is not None
+        assert [(ps.name, ps.count) for ps in wl.podsets] == [
+            ("leader", 1), ("workers", 3)]
+
+    # one admission per CQ per cycle (head-based, scheduler.go nominate)
+    for _ in range(3):
+        env.scheduler.schedule(env.t)
+    ctl.reconcile(env.t)
+    assert all(not g.is_suspended() for g in ctl.groups_of(lws))
+
+    # scale down deletes the orphaned group's workload
+    lws.replicas = 1
+    ctl.reconcile(env.t)
+    assert [g.name for g in ctl.groups_of(lws)] == ["serve-0"]
+    assert env.store.workloads.get("default/lwsgroup-serve-1") is None
+
+    # scale up creates the missing groups
+    lws.replicas = 2
+    ctl.reconcile(env.t)
+    assert [g.name for g in ctl.groups_of(lws)] == ["serve-0", "serve-1"]
+
+
+def test_lws_groups_admit_independently():
+    env = Env(nominal=2500)  # room for one 4-pod group only
+    lws = LeaderWorkerSet(name="s", queue_name="lq", replicas=2, size=4,
+                          leader_requests={"cpu": 1000},
+                          worker_requests={"cpu": 500})
+    ctl = LeaderWorkerSetReconciler(env.jobs)
+    ctl.upsert(lws)
+    ctl.reconcile(env.t)
+    env.scheduler.schedule(env.t)
+    ctl.reconcile(env.t)
+    admitted = [g for g in ctl.groups_of(lws) if not g.is_suspended()]
+    assert len(admitted) == 1, "only one group fits the quota"
+
+
+# -- StatefulSet / Deployment (pod-backed) -----------------------------------
+
+
+def test_statefulset_pods_form_a_group():
+    env = Env()
+    sts = StatefulSet(name="db", queue_name="lq", replicas=3,
+                      requests={"cpu": 1000})
+    pods = sts.expand_pods()
+    assert len(pods) == 3 and all(p.gated for p in pods)
+    ctl = PodGroupController(env.store, env.scheduler, env.jobs)
+    for p in pods:
+        ctl.upsert_pod(p)
+    ctl.reconcile(env.t)
+    env.scheduler.schedule(env.t)
+    ctl.reconcile(env.t)
+    wl = env.store.workloads.get("default/podgroup-db")
+    assert wl is not None and wl.is_admitted
+    assert all(not p.gated for p in pods), "admission ungates members"
+
+
+def test_deployment_pods_admit_individually():
+    env = Env(nominal=2000)
+    dep = Deployment = None  # avoid shadow warnings
+    from kueue_oss_tpu.jobs import Deployment as Dep
+
+    dep = Dep(name="web", queue_name="lq", replicas=3,
+              requests={"cpu": 1000})
+    pods = dep.expand_pods()
+    assert all(p.group_name is None for p in pods)
+    ctl = PodGroupController(env.store, env.scheduler, env.jobs)
+    for p in pods:
+        ctl.upsert_pod(p)
+    ctl.reconcile(env.t)
+    for _ in range(3):
+        env.scheduler.schedule(env.t)
+    ctl.reconcile(env.t)
+    ungated = [p for p in pods if not p.gated]
+    assert len(ungated) == 2, "serving pods admit independently up to quota"
+
+
+# -- AppWrapper --------------------------------------------------------------
+
+
+def test_appwrapper_wraps_child_jobs():
+    child1 = BatchJob(name="prep", parallelism=2, requests={"cpu": 100})
+    child2 = PyTorchJob(name="train", replica_specs=[
+        ReplicaSpec(role="Master", requests={"cpu": 200}),
+        ReplicaSpec(role="Worker", replicas=2, requests={"cpu": 300})])
+    aw = AppWrapper(name="aw", queue_name="lq",
+                    components=[child1, child2])
+    names = [ps.name for ps in aw.pod_sets()]
+    assert names == ["prep-main", "train-master", "train-worker"]
+
+    infos = [PodSetInfo(name=n, count=c,
+                        node_selector={"pool": "tpu"})
+             for n, c in [("prep-main", 2), ("train-master", 1),
+                          ("train-worker", 2)]]
+    aw.run_with_podsets_info(infos)
+    assert not child1.is_suspended() and not child2.is_suspended()
+    master = next(rs for rs in child2.replica_specs
+                  if rs.role == "Master")
+    assert master.node_selector == {"pool": "tpu"}
+
+    child1.mark_finished(success=True)
+    assert aw.finished()[2] is False
+    child2.mark_finished(success=True)
+    assert aw.finished() == ("all components finished", True, True)
+
+
+def test_appwrapper_strips_prefix_for_child_infos():
+    # a wrapped Spark app matches infos by its OWN podset names: the
+    # partial-admission hook keys on "executor", not "etl-executor"
+    child = SparkApplication(name="etl", executor_instances=10,
+                             executor_requests={"cpu": 100})
+    aw = AppWrapper(name="aw", components=[child])
+    aw.run_with_podsets_info([
+        PodSetInfo(name="etl-driver", count=1),
+        PodSetInfo(name="etl-executor", count=4)])
+    assert child.executor_instances == 4
+
+
+def test_appwrapper_component_failure_fails_wrapper():
+    child = BatchJob(name="c", parallelism=1)
+    aw = AppWrapper(name="aw", components=[child])
+    child.mark_finished(success=False, message="boom")
+    msg, success, done = aw.finished()
+    assert done and not success
+
+
+def test_appwrapper_raw_tuple_components():
+    aw = AppWrapper(name="aw", components=[("c1", 2, {"cpu": 100})])
+    assert [(ps.name, ps.count) for ps in aw.pod_sets()] == [("c1", 2)]
+
+
+# -- Spark -------------------------------------------------------------------
+
+
+def test_spark_resource_model_derivation():
+    app = SparkApplication(
+        name="s",
+        driver_spec=SparkRoleSpec(cores=2, memory_mib=2048,
+                                  memory_overhead_mib=512),
+        executor_spec=SparkRoleSpec(cores=4, memory_mib=4096,
+                                    gpu_name="gpu", gpu_quantity=1),
+        executor_instances=3)
+    driver, executor = app.pod_sets()
+    assert driver.requests == {"cpu": 2000, "memory": (2048 + 512) * MIB}
+    # overhead defaults to max(10%, 384Mi)
+    assert executor.requests == {"cpu": 4000,
+                                 "memory": (4096 + 409) * MIB, "gpu": 1}
+    assert executor.count == 3
+
+
+def test_spark_dynamic_allocation_rejected():
+    app = SparkApplication(name="s", dynamic_allocation=True)
+    assert app.validate()
+    assert not SparkApplication(name="s2").validate()
+
+
+def test_spark_partial_admission_updates_instances():
+    app = SparkApplication(name="s", executor_instances=10,
+                           executor_requests={"cpu": 100})
+    app.run_with_podsets_info([
+        PodSetInfo(name="driver", count=1),
+        PodSetInfo(name="executor", count=6)])
+    assert app.executor_instances == 6
+
+
+# -- TrainJob ----------------------------------------------------------------
+
+
+def test_trainjob_resolves_runtime_with_overrides():
+    runtime_registry.register(TrainingRuntime(name="torch-tpu", steps=[
+        ReplicaSpec(role="dataset-initializer", replicas=1,
+                    requests={"cpu": 100}),
+        ReplicaSpec(role="Node", replicas=2, requests={"cpu": 1000}),
+    ]))
+    tj = TrainJob(name="tj", runtime_ref="torch-tpu", num_nodes=8,
+                  resources_per_node={"cpu": 4000})
+    sets = tj.pod_sets()
+    assert [(ps.name, ps.count) for ps in sets] == [
+        ("dataset-initializer", 1), ("node", 8)]
+    assert sets[1].requests == {"cpu": 4000}
+
+
+def test_trainjob_unknown_runtime_raises():
+    tj = TrainJob(name="tj", runtime_ref="nope")
+    with pytest.raises(ValueError):
+        tj.pod_sets()
+
+
+# -- Job / JobSet reclaimable math -------------------------------------------
+
+
+def test_batch_job_reclaimable_pods():
+    job = BatchJob(name="j", parallelism=4, completions=6)
+    assert job.reclaimable_pods() == {}
+    job.succeeded = 2  # remaining 4 >= parallelism 4 → nothing yet
+    assert job.reclaimable_pods() == {}
+    job.succeeded = 3  # remaining 3 < 4 → 1 seat reclaimable
+    assert job.reclaimable_pods() == {"main": 1}
+    job.succeeded = 5  # remaining 1 → 3 seats reclaimable
+    assert job.reclaimable_pods() == {"main": 3}
+
+
+def test_batch_job_mark_succeeded_finishes():
+    job = BatchJob(name="j", parallelism=2, completions=2)
+    job.mark_running()
+    job.mark_succeeded(2)
+    assert job.finished() == ("JobComplete", True, True)
+
+
+def test_jobset_pods_ready_and_reclaimable():
+    js = JobSet(name="js", replicated_jobs=[
+        ReplicatedJob(name="a", replicas=2, parallelism=3),
+        ReplicatedJob(name="b", replicas=1, parallelism=2),
+    ])
+    js.replicated_jobs[0].ready_replicas = 1
+    js.replicated_jobs[1].ready_replicas = 1
+    assert not js.pods_ready()
+    js.replicated_jobs[0].succeeded_replicas = 1
+    assert js.pods_ready()
+    assert js.reclaimable_pods() == {"a": 3}
+
+
+def test_pod_priority_propagates_to_workloads():
+    env = Env()
+    sts = StatefulSet(name="db", queue_name="lq", replicas=2,
+                      requests={"cpu": 100}, priority=50)
+    ctl = PodGroupController(env.store, env.scheduler, env.jobs)
+    for p in sts.expand_pods():
+        assert p.priority == 50
+        ctl.upsert_pod(p)
+    ctl.reconcile(env.t)
+    wl = env.store.workloads.get("default/podgroup-db")
+    assert wl is not None and wl.priority == 50
+
+
+def test_pending_gauge_zeroed_when_queue_drains():
+    from kueue_oss_tpu import metrics
+
+    env = Env()
+    job = BatchJob(name="j", queue_name="lq", parallelism=1,
+                   requests={"cpu": 500})
+    env.jobs.upsert_job(job)
+    env.jobs.reconcile(job, env.t)
+    env.scheduler.schedule(env.t)  # admits; pending drains
+    env.scheduler.schedule(env.t)  # re-reports with empty pending
+    key = ("cq", "cpu")
+    val = metrics.cluster_queue_resource_pending._values.get(key)
+    assert not val, f"drained pending gauge must read 0, got {val}"
+
+
+def test_spark_restore_recovers_spec_instances():
+    app = SparkApplication(name="s", executor_instances=10,
+                           executor_requests={"cpu": 100})
+    infos = [PodSetInfo(name="driver", count=1),
+             PodSetInfo(name="executor", count=6)]
+    app.run_with_podsets_info(infos)
+    assert app.executor_instances == 6
+    app.restore_podsets_info(infos)
+    assert app.executor_instances == 10, "eviction must restore the spec"
+
+
+def test_partial_admission_not_treated_as_shape_change():
+    """A partially admitted job's shrunken pod_sets() must not read as a
+    podset change and evict the workload (reconciler equivalentToWorkload
+    vs admitted counts)."""
+    env = Env(nominal=3000)
+    job = BatchJob(name="big", queue_name="lq", parallelism=10,
+                   min_parallelism=2, requests={"cpu": 1000})
+    env.jobs.upsert_job(job)
+    env.jobs.reconcile(job, env.t)
+    env.tick()
+    wl = env.jobs.workload_for(job)
+    assert wl.is_admitted
+    assert job.parallelism == 3, "partial admission shrinks parallelism"
+    key = wl.key
+    env.tick()
+    wl2 = env.jobs.workload_for(job)
+    assert wl2 is not None and wl2.key == key and wl2.is_admitted, \
+        "reconcile must not evict/recreate the partially admitted workload"
+
+
+def test_double_injection_keeps_pristine_selectors():
+    job = PyTorchJob(name="pt", replica_specs=[
+        ReplicaSpec(role="Worker", node_selector={"zone": "a"})])
+    infos1 = [PodSetInfo(name="worker", count=1,
+                         node_selector={"pool": "od"})]
+    job.run_with_podsets_info(infos1)
+    # elastic slice takeover re-injects without an intervening restore
+    infos2 = [PodSetInfo(name="worker", count=1,
+                         node_selector={"pool": "spot"})]
+    job.run_with_podsets_info(infos2)
+    job.restore_podsets_info(infos2)
+    assert job.replica_specs[0].node_selector == {"zone": "a"}
+
+
+def test_lws_delete_after_scale_down_leaks_nothing():
+    env = Env()
+    lws = LeaderWorkerSet(name="s", queue_name="lq", replicas=3, size=2,
+                          leader_requests={"cpu": 100},
+                          worker_requests={"cpu": 100})
+    ctl = LeaderWorkerSetReconciler(env.jobs)
+    ctl.upsert(lws)
+    ctl.reconcile(env.t)
+    assert len(ctl.groups_of(lws)) == 3
+    lws.replicas = 1  # scale down in the spec, then delete BEFORE reconcile
+    ctl.delete(lws.key)
+    assert not any(kind == "LWSGroup"
+                   for kind, _ in env.jobs.jobs), "groups leaked"
+    assert not any(w.owner and w.owner.startswith("LWSGroup/")
+                   for w in env.store.workloads.values())
+
+
+def test_ray_autoscaler_count_clamped_to_bounds():
+    wg = WorkerGroup(name="wg", replicas=2, min_replicas=1, max_replicas=5,
+                     live_replicas=9)
+    assert wg.count(autoscaling=True) == 5
+    wg.live_replicas = 0
+    assert wg.count(autoscaling=True) == 1
+
+
+def test_gauge_stale_series_dropped_after_zero_scrape():
+    from kueue_oss_tpu.metrics import Gauge
+
+    g = Gauge("test_gauge", "t", ("cq", "resource"))
+    g.replace_prefix(("a",), {("cpu",): 5.0})
+    g.replace_prefix(("a",), {})  # drained: one scrape of 0
+    assert g._values.get(("a", "cpu")) == 0.0
+    g.replace_prefix(("a",), {})  # then the series drops off
+    assert ("a", "cpu") not in g._values
+
+
+def test_jobset_info_merge_restore():
+    js = JobSet(name="js", queue_name="lq", replicated_jobs=[
+        ReplicatedJob(name="a", replicas=1, parallelism=2)])
+    infos = [PodSetInfo(name="a", count=2, node_selector={"pool": "x"})]
+    js.run_with_podsets_info(infos)
+    assert js.replicated_jobs[0].node_selector == {"pool": "x"}
+    js.restore_podsets_info(infos)
+    assert js.replicated_jobs[0].node_selector == {}
