@@ -543,14 +543,16 @@ class Workload:
 
 
 @dataclass
+class AdmissionCheckStatus:
+    active: bool = True
+
+
+@dataclass
 class AdmissionCheck:
     """Reference parity: admissioncheck_types.go (KEP-993)."""
 
     name: str
     controller_name: str = ""
     parameters: dict[str, str] = field(default_factory=dict)
-
-
-@dataclass
-class AdmissionCheckStatus:
-    active: bool = True
+    status: AdmissionCheckStatus = field(
+        default_factory=AdmissionCheckStatus)

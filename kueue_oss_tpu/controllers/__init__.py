@@ -8,10 +8,32 @@ from kueue_oss_tpu.controllers.concurrent_admission import (
 from kueue_oss_tpu.controllers.failure_recovery import (
     NodeFailureController,
 )
+from kueue_oss_tpu.controllers.cq_controller import (
+    ClusterQueueReconciler,
+    CQStatus,
+)
+from kueue_oss_tpu.controllers.core_controllers import (
+    AdmissionCheckReconciler,
+    CohortReconciler,
+    CohortStatus,
+    LocalQueueReconciler,
+    LQStatus,
+    ResourceFlavorReconciler,
+    WorkloadPriorityClassReconciler,
+)
 
 __all__ = [
     "EvictionReason",
     "WorkloadReconciler",
     "ConcurrentAdmissionReconciler",
     "NodeFailureController",
+    "ClusterQueueReconciler",
+    "CQStatus",
+    "AdmissionCheckReconciler",
+    "CohortReconciler",
+    "CohortStatus",
+    "LocalQueueReconciler",
+    "LQStatus",
+    "ResourceFlavorReconciler",
+    "WorkloadPriorityClassReconciler",
 ]

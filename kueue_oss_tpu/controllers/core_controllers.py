@@ -1,0 +1,321 @@
+"""Core object reconcilers: LocalQueue, Cohort, AdmissionCheck,
+ResourceFlavor, WorkloadPriorityClass.
+
+Reference parity: pkg/controller/core/{localqueue_controller.go,
+cohort_controller.go, admissioncheck_controller.go,
+resourceflavor_controller.go, workloadpriorityclass_controller.go}.
+Each reconciler computes the object's STATUS from the store the way the
+reference computes it from informer caches, and keeps the dependent
+caches (queue manager, CQ Active conditions) notified.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu import features, metrics
+from kueue_oss_tpu.api.types import StopPolicy
+from kueue_oss_tpu.core.store import Store
+
+ACTIVE = "Active"
+
+
+# ---------------------------------------------------------------------------
+# LocalQueue
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LQStatus:
+    """localqueue_controller.go Reconcile (:176-240): counts + Active."""
+
+    active: bool = False
+    reason: str = ""
+    message: str = ""
+    pending_workloads: int = 0
+    reserving_workloads: int = 0
+    admitted_workloads: int = 0
+    #: flavors usable through the parent CQ (ExposeFlavorsInLocalQueue)
+    flavors: list[str] = field(default_factory=list)
+    #: AFS consumed-usage sample (admissionFairSharing status)
+    fair_sharing_usage: dict[str, float] = field(default_factory=dict)
+
+
+class LocalQueueReconciler:
+    """Maintains LocalQueue status: workload counts, the Active
+    condition derived from the parent CQ, exposed flavors, and the AFS
+    usage sample (localqueue_controller.go:176-240)."""
+
+    def __init__(self, store: Store, queues=None, cq_reconciler=None,
+                 afs=None) -> None:
+        self.store = store
+        self.queues = queues
+        self.cq_reconciler = cq_reconciler
+        self.afs = afs
+        self.status: dict[str, LQStatus] = {}
+
+    def reconcile(self, lq_key: str, now: float = 0.0) -> LQStatus:
+        lq = self.store.local_queues.get(lq_key)
+        if lq is None:
+            self.status.pop(lq_key, None)
+            return LQStatus(active=False, reason="NotFound")
+        st = LQStatus()
+
+        cq = self.store.cluster_queues.get(lq.cluster_queue)
+        if cq is None:
+            st.reason, st.message = ("ClusterQueueDoesNotExist",
+                                     "Can't submit new workloads to "
+                                     "clusterQueue")
+        elif lq.stop_policy != StopPolicy.NONE:
+            st.reason, st.message = ("Stopped",
+                                     "LocalQueue is stopped")
+        else:
+            cq_active = True
+            if self.cq_reconciler is not None:
+                cq_st = self.cq_reconciler.status.get(lq.cluster_queue)
+                if cq_st is None:
+                    cq_st = self.cq_reconciler.reconcile(lq.cluster_queue)
+                cq_active = cq_st.active
+            if not cq_active:
+                st.reason, st.message = ("ClusterQueueIsInactive",
+                                         "Can't submit new workloads to "
+                                         "clusterQueue")
+            else:
+                st.active, st.reason, st.message = (
+                    True, "Ready", "Can submit new workloads to "
+                    "clusterQueue")
+
+        # workload counts (localqueue_controller.go status update)
+        for wl in self.store.workloads.values():
+            if (wl.namespace, wl.queue_name) != (lq.namespace, lq.name):
+                continue
+            if wl.is_finished:
+                continue
+            if wl.is_quota_reserved:
+                st.reserving_workloads += 1
+                if wl.is_admitted:
+                    st.admitted_workloads += 1
+            else:
+                st.pending_workloads += 1
+
+        # flavors usable from this queue (ExposeFlavorsInLocalQueue)
+        if cq is not None and features.enabled("ExposeFlavorsInLocalQueue"):
+            seen: list[str] = []
+            for rg in cq.resource_groups:
+                for fq in rg.flavors:
+                    if fq.name not in seen:
+                        seen.append(fq.name)
+            st.flavors = seen
+
+        # AFS consumed-usage sample (localqueue_controller.go:227-239)
+        if self.afs is not None and features.enabled(
+                "AdmissionFairSharing"):
+            st.fair_sharing_usage = self.afs.lq_usage(lq_key, now)
+
+        self.status[lq_key] = st
+        if metrics._lq_metrics_enabled():
+            metrics.local_queue_status.set(
+                lq.name, lq.namespace, "active",
+                value=1.0 if st.active else 0.0)
+        return st
+
+    def reconcile_all(self, now: float = 0.0) -> dict[str, LQStatus]:
+        for key in list(self.status):
+            if key not in self.store.local_queues:
+                self.status.pop(key, None)
+        return {key: self.reconcile(key, now)
+                for key in self.store.local_queues}
+
+
+# ---------------------------------------------------------------------------
+# Cohort
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CohortStatus:
+    """cohort_controller.go Reconcile: validity + fair-sharing share."""
+
+    active: bool = True
+    reason: str = "Ready"
+    message: str = ""
+    #: rounded weighted share when fair sharing is on (status.fairSharing)
+    weighted_share: Optional[int] = None
+
+
+class CohortReconciler:
+    """Validates cohort parent edges and publishes the subtree's
+    fair-sharing weighted share (cohort_controller.go)."""
+
+    def __init__(self, store: Store, fair_sharing_enabled: bool = False,
+                 snapshot_fn=None) -> None:
+        self.store = store
+        self.fair_sharing_enabled = fair_sharing_enabled
+        #: () -> Snapshot, for weighted-share computation
+        self.snapshot_fn = snapshot_fn
+        self.status: dict[str, CohortStatus] = {}
+
+    def _has_cycle(self, name: str) -> bool:
+        seen: set[str] = set()
+        cur: Optional[str] = name
+        while cur:
+            if cur in seen:
+                return True
+            seen.add(cur)
+            co = self.store.cohorts.get(cur)
+            cur = co.parent if co is not None else None
+        return False
+
+    def reconcile(self, name: str) -> CohortStatus:
+        st = CohortStatus()
+        if name not in self.store.cohorts:
+            self.status.pop(name, None)
+            return CohortStatus(active=False, reason="NotFound")
+        if self._has_cycle(name):
+            st = CohortStatus(
+                active=False, reason="CohortCycleDetected",
+                message=f"cohort {name} is part of a parent cycle")
+        elif self.fair_sharing_enabled and self.snapshot_fn is not None:
+            from kueue_oss_tpu.core.quota import dominant_resource_share
+
+            snap = self.snapshot_fn()
+            node = snap.forest.nodes.get(f"cohort/{name}")
+            if node is not None:
+                drs = dominant_resource_share(node)
+                st.weighted_share = drs.rounded_weighted_share()
+        self.status[name] = st
+        return st
+
+    def reconcile_all(self) -> dict[str, CohortStatus]:
+        return {name: self.reconcile(name)
+                for name in list(self.store.cohorts)}
+
+
+# ---------------------------------------------------------------------------
+# AdmissionCheck
+# ---------------------------------------------------------------------------
+
+
+class AdmissionCheckReconciler:
+    """Maintains per-check Active conditions: a check is Active when a
+    controller is registered for its controllerName
+    (admissioncheck_controller.go:90-124); flips feed the CQ
+    reconciler the way the reference notifies the cache."""
+
+    def __init__(self, store: Store, cq_reconciler=None) -> None:
+        self.store = store
+        self.cq_reconciler = cq_reconciler
+        #: controllerName values with a live controller
+        self.registered_controllers: set[str] = set()
+        self.active: dict[str, bool] = {}
+
+    def register_controller(self, controller_name: str) -> None:
+        self.registered_controllers.add(controller_name)
+
+    def unregister_controller(self, controller_name: str) -> None:
+        self.registered_controllers.discard(controller_name)
+
+    def reconcile(self, name: str) -> bool:
+        ac = self.store.admission_checks.get(name)
+        if ac is None:
+            self.active.pop(name, None)
+            return False
+        is_active = (not ac.controller_name
+                     or ac.controller_name in self.registered_controllers)
+        was = self.active.get(name)
+        self.active[name] = is_active
+        ac.status.active = is_active
+        # `was is None` (first reconcile) must notify too: the check's
+        # default-True status may have let referencing CQs go Active
+        # before this reconciler ever ran
+        if was != is_active and self.cq_reconciler is not None:
+            # notify CQs referencing this check (NotifyAdmissionCheckUpdate)
+            for cq in self.store.cluster_queues.values():
+                if name in getattr(cq, "admission_checks", []):
+                    self.cq_reconciler.reconcile(cq.name)
+        return is_active
+
+    def reconcile_all(self) -> dict[str, bool]:
+        return {name: self.reconcile(name)
+                for name in list(self.store.admission_checks)}
+
+
+# ---------------------------------------------------------------------------
+# ResourceFlavor
+# ---------------------------------------------------------------------------
+
+
+class ResourceFlavorReconciler:
+    """Finalizer semantics: a flavor referenced by any ClusterQueue
+    cannot be deleted; deletion is deferred until the last reference is
+    gone (resourceflavor_controller.go Reconcile)."""
+
+    def __init__(self, store: Store, cq_reconciler=None) -> None:
+        self.store = store
+        self.cq_reconciler = cq_reconciler
+        #: flavors whose deletion awaits release
+        self.pending_deletion: set[str] = set()
+
+    def in_use_by(self, flavor: str) -> list[str]:
+        out = []
+        for cq in self.store.cluster_queues.values():
+            for rg in cq.resource_groups:
+                if any(fq.name == flavor for fq in rg.flavors):
+                    out.append(cq.name)
+                    break
+        return sorted(out)
+
+    def request_deletion(self, flavor: str) -> bool:
+        """True if deleted now; False if deferred behind references."""
+        if flavor not in self.store.resource_flavors:
+            return True
+        if self.in_use_by(flavor):
+            self.pending_deletion.add(flavor)
+            return False
+        self._delete(flavor)
+        return True
+
+    def _delete(self, flavor: str) -> None:
+        self.store.resource_flavors.pop(flavor, None)
+        self.pending_deletion.discard(flavor)
+        if self.cq_reconciler is not None:
+            for cq in self.store.cluster_queues.values():
+                self.cq_reconciler.reconcile(cq.name)
+
+    def reconcile_all(self) -> None:
+        for flavor in list(self.pending_deletion):
+            if not self.in_use_by(flavor):
+                self._delete(flavor)
+
+
+# ---------------------------------------------------------------------------
+# WorkloadPriorityClass
+# ---------------------------------------------------------------------------
+
+
+class WorkloadPriorityClassReconciler:
+    """Propagates priority-class value changes to the workloads that
+    reference the class (workloadpriorityclass_controller.go — the
+    reference re-enqueues owning workloads on update)."""
+
+    def __init__(self, store: Store, queues=None) -> None:
+        self.store = store
+        self.queues = queues
+
+    def reconcile(self, name: str) -> int:
+        """Sync priorities from the class; returns workloads updated."""
+        pc = self.store.priority_classes.get(name)
+        if pc is None:
+            return 0
+        n = 0
+        for wl in self.store.workloads.values():
+            if wl.priority_class == name and wl.priority != pc.value:
+                wl.priority = pc.value
+                self.store.update_workload(wl)
+                n += 1
+        return n
+
+    def reconcile_all(self) -> int:
+        return sum(self.reconcile(name)
+                   for name in list(self.store.priority_classes))

@@ -74,7 +74,7 @@ class ClusterQueueReconciler:
             ac = self.store.admission_checks.get(ac_name)
             if ac is None:
                 missing_checks.append(ac_name)
-            elif not getattr(ac, "active", True):
+            elif not ac.status.active:
                 inactive_checks.append(ac_name)
         if cq.stop_policy != StopPolicy.NONE:
             st = CQStatus(False, R_STOPPED, "ClusterQueue is stopped")

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import threading
 from typing import Iterable, Optional
 
 from kueue_oss_tpu.api.types import QueueingStrategy, StopPolicy, Workload
@@ -225,6 +226,11 @@ class QueueManager:
 
     def __init__(self, store: Store, afs=None) -> None:
         self.store = store
+        #: guards all queue mutations; the condition signals new pending
+        #: work the way the reference's manager blocks scheduler Heads()
+        #: on a sync.Cond (manager.go Heads/CleanUpOnContext)
+        self._mu = threading.RLock()
+        self._cond = threading.Condition(self._mu)
         self.queues: dict[str, ClusterQueuePendingQueue] = {}
         self.cycle = 0
         #: CQs whose pending counts changed since the last drain
@@ -303,6 +309,11 @@ class QueueManager:
             q.afs_key = None
 
     def _on_event(self, event) -> None:
+        with self._mu:
+            self._on_event_locked(event)
+            self._cond.notify_all()
+
+    def _on_event_locked(self, event) -> None:
         verb, kind, obj = event
         if kind == "ClusterQueue":
             if verb == "delete":
@@ -313,17 +324,19 @@ class QueueManager:
             self.add_cluster_queue(obj.name)
             self.queues[obj.name].queue_inadmissible(self.cycle)
         elif kind == "LocalQueue":
+            # list(...) snapshots: watchers run outside Store._lock, so a
+            # concurrent add_workload may mutate the dict mid-iteration
             if verb == "delete":
                 # Workloads of a deleted LQ are no longer schedulable.
                 q = self.queues.get(obj.cluster_queue)
                 if q is not None:
-                    for wl in self.store.workloads.values():
+                    for wl in list(self.store.workloads.values()):
                         if (wl.namespace == obj.namespace
                                 and wl.queue_name == obj.name):
                             q.delete(wl.key)
                 return
             # Resume/stop of an LQ re-evaluates its pending workloads.
-            for wl in self.store.workloads.values():
+            for wl in list(self.store.workloads.values()):
                 if (wl.namespace == obj.namespace
                         and wl.queue_name == obj.name):
                     self.add_or_update_workload(wl)
@@ -353,6 +366,13 @@ class QueueManager:
 
     def add_or_update_workload(self, wl: Workload) -> bool:
         """Queue a workload if it is pending (active, no quota reserved)."""
+        with self._mu:
+            queued = self._add_or_update_locked(wl)
+            if queued:
+                self._cond.notify_all()
+            return queued
+
+    def _add_or_update_locked(self, wl: Workload) -> bool:
         cq = self._cq_for(wl)
         if cq is None:
             return False
@@ -380,22 +400,27 @@ class QueueManager:
 
     def requeue_workload(self, info: WorkloadInfo, reason: str) -> bool:
         """Re-fetch latest object state and requeue (manager.go:645)."""
-        wl = self.store.workloads.get(info.key)
-        if (wl is None or not wl.active or wl.is_quota_reserved
-                or wl.is_finished or self._local_queue_stopped(wl)):
-            return False
-        fresh = WorkloadInfo(wl, cluster_queue=info.cluster_queue)
-        fresh.last_assignment = info.last_assignment
-        q = self.queues.get(info.cluster_queue)
-        if q is None:
-            return False
-        return q.requeue_if_not_present(
-            fresh, reason, pop_cycle=getattr(info, "pop_cycle", -1))
+        with self._mu:
+            wl = self.store.workloads.get(info.key)
+            if (wl is None or not wl.active or wl.is_quota_reserved
+                    or wl.is_finished or self._local_queue_stopped(wl)):
+                return False
+            fresh = WorkloadInfo(wl, cluster_queue=info.cluster_queue)
+            fresh.last_assignment = info.last_assignment
+            q = self.queues.get(info.cluster_queue)
+            if q is None:
+                return False
+            requeued = q.requeue_if_not_present(
+                fresh, reason, pop_cycle=getattr(info, "pop_cycle", -1))
+            if requeued:
+                self._cond.notify_all()
+            return requeued
 
     def delete_workload(self, wl: Workload) -> None:
-        cq = self._cq_for(wl)
-        if cq is not None:
-            self.queues[cq].delete(wl.key)
+        with self._mu:
+            cq = self._cq_for(wl)
+            if cq is not None:
+                self.queues[cq].delete(wl.key)
 
     # -- heads -------------------------------------------------------------
 
@@ -405,16 +430,31 @@ class QueueManager:
         Non-popped entries stay; non-admitted heads must be requeued by the
         scheduler (mirrors Heads+requeue contract of the reference cycle).
         """
-        self.cycle += 1
-        out: list[WorkloadInfo] = []
-        for q in self.queues.values():
-            if not q.active:
-                continue
-            head = q.pop_head()
-            if head is not None:
-                head.pop_cycle = self.cycle
-                out.append(head)
-        return out
+        with self._mu:
+            self.cycle += 1
+            out: list[WorkloadInfo] = []
+            for q in self.queues.values():
+                if not q.active:
+                    continue
+                head = q.pop_head()
+                if head is not None:
+                    head.pop_cycle = self.cycle
+                    out.append(head)
+            return out
+
+    def wait_for_pending(self, timeout: Optional[float] = None) -> bool:
+        """Block until some queue has pending work (or timeout); the
+        reference scheduler blocks in manager.Heads() the same way."""
+        with self._cond:
+            if self.has_pending():
+                return True
+            self._cond.wait(timeout)
+            return self.has_pending()
+
+    def wakeup(self) -> None:
+        """Wake any blocked wait_for_pending (shutdown / external nudge)."""
+        with self._cond:
+            self._cond.notify_all()
 
     def has_pending(self) -> bool:
         return any(len(q._in_heap) > 0 for q in self.queues.values() if q.active)
@@ -473,7 +513,7 @@ class QueueManager:
 
         my_root = root_of(spec.cohort)
         return [
-            name for name, other in self.store.cluster_queues.items()
+            name for name, other in list(self.store.cluster_queues.items())
             if other.cohort and root_of(other.cohort) == my_root
         ]
 
@@ -483,10 +523,12 @@ class QueueManager:
         Called when capacity may have freed (workload finished/evicted) —
         reference: QueueAssociatedInadmissibleWorkloadsAfter.
         """
-        for member in self._cohort_members(cq_name):
-            q = self.queues.get(member)
-            if q is not None:
-                q.queue_inadmissible(self.cycle)
+        with self._mu:
+            for member in self._cohort_members(cq_name):
+                q = self.queues.get(member)
+                if q is not None:
+                    q.queue_inadmissible(self.cycle)
+            self._cond.notify_all()
 
     def report_workload_finished(self, wl: Workload) -> None:
         cq = self._cq_for(wl)

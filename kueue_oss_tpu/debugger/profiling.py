@@ -1,0 +1,273 @@
+"""Profiling + tracing endpoints.
+
+Reference parity: the reference exposes Go pprof via the manager's
+pprofBindAddress (apis/config PprofBindAddress; pkg/config/config_test.go
+:251) and structured per-phase log timing. The Python analogs here:
+
+- `Profiler`: cProfile sessions with pstats summaries — the
+  /debug/pprof/profile equivalent for the host scheduling path;
+- `Tracer`: lightweight span recording with Chrome-trace JSON export
+  (chrome://tracing / Perfetto-loadable, the same workflow used for
+  JAX/XLA device traces), wired into the scheduler's cycle phases via
+  `attach_to_scheduler`.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import json
+import pstats
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+class Profiler:
+    """cProfile session manager (pprof 'profile' endpoint analog)."""
+
+    def __init__(self) -> None:
+        self._profile: Optional[cProfile.Profile] = None
+        self._lock = threading.Lock()
+
+    @property
+    def running(self) -> bool:
+        return self._profile is not None
+
+    def start(self) -> None:
+        with self._lock:
+            if self._profile is not None:
+                raise RuntimeError("profiler already running")
+            self._profile = cProfile.Profile()
+            self._profile.enable()
+
+    def stop(self, top: int = 30, sort: str = "cumulative") -> str:
+        """Stop and return a pstats text summary of the top functions."""
+        with self._lock:
+            if self._profile is None:
+                raise RuntimeError("profiler not running")
+            self._profile.disable()
+            buf = io.StringIO()
+            stats = pstats.Stats(self._profile, stream=buf)
+            stats.sort_stats(sort).print_stats(top)
+            self._profile = None
+            return buf.getvalue()
+
+    @contextmanager
+    def profile(self, top: int = 30):
+        """Context manager yielding a result holder; holder['report']
+        has the summary after the block exits."""
+        holder: dict = {}
+        self.start()
+        try:
+            yield holder
+        finally:
+            holder["report"] = self.stop(top=top)
+
+
+class SamplingProfiler:
+    """Statistical whole-process profiler (py-spy style).
+
+    cProfile instruments only the calling thread, so it cannot see a
+    scheduler serving in its own thread. This sampler walks
+    ``sys._current_frames()`` — every thread's live stack — at a fixed
+    interval and aggregates leaf/stack counts; it is what the
+    /debug/pprof/profile endpoint uses.
+    """
+
+    def __init__(self, interval: float = 0.005,
+                 max_depth: int = 40) -> None:
+        self.interval = interval
+        self.max_depth = max_depth
+
+    def sample_for(self, seconds: float, top: int = 30) -> str:
+        me = threading.get_ident()
+        leaf_counts: dict[str, int] = {}
+        stack_counts: dict[tuple, int] = {}
+        samples = 0
+        end = time.monotonic() + seconds
+        while time.monotonic() < end:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                stack = []
+                f = frame
+                while f is not None and len(stack) < self.max_depth:
+                    code = f.f_code
+                    stack.append(
+                        f"{code.co_name} "
+                        f"({code.co_filename.rsplit('/', 1)[-1]}"
+                        f":{f.f_lineno})")
+                    f = f.f_back
+                if not stack:
+                    continue
+                samples += 1
+                leaf_counts[stack[0]] = leaf_counts.get(stack[0], 0) + 1
+                key = tuple(reversed(stack))
+                stack_counts[key] = stack_counts.get(key, 0) + 1
+            time.sleep(self.interval)
+        lines = [f"{samples} samples over {seconds:.2f}s "
+                 f"({self.interval * 1000:.0f}ms interval)", "",
+                 "top functions (leaf samples):"]
+        for name, n in sorted(leaf_counts.items(),
+                              key=lambda kv: -kv[1])[:top]:
+            lines.append(f"  {n:6d}  {name}")
+        lines += ["", "top stacks:"]
+        for stack, n in sorted(stack_counts.items(),
+                               key=lambda kv: -kv[1])[:5]:
+            lines.append(f"  {n:6d} samples:")
+            for fr in stack[-10:]:
+                lines.append(f"          {fr}")
+        return "\n".join(lines)
+
+
+class Tracer:
+    """Span recorder with Chrome-trace export.
+
+    Bounded ring of spans; thread-safe; zero overhead when disabled.
+    """
+
+    def __init__(self, max_spans: int = 100_000,
+                 clock=time.perf_counter) -> None:
+        self.max_spans = max_spans
+        self.clock = clock
+        self.enabled = True
+        self._lock = threading.Lock()
+        #: (name, thread id, start_us, duration_us, args)
+        self._spans: list[tuple] = []
+
+    @contextmanager
+    def span(self, name: str, **args):
+        if not self.enabled:
+            yield
+            return
+        t0 = self.clock()
+        try:
+            yield
+        finally:
+            dur = self.clock() - t0
+            with self._lock:
+                if len(self._spans) < self.max_spans:
+                    self._spans.append(
+                        (name, threading.get_ident(),
+                         int(t0 * 1e6), int(dur * 1e6), args or None))
+
+    def spans(self) -> list[tuple]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def durations_ms(self, name: str) -> list[float]:
+        return [dur / 1000 for (n, _, _, dur, _) in self.spans()
+                if n == name]
+
+    def chrome_trace(self) -> str:
+        """Chrome-trace JSON ('X' complete events) — loadable in
+        chrome://tracing or Perfetto alongside a JAX device trace."""
+        events = []
+        for name, tid, ts, dur, args in self.spans():
+            ev = {"name": name, "ph": "X", "pid": 1, "tid": tid,
+                  "ts": ts, "dur": dur, "cat": "scheduler"}
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        return json.dumps({"traceEvents": events,
+                           "displayTimeUnit": "ms"})
+
+
+class DebugServer:
+    """HTTP debug endpoints (the pprofBindAddress analog):
+
+    - ``GET /debug/pprof/profile?seconds=S`` — profile the process for
+      S seconds, return the pstats summary;
+    - ``GET /debug/trace`` — the tracer's Chrome-trace JSON;
+    - ``GET /debug/trace/clear`` — reset the span ring.
+    """
+
+    def __init__(self, tracer: Optional[Tracer] = None,
+                 port: int = 0) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from urllib.parse import parse_qs, urlparse
+
+        self.tracer = tracer
+        sampler = SamplingProfiler()
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, body: str,
+                       ctype: str = "text/plain") -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:
+                url = urlparse(self.path)
+                if url.path == "/debug/pprof/profile":
+                    qs = parse_qs(url.query)
+                    try:
+                        seconds = float(qs.get("seconds", ["1"])[0])
+                    except ValueError:
+                        self._reply(400, "seconds must be a number")
+                        return
+                    if not 0 < seconds <= 60:
+                        self._reply(400, "seconds must be in (0, 60]")
+                        return
+                    # sampling profiler: sees every thread's stack, not
+                    # just this handler thread (cProfile would not)
+                    self._reply(200, sampler.sample_for(seconds))
+                elif url.path == "/debug/trace":
+                    if outer.tracer is None:
+                        self._reply(404, "no tracer attached")
+                    else:
+                        self._reply(200, outer.tracer.chrome_trace(),
+                                    "application/json")
+                elif url.path == "/debug/trace/clear":
+                    if outer.tracer is not None:
+                        outer.tracer.clear()
+                    self._reply(200, "ok")
+                else:
+                    self._reply(404, "not found")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def attach_to_scheduler(scheduler, tracer: Tracer) -> None:
+    """Wrap the scheduler's cycle phases in tracer spans: one
+    'schedule' span per cycle with nested 'snapshot' / 'nominate'
+    phases (the reference logs per-phase durations at V(2))."""
+    orig_schedule = scheduler.schedule
+    orig_nominate = scheduler._nominate
+
+    def schedule(now=None):
+        with tracer.span("schedule", cycle=scheduler.cycle_count + 1):
+            return orig_schedule(now)
+
+    def nominate(heads, snapshot, now):
+        with tracer.span("nominate", heads=len(heads)):
+            return orig_nominate(heads, snapshot, now)
+
+    scheduler.schedule = schedule
+    scheduler._nominate = nominate

@@ -52,6 +52,11 @@ _DEFAULTS: dict[str, bool] = {
     "MultiKueueOrchestratedPreemption": False,  # scheduler gate check
     # BestEffortFIFO NoFit equivalence-class dedup (kube_features.go)
     "SchedulingEquivalenceHashing": True,  # queue_manager no-fit hashes
+    # LocalQueue status lists usable flavors (kube_features.go)
+    "ExposeFlavorsInLocalQueue": True,  # core_controllers LQ status
+    # namespace selector bounds queue-named jobs too (kube_features.go
+    # :163-166, beta default true since 0.14)
+    "ManagedJobsNamespaceSelectorAlwaysRespected": True,  # jobframework
 }
 
 _lock = threading.Lock()

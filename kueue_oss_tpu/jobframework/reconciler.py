@@ -42,11 +42,15 @@ class JobReconciler:
     def __init__(self, store: Store, scheduler: Scheduler,
                  manager: IntegrationManager = integration_manager,
                  manage_jobs_without_queue_name: bool = False,
+                 managed_jobs_namespace_selector=None,
                  workload_reconciler=None) -> None:
         self.store = store
         self.scheduler = scheduler
         self.manager = manager
         self.manage_jobs_without_queue_name = manage_jobs_without_queue_name
+        #: namespace -> bool predicate (the reference's label selector
+        #: over Namespace objects, reconciler.go:96)
+        self.managed_jobs_namespace_selector = managed_jobs_namespace_selector
         #: optional WorkloadReconciler for PodsReady propagation
         self.workload_reconciler = workload_reconciler
         #: jobs under management, keyed "namespace/name" per kind
@@ -91,6 +95,17 @@ class JobReconciler:
 
         if not job.queue_name and not self.manage_jobs_without_queue_name:
             return
+        # namespace opt-in (reconciler.go:342-358, :398-410): the
+        # selector always bounds manageJobsWithoutQueueName; with the
+        # AlwaysRespected gate it bounds queue-named jobs too
+        from kueue_oss_tpu import features
+
+        selector = self.managed_jobs_namespace_selector
+        if selector is not None and not selector(job.namespace):
+            if not job.queue_name:
+                return
+            if features.enabled("ManagedJobsNamespaceSelectorAlwaysRespected"):
+                return
 
         if workloadslicing.enabled(job):
             self._reconcile_elastic(job, now)

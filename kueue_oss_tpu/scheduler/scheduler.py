@@ -340,6 +340,42 @@ class Scheduler:
     def _queue_fingerprint(self):
         return self.queues.membership_fingerprint()
 
+    def serve(self, stop, poll: float = 0.05,
+              clock=None, backoff=None) -> int:
+        """Event-driven scheduler loop for threaded deployments: block on
+        the queue manager's condition until pending work arrives, run a
+        cycle, repeat until `stop` is set (the reference scheduler
+        blocks in manager.Heads() the same way, and wraps the cycle in
+        untilWithBackoff). A cycle that makes NO progress — heads that
+        immediately requeue (StrictFIFO blocked head, pending
+        preemption) keep the queues non-empty — signals SlowDown: the
+        loop sleeps on an exponential backoff instead of spinning, and
+        any queue event resets it. Returns cycles run."""
+        import time as _time
+
+        from kueue_oss_tpu.util.primitives import Backoff
+
+        clock = clock or _time.monotonic
+        backoff = backoff or Backoff(initial=0.002, cap=max(poll, 0.002),
+                                     factor=2.0)
+        cycles = 0
+        idle_rounds = 0
+        while not stop.is_set():
+            if not self.queues.wait_for_pending(timeout=poll):
+                # timeout: re-check stop, serve due requeues/second pass
+                self.requeue_due(clock())
+                continue
+            pre = self._queue_fingerprint()
+            stats = self.schedule(now=clock())
+            cycles += 1
+            if (stats.admitted or stats.preempted
+                    or self._queue_fingerprint() != pre):
+                idle_rounds = 0  # KeepGoing
+            else:
+                idle_rounds += 1  # SlowDown
+                stop.wait(backoff.wait_time(idle_rounds))
+        return cycles
+
     # ------------------------------------------------------------------
     # Nomination
     # ------------------------------------------------------------------

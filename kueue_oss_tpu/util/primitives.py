@@ -1,0 +1,130 @@
+"""Primitive concurrency/retry utilities.
+
+Reference parity: pkg/util/parallelize/parallelize.go (bounded fan-out
+with first-error propagation), pkg/util/routine/wrapper.go (hooked
+goroutine spawner), pkg/util/wait/backoff.go (exponential backoff +
+SpeedSignal-driven polling loop).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+#: parallelize.go maxParallelism
+MAX_PARALLELISM = 8
+
+
+def parallelize_until(n: int, fn: Callable[[int], None],
+                      max_workers: int = MAX_PARALLELISM) -> None:
+    """Run fn(0..n-1) over a bounded worker pool; the FIRST exception
+    wins and is re-raised after all workers drain (parallelize.Until +
+    ErrorChannel: one buffered error slot, later errors dropped)."""
+    if n <= 0:
+        return
+    first_error: list[BaseException] = []
+    lock = threading.Lock()
+
+    def run(i: int) -> None:
+        try:
+            fn(i)
+        except BaseException as e:  # noqa: BLE001 - propagated below
+            with lock:
+                if not first_error:
+                    first_error.append(e)
+
+    if n == 1 or max_workers <= 1:
+        for i in range(n):
+            run(i)
+    else:
+        with ThreadPoolExecutor(max_workers=min(max_workers, n)) as pool:
+            list(pool.map(run, range(n)))
+    if first_error:
+        raise first_error[0]
+
+
+class RoutineWrapper:
+    """routine.Wrapper: spawn work with before/after hooks — the
+    reference uses it to attach leader-demotion guards around scheduler
+    goroutines."""
+
+    def __init__(self, before: Optional[Callable[[], None]] = None,
+                 after: Optional[Callable[[], None]] = None) -> None:
+        self.before = before
+        self.after = after
+
+    def run(self, f: Callable[[], None]) -> threading.Thread:
+        if self.before is not None:
+            self.before()
+
+        def body() -> None:
+            try:
+                f()
+            finally:
+                if self.after is not None:
+                    self.after()
+
+        t = threading.Thread(target=body, daemon=True)
+        t.start()
+        return t
+
+
+class Backoff:
+    """wait.Backoff analog: exponential growth with cap and jitter.
+
+    wait_time(iteration) returns the duration for the i-th retry
+    (backoff.go:44-53): initial * factor^(i-1), capped, with
+    `jitter`-fraction uniform noise added.
+    """
+
+    def __init__(self, initial: float, cap: float = 0.0,
+                 factor: float = 2.0, jitter: float = 0.0,
+                 rng: Optional[random.Random] = None) -> None:
+        if initial <= 0 or factor < 1.0:
+            raise ValueError("initial must be > 0 and factor >= 1")
+        self.initial = initial
+        self.cap = cap or float("inf")
+        self.factor = factor
+        self.jitter = jitter
+        self.rng = rng or random.Random()
+
+    def wait_time(self, iteration: int) -> float:
+        if iteration <= 0:
+            return 0.0
+        duration = min(self.initial * self.factor ** (iteration - 1),
+                       self.cap)
+        if self.jitter > 0:
+            duration += duration * self.jitter * self.rng.random()
+        return min(duration, self.cap * (1 + self.jitter))
+
+
+class SpeedSignal:
+    """backoff.go SpeedSignal: the loop body reports whether to keep
+    the current cadence or slow down."""
+
+    KEEP_GOING = "KeepGoing"
+    SLOW_DOWN = "SlowDown"
+
+
+def until_with_backoff(f: Callable[[], str], backoff: Backoff,
+                       stop: Callable[[], bool],
+                       sleep: Callable[[float], None] = time.sleep) -> int:
+    """Run f repeatedly until stop(); SlowDown signals stack the
+    backoff iteration, KeepGoing resets it (backoff.go
+    UntilWithBackoff). Returns the number of invocations."""
+    iteration = 0
+    calls = 0
+    while not stop():
+        signal = f()
+        calls += 1
+        if signal == SpeedSignal.KEEP_GOING:
+            iteration = 0
+        else:
+            iteration += 1
+        if stop():
+            break
+        sleep(backoff.wait_time(iteration))
+    return calls

@@ -1,0 +1,335 @@
+"""Core reconcilers (LocalQueue/Cohort/AdmissionCheck/ResourceFlavor/
+WorkloadPriorityClass) + primitive utilities + managed-namespace
+selector.
+
+Mirrors pkg/controller/core/*_test.go scenario shapes.
+"""
+
+import threading
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    StopPolicy,
+    Workload,
+    WorkloadPriorityClass,
+    PodSet,
+)
+from kueue_oss_tpu.controllers import (
+    AdmissionCheckReconciler,
+    ClusterQueueReconciler,
+    CohortReconciler,
+    LocalQueueReconciler,
+    ResourceFlavorReconciler,
+    WorkloadPriorityClassReconciler,
+    WorkloadReconciler,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.snapshot import build_snapshot
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.jobframework import JobReconciler
+from kueue_oss_tpu.jobs import BatchJob
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.util.primitives import (
+    Backoff,
+    RoutineWrapper,
+    SpeedSignal,
+    parallelize_until,
+    until_with_backoff,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_gates():
+    yield
+    features.reset()
+
+
+def make_store():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=4000)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    return store
+
+
+def submit(store, name, cpu=1000, queue="lq", priority_class=None):
+    wl = Workload(name=name, queue_name=queue,
+                  priority_class=priority_class,
+                  podsets=[PodSet(name="main", count=1,
+                                  requests={"cpu": cpu})])
+    store.add_workload(wl)
+    return wl
+
+
+# -- LocalQueue --------------------------------------------------------------
+
+
+class TestLocalQueueReconciler:
+    def test_active_with_counts(self):
+        store = make_store()
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        submit(store, "a", cpu=3000)
+        submit(store, "b", cpu=3000)
+        sched.schedule(1.0)  # admits one, second pends
+
+        cqr = ClusterQueueReconciler(store, queues)
+        cqr.reconcile_all()
+        lqr = LocalQueueReconciler(store, queues, cq_reconciler=cqr)
+        st = lqr.reconcile("default/lq")
+        assert st.active and st.reason == "Ready"
+        assert st.reserving_workloads == 1
+        assert st.admitted_workloads == 1
+        assert st.pending_workloads == 1
+        assert st.flavors == ["default"], "ExposeFlavorsInLocalQueue"
+
+    def test_inactive_when_cq_missing_or_inactive(self):
+        store = make_store()
+        cqr = ClusterQueueReconciler(store)
+        lqr = LocalQueueReconciler(store, cq_reconciler=cqr)
+
+        store.upsert_local_queue(LocalQueue(name="orphan",
+                                            cluster_queue="nope"))
+        st = lqr.reconcile("default/orphan")
+        assert not st.active and st.reason == "ClusterQueueDoesNotExist"
+
+        # CQ goes inactive (missing flavor) -> LQ inactive
+        store.resource_flavors.clear()
+        cqr.reconcile_all()
+        st = lqr.reconcile("default/lq")
+        assert not st.active and st.reason == "ClusterQueueIsInactive"
+
+    def test_stopped_local_queue(self):
+        store = make_store()
+        lq = store.local_queues["default/lq"]
+        lq.stop_policy = StopPolicy.HOLD
+        cqr = ClusterQueueReconciler(store)
+        cqr.reconcile_all()
+        st = LocalQueueReconciler(store, cq_reconciler=cqr).reconcile(
+            "default/lq")
+        assert not st.active and st.reason == "Stopped"
+
+    def test_flavors_hidden_when_gate_off(self):
+        store = make_store()
+        features.set_gates({"ExposeFlavorsInLocalQueue": False})
+        cqr = ClusterQueueReconciler(store)
+        cqr.reconcile_all()
+        st = LocalQueueReconciler(store, cq_reconciler=cqr).reconcile(
+            "default/lq")
+        assert st.flavors == []
+
+
+# -- Cohort ------------------------------------------------------------------
+
+
+class TestCohortReconciler:
+    def test_cycle_detected(self):
+        store = make_store()
+        store.upsert_cohort(Cohort(name="a", parent="b"))
+        store.upsert_cohort(Cohort(name="b", parent="a"))
+        r = CohortReconciler(store)
+        st = r.reconcile("a")
+        assert not st.active and st.reason == "CohortCycleDetected"
+
+    def test_weighted_share_with_fair_sharing(self):
+        store = make_store()
+        store.upsert_cohort(Cohort(name="co"))
+        cq = store.cluster_queues["cq"]
+        cq.cohort = "co"
+        store.upsert_cluster_queue(cq)
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        submit(store, "a", cpu=2000)
+        sched.schedule(1.0)
+        r = CohortReconciler(
+            store, fair_sharing_enabled=True,
+            snapshot_fn=lambda: build_snapshot(store))
+        st = r.reconcile("co")
+        assert st.active and st.weighted_share is not None
+
+
+# -- AdmissionCheck ----------------------------------------------------------
+
+
+class TestAdmissionCheckReconciler:
+    def test_active_follows_registered_controllers(self):
+        store = make_store()
+        store.upsert_admission_check(AdmissionCheck(
+            name="prov", controller_name="kueue.x-k8s.io/provisioning"))
+        cqr = ClusterQueueReconciler(store)
+        acr = AdmissionCheckReconciler(store, cq_reconciler=cqr)
+        assert acr.reconcile("prov") is False
+
+        cq = store.cluster_queues["cq"]
+        cq.admission_checks = ["prov"]
+        store.upsert_cluster_queue(cq)
+        cqr.reconcile_all()
+        assert cqr.status["cq"].reason == "AdmissionCheckInactive"
+
+        acr.register_controller("kueue.x-k8s.io/provisioning")
+        assert acr.reconcile("prov") is True
+        # flip notifies the CQ reconciler
+        assert cqr.status["cq"].active
+
+    def test_check_without_controller_name_is_active(self):
+        store = make_store()
+        store.upsert_admission_check(AdmissionCheck(name="manual"))
+        acr = AdmissionCheckReconciler(store)
+        assert acr.reconcile("manual") is True
+
+
+# -- ResourceFlavor ----------------------------------------------------------
+
+
+class TestResourceFlavorReconciler:
+    def test_deletion_deferred_while_referenced(self):
+        store = make_store()
+        cqr = ClusterQueueReconciler(store)
+        r = ResourceFlavorReconciler(store, cq_reconciler=cqr)
+        assert r.in_use_by("default") == ["cq"]
+        assert r.request_deletion("default") is False
+        assert "default" in store.resource_flavors
+
+        # release the reference; the deferred deletion completes
+        cq = store.cluster_queues["cq"]
+        cq.resource_groups = []
+        store.upsert_cluster_queue(cq)
+        r.reconcile_all()
+        assert "default" not in store.resource_flavors
+
+    def test_unreferenced_flavor_deletes_immediately(self):
+        store = make_store()
+        store.upsert_resource_flavor(ResourceFlavor(name="spare"))
+        r = ResourceFlavorReconciler(store)
+        assert r.request_deletion("spare") is True
+        assert "spare" not in store.resource_flavors
+
+
+# -- WorkloadPriorityClass ---------------------------------------------------
+
+
+class TestWorkloadPriorityClassReconciler:
+    def test_value_change_propagates(self):
+        store = make_store()
+        store.upsert_priority_class(WorkloadPriorityClass(
+            name="high", value=100))
+        wl = submit(store, "a", priority_class="high")
+        assert wl.priority == 100
+        store.upsert_priority_class(WorkloadPriorityClass(
+            name="high", value=250))
+        r = WorkloadPriorityClassReconciler(store)
+        assert r.reconcile("high") == 1
+        assert store.workloads[wl.key].priority == 250
+
+
+# -- managed-jobs namespace selector -----------------------------------------
+
+
+class TestManagedNamespaceSelector:
+    def _env(self, **kwargs):
+        store = make_store()
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        jr = JobReconciler(store, sched, **kwargs)
+        return store, sched, jr
+
+    def test_selector_bounds_unlabeled_jobs(self):
+        store, sched, jr = self._env(
+            manage_jobs_without_queue_name=True,
+            managed_jobs_namespace_selector=lambda ns: ns == "prod")
+        job = BatchJob(name="j", namespace="dev", parallelism=1,
+                       requests={"cpu": 100})
+        jr.upsert_job(job)
+        jr.reconcile(job, 0.0)
+        assert jr.workload_for(job) is None, "dev namespace not opted in"
+
+        job2 = BatchJob(name="k", namespace="prod", parallelism=1,
+                        requests={"cpu": 100})
+        jr.upsert_job(job2)
+        jr.reconcile(job2, 0.0)
+        assert jr.workload_for(job2) is not None
+
+    def test_always_respected_gate_bounds_queue_named_jobs(self):
+        store, sched, jr = self._env(
+            managed_jobs_namespace_selector=lambda ns: ns == "prod")
+        job = BatchJob(name="j", namespace="dev", queue_name="lq",
+                       parallelism=1, requests={"cpu": 100})
+        jr.upsert_job(job)
+        jr.reconcile(job, 0.0)
+        assert jr.workload_for(job) is None, \
+            "AlwaysRespected gate excludes even queue-named jobs"
+
+        features.set_gates(
+            {"ManagedJobsNamespaceSelectorAlwaysRespected": False})
+        jr.reconcile(job, 0.0)
+        assert jr.workload_for(job) is not None, \
+            "with the gate off, queue-named jobs bypass the selector"
+
+
+# -- primitives --------------------------------------------------------------
+
+
+class TestPrimitives:
+    def test_parallelize_until_runs_all(self):
+        seen = set()
+        lock = threading.Lock()
+
+        def fn(i):
+            with lock:
+                seen.add(i)
+
+        parallelize_until(50, fn)
+        assert seen == set(range(50))
+
+    def test_parallelize_until_first_error_wins(self):
+        def fn(i):
+            if i == 7:
+                raise ValueError("boom")
+
+        with pytest.raises(ValueError):
+            parallelize_until(20, fn)
+
+    def test_routine_wrapper_hooks(self):
+        order = []
+        w = RoutineWrapper(before=lambda: order.append("before"),
+                           after=lambda: order.append("after"))
+        t = w.run(lambda: order.append("body"))
+        t.join(5)
+        assert order == ["before", "body", "after"]
+
+    def test_backoff_growth_and_cap(self):
+        b = Backoff(initial=1.0, cap=8.0, factor=2.0)
+        assert b.wait_time(0) == 0.0
+        assert [b.wait_time(i) for i in range(1, 6)] == [
+            1.0, 2.0, 4.0, 8.0, 8.0]
+
+    def test_until_with_backoff_slowdown_resets(self):
+        waits = []
+        signals = iter([SpeedSignal.SLOW_DOWN, SpeedSignal.SLOW_DOWN,
+                        SpeedSignal.KEEP_GOING, SpeedSignal.SLOW_DOWN])
+        n = [0]
+
+        def f():
+            n[0] += 1
+            return next(signals)
+
+        calls = until_with_backoff(
+            f, Backoff(initial=1.0, cap=4.0, factor=2.0),
+            stop=lambda: n[0] >= 4, sleep=waits.append)
+        assert calls == 4
+        # two slow-downs stack (1, 2), keep-going resets to 0
+        assert waits == [1.0, 2.0, 0.0]

@@ -1,0 +1,119 @@
+"""Profiling/tracing endpoints (pprof analog) tests."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.debugger.profiling import (
+    DebugServer,
+    Profiler,
+    Tracer,
+    attach_to_scheduler,
+)
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+def build():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=4000)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    queues = QueueManager(store)
+    return store, queues, Scheduler(store, queues)
+
+
+def test_profiler_produces_stats():
+    p = Profiler()
+    with p.profile(top=5) as holder:
+        sum(i * i for i in range(10000))
+    assert "function calls" in holder["report"]
+    assert not p.running
+
+
+def test_tracer_spans_scheduler_phases():
+    store, queues, sched = build()
+    tracer = Tracer()
+    attach_to_scheduler(sched, tracer)
+    for i in range(3):
+        store.add_workload(Workload(
+            name=f"w{i}", queue_name="lq",
+            podsets=[PodSet(name="main", count=1,
+                            requests={"cpu": 1000})]))
+    sched.run_until_quiet(now=0.0, tick=1.0)
+    names = {s[0] for s in tracer.spans()}
+    assert {"schedule", "nominate"} <= names
+    assert tracer.durations_ms("schedule")
+    trace = json.loads(tracer.chrome_trace())
+    assert trace["traceEvents"], "chrome trace has events"
+    assert all(ev["ph"] == "X" for ev in trace["traceEvents"])
+
+
+def test_debug_server_endpoints():
+    import threading
+    import time
+
+    tracer = Tracer()
+    with tracer.span("x"):
+        pass
+    srv = DebugServer(tracer=tracer)
+    srv.start()
+    # a busy background thread the sampler must observe
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            sum(i for i in range(1000))
+
+    t = threading.Thread(target=busy, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(
+            f"{base}/debug/pprof/profile?seconds=0.2").read().decode()
+        assert "samples over" in body
+        assert "busy" in body, "sampler must see other threads' stacks"
+        # invalid parameters are a 400, not a dropped connection
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{base}/debug/pprof/profile?seconds=abc")
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{base}/debug/pprof/profile?seconds=-1")
+        assert e.value.code == 400
+        trace = json.loads(urllib.request.urlopen(
+            f"{base}/debug/trace").read().decode())
+        assert trace["traceEvents"]
+        urllib.request.urlopen(f"{base}/debug/trace/clear")
+        trace = json.loads(urllib.request.urlopen(
+            f"{base}/debug/trace").read().decode())
+        assert trace["traceEvents"] == []
+    finally:
+        stop.set()
+        srv.stop()
+
+
+def test_tracer_disabled_records_nothing():
+    t = Tracer()
+    t.enabled = False
+    with t.span("x"):
+        pass
+    assert t.spans() == []
