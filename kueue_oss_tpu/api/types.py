@@ -458,6 +458,7 @@ class Workload:
     queue_name: str = ""  # LocalQueue name
     priority: int = 0
     priority_class: Optional[str] = None
+    labels: dict[str, str] = field(default_factory=dict)
     podsets: list[PodSet] = field(default_factory=list)
     #: spec.active=false deactivates the workload (reference: workload_types.go Active)
     active: bool = True

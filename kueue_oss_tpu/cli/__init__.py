@@ -81,6 +81,15 @@ class Kueuectl:
         clq.add_argument("-c", "--clusterqueue", required=True)
         clq.add_argument("-n", "--namespace", default="default")
         clq.set_defaults(func=self._create_lq)
+        crf = create.add_parser("resourceflavor")
+        crf.add_argument("name")
+        crf.add_argument("--node-labels", default="",
+                         help="key=value[,key=value...]")
+        crf.add_argument("--node-taints", default="",
+                         help="key=value:Effect[,...]")
+        crf.add_argument("--tolerations", default="",
+                         help="key=value:Effect[,...]")
+        crf.set_defaults(func=self._create_rf)
 
         lst = sub.add_parser("list").add_subparsers(required=True)
         lst.add_parser("clusterqueue").set_defaults(func=self._list_cq)
@@ -135,7 +144,29 @@ class Kueuectl:
         dwl.add_argument("name")
         dwl.add_argument("-n", "--namespace", default="default")
         dwl.set_defaults(func=self._delete_wl)
+
+        # passthrough verbs for object kinds without dedicated commands
+        # (cmd/kueuectl/app/passthrough: kubectl-delegated get/delete)
+        pt = sub.add_parser("get")
+        pt.add_argument("kind", choices=sorted(self._PASSTHROUGH))
+        pt.add_argument("name", nargs="?", default=None)
+        pt.set_defaults(func=self._passthrough_get)
+
+        dr = sub.add_parser("dryrun")
+        dr.add_argument("--max-cycles", type=int, default=1000)
+        dr.set_defaults(func=self._dryrun)
+
+        comp = sub.add_parser("completion")
+        comp.set_defaults(func=self._completion)
         return p
+
+    #: passthrough kinds -> store registry attribute
+    _PASSTHROUGH = {
+        "topology": "topologies",
+        "admissioncheck": "admission_checks",
+        "workloadpriorityclass": "priority_classes",
+        "node": "nodes",
+    }
 
     # -- create -------------------------------------------------------------
 
@@ -179,6 +210,107 @@ class Kueuectl:
             raise CliError(str(e)) from e
         self.store.upsert_local_queue(lq)
         return f"localqueue.kueue.x-k8s.io/{ns.name} created in {ns.namespace}"
+
+    def _create_rf(self, ns) -> str:
+        from kueue_oss_tpu.api.types import ResourceFlavor, Taint, Toleration
+
+        if ns.name in self.store.resource_flavors:
+            raise CliError(f"resourceflavor {ns.name!r} already exists")
+
+        def parse_kv(spec: str) -> dict[str, str]:
+            out = {}
+            for pair in filter(None, spec.split(",")):
+                k, sep, v = pair.partition("=")
+                if not sep:
+                    raise CliError(f"bad key=value entry {pair!r}")
+                out[k] = v
+            return out
+
+        def parse_taints(spec: str) -> list[Taint]:
+            out = []
+            for entry in filter(None, spec.split(",")):
+                kv, _, effect = entry.partition(":")
+                k, _, v = kv.partition("=")
+                out.append(Taint(key=k, value=v,
+                                 effect=effect or "NoSchedule"))
+            return out
+
+        rf = ResourceFlavor(
+            name=ns.name,
+            node_labels=parse_kv(ns.node_labels),
+            node_taints=parse_taints(ns.node_taints),
+            tolerations=[Toleration(key=t.key, value=t.value,
+                                    effect=t.effect)
+                         for t in parse_taints(ns.tolerations)],
+        )
+        self.store.upsert_resource_flavor(rf)
+        return f"resourceflavor.kueue.x-k8s.io/{ns.name} created"
+
+    # -- passthrough / dryrun / completion -----------------------------------
+
+    def _passthrough_get(self, ns) -> str:
+        registry = getattr(self.store, self._PASSTHROUGH[ns.kind])
+        if ns.name is not None:
+            obj = registry.get(ns.name)
+            if obj is None:
+                raise CliError(f"{ns.kind} {ns.name!r} not found")
+            return repr(obj)
+        rows = [[name] for name in sorted(registry)]
+        return _fmt_table(["NAME"], rows)
+
+    def _dryrun(self, ns) -> str:
+        """Simulate scheduling on a CLONE of the control plane and report
+        what would admit (cmd/kueuectl/app/dryrun — the reference spawns
+        a dry-run scheduler against the live caches)."""
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+        before = {k for k, w in self.store.workloads.items()
+                  if w.is_quota_reserved}
+        clone = self.store.clone()
+        # live eviction backoffs gate queueing on wall-clock deadlines
+        # the simulation's clock never reaches; a dry run asks "could it
+        # admit", so start pending workloads backoff-free
+        for wl in clone.workloads.values():
+            if not wl.is_quota_reserved:
+                wl.status.requeue_state = None
+        queues = QueueManager(clone)
+        sched = Scheduler(clone, queues)
+        cycles = sched.run_until_quiet(max_cycles=ns.max_cycles, now=0.0,
+                                       tick=1.0)
+        rows = []
+        for key, wl in sorted(clone.workloads.items()):
+            if wl.is_quota_reserved and key not in before:
+                cq = clone.cluster_queue_for(wl) or ""
+                flavors = ",".join(sorted(
+                    {f for psa in wl.status.admission.podset_assignments
+                     for f in psa.flavors.values()})) \
+                    if wl.status.admission else ""
+                rows.append([key, cq, flavors])
+        header = (f"dry run: {len(rows)} workload(s) would be admitted "
+                  f"in {cycles} cycle(s); no changes were made")
+        if not rows:
+            return header
+        return header + "\n" + _fmt_table(
+            ["WORKLOAD", "CLUSTERQUEUE", "FLAVORS"], rows)
+
+    def _completion(self, ns) -> str:
+        """Emit a bash completion function over the parser's verbs
+        (cmd/kueuectl/app/completion analog)."""
+        verbs = ("version create list describe stop resume delete get "
+                 "dryrun completion")
+        kinds = ("clusterqueue localqueue workload resourceflavor cohort "
+                 "pending-workloads " + " ".join(sorted(self._PASSTHROUGH)))
+        return (
+            "_kueuectl_completions() {\n"
+            "  local cur=${COMP_WORDS[COMP_CWORD]}\n"
+            "  if [ $COMP_CWORD -eq 1 ]; then\n"
+            f"    COMPREPLY=($(compgen -W \"{verbs}\" -- \"$cur\"))\n"
+            "  else\n"
+            f"    COMPREPLY=($(compgen -W \"{kinds}\" -- \"$cur\"))\n"
+            "  fi\n"
+            "}\n"
+            "complete -F _kueuectl_completions kueuectl\n")
 
     # -- list ---------------------------------------------------------------
 

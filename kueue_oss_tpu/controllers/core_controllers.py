@@ -55,7 +55,25 @@ class LocalQueueReconciler:
         self.afs = afs
         self.status: dict[str, LQStatus] = {}
 
-    def reconcile(self, lq_key: str, now: float = 0.0) -> LQStatus:
+    def _counts_by_lq(self) -> dict[tuple[str, str], tuple[int, int, int]]:
+        """One pass over workloads: (namespace, queue) -> (pending,
+        reserving, admitted). Keeps reconcile_all at O(W + LQ), not
+        O(LQ x W)."""
+        out: dict[tuple[str, str], list[int]] = {}
+        for wl in self.store.workloads.values():
+            if wl.is_finished:
+                continue
+            c = out.setdefault((wl.namespace, wl.queue_name), [0, 0, 0])
+            if wl.is_quota_reserved:
+                c[1] += 1
+                if wl.is_admitted:
+                    c[2] += 1
+            else:
+                c[0] += 1
+        return {k: tuple(v) for k, v in out.items()}
+
+    def reconcile(self, lq_key: str, now: float = 0.0,
+                  counts=None) -> LQStatus:
         lq = self.store.local_queues.get(lq_key)
         if lq is None:
             self.status.pop(lq_key, None)
@@ -87,17 +105,11 @@ class LocalQueueReconciler:
                     "clusterQueue")
 
         # workload counts (localqueue_controller.go status update)
-        for wl in self.store.workloads.values():
-            if (wl.namespace, wl.queue_name) != (lq.namespace, lq.name):
-                continue
-            if wl.is_finished:
-                continue
-            if wl.is_quota_reserved:
-                st.reserving_workloads += 1
-                if wl.is_admitted:
-                    st.admitted_workloads += 1
-            else:
-                st.pending_workloads += 1
+        if counts is None:
+            counts = self._counts_by_lq()
+        (st.pending_workloads, st.reserving_workloads,
+         st.admitted_workloads) = counts.get(
+            (lq.namespace, lq.name), (0, 0, 0))
 
         # flavors usable from this queue (ExposeFlavorsInLocalQueue)
         if cq is not None and features.enabled("ExposeFlavorsInLocalQueue"):
@@ -124,7 +136,8 @@ class LocalQueueReconciler:
         for key in list(self.status):
             if key not in self.store.local_queues:
                 self.status.pop(key, None)
-        return {key: self.reconcile(key, now)
+        counts = self._counts_by_lq()
+        return {key: self.reconcile(key, now, counts=counts)
                 for key in self.store.local_queues}
 
 
@@ -317,5 +330,14 @@ class WorkloadPriorityClassReconciler:
         return n
 
     def reconcile_all(self) -> int:
-        return sum(self.reconcile(name)
-                   for name in list(self.store.priority_classes))
+        """One pass over workloads (O(W + classes), not classes x W)."""
+        classes = self.store.priority_classes
+        n = 0
+        for wl in list(self.store.workloads.values()):
+            pc = classes.get(wl.priority_class) if wl.priority_class \
+                else None
+            if pc is not None and wl.priority != pc.value:
+                wl.priority = pc.value
+                self.store.update_workload(wl)
+                n += 1
+        return n

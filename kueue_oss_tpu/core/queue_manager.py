@@ -457,34 +457,39 @@ class QueueManager:
             self._cond.notify_all()
 
     def has_pending(self) -> bool:
-        return any(len(q._in_heap) > 0 for q in self.queues.values() if q.active)
+        with self._mu:
+            return any(len(q._in_heap) > 0
+                       for q in self.queues.values() if q.active)
 
     def membership_fingerprint(self) -> int:
         """Order-insensitive digest of every queue's (key, heap|parked)
         membership, maintained O(1) per transition — the scheduler's
         run_until_quiet quiescence probe (replaces walking queue internals)."""
-        acc = 0
-        for name, q in self.queues.items():
-            acc ^= hash((name, q.state_hash))
-        return acc
+        with self._mu:
+            acc = 0
+            for name, q in self.queues.items():
+                acc ^= hash((name, q.state_hash))
+            return acc
 
     def drain_dirty_pending_counts(self) -> dict[str, tuple[int, int]]:
         """Pending counts for CQs that changed since the last drain —
         O(changed CQs) so the scheduler's metric refresh stays off the
         all-CQs path."""
-        dirty, self.dirty_cqs = self.dirty_cqs, set()
-        out = {}
-        for name in dirty:
-            q = self.queues.get(name)
-            if q is not None:
-                out[name] = (q.pending_active, q.pending_inadmissible)
-        return out
+        with self._mu:
+            dirty, self.dirty_cqs = self.dirty_cqs, set()
+            out = {}
+            for name in dirty:
+                q = self.queues.get(name)
+                if q is not None:
+                    out[name] = (q.pending_active, q.pending_inadmissible)
+            return out
 
     def pending_counts(self) -> dict[str, tuple[int, int]]:
-        return {
-            name: (q.pending_active, q.pending_inadmissible)
-            for name, q in self.queues.items()
-        }
+        with self._mu:
+            return {
+                name: (q.pending_active, q.pending_inadmissible)
+                for name, q in self.queues.items()
+            }
 
     # -- capacity-freed events ---------------------------------------------
 

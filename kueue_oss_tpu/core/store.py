@@ -55,6 +55,35 @@ class Store:
         #: resource transformations) the info cache was computed under
         self._info_cache_gen = -1
 
+    def clone(self) -> "Store":
+        """Deep copy of all objects into a fresh Store — no watchers, a
+        new lock (dry-run scheduling, restart/rebuild tests)."""
+        import copy
+
+        with self._lock:
+            out = Store()
+            out.namespaces = copy.deepcopy(self.namespaces)
+            for cohort in self.cohorts.values():
+                out.upsert_cohort(copy.deepcopy(cohort))
+            for rf in self.resource_flavors.values():
+                out.upsert_resource_flavor(copy.deepcopy(rf))
+            for t in self.topologies.values():
+                out.upsert_topology(copy.deepcopy(t))
+            for ac in self.admission_checks.values():
+                out.upsert_admission_check(copy.deepcopy(ac))
+            for pc in self.priority_classes.values():
+                out.upsert_priority_class(copy.deepcopy(pc))
+            for cq in self.cluster_queues.values():
+                out.upsert_cluster_queue(copy.deepcopy(cq))
+            for lq in self.local_queues.values():
+                out.upsert_local_queue(copy.deepcopy(lq))
+            for node in self.nodes.values():
+                out.upsert_node(copy.deepcopy(node))
+            for wl in self.workloads.values():
+                out.add_workload(copy.deepcopy(wl))
+            out.cq_generation = dict(self.cq_generation)
+            return out
+
     # -- watch -------------------------------------------------------------
 
     def watch(self, fn: Callable[[Event], None]) -> None:

@@ -135,8 +135,11 @@ class Tracer:
         self.clock = clock
         self.enabled = True
         self._lock = threading.Lock()
-        #: (name, thread id, start_us, duration_us, args)
+        #: ring of (name, thread id, start_us, duration_us, args) — the
+        #: newest max_spans survive (an operator debugging a current
+        #: stall needs the RECENT activity, not warm-up)
         self._spans: list[tuple] = []
+        self._next = 0
 
     @contextmanager
     def span(self, name: str, **args):
@@ -148,19 +151,26 @@ class Tracer:
             yield
         finally:
             dur = self.clock() - t0
+            entry = (name, threading.get_ident(),
+                     int(t0 * 1e6), int(dur * 1e6), args or None)
             with self._lock:
                 if len(self._spans) < self.max_spans:
-                    self._spans.append(
-                        (name, threading.get_ident(),
-                         int(t0 * 1e6), int(dur * 1e6), args or None))
+                    self._spans.append(entry)
+                else:
+                    self._spans[self._next % self.max_spans] = entry
+                self._next += 1
 
     def spans(self) -> list[tuple]:
         with self._lock:
-            return list(self._spans)
+            if len(self._spans) < self.max_spans:
+                return list(self._spans)
+            cut = self._next % self.max_spans
+            return self._spans[cut:] + self._spans[:cut]
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._next = 0
 
     def durations_ms(self, name: str) -> list[float]:
         return [dur / 1000 for (n, _, _, dur, _) in self.spans()

@@ -57,6 +57,18 @@ _DEFAULTS: dict[str, bool] = {
     # namespace selector bounds queue-named jobs too (kube_features.go
     # :163-166, beta default true since 0.14)
     "ManagedJobsNamespaceSelectorAlwaysRespected": True,  # jobframework
+    # default queue-name from the namespace's "default" LocalQueue (GA)
+    "LocalQueueDefaulting": True,      # webhooks default_job
+    # workload_creation_latency_seconds series (beta, on)
+    "MetricForWorkloadCreationLatency": True,  # jobframework reconciler
+    # SparkApplication integration opt-in (alpha, off)
+    "SparkApplicationIntegration": False,  # jobframework registry
+    # finish workloads whose owner job vanished (alpha, off)
+    "FinishOrphanedWorkloads": False,  # jobframework reconcile_all GC
+    # copy the owner job's labels onto its workload (GA)
+    "PropagateBatchJobLabelsToWorkload": True,  # _create_workload
+    # hashed 63-char workload names (alpha, off)
+    "ShortWorkloadNames": False,       # workload_name_for
 }
 
 _lock = threading.Lock()

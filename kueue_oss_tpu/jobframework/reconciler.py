@@ -31,9 +31,20 @@ from kueue_oss_tpu.scheduler.scheduler import Scheduler
 
 def workload_name_for(job: GenericJob) -> str:
     """Reference parity: jobframework/workload_names.go
-    GetWorkloadNameForOwnerWithGVK (kind-prefixed, no hash needed here
-    because the in-memory store has no name-length limit)."""
-    return f"{job.kind.lower()}-{job.name}"
+    GetWorkloadNameForOwnerWithGVK. Under the ShortWorkloadNames gate,
+    names over the DNS-label limit truncate with a stable hash suffix
+    (workload_names.go short-name hashing); otherwise the in-memory
+    store has no length limit and the plain kind-prefixed name is
+    used."""
+    from kueue_oss_tpu import features
+
+    name = f"{job.kind.lower()}-{job.name}"
+    if features.enabled("ShortWorkloadNames") and len(name) > 63:
+        import hashlib
+
+        digest = hashlib.sha256(name.encode()).hexdigest()[:8]
+        name = f"{name[:54]}-{digest}"
+    return name
 
 
 class JobReconciler:
@@ -55,6 +66,9 @@ class JobReconciler:
         self.workload_reconciler = workload_reconciler
         #: jobs under management, keyed "namespace/name" per kind
         self.jobs: dict[tuple[str, str], GenericJob] = {}
+        #: every owner id this instance has managed (orphan-GC ground
+        #: truth; see _finish_orphans)
+        self._known_owners: set[str] = set()
 
     # -- job lifecycle ------------------------------------------------------
 
@@ -62,6 +76,7 @@ class JobReconciler:
         if not self.manager.is_enabled(job.kind):
             raise ValueError(f"integration {job.kind} is not enabled")
         self.jobs[(job.kind, job.key)] = job
+        self._known_owners.add(f"{job.kind}/{job.key}")
 
     def delete_job(self, job: GenericJob, now: float = 0.0) -> None:
         self.jobs.pop((job.kind, job.key), None)
@@ -82,6 +97,25 @@ class JobReconciler:
     def reconcile_all(self, now: float) -> None:
         for job in list(self.jobs.values()):
             self.reconcile(job, now)
+        self._finish_orphans(now)
+
+    def _finish_orphans(self, now: float) -> None:
+        """FinishOrphanedWorkloads gate: a workload whose owner job no
+        longer exists finishes instead of holding quota forever (the
+        reference GC's workloads with dead ownerReferences). Ground
+        truth here is owners THIS reconciler has actually managed
+        (`_known_owners`) — a freshly restarted reconciler must not
+        sweep workloads whose jobs simply have not been re-upserted
+        yet."""
+        from kueue_oss_tpu import features
+
+        if not features.enabled("FinishOrphanedWorkloads"):
+            return
+        live = {f"{job.kind}/{job.key}" for job in self.jobs.values()}
+        for wl in list(self.store.workloads.values()):
+            if (wl.owner and wl.owner in self._known_owners
+                    and wl.owner not in live and not wl.is_finished):
+                self.scheduler.finish_workload(wl.key, now=now)
 
     # -- core ---------------------------------------------------------------
 
@@ -228,10 +262,16 @@ class JobReconciler:
 
     def _create_workload(self, job: GenericJob, podsets: list[PodSet],
                          now: float, name_suffix: str = "") -> Workload:
+        from kueue_oss_tpu import features
+
+        labels = (dict(getattr(job, "labels", {}))
+                  if features.enabled("PropagateBatchJobLabelsToWorkload")
+                  else {})
         wl = Workload(
             name=workload_name_for(job) + name_suffix,
             namespace=job.namespace,
             queue_name=job.queue_name,
+            labels=labels,
             priority=getattr(job, "priority", 0),
             priority_class=getattr(job, "priority_class", None),
             max_execution_time=getattr(job, "max_execution_time", None),
@@ -246,10 +286,11 @@ class JobReconciler:
         )
         wl.owner = f"{job.kind}/{job.key}"
         self.store.add_workload(wl)
-        from kueue_oss_tpu import metrics
+        from kueue_oss_tpu import features, metrics
 
-        metrics.workload_creation_latency_seconds.observe(
-            job.kind, value=max(now - wl.creation_time, 0.0))
+        if features.enabled("MetricForWorkloadCreationLatency"):
+            metrics.workload_creation_latency_seconds.observe(
+                job.kind, value=max(now - wl.creation_time, 0.0))
         return wl
 
     def _stop_job(self, job: GenericJob, wl: Workload, reason: str,

@@ -40,9 +40,20 @@ class IntegrationManager:
             raise ValueError(f"unknown integrations: {unknown}")
         self._enabled = set(kinds)
 
+    #: kinds additionally guarded by a feature gate (kube_features.go
+    #: SparkApplicationIntegration: alpha integrations need the gate on
+    #: top of the integrations list)
+    GATED_KINDS = {"SparkApplication": "SparkApplicationIntegration"}
+
     def is_enabled(self, kind: str) -> bool:
         if kind not in self._by_kind:
             return False
+        gate = self.GATED_KINDS.get(kind)
+        if gate is not None:
+            from kueue_oss_tpu import features
+
+            if not features.enabled(gate):
+                return False
         return self._enabled is None or kind in self._enabled
 
 

@@ -15,9 +15,19 @@ class JobWebhookError(ValueError):
 
 
 def default_job(job: GenericJob,
-                manage_jobs_without_queue_name: bool = False) -> None:
+                manage_jobs_without_queue_name: bool = False,
+                store=None) -> None:
     """Mutating webhook: a managed job is created suspended so kueue
-    controls its start (base_webhook.go Default)."""
+    controls its start (base_webhook.go Default). Under the
+    LocalQueueDefaulting gate (GA), a job with no queue-name label in a
+    namespace that has a LocalQueue named "default" is defaulted onto
+    it (localqueue_defaulting webhook)."""
+    from kueue_oss_tpu import features
+
+    if (not job.queue_name and store is not None
+            and features.enabled("LocalQueueDefaulting")
+            and f"{job.namespace}/default" in store.local_queues):
+        job.queue_name = "default"
     if job.queue_name or manage_jobs_without_queue_name:
         if not job.is_suspended():
             job.do_suspend()

@@ -685,8 +685,13 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
                 t, usage_l, cq_node, req, allow_borrow)
             return (usage_l, victims, fitted, i + 1)
 
-        init = (usage0_round, jnp.zeros((p_max,), dtype=bool),
-                jnp.zeros((), dtype=bool), jnp.zeros((), dtype=jnp.int32))
+        # fresh init constants derive their type from head_w so the
+        # carries stay consistent under shard_map's varying-axes check
+        # (a no-op on the unsharded path)
+        vzero = head_w.astype(jnp.int32) * 0
+        vfalse = vzero != 0
+        init = (usage0_round, jnp.zeros((p_max,), dtype=bool) | vfalse,
+                vfalse, vzero)
         usage_l, victims, fitted, n_walked = jax.lax.while_loop(
             cond, body, init)
 
@@ -926,8 +931,78 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
 # ---------------------------------------------------------------------------
 
 
+def _run_searches(t, usage, wl_usage, admitted, evicted, ts, admit_rank,
+                  flat_w, flat_req, flat_avail, p_max, fs_enabled,
+                  lendable_r, mesh, axis):
+    """Run the per-lane victim searches, optionally SPMD over a mesh.
+
+    The victim search is the round's dominant cost — each lane scans
+    candidate legality over the whole workload axis — and lanes are
+    independent, so multi-chip scaling shards the LANE axis: each
+    device searches its slice of (head, option) lanes against the
+    replicated round state, and the [L]-shaped results concatenate
+    back. Per-round collective volume is the lane results only
+    (L x p_max ints over ICI); the tree/usage state never moves.
+    """
+    def vsearch(hw, rq, av, t_, usage_, wl_usage_, admitted_, evicted_,
+                ts_, rank_, lendable_):
+        if fs_enabled:
+            from kueue_oss_tpu.solver.fair_kernels import fair_search
+
+            return jax.vmap(
+                lambda a, b, c: fair_search(
+                    t_, lendable_, usage_, wl_usage_, admitted_,
+                    evicted_, ts_, rank_, a, b, c, p_max))(hw, rq, av)
+        return jax.vmap(
+            lambda a, b, c: classical_search(
+                t_, usage_, wl_usage_, admitted_, evicted_, ts_, rank_,
+                a, b, c, p_max))(hw, rq, av)
+
+    if mesh is None:
+        return vsearch(flat_w, flat_req, flat_avail, t, usage, wl_usage,
+                       admitted, evicted, ts, admit_rank, lendable_r)
+
+    from jax.sharding import PartitionSpec as P
+
+    W_null = t.wl_cqid.shape[0] - 1
+    n_dev = mesh.shape[axis]
+    L = flat_w.shape[0]
+    pad = (-L) % n_dev
+    if pad:
+        flat_w = jnp.concatenate(
+            [flat_w, jnp.full((pad,), W_null, dtype=flat_w.dtype)])
+        flat_req = jnp.concatenate(
+            [flat_req, jnp.zeros((pad,) + flat_req.shape[1:],
+                                 dtype=flat_req.dtype)])
+        flat_avail = jnp.concatenate(
+            [flat_avail, jnp.zeros((pad,) + flat_avail.shape[1:],
+                                   dtype=flat_avail.dtype)])
+    lend = lendable_r if lendable_r is not None else jnp.zeros((1,))
+
+    def shard_body(hw, rq, av, *rep):
+        # mark the replicated state varying-over-mesh so while_loop
+        # carries inside the search have consistent manual-axes types
+        rep = jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, (axis,), to="varying"), rep)
+        return vsearch(hw, rq, av, *rep)
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis),
+                  P(), P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(axis),) * 6,
+    )
+    out = sharded(flat_w, flat_req, flat_avail, t, usage, wl_usage,
+                  admitted, evicted, ts, admit_rank, lend)
+    if pad:
+        out = tuple(o[:L] for o in out)
+    return out
+
+
 def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
-               p_max: int, fs_enabled: bool = False, lendable_r=None):
+               p_max: int, fs_enabled: bool = False, lendable_r=None,
+               mesh=None, axis: str = "wl"):
     """One reference cycle (shared by the jitted loop and debug_drain)."""
     W1 = t.wl_cqid.shape[0]
     C = t.cq_node.shape[0]
@@ -993,20 +1068,14 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
     # ---- per-option victim-search simulation over [H, K] -------------
     # One search per (lane, option): SimulatePreemption parity (the host
     # runs _get_targets per flavor during assignment; the Preemptor
-    # dispatches to the fair-sharing search when enabled).
-    if fs_enabled:
-        from kueue_oss_tpu.solver.fair_kernels import fair_search
+    # dispatches to the fair-sharing search when enabled). With a mesh,
+    # the lane axis shards across devices (_run_searches).
+    def search(hw, rq, av):
+        return _run_searches(
+            t, usage, wl_usage, admitted, state["evicted"], ts,
+            state["admit_rank"], hw, rq, av, p_max, fs_enabled,
+            lendable_r, mesh, axis)
 
-        search = jax.vmap(
-            lambda hw, rq, av: fair_search(
-                t, lendable_r, usage, wl_usage, admitted,
-                state["evicted"], ts, state["admit_rank"], hw, rq, av,
-                p_max))
-    else:
-        search = jax.vmap(
-            lambda hw, rq, av: classical_search(
-                t, usage, wl_usage, admitted, state["evicted"], ts,
-                state["admit_rank"], hw, rq, av, p_max))
     flat_w = jnp.repeat(lane_w, K)
     flat_req = t.wl_req[lane_w].reshape(h_max * K, -1)
     flat_avail = jnp.repeat(lane_avail, K, axis=0)
@@ -1194,12 +1263,14 @@ def _init_state(t: FullTensors, g_max: int):
 
 
 def make_full_solver(g_max: int, h_max: int, p_max: int,
-                     fs_enabled: bool = False, round_cap: int = 0):
+                     fs_enabled: bool = False, round_cap: int = 0,
+                     mesh=None, axis: str = "wl"):
     """Build the jitted preemption-capable drain for static caps.
 
     ``round_cap`` > 0 bounds the drain's rounds below the quiescence
     bound (benchmarks use it to terminate preemption ping-pong shapes
-    the way the reference's wall-clock limits do)."""
+    the way the reference's wall-clock limits do). ``mesh`` shards the
+    victim-search lane axis across devices (see _run_searches)."""
 
     @jax.jit
     def solve(t: FullTensors):
@@ -1224,7 +1295,7 @@ def make_full_solver(g_max: int, h_max: int, p_max: int,
 
         def body(state):
             new_state, _ = round_body(t, state, pot, g_max, h_max, p_max,
-                                      fs_enabled, lendable_r)
+                                      fs_enabled, lendable_r, mesh, axis)
             return new_state
 
         final = jax.lax.while_loop(cond, body, _init_state(t, g_max))

@@ -235,6 +235,33 @@ def make_sharded_drain(mesh: Mesh, axis: str = "wl"):
     return drain
 
 
+def solve_backlog_full_sharded(problem: SolverProblem, mesh: Mesh,
+                               g_max: int, h_max: int = 32,
+                               p_max: int = 128, fs_enabled: bool = False,
+                               axis: str = "wl", round_cap: int = 0):
+    """Multi-chip PREEMPTION-capable drain.
+
+    Scaling model (complementary to the fit-only workload-axis shard
+    below): the full kernel's per-round cost is dominated by the
+    victim searches — h_max x K independent candidate scans over the
+    whole workload axis — so those LANES shard across the mesh
+    (full_kernels._run_searches) while the cohort-tree state stays
+    replicated. Per-round ICI volume is the gathered lane results
+    (lanes x p_max victim slots); admission/eviction bookkeeping is
+    identical on every device. Results match the single-chip
+    solve_backlog_full bit-for-bit.
+    """
+    from kueue_oss_tpu.solver.full_kernels import (
+        make_full_solver,
+        to_device_full,
+    )
+
+    t = to_device_full(problem)
+    solver = make_full_solver(g_max, h_max, p_max, fs_enabled,
+                              round_cap=round_cap, mesh=mesh, axis=axis)
+    return solver(t)
+
+
 def solve_backlog_sharded(problem: SolverProblem, mesh: Mesh,
                           axis: str = "wl"):
     """Shard, place, and drain a problem over the mesh. Returns

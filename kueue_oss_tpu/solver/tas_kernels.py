@@ -96,7 +96,7 @@ def fill_counts(parents, leaf_capacity, per_pod):
     return states
 
 
-def _greedy_segment(state, seg, need_of_seg, n_seg):
+def _greedy_segment(state, seg, need_of_seg, n_seg, least_free=False):
     """Minimize-domains assignment within each segment (sibling group).
 
     `state` [D], `seg` [D] segment id, `need_of_seg` [S] pods each
@@ -104,11 +104,19 @@ def _greedy_segment(state, seg, need_of_seg, n_seg):
     index asc) order until the remainder fits one domain, then give the
     remainder to the smallest sufficient domain at or after the
     crossing (updateCountsToMinimumGeneric + findBestFitDomainBy).
+
+    `least_free` (traced bool) flips to the LeastFreeCapacity profile
+    (unconstrained podsets under TASProfileMixed,
+    tas_flavor_snapshot.go sortedDomains ascending): fill (state asc,
+    index asc). In ascending order the best-fit refinement below is a
+    no-op — the crossing domain IS the smallest sufficient one — so the
+    same formula reproduces the host's sequential consume loop.
     Returns assignment [D].
     """
     D = state.shape[0]
     idx = jnp.arange(D, dtype=jnp.int32)
-    order = jnp.lexsort((idx, -state, seg))
+    sort_state = jnp.where(least_free, state, -state)
+    order = jnp.lexsort((idx, sort_state, seg))
     s_sorted = state[order]
     seg_sorted = seg[order]
     need = need_of_seg[seg_sorted]                 # [D]
@@ -154,7 +162,7 @@ def make_placer(parents_np: list[np.ndarray]):
 
     @jax.jit
     def place(leaf_capacity, per_pod, count, requested_level,
-              required, unconstrained):
+              required, unconstrained, least_free=False):
         states = fill_counts(parents, leaf_capacity, per_pod)
 
         def single_best(l):
@@ -194,7 +202,8 @@ def make_placer(parents_np: list[np.ndarray]):
             seg = jnp.zeros_like(states[l])        # one global segment
             g = _greedy_segment(
                 states[l], seg,
-                jnp.full((1,), count, dtype=states[l].dtype), 1)
+                jnp.full((1,), count, dtype=states[l].dtype), 1,
+                least_free=least_free)
             g_ok = jnp.sum(states[l]) >= count
             use_greedy = (~single_fit) & (greedy_level == l) & ~required
             sel[l] = jnp.where(is_single, seed_single,
@@ -206,13 +215,16 @@ def make_placer(parents_np: list[np.ndarray]):
         for l in range(n_levels - 1):
             par = parents[l + 1]
             n_par = states[l].shape[0]
-            computed = _greedy_segment(states[l + 1], par, sel[l], n_par)
-            # best-fit single-child shortcut per sibling group
+            computed = _greedy_segment(states[l + 1], par, sel[l], n_par,
+                                       least_free=least_free)
+            # best-fit single-child shortcut per sibling group (the
+            # least-free profile consumes sequentially without it,
+            # _consume_minimum's ascending loop)
             need = sel[l][par]
-            fits_whole = (states[l + 1] >= need) & (need > 0)
+            fits_whole = (states[l + 1] >= need) & (need > 0) & ~least_free
             key = jnp.where(fits_whole, states[l + 1], BIG)
             m = jax.ops.segment_min(key, par, num_segments=n_par)
-            has_single = (m < BIG)[par] & (need > 0)
+            has_single = (m < BIG)[par] & (need > 0) & ~least_free
             cidx = jnp.arange(par.shape[0], dtype=jnp.int32)
             is_best = fits_whole & (states[l + 1] == m[par])
             first_best = jax.ops.segment_min(
@@ -248,11 +260,13 @@ def place_podset(snapshot, per_pod: dict, count: int,
     req = np.zeros(max(1, len(levels.resources)), dtype=np.int32)
     for j, r in enumerate(levels.resources):
         req[j] = per_pod.get(r, 0)
+    least_free = unconstrained and getattr(snapshot, "profile_mixed", False)
     leaf_sel, feasible = placer(
         jnp.asarray(levels.leaf_capacity), jnp.asarray(req),
         jnp.asarray(count, dtype=jnp.int32),
         jnp.asarray(requested_level_idx, dtype=jnp.int32),
-        jnp.asarray(required), jnp.asarray(unconstrained))
+        jnp.asarray(required), jnp.asarray(unconstrained),
+        jnp.asarray(least_free))
     if not bool(feasible):
         return None
     leaf_sel = np.asarray(leaf_sel)

@@ -1,0 +1,134 @@
+"""Gate-guarded behaviors added in the breadth pass: LocalQueueDefaulting,
+ShortWorkloadNames, PropagateBatchJobLabelsToWorkload,
+FinishOrphanedWorkloads, SparkApplicationIntegration,
+MetricForWorkloadCreationLatency."""
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.jobframework import (
+    JobReconciler,
+    default_job,
+    integration_manager,
+)
+from kueue_oss_tpu.jobframework.reconciler import workload_name_for
+from kueue_oss_tpu.jobs import BatchJob, SparkApplication
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+@pytest.fixture(autouse=True)
+def _reset_gates():
+    yield
+    features.reset()
+
+
+def make_env():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=4000)])])]))
+    store.upsert_local_queue(LocalQueue(name="default",
+                                        cluster_queue="cq"))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    return store, sched, JobReconciler(store, sched)
+
+
+def test_local_queue_defaulting():
+    store, sched, jr = make_env()
+    job = BatchJob(name="j", parallelism=1, requests={"cpu": 100})
+    default_job(job, store=store)
+    assert job.queue_name == "default", \
+        "namespace's 'default' LocalQueue is adopted"
+    features.set_gates({"LocalQueueDefaulting": False})
+    job2 = BatchJob(name="k", parallelism=1)
+    default_job(job2, store=store)
+    assert job2.queue_name == ""
+
+
+def test_short_workload_names():
+    job = BatchJob(name="x" * 80, queue_name="lq")
+    assert len(workload_name_for(job)) > 63
+    features.set_gates({"ShortWorkloadNames": True})
+    short = workload_name_for(job)
+    assert len(short) == 63
+    # stable: same input, same hash
+    assert short == workload_name_for(job)
+
+
+def test_propagate_job_labels_to_workload():
+    store, sched, jr = make_env()
+    job = BatchJob(name="j", queue_name="default", parallelism=1,
+                   requests={"cpu": 100},
+                   labels={"team": "ml", "tier": "batch"})
+    jr.upsert_job(job)
+    jr.reconcile(job, 0.0)
+    wl = jr.workload_for(job)
+    assert wl.labels == {"team": "ml", "tier": "batch"}
+
+
+def test_finish_orphaned_workloads():
+    store, sched, jr = make_env()
+    job = BatchJob(name="j", queue_name="default", parallelism=1,
+                   requests={"cpu": 100})
+    jr.upsert_job(job)
+    jr.reconcile(job, 0.0)
+    wl = jr.workload_for(job)
+    # orphan it: drop the job from management without delete_job
+    jr.jobs.clear()
+    jr.reconcile_all(1.0)
+    assert not store.workloads[wl.key].is_finished, \
+        "gate off: orphans left alone"
+    features.set_gates({"FinishOrphanedWorkloads": True})
+    jr.reconcile_all(2.0)
+    assert store.workloads[wl.key].is_finished
+
+
+def test_spark_integration_gate():
+    assert not integration_manager.is_enabled("SparkApplication"), \
+        "alpha integration needs its gate"
+    features.set_gates({"SparkApplicationIntegration": True})
+    assert integration_manager.is_enabled("SparkApplication")
+
+
+def test_workload_creation_latency_gated():
+    from kueue_oss_tpu import metrics
+
+    store, sched, jr = make_env()
+    features.set_gates({"MetricForWorkloadCreationLatency": False})
+    before = dict(metrics.workload_creation_latency_seconds._values)
+    job = BatchJob(name="j", queue_name="default", parallelism=1,
+                   requests={"cpu": 100})
+    jr.upsert_job(job)
+    jr.reconcile(job, 5.0)
+    assert metrics.workload_creation_latency_seconds._values == before
+
+
+def test_finish_orphans_requires_known_owner():
+    """A fresh reconciler (restart) must not sweep workloads whose jobs
+    simply have not been re-upserted yet."""
+    store, sched, jr = make_env()
+    features.set_gates({"FinishOrphanedWorkloads": True})
+    job = BatchJob(name="j", queue_name="default", parallelism=1,
+                   requests={"cpu": 100})
+    jr.upsert_job(job)
+    jr.reconcile(job, 0.0)
+    wl = jr.workload_for(job)
+
+    fresh = JobReconciler(store, sched)
+    fresh.reconcile_all(1.0)
+    assert not store.workloads[wl.key].is_finished, \
+        "restarted reconciler must not GC unseen owners"

@@ -117,3 +117,12 @@ def test_tracer_disabled_records_nothing():
     with t.span("x"):
         pass
     assert t.spans() == []
+
+
+def test_tracer_ring_keeps_newest():
+    t = Tracer(max_spans=3)
+    for i in range(6):
+        with t.span(f"s{i}"):
+            pass
+    names = [s[0] for s in t.spans()]
+    assert names == ["s3", "s4", "s5"], names

@@ -1,0 +1,100 @@
+"""TAS perf-shape drain parity: the reference's TAS performance topology
+(1 block x 10 racks x 64 nodes = 640 nodes, 96 CPU each —
+test/performance/scheduler/configs/tas/generator.yaml) drained with the
+reference's workload mix (small 2x500m / medium 5x2 / large 20x5 CPU
+pods, required + preferred + unconstrained at rack level), asserting at
+EVERY step that the dense placement kernel picks exactly the host
+tree's domains, with usage accumulating identically on both sides.
+
+VERDICT round 2 item 6 done-when; baseline for scale:
+configs/tas/rangespec.yaml (15k workloads / 401s wall).
+"""
+
+import random
+
+import pytest
+
+from test_tas_kernel import (
+    BLOCK,
+    HOST,
+    LEVELS,
+    RACK,
+    host_place,
+    kernel_place,
+    make_nodes,
+)
+
+from kueue_oss_tpu.tas.snapshot import build_tas_flavor_snapshot
+
+#: the reference mix (generator.yaml workloadsSets): (pods, cpu per pod)
+MIX = [
+    ("small", 2, 500),
+    ("medium", 5, 2000),
+    ("large", 20, 5000),
+]
+MODES = ["required", "preferred", "unconstrained"]
+
+
+def full_domain(by_host, hostname):
+    return by_host[hostname]
+
+
+@pytest.mark.slow
+def test_tas_perf_shape_drain_parity():
+    # 640 nodes x 96 CPU = 61,440,000 mCPU capacity
+    nodes = make_nodes(1, 10, 64, cpu=96_000)
+    by_host = {n.name: (n.labels[BLOCK], n.labels[RACK], n.name)
+               for n in nodes}
+    snap_h = build_tas_flavor_snapshot("default", LEVELS, list(nodes))
+    snap_k = build_tas_flavor_snapshot("default", LEVELS, list(nodes))
+
+    rng = random.Random(640)
+    placed = parked = 0
+    placed_pods = 0
+    n_workloads = 2000
+    for i in range(n_workloads):
+        cls, pods, cpu = MIX[rng.randrange(len(MIX))]
+        mode = MODES[rng.randrange(len(MODES))]
+        per_pod = {"cpu": cpu}
+        h = host_place(snap_h, pods, per_pod, RACK,
+                       required=mode == "required",
+                       unconstrained=mode == "unconstrained")
+        k = kernel_place(snap_k, pods, per_pod, RACK,
+                         required=mode == "required",
+                         unconstrained=mode == "unconstrained")
+        if h is None:
+            assert k is None, (i, cls, mode, k)
+            parked += 1
+            continue
+        assert k == h, (i, cls, mode, h, k)
+        placed += 1
+        placed_pods += pods
+        # commit the placement on BOTH snapshots (identical domains)
+        for dom, count in h.items():
+            values = full_domain(by_host, dom[-1])
+            snap_h.add_tas_usage(values, per_pod, count)
+            snap_k.add_tas_usage(values, per_pod, count)
+
+    # the drain must be contended: a real fraction placed AND parked
+    assert placed > n_workloads // 2, (placed, parked)
+    assert parked > 0, "shape must saturate the 640-node capacity"
+    # usage identical on both trees at the end
+    assert set(snap_h.leaves) == set(snap_k.leaves)
+    for key, leaf_h in snap_h.leaves.items():
+        assert leaf_h.tas_usage == snap_k.leaves[key].tas_usage
+
+
+@pytest.mark.slow
+def test_tas_perf_shape_preferred_spills_across_racks():
+    """A preferred-rack large workload bigger than any single rack's
+    free capacity must spill across racks identically in both paths."""
+    nodes = make_nodes(1, 10, 64, cpu=96_000)
+    snap_h = build_tas_flavor_snapshot("default", LEVELS, list(nodes))
+    snap_k = build_tas_flavor_snapshot("default", LEVELS, list(nodes))
+    # 64 hosts/rack x 96 CPU = 6144 CPU per rack; 80 pods x 96 CPU
+    # needs more than one rack
+    h = host_place(snap_h, 80, {"cpu": 96_000}, RACK)
+    k = kernel_place(snap_k, 80, {"cpu": 96_000}, RACK)
+    assert h is not None and k == h
+    racks = {dom[-1].rsplit("-", 1)[0] for dom in h}
+    assert len(racks) > 1, "placement must span racks"

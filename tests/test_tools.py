@@ -259,3 +259,72 @@ def test_dashboard_views_and_server():
         assert "/api/clusterqueues" in html
     finally:
         srv.stop()
+
+
+def test_cli_create_resourceflavor_get_dryrun_completion():
+    from kueue_oss_tpu.api.types import Topology
+
+    store = Store()
+    ctl = Kueuectl(store)
+    out = ctl.run(["create", "resourceflavor", "tpu",
+                   "--node-labels", "pool=tpu,zone=a",
+                   "--node-taints", "dedicated=ml:NoSchedule"])
+    assert "created" in out
+    rf = store.resource_flavors["tpu"]
+    assert rf.node_labels == {"pool": "tpu", "zone": "a"}
+    assert rf.node_taints[0].effect == "NoSchedule"
+
+    # the tainted flavor rejects untolerated workloads, so the schedulable
+    # queue uses a second, untainted flavor
+    ctl.run(["create", "resourceflavor", "plain"])
+    ctl.run(["create", "clusterqueue", "cq",
+             "--nominal-quota", "plain:cpu=4000"])
+    ctl.run(["create", "localqueue", "lq", "-c", "cq"])
+
+    # passthrough get over kinds without dedicated commands
+    store.upsert_topology(Topology(name="dc", levels=["rack", "host"]))
+    assert "dc" in ctl.run(["get", "topology"])
+    assert "levels" in ctl.run(["get", "topology", "dc"])
+
+    # dryrun simulates on a clone: reports would-be admissions, commits
+    # nothing
+    submit(store, "w1", "lq")
+    out = ctl.run(["dryrun"])
+    assert "1 workload(s) would be admitted" in out
+    assert "default/w1" in out and "cq" in out
+    assert not store.workloads["default/w1"].is_quota_reserved
+
+    assert "complete -F _kueuectl_completions" in ctl.run(["completion"])
+
+
+def test_store_clone_is_independent():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    ctl = Kueuectl(store)
+    ctl.run(["create", "clusterqueue", "cq",
+             "--nominal-quota", "default:cpu=4000"])
+    ctl.run(["create", "localqueue", "lq", "-c", "cq"])
+    submit(store, "w1", "lq")
+    clone = store.clone()
+    clone.workloads["default/w1"].priority = 99
+    assert store.workloads["default/w1"].priority != 99
+    clone.delete_workload("default/w1")
+    assert "default/w1" in store.workloads
+
+
+def test_dryrun_clears_eviction_backoff():
+    """A live eviction backoff must not gate the simulation
+    (kueuectl dryrun asks 'could it admit')."""
+    from kueue_oss_tpu.api.types import RequeueState
+
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    ctl = Kueuectl(store)
+    ctl.run(["create", "clusterqueue", "cq",
+             "--nominal-quota", "default:cpu=4000"])
+    ctl.run(["create", "localqueue", "lq", "-c", "cq"])
+    submit(store, "w1", "lq")
+    store.workloads["default/w1"].status.requeue_state = RequeueState(
+        count=3, requeue_at=10_000.0)
+    out = ctl.run(["dryrun"])
+    assert "1 workload(s) would be admitted" in out, out
