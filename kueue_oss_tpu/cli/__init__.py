@@ -235,13 +235,21 @@ class Kueuectl:
                                  effect=effect or "NoSchedule"))
             return out
 
+        def parse_tolerations(spec: str) -> list[Toleration]:
+            # unlike taints, an EMPTY toleration effect matches all
+            # effects (types.py Toleration.tolerates) — don't default it
+            out = []
+            for entry in filter(None, spec.split(",")):
+                kv, _, effect = entry.partition(":")
+                k, _, v = kv.partition("=")
+                out.append(Toleration(key=k, value=v, effect=effect))
+            return out
+
         rf = ResourceFlavor(
             name=ns.name,
             node_labels=parse_kv(ns.node_labels),
             node_taints=parse_taints(ns.node_taints),
-            tolerations=[Toleration(key=t.key, value=t.value,
-                                    effect=t.effect)
-                         for t in parse_taints(ns.tolerations)],
+            tolerations=parse_tolerations(ns.tolerations),
         )
         self.store.upsert_resource_flavor(rf)
         return f"resourceflavor.kueue.x-k8s.io/{ns.name} created"

@@ -59,6 +59,7 @@ class WorkloadReconciler:
         self.config = config or Configuration()
         #: keys deleted by retention GC (observability/tests)
         self.gc_deleted: list[str] = []
+
     @staticmethod
     def _has_pending_topology(wl: Workload) -> bool:
         """workload.go HasTopologyAssignmentsPending."""
@@ -140,10 +141,20 @@ class WorkloadReconciler:
             if cq:
                 metrics.ready_wait_time_seconds.observe(
                     cq, value=max(now - wl.creation_time, 0.0))
+                if metrics._lq_metrics_enabled():
+                    metrics.local_queue_ready_wait_time_seconds.observe(
+                        wl.queue_name, wl.namespace,
+                        value=max(now - wl.creation_time, 0.0))
                 adm = wl.condition(WorkloadConditionType.ADMITTED)
                 if adm is not None and adm.status:
                     metrics.admitted_until_ready_wait_time_seconds.observe(
                         cq, value=max(now - adm.last_transition_time, 0.0))
+                    if metrics._lq_metrics_enabled():
+                        (metrics
+                         .local_queue_admitted_until_ready_wait_time_seconds
+                         .observe(wl.queue_name, wl.namespace,
+                                  value=max(now - adm.last_transition_time,
+                                            0.0)))
         if ready:
             # Pods came up: the PodsReady requeue/backoff history is done
             # (reference: RequeueState reset once the workload runs).
@@ -164,6 +175,17 @@ class WorkloadReconciler:
         if now >= due:
             self.store.delete_workload(wl.key)
             self.gc_deleted.append(wl.key)
+            # the "currently retained" gauges shed the GC'd workload
+            from kueue_oss_tpu import metrics
+
+            cq = (wl.status.admission.cluster_queue
+                  if wl.status.admission is not None
+                  else self.store.cluster_queue_for(wl))
+            if cq:
+                metrics.finished_workloads_gauge.inc(cq, by=-1)
+                if metrics._lq_metrics_enabled():
+                    metrics.local_queue_finished_workloads_gauge.inc(
+                        wl.queue_name, wl.namespace, by=-1)
             return None
         return due
 
@@ -289,6 +311,12 @@ class WorkloadReconciler:
                 if qr is not None:
                     metrics.admission_checks_wait_time_seconds.observe(
                         cq_name, value=max(now - qr.last_transition_time, 0.0))
+                    if metrics._lq_metrics_enabled():
+                        (metrics
+                         .local_queue_admission_checks_wait_time_seconds
+                         .observe(wl.queue_name, wl.namespace,
+                                  value=max(now - qr.last_transition_time,
+                                            0.0)))
         return False
 
     # -- max execution time -------------------------------------------------

@@ -81,6 +81,9 @@ class JobReconciler:
     def delete_job(self, job: GenericJob, now: float = 0.0) -> None:
         self.jobs.pop((job.kind, job.key), None)
         owner = f"{job.kind}/{job.key}"
+        # the owned workloads are deleted below; keeping the owner id
+        # would only grow _known_owners without bound
+        self._known_owners.discard(owner)
         # All workloads owned by the job — the base workload and, for
         # elastic jobs, every slice (suffixIndexed names).
         keys = [wl.key for wl in self.store.workloads.values()

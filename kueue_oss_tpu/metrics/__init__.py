@@ -364,6 +364,28 @@ workload_creation_latency_seconds = registry.register(Histogram(
     "kueue_workload_creation_latency_seconds",
     "Time from job creation to its Workload object creation",
     ("job_kind",), buckets=WAIT_BUCKETS))
+workload_eviction_latency_seconds = registry.register(Histogram(
+    "kueue_workload_eviction_latency_seconds",
+    "Time from the Evicted condition turning True until quota released "
+    "(metrics.go:654-666; ~0 for synchronous in-process evictions, >0 "
+    "when a deferred flow set the condition earlier)",
+    ("cluster_queue", "reason"), buckets=WAIT_BUCKETS))
+local_queue_admission_checks_wait_time_seconds = registry.register(
+    Histogram("kueue_local_queue_admission_checks_wait_time_seconds",
+              "Per-LQ time waiting on admission checks",
+              ("local_queue", "namespace"), buckets=WAIT_BUCKETS))
+local_queue_admitted_until_ready_wait_time_seconds = registry.register(
+    Histogram("kueue_local_queue_admitted_until_ready_wait_time_seconds",
+              "Per-LQ time from admission until all pods ready",
+              ("local_queue", "namespace"), buckets=WAIT_BUCKETS))
+local_queue_ready_wait_time_seconds = registry.register(
+    Histogram("kueue_local_queue_ready_wait_time_seconds",
+              "Per-LQ time from creation until all pods ready",
+              ("local_queue", "namespace"), buckets=WAIT_BUCKETS))
+local_queue_finished_workloads_gauge = registry.register(Gauge(
+    "kueue_local_queue_finished_workloads",
+    "Finished workloads currently retained per LQ",
+    ("local_queue", "namespace")))
 cluster_queue_resource_pending = registry.register(Gauge(
     "kueue_cluster_queue_resource_pending",
     "Pending requested quantity per CQ/resource",

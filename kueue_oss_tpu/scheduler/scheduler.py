@@ -119,6 +119,10 @@ class Scheduler:
         self._cycle_touched_cqs: set[str] = set()
         #: cq -> (lq, ns) label sets last reported, for gauge zero-fill
         self._lq_reported: dict[str, set] = {}
+        from kueue_oss_tpu.util import logging as klog
+
+        #: structured logger (zap-via-controller-runtime analog)
+        self.log = klog.root.with_name("scheduler")
         # metrics
         self.admitted_total: dict[str, int] = {}
         self.preempted_total: dict[str, int] = {}
@@ -173,6 +177,11 @@ class Scheduler:
             self._requeue_and_update(e)
 
         stats.duration_s = self.clock() - start
+        self.log.info("cycle finished", v=2, cycle=stats.cycle,
+                      heads=stats.heads, admitted=stats.admitted,
+                      preempted=stats.preempted,
+                      inadmissible=stats.inadmissible,
+                      duration_s=round(stats.duration_s, 6))
         self.admission_attempt_durations.append(stats.duration_s)
         result = (metrics.CycleResult.SUCCESS if stats.admitted or stats.preempted
                   else metrics.CycleResult.INADMISSIBLE)
@@ -903,6 +912,7 @@ class Scheduler:
         cq = (wl.status.admission.cluster_queue
               if wl.status.admission is not None
               else self.store.cluster_queue_for(wl))
+        was_reserved = wl.is_quota_reserved
         wl.set_condition(WorkloadConditionType.EVICTED, True, reason=reason,
                          message=message, now=now)
         if preemption_reason:
@@ -952,11 +962,22 @@ class Scheduler:
                       WARNING if preemption_reason else NORMAL,
                       "Preempted" if preemption_reason else "Evicted",
                       message, now=now)
+        self.log.info("workload evicted", v=2, workload=wl.key,
+                      reason=reason, preemption=bool(preemption_reason))
         # the eviction is now observable: clear pending expectations
         self.preemption_expectations.observe(wl.uid)
         self.evicted_total[wl.key] = self.evicted_total.get(wl.key, 0) + 1
         if cq:
             metrics.evicted_workloads_total.inc(cq, reason)
+            # latency = Evicted-condition transition -> quota released;
+            # only meaningful when THIS call released a reservation (an
+            # already-pending workload re-evicted by job deletion would
+            # otherwise record the stale transition age)
+            ev = wl.condition(WorkloadConditionType.EVICTED)
+            if ev is not None and was_reserved:
+                metrics.workload_eviction_latency_seconds.observe(
+                    cq, reason,
+                    value=max(now - ev.last_transition_time, 0.0))
             if self.evicted_total[wl.key] == 1:
                 metrics.evicted_workloads_once_total.inc(cq, reason)
             if metrics._lq_metrics_enabled():
@@ -1071,6 +1092,8 @@ class Scheduler:
             metrics.finished_workloads_gauge.inc(cq)
             if metrics._lq_metrics_enabled():
                 metrics.local_queue_finished_workloads_total.inc(
+                    wl.queue_name, wl.namespace)
+                metrics.local_queue_finished_workloads_gauge.inc(
                     wl.queue_name, wl.namespace)
             self._cycle_touched_cqs.add(cq)
         self.queues.report_workload_finished(wl)

@@ -243,6 +243,37 @@ def make_placer(parents_np: list[np.ndarray]):
     return place
 
 
+def make_sequential_placer(parents_np: list[np.ndarray]):
+    """Jitted DRAIN of a whole TAS backlog on device: place M podsets
+    one after another with the leaf-capacity carry updated in between
+    (the perf-shape workload: 15k sequential admissions against one
+    640-node tree, configs/tas/generator.yaml). One lax.scan step per
+    workload; everything stays on the accelerator.
+
+    Inputs: per-workload arrays [M] — per_pod [M,R], count [M],
+    requested level [M], required/unconstrained/least_free flags [M].
+    Returns (leaf_sel [M, D_leaf], feasible [M], leaf_capacity_after).
+    """
+    place = make_placer(parents_np)
+
+    @jax.jit
+    def place_all(leaf_capacity, per_pod, count, level, required,
+                  unconstrained, least_free):
+        def step(cap, xs):
+            pp, ct, lv, rq, un, lf = xs
+            sel, ok = place(cap, pp, ct, lv, rq, un, lf)
+            take = jnp.where(ok, sel, 0)
+            cap = cap - take[:, None] * pp[None, :]
+            return cap, (sel * ok.astype(sel.dtype), ok)
+
+        cap_after, (sels, oks) = jax.lax.scan(
+            step, leaf_capacity,
+            (per_pod, count, level, required, unconstrained, least_free))
+        return sels, oks, cap_after
+
+    return place_all
+
+
 _placer_cache: dict = {}
 
 

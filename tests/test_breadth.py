@@ -194,3 +194,36 @@ class TestReadinessMetrics:
         before = metrics.ready_wait_time_seconds.total_count()
         rec.set_pods_ready("default/w1", True, now=5.0)
         assert metrics.ready_wait_time_seconds.total_count() == before + 1
+
+
+def test_structured_logging():
+    """util/logging: JSON-lines with verbosity gating, WithValues /
+    WithName context (zap-via-logr analog)."""
+    from kueue_oss_tpu.util.logging import CapturingLogger
+
+    log = CapturingLogger(level=1)
+    log.info("plain", answer=42)
+    log.info("dropped", v=5)
+    child = log.with_name("scheduler").with_values(cycle=7)
+    child.info("cycle finished", v=1, admitted=3)
+    child.error("boom", workload="default/w")
+    recs = log.records
+    assert [r["msg"] for r in recs] == ["plain", "cycle finished", "boom"]
+    assert recs[0]["answer"] == 42
+    assert recs[1]["logger"] == "scheduler" and recs[1]["cycle"] == 7
+    assert recs[2]["severity"] == "error"
+
+
+def test_scheduler_logs_cycles_when_verbose():
+    import json as _json
+
+    from kueue_oss_tpu.util.logging import CapturingLogger
+
+    store, queues, sched = make_env()
+    cap = CapturingLogger(level=2)
+    sched.log = cap.with_name("scheduler")
+    submit(store, "w", "lq0", cpu=100)
+    sched.schedule(1.0)
+    parsed = [_json.loads(l) for l in cap._buffer.getvalue().splitlines()]
+    assert any(p["msg"] == "cycle finished" and p["admitted"] == 1
+               for p in parsed)

@@ -132,3 +132,31 @@ def test_finish_orphans_requires_known_owner():
     fresh.reconcile_all(1.0)
     assert not store.workloads[wl.key].is_finished, \
         "restarted reconciler must not GC unseen owners"
+
+
+def test_lq_wait_time_and_eviction_latency_series():
+    """The per-LQ wait-time histograms and the eviction-latency series
+    record at their CQ counterparts' sites (metrics.go parity)."""
+    from kueue_oss_tpu import metrics
+    from kueue_oss_tpu.controllers import WorkloadReconciler
+
+    store, sched, jr = make_env()
+    jr.workload_reconciler = WorkloadReconciler(store, sched)
+    job = BatchJob(name="j", queue_name="default", parallelism=1,
+                   requests={"cpu": 100})
+    jr.upsert_job(job)
+    jr.reconcile(job, 0.0)
+    sched.schedule(1.0)
+    jr.reconcile_all(1.0)
+    job.mark_running()
+    jr.reconcile_all(2.0)
+    key = ("default", "default")
+    assert key in metrics.local_queue_ready_wait_time_seconds._values
+    assert key in (metrics
+                   .local_queue_admitted_until_ready_wait_time_seconds
+                   ._values)
+
+    sched.evict_workload(jr.workload_for(job).key, reason="Preempted",
+                         message="test", now=3.0)
+    assert any(k[0] == "cq" for k in
+               metrics.workload_eviction_latency_seconds._values)

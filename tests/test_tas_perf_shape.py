@@ -98,3 +98,75 @@ def test_tas_perf_shape_preferred_spills_across_racks():
     assert h is not None and k == h
     racks = {dom[-1].rsplit("-", 1)[0] for dom in h}
     assert len(racks) > 1, "placement must span racks"
+
+
+@pytest.mark.slow
+def test_sequential_placer_matches_stepwise_drain():
+    """make_sequential_placer: the whole-backlog on-device drain (one
+    lax.scan step per workload, capacity carried) must equal the
+    step-by-step host drain."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from kueue_oss_tpu.solver.tas_kernels import (
+        build_levels,
+        make_sequential_placer,
+    )
+
+    nodes = make_nodes(1, 10, 64, cpu=96_000)
+    by_host = {n.name: (n.labels[BLOCK], n.labels[RACK], n.name)
+               for n in nodes}
+    snap_h = build_tas_flavor_snapshot("default", LEVELS, list(nodes))
+    levels = build_levels(snap_h)
+    rng = random.Random(7)
+
+    M = 512
+    specs = []
+    for _ in range(M):
+        cls, pods, cpu = MIX[rng.randrange(len(MIX))]
+        mode = MODES[rng.randrange(len(MODES))]
+        specs.append((pods, cpu, mode))
+
+    # host: sequential placements with accumulating usage
+    host_results = []
+    for pods, cpu, mode in specs:
+        h = host_place(snap_h, pods, {"cpu": cpu}, RACK,
+                       required=mode == "required",
+                       unconstrained=mode == "unconstrained")
+        host_results.append(h)
+        if h is not None:
+            for dom, count in h.items():
+                snap_h.add_tas_usage(by_host[dom[-1]], {"cpu": cpu},
+                                     count)
+
+    # device: one scan over the same backlog
+    R = len(levels.resources)
+    per_pod = np.zeros((M, R), dtype=np.int32)
+    per_pod[:, levels.resources.index("cpu")] = [c for _, c, _ in specs]
+    count = np.asarray([p for p, _, _ in specs], dtype=np.int32)
+    rack_idx = LEVELS.index(RACK)
+    level = np.asarray(
+        [len(LEVELS) - 1 if m == "unconstrained" else rack_idx
+         for _, _, m in specs], dtype=np.int32)
+    required = np.asarray([m == "required" for _, _, m in specs])
+    unconstrained = np.asarray([m == "unconstrained"
+                                for _, _, m in specs])
+    least_free = unconstrained & snap_h.profile_mixed
+    place_all = make_sequential_placer(levels.parents)
+    sels, oks, _cap = place_all(
+        jnp.asarray(levels.leaf_capacity), jnp.asarray(per_pod),
+        jnp.asarray(count), jnp.asarray(level), jnp.asarray(required),
+        jnp.asarray(unconstrained), jnp.asarray(least_free))
+    sels = np.asarray(sels)
+    oks = np.asarray(oks)
+
+    n_ok = 0
+    for i, h in enumerate(host_results):
+        if h is None:
+            assert not oks[i], (i, specs[i])
+            continue
+        n_ok += 1
+        got = {(levels.leaf_names[d][-1],): int(sels[i, d])
+               for d in np.nonzero(sels[i])[0]}
+        assert oks[i] and got == h, (i, specs[i], h, got)
+    assert n_ok > M // 2
