@@ -226,30 +226,25 @@ class Kueuectl:
                 out[k] = v
             return out
 
-        def parse_taints(spec: str) -> list[Taint]:
+        def parse_effects(spec: str, default_effect: str) -> list[tuple]:
             out = []
             for entry in filter(None, spec.split(",")):
                 kv, _, effect = entry.partition(":")
                 k, _, v = kv.partition("=")
-                out.append(Taint(key=k, value=v,
-                                 effect=effect or "NoSchedule"))
-            return out
-
-        def parse_tolerations(spec: str) -> list[Toleration]:
-            # unlike taints, an EMPTY toleration effect matches all
-            # effects (types.py Toleration.tolerates) — don't default it
-            out = []
-            for entry in filter(None, spec.split(",")):
-                kv, _, effect = entry.partition(":")
-                k, _, v = kv.partition("=")
-                out.append(Toleration(key=k, value=v, effect=effect))
+                out.append((k, v, effect or default_effect))
             return out
 
         rf = ResourceFlavor(
             name=ns.name,
             node_labels=parse_kv(ns.node_labels),
-            node_taints=parse_taints(ns.node_taints),
-            tolerations=parse_tolerations(ns.tolerations),
+            node_taints=[Taint(key=k, value=v, effect=e)
+                         for k, v, e in parse_effects(
+                             ns.node_taints, "NoSchedule")],
+            # an EMPTY toleration effect matches all effects
+            # (types.py Toleration.tolerates) — no default
+            tolerations=[Toleration(key=k, value=v, effect=e)
+                         for k, v, e in parse_effects(
+                             ns.tolerations, "")],
         )
         self.store.upsert_resource_flavor(rf)
         return f"resourceflavor.kueue.x-k8s.io/{ns.name} created"

@@ -173,19 +173,10 @@ class WorkloadReconciler:
         fin = wl.condition(WorkloadConditionType.FINISHED)
         due = fin.last_transition_time + pol.finished_workload_retention_seconds
         if now >= due:
+            # Store.delete_workload decrements the retained-finished
+            # gauges on every deletion path
             self.store.delete_workload(wl.key)
             self.gc_deleted.append(wl.key)
-            # the "currently retained" gauges shed the GC'd workload
-            from kueue_oss_tpu import metrics
-
-            cq = (wl.status.admission.cluster_queue
-                  if wl.status.admission is not None
-                  else self.store.cluster_queue_for(wl))
-            if cq:
-                metrics.finished_workloads_gauge.inc(cq, by=-1)
-                if metrics._lq_metrics_enabled():
-                    metrics.local_queue_finished_workloads_gauge.inc(
-                        wl.queue_name, wl.namespace, by=-1)
             return None
         return due
 

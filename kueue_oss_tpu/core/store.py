@@ -51,6 +51,12 @@ class Store:
         self._admitted: dict[str, Workload] = {}
         #: cached WorkloadInfo for admitted workloads; invalidated on write
         self._admitted_infos: dict[str, object] = {}
+        #: keys whose FINISHED transition was counted into the
+        #: retained-finished gauges (see _track_finished)
+        self._finished_counted: set[str] = set()
+        #: cloned/simulation stores must not touch the process-wide
+        #: metric registry (Store.clone sets this False)
+        self._metrics_enabled = True
         #: generation of the global request-shaping config (LimitRanges /
         #: resource transformations) the info cache was computed under
         self._info_cache_gen = -1
@@ -62,6 +68,7 @@ class Store:
 
         with self._lock:
             out = Store()
+            out._metrics_enabled = False
             out.namespaces = copy.deepcopy(self.namespaces)
             for cohort in self.cohorts.values():
                 out.upsert_cohort(copy.deepcopy(cohort))
@@ -174,20 +181,52 @@ class Store:
                     wl.priority = pc.value
             self.workloads[wl.key] = wl
             self._index_workload(wl)
+            self._track_finished(wl)
         self._emit("add", "Workload", wl)
 
     def update_workload(self, wl: Workload) -> None:
         with self._lock:
             self.workloads[wl.key] = wl
             self._index_workload(wl)
+            self._track_finished(wl)
         self._emit("update", "Workload", wl)
+
+    def _track_finished(self, wl: Workload) -> None:
+        """The retained-finished gauges count workloads whose FINISHED
+        condition is true and that still exist in the store. Tracking
+        the transition HERE (the single write choke point) keeps inc/dec
+        balanced regardless of which component set the condition
+        (scheduler, MultiKueue copy-back, slice replacement)."""
+        if wl.is_finished and wl.key not in self._finished_counted:
+            self._finished_counted.add(wl.key)
+            self._finished_gauges(wl, +1)
+
+    def _finished_gauges(self, wl: Workload, delta: int) -> None:
+        if not self._metrics_enabled:
+            return
+        from kueue_oss_tpu import metrics
+
+        cq = (wl.status.admission.cluster_queue
+              if wl.status.admission is not None
+              else self.cluster_queue_for(wl))
+        if cq:
+            metrics.finished_workloads_gauge.inc(cq, by=delta)
+            if metrics._lq_metrics_enabled():
+                metrics.local_queue_finished_workloads_gauge.inc(
+                    wl.queue_name, wl.namespace, by=delta)
 
     def delete_workload(self, key: str) -> Optional[Workload]:
         with self._lock:
             wl = self.workloads.pop(key, None)
             self._admitted.pop(key, None)
             self._admitted_infos.pop(key, None)
+            counted = key in self._finished_counted
+            self._finished_counted.discard(key)
         if wl is not None:
+            if counted:
+                # shed the retained-finished sample on ANY deletion path
+                # (retention GC, job deletion, slices)
+                self._finished_gauges(wl, -1)
             self._emit("delete", "Workload", wl)
         return wl
 

@@ -27,6 +27,12 @@ _DEFAULTS: dict[str, bool] = {
     "AdmissionFairSharing": True,      # queue_manager AFS ordering key
     # multi-cluster
     "MultiKueue": True,                # multikueue controller sync
+    # hub check waits for worker ADMITTED, not just quota-reserved (GA)
+    "MultiKueueWaitForWorkloadAdmitted": True,  # controller race check
+    # worker eviction triggers hub re-dispatch instead of waiting (GA)
+    "MultiKueueRedoAdmissionOnEvictionInWorker": True,  # _sync_winner
+    # jobs managedBy the multikueue controller never start locally (GA)
+    "MultiKueueBatchJobWithManagedBy": True,  # jobframework run gate
     # observability
     "VisibilityOnDemand": True,        # visibility pending-workloads API
     "LocalQueueMetrics": True,         # local_queue_* metric series
@@ -46,6 +52,8 @@ _DEFAULTS: dict[str, bool] = {
     "WaitForPodsReady": True,          # workload controller PodsReady path
     # elastic jobs (KEP-77; reference default off)
     "ElasticJobsViaWorkloadSlices": False,  # workloadslicing + scheduler hooks
+    # slices for TAS-placed jobs (alpha, off)
+    "ElasticJobsViaWorkloadSlicesWithTAS": False,  # workloadslicing.enabled
     # concurrent admission variants (KEP-8691; reference default off)
     "ConcurrentAdmission": False,      # variant fan-out + migration hooks
     # MultiKueue orchestrated preemption (KEP-8303)

@@ -96,6 +96,10 @@ class BaseJob(GenericJob):
     namespace: str = "default"
     #: kueue.x-k8s.io/queue-name label on the reference
     queue_name: str = ""
+    #: spec.managedBy (JobWithManagedBy): a job delegated to the
+    #: MultiKueue controller runs on a WORKER cluster; the local
+    #: reconciler must never unsuspend it (job_multikueue_adapter.go)
+    managed_by: Optional[str] = None
     suspend: bool = True
     priority_class: Optional[str] = None
     priority: int = 0

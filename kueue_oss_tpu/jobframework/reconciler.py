@@ -205,6 +205,18 @@ class JobReconciler:
                 self._stop_job(job, wl, StopReason.NOT_ADMITTED, now)
             return
 
+        # A job managedBy the MultiKueue controller executes on a WORKER
+        # cluster; the hub-side copy stays suspended even once admitted
+        # (MultiKueueBatchJobWithManagedBy, job_multikueue_adapter.go).
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.multikueue.controller import (
+            MULTIKUEUE_CONTROLLER_NAME,
+        )
+
+        if (getattr(job, "managed_by", None) == MULTIKUEUE_CONTROLLER_NAME
+                and features.enabled("MultiKueueBatchJobWithManagedBy")):
+            return
+
         # Admitted → run with injected podset infos.
         if job.is_suspended():
             job.run_with_podsets_info(self._podset_infos(wl))

@@ -90,13 +90,22 @@ class MultiKueueController:
                 continue
             self._ensure_mirror(wl, cluster)
 
-        # Did any worker admit its mirror?
+        # Did any worker admit its mirror? Under the GA
+        # MultiKueueWaitForWorkloadAdmitted gate the race is won only by
+        # full admission (all worker checks ready); with the gate off,
+        # the pre-0.18 behavior settles for quota reservation.
+        from kueue_oss_tpu import features
+
+        wait_admitted = features.enabled("MultiKueueWaitForWorkloadAdmitted")
         for name in wl.status.nominated_cluster_names:
             cluster = self.clusters.get(name)
             if cluster is None or not cluster.active:
                 continue
             mirror = cluster.environment.store.workloads.get(wl.key)
-            if mirror is not None and mirror.is_admitted:
+            won = (mirror is not None
+                   and (mirror.is_admitted if wait_admitted
+                        else mirror.is_quota_reserved))
+            if won:
                 wl.status.cluster_name = name
                 wl.status.nominated_cluster_names = []
                 state.state = CheckState.READY
@@ -141,6 +150,41 @@ class MultiKueueController:
             state.message = f"Mirror lost on worker \"{winner}\""
             self.store.update_workload(wl)
             return
+        from kueue_oss_tpu import features
+
+        if (not mirror.is_quota_reserved and not mirror.is_finished
+                and features.enabled(
+                    "MultiKueueRedoAdmissionOnEvictionInWorker")):
+            # The worker evicted the mirror (preemption / stop policy):
+            # redo the hub-side admission race instead of waiting for
+            # the worker to re-admit (workload.go eviction redo, GA).
+            # The requeued mirror must be WITHDRAWN first — a fresh race
+            # could pick a different worker while the old mirror
+            # re-admits, running the workload on two clusters.
+            self._cleanup_remotes(wl, keep=None)
+            if hasattr(self.dispatcher, "clear"):
+                self.dispatcher.clear(wl.key)
+            wl.status.cluster_name = None
+            wl.status.nominated_cluster_names = []
+            state.state = CheckState.RETRY
+            state.message = (f"The workload got evicted on worker "
+                             f"\"{winner}\"")
+            self.store.update_workload(wl)
+            return
+        # Propagate the worker's PodsReady condition to the hub
+        # workload: the hub's WaitForPodsReady timers must see the
+        # delegated job's real readiness (the local job never starts
+        # under MultiKueueBatchJobWithManagedBy).
+        ready = mirror.condition(WorkloadConditionType.PODS_READY)
+        if ready is not None:
+            cur = wl.condition(WorkloadConditionType.PODS_READY)
+            if cur is None or cur.status != ready.status:
+                wl.set_condition(
+                    WorkloadConditionType.PODS_READY, ready.status,
+                    reason=ready.reason, message=ready.message, now=now)
+                if ready.status:
+                    wl.status.requeue_state = None
+                self.store.update_workload(wl)
         if mirror.is_finished and not wl.is_finished:
             # Copy terminal status back to the hub (workload.go status sync).
             fin = mirror.condition(WorkloadConditionType.FINISHED)

@@ -1088,12 +1088,12 @@ class Scheduler:
                          reason="JobFinished", now=now)
         self.store.update_workload(wl)
         if cq:
+            # the retained-finished GAUGES are maintained by the Store's
+            # write choke point (_track_finished); only the monotone
+            # counters live here
             metrics.finished_workloads_total.inc(cq)
-            metrics.finished_workloads_gauge.inc(cq)
             if metrics._lq_metrics_enabled():
                 metrics.local_queue_finished_workloads_total.inc(
-                    wl.queue_name, wl.namespace)
-                metrics.local_queue_finished_workloads_gauge.inc(
                     wl.queue_name, wl.namespace)
             self._cycle_touched_cqs.add(cq)
         self.queues.report_workload_finished(wl)

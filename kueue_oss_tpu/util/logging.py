@@ -31,26 +31,42 @@ class StructuredLogger:
     def __init__(self, sink: Optional[TextIO] = None, level: int = 0,
                  name: str = "", clock=time.time,
                  _bound: Optional[dict] = None,
-                 _lock: Optional[threading.Lock] = None) -> None:
+                 _lock: Optional[threading.Lock] = None,
+                 _level_ref: Optional[list] = None) -> None:
         self.sink = sink if sink is not None else sys.stderr
-        self.level = level
+        #: verbosity is SHARED with child loggers by reference, so
+        #: set_verbosity() after children were created (the documented
+        #: startup flow: construct components, then apply config)
+        #: affects every logger in the tree
+        self._level_ref = _level_ref if _level_ref is not None else [level]
         self.name = name
         self.clock = clock
         self._bound = dict(_bound or {})
         self._lock = _lock or threading.Lock()
+
+    @property
+    def level(self) -> int:
+        return self._level_ref[0]
+
+    @level.setter
+    def level(self, value: int) -> None:
+        self._level_ref[0] = value
 
     # -- context ------------------------------------------------------------
 
     def with_values(self, **kv: Any) -> "StructuredLogger":
         bound = dict(self._bound)
         bound.update(kv)
-        return StructuredLogger(self.sink, self.level, self.name,
-                                self.clock, bound, self._lock)
+        return StructuredLogger(self.sink, name=self.name,
+                                clock=self.clock, _bound=bound,
+                                _lock=self._lock,
+                                _level_ref=self._level_ref)
 
     def with_name(self, name: str) -> "StructuredLogger":
         full = f"{self.name}.{name}" if self.name else name
-        return StructuredLogger(self.sink, self.level, full, self.clock,
-                                self._bound, self._lock)
+        return StructuredLogger(self.sink, name=full, clock=self.clock,
+                                _bound=self._bound, _lock=self._lock,
+                                _level_ref=self._level_ref)
 
     # -- emit ---------------------------------------------------------------
 

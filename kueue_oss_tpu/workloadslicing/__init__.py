@@ -33,11 +33,23 @@ TARGET_REASON = "WorkloadSliceReplacement"
 
 
 def enabled(job) -> bool:
-    """True when the job opts into slicing (workloadslicing.go Enabled)."""
+    """True when the job opts into slicing (workloadslicing.go Enabled).
+
+    Jobs whose podsets carry topology requests additionally need the
+    alpha ElasticJobsViaWorkloadSlicesWithTAS gate: a slice replacing a
+    TAS-placed workload must re-run placement, which the base slicing
+    path only supports behind that gate (kube_features.go)."""
     if not features.enabled("ElasticJobsViaWorkloadSlices"):
         return False
-    return (getattr(job, "annotations", {}).get(ENABLED_ANNOTATION_KEY)
-            == ENABLED_ANNOTATION_VALUE)
+    if (getattr(job, "annotations", {}).get(ENABLED_ANNOTATION_KEY)
+            != ENABLED_ANNOTATION_VALUE):
+        return False
+    uses_tas = any(ps.topology_request is not None
+                   for ps in job.pod_sets())
+    if uses_tas and not features.enabled(
+            "ElasticJobsViaWorkloadSlicesWithTAS"):
+        return False
+    return True
 
 
 def is_elastic_workload(wl: Workload) -> bool:

@@ -160,3 +160,68 @@ def test_lq_wait_time_and_eviction_latency_series():
                          message="test", now=3.0)
     assert any(k[0] == "cq" for k in
                metrics.workload_eviction_latency_seconds._values)
+
+
+def test_slices_with_tas_need_their_gate():
+    from kueue_oss_tpu import workloadslicing
+    from kueue_oss_tpu.api.types import PodSetTopologyRequest
+    from kueue_oss_tpu.jobs import StatefulSet
+    from kueue_oss_tpu.workloadslicing import (
+        ENABLED_ANNOTATION_KEY,
+        ENABLED_ANNOTATION_VALUE,
+    )
+
+    features.set_gates({"ElasticJobsViaWorkloadSlices": True})
+    plain = StatefulSet(
+        name="s", replicas=2, requests={"cpu": 100},
+        annotations={ENABLED_ANNOTATION_KEY: ENABLED_ANNOTATION_VALUE})
+    assert workloadslicing.enabled(plain)
+
+    tas_job = StatefulSet(
+        name="t", replicas=2, requests={"cpu": 100},
+        annotations={ENABLED_ANNOTATION_KEY: ENABLED_ANNOTATION_VALUE})
+    tas_job.pod_sets()[0]  # shape check
+    # give the podsets a topology request via subclass shim
+    class TASSts(StatefulSet):
+        def pod_sets(self):
+            sets = super().pod_sets()
+            for ps in sets:
+                ps.topology_request = PodSetTopologyRequest(
+                    required="cloud/rack")
+            return sets
+
+    tj = TASSts(name="t", replicas=2, requests={"cpu": 100},
+                annotations={ENABLED_ANNOTATION_KEY:
+                             ENABLED_ANNOTATION_VALUE})
+    assert not workloadslicing.enabled(tj), "TAS slices need the gate"
+    features.set_gates({"ElasticJobsViaWorkloadSlicesWithTAS": True})
+    assert workloadslicing.enabled(tj)
+
+
+def test_verbosity_change_reaches_existing_child_loggers():
+    from kueue_oss_tpu.util.logging import CapturingLogger
+
+    cap = CapturingLogger(level=0)
+    child = cap.with_name("scheduler").with_values(x=1)
+    child.info("hidden", v=2)
+    cap.level = 2  # set_verbosity analog: after children exist
+    child.info("visible", v=2)
+    assert [r["msg"] for r in cap.records] == ["visible"]
+
+
+def test_finished_gauge_decrements_on_any_deletion():
+    from kueue_oss_tpu import metrics
+
+    store, sched, jr = make_env()
+    job = BatchJob(name="j", queue_name="default", parallelism=1,
+                   requests={"cpu": 100})
+    jr.upsert_job(job)
+    jr.reconcile(job, 0.0)
+    sched.schedule(1.0)
+    jr.reconcile_all(1.0)
+    job.mark_finished()
+    jr.reconcile_all(2.0)
+    before = metrics.finished_workloads_gauge._values.get(("cq",), 0)
+    jr.delete_job(job, now=3.0)  # deletes the finished workload
+    after = metrics.finished_workloads_gauge._values.get(("cq",), 0)
+    assert after == before - 1, (before, after)

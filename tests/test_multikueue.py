@@ -220,3 +220,134 @@ def test_preemption_gate_blocks_preemption_until_opened():
         assert store.workloads["default/high"].is_quota_reserved
     finally:
         features.reset()
+
+
+def test_worker_eviction_redoes_hub_admission():
+    """MultiKueueRedoAdmissionOnEvictionInWorker (GA): a worker evicting
+    the winning mirror flips the hub check to Retry and restarts the
+    race, instead of waiting for the worker to re-admit."""
+    env = MkEnv()
+    env.submit()
+    env.tick()
+    wl = env.wl()
+    winner = wl.status.cluster_name
+    assert winner is not None
+    # the worker preempts/evicts the mirror but keeps the object
+    env.mk.clusters[winner].environment.scheduler.evict_workload(
+        wl.key, reason="Preempted", message="worker-side preemption",
+        now=env.t, requeue=True)
+    env.mk.reconcile_all(env.t + 1)
+    assert wl.status.cluster_name is None
+    assert wl.status.admission_checks["multikueue"].state == CheckState.RETRY
+
+    # with the gate off, the hub keeps waiting on the winner
+    features.set_gates({"MultiKueueRedoAdmissionOnEvictionInWorker": False})
+    try:
+        env2 = MkEnv()
+        env2.submit()
+        env2.tick()
+        wl2 = env2.wl()
+        winner2 = wl2.status.cluster_name
+        env2.mk.clusters[winner2].environment.scheduler.evict_workload(
+            wl2.key, reason="Preempted", message="worker-side preemption",
+            now=env2.t, requeue=True)
+        env2.mk.reconcile_all(env2.t + 1)
+        assert wl2.status.cluster_name == winner2, "gate off: keep waiting"
+    finally:
+        features.reset()
+
+
+def test_wait_for_admitted_gate_controls_race_win():
+    """MultiKueueWaitForWorkloadAdmitted: a worker whose mirror is only
+    quota-reserved (an unsatisfied worker-side admission check) wins the
+    race only with the gate OFF."""
+    env = MkEnv(worker_quotas=(8000,))
+    worker = env.workers[0]
+    # worker CQ requires a check nobody satisfies -> mirrors reserve
+    # quota but never reach Admitted
+    wcq = worker.environment.store.cluster_queues["cq"]
+    wcq.admission_checks = ["hold"]
+    worker.environment.store.upsert_cluster_queue(wcq)
+    worker.environment.store.upsert_admission_check(
+        AdmissionCheck(name="hold"))
+    env.submit()
+    for _ in range(3):
+        env.tick()
+    wl = env.wl()
+    assert wl.status.cluster_name is None, \
+        "gate on: quota-reserved-only mirror must not win"
+
+    features.set_gates({"MultiKueueWaitForWorkloadAdmitted": False})
+    try:
+        env.tick()
+        assert env.wl().status.cluster_name == "worker1", \
+            "gate off: reservation wins the race"
+    finally:
+        features.reset()
+
+
+def test_managed_by_multikueue_job_never_starts_locally():
+    """MultiKueueBatchJobWithManagedBy: a job delegated to the
+    multikueue controller stays suspended on the hub even after its
+    workload is admitted (it runs on the worker)."""
+    from kueue_oss_tpu.jobframework import JobReconciler
+    from kueue_oss_tpu.jobs import BatchJob
+
+    env = MkEnv()
+    jr = JobReconciler(env.hub_store, env.hub_scheduler,
+                       workload_reconciler=env.hub_wr)
+    job = BatchJob(name="delegated", queue_name="lq", parallelism=1,
+                   requests={"cpu": 500},
+                   managed_by=MULTIKUEUE_CONTROLLER_NAME)
+    jr.upsert_job(job)
+    jr.reconcile(job, env.t)
+    for _ in range(3):
+        env.tick()
+        jr.reconcile_all(env.t)
+    wl = jr.workload_for(job)
+    assert wl.is_admitted
+    assert job.is_suspended(), "hub copy must not start"
+
+    local = BatchJob(name="local", queue_name="lq", parallelism=1,
+                     requests={"cpu": 500})
+    jr.upsert_job(local)
+    jr.reconcile(local, env.t)
+    for _ in range(3):
+        env.tick()
+        jr.reconcile_all(env.t)
+    assert not local.is_suspended(), "un-delegated jobs still start"
+
+
+def test_worker_pods_ready_propagates_to_hub():
+    """A delegated job never starts locally, so the hub's PodsReady
+    (and its WaitForPodsReady timers) must track the WORKER mirror."""
+    env = MkEnv()
+    env.submit()
+    env.tick()
+    wl = env.wl()
+    winner = env.mk.clusters[wl.status.cluster_name]
+    mirror = winner.environment.store.workloads[wl.key]
+    from kueue_oss_tpu.api.types import WorkloadConditionType
+
+    mirror.set_condition(WorkloadConditionType.PODS_READY, True,
+                         reason="PodsReady", now=env.t)
+    env.mk.reconcile_all(env.t + 1)
+    cond = wl.condition(WorkloadConditionType.PODS_READY)
+    assert cond is not None and cond.status
+
+
+def test_eviction_redo_withdraws_stale_mirror():
+    """The redo path must withdraw the requeued mirror before
+    restarting the race — otherwise the workload can run on two
+    clusters at once."""
+    env = MkEnv()
+    env.submit()
+    env.tick()
+    wl = env.wl()
+    winner = wl.status.cluster_name
+    env.mk.clusters[winner].environment.scheduler.evict_workload(
+        wl.key, reason="Preempted", message="worker preemption",
+        now=env.t, requeue=True)
+    env.mk.reconcile_all(env.t + 1)
+    assert wl.key not in env.mk.clusters[winner].environment.store.workloads, \
+        "stale mirror must be withdrawn on redo"

