@@ -60,6 +60,9 @@ _DEFAULTS: dict[str, bool] = {
     "MultiKueueOrchestratedPreemption": False,  # scheduler gate check
     # BestEffortFIFO NoFit equivalence-class dedup (kube_features.go)
     "SchedulingEquivalenceHashing": True,  # queue_manager no-fit hashes
+    # fair-sharing variants (beta, on since 0.17)
+    "FairSharingPreemptWithinNominal": True,   # preemption S1 bypass
+    "FairSharingPrioritizeNonBorrowing": True,  # tournament step 1
     # LocalQueue status lists usable flavors (kube_features.go)
     "ExposeFlavorsInLocalQueue": True,  # core_controllers LQ status
     # namespace selector bounds queue-named jobs too (kube_features.go

@@ -631,6 +631,17 @@ class Preemptor:
     def _run_first_fs_strategy(
         self, ctx: _PreemptionCtx, candidates: list[WorkloadInfo], strategy
     ) -> tuple[bool, list[Target], list[WorkloadInfo]]:
+        from kueue_oss_tpu import features
+
+        # FairSharingPreemptWithinNominal (beta, on): a preemptor whose
+        # CQ stays within nominal quota on the contested resources —
+        # the incoming usage is already simulated by the caller — is
+        # entitled to preempt cross-CQ candidates UNCONDITIONALLY,
+        # bypassing the DRS strategy check (preemption.go:377-412); no
+        # retry candidates are produced for the second strategy.
+        within_nominal = (
+            features.enabled("FairSharingPreemptWithinNominal")
+            and ctx.cq.is_within_nominal(ctx.frs))
         ordering = _CQOrdering(ctx.cq, candidates, ctx.now)
         targets: list[Target] = []
         retry: list[WorkloadInfo] = []
@@ -639,6 +650,14 @@ class Preemptor:
                 wl = cand_cq.pop_workload()
                 ctx.snapshot.remove_workload(wl)
                 targets.append(Target(wl, IN_CLUSTER_QUEUE, cand_cq.cq))
+                if self._workload_fits_fs(ctx):
+                    return True, targets, []
+                continue
+            if within_nominal:
+                wl = cand_cq.pop_workload()
+                ctx.snapshot.remove_workload(wl)
+                targets.append(Target(wl, IN_COHORT_RECLAMATION,
+                                      cand_cq.cq))
                 if self._workload_fits_fs(ctx):
                     return True, targets, []
                 continue

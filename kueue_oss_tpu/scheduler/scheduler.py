@@ -1114,6 +1114,10 @@ class _ClassicalIterator:
     borrows first, higher priority, FIFO."""
 
     def __init__(self, entries: list[Entry]) -> None:
+        from kueue_oss_tpu import features
+
+        priority_step = features.enabled("PrioritySortingWithinCohort")
+
         def cmp(a: Entry, b: Entry) -> int:
             aq = a.info.obj.is_quota_reserved
             bq = b.info.obj.is_quota_reserved
@@ -1122,10 +1126,11 @@ class _ClassicalIterator:
             ab, bb = a.assignment.borrows(), b.assignment.borrows()
             if ab != bb:
                 return -1 if ab < bb else 1
-            pa = effective_priority(a.info.obj)
-            pb = effective_priority(b.info.obj)
-            if pa != pb:
-                return -1 if pa > pb else 1
+            if priority_step:
+                pa = effective_priority(a.info.obj)
+                pb = effective_priority(b.info.obj)
+                if pa != pb:
+                    return -1 if pa > pb else 1
             ta = queue_order_timestamp(a.info.obj)
             tb = queue_order_timestamp(b.info.obj)
             if ta != tb:
@@ -1162,19 +1167,21 @@ class _FairSharingIterator:
         if not cq.has_parent():
             return self.cq_to_entry.pop(cq)
         root = cq.parent().root()
-        drs_values = self._compute_drs(root)
-        winner = self._run_tournament(root, drs_values)
+        drs_values, requested_frs = self._compute_drs(root)
+        winner = self._run_tournament(root, drs_values, requested_frs)
         assert winner is not None
         del self.cq_to_entry[winner.cq_snapshot]
         return winner
 
     def _compute_drs(self, root):
         drs_values: dict[tuple[str, str], object] = {}
+        requested_frs: dict[str, dict] = {}
         for cq in root.subtree_cluster_queues():
             entry = self.cq_to_entry.get(cq)
             if entry is None:
                 continue
             usage = entry.assignment_usage()
+            requested_frs[entry.info.key] = usage
             revert = cq.simulate_usage_addition(usage)
             try:
                 share = cq.dominant_resource_share()
@@ -1183,14 +1190,16 @@ class _FairSharingIterator:
                     share = ancestor.dominant_resource_share()
             finally:
                 revert()
-        return drs_values
+        return drs_values, requested_frs
 
-    def _run_tournament(self, cohort, drs_values) -> Optional[Entry]:
+    def _run_tournament(self, cohort, drs_values,
+                        requested_frs) -> Optional[Entry]:
+        from kueue_oss_tpu import features
         from kueue_oss_tpu.core.quota import compare_drs
 
         candidates: list[Entry] = []
         for child in cohort.child_cohorts():
-            c = self._run_tournament(child, drs_values)
+            c = self._run_tournament(child, drs_values, requested_frs)
             if c is not None:
                 candidates.append(c)
         for child_cq in cohort.child_cqs():
@@ -1199,17 +1208,35 @@ class _FairSharingIterator:
         if not candidates:
             return None
 
+        non_borrowing_first = features.enabled(
+            "FairSharingPrioritizeNonBorrowing")
+        priority_step = features.enabled("PrioritySortingWithinCohort")
+
         def less(a: Entry, b: Entry) -> bool:
             a_drs = drs_values.get((cohort.name, a.info.key))
             b_drs = drs_values.get((cohort.name, b.info.key))
             if a_drs is not None and b_drs is not None:
+                if non_borrowing_first:
+                    # 1: nominal first — a subtree not borrowing on the
+                    # workload's REQUESTED flavors at this tournament
+                    # level wins (fair_sharing_iterator.go:180-193)
+                    ab = a_drs.is_borrowing_on(
+                        requested_frs.get(a.info.key, {}))
+                    bb = b_drs.is_borrowing_on(
+                        requested_frs.get(b.info.key, {}))
+                    if ab != bb:
+                        return not ab
+                # 2: DRF
                 c = compare_drs(a_drs, b_drs)
                 if c != 0:
                     return c < 0
-            pa = effective_priority(a.info.obj)
-            pb = effective_priority(b.info.obj)
-            if pa != pb:
-                return pa > pb
+            # 3: effective priority (gated like the reference)
+            if priority_step:
+                pa = effective_priority(a.info.obj)
+                pb = effective_priority(b.info.obj)
+                if pa != pb:
+                    return pa > pb
+            # 4: FIFO
             return (queue_order_timestamp(a.info.obj)
                     < queue_order_timestamp(b.info.obj))
 

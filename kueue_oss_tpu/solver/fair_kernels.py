@@ -139,6 +139,7 @@ def fair_search(t, lendable_r, usage0_round, wl_usage, admitted, evicted_f,
     any_same_cq, borrow_after).
     """
     from kueue_oss_tpu.solver.full_kernels import (
+        V_HIERARCHICAL_RECLAIM,
         V_WITHIN_CQ,
         _height_along_path,
         _remove_usage_along_path,
@@ -213,6 +214,19 @@ def fair_search(t, lendable_r, usage0_round, wl_usage, admitted, evicted_f,
     # simulate the incoming usage on the preemptor's CQ for the whole
     # strategy phase (preemption.py: cq.simulate_usage_addition(ctx.usage))
     usage_sim = _add_usage_along_path(t, usage0_round, cq_node, req)
+
+    # FairSharingPreemptWithinNominal (trace-time gate): a preemptor
+    # whose CQ is not borrowing on any contested FR — with the incoming
+    # usage simulated — preempts cross-CQ candidates UNCONDITIONALLY,
+    # bypassing the strategy rules (preemption.go:377-412). Those
+    # victims carry the InCohortReclamation reason.
+    from kueue_oss_tpu import features as _features
+
+    if _features.enabled("FairSharingPreemptWithinNominal"):
+        within_nominal = ~jnp.any(
+            frs_mask & (usage_sim[cq_node] > t.subtree[cq_node]))
+    else:
+        within_nominal = jnp.zeros((), dtype=bool)
 
     on_my_path = jnp.zeros((N1,), dtype=bool).at[my_path].set(
         my_path != null_node)
@@ -353,10 +367,13 @@ def fair_search(t, lendable_r, usage0_round, wl_usage, admitted, evicted_f,
                                  unw2[tgt_alca])
 
         # strategy rule: phase 1 = S2-a LessThanOrEqualToFinalShare
-        # (own-CQ pops skip the rule); phase 2 = S2-b LessThanInitialShare
+        # (own-CQ pops skip the rule; a within-nominal preemptor
+        # bypasses it for cross-CQ candidates too); phase 2 = S2-b
+        # LessThanInitialShare
         s2a = drs_le(p_zwb, p_share, p_unw, n_zwb, n_share, n_unw)
         s2b = drs_lt(p_zwb, p_share, p_unw, t_zwb, t_share, t_unw)
-        accept = slot_ok & jnp.where(phase == 1, is_own | s2a, s2b)
+        accept = slot_ok & jnp.where(
+            phase == 1, is_own | within_nominal | s2a, s2b)
 
         u = jnp.where(accept, u_try, u)
         consumed = consumed.at[jnp.minimum(slot, p_max - 1)].set(
@@ -446,9 +463,13 @@ def fair_search(t, lendable_r, usage0_round, wl_usage, admitted, evicted_f,
     borrow_after = jnp.max(jnp.where(frs_mask, level_f, 0))
     victim_same = victims & (t.wl_cqid[cand_w] == cqid)
     any_same_cq = jnp.any(victim_same)
+    # within-nominal bypass victims are entitlement reclamations
+    # (kueue.InCohortReclamationReason), not fair-sharing preemptions
+    cross_reason = jnp.where(within_nominal, V_HIERARCHICAL_RECLAIM,
+                             V_FAIR_SHARING)
     reason = jnp.where(
         victims,
-        jnp.where(victim_same, V_WITHIN_CQ, V_FAIR_SHARING),
+        jnp.where(victim_same, V_WITHIN_CQ, cross_reason),
         0).astype(jnp.int8)
     return success, cand_w, victims, reason, any_same_cq, borrow_after
 
@@ -500,6 +521,22 @@ def fair_entry_pick(t, lendable_r, usage, cand_w, req_c, ts, active):
     share = jnp.where(w > 0, unw / jnp.maximum(w, 1e-30), 0.0)
     zwb = (w == 0) & (unw > 0)
 
+    # FairSharingPrioritizeNonBorrowing (trace-time gate): the leading
+    # tournament key prefers subtrees NOT borrowing on the entry's
+    # REQUESTED resources at this level
+    # (fair_sharing_iterator.go:180-193)
+    from kueue_oss_tpu import features as _features
+
+    fs_nonborrow = _features.enabled("FairSharingPrioritizeNonBorrowing")
+    prio_step = _features.enabled("PrioritySortingWithinCohort")
+    if fs_nonborrow:
+        # FLAVOR-resource granularity, matching the host's
+        # DRS.is_borrowing_on over borrowed_frs (quota.py) — borrowing
+        # on another flavor of the same resource must not penalize
+        borrow_on_req = jnp.any(
+            (borrowed > 0) & (req_c[:, None, :] > 0), axis=2)  # [C, D]
+        borrow_on_req = borrow_on_req & t.has_parent[paths]
+
     # bottom-up winner propagation over the cohort forest
     prio = t.wl_prio[cand_w]
     ets = ts[cand_w]
@@ -516,16 +553,25 @@ def fair_entry_pick(t, lendable_r, usage, cand_w, req_c, ts, active):
         ec = jnp.minimum(e, C - 1)
         # position of this node on the entry's path
         j = jnp.clip(depth_cq[ec] - d, 0, D - 1)
+        parent = jnp.where(contend, t.parent, null_node)
+        seg = jnp.minimum(parent, null_node)
+        # lexicographic segment-min: [not-borrowing-on-requested first
+        # when gated,] zwb asc (non-borrower first), value asc, -prio
+        # asc, ts asc, entry idx asc
+        if fs_nonborrow:
+            k_bor = jnp.where(contend, borrow_on_req[ec, j], True)
+            m_b = jax.ops.segment_min(
+                k_bor.astype(jnp.int32), seg, num_segments=N1)
+            contend = contend & (k_bor.astype(jnp.int32) == m_b[seg])
         k_zwb = jnp.where(contend, zwb[ec, j], True)
         k_val = jnp.where(contend,
                           jnp.where(zwb[ec, j], unw[ec, j], share[ec, j]),
                           jnp.inf)
-        k_prio = jnp.where(contend, -prio[ec], BIG)
+        # the priority tie-break is gated like the host's step 3
+        # (PrioritySortingWithinCohort; a constant key = skipped step)
+        k_prio = (jnp.where(contend, -prio[ec], BIG)
+                  if prio_step else jnp.zeros_like(prio[ec]))
         k_ts = jnp.where(contend, ets[ec], BIG)
-        parent = jnp.where(contend, t.parent, null_node)
-        seg = jnp.minimum(parent, null_node)
-        # lexicographic segment-min: zwb asc (non-borrower first), value
-        # asc, -prio asc, ts asc, entry idx asc
         m_z = jax.ops.segment_min(
             k_zwb.astype(jnp.int32), seg, num_segments=N1)
         c1 = contend & (k_zwb.astype(jnp.int32) == m_z[seg])

@@ -1356,8 +1356,18 @@ _solver_cache: dict = {}
 
 def solve_backlog_full(t: FullTensors, g_max: int, h_max: int = 32,
                        p_max: int = 128, fs_enabled: bool = False):
-    """Cached-jit entry point; (g_max, h_max, p_max, fs) are compile-time."""
-    key = (g_max, h_max, p_max, fs_enabled)
+    """Cached-jit entry point; (g_max, h_max, p_max, fs) are compile-time.
+
+    The fair-sharing gates are baked in at trace time, so they join the
+    cache key — a gate flip must not serve a stale compilation."""
+    from kueue_oss_tpu import features
+
+    gates = ()
+    if fs_enabled:
+        gates = (features.enabled("FairSharingPreemptWithinNominal"),
+                 features.enabled("FairSharingPrioritizeNonBorrowing"),
+                 features.enabled("PrioritySortingWithinCohort"))
+    key = (g_max, h_max, p_max, fs_enabled, gates)
     fn = _solver_cache.get(key)
     if fn is None:
         fn = make_full_solver(g_max, h_max, p_max, fs_enabled)

@@ -147,7 +147,8 @@ def test_fair_drain_parity(seed):
 
 
 def test_fair_victim_reason():
-    """Fair-sharing cross-CQ victims carry InCohortFairSharing."""
+    """A within-nominal claimant's cross-CQ victims carry
+    InCohortReclamation (FairSharingPreemptWithinNominal, GA)."""
     store = Store()
     store.upsert_resource_flavor(ResourceFlavor(name="f1"))
     store.upsert_cohort(Cohort(name="co"))
@@ -182,5 +183,7 @@ def test_fair_victim_reason():
     from kueue_oss_tpu.api.types import WorkloadConditionType
 
     pre = b.status.conditions.get(WorkloadConditionType.PREEMPTED)
-    assert pre is not None and pre.reason == "InCohortFairSharing"
+    # claimant within nominal -> InCohortReclamation
+    # (FairSharingPreemptWithinNominal, preemption.go:377-412)
+    assert pre is not None and pre.reason == "InCohortReclamation"
     assert result.evicted == 1

@@ -225,3 +225,65 @@ def test_finished_gauge_decrements_on_any_deletion():
     jr.delete_job(job, now=3.0)  # deletes the finished workload
     after = metrics.finished_workloads_gauge._values.get(("cq",), 0)
     assert after == before - 1, (before, after)
+
+
+def test_fair_sharing_within_nominal_gate_off_keeps_fair_reason():
+    """With FairSharingPreemptWithinNominal OFF, a within-nominal
+    claimant's cross-CQ victims go through the DRS strategy and carry
+    the InCohortFairSharing reason (pre-0.17 behavior)."""
+    from kueue_oss_tpu.api.types import (
+        Cohort,
+        PodSet,
+        PreemptionPolicy,
+        PreemptionPolicyValue,
+        Workload,
+        WorkloadConditionType,
+    )
+
+    features.set_gates({"FairSharingPreemptWithinNominal": False})
+
+    def build():
+        from kueue_oss_tpu.api.types import (
+            ClusterQueue, FlavorQuotas, LocalQueue, ResourceFlavor,
+            ResourceGroup, ResourceQuota)
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.core.store import Store
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+        store = Store()
+        store.upsert_resource_flavor(ResourceFlavor(name="default"))
+        store.upsert_cohort(Cohort(name="co"))
+        for n in ("a", "b"):
+            store.upsert_cluster_queue(ClusterQueue(
+                name=n, cohort="co",
+                preemption=PreemptionPolicy(
+                    reclaim_within_cohort=PreemptionPolicyValue.ANY),
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources=[
+                        ResourceQuota(name="cpu", nominal=2000)])])]))
+            store.upsert_local_queue(LocalQueue(name=f"lq-{n}",
+                                                cluster_queue=n))
+        queues = QueueManager(store)
+        return store, queues, Scheduler(store, queues,
+                                        enable_fair_sharing=True)
+
+    store, queues, sched = build()
+    # CQ a borrows the whole cohort
+    for i in range(4):
+        store.add_workload(Workload(
+            name=f"hog{i}", queue_name="lq-a", uid=i + 1,
+            creation_time=float(i),
+            podsets=[PodSet(name="m", count=1, requests={"cpu": 1000})]))
+    sched.run_until_quiet(now=10.0, tick=1.0)
+    # b claims within its nominal
+    store.add_workload(Workload(
+        name="claim", queue_name="lq-b", uid=99, creation_time=20.0,
+        podsets=[PodSet(name="m", count=1, requests={"cpu": 1000})]))
+    sched.run_until_quiet(now=30.0, tick=1.0)
+    assert store.workloads["default/claim"].is_quota_reserved
+    evicted = [w for w in store.workloads.values()
+               if w.condition(WorkloadConditionType.PREEMPTED)]
+    assert evicted
+    assert all(w.condition(WorkloadConditionType.PREEMPTED).reason
+               == "InCohortFairSharing" for w in evicted)
