@@ -329,7 +329,21 @@ def main() -> None:
     preempt = None
     scale_label = None
     platform = "tpu"
-    for label, env, tmo in SCALES:
+    # a wedged tunnel HANGS jax init rather than erroring; probe it with
+    # a short-lived subprocess so a dead device costs 120s, not the
+    # whole scale ladder's timeouts
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            capture_output=True, text=True, timeout=120)
+        device_ok = probe.returncode == 0 and "ok" in probe.stdout
+    except subprocess.TimeoutExpired:
+        device_ok = False
+    if not device_ok:
+        log("[probe] TPU backend unreachable; skipping the TPU ladder")
+        platform = "cpu_fallback"
+    for label, env, tmo in (SCALES if device_ok else []):
         try:
             preempt = measure("preempt", extra_env=env, timeout=tmo)
             scale_label = label
