@@ -1,14 +1,29 @@
 """Provisioning admission-check controller.
 
-Reference parity: pkg/controller/admissionchecks/provisioning (KEP-1136) —
-for every quota-reserved workload whose ClusterQueue lists an AdmissionCheck
-handled by this controller, it creates a capacity ProvisioningRequest,
-relays the provider's answer into the workload's AdmissionCheckState, and
-retries failed requests with exponential backoff up to a retry limit
-(KEP-3258), after which the check goes Rejected.
+Reference parity: pkg/controller/admissionchecks/provisioning (KEP-1136,
+controller.go 1222 LoC) — for every quota-reserved workload whose
+ClusterQueue lists an AdmissionCheck handled by this controller:
 
-The cloud/autoscaler side is abstracted as a `CapacityProvider` callable so
-tests (and the in-process runtime) can decide provisioning outcomes; the
+- resolve the check's ProvisioningRequestConfig (class name, parameters,
+  managedResources, retryStrategy, podSetUpdates);
+- build a capacity ProvisioningRequest covering the podsets that
+  request MANAGED resources (requiredPodSets, controller.go:427); a
+  workload touching none of them needs no provisioning — the check goes
+  Ready immediately;
+- relay the provider's condition into the workload's
+  AdmissionCheckState (controller.go:543-590):
+    Provisioned      -> Ready, with the config's podSetUpdates attached
+                        (node selector/labels steering pods onto the
+                        provisioned capacity, :629-660);
+    Failed           -> Retry with exponential backoff while attempts
+                        remain (KEP-3258), else Rejected;
+    BookingExpired   -> like Failed while the workload is NOT admitted;
+                        ignored after admission (:568-583);
+    CapacityRevoked  -> Rejected (triggers workload deactivation — the
+                        autoscaler already deleted the nodes, :560-567).
+
+The cloud/autoscaler side is abstracted as a `CapacityProvider` callable
+so tests (and the in-process runtime) decide provisioning outcomes; the
 reference's equivalent boundary is the autoscaler acting on the
 ProvisioningRequest CR.
 """
@@ -16,15 +31,25 @@ ProvisioningRequest CR.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Callable, Optional, Union
 
-from kueue_oss_tpu.api.types import CheckState, Workload
+from kueue_oss_tpu.api.types import CheckState, PodSetUpdate, Workload
 from kueue_oss_tpu.core.store import Store
 
 CONTROLLER_NAME = "kueue.x-k8s.io/provisioning-request"
 
-#: provider(request) -> True (provisioned) | False (failed) | None (pending)
-CapacityProvider = Callable[["ProvisioningRequest"], Optional[bool]]
+# ProvisioningRequest condition states (autoscaling.x-k8s.io/v1)
+PENDING = "Pending"
+PROVISIONED = "Provisioned"
+FAILED = "Failed"
+BOOKING_EXPIRED = "BookingExpired"
+CAPACITY_REVOKED = "CapacityRevoked"
+
+#: provider(request) -> one of the condition states above; bool/None
+#: keep their legacy meaning (True=Provisioned, False=Failed,
+#: None=still pending)
+CapacityProvider = Callable[["ProvisioningRequest"],
+                            Union[str, bool, None]]
 
 
 @dataclass
@@ -34,10 +59,15 @@ class ProvisioningRequest:
     name: str
     workload_key: str
     check_name: str
-    #: aggregated resource requests the capacity must cover
+    #: aggregated MANAGED resource requests the capacity must cover
     requests: dict[str, int] = field(default_factory=dict)
+    #: podset names included (those requesting managed resources)
+    podsets: list[str] = field(default_factory=list)
+    #: ProvisioningRequestConfig passthrough
+    provisioning_class: str = ""
+    parameters: dict[str, str] = field(default_factory=dict)
     attempt: int = 1
-    state: str = "Pending"  # Pending | Provisioned | Failed
+    state: str = PENDING
     #: when a failed attempt may be retried
     retry_at: Optional[float] = None
     #: QuotaReserved transition time this request was provisioned for; a
@@ -47,8 +77,19 @@ class ProvisioningRequest:
 
 @dataclass
 class ProvisioningConfig:
-    """Reference parity: ProvisioningRequestConfig CRD (retry KEP-3258)."""
+    """Reference parity: ProvisioningRequestConfig CRD."""
 
+    #: spec.provisioningClassName (e.g. queued-provisioning.gke.io)
+    provisioning_class: str = "check-capacity.autoscaling.x-k8s.io"
+    #: spec.parameters passthrough to the autoscaler
+    parameters: dict[str, str] = field(default_factory=dict)
+    #: spec.managedResources: only these count toward the request; an
+    #: empty list means ALL resources are managed
+    managed_resources: list[str] = field(default_factory=list)
+    #: node selector injected into Ready checks' podSetUpdates
+    #: (spec.podSetUpdates.nodeSelector)
+    update_node_selector: dict[str, str] = field(default_factory=dict)
+    #: retryStrategy (KEP-3258)
     max_retries: int = 3
     base_backoff_seconds: float = 60.0
     max_backoff_seconds: float = 1800.0
@@ -57,15 +98,30 @@ class ProvisioningConfig:
 class ProvisioningController:
     def __init__(self, store: Store,
                  provider: Optional[CapacityProvider] = None,
-                 config: Optional[ProvisioningConfig] = None) -> None:
+                 config: Optional[ProvisioningConfig] = None,
+                 configs_by_check: Optional[dict] = None) -> None:
         self.store = store
         self.provider: CapacityProvider = provider or (lambda req: True)
         self.config = config or ProvisioningConfig()
+        #: per-check ProvisioningRequestConfig overrides (the reference
+        #: resolves the config through the AdmissionCheck's parameters
+        #: reference)
+        self.configs_by_check = configs_by_check or {}
         #: live request per (workload key, check name); superseded attempts
         #: are replaced in place so retention stays O(reserved workloads)
         self.requests: dict[tuple[str, str], ProvisioningRequest] = {}
+        #: completed FAILED attempts per (workload key, check) — survives
+        #: the Retry eviction (the reference derives this from retained
+        #: ProvisioningRequest objects, getAttempt)
+        self.attempts: dict[tuple[str, str], int] = {}
+        #: earliest time the next attempt may be created (retryStrategy
+        #: backoff gates provreq re-creation, controller.go remainingTime)
+        self.retry_at: dict[tuple[str, str], float] = {}
 
     # -- helpers ------------------------------------------------------------
+
+    def _config_for(self, check: str) -> ProvisioningConfig:
+        return self.configs_by_check.get(check, self.config)
 
     def _checks_for(self, wl: Workload) -> list[str]:
         """Names of this controller's checks pending on the workload."""
@@ -81,6 +137,28 @@ class ProvisioningController:
     def _request_name(wl: Workload, check: str, attempt: int) -> str:
         return f"{wl.namespace}/{wl.name}/{check}/{attempt}"
 
+    def _required_podsets(self, wl: Workload,
+                          cfg: ProvisioningConfig) -> list:
+        """requiredPodSets (controller.go:427): podsets requesting at
+        least one managed resource; all podsets when managedResources
+        is empty."""
+        if not cfg.managed_resources:
+            return list(wl.podsets)
+        managed = set(cfg.managed_resources)
+        return [ps for ps in wl.podsets
+                if any(r in managed and q > 0
+                       for r, q in ps.requests.items())]
+
+    @staticmethod
+    def _managed_totals(podsets, cfg: ProvisioningConfig) -> dict:
+        managed = set(cfg.managed_resources)
+        out: dict[str, int] = {}
+        for ps in podsets:
+            for r, q in ps.requests.items():
+                if not managed or r in managed:
+                    out[r] = out.get(r, 0) + q * ps.count
+        return out
+
     # -- reconcile ----------------------------------------------------------
 
     def reconcile(self, now: float) -> Optional[float]:
@@ -93,8 +171,39 @@ class ProvisioningController:
                 due = self._advance(wl, check, now)
                 if due is not None:
                     next_due = due if next_due is None else min(next_due, due)
+            self._watch_ready(wl, now)
         self._gc(now)
         return next_due
+
+    def _watch_ready(self, wl: Workload, now: float) -> None:
+        """Re-poll PROVISIONED requests behind READY checks: the
+        autoscaler can revoke capacity or expire a booking AFTER the
+        check went Ready (controller.go:560-583 — condition updates
+        arrive via the provreq watch, not only while pending)."""
+        for name, state in wl.status.admission_checks.items():
+            if state.state != CheckState.READY:
+                continue
+            ac = self.store.admission_checks.get(name)
+            if ac is None or ac.controller_name != CONTROLLER_NAME:
+                continue
+            req = self.requests.get((wl.key, name))
+            if req is None or req.state != PROVISIONED:
+                continue
+            self._poll(req)
+            if req.state == CAPACITY_REVOKED:
+                # nodes already deleted: reject to trigger deactivation
+                state.state = CheckState.REJECTED
+                state.message = (f"Provisioning request {req.name}: "
+                                 f"capacity revoked")
+                self.store.update_workload(wl)
+            elif req.state == BOOKING_EXPIRED:
+                if wl.is_admitted:
+                    req.state = PROVISIONED  # booked long enough; ignore
+                else:
+                    # Ready but not yet admitted (other checks pending):
+                    # the booking lapsed — retry like a failure
+                    self._schedule_retry(wl, state, req,
+                                         "booking expired", now)
 
     @staticmethod
     def _epoch(wl: Workload) -> float:
@@ -103,7 +212,33 @@ class ProvisioningController:
         cond = wl.condition(WorkloadConditionType.QUOTA_RESERVED)
         return cond.last_transition_time if cond is not None else 0.0
 
+    def _poll(self, req: ProvisioningRequest) -> None:
+        answer = self.provider(req)
+        if answer is None:
+            return
+        if answer is True:
+            req.state = PROVISIONED
+        elif answer is False:
+            req.state = FAILED
+        else:
+            req.state = answer
+
     def _advance(self, wl: Workload, check: str, now: float) -> Optional[float]:
+        cfg = self._config_for(check)
+        state = wl.status.admission_checks.get(check)
+        if state is None:
+            return None
+
+        required = self._required_podsets(wl, cfg)
+        if not required:
+            # nothing to provision: the check is immediately satisfied
+            # (controller.go:427 requiredPodSets empty -> Ready)
+            state.state = CheckState.READY
+            state.message = ("no podset requests managed resources; "
+                            "provisioning not required")
+            self.store.update_workload(wl)
+            return None
+
         epoch = self._epoch(wl)
         req = self.requests.get((wl.key, check))
         if req is not None and req.reservation_epoch != epoch:
@@ -111,52 +246,101 @@ class ProvisioningController:
             # provisioned/failed answer belongs to the previous admission.
             req = None
         if req is None:
+            prior = self.attempts.get((wl.key, check), 0)
+            gate = self.retry_at.get((wl.key, check))
+            if prior and gate is not None and now < gate:
+                # backoff still running: the next attempt may not be
+                # created yet (controller.go remainingTime)
+                return gate
             req = ProvisioningRequest(
-                name=self._request_name(wl, check, 1),
+                name=self._request_name(wl, check, prior + 1),
                 workload_key=wl.key, check_name=check,
-                requests=wl.total_requests(), reservation_epoch=epoch)
+                requests=self._managed_totals(required, cfg),
+                podsets=[ps.name for ps in required],
+                provisioning_class=cfg.provisioning_class,
+                parameters=dict(cfg.parameters),
+                attempt=prior + 1,
+                reservation_epoch=epoch)
             self.requests[(wl.key, check)] = req
 
-        if req.state == "Pending":
-            answer = self.provider(req)
-            if answer is None:
-                return None  # still provisioning; provider will be re-polled
-            req.state = "Provisioned" if answer else "Failed"
+        if req.state == PENDING:
+            self._poll(req)
+            if req.state == PENDING:
+                return None  # still provisioning; re-polled next pass
 
-        state = wl.status.admission_checks.get(check)
-        if state is None:
-            return None
-        if req.state == "Provisioned":
+        if req.state == PROVISIONED:
             state.state = CheckState.READY
             state.message = f"Provisioning request {req.name} provisioned"
+            # steer the provisioned podsets onto the new capacity
+            # (controller.go podSetUpdates :629-660)
+            state.pod_set_updates = [PodSetUpdate(
+                name=name,
+                node_selector=dict(cfg.update_node_selector),
+                annotations={
+                    "cluster-autoscaler.kubernetes.io/"
+                    "consume-provisioning-request": req.name,
+                    "cluster-autoscaler.kubernetes.io/"
+                    "provisioning-class-name": req.provisioning_class,
+                }) for name in req.podsets]
             self.store.update_workload(wl)
             return None
-        # Failed: retry with backoff, then reject (KEP-3258).
-        if req.attempt > self.config.max_retries:
+
+        if req.state == CAPACITY_REVOKED:
+            # nodes already deleted by the autoscaler: reject to trigger
+            # workload deactivation (controller.go:560-567)
+            if wl.active and not wl.is_finished:
+                state.state = CheckState.REJECTED
+                state.message = (f"Provisioning request {req.name}: "
+                                 f"capacity revoked")
+                self.store.update_workload(wl)
+            return None
+
+        if req.state == BOOKING_EXPIRED and wl.is_admitted:
+            # an admitted workload keeps running; the booking mattered
+            # only until admission (controller.go:568-570)
+            return None
+
+        # Failed (or BookingExpired before admission): Retry — the check
+        # flips to CheckState.RETRY so the workload controller EVICTS and
+        # releases the quota for the whole backoff window (KEP-3258; the
+        # reference does not hold capacity while a retry waits) — then
+        # Rejected once attempts are exhausted.
+        kind = ("booking expired" if req.state == BOOKING_EXPIRED
+                else "failed")
+        self._schedule_retry(wl, state, req, kind, now)
+        return None
+
+    def _schedule_retry(self, wl: Workload, state, req, kind: str,
+                        now: float) -> None:
+        cfg = self._config_for(req.check_name)
+        if req.attempt > cfg.max_retries:
             state.state = CheckState.REJECTED
-            state.message = (f"Provisioning request failed after "
+            state.message = (f"Provisioning request {kind} after "
                              f"{req.attempt} attempt(s)")
             self.store.update_workload(wl)
-            return None
-        if req.retry_at is None:
-            delay = min(
-                self.config.base_backoff_seconds * (2 ** (req.attempt - 1)),
-                self.config.max_backoff_seconds)
-            req.retry_at = now + delay
-        if now < req.retry_at:
-            return req.retry_at
-        nxt = ProvisioningRequest(
-            name=self._request_name(wl, check, req.attempt + 1),
-            workload_key=wl.key, check_name=check,
-            requests=wl.total_requests(), attempt=req.attempt + 1,
-            reservation_epoch=req.reservation_epoch)
-        self.requests[(wl.key, check)] = nxt
-        return self._advance(wl, check, now)
+            return
+        key = (wl.key, req.check_name)
+        self.attempts[key] = req.attempt
+        delay = min(cfg.base_backoff_seconds * (2 ** (req.attempt - 1)),
+                    cfg.max_backoff_seconds)
+        self.retry_at[key] = now + delay
+        self.requests.pop(key, None)
+        state.state = CheckState.RETRY
+        state.retry_count = req.attempt
+        state.message = (f"Retrying after {kind}: attempt {req.attempt}, "
+                         f"next at t+{delay:.0f}s")
+        self.store.update_workload(wl)
 
     def _gc(self, now: float) -> None:
-        """Drop requests whose workload no longer reserves quota
-        (reference: provisioning controller owns requests via ownerRefs)."""
+        """Drop requests whose workload no longer reserves quota; the
+        attempt/backoff bookkeeping survives evictions (it paces the
+        NEXT attempt) and dies with the workload."""
         for key, req in list(self.requests.items()):
             wl = self.store.workloads.get(req.workload_key)
             if wl is None or not wl.is_quota_reserved or wl.is_finished:
                 del self.requests[key]
+        for key in list(self.attempts):
+            wl = self.store.workloads.get(key[0])
+            if wl is None or wl.is_finished:
+                self.attempts.pop(key, None)
+                self.retry_at.pop(key, None)

@@ -405,10 +405,28 @@ class CheckState:
 
 
 @dataclass
+class PodSetUpdate:
+    """Reference parity: workload_types.go PodSetUpdate — per-podset
+    scheduling context an admission-check controller injects at Ready
+    (node selectors/labels pointing pods at provisioned capacity)."""
+
+    name: str
+    node_selector: dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    tolerations: list["Toleration"] = field(default_factory=list)
+
+
+@dataclass
 class AdmissionCheckState:
     name: str
     state: str = CheckState.PENDING
     message: str = ""
+    #: injected into the job's podsets when the workload starts
+    #: (workload_types.go AdmissionCheckState.PodSetUpdates)
+    pod_set_updates: list[PodSetUpdate] = field(default_factory=list)
+    #: provisioning retry bookkeeping (KEP-3258 RetryCount)
+    retry_count: int = 0
 
 
 @dataclass

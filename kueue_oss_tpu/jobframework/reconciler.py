@@ -316,7 +316,8 @@ class JobReconciler:
 
     def _podset_infos(self, wl: Workload) -> list[PodSetInfo]:
         """Build the injected infos from the admission: flavor node labels
-        + tolerations, TAS selector (reconciler.go getPodSetsInfoFromStatus)."""
+        + tolerations, admission-check podSetUpdates (provisioned-capacity
+        steering), TAS selector (reconciler.go getPodSetsInfoFromStatus)."""
         if wl.status.admission is None:
             return [PodSetInfo(name=ps.name, count=ps.count)
                     for ps in wl.podsets]
@@ -329,6 +330,16 @@ class JobReconciler:
                     continue
                 info.node_selector.update(rf.node_labels)
                 info.tolerations.extend(rf.tolerations)
+            # admission-check podSetUpdates (e.g. the provisioning
+            # controller's consume-provisioning-request annotations)
+            for cs in wl.status.admission_checks.values():
+                for upd in cs.pod_set_updates:
+                    if upd.name != psa.name:
+                        continue
+                    info.node_selector.update(upd.node_selector)
+                    info.labels.update(upd.labels)
+                    info.annotations.update(upd.annotations)
+                    info.tolerations.extend(upd.tolerations)
             if psa.topology_assignment is not None:
                 info.scheduling_gates.append(
                     "kueue.x-k8s.io/topology")  # ungated per-domain by TAS

@@ -1,0 +1,243 @@
+"""Provisioning admission-check controller depth tests.
+
+Mirrors pkg/controller/admissionchecks/provisioning/controller_test.go
+scenario shapes: managed-resource filtering, condition handling
+(Provisioned / Failed retry / BookingExpired / CapacityRevoked),
+podSetUpdates flowing into the job's injected infos.
+"""
+
+import pytest
+
+from kueue_oss_tpu.admissionchecks.provisioning import (
+    BOOKING_EXPIRED,
+    CAPACITY_REVOKED,
+    CONTROLLER_NAME,
+    PROVISIONED,
+    ProvisioningConfig,
+    ProvisioningController,
+)
+from kueue_oss_tpu.api.types import (
+    AdmissionCheck,
+    CheckState,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.controllers import WorkloadReconciler
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.jobframework import JobReconciler
+from kueue_oss_tpu.jobs import BatchJob
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+def make_env(provider=None, config=None):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", admission_checks=["prov"],
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu", "tpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=8000),
+                ResourceQuota(name="tpu", nominal=64)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    store.upsert_admission_check(AdmissionCheck(
+        name="prov", controller_name=CONTROLLER_NAME))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    wr = WorkloadReconciler(store, sched)
+    ctl = ProvisioningController(store, provider=provider, config=config)
+    return store, sched, wr, ctl
+
+
+def submit(store, name="w", requests=None):
+    store.add_workload(Workload(
+        name=name, queue_name="lq",
+        podsets=[PodSet(name="main", count=2,
+                        requests=requests or {"cpu": 500, "tpu": 4})]))
+    return f"default/{name}"
+
+
+def test_managed_resources_filter_and_request_shape():
+    seen = []
+
+    def provider(req):
+        seen.append(req)
+        return True
+
+    cfg = ProvisioningConfig(managed_resources=["tpu"],
+                             provisioning_class="queued.gke.io",
+                             parameters={"priority": "high"})
+    store, sched, wr, ctl = make_env(provider, cfg)
+    key = submit(store)
+    sched.schedule(1.0)
+    ctl.reconcile(2.0)
+    assert seen, "provider consulted"
+    req = seen[0]
+    assert req.requests == {"tpu": 8}, "only managed resources, x count"
+    assert req.provisioning_class == "queued.gke.io"
+    assert req.parameters == {"priority": "high"}
+    wl = store.workloads[key]
+    assert wl.status.admission_checks["prov"].state == CheckState.READY
+
+
+def test_no_managed_resources_skips_provisioning():
+    calls = []
+    cfg = ProvisioningConfig(managed_resources=["tpu"])
+    store, sched, wr, ctl = make_env(lambda r: calls.append(r), cfg)
+    key = submit(store, requests={"cpu": 500})  # no tpu requested
+    sched.schedule(1.0)
+    ctl.reconcile(2.0)
+    wl = store.workloads[key]
+    assert wl.status.admission_checks["prov"].state == CheckState.READY
+    assert "not required" in wl.status.admission_checks["prov"].message
+    assert not calls, "no ProvisioningRequest created"
+
+
+def test_provisioned_podset_updates_reach_job_pods():
+    cfg = ProvisioningConfig(
+        update_node_selector={"autoscaled-pool": "tpu-reserved"})
+    store, sched, wr, ctl = make_env(lambda r: PROVISIONED, cfg)
+    jr = JobReconciler(store, sched, workload_reconciler=wr)
+    job = BatchJob(name="j", queue_name="lq", parallelism=1,
+                   requests={"cpu": 500})
+    jr.upsert_job(job)
+    jr.reconcile(job, 0.0)
+    sched.schedule(1.0)
+    ctl.reconcile(2.0)
+    wr.reconcile_all(3.0)  # checks ready -> Admitted
+    jr.reconcile_all(4.0)
+    assert not job.is_suspended()
+    info = job.injected[0]
+    assert info.node_selector["autoscaled-pool"] == "tpu-reserved"
+    assert any("consume-provisioning-request" in k
+               for k in info.annotations)
+
+
+def drive(store, sched, wr, ctl, t):
+    """One control-plane pass: schedule, provision, sync checks."""
+    sched.requeue_due(t)
+    sched.schedule(t)
+    due = ctl.reconcile(t)
+    wr.reconcile_all(t)
+    return due
+
+
+def test_failed_retry_releases_quota_then_rejects():
+    """KEP-3258: a failed attempt flips the check to Retry — the
+    workload is EVICTED so its quota frees for the backoff window —
+    and the next attempt is paced by the provisioning backoff; the
+    limit exhausts into Rejected."""
+    cfg = ProvisioningConfig(max_retries=1, base_backoff_seconds=10.0)
+    store, sched, wr, ctl = make_env(lambda r: False, cfg)
+    key = submit(store)
+    drive(store, sched, wr, ctl, 1.0)
+    wl = store.workloads[key]
+    assert not wl.is_quota_reserved, \
+        "Retry evicts: quota must not be held through the backoff"
+    assert ctl.attempts[(key, "prov")] == 1
+
+    # re-admitted before the backoff elapses: no new attempt yet
+    drive(store, sched, wr, ctl, 3.0)
+    wl = store.workloads[key]
+    if wl.is_quota_reserved:
+        assert (key, "prov") not in ctl.requests, \
+            "backoff still gates the next provisioning attempt"
+
+    # past the backoff: attempt 2 runs, fails, and the limit rejects
+    for t in (12.0, 13.0, 14.0, 30.0):
+        drive(store, sched, wr, ctl, t)
+    wl = store.workloads[key]
+    st = wl.status.admission_checks.get("prov")
+    assert (st is not None and st.state == CheckState.REJECTED) \
+        or not wl.active, "attempt limit must reject/deactivate"
+
+
+def test_booking_expired_before_admission_retries():
+    answers = [BOOKING_EXPIRED, PROVISIONED]
+
+    def provider(req):
+        return answers.pop(0) if len(answers) > 1 else answers[0]
+
+    cfg = ProvisioningConfig(base_backoff_seconds=5.0)
+    store, sched, wr, ctl = make_env(provider, cfg)
+    key = submit(store)
+    drive(store, sched, wr, ctl, 1.0)
+    wl = store.workloads[key]
+    assert not wl.is_quota_reserved, "booking expiry retries like failure"
+    for t in (7.0, 8.0, 9.0):
+        drive(store, sched, wr, ctl, t)
+    wl = store.workloads[key]
+    assert wl.status.admission_checks["prov"].state == CheckState.READY
+
+
+def test_booking_expired_after_admission_is_ignored():
+    answers = [PROVISIONED]
+
+    def provider(req):
+        return answers[0]
+
+    store, sched, wr, ctl = make_env(provider)
+    key = submit(store)
+    sched.schedule(1.0)
+    ctl.reconcile(2.0)
+    wr.reconcile_all(3.0)
+    wl = store.workloads[key]
+    assert wl.is_admitted
+    # the booking expires after admission; the check must stay Ready
+    # and the workload untouched
+    st = wl.status.admission_checks["prov"]
+    st.state = CheckState.PENDING  # controller re-sees a pending check
+    ctl.requests[(key, "prov")].state = BOOKING_EXPIRED
+    ctl.reconcile(4.0)
+    assert wl.is_admitted
+    assert st.state == CheckState.PENDING, \
+        "no retry churn for an admitted workload"
+
+
+def test_capacity_revoked_rejects_and_deactivates():
+    answers = {"state": PROVISIONED}
+    store, sched, wr, ctl = make_env(lambda r: answers["state"])
+    key = submit(store)
+    sched.schedule(1.0)
+    ctl.reconcile(2.0)
+    wr.reconcile_all(3.0)
+    wl = store.workloads[key]
+    assert wl.is_admitted
+
+    # the autoscaler deletes the nodes
+    st = wl.status.admission_checks["prov"]
+    st.state = CheckState.PENDING
+    ctl.requests[(key, "prov")].state = CAPACITY_REVOKED
+    ctl.reconcile(4.0)
+    assert st.state == CheckState.REJECTED
+    wr.reconcile_all(5.0)
+    wl = store.workloads[key]
+    assert not wl.is_quota_reserved, "rejected check evicts the workload"
+    assert not wl.active, "rejected check deactivates (no requeue loop)"
+
+
+def test_capacity_revoked_after_ready_detected_by_watch():
+    """Revocation AFTER the check went Ready must still be seen: the
+    controller re-polls provisioned requests behind Ready checks
+    (controller.go watches provreq conditions, not only pending ones)."""
+    answers = {"state": PROVISIONED}
+    store, sched, wr, ctl = make_env(lambda r: answers["state"])
+    key = submit(store)
+    drive(store, sched, wr, ctl, 1.0)
+    drive(store, sched, wr, ctl, 2.0)
+    wl = store.workloads[key]
+    assert wl.is_admitted
+
+    answers["state"] = CAPACITY_REVOKED  # autoscaler deletes the nodes
+    drive(store, sched, wr, ctl, 3.0)
+    drive(store, sched, wr, ctl, 4.0)
+    wl = store.workloads[key]
+    assert not wl.is_quota_reserved and not wl.active, \
+        "revoked capacity must evict + deactivate the admitted workload"
