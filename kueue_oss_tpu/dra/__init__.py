@@ -155,3 +155,77 @@ class DeviceClassMapper:
             claims = claims_by_podset.get(ps.name)
             if claims:
                 self.apply_to_podset(ps, claims)
+
+
+# ---------------------------------------------------------------------------
+# Extended resources backed by DRA (extended_resources.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeviceClass:
+    """resourcev1.DeviceClass analog: spec.extendedResourceName lets
+    containers keep requesting the familiar extended resource (e.g.
+    vendor.com/gpu) while DRA backs it."""
+
+    name: str
+    extended_resource_name: Optional[str] = None
+
+
+def is_extended_resource_name(name: str) -> bool:
+    """util/resource IsExtendedResourceName: domain-prefixed and not a
+    kubernetes.io native resource."""
+    if "/" not in name:
+        return False
+    domain = name.split("/", 1)[0]
+    return not domain.endswith("kubernetes.io")
+
+
+def resolve_extended_resources(
+    ps: PodSet,
+    device_classes: list[DeviceClass],
+    mapper: DeviceClassMapper,
+) -> list[str]:
+    """DRAExtendedResources (extended_resources.go:51-120, gated): an
+    extended resource whose name matches a DeviceClass's
+    extendedResourceName is replaced by the class's mapped LOGICAL
+    resource, so DRA-backed devices flow through the ordinary quota
+    math. Returns the replaced resource names; multiple DeviceClasses
+    claiming one extended resource is an error (the reference rejects
+    the ambiguity)."""
+    from kueue_oss_tpu import features
+
+    if not (features.enabled("DynamicResourceAllocation")
+            and features.enabled("DRAExtendedResources")):
+        return []
+    by_ext: dict[str, list[DeviceClass]] = {}
+    for dc in device_classes:
+        if dc.extended_resource_name:
+            by_ext.setdefault(dc.extended_resource_name, []).append(dc)
+    # Resolve against a SNAPSHOT and validate everything before touching
+    # ps.requests: a DRAError must not leave the podset half-translated,
+    # and a logical name colliding with another class's
+    # extendedResourceName must not chain-resolve.
+    plan: list[tuple[str, str, int]] = []  # (extended, logical, qty)
+    for resource, qty in ps.requests.items():
+        if qty <= 0 or not is_extended_resource_name(resource):
+            continue
+        classes = by_ext.get(resource)
+        if not classes:
+            continue
+        if len(classes) > 1:
+            raise DRAError(
+                f"extended resource {resource!r} is claimed by multiple "
+                f"DeviceClasses: {sorted(dc.name for dc in classes)}")
+        logical = mapper.logical_resource(classes[0].name)
+        if logical is None:
+            raise DRAError(f"device class {classes[0].name!r} has no "
+                           "deviceClassMapping")
+        plan.append((resource, logical, qty))
+    # all deletions before all additions: a logical name that equals a
+    # later-deleted extended name must not have its merged value removed
+    for resource, _, _ in plan:
+        del ps.requests[resource]
+    for _, logical, qty in plan:
+        ps.requests[logical] = ps.requests.get(logical, 0) + qty
+    return [resource for resource, _, _ in plan]

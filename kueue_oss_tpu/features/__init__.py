@@ -38,6 +38,8 @@ _DEFAULTS: dict[str, bool] = {
     "LocalQueueMetrics": True,         # local_queue_* metric series
     # DRA (reference default: alpha, off)
     "DynamicResourceAllocation": False,  # dra device-class mapping
+    # extended resources resolved through DeviceClasses (alpha, off)
+    "DRAExtendedResources": False,     # dra.resolve_extended_resources
     # TAS replacement triggers
     "TASReplaceNodeOnNodeTaints": True,     # failure_recovery taint path
     "TASReplaceNodeOnPodTermination": True,  # failure_recovery term path

@@ -121,3 +121,131 @@ def test_claim_requests_share_slice_pool():
     assert not claim_satisfiable(claim, [one])
     assert claim_satisfiable(claim, [one, DeviceSlice(device_class="gpu",
                                                       count=2)])
+
+
+class TestExtendedResources:
+    def _setup(self):
+        from kueue_oss_tpu.dra import DeviceClass, DeviceClassMapper
+
+        classes = [DeviceClass(name="tpu-v5e",
+                               extended_resource_name="google.com/tpu")]
+        mapper = DeviceClassMapper({"tpu-v5e": "tpu"})
+        return classes, mapper
+
+    def test_replacement_gated(self):
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.api.types import PodSet
+        from kueue_oss_tpu.dra import resolve_extended_resources
+
+        classes, mapper = self._setup()
+        ps = PodSet(name="m", count=2,
+                    requests={"cpu": 500, "google.com/tpu": 4})
+        assert resolve_extended_resources(ps, classes, mapper) == []
+        assert "google.com/tpu" in ps.requests, "gate off: untouched"
+
+        features.set_gates({"DynamicResourceAllocation": True,
+                            "DRAExtendedResources": True})
+        try:
+            out = resolve_extended_resources(ps, classes, mapper)
+            assert out == ["google.com/tpu"]
+            assert ps.requests == {"cpu": 500, "tpu": 4}
+        finally:
+            features.reset()
+
+    def test_ambiguous_class_rejected(self):
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.api.types import PodSet
+        from kueue_oss_tpu.dra import (
+            DeviceClass,
+            DRAError,
+            resolve_extended_resources,
+        )
+
+        classes, mapper = self._setup()
+        classes.append(DeviceClass(name="tpu-v6",
+                                   extended_resource_name="google.com/tpu"))
+        ps = PodSet(name="m", count=1, requests={"google.com/tpu": 1})
+        features.set_gates({"DynamicResourceAllocation": True,
+                            "DRAExtendedResources": True})
+        try:
+            import pytest as _pytest
+
+            with _pytest.raises(DRAError):
+                resolve_extended_resources(ps, classes, mapper)
+        finally:
+            features.reset()
+
+    def test_native_and_unmatched_resources_untouched(self):
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.api.types import PodSet
+        from kueue_oss_tpu.dra import resolve_extended_resources
+
+        classes, mapper = self._setup()
+        ps = PodSet(name="m", count=1, requests={
+            "cpu": 100, "memory": 1 << 30, "example.com/fpga": 2})
+        features.set_gates({"DynamicResourceAllocation": True,
+                            "DRAExtendedResources": True})
+        try:
+            assert resolve_extended_resources(ps, classes, mapper) == []
+            assert ps.requests["example.com/fpga"] == 2
+        finally:
+            features.reset()
+
+    def test_error_leaves_podset_untouched(self):
+        """A DRAError mid-resolution must not half-translate the podset."""
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.api.types import PodSet
+        from kueue_oss_tpu.dra import (
+            DeviceClass,
+            DeviceClassMapper,
+            DRAError,
+            resolve_extended_resources,
+        )
+
+        classes = [
+            DeviceClass(name="ok", extended_resource_name="a.com/x"),
+            DeviceClass(name="dup1", extended_resource_name="g.com/t"),
+            DeviceClass(name="dup2", extended_resource_name="g.com/t"),
+        ]
+        mapper = DeviceClassMapper({"ok": "xres"})
+        ps = PodSet(name="m", count=1,
+                    requests={"a.com/x": 1, "g.com/t": 4})
+        before = dict(ps.requests)
+        features.set_gates({"DynamicResourceAllocation": True,
+                            "DRAExtendedResources": True})
+        try:
+            import pytest as _pytest
+
+            with _pytest.raises(DRAError):
+                resolve_extended_resources(ps, classes, mapper)
+            assert ps.requests == before, "no partial rewrite on error"
+        finally:
+            features.reset()
+
+    def test_no_chained_resolution(self):
+        """A logical name colliding with another class's extended name
+        must not chain-resolve (order independence)."""
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.api.types import PodSet
+        from kueue_oss_tpu.dra import (
+            DeviceClass,
+            DeviceClassMapper,
+            resolve_extended_resources,
+        )
+
+        classes = [
+            DeviceClass(name="a", extended_resource_name="a.com/x"),
+            DeviceClass(name="b", extended_resource_name="b.com/y"),
+        ]
+        mapper = DeviceClassMapper({"a": "b.com/y", "b": "tpu"})
+        features.set_gates({"DynamicResourceAllocation": True,
+                            "DRAExtendedResources": True})
+        try:
+            for order in ([("a.com/x", 2), ("b.com/y", 3)],
+                          [("b.com/y", 3), ("a.com/x", 2)]):
+                ps = PodSet(name="m", count=1, requests=dict(order))
+                resolve_extended_resources(ps, classes, mapper)
+                assert ps.requests == {"b.com/y": 2, "tpu": 3}, \
+                    (order, ps.requests)
+        finally:
+            features.reset()
