@@ -173,12 +173,14 @@ class DeviceClass:
 
 
 def is_extended_resource_name(name: str) -> bool:
-    """util/resource IsExtendedResourceName: domain-prefixed and not a
-    kubernetes.io native resource."""
-    if "/" not in name:
+    """util/resource IsExtendedResourceName: domain-prefixed, not a
+    kubernetes.io-domain native resource, and not a quota-style
+    `requests.`-prefixed key."""
+    if "/" not in name or name.startswith("requests."):
         return False
     domain = name.split("/", 1)[0]
-    return not domain.endswith("kubernetes.io")
+    return not (domain == "kubernetes.io"
+                or domain.endswith(".kubernetes.io"))
 
 
 def resolve_extended_resources(

@@ -415,17 +415,22 @@ def fair_search(t, lendable_r, usage0_round, wl_usage, admitted, evicted_f,
         return (~fitted & (it < 2 * p_max + N1)
                 & ~((phase == 2) & root_dead))
 
+    # fresh init constants derive their type from head_w so the carries
+    # stay consistent under shard_map's varying-axes check (a no-op on
+    # the unsharded path; same pattern as classical_search)
+    vzero = head_w.astype(jnp.int32) * 0
+    vfalse = vzero != 0
     init = (usage_sim,
-            jnp.zeros((p_max,), dtype=bool),   # consumed
-            jnp.zeros((p_max,), dtype=bool),   # retry
-            jnp.zeros((p_max,), dtype=bool),   # victims
-            jnp.full((p_max,), -1, dtype=jnp.int32),  # vseq
-            jnp.zeros((), dtype=jnp.int32),    # nv
-            jnp.zeros((C,), dtype=bool),       # pruned_cq
-            jnp.zeros((N1,), dtype=bool),      # pruned_cohort
-            jnp.zeros((), dtype=bool),         # fitted
-            jnp.ones((), dtype=jnp.int32),     # phase
-            jnp.zeros((), dtype=jnp.int32))
+            jnp.zeros((p_max,), dtype=bool) | vfalse,   # consumed
+            jnp.zeros((p_max,), dtype=bool) | vfalse,   # retry
+            jnp.zeros((p_max,), dtype=bool) | vfalse,   # victims
+            jnp.full((p_max,), -1, dtype=jnp.int32) + vzero,  # vseq
+            vzero,                             # nv
+            jnp.zeros((C,), dtype=bool) | vfalse,       # pruned_cq
+            jnp.zeros((N1,), dtype=bool) | vfalse,      # pruned_cohort
+            vfalse,                            # fitted
+            jnp.ones((), dtype=jnp.int32) + vzero,      # phase
+            vzero)
     (u_fin, consumed, retry, victims, vseq, nv, _pc, _pco, fitted,
      _phase, _it) = jax.lax.while_loop(phase_cond, phase_loop, init)
 

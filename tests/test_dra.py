@@ -249,3 +249,14 @@ class TestExtendedResources:
                     (order, ps.requests)
         finally:
             features.reset()
+
+    def test_extended_resource_name_predicate(self):
+        from kueue_oss_tpu.dra import is_extended_resource_name
+
+        assert is_extended_resource_name("vendor.com/gpu")
+        assert is_extended_resource_name("mykubernetes.io/gpu"), \
+            "substring match must not misclassify as native"
+        assert not is_extended_resource_name("kubernetes.io/batch")
+        assert not is_extended_resource_name("sub.kubernetes.io/x")
+        assert not is_extended_resource_name("cpu")
+        assert not is_extended_resource_name("requests.nvidia.com/gpu")

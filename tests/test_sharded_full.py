@@ -156,3 +156,4 @@ def test_larger_contended_preemption_sharded(eight_devices):
     evicted = [problem.wl_keys[w] for w in range(problem.n_workloads)
                if problem.wl_admitted0[w] and not adm[w]]
     assert evicted, "shape must evict somebody"
+
