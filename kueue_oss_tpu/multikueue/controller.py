@@ -59,7 +59,16 @@ class MultiKueueController:
             if (self.check_name in wl.status.admission_checks
                     or wl.status.cluster_name is not None
                     or wl.status.nominated_cluster_names):
-                self.reconcile(wl, now)
+                try:
+                    self.reconcile(wl, now)
+                except (ConnectionError, RuntimeError):
+                    # A worker died mid-RPC (remote.RemoteWorkerError)
+                    # or a worker-side op failed (e.g. the mirror was
+                    # deleted concurrently): skip just this workload and
+                    # reconcile it again next pass — the reference logs
+                    # and requeues the failing workload only
+                    # (multikueuecluster.go reconnect handling).
+                    continue
 
     def reconcile(self, wl: Workload, now: float) -> None:
         if (wl.is_finished or not wl.active

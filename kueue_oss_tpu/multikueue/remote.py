@@ -1,0 +1,222 @@
+"""Hub-side remote worker client: proxies + watcher + config watch.
+
+Reference parity: pkg/controller/admissionchecks/multikueue/
+multikueuecluster.go:91-283 — a remoteClient per worker with long-lived
+watchers streaming remote events into the hub reconcile queue,
+reconnect with backoff, and garbage collection of orphaned mirrors;
+fswatch.go — kubeconfig directory watching that adds/removes clusters
+live. The proxy classes present the same duck-typed surface the
+MultiKueueController uses on an in-process WorkerEnvironment
+(store.workloads.get / add_workload / delete_workload /
+scheduler.evict_workload / run_cycle), so in-process and
+process-separated workers are interchangeable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Optional
+
+from kueue_oss_tpu.multikueue.worker import recv_msg, send_msg
+
+
+class RemoteWorkerError(ConnectionError):
+    pass
+
+
+class _Conn:
+    """One socket with request/response framing; thread-safe."""
+
+    def __init__(self, path: str, timeout_s: float = 30.0) -> None:
+        self.path = path
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def call(self, **req):
+        with self._lock:
+            if self._sock is None:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.settimeout(self.timeout_s)
+                try:
+                    s.connect(self.path)
+                except OSError as e:
+                    raise RemoteWorkerError(str(e)) from e
+                self._sock = s
+            try:
+                send_msg(self._sock, req)
+                out = recv_msg(self._sock)
+            except (OSError, ConnectionError, EOFError) as e:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                raise RemoteWorkerError(str(e)) from e
+        if not out["ok"]:
+            raise RuntimeError(f"worker error: {out['error']}")
+        return out["result"]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+
+class _RemoteWorkloads:
+    def __init__(self, conn: _Conn) -> None:
+        self._conn = conn
+
+    def get(self, key: str):
+        return self._conn.call(op="get_workload", key=key)
+
+    def __contains__(self, key: str) -> bool:
+        return self.get(key) is not None
+
+    def keys(self):
+        return self._conn.call(op="list_keys")
+
+
+class _RemoteStore:
+    def __init__(self, conn: _Conn) -> None:
+        self._conn = conn
+        self.workloads = _RemoteWorkloads(conn)
+
+    def add_workload(self, wl) -> None:
+        self._conn.call(op="add_workload", workload=wl)
+
+    def update_workload(self, wl) -> None:
+        self._conn.call(op="update_workload", workload=wl)
+
+    def delete_workload(self, key: str) -> None:
+        self._conn.call(op="delete_workload", key=key)
+
+    def upsert(self, kind: str, obj) -> None:
+        self._conn.call(op="upsert", kind=kind, obj=obj)
+
+
+class _RemoteScheduler:
+    def __init__(self, conn: _Conn) -> None:
+        self._conn = conn
+
+    def evict_workload(self, key: str, reason: str = "Evicted",
+                       message: str = "", now: float = 0.0,
+                       requeue: bool = True, **_kw) -> None:
+        self._conn.call(op="evict_workload", key=key, reason=reason,
+                        message=message, now=now, requeue=requeue)
+
+
+class RemoteWorkerEnvironment:
+    """Duck-typed WorkerEnvironment over the worker-process socket."""
+
+    def __init__(self, name: str, socket_path: str,
+                 timeout_s: float = 30.0) -> None:
+        self.name = name
+        self._conn = _Conn(socket_path, timeout_s)
+        self.store = _RemoteStore(self._conn)
+        self.scheduler = _RemoteScheduler(self._conn)
+
+    def run_cycle(self, now: float):
+        return self._conn.call(op="run_cycle", now=now)
+
+    def ping(self) -> bool:
+        return self._conn.call(op="ping") == "pong"
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class WorkerWatcher:
+    """Health/watch loop per remote worker (multikueuecluster.go:205-283).
+
+    Pings the worker on an interval; connection failure flips the
+    MultiKueueCluster inactive (the hub's worker-lost timeout then
+    triggers re-dispatch) and the loop keeps retrying with backoff until
+    the worker returns, at which point the cluster reactivates and an
+    optional callback requeues affected hub workloads (the reference
+    re-lists watched GVKs after reconnect).
+    """
+
+    def __init__(self, cluster, env: RemoteWorkerEnvironment,
+                 interval_s: float = 1.0,
+                 on_reconnect: Optional[Callable[[], None]] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.cluster = cluster
+        self.env = env
+        self.interval_s = interval_s
+        self.on_reconnect = on_reconnect
+        self.clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> bool:
+        """One health probe; returns current liveness."""
+        try:
+            ok = self.env.ping()
+        except (RemoteWorkerError, RuntimeError):
+            ok = False
+        was_active = self.cluster.active
+        self.cluster.active = ok
+        if ok:
+            self.cluster.mark_seen(self.clock())
+            if not was_active and self.on_reconnect is not None:
+                self.on_reconnect()
+        return ok
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.poll_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class WorkerConfigWatcher:
+    """kubeconfig-analog file watch (fswatch.go): a JSON file mapping
+    cluster name -> unix socket path; reloading on mtime change adds new
+    clusters and deactivates removed ones via callbacks."""
+
+    def __init__(self, path: str,
+                 on_add: Callable[[str, str], None],
+                 on_remove: Callable[[str], None]) -> None:
+        self.path = path
+        self.on_add = on_add
+        self.on_remove = on_remove
+        self._mtime = 0.0
+        self._known: dict[str, str] = {}
+
+    def poll(self) -> bool:
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except FileNotFoundError:
+            return False
+        if mtime == self._mtime:
+            return False
+        self._mtime = mtime
+        with open(self.path) as f:
+            current = json.load(f)
+        for name, sock_path in current.items():
+            if name not in self._known:
+                self.on_add(name, sock_path)
+            elif self._known[name] != sock_path:
+                # same cluster, new endpoint: rebuild the remote client
+                # (fswatch.go rebuilds on kubeconfig content change)
+                self.on_remove(name)
+                self.on_add(name, sock_path)
+        for name in list(self._known):
+            if name not in current:
+                self.on_remove(name)
+        self._known = dict(current)
+        return True

@@ -1,0 +1,132 @@
+"""MultiKueue worker-cluster process.
+
+Reference parity: pkg/controller/admissionchecks/multikueue/
+multikueuecluster.go — the hub talks to each worker cluster over a real
+process/cluster boundary (remote clients built from kubeconfig
+Secrets). Here the worker is a separate OS process hosting a full
+WorkerEnvironment (store + queues + scheduler) behind a length-prefixed
+pickle RPC on a unix socket; the hub side (remote.py) mirrors
+workloads, polls status, and detects worker loss by connection failure,
+exactly like the reference's watcher/reconnect loops
+(multikueuecluster.go:205-283).
+
+Transport note: pickle over a local unix socket — hub and workers are
+one trust domain (the reference's kubeconfigs likewise grant full
+API access); the socket path's filesystem permissions are the boundary.
+
+Run: python -m kueue_oss_tpu.multikueue.worker --socket /tmp/w1.sock
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    blob = pickle.dumps(obj)
+    sock.sendall(struct.pack("<I", len(blob)) + blob)
+
+
+def recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, 4)
+    (n,) = struct.unpack("<I", hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("worker connection closed")
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        env = self.server.env  # type: ignore[attr-defined]
+        while True:
+            try:
+                req = recv_msg(self.connection)
+            except (ConnectionError, EOFError):
+                return
+            try:
+                out = self._dispatch(env, req)
+                send_msg(self.connection, {"ok": True, "result": out})
+            except Exception as e:  # noqa: BLE001 - reported to hub
+                send_msg(self.connection,
+                         {"ok": False, "error": repr(e)})
+
+    def _dispatch(self, env, req):
+        op = req["op"]
+        if op == "ping":
+            return "pong"
+        if op == "upsert":
+            kind, obj = req["kind"], req["obj"]
+            getattr(env.store, f"upsert_{kind}")(obj)
+            return None
+        if op == "get_workload":
+            return env.store.workloads.get(req["key"])
+        if op == "add_workload":
+            env.store.add_workload(req["workload"])
+            return None
+        if op == "update_workload":
+            env.store.update_workload(req["workload"])
+            return None
+        if op == "delete_workload":
+            if req["key"] in env.store.workloads:
+                env.store.delete_workload(req["key"])
+            return None
+        if op == "evict_workload":
+            env.scheduler.evict_workload(
+                req["key"], reason=req.get("reason", "Evicted"),
+                message=req.get("message", ""), now=req.get("now", 0.0),
+                requeue=req.get("requeue", True))
+            return None
+        if op == "run_cycle":
+            stats = env.run_cycle(req["now"])
+            return {"admitted": stats.admitted, "heads": stats.heads}
+        if op == "list_keys":
+            return list(env.store.workloads.keys())
+        raise ValueError(f"unknown op {op!r}")
+
+
+class WorkerServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, socket_path: str) -> None:
+        from kueue_oss_tpu.multikueue.cluster import WorkerEnvironment
+
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+        super().__init__(socket_path, _Handler)
+        self.env = WorkerEnvironment(
+            name=os.path.basename(socket_path))
+
+    def serve_in_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--socket", required=True)
+    args = parser.parse_args()
+    server = WorkerServer(args.socket)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

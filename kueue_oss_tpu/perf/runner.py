@@ -59,6 +59,14 @@ class Simulator:
         self.scheduler = Scheduler(store, self.queues,
                                    enable_fair_sharing=enable_fair_sharing,
                                    solver=solver)
+        if solver is not None:
+            # one compiled program for every drain of the run: pad the
+            # workload axis to the schedule's peak instead of
+            # recompiling at each power-of-two crossing as the backlog
+            # grows
+            engine = self.scheduler._solver_engine()
+            if engine is not None:
+                engine.pad_to = len(schedule)
         self.by_key = {g.workload.key: g for g in schedule}
         #: workload keys touched since the last admission/eviction sweep —
         #: keeps the sweep O(changed) instead of O(all workloads)

@@ -73,6 +73,12 @@ class SolverEngine:
         #: separate sidecar process (SURVEY §2.4); export, verify, and
         #: commit stay in this process
         self.remote = remote
+        #: pad the workload axis to at least this size before solving.
+        #: Callers that drain repeatedly while the backlog grows (the
+        #: scheduler serve loop, the perf Simulator) set it to the
+        #: expected peak so every drain reuses ONE compiled program
+        #: instead of recompiling at each power-of-two crossing.
+        self.pad_to = 0
 
     def supported(self) -> bool:
         """Whether the drain can run on-device.
@@ -134,7 +140,8 @@ class SolverEngine:
         problem, pending = self.export()
         if problem.n_workloads == 0:
             return result
-        problem = pad_workloads(problem, _pow2(problem.n_workloads))
+        problem = pad_workloads(
+            problem, _pow2(max(problem.n_workloads, self.pad_to)))
 
         t0 = time.monotonic()
         if self.remote is not None:
@@ -228,17 +235,67 @@ class SolverEngine:
         h_max bounds victim searches per round: capping it only delays
         later preempt-mode heads a round, so a modest cap is safe. p_max
         bounds candidates per search and MUST cover the largest possible
-        candidate set (all workloads sharing a cohort tree with the
-        preemptor) — too small would wrongly produce NoCandidates where
-        the reference iterates every candidate (preemption.go:311).
-        Rounded up to powers of two to reuse compiled kernels.
+        candidate set. Candidates are always CONCURRENTLY-ADMITTED
+        workloads with nonzero usage in the preemptor's cohort tree
+        (preemption.go:311, candidate_generator.go:34-160), so besides
+        the cohort population, p_max is bounded by tree capacity. The
+        sound capacity measure is the tree's total quota, NOT the root's
+        subtree row: usage bubbling subtracts each child's local quota
+        on the way up (resource_node.go:210-217), so with lending
+        limits admitted usage can sit entirely below the CQs' local
+        quotas and never surface at the root. Inductively
+        sum(cq usage) <= sum(local quotas in the tree) + usage[root]
+        and usage[root] <= subtree[root], and every admitted candidate
+        uses >= the smallest positive request on some FR. Rounded up to
+        powers of two to reuse compiled kernels.
         """
         C = problem.n_cqs
         h_max = max(1, min(C, 64))
         root_of_cq = problem.cq_root
         wl_root = root_of_cq[np.minimum(problem.wl_cqid[:-1], C - 1)]
         counts = np.bincount(wl_root, minlength=problem.n_nodes + 1)
-        p_max = int(counts.max()) if counts.size else 1
+        pop = int(counts.max()) if counts.size else 1
+        # per-FR smallest positive usage a candidate can hold: flavor
+        # options plus actual admitted usage (partial admission can sit
+        # below every full-count option)
+        req = problem.wl_req[:-1].reshape(-1, problem.wl_req.shape[-1])
+        if problem.ad_usage is not None:
+            req = np.concatenate([req, problem.ad_usage[:-1]], axis=0)
+        pos = req > 0
+        if pos.any():
+            big = np.iinfo(req.dtype).max
+            min_req = np.where(pos.any(axis=0),
+                               np.where(pos, req, big).min(axis=0), 0)
+            # per-node root: last valid entry on the ancestor path
+            path = problem.path                       # [N+1, D]
+            null = path.shape[0] - 1
+            valid = path != null
+            last = np.maximum(valid.shape[1] - 1 - np.argmax(
+                valid[:, ::-1], axis=1), 0)
+            root_of_node = path[np.arange(path.shape[0]), last]
+            root_of_node = np.where(valid.any(axis=1), root_of_node, null)
+            tree_quota = np.zeros_like(problem.local_quota)
+            np.add.at(tree_quota, root_of_node[:-1],
+                      problem.local_quota[:-1])
+            # workloads admitted BEFORE this drain may predate a quota
+            # reduction (usage above today's tree quota is kept), so
+            # they are counted directly; the quota bound covers only
+            # what the drain itself can newly admit
+            if problem.ad_usage is not None:
+                adm0 = problem.ad_usage[:-1].any(axis=1)
+                adm_counts = np.bincount(
+                    wl_root[adm0], minlength=problem.n_nodes + 1)
+            else:
+                adm_counts = np.zeros(problem.n_nodes + 1, dtype=np.int64)
+            cap = 0
+            for rn in np.unique(root_of_cq):
+                quota = tree_quota[rn] + problem.subtree[rn]
+                per_fr = quota // np.maximum(min_req, 1)
+                cap = max(cap, int(per_fr[min_req > 0].sum())
+                          + int(adm_counts[rn]))
+            p_max = min(pop, max(8, cap))
+        else:
+            p_max = pop
         return h_max, _pow2(max(8, p_max))
 
     def _drain_full(self, now: float, verify: bool = False) -> DrainResult:
@@ -267,7 +324,8 @@ class SolverEngine:
             return result
         g_max = int(problem.cq_ngroups.max())
         h_max, p_max = self._size_caps(problem)
-        problem = pad_workloads(problem, _pow2(problem.n_workloads))
+        problem = pad_workloads(
+            problem, _pow2(max(problem.n_workloads, self.pad_to)))
 
         t0 = time.monotonic()
         if self.remote is not None:

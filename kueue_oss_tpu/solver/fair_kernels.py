@@ -129,12 +129,14 @@ def _lca_of(t, my_path, other_cq_node):
 
 
 def fair_search(t, lendable_r, usage0_round, wl_usage, admitted, evicted_f,
-                ts, admit_rank, head_w, req, avail_cq, p_max: int):
+                ts, head_w, req, avail_cq, cands, p_max: int):
     """Fair-sharing victim search for ONE preemptor (vmap over lanes).
 
     Mirrors Preemptor._fair_preemptions: candidate collection
     (_find_fs_candidates), the DRS tournament over target CQs, strategy
-    rules S2-a then S2-b, fill-back. Same return contract as
+    rules S2-a then S2-b, fill-back. ``cands`` is the preemptor root's
+    row of build_candidate_table (round-start admitted workloads in the
+    shared candidate order, W_null padded). Same return contract as
     classical_search: (success, cand_w [P], victims [P], reason [P] int8,
     any_same_cq, borrow_after).
     """
@@ -161,15 +163,17 @@ def fair_search(t, lendable_r, usage0_round, wl_usage, admitted, evicted_f,
     frs_mask = (req > 0) & (req > avail_cq)
 
     # ---- candidate collection (_find_fs_candidates) ----------------------
-    cand_cqid = t.wl_cqid[:-1]
+    present = cands != W_null
+    cand_cqid = t.wl_cqid[cands]                          # [P]
     cand_node = t.cq_node[jnp.minimum(cand_cqid, C - 1)]
-    is_adm = admitted[:-1] & (jnp.arange(W1 - 1) != head_w)
-    uses = jnp.any(wl_usage[:-1] * frs_mask[None, :] > 0, axis=1)
+    is_adm = present & admitted[cands] & (cands != head_w)
+    uses = jnp.any(wl_usage[cands] * frs_mask[None, :] > 0, axis=1)
     same_cq = cand_cqid == cqid
     prio_p = t.wl_prio[head_w]
     ts_p = ts[head_w]
-    lower = prio_p > t.wl_prio[:-1]
-    newer_eq = (prio_p == t.wl_prio[:-1]) & (ts_p < ts[:-1])
+    prio_c = t.wl_prio[cands]
+    lower = prio_p > prio_c
+    newer_eq = (prio_p == prio_c) & (ts_p < ts[cands])
 
     def sat(policy):
         return jnp.where(
@@ -192,22 +196,19 @@ def fair_search(t, lendable_r, usage0_round, wl_usage, admitted, evicted_f,
                    & sat(t.cq_reclaim_policy[cqi]))
     legal = is_adm & uses & (own_legal | other_legal)
 
-    # ---- global candidate ordering (candidates_ordering) ------------------
-    not_evicted = ~evicted_f[:-1]
-    order = jnp.lexsort((
-        t.wl_uid[:-1],
-        -admit_rank[:-1],
-        t.wl_prio[:-1],
-        same_cq,                 # other-CQ candidates first
-        not_evicted,             # evicted first
-        ~legal,
-    ))
-    sorted_legal = legal[order]
-    pos = jnp.cumsum(sorted_legal.astype(jnp.int32)) - 1
-    cand_w = jnp.full((p_max,), W_null, dtype=jnp.int32)
-    cand_w = cand_w.at[jnp.where(sorted_legal, pos, p_max)].set(
-        order.astype(jnp.int32), mode="drop")
-    cand_valid = cand_w != W_null
+    # ---- candidate ordering (candidates_ordering) -------------------------
+    # ``cands`` already carries the shared (priority, -admit_rank, uid)
+    # suffix order; the full ordering is a stable bucket sort: legal
+    # first, evicted first, other-CQ candidates before own-CQ ones.
+    not_evicted = ~evicted_f[cands]
+    bucket = jnp.where(
+        legal,
+        not_evicted.astype(jnp.int32) * 2 + same_cq.astype(jnp.int32), 4)
+    p_idx = jnp.arange(p_max, dtype=jnp.int32)
+    perm = jnp.argsort(bucket * p_max + p_idx).astype(jnp.int32)
+    cand_ok = bucket[perm] < 4
+    cand_w = jnp.where(cand_ok, cands[perm], W_null)
+    cand_valid = cand_ok
     slot_cqid = jnp.where(cand_valid, t.wl_cqid[cand_w], C)
 
     # ---- state -------------------------------------------------------------

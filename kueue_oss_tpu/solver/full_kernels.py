@@ -474,48 +474,96 @@ def _workload_fits(t, usage, cq_node, req, allow_borrow):
     return fits_avail & (allow_borrow | no_borrow_ok)
 
 
+def build_candidate_table(t: FullTensors, admitted, admit_rank, wl_usage,
+                          a_max: int):
+    """Per-cohort-root admitted-candidate table, [N+1, A] int32.
+
+    Victim candidates are always admitted workloads with nonzero usage in
+    the preemptor's cohort tree (candidate_generator.go:34-160), and the
+    candidate orderings' lane-independent suffix is shared: (priority
+    asc, admit_rank desc = most recently admitted first, uid asc)
+    (common/ordering.go). Building one table per round — rows keyed by
+    root node, candidates in shared order — lets every victim search run
+    on a small capacity-bounded axis instead of re-sorting the whole
+    workload axis per lane. Rows pad with W_null.
+    """
+    W1 = t.wl_cqid.shape[0]
+    W_null = W1 - 1
+    N1 = t.parent.shape[0]
+    C = t.cq_node.shape[0]
+    root_of = t.cq_root[jnp.minimum(t.wl_cqid[:-1], C - 1)]   # [W]
+    elig = admitted[:-1] & jnp.any(wl_usage[:-1] > 0, axis=1)
+    order = jnp.lexsort((t.wl_uid[:-1], -admit_rank[:-1], t.wl_prio[:-1]))
+    rank = jnp.zeros((W1 - 1,), dtype=jnp.int32).at[order].set(
+        jnp.arange(W1 - 1, dtype=jnp.int32))
+    root_eff = jnp.where(elig, root_of, N1)
+    sorted_w = jnp.lexsort((rank, root_eff)).astype(jnp.int32)
+    elig_s = elig[sorted_w]
+    root_s = root_of[sorted_w]
+    counts = jax.ops.segment_sum(
+        elig.astype(jnp.int32), root_of, num_segments=N1)
+    offsets = jnp.cumsum(counts) - counts
+    pos = jnp.arange(W1 - 1, dtype=jnp.int32) - offsets[root_s]
+    row = jnp.where(elig_s, root_s, N1)               # OOB row -> dropped
+    col = jnp.where(elig_s, jnp.minimum(pos, a_max), a_max)
+    table = jnp.full((N1, a_max), W_null, dtype=jnp.int32)
+    return table.at[row, col].set(sorted_w, mode="drop")
+
+
 def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
-                     evicted_f, ts, admit_rank, head_w, req, avail_cq,
-                     p_max: int):
+                     evicted_f, ts, head_w, req, avail_cq,
+                     cands, p_max: int):
     """Victim search for ONE preemptor (vmap over lanes).
+
+    ``cands`` is the preemptor root's row of build_candidate_table:
+    round-start admitted workloads in the shared candidate order, W_null
+    padded — the only workloads that can ever be victims, on an axis
+    bounded by cohort capacity instead of cohort population.
 
     Returns (success, victim_w [P] int32 (W_null padded), victim_valid [P]
     bool, victim_reason [P] int8, any_same_cq bool, borrow_after int32).
     Mirrors Preemptor._classical_preemptions: candidate generation +
-    ordering, two allow-borrowing attempts of the remove-until-fits scan,
-    then fillBackWorkloads. ``borrow_after`` is the
-    FindHeightOfLowestSubtreeThatFits level computed on the usage with the
-    chosen victims removed (round-start usage when the search fails),
-    maxed over the FRs needing preemption — simulate_preemption's
-    borrow-after that ranks preempt flavors in the assigner's granular
-    mode; ``any_same_cq`` distinguishes Preempt from Reclaim possibilities
-    (preemption_oracle.go).
+    ordering, two allow-borrowing attempts of the remove-until-fits walk,
+    then fillBackWorkloads. The walk is a bulk-skip loop: pop-time
+    validity (over-quota predicates, candidate_generator.go _valid) is
+    monotone non-increasing under removals, so all currently-invalid
+    candidates are skipped in one parallel step and each iteration
+    removes exactly one true victim — the loop trips #victims times, not
+    p_max times. ``borrow_after`` is the FindHeightOfLowestSubtreeThatFits
+    level computed on the usage with the chosen victims removed
+    (round-start usage when the search fails), maxed over the FRs needing
+    preemption — simulate_preemption's borrow-after that ranks preempt
+    flavors in the assigner's granular mode; ``any_same_cq`` distinguishes
+    Preempt from Reclaim possibilities (preemption_oracle.go).
     """
     W1 = t.wl_cqid.shape[0]
     W_null = W1 - 1
+    C_n = t.cq_node.shape[0]
     null_node = t.parent.shape[0] - 1
     D = t.path.shape[1]
     cqid = t.wl_cqid[head_w]
-    cq_node = t.cq_node[jnp.minimum(cqid, t.cq_node.shape[0] - 1)]
+    cqi = jnp.minimum(cqid, C_n - 1)
+    cq_node = t.cq_node[cqi]
     my_path = t.path[cq_node]                    # [D]
 
     # FRs needing preemption: requested and not fitting current avail
     frs_mask = (req > 0) & (req > avail_cq)      # [F]
 
     # ---- candidate legality (candidate_generator.go:34-160) -------------
-    cand_cqid = t.wl_cqid[:-1]
-    cand_node = t.cq_node[jnp.minimum(cand_cqid, t.cq_node.shape[0] - 1)]
-    is_adm = admitted[:-1] & (jnp.arange(W1 - 1) != head_w)
-    uses = jnp.any(wl_usage[:-1] * frs_mask[None, :] > 0, axis=1)
+    present = cands != W_null
+    cand_cqid = t.wl_cqid[cands]                 # [P]
+    cand_node = t.cq_node[jnp.minimum(cand_cqid, C_n - 1)]
+    is_adm = present & admitted[cands] & (cands != head_w)
+    uses = jnp.any(wl_usage[cands] * frs_mask[None, :] > 0, axis=1)
     same_cq = cand_cqid == cqid
 
     prio_p = t.wl_prio[head_w]
     ts_p = ts[head_w]
-    lower = prio_p > t.wl_prio[:-1]
-    newer_eq = (prio_p == t.wl_prio[:-1]) & (ts_p < ts[:-1])
-    policy = jnp.where(same_cq, t.cq_within_policy[jnp.minimum(
-        cqid, t.cq_node.shape[0] - 1)], t.cq_reclaim_policy[jnp.minimum(
-            cqid, t.cq_node.shape[0] - 1)])
+    prio_c = t.wl_prio[cands]
+    lower = prio_p > prio_c
+    newer_eq = (prio_p == prio_c) & (ts_p < ts[cands])
+    policy = jnp.where(same_cq, t.cq_within_policy[cqi],
+                       t.cq_reclaim_policy[cqi])
     sat = jnp.where(
         policy == POLICY_NEVER, False,
         jnp.where(policy == POLICY_LOWER_PRIORITY, lower,
@@ -525,12 +573,12 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
 
     # ---- LCA ring + hierarchical advantage ------------------------------
     # lca_d[a] = first index on MY path that is an ancestor of cand's CQ
-    cand_path = t.path[cand_node]                # [W, D]
-    anc = (cand_path[:, :, None] == my_path[None, None, :])  # [W, Dc, Dp]
-    is_anc = jnp.any(anc, axis=1)                # [W, Dp]
+    cand_path = t.path[cand_node]                # [P, D]
+    anc = (cand_path[:, :, None] == my_path[None, None, :])  # [P, Dc, Dp]
+    is_anc = jnp.any(anc, axis=1)                # [P, Dp]
     is_anc = is_anc & (my_path[None, :] != null_node)
     d_idx = jnp.arange(D, dtype=jnp.int32)[None, :]
-    lca_d = jnp.min(jnp.where(is_anc, d_idx, D), axis=1)  # [W]
+    lca_d = jnp.min(jnp.where(is_anc, d_idx, D), axis=1)  # [P]
     other_ok = (lca_d >= 1) & (lca_d < D)        # shares a cohort tree
 
     # advantage chain along my path (hierarchical_preemption.go);
@@ -558,15 +606,12 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
     # collection-time within-nominal pruning (round-start usage): the
     # candidate's CQ and every cohort strictly below the LCA must be
     # over nominal for some needed fr (_collect_in_subtree)
-    def not_within(node):
-        return ~jnp.all(
-            ~frs_mask[None, :]
-            | (usage0_round[node] <= t.subtree[node]))
-
-    cand_over = not_within(cand_node)            # [W]
+    cand_over = ~jnp.all(
+        ~frs_mask[None, :]
+        | (usage0_round[cand_node] <= t.subtree[cand_node]), axis=1)
     # cohorts on cand's path strictly below the LCA: path entries before
     # the one equal to my_path[lca_d]
-    lca_node = my_path[jnp.minimum(lca_d, D - 1)]            # [W]
+    lca_node = my_path[jnp.minimum(lca_d, D - 1)]            # [P]
     seen_lca = jnp.cumsum(
         (cand_path == lca_node[:, None]).astype(jnp.int32), axis=1) > 0
     strictly_below = (~seen_lca) & (cand_path != null_node)
@@ -577,16 +622,15 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
         | ~jnp.all(~frs_mask[None, None, :]
                    | (usage0_round[cand_path]
                       <= t.subtree[cand_path]), axis=2),
-        axis=1)                                   # [W]
+        axis=1)                                   # [P]
     other_legal = legal & ~same_cq & other_ok & cand_over & path_over
     same_legal = legal & same_cq
     legal_all = other_legal | same_legal
 
     # ---- variants & groups ----------------------------------------------
-    cqi = jnp.minimum(cqid, t.cq_node.shape[0] - 1)
     thr = t.cq_bwc_threshold[cqi]
-    above_thr = (t.wl_prio[:-1] >= prio_p) | (
-        (thr != NO_THRESHOLD) & (t.wl_prio[:-1] > thr))
+    above_thr = (prio_c >= prio_p) | (
+        (thr != NO_THRESHOLD) & (prio_c > thr))
     variant = jnp.where(
         same_cq, V_WITHIN_CQ,
         jnp.where(hier_adv, V_HIERARCHICAL_RECLAIM,
@@ -596,25 +640,32 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
     group_rank = jnp.where(same_cq, 2, jnp.where(hier_adv, 0, 1))
 
     # ---- ordering (common/ordering.go CandidatesOrdering) ---------------
-    not_evicted = ~evicted_f[:-1]
-    order = jnp.lexsort((
-        t.wl_uid[:-1],
-        -admit_rank[:-1],        # more recently admitted first
-        t.wl_prio[:-1],          # lower priority first
-        group_rank,
-        not_evicted,             # evicted first
-        ~legal_all,              # legal candidates to the front
-    ))
-    sorted_legal = legal_all[order]
-    pos = jnp.cumsum(sorted_legal.astype(jnp.int32)) - 1
-    cand_w = jnp.full((p_max,), W_null, dtype=jnp.int32)
-    cand_w = cand_w.at[jnp.where(sorted_legal, pos, p_max)].set(
-        order.astype(jnp.int32), mode="drop")
-    cand_valid = cand_w != W_null
-    cand_variant = jnp.where(cand_valid, variant[
-        jnp.minimum(cand_w, W1 - 2)], V_NEVER)
-    cand_lca = jnp.where(cand_valid,
-                         lca_d[jnp.minimum(cand_w, W1 - 2)], 0)
+    # ``cands`` already carries the shared (priority, -admit_rank, uid)
+    # suffix order, so the full ordering reduces to a stable 7-bucket
+    # sort: legal first, evicted first, then candidate group.
+    not_evicted = ~evicted_f[cands]
+    bucket = jnp.where(
+        legal_all,
+        jnp.where(not_evicted, 3 + group_rank, group_rank), 6)
+    p_idx = jnp.arange(p_max, dtype=jnp.int32)
+    perm = jnp.argsort(bucket * p_max + p_idx).astype(jnp.int32)
+    cand_ok = bucket[perm] < 6
+    cand_w = jnp.where(cand_ok, cands[perm], W_null)
+    cand_valid = cand_ok
+    cand_variant = jnp.where(cand_valid, variant[perm], V_NEVER)
+    cand_lca = jnp.where(cand_valid, lca_d[perm], 0)
+
+    # per-candidate walk state on the permuted axis
+    v_cqid = t.wl_cqid[cand_w]
+    v_node = t.cq_node[jnp.minimum(v_cqid, C_n - 1)]
+    v_path = t.path[v_node]                       # [P, D]
+    v_usage = wl_usage[cand_w]                    # [P, F]
+    v_same = cand_valid & (v_cqid == cqid)
+    v_lnode = my_path[jnp.minimum(cand_lca, D - 1)]
+    v_seen = jnp.cumsum((v_path == v_lnode[:, None]).astype(jnp.int32),
+                        axis=1) > 0
+    v_below = (~v_seen) & (v_path != null_node)
+    v_below = v_below.at[:, 0].set(False)
 
     # ---- attempt schedule (preemption.py:508-515) -----------------------
     no_other = ~jnp.any(other_legal)
@@ -628,62 +679,58 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
     second_borrow = jnp.where(f_then_t, True, False)
     has_second = ~single
 
-    # ---- the remove-until-fits scan (one attempt) -----------------------
-    C_n = t.cq_node.shape[0]
+    # ---- the remove-until-fits walk (one attempt) -----------------------
 
     def attempt(allow_borrow, run):
         # Infeasibility precheck: remove EVERY candidate this attempt
         # could ever pop (a superset of what the sequential walk removes).
         # available() is monotone non-increasing in usage, so if the
         # preemptor does not fit even then, no subset of removals can
-        # succeed — skip the sequential walk entirely. This is what makes
-        # contended large-scale rounds cheap: most searches fail, and
-        # they fail here in O(tree) instead of O(p_max) scan steps.
+        # succeed — skip the walk entirely. This is what makes contended
+        # large-scale rounds cheap: most searches fail, and they fail
+        # here in O(tree) instead of any walk steps.
         vb_all = ~(allow_borrow
                    & (cand_variant == V_RECLAIM_WITHOUT_BORROWING))
         removable = cand_valid & vb_all
-        v_nodes_all = t.cq_node[jnp.minimum(t.wl_cqid[cand_w], C_n - 1)]
         rows0 = jnp.where(t.is_cq[:, None], usage0_round, 0)
-        rows_min = rows0.at[v_nodes_all].add(
-            -jnp.where(removable[:, None], wl_usage[cand_w], 0),
-            mode="drop")
+        rows_min = rows0.at[v_node].add(
+            -jnp.where(removable[:, None], v_usage, 0), mode="drop")
         usage_min = refresh_cohort_usage(t, rows_min)
         could_fit = _workload_fits(t, usage_min, cq_node, req, allow_borrow)
         run = run & could_fit
 
         def cond(carry):
-            usage_l, victims, fitted, i = carry
-            return run & ~fitted & (i < p_max)
+            usage_l, victims, fitted, cursor = carry
+            return run & ~fitted & (cursor < p_max)
 
         def body(carry):
-            usage_l, victims, fitted, i = carry
-            a = cand_w[i]
-            a_cqid = t.wl_cqid[a]
-            a_node = t.cq_node[jnp.minimum(a_cqid, C_n - 1)]
-            var = cand_variant[i]
-            # pop-time validity (_valid, candidate_generator.go)
-            vb = ~(allow_borrow & (var == V_RECLAIM_WITHOUT_BORROWING))
-            is_same = a_cqid == cqid
-            cq_over = ~jnp.all(
-                ~frs_mask | (usage_l[a_node] <= t.subtree[a_node]))
-            a_path = t.path[a_node]
-            lnode = my_path[jnp.minimum(cand_lca[i], D - 1)]
-            seen = jnp.cumsum(
-                (a_path == lnode).astype(jnp.int32)) > 0
-            below = (~seen) & (a_path != null_node)
-            below = below.at[0].set(False)
-            path_ok = jnp.all(
-                ~below | ~jnp.all(
-                    ~frs_mask[None, :]
-                    | (usage_l[a_path] <= t.subtree[a_path]), axis=1))
-            valid = cand_valid[i] & vb & (
-                is_same | (cq_over & path_ok))
-            u_row = jnp.where(valid, wl_usage[a], 0)
-            usage_l = _remove_usage_along_path(t, usage_l, a_node, u_row)
-            victims = victims.at[i].set(valid)
-            fitted = valid & _workload_fits(
+            usage_l, victims, fitted, cursor = carry
+            # bulk pop-time validity (_valid, candidate_generator.go)
+            # under the current usage: the over-quota predicates only
+            # flip true->false as removals shrink usage, and nothing is
+            # removed between the cursor and the next valid slot, so
+            # invalid-now candidates are invalid at their sequential pop
+            # time too — skip them all in one step and remove exactly
+            # one true victim.
+            cq_over = jnp.any(
+                frs_mask[None, :]
+                & (usage_l[v_node] > t.subtree[v_node]), axis=1)
+            wn = jnp.all(
+                ~frs_mask[None, None, :]
+                | (usage_l[v_path] <= t.subtree[v_path]), axis=2)
+            path_ok = jnp.all(~v_below | ~wn, axis=1)
+            valid_now = removable & (v_same | (cq_over & path_ok))
+            j = jnp.min(jnp.where(valid_now & (p_idx >= cursor),
+                                  p_idx, p_max))
+            has = j < p_max
+            jc = jnp.minimum(j, p_max - 1)
+            u_row = jnp.where(has, v_usage[jc], 0)
+            usage_l = _remove_usage_along_path(t, usage_l, v_node[jc],
+                                               u_row)
+            victims = victims.at[jc].set(victims[jc] | has)
+            fitted = has & _workload_fits(
                 t, usage_l, cq_node, req, allow_borrow)
-            return (usage_l, victims, fitted, i + 1)
+            return (usage_l, victims, fitted, j + 1)
 
         # fresh init constants derive their type from head_w so the
         # carries stay consistent under shard_map's varying-axes check
@@ -692,35 +739,38 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
         vfalse = vzero != 0
         init = (usage0_round, jnp.zeros((p_max,), dtype=bool) | vfalse,
                 vfalse, vzero)
-        usage_l, victims, fitted, n_walked = jax.lax.while_loop(
+        usage_l, victims, fitted, _cur = jax.lax.while_loop(
             cond, body, init)
 
         # fillBackWorkloads: re-add earlier victims (excluding the last
-        # removed) newest-first while the preemptor still fits
-        last_idx = jnp.max(jnp.where(
-            victims, jnp.arange(p_max, dtype=jnp.int32), -1))
+        # removed) newest-first while the preemptor still fits. Victims
+        # were removed in slot order, so slot rank = removal sequence.
+        vseq = jnp.cumsum(victims.astype(jnp.int32)) - 1   # [P]
+        nv = jnp.max(jnp.where(victims, vseq + 1, 0))
 
         def fb_cond(carry):
-            usage_l, victims, j = carry
-            return fitted & (j >= 0)
+            usage_l, vcur, s = carry
+            return fitted & (s >= 0)
 
         def fb_body(carry):
-            usage_l, victims, j = carry
-            a = cand_w[j]
-            a_node = t.cq_node[jnp.minimum(t.wl_cqid[a], C_n - 1)]
-            tryit = victims[j] & (j < last_idx)
-            u_row = jnp.where(tryit, wl_usage[a], 0)
-            usage_l = _add_usage_along_path(t, usage_l, a_node, u_row)
+            usage_l, vcur, s = carry
+            match = victims & (vseq == s)
+            slot = jnp.argmax(match).astype(jnp.int32)
+            tryit = jnp.any(match)
+            u_row = jnp.where(tryit, v_usage[slot], 0)
+            usage_l = _add_usage_along_path(t, usage_l, v_node[slot],
+                                            u_row)
             still = _workload_fits(t, usage_l, cq_node, req, allow_borrow)
             # fit held -> the candidate stays re-added (not a victim);
             # fit broke -> undo the re-add, it remains a victim
             usage_l = _remove_usage_along_path(
-                t, usage_l, a_node, jnp.where(tryit & ~still, u_row, 0))
-            victims = victims.at[j].set(victims[j] & ~(tryit & still))
-            return (usage_l, victims, j - 1)
+                t, usage_l, v_node[slot],
+                jnp.where(tryit & ~still, u_row, 0))
+            vcur = vcur.at[slot].set(vcur[slot] & ~(tryit & still))
+            return (usage_l, vcur, s - 1)
 
         usage_l, victims, _ = jax.lax.while_loop(
-            fb_cond, fb_body, (usage_l, victims, last_idx - 1))
+            fb_cond, fb_body, (usage_l, victims, nv - 2))
         return fitted, victims, usage_l
 
     ok1, v1, u1 = attempt(first_borrow, jnp.ones((), dtype=bool))
@@ -931,36 +981,35 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
 # ---------------------------------------------------------------------------
 
 
-def _run_searches(t, usage, wl_usage, admitted, evicted, ts, admit_rank,
-                  flat_w, flat_req, flat_avail, p_max, fs_enabled,
-                  lendable_r, mesh, axis):
+def _run_searches(t, usage, wl_usage, admitted, evicted, ts,
+                  flat_w, flat_req, flat_avail, flat_cands, p_max,
+                  fs_enabled, lendable_r, mesh, axis):
     """Run the per-lane victim searches, optionally SPMD over a mesh.
 
-    The victim search is the round's dominant cost — each lane scans
-    candidate legality over the whole workload axis — and lanes are
+    The victim search is the round's dominant cost and lanes are
     independent, so multi-chip scaling shards the LANE axis: each
     device searches its slice of (head, option) lanes against the
     replicated round state, and the [L]-shaped results concatenate
     back. Per-round collective volume is the lane results only
     (L x p_max ints over ICI); the tree/usage state never moves.
     """
-    def vsearch(hw, rq, av, t_, usage_, wl_usage_, admitted_, evicted_,
-                ts_, rank_, lendable_):
+    def vsearch(hw, rq, av, cd, t_, usage_, wl_usage_, admitted_,
+                evicted_, ts_, lendable_):
         if fs_enabled:
             from kueue_oss_tpu.solver.fair_kernels import fair_search
 
             return jax.vmap(
-                lambda a, b, c: fair_search(
+                lambda a, b, c, d: fair_search(
                     t_, lendable_, usage_, wl_usage_, admitted_,
-                    evicted_, ts_, rank_, a, b, c, p_max))(hw, rq, av)
+                    evicted_, ts_, a, b, c, d, p_max))(hw, rq, av, cd)
         return jax.vmap(
-            lambda a, b, c: classical_search(
-                t_, usage_, wl_usage_, admitted_, evicted_, ts_, rank_,
-                a, b, c, p_max))(hw, rq, av)
+            lambda a, b, c, d: classical_search(
+                t_, usage_, wl_usage_, admitted_, evicted_, ts_,
+                a, b, c, d, p_max))(hw, rq, av, cd)
 
     if mesh is None:
-        return vsearch(flat_w, flat_req, flat_avail, t, usage, wl_usage,
-                       admitted, evicted, ts, admit_rank, lendable_r)
+        return vsearch(flat_w, flat_req, flat_avail, flat_cands, t, usage,
+                       wl_usage, admitted, evicted, ts, lendable_r)
 
     from jax.sharding import PartitionSpec as P
 
@@ -977,24 +1026,27 @@ def _run_searches(t, usage, wl_usage, admitted, evicted, ts, admit_rank,
         flat_avail = jnp.concatenate(
             [flat_avail, jnp.zeros((pad,) + flat_avail.shape[1:],
                                    dtype=flat_avail.dtype)])
+        flat_cands = jnp.concatenate(
+            [flat_cands, jnp.full((pad,) + flat_cands.shape[1:], W_null,
+                                  dtype=flat_cands.dtype)])
     lend = lendable_r if lendable_r is not None else jnp.zeros((1,))
 
-    def shard_body(hw, rq, av, *rep):
+    def shard_body(hw, rq, av, cd, *rep):
         # mark the replicated state varying-over-mesh so while_loop
         # carries inside the search have consistent manual-axes types
         rep = jax.tree_util.tree_map(
             lambda x: jax.lax.pcast(x, (axis,), to="varying"), rep)
-        return vsearch(hw, rq, av, *rep)
+        return vsearch(hw, rq, av, cd, *rep)
 
     sharded = jax.shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis),
-                  P(), P(), P(), P(), P(), P(), P(), P()),
+        in_specs=(P(axis), P(axis), P(axis), P(axis),
+                  P(), P(), P(), P(), P(), P(), P()),
         out_specs=(P(axis),) * 6,
     )
-    out = sharded(flat_w, flat_req, flat_avail, t, usage, wl_usage,
-                  admitted, evicted, ts, admit_rank, lend)
+    out = sharded(flat_w, flat_req, flat_avail, flat_cands, t, usage,
+                  wl_usage, admitted, evicted, ts, lend)
     if pad:
         out = tuple(o[:L] for o in out)
     return out
@@ -1069,18 +1121,23 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
     # One search per (lane, option): SimulatePreemption parity (the host
     # runs _get_targets per flavor during assignment; the Preemptor
     # dispatches to the fair-sharing search when enabled). With a mesh,
-    # the lane axis shards across devices (_run_searches).
-    def search(hw, rq, av):
+    # the lane axis shards across devices (_run_searches). Candidates
+    # come from the per-root round-start table (build_candidate_table).
+    cand_table = build_candidate_table(t, admitted, state["admit_rank"],
+                                       wl_usage, p_max)
+    lane_cands = cand_table[t.cq_root[lane_cqc]]   # [H, P]
+
+    def search(hw, rq, av, cd):
         return _run_searches(
             t, usage, wl_usage, admitted, state["evicted"], ts,
-            state["admit_rank"], hw, rq, av, p_max, fs_enabled,
-            lendable_r, mesh, axis)
+            hw, rq, av, cd, p_max, fs_enabled, lendable_r, mesh, axis)
 
     flat_w = jnp.repeat(lane_w, K)
     flat_req = t.wl_req[lane_w].reshape(h_max * K, -1)
     flat_avail = jnp.repeat(lane_avail, K, axis=0)
+    flat_cands = jnp.repeat(lane_cands, K, axis=0)
     (s_succ, s_cand_w, s_victims, s_reason, s_same, s_borrow) = search(
-        flat_w, flat_req, flat_avail)
+        flat_w, flat_req, flat_avail, flat_cands)
 
     # granular-mode table per (lane, option)
     sim_pmode = jnp.where(
@@ -1124,7 +1181,7 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
         # multi-group: GetTargets re-runs on the combined assignment
         # usage (preemption.py get_targets with all preempt-mode frs)
         (lane_success, lane_cand_w, lane_victims, lane_reason,
-         _s, _b) = search(lane_w, l_req, lane_avail)
+         _s, _b) = search(lane_w, l_req, lane_avail, lane_cands)
     lane_success = (lane_success & lane_valid & (l_mode == M_PREEMPT))
 
     # compact victims to the front of each lane's slot axis: the entry

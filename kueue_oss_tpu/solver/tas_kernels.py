@@ -117,41 +117,8 @@ def _greedy_segment(state, seg, need_of_seg, n_seg, least_free=False):
     idx = jnp.arange(D, dtype=jnp.int32)
     sort_state = jnp.where(least_free, state, -state)
     order = jnp.lexsort((idx, sort_state, seg))
-    s_sorted = state[order]
-    seg_sorted = seg[order]
-    need = need_of_seg[seg_sorted]                 # [D]
-
-    csum = jnp.cumsum(s_sorted)
-    # exclusive prefix within segment: subtract the csum at segment start
-    is_start = jnp.concatenate([jnp.ones(1, dtype=bool),
-                                seg_sorted[1:] != seg_sorted[:-1]])
-    base = jnp.where(is_start, csum - s_sorted, 0)
-    base = jax.lax.associative_scan(jnp.maximum, jnp.where(
-        is_start, base, -1))
-    prefix_excl = csum - s_sorted - base
-    remaining = jnp.maximum(need - prefix_excl, 0)  # pods left before me
-
-    # crossing: first position (per segment) whose state covers the
-    # remaining count -> the best-fit switch point
-    covers = (s_sorted >= remaining) & (remaining > 0)
-    pos_cover = jnp.where(covers, idx, BIG)
-    q = jax.ops.segment_min(pos_cover, seg_sorted, num_segments=n_seg)
-    q_of = q[seg_sorted]
-    full_take = jnp.where((idx < q_of) & (remaining > 0), s_sorted, 0)
-    rem_at_q = jnp.where(idx == q_of, remaining, 0)
-    rem_of_seg = jax.ops.segment_max(rem_at_q, seg_sorted,
-                                     num_segments=n_seg)
-    r = rem_of_seg[seg_sorted]
-    # best-fit among positions >= q with state >= r: smallest such
-    # state, ties -> first position
-    elig = (idx >= q_of) & (s_sorted >= r) & (r > 0)
-    s_min = jax.ops.segment_min(jnp.where(elig, s_sorted, BIG),
-                                seg_sorted, num_segments=n_seg)
-    is_best = elig & (s_sorted == s_min[seg_sorted])
-    first_best = jax.ops.segment_min(jnp.where(is_best, idx, BIG),
-                                     seg_sorted, num_segments=n_seg)
-    bf_take = jnp.where(idx == first_best[seg_sorted], r, 0)
-    take_sorted = full_take + bf_take
+    take_sorted = _consume_in_order(state[order], seg[order], need_of_seg,
+                                    n_seg, least_free)
     return jnp.zeros_like(state).at[order].set(take_sorted)
 
 
@@ -274,6 +241,349 @@ def make_sequential_placer(parents_np: list[np.ndarray]):
     return place_all
 
 
+def make_sequential_placer_ext(parents_np: list[np.ndarray]):
+    """Sequential on-device drain through the slice/leader-capable
+    placer: per-workload slice_size/slice_level and an optional count-1
+    leader (``has_leader`` [M] bool — explicit, so a leader podset with
+    all-zero requests places identically to place_podset_ext). The
+    capacity carry subtracts worker pods AND the leader's row."""
+    place = make_placer_ext(parents_np)
+    n_levels = len(parents_np)
+
+    @jax.jit
+    def place_all(leaf_capacity, per_pod, count, level, required,
+                  unconstrained, least_free, slice_size, slice_level,
+                  leader_per_pod, has_leader):
+        def step(cap, xs):
+            pp, ct, lv, rq, un, lf, ss, sl, lpp, hl = xs
+            sel, lead_leaf, ok = place(cap, pp, ct, lv, rq, un, lf,
+                                       ss, sl, lpp, hl)
+            take = jnp.where(ok, sel, 0)
+            cap = cap - take[:, None] * pp[None, :]
+            lead_onehot = (jnp.arange(cap.shape[0], dtype=jnp.int32)
+                           == lead_leaf) & ok & hl
+            cap = cap - jnp.where(lead_onehot[:, None], lpp[None, :], 0)
+            return cap, (sel * ok.astype(sel.dtype),
+                         jnp.where(ok, lead_leaf, -1), ok)
+
+        cap_after, (sels, leads, oks) = jax.lax.scan(
+            step, leaf_capacity,
+            (per_pod, count, level, required, unconstrained, least_free,
+             slice_size, slice_level, leader_per_pod, has_leader))
+        return sels, leads, oks, cap_after
+
+    return place_all
+
+
+# ---------------------------------------------------------------------------
+# extended placer: slices + leaders (tas_flavor_snapshot.go:867-1060,
+# 1348-1469)
+# ---------------------------------------------------------------------------
+
+
+def fill_counts_ext(parents, leaf_capacity, per_pod, leader_per_pod,
+                    has_leader, slice_size, slice_level):
+    """Phase 1 with slice and leader states (fillInCounts +
+    fillInCountsHelper, tas_flavor_snapshot.go:1568-1719).
+
+    Returns per level l: dict with st (pods), swl (pods with the leader
+    hosted somewhere below), ls (leader capacity 0/1), ss (slices),
+    sswl (slices with leader). ``slice_level``/``slice_size`` are traced
+    scalars; levels are a static Python loop.
+    """
+    n_levels = len(parents)
+    nz = per_pod > 0
+    per_dom = jnp.where(nz[None, :],
+                        leaf_capacity // jnp.maximum(per_pod, 1)[None, :],
+                        BIG)
+    st = jnp.minimum(jnp.min(per_dom, axis=1), BIG)        # [D_leaf]
+    lnz = leader_per_pod > 0
+    fits_leader = jnp.all(~lnz[None, :]
+                          | (leaf_capacity >= leader_per_pod[None, :]),
+                          axis=1) & has_leader
+    rem = leaf_capacity - jnp.where(fits_leader[:, None],
+                                    leader_per_pod[None, :], 0)
+    per_dom_l = jnp.where(nz[None, :],
+                          rem // jnp.maximum(per_pod, 1)[None, :], BIG)
+    swl = jnp.minimum(jnp.min(per_dom_l, axis=1), BIG)
+    ls = fits_leader.astype(jnp.int32)
+
+    leaf_l = n_levels - 1
+    at_sl = leaf_l == slice_level
+    ss = jnp.where(at_sl, st // jnp.maximum(slice_size, 1), 0)
+    sswl = jnp.where(at_sl, swl // jnp.maximum(slice_size, 1), 0)
+    out = {leaf_l: dict(st=st, swl=swl, ls=ls, ss=ss, sswl=sswl)}
+
+    for l in range(n_levels - 1, 0, -1):
+        n_up = parents[l - 1].shape[0]
+        seg = parents[l]
+        c = out[l]
+        total = jax.ops.segment_sum(c["st"], seg, num_segments=n_up)
+        slice_total = jax.ops.segment_sum(c["ss"], seg, num_segments=n_up)
+        # leader contributors: children able to host the leader (or no
+        # leader requested at all)
+        contrib = ~has_leader | (c["ls"] > 0)
+        any_contrib = jax.ops.segment_max(
+            contrib.astype(jnp.int32), seg, num_segments=n_up) > 0
+        state_diff = jnp.where(contrib, c["st"] - c["swl"], BIG)
+        slice_diff = jnp.where(contrib, c["ss"] - c["sswl"], BIG)
+        min_sd = jax.ops.segment_min(state_diff, seg, num_segments=n_up)
+        min_ssd = jax.ops.segment_min(slice_diff, seg, num_segments=n_up)
+        ls_up = jax.ops.segment_max(c["ls"], seg, num_segments=n_up)
+        swl_up = jnp.where(any_contrib, total - min_sd, 0)
+        sswl_up = jnp.where(any_contrib, slice_total - min_ssd, 0)
+        at_sl = (l - 1) == slice_level
+        ss_up = jnp.where(at_sl, total // jnp.maximum(slice_size, 1),
+                          slice_total)
+        sswl_up = jnp.where(at_sl, swl_up // jnp.maximum(slice_size, 1),
+                            sswl_up)
+        out[l - 1] = dict(st=total, swl=swl_up, ls=ls_up, ss=ss_up,
+                          sswl=sswl_up)
+    return out
+
+
+def _unit_views(c, l, slice_level):
+    """Unit-space (u_state, u_swl) at level l: slices at or above the
+    slice level, pods below. The sort keys always use the slice arrays
+    (zero below the slice level), mirroring _sorted/_sorted_with_leader
+    keying on slice_state at every level."""
+    in_slices = jnp.asarray(l, dtype=jnp.int32) <= slice_level
+    u_state = jnp.where(in_slices, c["ss"], c["st"])
+    u_swl = jnp.where(in_slices, c["sswl"], c["swl"])
+    return u_state, u_swl
+
+
+def _greedy_segment_lead(c, l, slice_level, seg, need_of_seg, lead_of_seg,
+                         n_seg, least_free):
+    """Per sibling group: route the (0/1) leader, then minimize domains
+    (updateCountsToMinimumGeneric + consumeWithLeadersGeneric,
+    tas_flavor_snapshot.go:1348-1469). ``need_of_seg`` is in the level's
+    units. Returns (take [D] units, lead_take [D] bool).
+    """
+    u_state, u_swl = _unit_views(c, l, slice_level)
+    ss_key = c["ss"]
+    st_key = c["st"]
+    ls = c["ls"]
+    D = u_state.shape[0]
+    idx = jnp.arange(D, dtype=jnp.int32)
+    need = need_of_seg[seg]
+    lead_here = lead_of_seg[seg]                      # [D] bool
+
+    # ---- leader domain (sortedDomainsWithLeader order) ----------------
+    # keys: (-leader_state, ±slice_swl, state_swl, idx); only segments
+    # with a leader to place participate.
+    sswl_key = jnp.where(least_free, c["sswl"], -c["sswl"])
+    # lexicographic min via segment reductions
+    k1 = -ls
+    m1 = jax.ops.segment_min(jnp.where(lead_here, k1, BIG), seg,
+                             num_segments=n_seg)
+    c1 = lead_here & (k1 == m1[seg])
+    m2 = jax.ops.segment_min(jnp.where(c1, sswl_key, BIG), seg,
+                             num_segments=n_seg)
+    c2 = c1 & (sswl_key == m2[seg])
+    m3 = jax.ops.segment_min(jnp.where(c2, c["swl"], BIG), seg,
+                             num_segments=n_seg)
+    c3 = c2 & (c["swl"] == m3[seg])
+    top_lead = jax.ops.segment_min(jnp.where(c3, idx, BIG), seg,
+                                   num_segments=n_seg)  # [S]
+    top_of = top_lead[seg]
+    top_fits = (u_swl[jnp.minimum(top_of, D - 1)] >= need) & (
+        ls[jnp.minimum(top_of, D - 1)] > 0)
+    # best-fit swap (findBestFitDomainBy over u_swl) when the top fits
+    # everything and we are not least-free
+    elig_bf = lead_here & (ls > 0) & (u_swl >= need) & top_fits & (
+        ~least_free)
+    bf_min = jax.ops.segment_min(jnp.where(elig_bf, u_swl, BIG), seg,
+                                 num_segments=n_seg)
+    is_bf = elig_bf & (u_swl == bf_min[seg])
+    bf_first = jax.ops.segment_min(jnp.where(is_bf, idx, BIG), seg,
+                                   num_segments=n_seg)
+    # least_free keeps the sorted-with-leader top (no best-fit swap)
+    lead_dom = jnp.where(bf_first < BIG, bf_first, top_lead)  # [S]
+    has_lead_dom = (lead_dom < BIG) & lead_of_seg & (
+        jax.ops.segment_max(ls, seg, num_segments=n_seg) > 0)
+    lead_dom_c = jnp.minimum(lead_dom, D - 1).astype(jnp.int32)
+    is_lead = (idx == lead_dom_c[seg]) & has_lead_dom[seg]
+    lead_take_units = jnp.where(is_lead, jnp.minimum(u_swl, need), 0)
+
+    # ---- the rest: normal greedy on remaining need --------------------
+    taken = jax.ops.segment_sum(lead_take_units, seg, num_segments=n_seg)
+    rest_need = jnp.maximum(need_of_seg - taken, 0)
+    state_rest = jnp.where(is_lead, 0, u_state)
+    # ordering: (±slice_state, state, idx); leader domain excluded
+    ss_sort = jnp.where(least_free, ss_key, -ss_key)
+    key = jnp.where(is_lead, BIG, 0)
+    order = jnp.lexsort((idx, st_key, ss_sort, key, seg))
+    take_sorted = _consume_in_order(state_rest[order], seg[order],
+                                    rest_need, n_seg, least_free)
+    take = jnp.zeros_like(u_state).at[order].set(take_sorted)
+    return take + lead_take_units, is_lead
+
+
+def _consume_in_order(s_sorted, seg_sorted, need_of_seg, n_seg,
+                      least_free):
+    """updateCountsToMinimumGeneric on a pre-sorted domain sequence:
+    take full domains until the remainder fits one, then best-fit the
+    remainder (no-op refinement under least-free ascending order)."""
+    D = s_sorted.shape[0]
+    idx = jnp.arange(D, dtype=jnp.int32)
+    need = need_of_seg[seg_sorted]
+    csum = jnp.cumsum(s_sorted)
+    is_start = jnp.concatenate([jnp.ones(1, dtype=bool),
+                                seg_sorted[1:] != seg_sorted[:-1]])
+    base = jnp.where(is_start, csum - s_sorted, 0)
+    base = jax.lax.associative_scan(jnp.maximum,
+                                    jnp.where(is_start, base, -1))
+    prefix_excl = csum - s_sorted - base
+    remaining = jnp.maximum(need - prefix_excl, 0)
+    covers = (s_sorted >= remaining) & (remaining > 0)
+    pos_cover = jnp.where(covers, idx, BIG)
+    q = jax.ops.segment_min(pos_cover, seg_sorted, num_segments=n_seg)
+    q_of = q[seg_sorted]
+    full_take = jnp.where((idx < q_of) & (remaining > 0), s_sorted, 0)
+    rem_at_q = jnp.where(idx == q_of, remaining, 0)
+    rem_of_seg = jax.ops.segment_max(rem_at_q, seg_sorted,
+                                     num_segments=n_seg)
+    r = rem_of_seg[seg_sorted]
+    elig = (idx >= q_of) & (s_sorted >= r) & (r > 0)
+    s_min = jax.ops.segment_min(jnp.where(elig, s_sorted, BIG),
+                                seg_sorted, num_segments=n_seg)
+    is_best = elig & (s_sorted == s_min[seg_sorted])
+    first_best = jax.ops.segment_min(jnp.where(is_best, idx, BIG),
+                                     seg_sorted, num_segments=n_seg)
+    bf_take = jnp.where(idx == first_best[seg_sorted], r, 0)
+    return full_take + bf_take
+
+
+def make_placer_ext(parents_np: list[np.ndarray]):
+    """Jitted placer with slice + leader support for one tree shape.
+
+    ``place(leaf_capacity, per_pod, count, requested_level, required,
+    unconstrained, least_free, slice_size, slice_level, leader_per_pod,
+    has_leader)`` returns (worker_leaf_sel [D_leaf] pods,
+    leader_leaf int32 (-1 when none), feasible bool). Covers
+    findTopologyAssignment for single-layer slices and a count-1 leader
+    podset (tas_flavor_snapshot.go:804-999); nested slice layers and
+    balanced placement stay on the host tree.
+    """
+    parents = [jnp.asarray(p) for p in parents_np]
+    n_levels = len(parents)
+
+    @jax.jit
+    def place(leaf_capacity, per_pod, count, requested_level, required,
+              unconstrained, least_free, slice_size, slice_level,
+              leader_per_pod, has_leader):
+        cs = fill_counts_ext(parents, leaf_capacity, per_pod,
+                             leader_per_pod, has_leader, slice_size,
+                             slice_level)
+        slice_count = count // jnp.maximum(slice_size, 1)
+
+        def units_at(l):
+            # placement units at level l (need conversions cross SL)
+            return jnp.where(jnp.asarray(l, jnp.int32) <= slice_level,
+                             slice_count, count)
+
+        # ---- findLevelWithFitDomains at the requested level, walking
+        # up for preferred requests ------------------------------------
+        chosen_level = jnp.asarray(-1, dtype=jnp.int32)
+        chosen_dom = jnp.asarray(0, dtype=jnp.int32)
+        for l in range(n_levels - 1, -1, -1):
+            c = cs[l]
+            u_state, u_swl = _unit_views(c, l, slice_level)
+            nd = units_at(l)
+            ok_lead = (c["ls"] > 0) | ~has_leader
+            # least-free (host: first sorted domain with slice_state >=
+            # need) still must hold the leader when one exists — the
+            # host's own least-free walk skips that check only because
+            # mixed-profile unconstrained podsets never carry leaders;
+            # without it the sequential drain's capacity carry would go
+            # negative on the leader row
+            fits = jnp.where(least_free & ~has_leader, u_state >= nd,
+                             (u_swl >= nd) & ok_lead)
+            # least-free: first in (-ls, sswl, swl, idx) order with
+            # slice_state >= need; normal: best-fit by u_swl
+            key_lf = jnp.where(fits, jnp.arange(u_state.shape[0]), BIG)
+            key_bf = jnp.where(fits, u_swl, BIG)
+            d_lf = jnp.argmin(key_lf).astype(jnp.int32)
+            d_bf = jnp.argmin(key_bf).astype(jnp.int32)
+            d = jnp.where(least_free, d_lf, d_bf)
+            okl = jnp.any(fits)
+            allowed = jnp.where(
+                required | unconstrained, l == requested_level,
+                l <= requested_level)
+            hit = okl & allowed & (chosen_level < 0) & (
+                l <= requested_level)
+            chosen_level = jnp.where(hit, l, chosen_level)
+            chosen_dom = jnp.where(hit & (chosen_level == l), d,
+                                   chosen_dom)
+        single_fit = chosen_level >= 0
+
+        # ---- seed: single domain, or greedy multi-domain -------------
+        sel = [jnp.zeros_like(cs[l]["st"]) for l in range(n_levels)]
+        lead = [jnp.zeros(cs[l]["st"].shape, dtype=bool)
+                for l in range(n_levels)]
+        feasible = jnp.zeros((), dtype=bool)
+        greedy_level = jnp.where(unconstrained, requested_level, 0)
+        for l in range(n_levels):
+            c = cs[l]
+            is_single = single_fit & (chosen_level == l)
+            one_hot = (jnp.arange(c["st"].shape[0],
+                                  dtype=jnp.int32) == chosen_dom)
+            seed_single = jnp.where(one_hot, units_at(l), 0)
+            seed_lead = one_hot & has_leader
+            seg = jnp.zeros_like(c["st"])
+            g, gl = _greedy_segment_lead(
+                c, l, slice_level, seg,
+                jnp.full((1,), units_at(l), dtype=c["st"].dtype),
+                jnp.full((1,), True) & has_leader, 1, least_free)
+            u_state, u_swl = _unit_views(c, l, slice_level)
+            cap_ok = jnp.where(
+                has_leader,
+                (jnp.sum(jnp.where(gl, u_swl, u_state)) >= units_at(l))
+                & (jnp.any(gl) | ~has_leader),
+                jnp.sum(u_state) >= units_at(l))
+            use_greedy = (~single_fit) & (greedy_level == l) & ~required
+            sel[l] = jnp.where(is_single, seed_single,
+                               jnp.where(use_greedy & cap_ok, g, sel[l]))
+            lead[l] = jnp.where(is_single, seed_lead & has_leader,
+                                jnp.where(use_greedy & cap_ok,
+                                          gl & has_leader, lead[l]))
+            feasible = feasible | is_single | (use_greedy & cap_ok)
+        start = jnp.where(single_fit, chosen_level, greedy_level)
+
+        # ---- descend --------------------------------------------------
+        for l in range(n_levels - 1):
+            par = parents[l + 1]
+            n_par = cs[l]["st"].shape[0]
+            # need conversion when crossing the slice level: parents at
+            # or above SL hold slices, children below hold pods
+            below_sl = jnp.asarray(l + 1, jnp.int32) > slice_level
+            need_par = jnp.where(
+                below_sl & (jnp.asarray(l, jnp.int32) <= slice_level),
+                sel[l] * jnp.maximum(slice_size, 1), sel[l])
+            computed, comp_lead = _greedy_segment_lead(
+                cs[l + 1], l + 1, slice_level, par, need_par, lead[l],
+                n_par, least_free)
+            keep = jnp.asarray(l + 1) <= start
+            sel[l + 1] = jnp.where(keep, sel[l + 1], computed)
+            lead[l + 1] = jnp.where(keep, lead[l + 1], comp_lead)
+
+        leaf = n_levels - 1
+        # leaf units -> pods
+        leaf_pods = jnp.where(
+            jnp.asarray(leaf, jnp.int32) <= slice_level,
+            sel[leaf] * jnp.maximum(slice_size, 1), sel[leaf])
+        total_ok = jnp.sum(leaf_pods) == count
+        feasible = feasible & total_ok & (
+            ~has_leader | jnp.any(lead[leaf]))
+        leader_leaf = jnp.where(
+            has_leader & feasible,
+            jnp.argmax(lead[leaf]).astype(jnp.int32), -1)
+        return leaf_pods, leader_leaf, feasible
+
+    return place
+
+
 _placer_cache: dict = {}
 
 
@@ -303,3 +613,57 @@ def place_podset(snapshot, per_pod: dict, count: int,
     leaf_sel = np.asarray(leaf_sel)
     return {levels.leaf_names[i]: int(leaf_sel[i])
             for i in range(len(levels.leaf_names)) if leaf_sel[i] > 0}
+
+
+_placer_ext_cache: dict = {}
+
+
+def place_podset_ext(snapshot, per_pod: dict, count: int,
+                     requested_level_idx: int, required: bool = False,
+                     unconstrained: bool = False, slice_size: int = 1,
+                     slice_level_idx: int | None = None,
+                     leader_per_pod: dict | None = None):
+    """Host wrapper for the slice/leader-capable placer.
+
+    Returns (worker {leaf id: pods}, leader leaf id or None) or None
+    when infeasible. Single slice layer + count-1 leader podset; nested
+    slice layers and balanced placement stay on the host tree
+    (tas_flavor_snapshot.go:804-999 scope notes in make_placer_ext).
+    """
+    levels = build_levels(snapshot)
+    key = tuple(tuple(p.tolist()) for p in levels.parents)
+    placer = _placer_ext_cache.get(key)
+    if placer is None:
+        placer = make_placer_ext(levels.parents)
+        _placer_ext_cache[key] = placer
+    R = max(1, len(levels.resources))
+    req = np.zeros(R, dtype=np.int32)
+    for j, r in enumerate(levels.resources):
+        req[j] = per_pod.get(r, 0)
+    lead = np.zeros(R, dtype=np.int32)
+    has_leader = leader_per_pod is not None
+    if has_leader:
+        for j, r in enumerate(levels.resources):
+            lead[j] = leader_per_pod.get(r, 0)
+    if slice_level_idx is None:
+        slice_level_idx = len(levels.parents) - 1
+    if count % max(slice_size, 1) != 0:
+        return None
+    least_free = unconstrained and getattr(snapshot, "profile_mixed", False)
+    worker_sel, leader_leaf, feasible = placer(
+        jnp.asarray(levels.leaf_capacity), jnp.asarray(req),
+        jnp.asarray(count, dtype=jnp.int32),
+        jnp.asarray(requested_level_idx, dtype=jnp.int32),
+        jnp.asarray(required), jnp.asarray(unconstrained),
+        jnp.asarray(least_free),
+        jnp.asarray(max(slice_size, 1), dtype=jnp.int32),
+        jnp.asarray(slice_level_idx, dtype=jnp.int32),
+        jnp.asarray(lead), jnp.asarray(has_leader))
+    if not bool(feasible):
+        return None
+    worker_sel = np.asarray(worker_sel)
+    workers = {levels.leaf_names[i]: int(worker_sel[i])
+               for i in range(len(levels.leaf_names)) if worker_sel[i] > 0}
+    leader = (levels.leaf_names[int(leader_leaf)]
+              if has_leader and int(leader_leaf) >= 0 else None)
+    return workers, leader

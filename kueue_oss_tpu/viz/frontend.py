@@ -1,10 +1,11 @@
 """Static dashboard frontend (kueueviz's React app analog, build-free).
 
 One self-contained HTML page served at `/`: fetches the JSON APIs
-(/api/overview, /api/clusterqueues, /api/cohorts, /api/workloads) and
-renders live-refreshing tables. Reference: cmd/kueueviz/frontend —
-the same read-only views (queues, cohorts, workloads, status counts)
-without the React/Vite toolchain.
+(/api/overview) and renders the cohort hierarchy as a nested tree with
+per-ClusterQueue usage bars, plus live-refreshing queue/workload
+tables. Reference: cmd/kueueviz/frontend — the same read-only views
+(queues, cohorts, workloads, status counts) without the React/Vite
+toolchain.
 """
 
 INDEX_HTML = """<!doctype html>
@@ -14,65 +15,119 @@ INDEX_HTML = """<!doctype html>
 <title>kueue-oss-tpu dashboard</title>
 <style>
   :root { color-scheme: light dark; }
-  body { font: 14px/1.5 system-ui, sans-serif; margin: 2rem; }
+  body { font: 14px/1.5 system-ui, sans-serif; margin: 2rem;
+         max-width: 72rem; }
   h1 { font-size: 1.3rem; }
   h2 { font-size: 1.05rem; margin-top: 2rem; }
   table { border-collapse: collapse; width: 100%; }
-  th, td { text-align: left; padding: .3rem .7rem;
-           border-bottom: 1px solid color-mix(in srgb, currentColor 18%, transparent); }
+  th, td { text-align: left; padding: .3rem .7rem; border-bottom:
+    1px solid color-mix(in srgb, currentColor 18%, transparent); }
   th { font-weight: 600; }
   .pill { display: inline-block; padding: 0 .5rem; border-radius: 999px;
           border: 1px solid currentColor; font-size: .85em; }
   #overview span { margin-right: 1.5rem; }
+  ul.tree { list-style: none; padding-left: 1.2rem; }
+  ul.tree > li { padding-left: .4rem; }
+  ul.tree > li::before { content: "├ "; opacity: .5; }
+  ul.tree > li:last-child::before { content: "└ "; }
+  .cohort { font-weight: 600; }
+  .cq-line { display: inline-flex; align-items: center; gap: .6rem;
+             width: calc(100% - 2rem); }
+  .cq-name { min-width: 11rem; }
+  .bar { flex: 1; max-width: 22rem; height: .8rem; border-radius: 4px;
+         border: 1px solid color-mix(in srgb, currentColor 35%,
+                                     transparent);
+         overflow: hidden; }
+  .bar > div { height: 100%; background:
+    color-mix(in srgb, currentColor 45%, transparent); }
+  .bar > div.over { background: #c0392b; }
+  .frac { opacity: .7; font-size: .85em; min-width: 8rem; }
   footer { margin-top: 2rem; opacity: .6; font-size: .85em; }
 </style>
 </head>
 <body>
 <h1>kueue-oss-tpu</h1>
 <div id="overview">loading…</div>
+<h2>Cohort tree</h2>
+<div id="tree"></div>
 <h2>ClusterQueues</h2>
 <table id="cqs"><thead><tr>
   <th>Name</th><th>Cohort</th><th>Pending</th><th>Inadmissible</th>
   <th>Reserving</th><th>Usage</th></tr></thead><tbody></tbody></table>
-<h2>Cohorts</h2>
-<table id="cohorts"><thead><tr>
-  <th>Name</th><th>Parent</th><th>ClusterQueues</th></tr></thead>
-  <tbody></tbody></table>
 <h2>Workloads</h2>
 <table id="wls"><thead><tr>
   <th>Namespace</th><th>Name</th><th>LocalQueue</th><th>Priority</th>
-  <th>Status</th></tr></thead><tbody></tbody></table>
-<footer>auto-refreshes every 2s · JSON at /api/*</footer>
+  <th>Status</th><th>ClusterQueue</th></tr></thead><tbody></tbody></table>
+<footer>auto-refreshes every 2s · JSON at /api/overview</footer>
 <script>
 const fmt = (o) => Object.entries(o || {}).map(
-    ([k, v]) => `${k}=${v}`).join(" ");
+    ([k, v]) => `${k}=${v}`).join(" ") || "—";
+function usageBar(cq) {
+  // dominant utilisation across flavor/resource quota columns
+  let frac = 0, label = "";
+  for (const [k, n] of Object.entries(cq.nominalQuota || {})) {
+    const used = (cq.usage || {})[k] || 0;
+    if (n > 0 && used / n > frac) { frac = used / n; label = k; }
+  }
+  const over = frac > 1;
+  const pct = Math.min(frac, 1) * 100;
+  return `<span class="cq-line"><span class="cq-name">${cq.name}</span>` +
+    `<span class="bar"><div class="${over ? "over" : ""}"` +
+    ` style="width:${pct}%"></div></span>` +
+    `<span class="frac">${(frac * 100).toFixed(0)}%` +
+    (label ? ` ${label}` : "") + (over ? " ⚠ borrowing" : "") +
+    `</span><span class="frac">${cq.pending || 0} pending</span></span>`;
+}
+function renderTree(cohorts, cqs) {
+  const byName = Object.fromEntries(cohorts.map(c => [c.name, c]));
+  const cqByName = Object.fromEntries(cqs.map(q => [q.name, q]));
+  const children = {};
+  const roots = [];
+  for (const c of cohorts) {
+    if (c.parent && byName[c.parent]) {
+      (children[c.parent] ||= []).push(c.name);
+    } else roots.push(c.name);
+  }
+  function node(name) {
+    const c = byName[name];
+    const kids = (children[name] || []).map(node).join("");
+    const queues = (c.clusterQueues || [])
+      .map(q => `<li>${usageBar(cqByName[q] || {name: q})}</li>`)
+      .join("");
+    return `<li><span class="cohort">${name}</span>` +
+      `<ul class="tree">${kids}${queues}</ul></li>`;
+  }
+  // parentless ClusterQueues render as their own roots
+  const solo = cqs.filter(q => !q.cohort)
+    .map(q => `<li>${usageBar(q)}</li>`).join("");
+  return `<ul class="tree">${roots.map(node).join("")}${solo}</ul>`;
+}
 async function refresh() {
   try {
-    const [cqs, cohorts, wls] = await Promise.all([
-      fetch('/api/clusterqueues').then(r => r.json()),
-      fetch('/api/cohorts').then(r => r.json()),
-      fetch('/api/workloads').then(r => r.json()),
-    ]);
+    const o = await fetch("/api/overview").then(r => r.json());
+    const cqs = o.clusterQueues, wls = o.workloads;
     const counts = {};
     for (const w of wls) counts[w.status] = (counts[w.status] || 0) + 1;
-    document.getElementById('overview').innerHTML =
+    document.getElementById("overview").innerHTML =
       `<span><b>${cqs.length}</b> ClusterQueues</span>` +
+      `<span><b>${o.cohorts.length}</b> Cohorts</span>` +
       `<span><b>${wls.length}</b> Workloads</span>` +
       Object.entries(counts)
-        .map(([k, v]) => `<span><b>${v}</b> ${k}</span>`).join('');
+        .map(([k, v]) => `<span><b>${v}</b> ${k}</span>`).join("");
+    document.getElementById("tree").innerHTML =
+      renderTree(o.cohorts, cqs);
     const fill = (id, rows) => {
       document.querySelector(`#${id} tbody`).innerHTML =
-        rows.map(r => `<tr>${r.map(c => `<td>${c}</td>`).join('')}</tr>`)
-            .join('');
+        rows.map(r => `<tr>${r.map(c => `<td>${c}</td>`).join("")}</tr>`)
+            .join("");
     };
-    fill('cqs', cqs.map(q => [q.name, q.cohort || '—', q.pending,
+    fill("cqs", cqs.map(q => [q.name, q.cohort || "—", q.pending,
                               q.inadmissible, q.reserved,
                               fmt(q.usage)]));
-    fill('cohorts', cohorts.map(c => [c.name, c.parent || '—',
-                                      (c.clusterQueues || []).join(', ')]));
-    fill('wls', wls.map(w => [w.namespace, w.name, w.localQueue,
-                              w.priority,
-                              `<span class="pill">${w.status}</span>`]));
+    fill("wls", wls.slice(0, 300).map(w => [
+        w.namespace, w.name, w.localQueue, w.priority,
+        `<span class="pill">${w.status}</span>`,
+        w.clusterQueue || "—"]));
   } catch (e) { /* server restarting; retry on next tick */ }
 }
 refresh();

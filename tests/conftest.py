@@ -19,6 +19,12 @@ if "xla_cpu_parallel_codegen_split_count" not in flags:
     # compiling the large solver programs (observed in
     # compiler.py backend_compile_and_load); serial codegen is stable.
     flags = (flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+if "xla_cpu_max_isa" not in flags:
+    # This host's LLVM aborts with "Cannot select:
+    # X86ISD::SUBV_BROADCAST_LOAD v32i8" (an AVX2 ISel bug) while
+    # compiling some solver sort-comparator fusions; capping the ISA at
+    # AVX sidesteps it. CPU-only knob — TPU lowering is unaffected.
+    flags = (flags + " --xla_cpu_max_isa=AVX").strip()
 os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402

@@ -126,9 +126,7 @@ def test_fair_drain_parity(seed):
     store_h, queues_h, sched_h = _setup(seed)
     init = _state(store_h)
     cycles = sched_h.run_until_quiet(now=200.0, max_cycles=300, tick=1.0)
-    if cycles >= 300:
-        pytest.skip(f"fs seed {seed}: host livelock")
-    admitted_h = _state(store_h)
+    livelocked = cycles >= 300
 
     store_k, queues_k, _ = _setup(seed)
     assert _state(store_k) == init
@@ -136,6 +134,22 @@ def test_fair_drain_parity(seed):
     assert engine.supported() and engine.needs_full_kernel()
     engine.drain(now=200.0)
     admitted_k = _state(store_k)
+
+    if livelocked:
+        # Reference-inherited preemption ping-pong under fair sharing
+        # (preemption evictions requeue with no backoff,
+        # workload_controller.go:1030-1049): the host revisits a bounded
+        # limit cycle; the kernel's bounded drain must land on one of
+        # those states (see test_full_kernel_parity.LIMIT_CYCLE_PROBE).
+        states = set()
+        for c in range(12):
+            sched_h.schedule(now=600.0 + c)
+            states.add(frozenset(_state(store_h)))
+        assert frozenset(admitted_k) in states, (
+            f"fs seed {seed}: kernel terminal admitted set not in the "
+            f"host's limit cycle ({len(states)} states)")
+        return
+    admitted_h = _state(store_h)
 
     victims_h = init - admitted_h
     victims_k = init - admitted_k

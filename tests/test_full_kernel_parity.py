@@ -38,6 +38,54 @@ from kueue_oss_tpu.solver.full_kernels import (
 )
 from kueue_oss_tpu.solver.tensors import export_problem
 
+#: Preemption ping-pong characterization: the reference cycles forever
+#: on symmetric reclaim fights — preemption evictions requeue with NO
+#: RequeueState backoff (workload_controller.go:1030-1049 applies
+#: backoff only under waitForPodsReady), so nothing algorithmic breaks
+#: the loop; real deployments are throttled by pod-termination latency
+#: only. The host scheduler faithfully enters that bounded limit cycle
+#: (observed period 2: a borrower re-admits into the capacity its
+#: preemptor freed, then is reclaimed again). The kernel's round state
+#: machine reaches a FIXED POINT instead (its reserve-and-park round
+#: bookkeeping dampens the oscillation); parity on livelock seeds is
+#: asserted as: the kernel terminates AND its terminal admitted
+#: set/flavors is a member of the host's limit cycle.
+LIMIT_CYCLE_PROBE = 12
+
+
+def freeze_state(admitted, flavors):
+    return (frozenset(admitted),
+            tuple(sorted((k, tuple(sorted(v.items())))
+                         for k, v in flavors.items())))
+
+
+def host_limit_cycle(seed, build, mk_wl, scheduler_kwargs=None):
+    """Drive the host deep into its non-quiescent regime, then collect
+    the distinct (admitted, flavors) states it keeps revisiting."""
+    store, phase1, phase2 = build(seed)
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues, **(scheduler_kwargs or {}))
+    uid = 1
+    for spec in phase1:
+        store.add_workload(mk_wl(spec, uid))
+        uid += 1
+    sched.run_until_quiet(now=50.0, tick=1.0)
+    for spec in phase2:
+        store.add_workload(mk_wl(spec, uid))
+        uid += 1
+    sched.run_until_quiet(now=200.0, max_cycles=300, tick=1.0)
+    states = set()
+    for c in range(LIMIT_CYCLE_PROBE):
+        sched.schedule(now=600.0 + c)
+        admitted = {k for k, w in store.workloads.items()
+                    if w.is_quota_reserved}
+        flavors = {
+            k: {r: f for psa in w.status.admission.podset_assignments
+                for r, f in psa.flavors.items()}
+            for k, w in store.workloads.items() if w.is_quota_reserved}
+        states.add(freeze_state(admitted, flavors))
+    return states
+
 
 def build_scenario(seed: int):
     """Deterministic store + workload schedule for one random scenario."""
@@ -132,12 +180,11 @@ def run_host(seed: int):
     for spec in phase2:
         store.add_workload(_mk_wl(spec, uid))
         uid += 1
-    cycles = sched.run_until_quiet(now=200.0, max_cycles=300, tick=1.0)
+    cycles = sched.run_until_quiet(now=200.0, max_cycles=300,
+                                   tick=1.0)
     if cycles >= 300:
-        # Preemption ping-pong livelock (a borrower re-admits into the
-        # capacity its preemptor freed, forever). Inherited from the
-        # reference's cycle semantics; no stable outcome to compare.
-        pytest.skip(f"seed {seed}: host scheduler does not quiesce")
+        # Preemption ping-pong livelock: see LIMIT_CYCLE_PROBE.
+        return None
     admitted = {k for k, w in store.workloads.items() if w.is_quota_reserved}
     flavors = {
         k: {r: f for psa in w.status.admission.podset_assignments
@@ -175,8 +222,8 @@ def run_kernel(seed: int):
                              parked=parked)
     t = to_device_full(problem)
     g_max = int(problem.cq_ngroups.max())
-    admitted_a, opt, admit_round, parked, rounds, usage, wl_usage, _vr = (
-        solve_backlog_full(t, g_max=g_max, h_max=8, p_max=32))
+    (admitted_a, opt, admit_round, parked, rounds, usage, wl_usage,
+     _vr) = solve_backlog_full(t, g_max=g_max, h_max=8, p_max=32)
     admitted_a = np.asarray(admitted_a)
     opt = np.asarray(opt)
     admitted = {problem.wl_keys[w] for w in range(problem.n_workloads)
@@ -213,8 +260,17 @@ SEEDS = list(range(30))
 
 @pytest.mark.parametrize("seed", SEEDS)
 def test_drain_parity(seed):
-    init_h, admitted_h, flavors_h = run_host(seed)
+    host = run_host(seed)
     init_k, admitted_k, flavors_k, rounds = run_kernel(seed)
+    if host is None:
+        # Livelock seed (see LIMIT_CYCLE_PROBE): the kernel must
+        # terminate on a state the host keeps revisiting.
+        states = host_limit_cycle(seed, build_scenario, _mk_wl)
+        assert freeze_state(admitted_k, flavors_k) in states, (
+            f"seed {seed}: kernel terminal state not in the host's "
+            f"limit cycle ({len(states)} states)")
+        return
+    init_h, admitted_h, flavors_h = host
     assert init_h == init_k, "setup must be identical"
     victims_h = init_h - admitted_h
     victims_k = init_k - admitted_k

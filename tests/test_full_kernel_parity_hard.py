@@ -40,6 +40,25 @@ from kueue_oss_tpu.solver.full_kernels import (
 )
 from kueue_oss_tpu.solver.tensors import export_problem
 
+from test_full_kernel_parity import freeze_state, host_limit_cycle
+
+
+@pytest.fixture(autouse=True)
+def _clear_caches_each_test():
+    """The XLA:CPU backend aborts after enough in-process compilations
+    of the large solver programs (see tests/conftest.py); this file now
+    compiles 40 seeds' worth (the livelock seeds run the kernel since
+    the limit-cycle conversion), so compiled programs drop after every
+    test instead of every module."""
+    yield
+    import jax
+
+    jax.clear_caches()
+    from kueue_oss_tpu.solver import full_kernels
+
+    full_kernels._solver_cache.clear()
+
+
 WITHIN = [PreemptionPolicyValue.NEVER,
           PreemptionPolicyValue.LOWER_PRIORITY,
           PreemptionPolicyValue.LOWER_OR_NEWER_EQUAL_PRIORITY]
@@ -157,8 +176,9 @@ def _run_host(seed: int):
         uid += 1
     cycles = sched.run_until_quiet(now=200.0, max_cycles=600, tick=1.0)
     if cycles >= 600:
-        pytest.skip(f"hard seed {seed}: host livelock (preemption "
-                    "ping-pong; no stable outcome to compare)")
+        # reference-inherited preemption ping-pong; characterized via
+        # the limit-cycle assertion (test_full_kernel_parity)
+        return None
     admitted = {k for k, w in store.workloads.items() if w.is_quota_reserved}
     flavors = {
         k: {r: f for psa in w.status.admission.podset_assignments
@@ -232,8 +252,17 @@ HARD_SEEDS = list(range(40))
 
 @pytest.mark.parametrize("seed", HARD_SEEDS)
 def test_hard_drain_parity(seed):
-    init_h, admitted_h, flavors_h = _run_host(seed)
+    host = _run_host(seed)
     init_k, admitted_k, flavors_k, rounds = _run_kernel(seed)
+    if host is None:
+        # host livelock: the kernel must terminate on a state the host
+        # keeps revisiting (see test_full_kernel_parity.LIMIT_CYCLE_PROBE)
+        states = host_limit_cycle(seed, build_hard_scenario, _mk_wl)
+        assert freeze_state(admitted_k, flavors_k) in states, (
+            f"hard seed {seed}: kernel terminal state not in the "
+            f"host's limit cycle ({len(states)} states)")
+        return
+    init_h, admitted_h, flavors_h = host
     assert init_h == init_k, "setup must be identical"
     victims_h = init_h - admitted_h
     victims_k = init_k - admitted_k

@@ -1,0 +1,45 @@
+"""Heterogeneous-shape drain parity: the perf generator's multi-flavor /
+multi-resource-group / multi-podset mix (GeneratorConfig.heterogeneous)
+drained by the full kernel must admit exactly the host scheduler's set.
+
+Covers what the degenerate large-scale perf shape never exercises at
+generator level: two fungible flavors over cpu+memory (flavor walk with
+whenCanBorrow), a second resource group (per-group flavor decode,
+walk_assign g_max=2), and pod-group podsets (multiple podsets summed
+into the request vector). Reference shape analog:
+test/performance/scheduler generator.yaml with multiple resource
+flavors per queue.
+"""
+
+import pytest
+
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.perf.generator import GeneratorConfig, generate
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.solver.engine import SolverEngine
+
+
+@pytest.mark.parametrize("n_cohorts,cqs", [(1, 4), (1, 6), (2, 5)])
+def test_hetero_drain_parity(n_cohorts, cqs):
+    cfg = GeneratorConfig.heterogeneous(n_cohorts, cqs)
+    store, schedule = generate(cfg)
+    for g in schedule:
+        store.add_workload(g.workload)
+    queues = QueueManager(store)
+    engine = SolverEngine(store, queues)
+    result = engine.drain(now=0.0)
+    adm_kernel = {k for k, w in store.workloads.items()
+                  if w.is_quota_reserved}
+
+    store2, schedule2 = generate(cfg)
+    for g in schedule2:
+        store2.add_workload(g.workload)
+    queues2 = QueueManager(store2)
+    Scheduler(store2, queues2).run_until_quiet(
+        now=0.0, max_cycles=20000, tick=1.0)
+    adm_host = {k for k, w in store2.workloads.items()
+                if w.is_quota_reserved}
+
+    assert adm_kernel == adm_host
+    assert result.admitted == len(adm_kernel)
+    assert result.admitted > 0

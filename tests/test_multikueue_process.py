@@ -1,0 +1,206 @@
+"""MultiKueue over a REAL process boundary.
+
+Worker clusters run as separate OS processes
+(kueue_oss_tpu/multikueue/worker.py) behind unix-socket RPC; the hub
+drives them through RemoteWorkerEnvironment proxies. Mirrors the
+reference's remote-client architecture
+(multikueuecluster.go:91-283): dispatch races across processes, worker
+death is detected by the watcher, and the workload re-dispatches to a
+surviving worker after the worker-lost timeout.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    AdmissionCheck,
+    CheckState,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.controllers import WorkloadReconciler
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.multikueue import (
+    MULTIKUEUE_CONTROLLER_NAME,
+    MultiKueueCluster,
+    MultiKueueController,
+)
+from kueue_oss_tpu.multikueue.remote import (
+    RemoteWorkerEnvironment,
+    RemoteWorkerError,
+    WorkerConfigWatcher,
+    WorkerWatcher,
+)
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_worker(tmp_path, name: str):
+    sock = str(tmp_path / f"{name}.sock")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kueue_oss_tpu.multikueue.worker",
+         "--socket", sock],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 120
+    remote = RemoteWorkerEnvironment(name, sock)
+    while time.monotonic() < deadline:
+        if os.path.exists(sock):
+            try:
+                if remote.ping():
+                    return proc, sock, remote
+            except (RemoteWorkerError, RuntimeError):
+                pass
+        if proc.poll() is not None:
+            raise RuntimeError(f"worker {name} exited early")
+        time.sleep(0.5)
+    proc.kill()
+    raise RuntimeError(f"worker {name} did not come up")
+
+
+def _worker_cluster_config(remote: RemoteWorkerEnvironment,
+                           nominal: int = 8000) -> None:
+    remote.store.upsert("resource_flavor", ResourceFlavor(name="default"))
+    remote.store.upsert("cluster_queue", ClusterQueue(
+        name="cq",
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=nominal)])])]))
+    remote.store.upsert("local_queue", LocalQueue(
+        name="lq", cluster_queue="cq"))
+
+
+class HubEnv:
+    def __init__(self, clusters):
+        self.store = Store()
+        self.store.upsert_resource_flavor(ResourceFlavor(name="default"))
+        self.store.upsert_cluster_queue(ClusterQueue(
+            name="cq", admission_checks=["multikueue"],
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources=[
+                    ResourceQuota(name="cpu", nominal=8000)])])]))
+        self.store.upsert_local_queue(
+            LocalQueue(name="lq", cluster_queue="cq"))
+        self.store.upsert_admission_check(AdmissionCheck(
+            name="multikueue",
+            controller_name=MULTIKUEUE_CONTROLLER_NAME))
+        self.queues = QueueManager(self.store)
+        self.scheduler = Scheduler(self.store, self.queues)
+        self.wr = WorkloadReconciler(self.store, self.scheduler)
+        self.mk = MultiKueueController(
+            self.store, self.scheduler, clusters,
+            worker_lost_timeout_s=5.0)
+        self.t = 0.0
+
+    def tick(self, clusters):
+        self.t += 1.0
+        self.scheduler.schedule(self.t)
+        self.mk.reconcile_all(self.t)
+        for c in clusters:
+            if c.active:
+                try:
+                    c.environment.run_cycle(self.t)
+                except (RemoteWorkerError, RuntimeError):
+                    pass
+        self.mk.reconcile_all(self.t)
+        self.wr.reconcile_all(self.t)
+
+
+def test_process_worker_race_kill_and_redispatch(tmp_path):
+    procs = {}
+    try:
+        clusters = []
+        watchers = []
+        for name in ("w1", "w2"):
+            proc, sock, remote = _spawn_worker(tmp_path, name)
+            procs[name] = proc
+            _worker_cluster_config(remote)
+            cluster = MultiKueueCluster(name=name, environment=remote)
+            clusters.append(cluster)
+            watchers.append(WorkerWatcher(cluster, remote))
+        hub = HubEnv(clusters)
+
+        hub.store.add_workload(Workload(
+            name="wl", queue_name="lq", creation_time=0.0,
+            podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+        for _ in range(4):
+            for w in watchers:
+                w.poll_once()
+            hub.tick(clusters)
+        wl = hub.store.workloads["default/wl"]
+        assert wl.status.cluster_name in ("w1", "w2")
+        winner = wl.status.cluster_name
+        assert (wl.status.admission_checks["multikueue"].state
+                == CheckState.READY)
+        # the winner process really holds the admitted mirror
+        winner_cluster = hub.mk.clusters[winner]
+        mirror = winner_cluster.environment.store.workloads.get(wl.key)
+        assert mirror is not None and mirror.is_quota_reserved
+
+        # ---- kill the winning worker PROCESS -------------------------
+        procs[winner].kill()
+        procs[winner].wait(timeout=30)
+        for w in watchers:
+            w.poll_once()
+        assert not hub.mk.clusters[winner].active
+
+        # past the worker-lost timeout the hub retries and re-dispatches
+        hub.t += 10.0
+        for _ in range(5):
+            for w in watchers:
+                w.poll_once()
+            hub.tick(clusters)
+        survivor = "w2" if winner == "w1" else "w1"
+        assert wl.status.cluster_name == survivor, (
+            f"expected re-dispatch to {survivor}, "
+            f"got {wl.status.cluster_name!r} "
+            f"(check={wl.status.admission_checks['multikueue'].state})")
+        mirror = hub.mk.clusters[survivor].environment.store.workloads.get(
+            wl.key)
+        assert mirror is not None and mirror.is_quota_reserved
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
+def test_config_watcher_adds_and_removes_clusters(tmp_path):
+    cfg = tmp_path / "workers.json"
+    added, removed = [], []
+    watcher = WorkerConfigWatcher(
+        str(cfg), on_add=lambda n, s: added.append((n, s)),
+        on_remove=lambda n: removed.append(n))
+    assert not watcher.poll()                      # no file yet
+    cfg.write_text(json.dumps({"w1": "/tmp/w1.sock"}))
+    assert watcher.poll()
+    assert added == [("w1", "/tmp/w1.sock")]
+    time.sleep(0.05)
+    cfg.write_text(json.dumps({"w2": "/tmp/w2.sock"}))
+    os.utime(cfg, (time.time() + 1, time.time() + 1))
+    assert watcher.poll()
+    assert ("w2", "/tmp/w2.sock") in added
+    assert removed == ["w1"]
+    # endpoint change for an existing cluster rebuilds the client
+    cfg.write_text(json.dumps({"w2": "/tmp/w2b.sock"}))
+    os.utime(cfg, (time.time() + 2, time.time() + 2))
+    assert watcher.poll()
+    assert ("w2", "/tmp/w2b.sock") in added
+    assert removed == ["w1", "w2"]

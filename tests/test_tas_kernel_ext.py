@@ -1,0 +1,200 @@
+"""Slice + leader TAS placement parity: the extended device placer
+(solver/tas_kernels.py make_placer_ext) vs the host tree.
+
+Covers the feature matrix the base kernel lacks: podset slices (whole
+slices constrained within a topology level — tas_flavor_snapshot.go
+:867-875 sliceState propagation), and leader podsets (a count-1 driver
+co-placed with its worker group — findLeaderAndWorkers :596-609,
+consumeWithLeadersGeneric :1348-1403).
+"""
+
+import random
+
+import pytest
+
+from kueue_oss_tpu.api.types import Node, PodSet, PodSetTopologyRequest
+from kueue_oss_tpu.solver.tas_kernels import place_podset_ext
+from kueue_oss_tpu.tas.snapshot import (
+    TASPodSetRequest,
+    build_tas_flavor_snapshot,
+)
+
+HOST = "kubernetes.io/hostname"
+BLOCK = "cloud/block"
+RACK = "cloud/rack"
+LEVELS = [BLOCK, RACK, HOST]
+
+
+def make_nodes(blocks, racks, hosts, cpu=4000):
+    nodes = []
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                nodes.append(Node(
+                    name=f"n-{b}-{r}-{h}",
+                    labels={BLOCK: f"b{b}", RACK: f"b{b}-r{r}"},
+                    allocatable={"cpu": cpu}))
+    return nodes
+
+
+def host_place_slices(snap, count, per_pod, level, slice_level,
+                      slice_size, required=False):
+    tr_req = (PodSetTopologyRequest(
+        required=level, podset_slice_required_topology=slice_level,
+        podset_slice_size=slice_size) if required
+        else PodSetTopologyRequest(
+            preferred=level, podset_slice_required_topology=slice_level,
+            podset_slice_size=slice_size))
+    ps = PodSet(name="main", count=count, requests=dict(per_pod),
+                topology_request=tr_req)
+    req = TASPodSetRequest(podset=ps, single_pod_requests=dict(per_pod),
+                           count=count, flavor="default")
+    result = snap.find_topology_assignments([req])
+    ta = result["main"].assignment
+    if ta is None:
+        return None
+    return {tuple(d.values): d.count for d in ta.domains}
+
+
+def host_place_leader(snap, count, per_pod, leader_per_pod, level,
+                      required=True):
+    tr_req = (PodSetTopologyRequest(required=level,
+                                    podset_group_name="g")
+              if required else
+              PodSetTopologyRequest(preferred=level,
+                                    podset_group_name="g"))
+    workers = PodSet(name="workers", count=count, requests=dict(per_pod),
+                     topology_request=tr_req)
+    leader = PodSet(name="leader", count=1, requests=dict(leader_per_pod),
+                    topology_request=tr_req)
+    reqs = [
+        TASPodSetRequest(podset=workers,
+                         single_pod_requests=dict(per_pod),
+                         count=count, flavor="default",
+                         podset_group_name="g"),
+        TASPodSetRequest(podset=leader,
+                         single_pod_requests=dict(leader_per_pod),
+                         count=1, flavor="default",
+                         podset_group_name="g"),
+    ]
+    result = snap.find_topology_assignments(reqs)
+    wta = result["workers"].assignment
+    lta = result["leader"].assignment
+    if wta is None or lta is None:
+        return None
+    w = {tuple(d.values): d.count for d in wta.domains}
+    l = [tuple(d.values) for d in lta.domains]
+    return w, (l[0] if l else None)
+
+
+def kernel_place_slices(snap, count, per_pod, level, slice_level,
+                        slice_size, required=False):
+    out = place_podset_ext(
+        snap, per_pod, count, LEVELS.index(level), required=required,
+        slice_size=slice_size,
+        slice_level_idx=LEVELS.index(slice_level))
+    if out is None:
+        return None
+    workers, _ = out
+    return {(leaf[-1],): c for leaf, c in workers.items()}
+
+
+def kernel_place_leader(snap, count, per_pod, leader_per_pod, level,
+                        required=True):
+    out = place_podset_ext(
+        snap, per_pod, count, LEVELS.index(level), required=required,
+        leader_per_pod=leader_per_pod)
+    if out is None:
+        return None
+    workers, leader = out
+    return ({(leaf[-1],): c for leaf, c in workers.items()},
+            (leader[-1],) if leader is not None else None)
+
+
+SLICE_CASES = [
+    # (blocks, racks, hosts, count, level, slice_level, slice_size, req)
+    (1, 2, 2, 4, RACK, HOST, 2, True),    # 2 slices of 2, rack-bound
+    (1, 2, 2, 8, BLOCK, RACK, 4, True),   # 2 slices of 4, rack slices
+    (2, 2, 2, 8, BLOCK, RACK, 4, False),  # preferred, slices of 4
+    (1, 2, 2, 6, RACK, HOST, 2, True),    # 3 slices: must span hosts
+    (2, 3, 2, 12, BLOCK, RACK, 6, True),  # rack-sized slices
+    (1, 2, 2, 12, RACK, HOST, 2, True),   # infeasible: beyond rack
+    (2, 2, 2, 8, RACK, RACK, 8, False),   # slice == whole request
+]
+
+
+@pytest.mark.parametrize("case", SLICE_CASES)
+def test_slices_match_host(case):
+    blocks, racks, hosts, count, level, slevel, ssize, req = case
+    snap = build_tas_flavor_snapshot(
+        "default", LEVELS, make_nodes(blocks, racks, hosts))
+    h = host_place_slices(snap, count, {"cpu": 1000}, level, slevel,
+                          ssize, required=req)
+    snap2 = build_tas_flavor_snapshot(
+        "default", LEVELS, make_nodes(blocks, racks, hosts))
+    k = kernel_place_slices(snap2, count, {"cpu": 1000}, level, slevel,
+                            ssize, required=req)
+    if h is None:
+        assert k is None, f"{case}: host infeasible, kernel placed {k}"
+    else:
+        assert k == h, f"{case}: host={h} kernel={k}"
+
+
+LEADER_CASES = [
+    # (blocks, racks, hosts, count, leader_cpu, level, required)
+    (1, 2, 2, 3, 1000, RACK, True),
+    (1, 2, 2, 4, 2000, RACK, True),       # leader displaces a worker
+    (2, 2, 2, 7, 1000, BLOCK, True),
+    (2, 2, 2, 10, 1000, RACK, False),     # preferred walk-up
+    (1, 1, 2, 8, 1000, RACK, True),       # exactly full rack
+]
+
+
+@pytest.mark.parametrize("case", LEADER_CASES)
+def test_leader_matches_host(case):
+    blocks, racks, hosts, count, lcpu, level, req = case
+    snap = build_tas_flavor_snapshot(
+        "default", LEVELS, make_nodes(blocks, racks, hosts))
+    h = host_place_leader(snap, count, {"cpu": 1000}, {"cpu": lcpu},
+                          level, required=req)
+    snap2 = build_tas_flavor_snapshot(
+        "default", LEVELS, make_nodes(blocks, racks, hosts))
+    k = kernel_place_leader(snap2, count, {"cpu": 1000}, {"cpu": lcpu},
+                            level, required=req)
+    if h is None:
+        assert k is None, f"{case}: host infeasible, kernel placed {k}"
+    else:
+        hw, hl = h
+        kw, kl = k
+        assert kw == hw, f"{case}: workers host={hw} kernel={kw}"
+        assert kl == hl, f"{case}: leader host={hl} kernel={kl}"
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_randomized_slice_parity(seed):
+    rng = random.Random(7000 + seed)
+    blocks = rng.randint(1, 3)
+    racks = rng.randint(1, 3)
+    hosts = rng.randint(1, 3)
+    nodes = make_nodes(blocks, racks, hosts, cpu=rng.choice([2000, 4000]))
+    ssize = rng.choice([1, 2, 4])
+    n_slices = rng.randint(1, blocks * racks * hosts * 2)
+    count = n_slices * ssize
+    per_pod = {"cpu": rng.choice([500, 1000])}
+    slevel = rng.choice([RACK, HOST])
+    level = rng.choice([BLOCK, RACK] if slevel == RACK else LEVELS)
+    if LEVELS.index(level) > LEVELS.index(slevel):
+        level = slevel
+    required = rng.random() < 0.5
+
+    snap_h = build_tas_flavor_snapshot("default", LEVELS, list(nodes))
+    snap_k = build_tas_flavor_snapshot("default", LEVELS, list(nodes))
+    h = host_place_slices(snap_h, count, per_pod, level, slevel, ssize,
+                          required=required)
+    k = kernel_place_slices(snap_k, count, per_pod, level, slevel, ssize,
+                            required=required)
+    if h is None:
+        assert k is None, (
+            f"seed {seed}: host infeasible, kernel placed {k}")
+    else:
+        assert k == h, f"seed {seed}: host={h} kernel={k}"

@@ -256,7 +256,9 @@ def test_dashboard_views_and_server():
         html = urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/", timeout=5).read().decode()
         assert "<title>kueue-oss-tpu dashboard</title>" in html
-        assert "/api/clusterqueues" in html
+        assert "/api/overview" in html
+        # cohort tree + usage-bar rendering (kueueviz frontend analog)
+        assert "renderTree" in html and "usageBar" in html
     finally:
         srv.stop()
 

@@ -1,0 +1,66 @@
+"""Quick host-vs-kernel parity smoke on a shrunken large-scale shape.
+
+Usage: python tools/smoke_kernel.py [n_cohorts] [cqs_per_cohort] [div]
+Forces the CPU backend (the ambient axon TPU plugin overrides
+JAX_PLATFORMS and hangs when the tunnel is down).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.perf.generator import GeneratorConfig, generate
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.solver.engine import SolverEngine
+
+
+def main() -> None:
+    n_cohorts = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    cqs = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+    div = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    config = GeneratorConfig.large_scale(preemption=True)
+    config.n_cohorts, config.cqs_per_cohort = n_cohorts, cqs
+    for wc in config.classes:
+        wc.count = max(1, wc.count // div)
+
+    t0 = time.time()
+    store, schedule = generate(config)
+    for g in schedule:
+        store.add_workload(g.workload)
+    queues = QueueManager(store)
+    engine = SolverEngine(store, queues)
+    print(f"setup {time.time() - t0:.1f}s "
+          f"(W={len(schedule)} C={n_cohorts * cqs})", flush=True)
+    t0 = time.time()
+    r = engine.drain(now=0.0)
+    print(f"kernel admitted={r.admitted} evicted={r.evicted} "
+          f"rounds={r.rounds} solve={r.solver_time_s:.2f}s "
+          f"total={time.time() - t0:.1f}s", flush=True)
+
+    t0 = time.time()
+    store2, schedule2 = generate(config)
+    for g in schedule2:
+        store2.add_workload(g.workload)
+    queues2 = QueueManager(store2)
+    Scheduler(store2, queues2).run_until_quiet(
+        now=0.0, max_cycles=20000, tick=1.0)
+    adm_h = {k for k, w in store2.workloads.items() if w.is_quota_reserved}
+    adm_k = {k for k, w in store.workloads.items() if w.is_quota_reserved}
+    print(f"host admitted={len(adm_h)} ({time.time() - t0:.1f}s) "
+          f"agree={len(adm_h & adm_k)} union={len(adm_h | adm_k)}",
+          flush=True)
+    if adm_h != adm_k:
+        print("MISMATCH only-host:", sorted(adm_h - adm_k)[:6])
+        print("MISMATCH only-kernel:", sorted(adm_k - adm_h)[:6])
+        raise SystemExit(1)
+    print("PARITY OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
