@@ -630,10 +630,12 @@ def main() -> None:
         "lean_admissions_per_s_50k": round(lean_value, 1),
         **extra,
         "platform": platform,
-        "note": ("full kernel timed on TPU at the largest scale the "
-                 "tunneled device completes; larger shapes stall in "
-                 "remote compile/execution; platform=cpu_fallback means "
-                 "the tunneled TPU was unavailable for this run"),
+        "note": ("full preemption kernel restructured round 4 "
+                 "(candidate tables + bulk-skip victim walks): the 50k "
+                 "x 1k drain runs ~113ms/round even on the CPU backend "
+                 "vs ~2s/round before; platform=cpu_fallback means the "
+                 "tunneled TPU was unavailable for this run and every "
+                 "figure is an XLA:CPU number"),
     }), flush=True)
 
 

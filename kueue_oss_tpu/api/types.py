@@ -241,6 +241,8 @@ def _quota_for(resource_groups: list[ResourceGroup],
 class ClusterQueue:
     name: str
     cohort: Optional[str] = None
+    #: object labels (CustomMetricLabels reads configured keys)
+    labels: dict[str, str] = field(default_factory=dict)
     resource_groups: list[ResourceGroup] = field(default_factory=list)
     queueing_strategy: str = QueueingStrategy.BEST_EFFORT_FIFO
     preemption: PreemptionPolicy = field(default_factory=PreemptionPolicy)
@@ -477,6 +479,8 @@ class Workload:
     priority: int = 0
     priority_class: Optional[str] = None
     labels: dict[str, str] = field(default_factory=dict)
+    #: object annotations (e.g. kueue.x-k8s.io/priority-boost)
+    annotations: dict[str, str] = field(default_factory=dict)
     podsets: list[PodSet] = field(default_factory=list)
     #: spec.active=false deactivates the workload (reference: workload_types.go Active)
     active: bool = True

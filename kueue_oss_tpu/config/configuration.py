@@ -95,6 +95,10 @@ class ResourcesConfig:
 
     exclude_resource_prefixes: list[str] = field(default_factory=list)
     transformations: list[ResourceTransformation] = field(default_factory=list)
+    #: "IgnoreUndeclared" skips resources no ResourceGroup covers during
+    #: quota checks instead of failing admission (gate QuotaCheckStrategy;
+    #: flavorassigner.go IgnoreUndeclaredResources)
+    quota_check_strategy: Optional[str] = None
     #: DRA: device class name -> logical resource name (KEP-2941)
     device_class_mappings: dict[str, str] = field(default_factory=dict)
 
@@ -258,6 +262,7 @@ def load(data: Optional[dict] = None) -> Configuration:
                 "transformations",
                 lambda ts: [conv_transform(t) for t in ts]),
             "deviceClassMappings": ("device_class_mappings", dict),
+            "quotaCheckStrategy": ("quota_check_strategy", str),
         })
 
     def conv_retention(d: dict) -> ObjectRetentionPolicies:

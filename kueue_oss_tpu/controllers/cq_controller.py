@@ -94,6 +94,7 @@ class ClusterQueueReconciler:
             st = CQStatus(False, R_COHORT_CYCLE,
                           f"cohort {cq.cohort} is part of a cycle")
         self.status[cq_name] = st
+        metrics.record_cq_labels(cq_name, cq.labels)
         metrics.cluster_queue_status.set(
             cq_name, "active", value=1 if st.active else 0)
         metrics.cluster_queue_status.set(

@@ -27,6 +27,17 @@ _limit_ranges: dict[str, dict[str, int]] = {}
 _requests_config_generation = 0
 
 
+def ignore_undeclared_resources() -> bool:
+    """QuotaCheckStrategy=IgnoreUndeclared honored when the gate is on
+    (flavorassigner.go:245-247 IgnoreUndeclaredResources)."""
+    from kueue_oss_tpu import features
+
+    return (features.enabled("QuotaCheckStrategy")
+            and _active_resources_config is not None
+            and getattr(_active_resources_config, "quota_check_strategy",
+                        None) == "IgnoreUndeclared")
+
+
 def requests_config_generation() -> int:
     return _requests_config_generation
 
@@ -218,8 +229,27 @@ class WorkloadInfo:
         return f"WorkloadInfo({self.key}@{self.cluster_queue})"
 
 
+#: annotation carrying an additive priority boost (reference:
+#: controllerconstants.PriorityBoostAnnotationKey; priority.go:43-60)
+PRIORITY_BOOST_ANNOTATION = "kueue.x-k8s.io/priority-boost"
+
+
 def effective_priority(wl: Workload) -> int:
-    return wl.priority
+    """Workload priority plus the PriorityBoost annotation (gated).
+
+    Invalid annotation values are rejected by the workload webhook;
+    reads treat them as 0 the way priority.go does on parse failure."""
+    from kueue_oss_tpu import features
+
+    boost = 0
+    if features.enabled("PriorityBoost"):
+        raw = wl.annotations.get(PRIORITY_BOOST_ANNOTATION, "")
+        if raw:
+            try:
+                boost = int(raw)
+            except ValueError:
+                boost = 0
+    return wl.priority + boost
 
 
 def queue_order_timestamp(wl: Workload) -> float:

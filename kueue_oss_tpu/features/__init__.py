@@ -82,6 +82,16 @@ _DEFAULTS: dict[str, bool] = {
     "PropagateBatchJobLabelsToWorkload": True,  # _create_workload
     # hashed 63-char workload names (alpha, off)
     "ShortWorkloadNames": False,       # workload_name_for
+    # priority boost annotation adds to effective priority (alpha, off)
+    "PriorityBoost": False,            # workload_info.effective_priority
+    # same-priority preemption needs a 5-minute timestamp gap (alpha)
+    "SchedulerTimestampPreemptionBuffer": False,  # preemption legality
+    # Resources.quotaCheckStrategy=IgnoreUndeclared honored (GA)
+    "QuotaCheckStrategy": True,        # flavor_assigner + solver export
+    # inadmissible requeue sweeps batch at 10s instead of 1s (alpha)
+    "SchedulerLongRequeueInterval": False,  # scheduler.serve requeue_due
+    # per-CQ/LQ label values appended to metric series (alpha, off)
+    "CustomMetricLabels": False,       # metrics custom label resolution
 }
 
 _lock = threading.Lock()

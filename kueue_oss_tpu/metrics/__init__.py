@@ -427,10 +427,66 @@ def _lq_metrics_enabled() -> bool:
     return features.enabled("LocalQueueMetrics")
 
 
+# ---------------------------------------------------------------------------
+# custom metric labels (gate CustomMetricLabels; pkg/metrics/custom_labels.go)
+# ---------------------------------------------------------------------------
+
+#: configured ClusterQueue label keys appended to per-CQ series
+_custom_cq_keys: list[str] = []
+#: cq name -> resolved label values (parallel to _custom_cq_keys)
+_custom_cq_values: dict[str, tuple[str, ...]] = {}
+
+
+def configure_custom_labels(cq_label_keys: list[str]) -> None:
+    """Extend the per-CQ admission series with values taken from each
+    ClusterQueue's object labels (reference custom_labels.go: the metric
+    vecs are rebuilt with the extended label set at config time). The
+    gate is consulted HERE, at configure time, so the series label
+    tuples and the emit-time value tuples can never disagree."""
+    from kueue_oss_tpu import features
+
+    global _custom_cq_keys
+    if not features.enabled("CustomMetricLabels"):
+        cq_label_keys = []
+    _custom_cq_keys = list(cq_label_keys)
+    _custom_cq_values.clear()
+    extra = tuple("label_" + k.replace("/", "_").replace(".", "_").
+                  replace("-", "_") for k in cq_label_keys)
+    for series in (admitted_workloads_total, admission_wait_time_seconds,
+                   quota_reserved_workloads_total,
+                   quota_reserved_wait_time_seconds):
+        base = series.labels[:1]          # ("cluster_queue",)
+        series.labels = base + extra
+
+
+def record_cq_labels(cq_name: str, labels: dict) -> None:
+    """Resolve + store a CQ's custom label values; a change clears the
+    CQ's stale series (CustomLabelStore.StoreAndClear)."""
+    if not _custom_cq_keys:
+        return
+    vals = tuple(labels.get(k, "") for k in _custom_cq_keys)
+    old = _custom_cq_values.get(cq_name)
+    if old is not None and old != vals:
+        for series in (admitted_workloads_total,
+                       admission_wait_time_seconds,
+                       quota_reserved_workloads_total,
+                       quota_reserved_wait_time_seconds):
+            series.delete_matching(cluster_queue=cq_name)
+    _custom_cq_values[cq_name] = vals
+
+
+def _cq_labels(cq: str) -> tuple:
+    if not _custom_cq_keys:
+        return (cq,)
+    return (cq,) + _custom_cq_values.get(
+        cq, ("",) * len(_custom_cq_keys))
+
+
 def admitted_workload(cq: str, wait_s: float, lq: str = "",
                       namespace: str = "default") -> None:
-    admitted_workloads_total.inc(cq)
-    admission_wait_time_seconds.observe(cq, value=max(wait_s, 0.0))
+    admitted_workloads_total.inc(*_cq_labels(cq))
+    admission_wait_time_seconds.observe(*_cq_labels(cq),
+                                        value=max(wait_s, 0.0))
     if lq and _lq_metrics_enabled():
         local_queue_admitted_workloads_total.inc(lq, namespace)
         local_queue_admission_wait_time_seconds.observe(
@@ -439,8 +495,9 @@ def admitted_workload(cq: str, wait_s: float, lq: str = "",
 
 def quota_reserved_workload(cq: str, wait_s: float, lq: str = "",
                             namespace: str = "default") -> None:
-    quota_reserved_workloads_total.inc(cq)
-    quota_reserved_wait_time_seconds.observe(cq, value=max(wait_s, 0.0))
+    quota_reserved_workloads_total.inc(*_cq_labels(cq))
+    quota_reserved_wait_time_seconds.observe(*_cq_labels(cq),
+                                             value=max(wait_s, 0.0))
     if lq and _lq_metrics_enabled():
         local_queue_quota_reserved_workloads_total.inc(lq, namespace)
         local_queue_quota_reserved_wait_time_seconds.observe(

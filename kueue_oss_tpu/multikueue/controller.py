@@ -59,9 +59,11 @@ class MultiKueueController:
             if (self.check_name in wl.status.admission_checks
                     or wl.status.cluster_name is not None
                     or wl.status.nominated_cluster_names):
+                from kueue_oss_tpu.multikueue.remote import RemoteOpError
+
                 try:
                     self.reconcile(wl, now)
-                except (ConnectionError, RuntimeError):
+                except (ConnectionError, RemoteOpError):
                     # A worker died mid-RPC (remote.RemoteWorkerError)
                     # or a worker-side op failed (e.g. the mirror was
                     # deleted concurrently): skip just this workload and

@@ -25,7 +25,11 @@ from kueue_oss_tpu.multikueue.worker import recv_msg, send_msg
 
 
 class RemoteWorkerError(ConnectionError):
-    pass
+    """Transport-level failure: the worker process is unreachable."""
+
+
+class RemoteOpError(RuntimeError):
+    """The worker processed the request and reported a failure."""
 
 
 class _Conn:
@@ -57,7 +61,7 @@ class _Conn:
                     self._sock = None
                 raise RemoteWorkerError(str(e)) from e
         if not out["ok"]:
-            raise RuntimeError(f"worker error: {out['error']}")
+            raise RemoteOpError(f"worker error: {out['error']}")
         return out["result"]
 
     def close(self) -> None:
@@ -204,9 +208,14 @@ class WorkerConfigWatcher:
             return False
         if mtime == self._mtime:
             return False
+        try:
+            with open(self.path) as f:
+                current = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            # partially-written config (non-atomic writer): leave the
+            # mtime uncommitted so the completed write is re-read
+            return False
         self._mtime = mtime
-        with open(self.path) as f:
-            current = json.load(f)
         for name, sock_path in current.items():
             if name not in self._known:
                 self.on_add(name, sock_path)

@@ -384,6 +384,14 @@ class FlavorAssigner:
                 if self.cq.rg_by_resource(res) is None:
                     if group_requests[res] == 0:
                         continue
+                    from kueue_oss_tpu.core.workload_info import (
+                        ignore_undeclared_resources,
+                    )
+
+                    if ignore_undeclared_resources():
+                        # QuotaCheckStrategy=IgnoreUndeclared: the
+                        # resource simply doesn't participate in quota
+                        continue
                     group_reasons.append(
                         f"resource {res} unavailable in ClusterQueue")
                     failed = True

@@ -66,6 +66,11 @@ class Target:
 # ---------------------------------------------------------------------------
 
 
+#: same-priority preemption timestamp gap under the gate
+#: (preemption_policy.go:30 timestampPreemptionBuffer)
+TIMESTAMP_PREEMPTION_BUFFER_S = 300.0
+
+
 def satisfies_preemption_policy(preemptor: Workload, candidate: Workload,
                                 policy: str) -> bool:
     """common/preemption_policy.go SatisfiesPreemptionPolicy."""
@@ -77,6 +82,16 @@ def satisfies_preemption_policy(preemptor: Workload, candidate: Workload,
             effective_priority(preemptor) == effective_priority(candidate)
             and queue_order_timestamp(preemptor) < queue_order_timestamp(candidate)
         )
+        from kueue_oss_tpu import features
+
+        if newer_equal and features.enabled(
+                "SchedulerTimestampPreemptionBuffer"):
+            # a marginally-newer equal-priority candidate is spared:
+            # the gap must exceed the buffer (preemption_policy.go:44)
+            newer_equal = (
+                queue_order_timestamp(candidate)
+                - queue_order_timestamp(preemptor)
+                > TIMESTAMP_PREEMPTION_BUFFER_S)
         return lower_priority or newer_equal
     return policy == PreemptionPolicyValue.ANY
 

@@ -364,15 +364,27 @@ class Scheduler:
 
         from kueue_oss_tpu.util.primitives import Backoff
 
+        from kueue_oss_tpu import features
+
         clock = clock or _time.monotonic
         backoff = backoff or Backoff(initial=0.002, cap=max(poll, 0.002),
                                      factor=2.0)
+        # requeue sweeps batch like the reference requeuer
+        # (inadmissible_workloads.go:37-47): 1s normally, 10s under
+        # SchedulerLongRequeueInterval
+        requeue_period = (10.0 if features.enabled(
+            "SchedulerLongRequeueInterval") else 1.0)
+        last_sweep = -requeue_period
         cycles = 0
         idle_rounds = 0
         while not stop.is_set():
             if not self.queues.wait_for_pending(timeout=poll):
                 # timeout: re-check stop, serve due requeues/second pass
-                self.requeue_due(clock())
+                # on the batch cadence
+                now_c = clock()
+                if now_c - last_sweep >= requeue_period:
+                    last_sweep = now_c
+                    self.requeue_due(now_c)
                 continue
             pre = self._queue_fingerprint()
             stats = self.schedule(now=clock())

@@ -84,18 +84,15 @@ class SolverEngine:
         """Whether the drain can run on-device.
 
         The full kernel covers classical preemption, multiple resource
-        groups, and fair sharing (DRS tournament + S2-a/S2-b). Still
-        host-only: admission fair sharing (LocalQueue-usage queue
-        ordering). TAS shapes are rejected at export
-        (UnsupportedProblem).
+        groups, fair sharing (DRS tournament + S2-a/S2-b), and
+        admission fair sharing (KEP-4136: penalty-ordered head
+        selection with entry penalties charged on admission). TAS
+        shapes are rejected at export (UnsupportedProblem).
         """
-        for cq in self.store.cluster_queues.values():
-            if cq.admission_scope is not None:
-                return False
         return True
 
     def needs_full_kernel(self) -> bool:
-        """Preemption, multi-RG, or fair-sharing shapes run the
+        """Preemption, multi-RG, fair-sharing, or AFS shapes run the
         unified-axis kernel; the lean fit-only kernel stays for the
         uncontended classical case."""
         if self.enable_fair_sharing:
@@ -105,15 +102,46 @@ class SolverEngine:
                 return True
             if len(cq.resource_groups) > 1:
                 return True
+            if (cq.admission_scope is not None
+                    and self.queues.afs is not None):
+                return True
+        return False
+
+    def _is_tas_cq(self, cq_name: str) -> bool:
+        """Any flavor with a Topology makes admissions TAS-placed (explicit
+        or implied requests — flavor_assigner workload_topology_requests);
+        those need the host tree, so the solver leaves them pending."""
+        spec = self.store.cluster_queues.get(cq_name)
+        if spec is None:
+            return False
+        for rg in spec.resource_groups:
+            for fq in rg.flavors:
+                fl = self.store.resource_flavors.get(fq.name)
+                if fl is not None and fl.topology_name is not None:
+                    return True
         return False
 
     def pending_backlog(self) -> dict[str, list[WorkloadInfo]]:
-        """Current heap contents per CQ in rank (pop) order."""
+        """Current heap contents per CQ in rank (pop) order.
+
+        TAS-shaped workloads (explicit topology requests, podset groups,
+        or any CQ whose flavors carry a Topology) are excluded: the
+        kernel admits without computing topology assignments, so those
+        stay in their heaps for the host scheduler's mop-up cycles
+        (Scheduler.run_until_quiet after _solver_drain), which run the
+        full TAS machinery."""
         out: dict[str, list[WorkloadInfo]] = {}
         for name, q in self.queues.queues.items():
             if not q.active:
                 continue
             infos = q.snapshot_order()
+            if not infos:
+                continue
+            if self._is_tas_cq(name):
+                continue
+            infos = [i for i in infos
+                     if all(ps.topology_request is None
+                            for ps in i.obj.podsets)]
             if infos:
                 out[name] = infos
         return out
@@ -189,9 +217,14 @@ class SolverEngine:
             cq_name = problem.cq_names[problem.wl_cqid[w]]
             flavor = problem.cq_option_flavors[cq_name][opt[w]]
             info = WorkloadInfo(wl, cluster_queue=cq_name)
+            declared = {r for rg in
+                        self.store.cluster_queues[cq_name].resource_groups
+                        for r in rg.covered_resources}
             plan_usage: dict[tuple[str, str], int] = {}
             for psr in info.total_requests:
                 for r, q in psr.requests.items():
+                    if r not in declared:
+                        continue  # QuotaCheckStrategy=IgnoreUndeclared
                     fr = (flavor, r)
                     plan_usage[fr] = plan_usage.get(fr, 0) + q
             candidates.append((wl, cq_name, flavor, info, plan_usage))
@@ -316,10 +349,16 @@ class SolverEngine:
         pending = self.pending_backlog()
         parked_map: dict[str, list[WorkloadInfo]] = {}
         for name, q in self.queues.queues.items():
-            if q.inadmissible:
-                parked_map[name] = list(q.inadmissible.values())
+            if not q.inadmissible or self._is_tas_cq(name):
+                continue
+            infos = [i for i in q.inadmissible.values()
+                     if all(ps.topology_request is None
+                            for ps in i.obj.podsets)]
+            if infos:
+                parked_map[name] = infos
         problem = export_problem(self.store, pending,
-                                 include_admitted=True, parked=parked_map)
+                                 include_admitted=True, parked=parked_map,
+                                 afs=self.queues.afs, now=now)
         if problem.n_workloads == 0:
             return result
         g_max = int(problem.cq_ngroups.max())
@@ -425,6 +464,8 @@ class SolverEngine:
             plan_usage: dict[tuple[str, str], int] = {}
             for psr in info.total_requests:
                 for r, q in psr.requests.items():
+                    if r not in flavor_of:
+                        continue  # QuotaCheckStrategy=IgnoreUndeclared
                     fr = (flavor_of[r], r)
                     plan_usage[fr] = plan_usage.get(fr, 0) + q
             candidates.append((wl, cq_name, flavor_of, info, plan_usage))
@@ -462,7 +503,10 @@ class SolverEngine:
             podset_assignments=[
                 PodSetAssignment(
                     name=psr.name,
-                    flavors={r: flavor_of[r] for r in psr.requests},
+                    # undeclared resources carry no flavor under
+                    # QuotaCheckStrategy=IgnoreUndeclared
+                    flavors={r: flavor_of[r] for r in psr.requests
+                             if r in flavor_of},
                     resource_usage=dict(psr.requests),
                     count=psr.count,
                 )
@@ -488,6 +532,18 @@ class SolverEngine:
                              reason="Admitted", now=now)
         self.store.update_workload(wl)
         self.queues.queues[cq_name].delete(key)
+        if (self.queues.afs is not None
+                and cq_spec.admission_scope is not None
+                and cq_spec.admission_scope.admission_mode
+                == "UsageBasedAdmissionFairSharing"):
+            # keep the host AfsManager in sync with the plan's entry
+            # penalties (scheduler._admit record_admission hook)
+            by_resource: dict[str, int] = {}
+            for psr in info.total_requests:
+                for r, q in psr.requests.items():
+                    by_resource[r] = by_resource.get(r, 0) + q
+            self.queues.afs.record_admission(
+                f"{wl.namespace}/{wl.queue_name}", by_resource, now)
         metrics.quota_reserved_workload(cq_name, now - wl.creation_time,
                                         lq=wl.queue_name,
                                         namespace=wl.namespace)

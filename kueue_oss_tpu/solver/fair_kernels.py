@@ -173,7 +173,9 @@ def fair_search(t, lendable_r, usage0_round, wl_usage, admitted, evicted_f,
     ts_p = ts[head_w]
     prio_c = t.wl_prio[cands]
     lower = prio_p > prio_c
-    newer_eq = (prio_p == prio_c) & (ts_p < ts[cands])
+    buf_p = jnp.where(ts_p >= t.ts_evict_base, BIG,
+                      t.wl_ts_buf[head_w])
+    newer_eq = (prio_p == prio_c) & (ts[cands] > buf_p)
 
     def sat(policy):
         return jnp.where(

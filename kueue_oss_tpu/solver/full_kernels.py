@@ -123,7 +123,12 @@ class FullTensors(NamedTuple):
     res_onehot: jnp.ndarray      # [F, R] int32 one-hot of fr_resource
     node_fair_weight: jnp.ndarray  # [N+1] float32
     wl_class: jnp.ndarray        # [W+1] int32 scheduling-equivalence class
-    class_root: jnp.ndarray      # [n_classes+1] int32 cohort root node
+    class_root: jnp.ndarray      # [n_classes+1] int32
+    wl_lq: jnp.ndarray           # [W+1] int32 dense LQ id (AFS)
+    wl_ts_buf: jnp.ndarray       # [W+1] int32 newer-eq threshold rank
+    wl_afs_penalty: jnp.ndarray  # [W+1] float32 admission penalty inc
+    lq_penalty0: jnp.ndarray     # [L+1] float32 decayed start penalties
+    cq_afs: jnp.ndarray          # [C] bool UsageBasedAdmissionFairSharing
     ts_evict_base: jnp.ndarray   # scalar int32
     admit_rank_base: jnp.ndarray  # scalar int32
 
@@ -189,6 +194,18 @@ def to_device_full(p: SolverProblem) -> FullTensors:
         node_fair_weight=jnp.asarray(p.node_fair_weight),
         wl_class=jnp.asarray(p.wl_class),
         class_root=jnp.asarray(p.class_root),
+        wl_lq=jnp.asarray(p.wl_lq if p.wl_lq is not None
+                          else np.zeros(p.wl_cqid.shape[0], np.int32)),
+        wl_ts_buf=jnp.asarray(p.wl_ts_buf if p.wl_ts_buf is not None
+                              else p.wl_ts),
+        wl_afs_penalty=jnp.asarray(
+            p.wl_afs_penalty if p.wl_afs_penalty is not None
+            else np.zeros(p.wl_cqid.shape[0], np.float32)),
+        lq_penalty0=jnp.asarray(
+            p.lq_penalty0 if p.lq_penalty0 is not None
+            else np.zeros(1, np.float32)),
+        cq_afs=jnp.asarray(p.cq_afs if p.cq_afs is not None
+                           else np.zeros(p.cq_node.shape[0], bool)),
         ts_evict_base=jnp.asarray(p.ts_evict_base, dtype=jnp.int32),
         admit_rank_base=jnp.asarray(p.admit_rank_base, dtype=jnp.int32),
     )
@@ -250,15 +267,31 @@ def _height_along_path(t, usage, cq_node, req):
 # ---------------------------------------------------------------------------
 
 
-def select_heads_full(t: FullTensors, admitted, parked, ts):
+def select_heads_full(t: FullTensors, admitted, parked, ts,
+                      lq_penalty=None):
     C = t.cq_node.shape[0]
     W1 = t.wl_cqid.shape[0]
     W_null = W1 - 1
     pending = ~admitted & ~parked
     seg = t.wl_cqid[:-1]
-    prio_eff = jnp.where(pending[:-1], t.wl_prio[:-1], -BIG)
+    if lq_penalty is not None:
+        # Admission fair sharing (KEP-4136): within a
+        # UsageBasedAdmissionFairSharing CQ the head is the entry whose
+        # LocalQueue carries the lowest decayed usage; the normal
+        # (priority, ts, uid) order is the tie-break
+        # (queue_manager.pop_head afs_key).
+        is_afs = t.cq_afs[jnp.minimum(seg, C - 1)]
+        pen = lq_penalty[t.wl_lq[:-1]]
+        pen_eff = jnp.where(pending[:-1] & is_afs, pen, jnp.inf)
+        min_pen = jax.ops.segment_min(pen_eff, seg,
+                                      num_segments=C + 1)[:C]
+        pending_head = pending[:-1] & (
+            ~is_afs | (pen == min_pen[seg]))
+    else:
+        pending_head = pending[:-1]
+    prio_eff = jnp.where(pending_head, t.wl_prio[:-1], -BIG)
     max_prio = jax.ops.segment_max(prio_eff, seg, num_segments=C + 1)[:C]
-    c1 = pending[:-1] & (t.wl_prio[:-1] == max_prio[seg])
+    c1 = pending_head & (t.wl_prio[:-1] == max_prio[seg])
     ts_eff = jnp.where(c1, ts[:-1], BIG)
     min_ts = jax.ops.segment_min(ts_eff, seg, num_segments=C + 1)[:C]
     c2 = c1 & (ts[:-1] == min_ts[seg])
@@ -561,7 +594,13 @@ def classical_search(t: FullTensors, usage0_round, wl_usage, admitted,
     ts_p = ts[head_w]
     prio_c = t.wl_prio[cands]
     lower = prio_p > prio_c
-    newer_eq = (prio_p == prio_c) & (ts_p < ts[cands])
+    # newer-equal: candidate rank beyond the preemptor's threshold
+    # (wl_ts_buf == own rank normally; the last within-buffer rank under
+    # SchedulerTimestampPreemptionBuffer). An in-drain-evicted preemptor
+    # (ts re-stamped past ts_evict_base) was evicted "now", so nothing
+    # pending can be newer by more than the buffer.
+    buf_p = jnp.where(ts_p >= t.ts_evict_base, BIG, t.wl_ts_buf[head_w])
+    newer_eq = (prio_p == prio_c) & (ts[cands] > buf_p)
     policy = jnp.where(same_cq, t.cq_within_policy[cqi],
                        t.cq_reclaim_policy[cqi])
     sat = jnp.where(
@@ -831,7 +870,7 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
 
     def step(carry, slot):
         (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
-         victims_all, victim_reason, any_adm, any_evict) = carry
+         victims_all, victim_reason, lq_pen, any_adm, any_evict) = carry
         w, cqid, m, req, brw, lane = slot
         cq_node = t.cq_node[jnp.minimum(cqid, C - 1)]
         is_active = (w != W_null) & (m != M_NOFIT)
@@ -915,14 +954,20 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
         admitted = admitted.at[w].set(admitted[w] | do_admit)
         wl_usage = wl_usage.at[w].set(
             jnp.where(do_admit, req, wl_usage[w]))
+        # AFS entry penalty: charge the admitted usage to the LocalQueue
+        # (afs/entry_penalties.go; scheduler record_admission hook)
+        afs_cq = t.cq_afs[jnp.minimum(cqid, C - 1)]
+        lq_pen = lq_pen.at[t.wl_lq[w]].add(
+            jnp.where(do_admit & afs_cq, t.wl_afs_penalty[w], 0.0))
         any_adm = any_adm | do_admit
         return (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
-                victims_all, victim_reason, any_adm, any_evict), (
+                victims_all, victim_reason, lq_pen, any_adm, any_evict), (
             do_admit, do_preempt)
 
     init = (state["usage_full"], state["usage_net"], state["cq_rows"],
             state["admitted"], state["parked"], state["wl_usage"],
             state["victims_all"], state["victim_reason"],
+            state["lq_penalty"],
             jnp.zeros((), dtype=bool), jnp.zeros((), dtype=bool))
 
     if not fs_enabled:
@@ -930,7 +975,7 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
                  mode[order], req_c[order], borrow[order],
                  lane_of_entry[order])
         (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
-         victims_all, victim_reason, any_adm, any_evict), (
+         victims_all, victim_reason, lq_pen, any_adm, any_evict), (
             admitted_slot, preempted_slot) = (
             jax.lax.scan(step, init, slots))
         # map per-slot flags back to entry order
@@ -966,13 +1011,13 @@ def full_round_scan(t: FullTensors, state, cand_w, mode, k_chosen, req_c,
         (inner, _act, adm_entry, pre_entry, _i) = jax.lax.while_loop(
             fs_cond, fs_body, fs_init)
         (usage_full, usage_net, cq_rows, admitted, parked, wl_usage,
-         victims_all, victim_reason, any_adm, any_evict) = inner
+         victims_all, victim_reason, lq_pen, any_adm, any_evict) = inner
 
     return {
         "usage_full": usage_full, "usage_net": usage_net,
         "cq_rows": cq_rows, "admitted": admitted, "parked": parked,
         "wl_usage": wl_usage, "victims_all": victims_all,
-        "victim_reason": victim_reason,
+        "victim_reason": victim_reason, "lq_penalty": lq_pen,
     }, adm_entry, pre_entry, any_adm, any_evict
 
 
@@ -1077,7 +1122,8 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
     parked_before = parked
     cursor_before = state["cursor"]
 
-    cand_w = select_heads_full(t, admitted, parked, ts)
+    cand_w = select_heads_full(t, admitted, parked, ts,
+                               lq_penalty=state["lq_penalty"])
     avail = available_all(t, usage)
     (mode, k_chosen, req_c, borrow, next_cursor,
      opt_fit, opt_preempt, opt_level, group_active, opt_valid) = (
@@ -1207,6 +1253,7 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
         "parked": parked, "wl_usage": wl_usage,
         "victims_all": jnp.zeros((W1,), dtype=bool),
         "victim_reason": state["victim_reason"], "ts": ts,
+        "lq_penalty": state["lq_penalty"],
     }
     out, adm_entry, pre_entry, any_adm, any_evict = full_round_scan(
         t, scan_state, cand_w, mode, k_chosen, req_c, borrow,
@@ -1286,7 +1333,8 @@ def round_body(t: FullTensors, state, pot, g_max: int, h_max: int,
         "evicted": evicted_f, "admit_rank": admit_rank,
         "wl_usage": wl_usage, "cursor": cursor, "opt": opt,
         "admit_round": admit_round, "class_nofit": class_nofit,
-        "victim_reason": out["victim_reason"], "progress": progress,
+        "victim_reason": out["victim_reason"],
+        "lq_penalty": out["lq_penalty"], "progress": progress,
         "rounds": rounds + 1,
     }
     debug = {
@@ -1313,6 +1361,7 @@ def _init_state(t: FullTensors, g_max: int):
         "opt": jnp.zeros((W1, g_max), dtype=jnp.int32),
         "admit_round": jnp.full((W1,), -1, dtype=jnp.int32),
         "victim_reason": jnp.zeros((W1,), dtype=jnp.int8),
+        "lq_penalty": t.lq_penalty0,
         "class_nofit": jnp.zeros((t.class_root.shape[0],), dtype=bool),
         "progress": jnp.ones((), dtype=bool),
         "rounds": jnp.zeros((), dtype=jnp.int32),

@@ -18,9 +18,15 @@ jitted JAX:
   chosen best-fit — a sort + prefix-sum + two segment reductions.
   The descent applies the same rule per sibling group at every level.
 
-Scope: single podset, BestFit profile, no slices/leaders (the host tree
-handles those shapes). Parity-tested against tas/snapshot.py in
-tests/test_tas_kernel.py.
+Scope: the base placer (make_placer) covers single podsets under the
+BestFit / LeastFreeCapacity profiles; the extended placer
+(make_placer_ext) adds single-layer podset slices and a count-1 leader
+podset, both parity-tested against the host tree
+(tests/test_tas_kernel.py, tests/test_tas_kernel_ext.py). Still
+host-only by design: balanced placement (its selectOptimalDomainSetToFit
+is a dict-memoized DP over (leaders, capacity) states whose tie-breaks
+resist an exact dense-tensor port — tas_balanced_placement.go:1-382) and
+nested multi-layer slice constraints.
 
 Reference parity: pkg/cache/scheduler/tas_flavor_snapshot.go (two-phase
 algorithm); SURVEY.md §7 step 6 calls this the most TPU-friendly
@@ -248,7 +254,6 @@ def make_sequential_placer_ext(parents_np: list[np.ndarray]):
     all-zero requests places identically to place_podset_ext). The
     capacity carry subtracts worker pods AND the leader's row."""
     place = make_placer_ext(parents_np)
-    n_levels = len(parents_np)
 
     @jax.jit
     def place_all(leaf_capacity, per_pod, count, level, required,

@@ -135,6 +135,18 @@ class SolverProblem:
     wl_class: Optional[np.ndarray] = None           # [W+1] int32
     class_root: Optional[np.ndarray] = None         # [n_classes+1] int32
     n_classes: int = 0
+    #: admission fair sharing (KEP-4136): per-workload dense LocalQueue
+    #: id + scalarized penalty increment, per-LQ decayed starting
+    #: penalty, per-CQ UsageBasedAdmissionFairSharing flag
+    wl_lq: Optional[np.ndarray] = None              # [W+1] int32
+    wl_afs_penalty: Optional[np.ndarray] = None     # [W+1] float32
+    #: newer-equal preemption threshold rank: a candidate satisfies the
+    #: LowerOrNewerEqualPriority timestamp test iff its ts rank exceeds
+    #: this (own rank normally; under SchedulerTimestampPreemptionBuffer
+    #: the rank of the last distinct timestamp within the 5-min buffer)
+    wl_ts_buf: Optional[np.ndarray] = None          # [W+1] int32
+    lq_penalty0: Optional[np.ndarray] = None        # [L+1] float32
+    cq_afs: Optional[np.ndarray] = None             # [C] bool
     n_resources: int = 1
     #: timestamp rank assigned to round-r evictions: ts_evict_base + r
     ts_evict_base: int = 0
@@ -205,6 +217,9 @@ def pad_workloads(problem: SolverProblem, target_w: int) -> SolverProblem:
         wl_admit_rank=pad1(problem.wl_admit_rank, 0),
         ad_usage=pad1(problem.ad_usage, 0),
         wl_class=pad1(problem.wl_class, problem.n_classes),
+        wl_lq=pad1(problem.wl_lq, 0),
+        wl_afs_penalty=pad1(problem.wl_afs_penalty, 0.0),
+        wl_ts_buf=pad1(problem.wl_ts_buf, 0),
         wl_keys=list(problem.wl_keys) + [""] * pad,
     )
 
@@ -225,6 +240,8 @@ def export_problem(
     snapshot: Optional[Snapshot] = None,
     include_admitted: bool = False,
     parked: Optional[dict[str, list[WorkloadInfo]]] = None,
+    afs=None,
+    now: float = 0.0,
 ) -> SolverProblem:
     """Build a SolverProblem from the store and the pending backlog.
 
@@ -463,13 +480,29 @@ def export_problem(
     # for entry sorting, and float32 would collapse epoch-scale values
     # less than ~128s apart (ties must stay ties for the uid tiebreak).
     raw_ts = [queue_order_timestamp(i.obj) for i in all_infos]
-    ts_rank = {ts: r for r, ts in enumerate(sorted(set(raw_ts)))}
+    distinct_ts = sorted(set(raw_ts))
+    ts_rank = {ts: r for r, ts in enumerate(distinct_ts)}
     raw_admit = [quota_reservation_time(i.obj, 0.0) for i in admitted_infos]
     admit_rank = {ts: r + 1 for r, ts in enumerate(sorted(set(raw_admit)))}
 
+    import bisect
+
+    from kueue_oss_tpu import features as _features
+    from kueue_oss_tpu.scheduler.preemption import (
+        TIMESTAMP_PREEMPTION_BUFFER_S,
+    )
+
+    ts_buffered = _features.enabled("SchedulerTimestampPreemptionBuffer")
+    wl_ts_buf = np.zeros(W + 1, dtype=np.int32)
     for w, info in enumerate(all_infos):
         wl_prio[w] = effective_priority(info.obj)
         wl_ts[w] = ts_rank[raw_ts[w]]
+        if ts_buffered:
+            wl_ts_buf[w] = bisect.bisect_right(
+                distinct_ts,
+                raw_ts[w] + TIMESTAMP_PREEMPTION_BUFFER_S) - 1
+        else:
+            wl_ts_buf[w] = wl_ts[w]
         wl_uid[w] = info.obj.uid
         wl_evicted0[w] = info.obj.is_evicted
         if w >= n_pending:
@@ -496,9 +529,17 @@ def export_problem(
         covered = {r for rg in spec.resource_groups
                    for r in rg.covered_resources}
         if any(q > 0 and r not in covered for r, q in totals.items()):
-            # Undeclared resource: no option can ever fit; leave all
-            # options invalid so the solver parks it (oracle parity).
-            continue
+            from kueue_oss_tpu.core.workload_info import (
+                ignore_undeclared_resources,
+            )
+
+            if not ignore_undeclared_resources():
+                # Undeclared resource: no option can ever fit; leave all
+                # options invalid so the solver parks it (oracle parity).
+                # Under QuotaCheckStrategy=IgnoreUndeclared the resource
+                # simply doesn't participate in quota (wl_req only ever
+                # carries declared (flavor, resource) columns).
+                continue
         k = -1
         for g, rg in enumerate(spec.resource_groups):
             allowed_keys = frozenset(
@@ -551,6 +592,45 @@ def export_problem(
     for i, n in enumerate(nodes):
         node_fair_weight[i] = n.fair_weight
 
+    # ---- admission fair sharing (KEP-4136): dense LQ ids + penalties ----
+    # Only UsageBasedAdmissionFairSharing CQs participate; the penalty
+    # increment is flavor-independent (requests are per-resource), so it
+    # exports as one scalar per workload (afs/entry_penalties.go).
+    wl_lq = np.zeros(W + 1, dtype=np.int32)
+    wl_afs_penalty = np.zeros(W + 1, dtype=np.float32)
+    cq_afs = np.zeros(C, dtype=bool)
+    lq_pen_list: list[float] = [0.0]
+    if afs is not None:
+        lq_index: dict[str, int] = {}
+        for cid, name in enumerate(cq_names):
+            scope = store.cluster_queues[name].admission_scope
+            cq_afs[cid] = (
+                scope is not None
+                and scope.admission_mode == "UsageBasedAdmissionFairSharing")
+        weights = afs.config.resource_weights
+        from kueue_oss_tpu.core.afs import _DEFAULT_WEIGHT
+
+        for w, info in enumerate(all_infos):
+            cid = cq_id[info.cluster_queue]
+            if not cq_afs[cid]:
+                continue
+            wl = info.obj
+            lq_key = f"{wl.namespace}/{wl.queue_name}"
+            li = lq_index.get(lq_key)
+            if li is None:
+                li = len(lq_pen_list)
+                lq_index[lq_key] = li
+                lq_pen_list.append(float(afs.weighted_usage(lq_key, now)))
+            wl_lq[w] = li
+            total = 0.0
+            for psr in info.total_requests:
+                for r, q in psr.requests.items():
+                    total += weights.get(r, _DEFAULT_WEIGHT) * q
+            lq_w = afs.lq_weights.get(lq_key, 1.0)
+            wl_afs_penalty[w] = (total / lq_w if lq_w > 0
+                                 else np.float32(np.inf))
+    lq_penalty0 = np.asarray(lq_pen_list, dtype=np.float32)
+
     return SolverProblem(
         parent=parent,
         depth=depth,
@@ -596,6 +676,11 @@ def export_problem(
         wl_class=wl_class,
         class_root=class_root,
         n_classes=n_classes,
+        wl_lq=wl_lq,
+        wl_afs_penalty=wl_afs_penalty,
+        wl_ts_buf=wl_ts_buf,
+        lq_penalty0=lq_penalty0,
+        cq_afs=cq_afs,
         n_resources=len(resources),
         ts_evict_base=len(ts_rank) + 1,
         admit_rank_base=len(admit_rank) + 2,

@@ -140,3 +140,68 @@ def test_no_admission_scope_keeps_fifo():
     env.submit("b1", "lq-b")
     order = admitted_order(env, 2)
     assert order == ["default/a1", "default/b1"], "FIFO without AFS scope"
+
+
+# ---------------------------------------------------------------------------
+# device drain parity (solver/engine + full kernel AFS head selection)
+# ---------------------------------------------------------------------------
+
+
+def _drain_env(env):
+    from kueue_oss_tpu.solver.engine import SolverEngine
+
+    eng = SolverEngine(env.store, env.queues)
+    assert eng.supported() and eng.needs_full_kernel()
+    env.t += 1.0
+    eng.drain(now=env.t)
+    return {k for k, w in env.store.workloads.items()
+            if w.is_quota_reserved}
+
+
+def test_device_drain_prefers_lighter_local_queue():
+    """Engine drain reproduces the AFS head order: the LQ with the
+    lowest decayed usage admits first even against older FIFO entries."""
+    env = Env(nominal=2000)
+    env.afs.record_admission("default/lq-a", {"cpu": 5000}, now=0.0)
+    for name, lq in [("a1", "lq-a"), ("a2", "lq-a"),
+                     ("b1", "lq-b"), ("b2", "lq-b")]:
+        env.submit(name, lq)
+    adm = _drain_env(env)
+    assert adm == {"default/b1", "default/b2"}, adm
+
+
+def test_device_drain_entry_penalty_alternates():
+    """Equal starting usage + capacity for two: the drain's entry
+    penalties alternate the admissions across LocalQueues."""
+    env = Env(nominal=2000)
+    for i in range(3):
+        env.submit(f"a{i}", "lq-a")
+    for i in range(3):
+        env.submit(f"b{i}", "lq-b")
+    adm = _drain_env(env)
+    assert len(adm) == 2
+    lqs = {env.store.workloads[k].queue_name for k in adm}
+    assert lqs == {"lq-a", "lq-b"}, adm
+
+
+def test_device_drain_matches_host_afs():
+    def build():
+        env = Env(nominal=2000)
+        env.afs.record_admission("default/lq-a", {"cpu": 1500}, now=0.0)
+        for i in range(3):
+            env.submit(f"a{i}", "lq-a")
+        for i in range(3):
+            env.submit(f"b{i}", "lq-b")
+        return env
+
+    env_h = build()
+    for _ in range(10):
+        env_h.run_cycle()
+    adm_h = {k for k, w in env_h.store.workloads.items()
+             if w.is_quota_reserved}
+    env_k = build()
+    adm_k = _drain_env(env_k)
+    assert adm_k == adm_h, (adm_k, adm_h)
+    # host AfsManager stays in sync: the committed admissions carried
+    # their entry penalties
+    assert env_k.afs.weighted_usage("default/lq-b", env_k.t) > 0

@@ -1,0 +1,236 @@
+"""Gate-guarded behaviors added in round 4: PriorityBoost,
+SchedulerTimestampPreemptionBuffer, QuotaCheckStrategy,
+SchedulerLongRequeueInterval, CustomMetricLabels."""
+
+import pytest
+
+from kueue_oss_tpu import features, metrics
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    PreemptionPolicyValue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.core.workload_info import (
+    PRIORITY_BOOST_ANNOTATION,
+    effective_priority,
+)
+from kueue_oss_tpu.scheduler.preemption import satisfies_preemption_policy
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+@pytest.fixture(autouse=True)
+def _reset_gates():
+    yield
+    features.reset()
+    from kueue_oss_tpu.core.workload_info import set_resources_config
+
+    set_resources_config(None)
+
+
+def test_priority_boost_annotation_gated():
+    wl = Workload(name="w", annotations={PRIORITY_BOOST_ANNOTATION: "7"},
+                  priority=10)
+    assert effective_priority(wl) == 10          # gate off: ignored
+    features.set_gates({"PriorityBoost": True})
+    assert effective_priority(wl) == 17
+    wl.annotations[PRIORITY_BOOST_ANNOTATION] = "garbage"
+    assert effective_priority(wl) == 10          # parse failure -> 0
+
+
+def test_timestamp_preemption_buffer():
+    pre = Workload(name="p", priority=5, creation_time=0.0)
+    cand_close = Workload(name="c1", priority=5, creation_time=100.0)
+    cand_far = Workload(name="c2", priority=5, creation_time=400.0)
+    pol = PreemptionPolicyValue.LOWER_OR_NEWER_EQUAL_PRIORITY
+    assert satisfies_preemption_policy(pre, cand_close, pol)
+    assert satisfies_preemption_policy(pre, cand_far, pol)
+    features.set_gates({"SchedulerTimestampPreemptionBuffer": True})
+    # within the 5-minute buffer the marginally-newer candidate is spared
+    assert not satisfies_preemption_policy(pre, cand_close, pol)
+    assert satisfies_preemption_policy(pre, cand_far, pol)
+
+
+def _buffered_store():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="f"))
+    store.upsert_cohort(Cohort(name="co"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", cohort="co",
+        preemption=PreemptionPolicy(
+            within_cluster_queue=(
+                PreemptionPolicyValue.LOWER_OR_NEWER_EQUAL_PRIORITY)),
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="f", resources=[
+                ResourceQuota(name="cpu", nominal=1000)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    return store
+
+
+@pytest.mark.parametrize("gap,expect_preempted", [(100.0, False),
+                                                  (400.0, True)])
+def test_timestamp_buffer_kernel_parity(gap, expect_preempted):
+    """The device drain honors the buffered newer-equal legality the
+    same way the host does (wl_ts_buf threshold ranks)."""
+    features.set_gates({"SchedulerTimestampPreemptionBuffer": True})
+
+    def build():
+        store = _buffered_store()
+        store.add_workload(Workload(
+            name="old", queue_name="lq", priority=5, uid=1,
+            creation_time=0.0,
+            podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+        return store
+
+    # host
+    store_h = build()
+    queues_h = QueueManager(store_h)
+    sched = Scheduler(store_h, queues_h)
+    sched.run_until_quiet(now=1.0, tick=1.0)
+    assert store_h.workloads["default/old"].is_quota_reserved
+    store_h.add_workload(Workload(
+        name="newcomer", queue_name="lq", priority=5, uid=2,
+        creation_time=-gap,  # OLDER than "old" by gap seconds
+        podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+    sched.run_until_quiet(now=2.0, tick=1.0)
+    host_preempted = not store_h.workloads["default/old"].is_quota_reserved
+
+    # kernel
+    from kueue_oss_tpu.solver.engine import SolverEngine
+
+    store_k = build()
+    queues_k = QueueManager(store_k)
+    sched_k = Scheduler(store_k, queues_k)
+    sched_k.run_until_quiet(now=1.0, tick=1.0)
+    store_k.add_workload(Workload(
+        name="newcomer", queue_name="lq", priority=5, uid=2,
+        creation_time=-gap,
+        podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+    SolverEngine(store_k, queues_k).drain(now=2.0)
+    kernel_preempted = not store_k.workloads["default/old"].is_quota_reserved
+
+    assert host_preempted == kernel_preempted == expect_preempted
+
+
+def test_quota_check_strategy_ignore_undeclared():
+    from kueue_oss_tpu.config.configuration import ResourcesConfig
+    from kueue_oss_tpu.core.workload_info import set_resources_config
+
+    def build():
+        store = Store()
+        store.upsert_resource_flavor(ResourceFlavor(name="f"))
+        store.upsert_cluster_queue(ClusterQueue(
+            name="cq", resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f", resources=[
+                    ResourceQuota(name="cpu", nominal=1000)])])]))
+        store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        store.add_workload(Workload(
+            name="w", queue_name="lq", uid=1,
+            podsets=[PodSet(count=1, requests={
+                "cpu": 500, "vendor.com/fpga": 2})]))
+        return store
+
+    # default: undeclared resource blocks admission
+    store = build()
+    queues = QueueManager(store)
+    Scheduler(store, queues).run_until_quiet(now=1.0, tick=1.0)
+    assert not store.workloads["default/w"].is_quota_reserved
+
+    # IgnoreUndeclared: the resource is skipped during quota checks
+    set_resources_config(ResourcesConfig(
+        quota_check_strategy="IgnoreUndeclared"))
+    store = build()
+    queues = QueueManager(store)
+    Scheduler(store, queues).run_until_quiet(now=1.0, tick=1.0)
+    assert store.workloads["default/w"].is_quota_reserved
+
+    # solver path agrees
+    from kueue_oss_tpu.solver.engine import SolverEngine
+
+    store = build()
+    queues = QueueManager(store)
+    SolverEngine(store, queues).drain(now=1.0)
+    assert store.workloads["default/w"].is_quota_reserved
+
+    # gate off: config alone does not change behavior
+    features.set_gates({"QuotaCheckStrategy": False})
+    store = build()
+    queues = QueueManager(store)
+    Scheduler(store, queues).run_until_quiet(now=1.0, tick=1.0)
+    assert not store.workloads["default/w"].is_quota_reserved
+
+
+def test_long_requeue_interval_batches_sweeps():
+    import threading
+
+    store = _buffered_store()
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    sweeps = []
+    orig = sched.requeue_due
+
+    def spy(now):
+        sweeps.append(now)
+        return orig(now)
+
+    sched.requeue_due = spy
+    features.set_gates({"SchedulerLongRequeueInterval": True})
+    stop = threading.Event()
+    clock_val = [0.0]
+
+    def clock():
+        clock_val[0] += 0.5
+        if clock_val[0] > 40.0:
+            stop.set()
+        return clock_val[0]
+
+    sched.serve(stop, poll=0.001, clock=clock)
+    # ~40 simulated seconds of idling: 10s batches -> <= 5 sweeps
+    assert 0 < len(sweeps) <= 5, sweeps
+
+
+def test_custom_metric_labels():
+    from kueue_oss_tpu.controllers.cq_controller import (
+        ClusterQueueReconciler,
+    )
+
+    features.set_gates({"CustomMetricLabels": True})
+    metrics.configure_custom_labels(["team"])
+    try:
+        store = Store()
+        store.upsert_resource_flavor(ResourceFlavor(name="f"))
+        store.upsert_cluster_queue(ClusterQueue(
+            name="cq", labels={"team": "ml"},
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f", resources=[
+                    ResourceQuota(name="cpu", nominal=1000)])])]))
+        store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        queues = QueueManager(store)
+        ClusterQueueReconciler(store, queues=queues).reconcile_all()
+        store.add_workload(Workload(
+            name="w", queue_name="lq", uid=1,
+            podsets=[PodSet(count=1, requests={"cpu": 100})]))
+        Scheduler(store, queues).run_until_quiet(now=1.0, tick=1.0)
+        assert metrics.admitted_workloads_total.value("cq", "ml") == 1.0
+        rendered = metrics.registry.render()
+        assert 'label_team="ml"' in rendered
+        # label change clears the stale series
+        cq = store.cluster_queues["cq"]
+        cq.labels["team"] = "infra"
+        store.upsert_cluster_queue(cq)
+        ClusterQueueReconciler(store, queues=queues).reconcile_all()
+        assert metrics.admitted_workloads_total.value("cq", "ml") == 0.0
+    finally:
+        metrics.configure_custom_labels([])

@@ -1,0 +1,111 @@
+"""Mixed TAS + non-TAS stores under the solver backend.
+
+A store with TAS-flavored ClusterQueues no longer disables the device
+drain wholesale: the engine exports only the non-TAS backlog (TAS
+admissions need topology assignments the kernel does not compute) and
+the host mop-up cycles after the drain place the TAS workloads through
+the full tree machinery (Scheduler.run_until_quiet solver+host
+contract; reference: the scheduler's updateAssignmentForTAS path,
+scheduler.go:759-783).
+"""
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    Node,
+    PodSet,
+    PodSetTopologyRequest,
+    PreemptionPolicy,
+    PreemptionPolicyValue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+HOST = "kubernetes.io/hostname"
+RACK = "cloud/rack"
+
+
+def _mixed_store():
+    store = Store()
+    store.upsert_topology(Topology(name="default", levels=[RACK, HOST]))
+    store.upsert_resource_flavor(ResourceFlavor(
+        name="tas-flavor", topology_name="default"))
+    store.upsert_resource_flavor(ResourceFlavor(name="plain"))
+    for r in range(2):
+        for h in range(2):
+            store.upsert_node(Node(
+                name=f"n-{r}-{h}", labels={RACK: f"r{r}"},
+                allocatable={"cpu": 4000}))
+    store.upsert_cohort(Cohort(name="co"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq-tas",
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="tas-flavor", resources=[
+                ResourceQuota(name="cpu", nominal=16000)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq-tas",
+                                        cluster_queue="cq-tas"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq-plain", cohort="co",
+        preemption=PreemptionPolicy(
+            within_cluster_queue=PreemptionPolicyValue.LOWER_PRIORITY),
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="plain", resources=[
+                ResourceQuota(name="cpu", nominal=4000)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq-plain",
+                                        cluster_queue="cq-plain"))
+    return store
+
+
+def test_solver_drains_plain_cq_host_places_tas():
+    store = _mixed_store()
+    store.add_workload(Workload(
+        name="tas-wl", queue_name="lq-tas", uid=1, creation_time=0.0,
+        podsets=[PodSet(name="main", count=4, requests={"cpu": 1000},
+                        topology_request=PodSetTopologyRequest(
+                            required=RACK))]))
+    for i in range(3):
+        store.add_workload(Workload(
+            name=f"plain-{i}", queue_name="lq-plain", uid=2 + i,
+            creation_time=1.0 + i,
+            podsets=[PodSet(name="main", count=1,
+                            requests={"cpu": 1000})]))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues, solver="auto")
+
+    # the engine's export must skip the TAS backlog, not reject it
+    engine = sched._solver_engine()
+    pending = engine.pending_backlog()
+    assert "cq-tas" not in pending
+    assert len(pending["cq-plain"]) == 3
+
+    sched.run_until_quiet(now=2.0, tick=1.0)
+    for i in range(3):
+        assert store.workloads[f"default/plain-{i}"].is_quota_reserved
+    tas_wl = store.workloads["default/tas-wl"]
+    assert tas_wl.is_admitted
+    ta = tas_wl.status.admission.podset_assignments[0].topology_assignment
+    assert ta is not None and sum(d.count for d in ta.domains) == 4
+
+
+def test_tas_only_store_still_fully_host_placed():
+    store = _mixed_store()
+    store.add_workload(Workload(
+        name="implied", queue_name="lq-tas", uid=1, creation_time=0.0,
+        podsets=[PodSet(name="main", count=2, requests={"cpu": 1000})]))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues, solver="auto")
+    sched.run_until_quiet(now=1.0, tick=1.0)
+    wl = store.workloads["default/implied"]
+    assert wl.is_admitted
+    assert (wl.status.admission.podset_assignments[0]
+            .topology_assignment is not None)
