@@ -35,6 +35,35 @@ class CliError(ValueError):
     pass
 
 
+def _match_selector(labels: dict, selector: str) -> bool:
+    """kubectl-style equality selector: k=v[,k2=v2...]; k!=v negates."""
+    if not selector:
+        return True
+    for term in selector.split(","):
+        term = term.strip()
+        if "!=" in term:
+            k, v = term.split("!=", 1)
+            if labels.get(k.strip()) == v.strip():
+                return False
+        elif "=" in term:
+            k, v = term.split("=", 1)
+            if labels.get(k.strip()) != v.strip():
+                return False
+        elif term and term not in labels:
+            return False
+    return True
+
+
+def _emit(headers: list[str], rows: list[list[str]], output: str) -> str:
+    """Render a listing as a table or as JSON (kueuectl -o json)."""
+    if output == "json":
+        import json as _json
+
+        keys = [h.lower().replace(" ", "_") for h in headers]
+        return _json.dumps([dict(zip(keys, r)) for r in rows], indent=2)
+    return _fmt_table(headers, rows)
+
+
 def _fmt_table(headers: list[str], rows: list[list[str]]) -> str:
     widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
               for i, h in enumerate(headers)]
@@ -92,15 +121,28 @@ class Kueuectl:
         crf.set_defaults(func=self._create_rf)
 
         lst = sub.add_parser("list").add_subparsers(required=True)
-        lst.add_parser("clusterqueue").set_defaults(func=self._list_cq)
+        lcq = lst.add_parser("clusterqueue")
+        lcq.add_argument("-o", "--output", default="table",
+                         choices=("table", "json"))
+        lcq.set_defaults(func=self._list_cq)
         llq = lst.add_parser("localqueue")
         llq.add_argument("-n", "--namespace", default=None)
+        llq.add_argument("-o", "--output", default="table",
+                         choices=("table", "json"))
         llq.set_defaults(func=self._list_lq)
         lwl = lst.add_parser("workload")
         lwl.add_argument("-n", "--namespace", default=None)
+        lwl.add_argument("-l", "--selector", default="",
+                         help="label selector k=v[,k2=v2]; k!=v negates")
+        lwl.add_argument("-o", "--output", default="table",
+                         choices=("table", "json"))
         lwl.set_defaults(func=self._list_wl)
         lst.add_parser("resourceflavor").set_defaults(func=self._list_rf)
         lst.add_parser("cohort").set_defaults(func=self._list_cohorts)
+        ltp = lst.add_parser("topology")
+        ltp.add_argument("-o", "--output", default="table",
+                         choices=("table", "json"))
+        ltp.set_defaults(func=self._list_topology)
         lpw = lst.add_parser("pending-workloads")
         lpw.add_argument("--clusterqueue", default=None)
         lpw.set_defaults(func=self._list_pending)
@@ -109,6 +151,9 @@ class Kueuectl:
         dscq = desc.add_parser("clusterqueue")
         dscq.add_argument("name")
         dscq.set_defaults(func=self._describe_cq)
+        dstp = desc.add_parser("topology")
+        dstp.add_argument("name")
+        dstp.set_defaults(func=self._describe_topology)
         dswl = desc.add_parser("workload")
         dswl.add_argument("name")
         dswl.add_argument("-n", "--namespace", default="default")
@@ -334,16 +379,17 @@ class Kueuectl:
             rows.append([cq.name, cq.cohort or "", cq.queueing_strategy,
                          str(pending), str(admitted),
                          cq.stop_policy])
-        return _fmt_table(
+        return _emit(
             ["NAME", "COHORT", "STRATEGY", "PENDING", "ADMITTED", "STOP"],
-            rows)
+            rows, getattr(ns, "output", "table"))
 
     def _list_lq(self, ns) -> str:
         rows = [[lq.namespace, lq.name, lq.cluster_queue, lq.stop_policy]
                 for lq in sorted(self.store.local_queues.values(),
                                  key=lambda l: l.key)
                 if ns.namespace is None or lq.namespace == ns.namespace]
-        return _fmt_table(["NAMESPACE", "NAME", "CLUSTERQUEUE", "STOP"], rows)
+        return _emit(["NAMESPACE", "NAME", "CLUSTERQUEUE", "STOP"], rows,
+                     getattr(ns, "output", "table"))
 
     def _list_wl(self, ns) -> str:
         from kueue_oss_tpu.core.workload_info import workload_status
@@ -352,10 +398,53 @@ class Kueuectl:
         for wl in sorted(self.store.workloads.values(), key=lambda w: w.key):
             if ns.namespace is not None and wl.namespace != ns.namespace:
                 continue
+            if not _match_selector(wl.labels, getattr(ns, "selector", "")):
+                continue
             rows.append([wl.namespace, wl.name, wl.queue_name,
                          str(wl.priority), workload_status(wl)])
-        return _fmt_table(
-            ["NAMESPACE", "NAME", "LOCALQUEUE", "PRIORITY", "STATUS"], rows)
+        return _emit(
+            ["NAMESPACE", "NAME", "LOCALQUEUE", "PRIORITY", "STATUS"], rows,
+            getattr(ns, "output", "table"))
+
+    def _list_topology(self, ns) -> str:
+        """Topology CRDs with per-level domain counts (the node/topology
+        view kueueviz surfaces; levels from the Topology spec, domains
+        counted over the store's Nodes)."""
+        from kueue_oss_tpu.tas.snapshot import build_tas_flavor_snapshot
+
+        rows = []
+        for t in sorted(self.store.topologies.values(),
+                        key=lambda t: t.name):
+            nodes = [n for n in self.store.nodes.values()]
+            snap = build_tas_flavor_snapshot(t.name, t.levels, nodes)
+            counts = "/".join(
+                str(len(snap.domains_per_level[l]))
+                for l in range(len(t.levels)))
+            rows.append([t.name, ",".join(t.levels), counts])
+        return _emit(["NAME", "LEVELS", "DOMAINS PER LEVEL"], rows,
+                     getattr(ns, "output", "table"))
+
+    def _describe_topology(self, ns) -> str:
+        t = self.store.topologies.get(ns.name)
+        if t is None:
+            raise CliError(f"topology {ns.name!r} not found")
+        from kueue_oss_tpu.tas.snapshot import build_tas_flavor_snapshot
+
+        nodes = list(self.store.nodes.values())
+        snap = build_tas_flavor_snapshot(t.name, t.levels, nodes)
+        lines = [f"Name: {t.name}", f"Levels: {', '.join(t.levels)}",
+                 f"Nodes: {len(nodes)}"]
+        for l, key in enumerate(t.levels):
+            doms = snap.domains_per_level[l]
+            lines.append(f"Level {l} ({key}): {len(doms)} domains")
+        caps: dict[str, int] = {}
+        for leaf in snap.leaves.values():
+            for r, q in leaf.free_capacity.items():
+                caps[r] = caps.get(r, 0) + q
+        if caps:
+            cap_s = ", ".join(f"{r}={q}" for r, q in sorted(caps.items()))
+            lines.append(f"Total capacity: {cap_s}")
+        return "\n".join(lines)
 
     def _list_rf(self, ns) -> str:
         rows = [[rf.name,

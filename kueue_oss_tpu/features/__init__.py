@@ -103,10 +103,14 @@ class UnknownFeatureGate(KeyError):
 
 
 def enabled(name: str) -> bool:
+    # Lock-free read: dict lookups are atomic under the GIL and
+    # _overrides is replaced/updated only under _lock by writers. The
+    # hot paths (per-workload effective_priority, export loops) call
+    # this tens of thousands of times per cycle.
     if name not in _DEFAULTS:
         raise UnknownFeatureGate(name)
-    with _lock:
-        return _overrides.get(name, _DEFAULTS[name])
+    v = _overrides.get(name)
+    return _DEFAULTS[name] if v is None else v
 
 
 def set_gates(gates: dict[str, bool]) -> None:

@@ -89,6 +89,7 @@ class Scheduler:
         enable_partial_admission: bool = True,
         clock=time.monotonic,
         solver=None,
+        solver_min_backlog: int = 256,
         eviction_backoff_max_s: float = 3600.0,
     ) -> None:
         self.store = store
@@ -107,6 +108,12 @@ class Scheduler:
         #: back to host cycles for unsupported shapes / rejected entries.
         self.solver = solver
         self._solver_instance = None
+        #: skip the device drain below this many active pending
+        #: workloads: a batched solve pays a fixed host-side export cost
+        #: per invocation, so backlog FLOODS go to the device while
+        #: trickles stay on the host cycle loop (the deployments' sweet
+        #: spot; SURVEY.md §7 incrementality note). 0 = always drain.
+        self.solver_min_backlog = solver_min_backlog
         #: Preemption/generic evictions requeue immediately (ordered by
         #: eviction time, reference workload.Ordering). Only controller
         #: evictions that pass an explicit backoff_base_s (PodsReady
@@ -307,6 +314,14 @@ class Scheduler:
 
         if not engine.supported():
             return False
+        if self.solver_min_backlog > 0:
+            # cheap heap-count heuristic (TAS entries may overcount; a
+            # TAS-only export returns empty and costs ~nothing)
+            active_pending = sum(
+                q.pending_active for q in self.queues.queues.values()
+                if q.active)
+            if active_pending < self.solver_min_backlog:
+                return False
         try:
             result = engine.drain(now=now if now is not None else 0.0,
                                   verify=True)
@@ -371,10 +386,9 @@ class Scheduler:
                                      factor=2.0)
         # requeue sweeps batch like the reference requeuer
         # (inadmissible_workloads.go:37-47): 1s normally, 10s under
-        # SchedulerLongRequeueInterval
-        requeue_period = (10.0 if features.enabled(
-            "SchedulerLongRequeueInterval") else 1.0)
-        last_sweep = -requeue_period
+        # SchedulerLongRequeueInterval (re-read per tick so live gate
+        # flips take effect like every other gate)
+        last_sweep = -1e18
         cycles = 0
         idle_rounds = 0
         while not stop.is_set():
@@ -382,6 +396,8 @@ class Scheduler:
                 # timeout: re-check stop, serve due requeues/second pass
                 # on the batch cadence
                 now_c = clock()
+                requeue_period = (10.0 if features.enabled(
+                    "SchedulerLongRequeueInterval") else 1.0)
                 if now_c - last_sweep >= requeue_period:
                     last_sweep = now_c
                     self.requeue_due(now_c)

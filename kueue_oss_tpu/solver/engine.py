@@ -207,6 +207,7 @@ class SolverEngine:
         # call (SURVEY.md §7 step 4 verify-then-assume pattern).
         order = np.argsort(admit_round[:-1], kind="stable")
         candidates = []
+        declared_of: dict[str, set] = {}
         for w in order:
             if not admitted[w]:
                 continue
@@ -217,9 +218,13 @@ class SolverEngine:
             cq_name = problem.cq_names[problem.wl_cqid[w]]
             flavor = problem.cq_option_flavors[cq_name][opt[w]]
             info = WorkloadInfo(wl, cluster_queue=cq_name)
-            declared = {r for rg in
-                        self.store.cluster_queues[cq_name].resource_groups
-                        for r in rg.covered_resources}
+            declared = declared_of.get(cq_name)
+            if declared is None:
+                declared = {
+                    r for rg in
+                    self.store.cluster_queues[cq_name].resource_groups
+                    for r in rg.covered_resources}
+                declared_of[cq_name] = declared
             plan_usage: dict[tuple[str, str], int] = {}
             for psr in info.total_requests:
                 for r, q in psr.requests.items():

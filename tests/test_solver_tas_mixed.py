@@ -80,7 +80,9 @@ def test_solver_drains_plain_cq_host_places_tas():
             podsets=[PodSet(name="main", count=1,
                             requests={"cpu": 1000})]))
     queues = QueueManager(store)
-    sched = Scheduler(store, queues, solver="auto")
+    # solver_min_backlog=0: this test wants the device drain to run even
+    # for a tiny backlog so the solver+host split is exercised for real
+    sched = Scheduler(store, queues, solver="auto", solver_min_backlog=0)
 
     # the engine's export must skip the TAS backlog, not reject it
     engine = sched._solver_engine()
@@ -103,7 +105,7 @@ def test_tas_only_store_still_fully_host_placed():
         name="implied", queue_name="lq-tas", uid=1, creation_time=0.0,
         podsets=[PodSet(name="main", count=2, requests={"cpu": 1000})]))
     queues = QueueManager(store)
-    sched = Scheduler(store, queues, solver="auto")
+    sched = Scheduler(store, queues, solver="auto", solver_min_backlog=0)
     sched.run_until_quiet(now=1.0, tick=1.0)
     wl = store.workloads["default/implied"]
     assert wl.is_admitted

@@ -330,3 +330,39 @@ def test_dryrun_clears_eviction_backoff():
         count=3, requeue_at=10_000.0)
     out = ctl.run(["dryrun"])
     assert "1 workload(s) would be admitted" in out, out
+
+
+def test_cli_selectors_json_and_topology_views():
+    from kueue_oss_tpu.api.types import Node, Topology, Workload, PodSet
+
+    store, queues, sched = make_env(nominal=1000)
+    store.upsert_topology(Topology(
+        name="dc", levels=["cloud/rack", "kubernetes.io/hostname"]))
+    for r in range(2):
+        for h in range(2):
+            store.upsert_node(Node(
+                name=f"n-{r}-{h}", labels={"cloud/rack": f"r{r}"},
+                allocatable={"cpu": 4000}))
+    store.add_workload(Workload(
+        name="labeled", queue_name="lq-a", labels={"team": "ml"},
+        podsets=[PodSet(count=1, requests={"cpu": 100})]))
+    store.add_workload(Workload(
+        name="other", queue_name="lq-a", labels={"team": "web"},
+        podsets=[PodSet(count=1, requests={"cpu": 100})]))
+    ctl = Kueuectl(store, queues=queues)
+
+    out = ctl.run(["list", "workload", "-l", "team=ml"])
+    assert "labeled" in out and "other" not in out
+    out = ctl.run(["list", "workload", "-l", "team!=ml"])
+    assert "other" in out and "labeled" not in out
+
+    data = json.loads(ctl.run(["list", "workload", "-o", "json"]))
+    assert {w["name"] for w in data} >= {"labeled", "other"}
+    data = json.loads(ctl.run(["list", "localqueue", "-o", "json"]))
+    assert all("clusterqueue" in row for row in data)
+
+    out = ctl.run(["list", "topology"])
+    assert "dc" in out and "2/4" in out
+    out = ctl.run(["describe", "topology", "dc"])
+    assert "Level 0 (cloud/rack): 2 domains" in out
+    assert "cpu=16000" in out
