@@ -66,11 +66,41 @@ class ClusterQueuePendingQueue:
         #: mutated O(1) on every queue transition so run_until_quiet can
         #: detect quiescence without walking queue internals
         self.state_hash = 0
+        #: solver-managed mode: capacity-freed flushes mark parked
+        #: entries STALE instead of physically re-heaping them (the
+        #: eager flush is O(parked) per finish — at flood scale that is
+        #: millions of heap pushes per run). Stale entries are exported
+        #: to the solver as pending; the host path materializes them
+        #: (moves them back into the heap) before it ever schedules.
+        self.lazy_flush = False
+        #: entries parked before the latest capacity-freed flush
+        #: (key -> info); they are schedulable-in-waiting, so they count
+        #: in pending_totals like heap members
+        self._stale: dict[str, WorkloadInfo] = {}
+        #: per-resource request totals over heap + stale members,
+        #: maintained O(requests) per transition so the metrics flush
+        #: never sorts or walks the backlog
+        #: (cluster_queue_resource_pending gauges)
+        self.pending_totals: dict[str, int] = {}
 
     _HEAP, _INADM = 1, 2
 
     def _hx(self, key: str, state: int) -> None:
         self.state_hash ^= hash((key, state))
+
+    def _tot(self, info: WorkloadInfo, sign: int) -> None:
+        for psr in info.total_requests:
+            for r, v in psr.requests.items():
+                nv = self.pending_totals.get(r, 0) + sign * v
+                if nv:
+                    self.pending_totals[r] = nv
+                else:
+                    self.pending_totals.pop(r, None)
+
+    def _stale_pop(self, key: str) -> None:
+        info = self._stale.pop(key, None)
+        if info is not None:
+            self._tot(info, -1)
 
     def __len__(self) -> int:
         return len(self._heap) + len(self.inadmissible)
@@ -98,15 +128,18 @@ class ClusterQueuePendingQueue:
             if info.key not in self.inadmissible:
                 self._hx(info.key, self._INADM)
             self.inadmissible[info.key] = info
+            self._stale_pop(info.key)  # updated shape => freshly parked
             self._on_change(self.name)
             return
         if info.key in self.inadmissible:
             del self.inadmissible[info.key]
+            self._stale_pop(info.key)
             self._hx(info.key, self._INADM)
         if info.key in self._in_heap:
             # Re-push with fresh ordering (priority/timestamps may change).
             self.delete(info.key)
         self._in_heap[info.key] = info
+        self._tot(info, +1)
         self._hx(info.key, self._HEAP)
         heapq.heappush(self._heap, (_order_key(info), next(self._counter), info))
         self._on_change(self.name)
@@ -120,6 +153,7 @@ class ClusterQueuePendingQueue:
             info = min(self._in_heap.values(),
                        key=lambda i: (self.afs_key(i), _order_key(i)))
             del self._in_heap[info.key]
+            self._tot(info, -1)
             self._hx(info.key, self._HEAP)
             # The AFS path never pops _heap, so stale tuples would pile up
             # forever; rebuild once they dominate (amortized O(1)).
@@ -133,33 +167,42 @@ class ClusterQueuePendingQueue:
             _, _, info = heapq.heappop(self._heap)
             if self._in_heap.get(info.key) is info:
                 del self._in_heap[info.key]
+                self._tot(info, -1)
                 self._hx(info.key, self._HEAP)
                 self._on_change(self.name)
                 return info
         return None
 
     def delete(self, key: str) -> None:
-        if key in self._in_heap:
+        live = self._in_heap.pop(key, None)
+        if live is not None:
+            self._tot(live, -1)
             self._hx(key, self._HEAP)
             self._on_change(self.name)
         if key in self.inadmissible:
             self._hx(key, self._INADM)
             self._on_change(self.name)
-        self._in_heap.pop(key, None)
         self.inadmissible.pop(key, None)
+        self._stale_pop(key)
 
     def snapshot_order(self) -> list[WorkloadInfo]:
         """Heap contents in pop (rank) order, without consuming them."""
         return sorted(self._in_heap.values(), key=_order_key)
 
     def park(self, key: str) -> None:
-        """Move a heap entry to the inadmissible set (external decision)."""
+        """Move a heap entry to the inadmissible set (external decision).
+
+        Re-parking an already-parked entry refreshes it: a stale entry
+        the solver retried and could not admit is parked *again* (it is
+        no longer owed a retry until the next capacity-freed flush)."""
         info = self._in_heap.get(key)
         if info is not None:
             self.delete(key)
             self.inadmissible[key] = info
             self._hx(key, self._INADM)
             self._on_change(self.name)
+        elif key in self.inadmissible:
+            self._stale_pop(key)
 
     def requeue_if_not_present(self, info: WorkloadInfo, reason: str,
                                pop_cycle: int = -1) -> bool:
@@ -206,17 +249,54 @@ class ClusterQueuePendingQueue:
     def queue_inadmissible(self, cycle: int) -> bool:
         """Move all parked workloads back into the heap. Known-NoFit
         classes reset: freed capacity may fit them now
-        (inadmissible_workloads.go:174)."""
+        (inadmissible_workloads.go:174).
+
+        In solver-managed (lazy) mode the move is virtual: every parked
+        entry becomes STALE in O(parked) set construction — no heap
+        pushes. The solver exports stale entries as pending; the host
+        path materializes them first (materialize_stale)."""
         self.no_fit_hashes.clear()
+        if self.lazy_flush:
+            self.queue_inadmissible_cycle = cycle
+            if not self.inadmissible:
+                return False
+            changed = False
+            for k, info in self.inadmissible.items():
+                if k not in self._stale:
+                    self._stale[k] = info
+                    self._tot(info, +1)  # schedulable-in-waiting again
+                    changed = True
+            if changed:
+                self._on_change(self.name)
+            return True
         if not self.inadmissible:
             self.queue_inadmissible_cycle = cycle
             return False
         parked = list(self.inadmissible.values())
         self.inadmissible.clear()
         for info in parked:
+            self._stale_pop(info.key)
             self._hx(info.key, self._INADM)
             self.push(info)
         self.queue_inadmissible_cycle = cycle
+        self._on_change(self.name)
+        return True
+
+    def stale_infos(self) -> list[WorkloadInfo]:
+        """Parked entries owed a retry since the last capacity-freed
+        flush (lazy mode)."""
+        return list(self._stale.values())
+
+    def materialize_stale(self) -> bool:
+        """Physically re-heap stale entries (host-path handoff)."""
+        if not self._stale:
+            return False
+        for k in list(self._stale):
+            info = self.inadmissible.pop(k, None)
+            self._stale_pop(k)
+            if info is not None:
+                self._hx(k, self._INADM)
+                self.push(info)
         self._on_change(self.name)
         return True
 
@@ -239,6 +319,8 @@ class QueueManager:
         self.afs = afs
         #: wall-clock of the current scheduling cycle, used by AFS decay
         self.current_time = 0.0
+        #: solver-managed lazy capacity-freed flushes (set_lazy_flush)
+        self.lazy_flush = False
         #: second-pass queue (second_pass_queue.go): min-heap of
         #: (ready_at, workload key) plus per-key attempt counts driving
         #: the 1s -> 30s exponential backoff
@@ -293,6 +375,7 @@ class QueueManager:
             self.queues[name] = ClusterQueuePendingQueue(
                 name, spec.queueing_strategy,
                 on_change=self.dirty_cqs.add)
+            self.queues[name].lazy_flush = self.lazy_flush
         q = self.queues[name]
         q.strategy = spec.queueing_strategy
         q.active = spec.stop_policy == StopPolicy.NONE
@@ -458,7 +541,40 @@ class QueueManager:
 
     def has_pending(self) -> bool:
         with self._mu:
-            return any(len(q._in_heap) > 0
+            return any(len(q._in_heap) > 0 or len(q._stale) > 0
+                       for q in self.queues.values() if q.active)
+
+    def set_lazy_flush(self, on: bool) -> None:
+        """Toggle solver-managed lazy flushing; turning it off hands any
+        stale entries back to the host path."""
+        with self._mu:
+            self.lazy_flush = on
+            for q in self.queues.values():
+                q.lazy_flush = on
+                if not on:
+                    q.materialize_stale()
+            self._cond.notify_all()
+
+    def any_stale(self) -> bool:
+        with self._mu:
+            return any(q._stale for q in self.queues.values() if q.active)
+
+    def materialize_stale_all(self) -> bool:
+        """Re-heap every stale entry (host-path handoff before host
+        cycles run with the solver disengaged)."""
+        with self._mu:
+            moved = False
+            for q in self.queues.values():
+                moved = q.materialize_stale() or moved
+            if moved:
+                self._cond.notify_all()
+            return moved
+
+    def solver_backlog_count(self) -> int:
+        """Pending work the solver would drain: heap entries plus stale
+        parked entries owed a retry."""
+        with self._mu:
+            return sum(len(q._in_heap) + len(q._stale)
                        for q in self.queues.values() if q.active)
 
     def membership_fingerprint(self) -> int:

@@ -262,16 +262,13 @@ class Scheduler:
                         lq, ns, value=n)
                     metrics.local_queue_admitted_active_workloads.set(
                         lq, ns, value=admitted_by_lq.get((lq, ns), 0))
-            # pending requested quantity per resource
+            # pending requested quantity per resource (totals maintained
+            # incrementally by the queue — never walks the backlog)
             q = self.queues.queues.get(name)
             if q is not None:
-                pend: dict[str, int] = {}
-                for info in q.snapshot_order():
-                    for psr in info.total_requests:
-                        for r, v in psr.requests.items():
-                            pend[r] = pend.get(r, 0) + v
                 metrics.cluster_queue_resource_pending.replace_prefix(
-                    (name,), {(r,): v for r, v in pend.items()})
+                    (name,),
+                    {(r,): v for r, v in q.pending_totals.items()})
             if cq.has_parent():
                 touched_cohorts.update(cq.path_parent_to_root())
         # cohort subtree gauges (metrics.go cohort_subtree_*)
@@ -313,19 +310,27 @@ class Scheduler:
         from kueue_oss_tpu.solver.tensors import UnsupportedProblem
 
         if not engine.supported():
+            self.queues.materialize_stale_all()
             return False
         if self.solver_min_backlog > 0:
             # cheap heap-count heuristic (TAS entries may overcount; a
-            # TAS-only export returns empty and costs ~nothing)
-            active_pending = sum(
-                q.pending_active for q in self.queues.queues.values()
-                if q.active)
-            if active_pending < self.solver_min_backlog:
+            # TAS-only export returns empty and costs ~nothing). Stale
+            # parked entries count — they are owed a retry. Lazy
+            # capacity-freed flushing engages only while the solver is
+            # draining floods (eager flushes there are O(parked) per
+            # finish — millions of heap pushes per run); at trickle
+            # scale the host path runs with exact eager semantics.
+            if self.queues.solver_backlog_count() < self.solver_min_backlog:
+                if self.queues.lazy_flush:
+                    self.queues.set_lazy_flush(False)  # materializes
                 return False
+            if not self.queues.lazy_flush:
+                self.queues.set_lazy_flush(True)
         try:
             result = engine.drain(now=now if now is not None else 0.0,
                                   verify=True)
         except UnsupportedProblem:
+            self.queues.materialize_stale_all()
             return False
         for key in result.admitted_keys:
             wl = self.store.workloads.get(key)
@@ -333,7 +338,11 @@ class Scheduler:
                 cq = wl.status.admission.cluster_queue
                 self.admitted_total[cq] = self.admitted_total.get(cq, 0) + 1
                 self._cycle_touched_cqs.add(cq)
-        return True
+        # progress = the plan changed something; a no-op drain (e.g. a
+        # blocked StrictFIFO head holding the whole backlog) must NOT
+        # reset serve()'s SlowDown backoff, or the loop would hot-spin
+        # full export+solve cycles until capacity frees
+        return bool(result.admitted or result.evicted)
 
     def run_until_quiet(self, max_cycles: int = 10_000,
                         now: Optional[float] = None,
@@ -347,18 +356,37 @@ class Scheduler:
         (a frozen clock collapses eviction/admission timestamps into
         ties, which real deployments never see).
         """
-        self._solver_drain(now)
         cycles = 0
+        prev_probe = None
         while cycles < max_cycles:
-            pre = self._queue_fingerprint()
-            n = None if now is None else now + cycles * tick
-            stats = self.schedule(now=n)
-            cycles += 1
-            if stats.heads == 0:
+            self._solver_drain(None if now is None
+                               else now + cycles * tick)
+            stalled = False
+            while cycles < max_cycles:
+                pre = self._queue_fingerprint()
+                n = None if now is None else now + cycles * tick
+                stats = self.schedule(now=n)
+                cycles += 1
+                if stats.heads == 0:
+                    break
+                if (stats.admitted == 0 and stats.preempted == 0
+                        and self._queue_fingerprint() == pre):
+                    stalled = True
+                    break
+            # mid-loop evictions may have lazily flushed parked entries
+            # (stale); loop back so the solver (or the host, via
+            # materialization) retries them before declaring quiescence
+            if stalled or not self.queues.any_stale():
                 break
-            if (stats.admitted == 0 and stats.preempted == 0
-                    and self._queue_fingerprint() == pre):
+            # cross-iteration progress probe: if a full drain+cycle pass
+            # changed neither queue membership nor the retryable backlog,
+            # further passes are no-ops — quiesce instead of burning
+            # export+solve until max_cycles
+            probe = (self._queue_fingerprint(),
+                     self.queues.solver_backlog_count())
+            if probe == prev_probe:
                 break
+            prev_probe = probe
         return cycles
 
     def _queue_fingerprint(self):
@@ -402,10 +430,15 @@ class Scheduler:
                     last_sweep = now_c
                     self.requeue_due(now_c)
                 continue
+            # Flood-to-solver routing (run_until_quiet parity): a backlog
+            # past solver_min_backlog drains through the device kernel in
+            # one batched invocation; the host cycle below mops up the
+            # trickle and anything the solver could not model or verify.
+            drained = self._solver_drain(clock()) if self.solver else False
             pre = self._queue_fingerprint()
             stats = self.schedule(now=clock())
             cycles += 1
-            if (stats.admitted or stats.preempted
+            if (drained or stats.admitted or stats.preempted
                     or self._queue_fingerprint() != pre):
                 idle_rounds = 0  # KeepGoing
             else:

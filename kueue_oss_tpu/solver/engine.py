@@ -19,6 +19,7 @@ from kueue_oss_tpu.api.types import (
     Admission,
     PodSetAssignment,
     PreemptionPolicyValue,
+    TopologyAssignment,
     WorkloadConditionType,
 )
 from kueue_oss_tpu.core.queue_manager import QueueManager
@@ -27,6 +28,7 @@ from kueue_oss_tpu.core.workload_info import WorkloadInfo
 from kueue_oss_tpu import metrics
 from kueue_oss_tpu.solver.kernels import solve_backlog, to_device
 from kueue_oss_tpu.solver.tensors import (
+    ExportCache,
     SolverProblem,
     UnsupportedProblem,
     export_problem,
@@ -79,6 +81,25 @@ class SolverEngine:
         #: expected peak so every drain reuses ONE compiled program
         #: instead of recompiling at each power-of-two crossing.
         self.pad_to = 0
+        #: cross-drain export memo (event-invalidated); repeated drains
+        #: assemble the problem with vectorized gathers instead of
+        #: per-workload Python loops
+        self.export_cache = ExportCache(store)
+        #: sticky pad high-water mark: the padded workload axis never
+        #: shrinks, so a backlog oscillating around a power-of-two
+        #: boundary (pending + admitted crossing pad_to) can't flap
+        #: between two compiled programs — recompiles are monotone
+        #: crossings only
+        self._pad_hwm = 0
+        #: production device-TAS path: TAS CQs whose backlog shapes the
+        #: extended placer supports drain through the quota kernel and
+        #: place on device (solver/tas_engine.py); set False to force
+        #: the pre-round-5 host-only TAS behavior
+        self.device_tas = True
+        self._tas_placer = None
+        #: TAS CQs admitted to the device path for the CURRENT drain
+        #: (computed by pending_backlog, read by the apply path)
+        self._drain_tas_ready: set[str] = set()
 
     def supported(self) -> bool:
         """Whether the drain can run on-device.
@@ -122,23 +143,49 @@ class SolverEngine:
         return False
 
     def pending_backlog(self) -> dict[str, list[WorkloadInfo]]:
-        """Current heap contents per CQ in rank (pop) order.
+        """Current heap contents per CQ in rank (pop) order, plus stale
+        parked entries owed a retry (lazy capacity-freed flushes merge
+        into the backlog virtually instead of re-heaping — the rank
+        order is the same _order_key sort a physical flush produces).
 
         TAS-shaped workloads (explicit topology requests, podset groups,
         or any CQ whose flavors carry a Topology) are excluded: the
         kernel admits without computing topology assignments, so those
         stay in their heaps for the host scheduler's mop-up cycles
         (Scheduler.run_until_quiet after _solver_drain), which run the
-        full TAS machinery."""
+        full TAS machinery. Stale TAS entries are materialized back into
+        their heaps for the same host path."""
+        from kueue_oss_tpu.core.queue_manager import _order_key
+
         out: dict[str, list[WorkloadInfo]] = {}
+        self._drain_tas_ready = set()
         for name, q in self.queues.queues.items():
             if not q.active:
                 continue
-            infos = q.snapshot_order()
-            if not infos:
-                continue
             if self._is_tas_cq(name):
+                if not self._tas_device_ready(name, q):
+                    q.materialize_stale()
+                    continue
+                # device-TAS path: quota through the kernel, placement
+                # through the sequential device placer at apply time
+                self._drain_tas_ready.add(name)
+                stale = q.stale_infos() if q._stale else []
+                infos = q.snapshot_order()
+                if stale:
+                    infos = sorted(infos + stale, key=_order_key)
+                if infos:
+                    out[name] = infos
                 continue
+            stale = q.stale_infos() if q._stale else []
+            if stale and any(ps.topology_request is not None
+                             for i in stale for ps in i.obj.podsets):
+                # hand topology-requesting stale entries (and their
+                # queue-mates, to keep one rank order) to the host path
+                q.materialize_stale()
+                stale = []
+            infos = q.snapshot_order()
+            if stale:
+                infos = sorted(infos + stale, key=_order_key)
             infos = [i for i in infos
                      if all(ps.topology_request is None
                             for ps in i.obj.podsets)]
@@ -146,9 +193,68 @@ class SolverEngine:
                 out[name] = infos
         return out
 
+    def _tas_device_ready(self, name: str, q) -> bool:
+        """Whether this TAS CQ's ENTIRE backlog (heap + parked) is
+        device-placeable. All-or-nothing per CQ keeps StrictFIFO head
+        order exact: exporting followers around an unsupported head
+        would let the kernel admit past a blocked head."""
+        if not self.device_tas:
+            return False
+        spec = self.store.cluster_queues.get(name)
+        if spec is None:
+            return False
+        from kueue_oss_tpu.solver.tas_engine import device_tas_supported
+
+        for info in list(q._in_heap.values()) + list(
+                q.inadmissible.values()):
+            if not device_tas_supported(info, self.store, spec):
+                return False
+        return True
+
+    def _compute_tas_assignments(self, candidates):
+        """Device-place admitted TAS candidates in admission order.
+
+        Returns (kept_candidates, topology_by_workload_key); candidates
+        whose placement failed are dropped — they stay in their heaps
+        for the host mop-up cycles after the drain."""
+        tas_items = []
+        for cand in candidates:
+            _wl, cq_name, flavor_of, info, _usage = cand
+            if cq_name in self._drain_tas_ready and flavor_of:
+                flavor = (next(iter(flavor_of.values()))
+                          if isinstance(flavor_of, dict) else flavor_of)
+                tas_items.append((info, flavor))
+        if not tas_items:
+            return candidates, {}
+        from kueue_oss_tpu.core.snapshot import build_snapshot
+        from kueue_oss_tpu.solver.tas_engine import DeviceTASPlacer
+
+        if self._tas_placer is None:
+            self._tas_placer = DeviceTASPlacer(self.store)
+        snapshot = build_snapshot(self.store)
+        placements = self._tas_placer.place_batch(snapshot, tas_items)
+        # only candidates actually submitted for placement can fail out
+        # of the plan; a TAS-CQ candidate with no flavored resources has
+        # no TAS request at all (workload_topology_requests skips empty
+        # psa.flavors) and commits without an assignment — host parity
+        submitted = {info.key for info, _ in tas_items}
+        kept = []
+        topo_of: dict[str, TopologyAssignment] = {}
+        for cand in candidates:
+            _wl, cq_name, _f, info, _usage = cand
+            if cq_name in self._drain_tas_ready and info.key in submitted:
+                ta = placements.get(info.key)
+                if ta is None:
+                    metrics.solver_plan_fallbacks_total.inc()
+                    continue  # host mop-up places (or rejects) it
+                topo_of[info.key] = ta
+            kept.append(cand)
+        return kept, topo_of
+
     def export(self) -> tuple[SolverProblem, dict[str, list[WorkloadInfo]]]:
         pending = self.pending_backlog()
-        problem = export_problem(self.store, pending)
+        problem = export_problem(self.store, pending,
+                                 cache=self.export_cache)
         return problem, pending
 
     def drain(self, now: float = 0.0, verify: bool = False) -> DrainResult:
@@ -168,8 +274,9 @@ class SolverEngine:
         problem, pending = self.export()
         if problem.n_workloads == 0:
             return result
-        problem = pad_workloads(
-            problem, _pow2(max(problem.n_workloads, self.pad_to)))
+        self._pad_hwm = max(self._pad_hwm,
+                            _pow2(max(problem.n_workloads, self.pad_to)))
+        problem = pad_workloads(problem, self._pad_hwm)
 
         t0 = time.monotonic()
         if self.remote is not None:
@@ -205,12 +312,11 @@ class SolverEngine:
         # Collect the committed plan entries in admission order first, so
         # the optional oracle verification can run as one batched native
         # call (SURVEY.md §7 step 4 verify-then-assume pattern).
-        order = np.argsort(admit_round[:-1], kind="stable")
+        adm_ws = np.nonzero(admitted[:-1])[0]
+        order = adm_ws[np.argsort(admit_round[adm_ws], kind="stable")]
         candidates = []
         declared_of: dict[str, set] = {}
         for w in order:
-            if not admitted[w]:
-                continue
             key = problem.wl_keys[w]
             wl = self.store.workloads.get(key)
             if wl is None or wl.is_quota_reserved or not wl.active:
@@ -233,6 +339,8 @@ class SolverEngine:
                     fr = (flavor, r)
                     plan_usage[fr] = plan_usage.get(fr, 0) + q
             candidates.append((wl, cq_name, flavor, info, plan_usage))
+
+        candidates, topo_of = self._compute_tas_assignments(candidates)
 
         if verify and candidates:
             # Verify-then-fallback (scheduler.go:427 fits re-check): plan
@@ -257,13 +365,12 @@ class SolverEngine:
             flavor_of = {r: flavor for psr in info.total_requests
                          for r in psr.requests}
             self._commit_admission(wl, cq_name, flavor_of, info, now,
-                                   result)
+                                   result, topology=topo_of.get(wl.key))
         # Mirror the solver's inadmissible-parking decisions host-side;
         # StrictFIFO blocked heads (not parked) stay in their heaps.
-        for w in range(problem.n_workloads):
-            if parked[w]:
-                cq_name = problem.cq_names[problem.wl_cqid[w]]
-                self.queues.queues[cq_name].park(problem.wl_keys[w])
+        for w in np.nonzero(parked[:problem.n_workloads])[0]:
+            cq_name = problem.cq_names[problem.wl_cqid[w]]
+            self.queues.queues[cq_name].park(problem.wl_keys[w])
 
     # -- full (preemption-capable) drain -----------------------------------
 
@@ -354,22 +461,29 @@ class SolverEngine:
         pending = self.pending_backlog()
         parked_map: dict[str, list[WorkloadInfo]] = {}
         for name, q in self.queues.queues.items():
-            if not q.inadmissible or self._is_tas_cq(name):
+            if not q.inadmissible or (
+                    self._is_tas_cq(name)
+                    and name not in self._drain_tas_ready):
                 continue
-            infos = [i for i in q.inadmissible.values()
-                     if all(ps.topology_request is None
-                            for ps in i.obj.podsets)]
+            # stale entries export as PENDING (pending_backlog); only
+            # still-parked (unflushed) entries export as parked0
+            infos = [i for k, i in q.inadmissible.items()
+                     if k not in q._stale
+                     and all(ps.topology_request is None
+                             for ps in i.obj.podsets)]
             if infos:
                 parked_map[name] = infos
         problem = export_problem(self.store, pending,
                                  include_admitted=True, parked=parked_map,
-                                 afs=self.queues.afs, now=now)
+                                 afs=self.queues.afs, now=now,
+                                 cache=self.export_cache)
         if problem.n_workloads == 0:
             return result
         g_max = int(problem.cq_ngroups.max())
         h_max, p_max = self._size_caps(problem)
-        problem = pad_workloads(
-            problem, _pow2(max(problem.n_workloads, self.pad_to)))
+        self._pad_hwm = max(self._pad_hwm,
+                            _pow2(max(problem.n_workloads, self.pad_to)))
+        problem = pad_workloads(problem, self._pad_hwm)
 
         t0 = time.monotonic()
         if self.remote is not None:
@@ -431,11 +545,10 @@ class SolverEngine:
         #    admission, or were evicted mid-drain and re-admitted with a
         #    (possibly different) flavor (admit_round >= 0).
         evictor = self._evictor()
-        for w in range(W):
-            if not wl_admitted0[w]:
-                continue
-            if admitted[w] and admit_round[w] < 0:
-                continue  # kept its original admission untouched
+        evict_ws = np.nonzero(
+            wl_admitted0[:W]
+            & ~(admitted[:W] & (admit_round[:W] < 0)))[0]
+        for w in evict_ws:
             key = problem.wl_keys[w]
             wl = self.store.workloads.get(key)
             if wl is None or not wl.is_quota_reserved:
@@ -451,11 +564,10 @@ class SolverEngine:
                 result.evicted_keys.append(key)
 
         # 2) admissions in (round, entry-order); per-group flavor decode.
-        order = np.argsort(admit_round[:W], kind="stable")
+        adm_ws = np.nonzero(admitted[:W] & (admit_round[:W] >= 0))[0]
+        order = adm_ws[np.argsort(admit_round[adm_ws], kind="stable")]
         candidates = []
         for w in order:
-            if not admitted[w] or admit_round[w] < 0:
-                continue
             key = problem.wl_keys[w]
             wl = self.store.workloads.get(key)
             if wl is None or wl.is_quota_reserved or not wl.active:
@@ -475,6 +587,11 @@ class SolverEngine:
                     plan_usage[fr] = plan_usage.get(fr, 0) + q
             candidates.append((wl, cq_name, flavor_of, info, plan_usage))
 
+        # device-TAS placement in admission order; failed placements
+        # drop out of the plan (host mop-up) BEFORE the oracle verify so
+        # the sequential usage walk matches what actually commits
+        candidates, topo_of = self._compute_tas_assignments(candidates)
+
         if verify and candidates:
             from kueue_oss_tpu.core.snapshot import build_snapshot
             from kueue_oss_tpu.native import BatchOracle
@@ -491,17 +608,18 @@ class SolverEngine:
                 metrics.solver_plan_fallbacks_total.inc()
                 continue
             self._commit_admission(wl, cq_name, flavor_of, info, now,
-                                   result)
+                                   result, topology=topo_of.get(wl.key))
 
         # 3) parking decisions (inadmissible backoff parity).
-        for w in range(W):
-            if parked[w] and not admitted[w]:
-                cq_name = problem.cq_names[problem.wl_cqid[w]]
-                self.queues.queues[cq_name].park(problem.wl_keys[w])
+        for w in np.nonzero(parked[:W] & ~admitted[:W])[0]:
+            cq_name = problem.cq_names[problem.wl_cqid[w]]
+            self.queues.queues[cq_name].park(problem.wl_keys[w])
 
     def _commit_admission(self, wl, cq_name: str,
                           flavor_of: dict[str, str], info: WorkloadInfo,
-                          now: float, result: DrainResult) -> None:
+                          now: float, result: DrainResult,
+                          topology: Optional[TopologyAssignment] = None,
+                          ) -> None:
         key = wl.key
         admission = Admission(
             cluster_queue=cq_name,
@@ -514,6 +632,9 @@ class SolverEngine:
                              if r in flavor_of},
                     resource_usage=dict(psr.requests),
                     count=psr.count,
+                    # device-TAS drains carry the placement computed by
+                    # the sequential on-device placer (single podset)
+                    topology_assignment=topology,
                 )
                 for psr in info.total_requests
             ],

@@ -31,8 +31,12 @@ from kueue_oss_tpu.core.store import Store
 from kueue_oss_tpu.core.workload_info import (
     WorkloadInfo,
     effective_priority,
+    ignore_undeclared_resources,
     queue_order_timestamp,
     quota_reservation_time,
+)
+from kueue_oss_tpu.core.workload_info import (
+    requests_config_generation as _wli_requests_config_generation,
 )
 from kueue_oss_tpu.scheduler.flavor_assigner import (
     _selector_matches,
@@ -234,6 +238,234 @@ def _flavor_compatible(info: WorkloadInfo, flavor: ResourceFlavor,
     return True
 
 
+class _WlRow:
+    """Per-workload cached export quantities (drain-invariant)."""
+
+    __slots__ = ("stamp", "cid", "prio", "uid", "raw_ts", "evicted",
+                 "shape_id", "class_tok", "lq_key", "totals",
+                 "usage_fs", "usage_qs", "admit_ts")
+
+    def __init__(self, stamp, cid, prio, uid, raw_ts, evicted, shape_id,
+                 class_tok, lq_key, totals, usage_fs, usage_qs, admit_ts):
+        self.stamp = stamp
+        self.cid = cid
+        self.prio = prio
+        self.uid = uid
+        self.raw_ts = raw_ts
+        self.evicted = evicted
+        self.shape_id = shape_id
+        self.class_tok = class_tok
+        self.lq_key = lq_key
+        self.totals = totals
+        self.usage_fs = usage_fs
+        self.usage_qs = usage_qs
+        self.admit_ts = admit_ts
+
+
+class ExportCache:
+    """Cross-drain memo for :func:`export_problem`.
+
+    Rebuilding the whole problem with per-workload Python loops cost
+    ~0.35 s per drain at 15k workloads — more than the solve itself once
+    the kernel got fast. The cache keeps per-workload rows and interns
+    request tensors by scheduling shape (CQ, pinned flavor, resource
+    totals, per-podset selector/tolerations — the exact inputs of the
+    option-validity walk), so repeated drains assemble ``wl_req`` /
+    ``wl_valid`` with one vectorized gather instead of loops.
+
+    Invalidation is event-driven: a Workload event drops that key's row;
+    any other kind (ClusterQueue, Cohort, ResourceFlavor, ...) bumps
+    ``spec_gen``, which retires every derived table through the stamp
+    check on the next export. Gate flips, request-shaping config changes
+    and vocabulary growth are caught by the per-export stamp itself
+    (``features.all_gates()``, ``requests_config_generation()``, the FR
+    vocabulary and CQ name ordering are all part of it).
+    """
+
+    def __init__(self, store: Store, subscribe: bool = True) -> None:
+        self.store = store
+        self.spec_gen = 0
+        self.rows: dict[str, _WlRow] = {}
+        #: interned scheduling shapes; shape 0 is the all-invalid row
+        self._shape_ids: dict[tuple, int] = {}
+        self._shape_valid: list[np.ndarray] = []
+        self._shape_req: list[np.ndarray] = []
+        self._stack_valid: Optional[np.ndarray] = None
+        self._stack_req: Optional[np.ndarray] = None
+        #: interned (cid, scheduling_hash) -> class token; token -> root
+        self._class_toks: dict[tuple, int] = {}
+        self._tok_root: list[int] = []
+        self._stamp: Optional[tuple] = None
+        self._fr_index: dict[FlavorResource, int] = {}
+        #: per-spec-gen CQ tables: covered resources + selector key sets
+        self._cq_gen = -1
+        self._cq_covered: list[set] = []
+        self._cq_allowed_keys: list[list[frozenset]] = []
+        if subscribe:
+            store.watch(self._on_event)
+
+    def _on_event(self, event) -> None:
+        verb, kind, obj = event
+        if kind == "Workload":
+            self.rows.pop(obj.key, None)
+        else:
+            self.spec_gen += 1
+
+    # -- derived-table lifecycle ------------------------------------------
+
+    def refresh(self, fr_list: list, cq_names: list[str], K: int,
+                F: int) -> tuple:
+        """Return the stamp rows must carry, clearing derived state when
+        anything it covers changed since the previous export."""
+        from kueue_oss_tpu import features
+
+        stamp = (self.spec_gen, tuple(sorted(features.all_gates().items())),
+                 _wli_requests_config_generation(), tuple(fr_list),
+                 tuple(cq_names), K)
+        if stamp != self._stamp:
+            self._stamp = stamp
+            self.rows.clear()
+            self._shape_ids.clear()
+            self._shape_valid = [np.zeros(K, dtype=bool)]
+            self._shape_req = [np.zeros((K, max(1, F)), dtype=np.int64)]
+            self._stack_valid = None
+            self._stack_req = None
+            self._class_toks.clear()
+            self._tok_root = []
+            self._fr_index = {fr: i for i, fr in enumerate(fr_list)}
+        return self._stamp
+
+    def cq_tables(self, cq_names: list[str]) -> None:
+        """Per-CQ covered-resource sets and selector key universes,
+        cached per spec generation."""
+        if self._cq_gen == self.spec_gen and len(self._cq_covered) == len(
+                cq_names):
+            return
+        self._cq_gen = self.spec_gen
+        self._cq_covered = []
+        self._cq_allowed_keys = []
+        for name in cq_names:
+            spec = self.store.cluster_queues[name]
+            covered = {r for rg in spec.resource_groups
+                       for r in rg.covered_resources}
+            per_group = []
+            for rg in spec.resource_groups:
+                per_group.append(frozenset(
+                    key for fq in rg.flavors
+                    for key in self.store.resource_flavors.get(
+                        fq.name, ResourceFlavor(name=fq.name)).node_labels))
+            self._cq_covered.append(covered)
+            self._cq_allowed_keys.append(per_group)
+
+    def shape_matrices(self) -> tuple[np.ndarray, np.ndarray]:
+        if (self._stack_valid is None
+                or self._stack_valid.shape[0] != len(self._shape_valid)):
+            self._stack_valid = np.stack(self._shape_valid)
+            self._stack_req = np.stack(self._shape_req)
+        return self._stack_valid, self._stack_req
+
+    # -- row building ------------------------------------------------------
+
+    def row(self, info: WorkloadInfo, cid: int, stamp: tuple,
+            strict: bool, root: int, K: int, F: int) -> _WlRow:
+        r = self.rows.get(info.key)
+        if r is not None and r.stamp is stamp:
+            return r
+        r = self._build_row(info, cid, stamp, strict, root, K, F)
+        self.rows[info.key] = r
+        return r
+
+    def _build_row(self, info: WorkloadInfo, cid: int, stamp: tuple,
+                   strict: bool, root: int, K: int, F: int) -> _WlRow:
+        from kueue_oss_tpu import features
+
+        wl = info.obj
+        for ps in wl.podsets:
+            if (ps.topology_request is not None
+                    and ps.topology_request.podset_group_name):
+                raise UnsupportedProblem(
+                    f"workload {info.key} uses podset topology groups")
+        totals: dict[str, int] = {}
+        for psr in info.total_requests:
+            for rname, q in psr.requests.items():
+                totals[rname] = totals.get(rname, 0) + q
+        shape_id = self._shape_id(info, cid, totals, K, F)
+        if not features.enabled("SchedulingEquivalenceHashing") or strict:
+            tok = -1
+        else:
+            ckey = (cid, info.scheduling_hash())
+            tok = self._class_toks.get(ckey)
+            if tok is None:
+                tok = len(self._tok_root)
+                self._class_toks[ckey] = tok
+                self._tok_root.append(int(root))
+        usage_fs = usage_qs = None
+        admit_ts = 0.0
+        if wl.status.admission is not None:
+            fs, qs = [], []
+            for fr, q in info.usage().items():
+                j = self._fr_index.get(fr)
+                if j is not None:
+                    fs.append(j)
+                    qs.append(q)
+            usage_fs = np.asarray(fs, dtype=np.int64)
+            usage_qs = np.asarray(qs, dtype=np.int64)
+            admit_ts = quota_reservation_time(wl, 0.0)
+        return _WlRow(
+            stamp, cid, effective_priority(wl), wl.uid,
+            queue_order_timestamp(wl), wl.is_evicted, shape_id, tok,
+            f"{wl.namespace}/{wl.queue_name}", totals, usage_fs, usage_qs,
+            admit_ts)
+
+    def _shape_id(self, info: WorkloadInfo, cid: int,
+                  totals: dict[str, int], K: int, F: int) -> int:
+        wl = info.obj
+        spec = self.store.cluster_queues[info.cluster_queue]
+        if not spec.resource_groups:
+            return 0
+        shape_key = (
+            cid, wl.allowed_flavor, tuple(sorted(totals.items())),
+            tuple((tuple(sorted(ps.node_selector.items())),
+                   tuple(ps.tolerations)) for ps in wl.podsets),
+        )
+        sid = self._shape_ids.get(shape_key)
+        if sid is not None:
+            return sid
+        covered = self._cq_covered[cid]
+        if (any(q > 0 and r not in covered for r, q in totals.items())
+                and not ignore_undeclared_resources()):
+            # Undeclared resource: no option can ever fit; the solver
+            # parks it (oracle parity). Intern to the all-invalid row.
+            self._shape_ids[shape_key] = 0
+            return 0
+        valid = np.zeros(K, dtype=bool)
+        req = np.zeros((K, max(1, F)), dtype=np.int64)
+        k = -1
+        for g, rg in enumerate(spec.resource_groups):
+            allowed_keys = self._cq_allowed_keys[cid][g]
+            for fq in rg.flavors:
+                k += 1
+                flavor = self.store.resource_flavors.get(fq.name)
+                if flavor is None:
+                    continue
+                # A concurrent-admission variant is pinned to one flavor
+                # (flavorassigner IsFlavorAllowedForVariant).
+                if (wl.allowed_flavor is not None
+                        and fq.name != wl.allowed_flavor):
+                    continue
+                if not _flavor_compatible(info, flavor, allowed_keys):
+                    continue
+                valid[k] = True
+                for rname, q in totals.items():
+                    if rname in rg.covered_resources:
+                        req[k, self._fr_index[(fq.name, rname)]] = q
+        sid = len(self._shape_valid)
+        self._shape_ids[shape_key] = sid
+        self._shape_valid.append(valid)
+        self._shape_req.append(req)
+        return sid
+
+
 def export_problem(
     store: Store,
     pending: dict[str, list[WorkloadInfo]],
@@ -242,6 +474,7 @@ def export_problem(
     parked: Optional[dict[str, list[WorkloadInfo]]] = None,
     afs=None,
     now: float = 0.0,
+    cache: Optional[ExportCache] = None,
 ) -> SolverProblem:
     """Build a SolverProblem from the store and the pending backlog.
 
@@ -406,7 +639,15 @@ def export_problem(
 
     cq_id = {name: i for i, name in enumerate(cq_names)}
 
-    # ---- workload arrays -------------------------------------------------
+    # ---- workload arrays (cache-assembled, vectorized) -------------------
+    # Per-workload quantities come from ExportCache rows (built once per
+    # workload state, invalidated by store events); request tensors are
+    # interned by scheduling shape and assembled with one gather.
+    if cache is None:
+        cache = ExportCache(store, subscribe=False)
+    stamp = cache.refresh(fr_list, cq_names, K, F)
+    cache.cq_tables(cq_names)
+
     all_infos: list[WorkloadInfo] = []
     wl_cqid_l, wl_rank_l = [], []
     for name, infos in pending.items():
@@ -422,15 +663,19 @@ def export_problem(
                 wl_cqid_l.append(cq_id[info.cluster_queue])
                 wl_rank_l.append(int(BIG))
     n_pending = len(all_infos)
-    admitted_infos: list[WorkloadInfo] = []
     if include_admitted:
         for info in store.admitted_infos():
             if info.cluster_queue in cq_id:
-                admitted_infos.append(info)
                 all_infos.append(info)
                 wl_cqid_l.append(cq_id[info.cluster_queue])
                 wl_rank_l.append(int(BIG))
     W = len(all_infos)
+
+    rows = [
+        cache.row(info, cid, stamp, bool(cq_strict[cid]),
+                  int(cq_root[cid]), K, F)
+        for info, cid in zip(all_infos, wl_cqid_l)
+    ]
 
     wl_cqid = np.concatenate(
         [np.asarray(wl_cqid_l, dtype=np.int32), [C]]).astype(np.int32)
@@ -449,131 +694,83 @@ def export_problem(
     wl_admit_rank = np.zeros(W + 1, dtype=np.int32)
     ad_usage = np.zeros((W + 1, F), dtype=np.int64)
 
-    # Scheduling-equivalence classes (per CQ; StrictFIFO and gate-off
-    # workloads get the sentinel class and never dedup-park).
-    from kueue_oss_tpu import features
+    if W:
+        wl_prio[:W] = np.fromiter((r.prio for r in rows), np.int64, W)
+        wl_uid[:W] = np.fromiter((r.uid for r in rows), np.int64, W)
+        wl_evicted0[:W] = np.fromiter(
+            (r.evicted for r in rows), bool, W)
+        shape_ids = np.fromiter(
+            (r.shape_id for r in rows), np.int64, W)
+        stack_valid, stack_req = cache.shape_matrices()
+        wl_valid[:W] = stack_valid[shape_ids]
+        wl_req[:W] = stack_req[shape_ids]
 
-    dedup_on = features.enabled("SchedulingEquivalenceHashing")
-    class_index: dict[tuple, int] = {}
-    class_root_l: list[int] = []
-    wl_class = np.zeros(W + 1, dtype=np.int32)
-    for w, info in enumerate(all_infos):
-        cid = cq_id[info.cluster_queue]
-        if not dedup_on or cq_strict[cid]:
-            wl_class[w] = -1
-            continue
-        key = (cid, info.scheduling_hash())
-        idx = class_index.get(key)
-        if idx is None:
-            idx = len(class_index)
-            class_index[key] = idx
-            class_root_l.append(int(cq_root[cid]))
-        wl_class[w] = idx
-    n_classes = len(class_index)
-    wl_class[wl_class < 0] = n_classes
-    wl_class[W] = n_classes
-    class_root = np.concatenate(
-        [np.asarray(class_root_l, dtype=np.int32),
-         [n_nodes]]).astype(np.int32)
+    # Scheduling-equivalence classes (per CQ; StrictFIFO and gate-off
+    # workloads get the sentinel class and never dedup-park) — interned
+    # tokens densified per export with np.unique.
+    toks = (np.fromiter((r.class_tok for r in rows), np.int64, W)
+            if W else np.zeros(0, dtype=np.int64))
+    pos = toks >= 0
+    if pos.any():
+        uniq, inv_c = np.unique(toks[pos], return_inverse=True)
+        n_classes = len(uniq)
+        wl_class = np.full(W + 1, n_classes, dtype=np.int32)
+        wl_class[np.nonzero(pos)[0]] = inv_c
+        tok_root = np.asarray(cache._tok_root, dtype=np.int32)
+        class_root = np.concatenate(
+            [tok_root[uniq], [n_nodes]]).astype(np.int32)
+    else:
+        n_classes = 0
+        wl_class = np.zeros(W + 1, dtype=np.int32)
+        class_root = np.asarray([n_nodes], dtype=np.int32)
 
     # Timestamps are exported as dense ranks: only relative order matters
     # for entry sorting, and float32 would collapse epoch-scale values
     # less than ~128s apart (ties must stay ties for the uid tiebreak).
-    raw_ts = [queue_order_timestamp(i.obj) for i in all_infos]
-    distinct_ts = sorted(set(raw_ts))
-    ts_rank = {ts: r for r, ts in enumerate(distinct_ts)}
-    raw_admit = [quota_reservation_time(i.obj, 0.0) for i in admitted_infos]
-    admit_rank = {ts: r + 1 for r, ts in enumerate(sorted(set(raw_admit)))}
-
-    import bisect
-
     from kueue_oss_tpu import features as _features
     from kueue_oss_tpu.scheduler.preemption import (
         TIMESTAMP_PREEMPTION_BUFFER_S,
     )
 
-    ts_buffered = _features.enabled("SchedulerTimestampPreemptionBuffer")
     wl_ts_buf = np.zeros(W + 1, dtype=np.int32)
-    for w, info in enumerate(all_infos):
-        wl_prio[w] = effective_priority(info.obj)
-        wl_ts[w] = ts_rank[raw_ts[w]]
-        if ts_buffered:
-            wl_ts_buf[w] = bisect.bisect_right(
-                distinct_ts,
-                raw_ts[w] + TIMESTAMP_PREEMPTION_BUFFER_S) - 1
+    n_ts = 0
+    n_admit_rank = 0
+    if W:
+        raw_ts = np.fromiter((r.raw_ts for r in rows), np.float64, W)
+        distinct_ts, inv_ts = np.unique(raw_ts, return_inverse=True)
+        n_ts = len(distinct_ts)
+        wl_ts[:W] = inv_ts
+        if _features.enabled("SchedulerTimestampPreemptionBuffer"):
+            wl_ts_buf[:W] = np.searchsorted(
+                distinct_ts, raw_ts + TIMESTAMP_PREEMPTION_BUFFER_S,
+                side="right") - 1
         else:
-            wl_ts_buf[w] = wl_ts[w]
-        wl_uid[w] = info.obj.uid
-        wl_evicted0[w] = info.obj.is_evicted
-        if w >= n_pending:
-            wl_admit_rank[w] = admit_rank[raw_admit[w - n_pending]]
-            for fr, q in info.usage().items():
-                if fr in fr_index:
-                    ad_usage[w, fr_index[fr]] = q
-        spec = store.cluster_queues[info.cluster_queue]
-        if not spec.resource_groups:
-            continue
-        ps_groups = {
-            ps.topology_request.podset_group_name
-            for ps in info.obj.podsets
-            if ps.topology_request is not None
-            and ps.topology_request.podset_group_name
-        }
-        if ps_groups:
-            raise UnsupportedProblem(
-                f"workload {info.key} uses podset topology groups")
-        totals: dict[str, int] = {}
-        for psr in info.total_requests:
-            for r, q in psr.requests.items():
-                totals[r] = totals.get(r, 0) + q
-        covered = {r for rg in spec.resource_groups
-                   for r in rg.covered_resources}
-        if any(q > 0 and r not in covered for r, q in totals.items()):
-            from kueue_oss_tpu.core.workload_info import (
-                ignore_undeclared_resources,
-            )
-
-            if not ignore_undeclared_resources():
-                # Undeclared resource: no option can ever fit; leave all
-                # options invalid so the solver parks it (oracle parity).
-                # Under QuotaCheckStrategy=IgnoreUndeclared the resource
-                # simply doesn't participate in quota (wl_req only ever
-                # carries declared (flavor, resource) columns).
-                continue
-        k = -1
-        for g, rg in enumerate(spec.resource_groups):
-            allowed_keys = frozenset(
-                key for fq in rg.flavors
-                for key in store.resource_flavors.get(
-                    fq.name, ResourceFlavor(name=fq.name)).node_labels)
-            for fq in rg.flavors:
-                k += 1
-                flavor = store.resource_flavors.get(fq.name)
-                if flavor is None:
-                    continue
-                # A concurrent-admission variant is pinned to one flavor
-                # (flavorassigner IsFlavorAllowedForVariant).
-                if (info.obj.allowed_flavor is not None
-                        and fq.name != info.obj.allowed_flavor):
-                    continue
-                if not _flavor_compatible(info, flavor, allowed_keys):
-                    continue
-                wl_valid[w, k] = True
-                for r, q in totals.items():
-                    if r in rg.covered_resources:
-                        wl_req[w, k, fr_index[(fq.name, r)]] = q
+            wl_ts_buf[:W] = inv_ts
+    if W > n_pending:
+        raw_admit = np.fromiter(
+            (r.admit_ts for r in rows[n_pending:]), np.float64,
+            W - n_pending)
+        distinct_admit, inv_a = np.unique(raw_admit, return_inverse=True)
+        n_admit_rank = len(distinct_admit)
+        wl_admit_rank[n_pending:W] = inv_a + 1
+        for w in range(n_pending, W):
+            r = rows[w]
+            if r.usage_fs is not None and r.usage_fs.size:
+                ad_usage[w, r.usage_fs] = r.usage_qs
 
     # ---- unit scaling ----------------------------------------------------
     # The gcd must cover every quantity that gets divided — including the
     # lending-limit-derived local_quota and subtree sums, which otherwise
-    # truncate and change availability.
-    quantities = [int(x) for arr in (nominal, borrow_limit[has_borrow],
-                                     usage0, wl_req, subtree, local_quota,
-                                     ad_usage)
-                  for x in np.asarray(arr).ravel() if x > 0]
+    # truncate and change availability. The interned shape matrix covers
+    # every wl_req row (a superset of the shapes present this export —
+    # any common divisor of the superset still divides every present
+    # quantity).
     scale = 0
-    for q in quantities:
-        scale = math.gcd(scale, q)
+    for arr in (nominal, borrow_limit[has_borrow], usage0, subtree,
+                local_quota, cache.shape_matrices()[1], ad_usage):
+        flat = np.asarray(arr, dtype=np.int64).ravel()
+        if flat.size:
+            scale = math.gcd(scale, int(np.gcd.reduce(flat)))
     scale = max(scale, 1)
 
     def scaled(a: np.ndarray) -> np.ndarray:
@@ -607,28 +804,27 @@ def export_problem(
             cq_afs[cid] = (
                 scope is not None
                 and scope.admission_mode == "UsageBasedAdmissionFairSharing")
-        weights = afs.config.resource_weights
-        from kueue_oss_tpu.core.afs import _DEFAULT_WEIGHT
+        if cq_afs.any():
+            weights = afs.config.resource_weights
+            from kueue_oss_tpu.core.afs import _DEFAULT_WEIGHT
 
-        for w, info in enumerate(all_infos):
-            cid = cq_id[info.cluster_queue]
-            if not cq_afs[cid]:
-                continue
-            wl = info.obj
-            lq_key = f"{wl.namespace}/{wl.queue_name}"
-            li = lq_index.get(lq_key)
-            if li is None:
-                li = len(lq_pen_list)
-                lq_index[lq_key] = li
-                lq_pen_list.append(float(afs.weighted_usage(lq_key, now)))
-            wl_lq[w] = li
-            total = 0.0
-            for psr in info.total_requests:
-                for r, q in psr.requests.items():
-                    total += weights.get(r, _DEFAULT_WEIGHT) * q
-            lq_w = afs.lq_weights.get(lq_key, 1.0)
-            wl_afs_penalty[w] = (total / lq_w if lq_w > 0
-                                 else np.float32(np.inf))
+            for w, r in enumerate(rows):
+                if not cq_afs[r.cid]:
+                    continue
+                lq_key = r.lq_key
+                li = lq_index.get(lq_key)
+                if li is None:
+                    li = len(lq_pen_list)
+                    lq_index[lq_key] = li
+                    lq_pen_list.append(
+                        float(afs.weighted_usage(lq_key, now)))
+                wl_lq[w] = li
+                total = 0.0
+                for rname, q in r.totals.items():
+                    total += weights.get(rname, _DEFAULT_WEIGHT) * q
+                lq_w = afs.lq_weights.get(lq_key, 1.0)
+                wl_afs_penalty[w] = (total / lq_w if lq_w > 0
+                                     else np.float32(np.inf))
     lq_penalty0 = np.asarray(lq_pen_list, dtype=np.float32)
 
     return SolverProblem(
@@ -682,8 +878,8 @@ def export_problem(
         lq_penalty0=lq_penalty0,
         cq_afs=cq_afs,
         n_resources=len(resources),
-        ts_evict_base=len(ts_rank) + 1,
-        admit_rank_base=len(admit_rank) + 2,
+        ts_evict_base=n_ts + 1,
+        admit_rank_base=n_admit_rank + 2,
         fr_list=fr_list,
         node_names=[n.name for n in nodes],
         cq_names=cq_names,

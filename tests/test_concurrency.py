@@ -242,6 +242,89 @@ class TestConcurrentSubmission:
         # the exponential SlowDown caps it near poll-cadence
         assert cycles < 200, f"serve() spun {cycles} cycles in 0.6s"
 
+    def test_serve_routes_flood_through_solver(self):
+        """The threaded serve() loop must run the same flood-to-solver
+        routing run_until_quiet has: a backlog past solver_min_backlog
+        drains through the kernel in one batched invocation while
+        submitters race the serving thread; outcomes match the host-only
+        scheduler on the same flood (capacity-bound per-CQ counts)."""
+        store = Store()
+        store.upsert_resource_flavor(ResourceFlavor(name="default"))
+        store.upsert_cohort(Cohort(name="co"))
+        for i in range(N_CQS):
+            store.upsert_cluster_queue(ClusterQueue(
+                name=f"cq{i}", cohort="co",
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources=[
+                        ResourceQuota(name="cpu", nominal=QUOTA)])])]))
+            store.upsert_local_queue(LocalQueue(
+                name=f"lq{i}", cluster_queue=f"cq{i}"))
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues, solver="auto")
+        engine = sched._solver_engine()
+        drains: list[int] = []
+        orig_drain = engine.drain
+
+        def counting_drain(*a, **k):
+            r = orig_drain(*a, **k)
+            drains.append(r.admitted)
+            return r
+
+        engine.drain = counting_drain
+
+        N_FLOOD = 1000
+        # flood half before serve starts, race the other half in
+        def make(j):
+            return Workload(
+                name=f"f{j}", queue_name=f"lq{j % N_CQS}",
+                podsets=[PodSet(name="main", count=1,
+                                requests={"cpu": 100})])
+
+        for j in range(N_FLOOD // 2):
+            store.add_workload(make(j))
+        stop = threading.Event()
+        server = threading.Thread(
+            target=sched.serve, args=(stop,), kwargs={"poll": 0.01},
+            daemon=True)
+        server.start()
+        errors: list[BaseException] = []
+
+        def submitter(lo: int, hi: int) -> None:
+            try:
+                for j in range(lo, hi):
+                    store.add_workload(make(j))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        half = N_FLOOD // 2
+        ts = [threading.Thread(target=submitter,
+                               args=(half + k * 125, half + (k + 1) * 125))
+              for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and queues.has_pending():
+            time.sleep(0.05)
+        stop.set()
+        queues.wakeup()
+        server.join(15)
+        assert not errors, errors
+        assert sum(drains) > 0, "no admissions went through the kernel"
+
+        # parity: the host-only scheduler on the same flood admits the
+        # same capacity-bound per-CQ counts (every CQ oversubscribed, no
+        # lending headroom: QUOTA/100 admissions each)
+        by_cq: dict[str, int] = {}
+        for wl in store.workloads.values():
+            if wl.is_quota_reserved:
+                cq = wl.status.admission.cluster_queue
+                by_cq[cq] = by_cq.get(cq, 0) + 1
+        per_cq = QUOTA // 100
+        assert by_cq == {f"cq{i}": per_cq for i in range(N_CQS)}, by_cq
+
     def test_wakeup_unblocks_without_work(self):
         store, queues, _ = build()
         result: list[bool] = []

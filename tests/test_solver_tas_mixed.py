@@ -1,12 +1,14 @@
 """Mixed TAS + non-TAS stores under the solver backend.
 
-A store with TAS-flavored ClusterQueues no longer disables the device
-drain wholesale: the engine exports only the non-TAS backlog (TAS
-admissions need topology assignments the kernel does not compute) and
-the host mop-up cycles after the drain place the TAS workloads through
-the full tree machinery (Scheduler.run_until_quiet solver+host
-contract; reference: the scheduler's updateAssignmentForTAS path,
-scheduler.go:759-783).
+Round 5: TAS workloads whose shapes the extended device placer supports
+(single podset, required/preferred/unconstrained, single-layer slices)
+are part of the solver backlog — quota through the kernel, placement
+through the sequential on-device placer (solver/tas_engine.py) — with
+host-machinery parity asserted. Unsupported shapes (balanced-eligible
+preferred requests under the gate, multi-layer slices, podset groups,
+multi-podset workloads) keep the CQ on the host path
+(Scheduler.run_until_quiet solver+host contract; reference: the
+scheduler's updateAssignmentForTAS path, scheduler.go:759-783).
 """
 
 from kueue_oss_tpu.api.types import (
@@ -66,7 +68,7 @@ def _mixed_store():
     return store
 
 
-def test_solver_drains_plain_cq_host_places_tas():
+def test_solver_drains_plain_cq_and_places_tas_on_device():
     store = _mixed_store()
     store.add_workload(Workload(
         name="tas-wl", queue_name="lq-tas", uid=1, creation_time=0.0,
@@ -84,30 +86,141 @@ def test_solver_drains_plain_cq_host_places_tas():
     # for a tiny backlog so the solver+host split is exercised for real
     sched = Scheduler(store, queues, solver="auto", solver_min_backlog=0)
 
-    # the engine's export must skip the TAS backlog, not reject it
+    # the supported-shape TAS backlog is part of the export (round-5
+    # production device-TAS path), not skipped for the host
     engine = sched._solver_engine()
     pending = engine.pending_backlog()
-    assert "cq-tas" not in pending
+    assert "cq-tas" in pending and len(pending["cq-tas"]) == 1
     assert len(pending["cq-plain"]) == 3
 
-    sched.run_until_quiet(now=2.0, tick=1.0)
-    for i in range(3):
-        assert store.workloads[f"default/plain-{i}"].is_quota_reserved
+    result = engine.drain(now=2.0)
+    assert "default/tas-wl" in result.admitted_keys
     tas_wl = store.workloads["default/tas-wl"]
-    assert tas_wl.is_admitted
     ta = tas_wl.status.admission.podset_assignments[0].topology_assignment
     assert ta is not None and sum(d.count for d in ta.domains) == 4
+    # required=RACK: all four pods share one rack
+    racks = {d.values[0] for d in ta.domains}
+    assert len(racks) == 1
+
+    sched.run_until_quiet(now=3.0, tick=1.0)
+    for i in range(3):
+        assert store.workloads[f"default/plain-{i}"].is_quota_reserved
 
 
-def test_tas_only_store_still_fully_host_placed():
+def test_device_tas_parity_with_host_machinery():
+    """The device placement must match what the host tree machinery
+    produces for the same sequence (domains and counts)."""
+    def submit(store):
+        store.add_workload(Workload(
+            name="a", queue_name="lq-tas", uid=1, creation_time=0.0,
+            podsets=[PodSet(name="main", count=4, requests={"cpu": 1000},
+                            topology_request=PodSetTopologyRequest(
+                                required=RACK))]))
+        store.add_workload(Workload(
+            name="b", queue_name="lq-tas", uid=2, creation_time=1.0,
+            podsets=[PodSet(name="main", count=2, requests={"cpu": 2000},
+                            topology_request=PodSetTopologyRequest(
+                                preferred=HOST))]))
+        store.add_workload(Workload(
+            name="c", queue_name="lq-tas", uid=3, creation_time=2.0,
+            podsets=[PodSet(name="main", count=2, requests={"cpu": 1000},
+                            topology_request=PodSetTopologyRequest(
+                                unconstrained=True))]))
+
+    def placements(store):
+        out = {}
+        for wl in store.workloads.values():
+            if not wl.is_quota_reserved:
+                continue
+            ta = wl.status.admission.podset_assignments[0].topology_assignment
+            assert ta is not None, wl.name
+            out[wl.name] = sorted(
+                (tuple(d.values), d.count) for d in ta.domains)
+        return out
+
+    store_h = _mixed_store()
+    submit(store_h)
+    sched_h = Scheduler(store_h, QueueManager(store_h))
+    sched_h.run_until_quiet(now=3.0, tick=1.0)
+
+    store_d = _mixed_store()
+    submit(store_d)
+    queues_d = QueueManager(store_d)
+    sched_d = Scheduler(store_d, queues_d, solver="auto",
+                        solver_min_backlog=0)
+    engine = sched_d._solver_engine()
+    result = engine.drain(now=3.0)
+    assert result.admitted == 3
+    assert placements(store_d) == placements(store_h)
+
+
+def test_unsupported_tas_shape_keeps_cq_on_host_path():
+    """A multi-podset (leader/worker-style) workload keeps its whole CQ
+    host-placed — all-or-nothing per CQ preserves FIFO order."""
+    store = _mixed_store()
+    store.add_workload(Workload(
+        name="grp", queue_name="lq-tas", uid=1, creation_time=0.0,
+        podsets=[
+            PodSet(name="driver", count=1, requests={"cpu": 500},
+                   topology_request=PodSetTopologyRequest(required=RACK)),
+            PodSet(name="workers", count=2, requests={"cpu": 1000},
+                   topology_request=PodSetTopologyRequest(required=RACK)),
+        ]))
+    store.add_workload(Workload(
+        name="simple", queue_name="lq-tas", uid=2, creation_time=1.0,
+        podsets=[PodSet(name="main", count=1, requests={"cpu": 1000},
+                        topology_request=PodSetTopologyRequest(
+                            required=RACK))]))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues, solver="auto", solver_min_backlog=0)
+    engine = sched._solver_engine()
+    assert "cq-tas" not in engine.pending_backlog()
+    sched.run_until_quiet(now=2.0, tick=1.0)
+    for name in ("grp", "simple"):
+        wl = store.workloads[f"default/{name}"]
+        assert wl.is_admitted, name
+        for psa in wl.status.admission.podset_assignments:
+            assert psa.topology_assignment is not None
+
+
+def test_tas_only_store_implied_requests_place_on_device():
     store = _mixed_store()
     store.add_workload(Workload(
         name="implied", queue_name="lq-tas", uid=1, creation_time=0.0,
         podsets=[PodSet(name="main", count=2, requests={"cpu": 1000})]))
     queues = QueueManager(store)
     sched = Scheduler(store, queues, solver="auto", solver_min_backlog=0)
+    engine = sched._solver_engine()
+    assert "cq-tas" in engine.pending_backlog()
     sched.run_until_quiet(now=1.0, tick=1.0)
     wl = store.workloads["default/implied"]
     assert wl.is_admitted
     assert (wl.status.admission.podset_assignments[0]
+            .topology_assignment is not None)
+
+
+def test_device_tas_placement_failure_falls_back_to_host():
+    """A workload the quota kernel admits but the device placer cannot
+    place (topology fragmentation) must stay pending and be resolved by
+    the host mop-up cycle — not committed without an assignment."""
+    store = _mixed_store()
+    # 4 hosts x 4000: a required-HOST podset of 1x5000 never fits a
+    # host, though CQ quota (16000) would admit it
+    store.add_workload(Workload(
+        name="toobig", queue_name="lq-tas", uid=1, creation_time=0.0,
+        podsets=[PodSet(name="main", count=1, requests={"cpu": 5000},
+                        topology_request=PodSetTopologyRequest(
+                            required=HOST))]))
+    store.add_workload(Workload(
+        name="fits", queue_name="lq-tas", uid=2, creation_time=1.0,
+        podsets=[PodSet(name="main", count=1, requests={"cpu": 1000},
+                        topology_request=PodSetTopologyRequest(
+                            required=HOST))]))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues, solver="auto", solver_min_backlog=0)
+    sched.run_until_quiet(now=2.0, tick=1.0)
+    assert not store.workloads["default/toobig"].is_quota_reserved
+    fits = store.workloads["default/fits"]
+    assert fits.is_admitted
+    assert (fits.status.admission.podset_assignments[0]
             .topology_assignment is not None)
