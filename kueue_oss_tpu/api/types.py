@@ -339,6 +339,10 @@ class PodSet:
     topology_request: Optional[PodSetTopologyRequest] = None
     node_selector: dict[str, str] = field(default_factory=dict)
     tolerations: list[Toleration] = field(default_factory=list)
+    #: pod template environment, ordered (name, value) pairs; duplicates
+    #: are legal in a spec and deduplicated at Workload creation under
+    #: the SanitizePodSets gate (kube_features.go:207-212)
+    env: list[tuple[str, str]] = field(default_factory=list)
 
     def total_requests(self) -> dict[str, int]:
         return {r: q * self.count for r, q in self.requests.items()}

@@ -92,6 +92,22 @@ _DEFAULTS: dict[str, bool] = {
     "SchedulerLongRequeueInterval": False,  # scheduler.serve requeue_due
     # per-CQ/LQ label values appended to metric series (alpha, off)
     "CustomMetricLabels": False,       # metrics custom label resolution
+    # config-declared generic adapters for custom job GVKs (beta, on)
+    "MultiKueueAdaptersForCustomJobs": True,  # externalframeworks adapter
+    # kubeconfigs that skip TLS verification (deprecated, off)
+    "MultiKueueAllowInsecureKubeconfigs": False,  # cluster.KubeConfigSource
+    # ClusterProfile as a kubeconfig source (alpha, off)
+    "MultiKueueClusterProfile": False,  # cluster.KubeConfigSource
+    # dedupe env vars in podset templates at Workload creation (GA)
+    "SanitizePodSets": True,           # webhooks sanitize_podsets
+    # force-delete stuck-Terminating pods that opted in (alpha, off)
+    "FailureRecoveryPolicy": False,    # pod._finalize_terminating
+    # terminating pods release quota immediately (alpha, off)
+    "FastQuotaReleaseInPodIntegration": False,  # pod.Pod.active
+    # pods gated by a suspended parent skip the finalizer (GA)
+    "SkipFinalizersForPodsSuspendedByParent": True,  # pod.upsert_pod
+    # queue provenance labels stamped on created pods (beta, on)
+    "AssignQueueLabelsForPods": True,  # reconciler._podset_infos
 }
 
 _lock = threading.Lock()

@@ -321,6 +321,8 @@ class JobReconciler:
         if wl.status.admission is None:
             return [PodSetInfo(name=ps.name, count=ps.count)
                     for ps in wl.podsets]
+        from kueue_oss_tpu import features
+
         infos: list[PodSetInfo] = []
         for psa in wl.status.admission.podset_assignments:
             info = PodSetInfo(name=psa.name, count=psa.count)
@@ -330,6 +332,12 @@ class JobReconciler:
                     continue
                 info.node_selector.update(rf.node_labels)
                 info.tolerations.extend(rf.tolerations)
+            if features.enabled("AssignQueueLabelsForPods"):
+                # queue provenance labels on every created pod
+                # (reconciler.go:1537 assignQueueLabels)
+                info.labels["kueue.x-k8s.io/queue-name"] = wl.queue_name
+                info.labels["kueue.x-k8s.io/cluster-queue"] = (
+                    wl.status.admission.cluster_queue)
             # admission-check podSetUpdates (e.g. the provisioning
             # controller's consume-provisioning-request annotations)
             for cs in wl.status.admission_checks.values():

@@ -34,6 +34,12 @@ from kueue_oss_tpu.jobframework.registry import integration_manager
 POD_GROUP_LABEL = "kueue.x-k8s.io/pod-group-name"
 POD_GROUP_TOTAL_ANNOTATION = "kueue.x-k8s.io/pod-group-total-count"
 ADMISSION_GATE = "kueue.x-k8s.io/admission"
+#: finalizer kueue places on managed pods so quota accounting survives
+#: deletion (pod_controller.go PodFinalizer)
+KUEUE_FINALIZER = "kueue.x-k8s.io/managed"
+#: opt-in annotation for FailureRecoveryPolicy force-deletion
+#: (constants.go SafeToForcefullyDeleteAnnotationKey)
+SAFE_TO_FORCE_DELETE_ANNOTATION = "kueue.x-k8s.io/safe-to-forcefully-delete"
 
 PENDING = "Pending"
 RUNNING = "Running"
@@ -69,10 +75,37 @@ class Pod:
     phase: str = PENDING
     priority: int = 0
     creation_time: float = 0.0
+    #: kueue's finalizer protocol: a managed pod keeps accounting alive
+    #: across deletion until the controller releases it
+    finalizers: list[str] = field(default_factory=list)
+    #: set when deletion was requested; the pod is TERMINATING until its
+    #: finalizers clear (pod_controller.go DeletionTimestamp handling)
+    deletion_timestamp: Optional[float] = None
+    deletion_grace_period_s: float = 30.0
 
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
+
+    @property
+    def terminating(self) -> bool:
+        return self.deletion_timestamp is not None
+
+    def active(self, now: float) -> bool:
+        """IsActive (pod_controller.go:404-434): Running, not counted
+        once stuck terminating past its grace period — and, under
+        FastQuotaReleaseInPodIntegration, not counted the moment
+        deletion begins."""
+        from kueue_oss_tpu import features
+
+        if self.phase != RUNNING:
+            return False
+        if self.deletion_timestamp is not None:
+            if features.enabled("FastQuotaReleaseInPodIntegration"):
+                return False
+            if now - self.deletion_timestamp > self.deletion_grace_period_s:
+                return False  # stuck terminating: free the quota
+        return True
 
     @property
     def group_name(self) -> Optional[str]:
@@ -90,6 +123,10 @@ class Pod:
     def ungate(self) -> None:
         if ADMISSION_GATE in self.scheduling_gates:
             self.scheduling_gates.remove(ADMISSION_GATE)
+            # the pod starts running under kueue management: pin it so
+            # quota accounting survives deletion (finalizer protocol)
+            if KUEUE_FINALIZER not in self.finalizers:
+                self.finalizers.append(KUEUE_FINALIZER)
 
     @property
     def terminal(self) -> bool:
@@ -144,7 +181,8 @@ class PodGroupController:
     quota; total success finishes the group.
     """
 
-    def __init__(self, store, scheduler, reconciler) -> None:
+    def __init__(self, store, scheduler, reconciler,
+                 stuck_termination_timeout_s: float = 300.0) -> None:
         self.store = store
         self.scheduler = scheduler
         self.reconciler = reconciler
@@ -153,18 +191,47 @@ class PodGroupController:
         self._groups: dict[tuple[str, str], PodGroup] = {}
         #: pods excluded as excess (observed beyond the declared total)
         self.excess_pods: set[str] = set()
+        #: FailureRecoveryPolicy: terminating pods stuck past this are
+        #: force-deleted when they opted in via the
+        #: safe-to-forcefully-delete annotation
+        self.stuck_termination_timeout_s = stuck_termination_timeout_s
 
     # -- pod lifecycle -----------------------------------------------------
 
     def upsert_pod(self, pod: Pod) -> None:
+        from kueue_oss_tpu import features
+
         self.pods[pod.key] = pod
+        # finalizer protocol: kueue pins managed pods so quota accounting
+        # survives deletion (pod_controller.go PodFinalizer). A pod still
+        # gated by a suspended parent skips it — there is nothing to
+        # account for yet (SkipFinalizersForPodsSuspendedByParent, GA).
+        skip = (pod.gated
+                and features.enabled(
+                    "SkipFinalizersForPodsSuspendedByParent"))
+        if not skip and KUEUE_FINALIZER not in pod.finalizers:
+            pod.finalizers.append(KUEUE_FINALIZER)
 
     def delete_pod(self, key: str, now: float = 0.0) -> None:
+        """Deletion request: a finalized pod only becomes TERMINATING —
+        it stays tracked (and its seat accounted) until the controller
+        releases the finalizer in reconcile; an unfinalized pod goes
+        immediately."""
         pod = self.pods.get(key)
         if pod is None:
             return
+        if pod.finalizers:
+            if pod.deletion_timestamp is None:
+                pod.deletion_timestamp = now
+            if pod.group_name is not None and pod.phase != SUCCEEDED:
+                pod.phase = FAILED  # seat vacated; replacement path
+            return
+        self._remove_pod(pod, now)
+
+    def _remove_pod(self, pod: Pod, now: float) -> None:
+        self.pods.pop(pod.key, None)
+        self.excess_pods.discard(pod.key)
         if pod.group_name is None:
-            del self.pods[key]
             job = self.reconciler.jobs.get(("Pod", pod.key))
             if job is not None:
                 self.reconciler.delete_job(job, now=now)
@@ -177,12 +244,47 @@ class PodGroupController:
         if pod.phase not in (SUCCEEDED,):
             pod.phase = FAILED
 
+    def _finalize_terminating(self, now: float) -> None:
+        """Release finalizers of terminating pods whose accounting is
+        settled (terminal phase, excess, or owning job finished), and
+        force-delete stuck terminators that opted in under
+        FailureRecoveryPolicy (pod_termination_controller.go:60-263)."""
+        from kueue_oss_tpu import features
+
+        frp = features.enabled("FailureRecoveryPolicy")
+        for pod in list(self.pods.values()):
+            if not pod.terminating:
+                continue
+            settled = pod.terminal or pod.key in self.excess_pods
+            if not settled and pod.group_name is not None:
+                job = self._groups.get((pod.namespace, pod.group_name))
+                settled = job is not None and job.is_finished
+            stuck = (now - pod.deletion_timestamp
+                     >= self.stuck_termination_timeout_s)
+            force = (frp and stuck
+                     and pod.annotations.get(
+                         SAFE_TO_FORCE_DELETE_ANNOTATION) == "true")
+            if settled or force:
+                pod.finalizers = [f for f in pod.finalizers
+                                  if f != KUEUE_FINALIZER]
+                if not pod.finalizers:
+                    self._remove_pod(pod, now)
+
     def mark_phase(self, key: str, phase: str) -> None:
         self.pods[key].phase = phase
 
     # -- reconcile ---------------------------------------------------------
 
     def reconcile(self, now: float) -> None:
+        from kueue_oss_tpu import features
+
+        # a pod whose gate was removed is actually managed now: pin it
+        # (the upsert-time skip only covers suspended-parent gating)
+        for p in self.pods.values():
+            if (not p.gated and not p.terminating
+                    and KUEUE_FINALIZER not in p.finalizers):
+                p.finalizers.append(KUEUE_FINALIZER)
+        self._finalize_terminating(now)
         singles = [p for p in self.pods.values() if p.group_name is None]
         for pod in singles:
             self._reconcile_single(pod, now)
@@ -304,8 +406,13 @@ class PodGroupController:
                 job.mark_finished(success=False,
                                   message="pod group failed")
         elif any(p.phase == RUNNING for p in seated):
-            job.mark_running(ready=all(
-                p.phase in (RUNNING, SUCCEEDED) for p in seated))
+            # activity honors termination state: a terminating pod stops
+            # counting under FastQuotaReleaseInPodIntegration (or once
+            # stuck past its grace period), releasing the workload's
+            # active claim (pod_controller.go IsActive)
+            job.active_pods = sum(1 for p in seated if p.active(now))
+            job.ready_pods = sum(1 for p in seated
+                                 if p.phase in (RUNNING, SUCCEEDED))
 
     def _sync_group_gates(self, ns: str, name: str,
                           members: list[Pod]) -> None:

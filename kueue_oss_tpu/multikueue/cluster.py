@@ -25,10 +25,52 @@ class WorkerEnvironment:
         self.store = store or Store()
         self.queues = QueueManager(self.store)
         self.scheduler = Scheduler(self.store, self.queues)
+        #: mirrored external-framework job objects keyed by "ns/name"
+        #: (externalframeworks.ExternalJobObject)
+        self.external_jobs: dict = {}
 
     def run_cycle(self, now: float):
         """One worker scheduling cycle (the driver/test advances workers)."""
         return self.scheduler.schedule(now)
+
+
+class InsecureKubeConfig(Exception):
+    """Raised for kubeconfig sources the gates forbid."""
+
+
+@dataclass
+class KubeConfigSource:
+    """Where a worker cluster's kubeconfig comes from
+    (MultiKueueCluster.spec.kubeConfig; multikueuecluster.go secret
+    loading + the ClusterProfile alternative).
+
+    ``location_type``: "Secret" | "Path" | "ClusterProfile".
+    ``insecure``: the loaded config skips TLS verification
+    (rest.Config.Insecure) — rejected unless the
+    MultiKueueAllowInsecureKubeconfigs gate is on.
+    """
+
+    location: str = ""
+    location_type: str = "Secret"
+    insecure: bool = False
+
+    def validate(self) -> None:
+        from kueue_oss_tpu import features
+
+        if (self.location_type == "ClusterProfile"
+                and not features.enabled("MultiKueueClusterProfile")):
+            raise InsecureKubeConfig(
+                "ClusterProfile kubeconfig sources need the "
+                "MultiKueueClusterProfile feature gate")
+        if self.location_type not in ("Secret", "Path", "ClusterProfile"):
+            raise InsecureKubeConfig(
+                f"unknown kubeconfig location type {self.location_type!r}")
+        if (self.insecure
+                and not features.enabled(
+                    "MultiKueueAllowInsecureKubeconfigs")):
+            raise InsecureKubeConfig(
+                "kubeconfig skips TLS verification; enable "
+                "MultiKueueAllowInsecureKubeconfigs to allow it")
 
 
 @dataclass
@@ -40,6 +82,14 @@ class MultiKueueCluster:
     #: connectivity (reference: cluster Active condition)
     active: bool = True
     last_seen: float = 0.0
+    #: how the connection is configured; validated against the
+    #: MultiKueueAllowInsecureKubeconfigs / MultiKueueClusterProfile
+    #: gates when set (None = in-process test cluster, always allowed)
+    kubeconfig: Optional[KubeConfigSource] = None
+
+    def __post_init__(self) -> None:
+        if self.kubeconfig is not None:
+            self.kubeconfig.validate()
 
     def mark_seen(self, now: float) -> None:
         self.last_seen = now

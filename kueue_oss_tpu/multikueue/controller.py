@@ -34,13 +34,23 @@ class MultiKueueController:
                  clusters: list[MultiKueueCluster],
                  dispatcher=None,
                  worker_lost_timeout_s: float = 900.0,
-                 check_name: str = "multikueue") -> None:
+                 check_name: str = "multikueue",
+                 external_adapters=None,
+                 hub_jobs: Optional[dict] = None) -> None:
         self.store = hub_store
         self.scheduler = hub_scheduler
         self.clusters = {c.name: c for c in clusters}
         self.dispatcher = dispatcher or AllAtOnceDispatcher()
         self.worker_lost_timeout_s = worker_lost_timeout_s
         self.check_name = check_name
+        #: config-declared generic adapters for custom job GVKs
+        #: (externalframeworks.new_adapters); each syncs its job object
+        #: alongside the workload mirror
+        self.external_adapters = external_adapters or []
+        #: hub-side external job objects keyed by "ns/name"
+        self.hub_jobs = hub_jobs if hub_jobs is not None else {}
+        #: origin label value stamped on mirrored objects
+        self.store_name = "hub"
 
     # -- main loop ----------------------------------------------------------
 
@@ -84,6 +94,19 @@ class MultiKueueController:
         state = wl.status.admission_checks.get(self.check_name)
         if state is None:
             return
+
+        # External-framework job (config-declared adapter): refuse to
+        # dispatch unless the custom job delegates to the MultiKueue
+        # controller via .spec.managedBy (adapter.go IsJobManagedByKueue,
+        # gated by MultiKueueAdaptersForCustomJobs).
+        ext = self._external_job_for(wl)
+        if ext is not None:
+            adapter, job = ext
+            managed, reason = adapter.is_job_managed_by_kueue(
+                self.hub_jobs, job.key)
+            if not managed:
+                state.message = reason
+                return
 
         winner = wl.status.cluster_name
         if winner is not None:
@@ -153,6 +176,18 @@ class MultiKueueController:
             return
         if cluster is None or not cluster.active:
             return  # transiently unreachable; wait for the timeout
+        # external-framework job: pull the whole remote status back to
+        # the hub object (adapter.go syncStatus default behavior)
+        ext = self._external_job_for(wl)
+        if ext is not None:
+            adapter, job = ext
+            try:
+                adapter.sync_job(self.hub_jobs,
+                                 cluster.environment.external_jobs,
+                                 job.key, workload_name=wl.name,
+                                 origin=self.store_name)
+            except KeyError:
+                pass
         mirror = cluster.environment.store.workloads.get(wl.key)
         if mirror is None:
             # Mirror vanished on the worker: retry admission.
@@ -208,8 +243,32 @@ class MultiKueueController:
 
     # -- mirroring ----------------------------------------------------------
 
+    def _external_job_for(self, wl: Workload):
+        """(adapter, hub job) bound to this workload via the prebuilt
+        label, when a config-declared adapter covers the job's GVK."""
+        if not self.external_adapters or not self.hub_jobs:
+            return None
+        from kueue_oss_tpu.multikueue.externalframeworks import (
+            PREBUILT_WORKLOAD_LABEL,
+            find_adapter,
+        )
+
+        for job in self.hub_jobs.values():
+            if (job.namespace == wl.namespace
+                    and job.labels.get(PREBUILT_WORKLOAD_LABEL) == wl.name):
+                adapter = find_adapter(self.external_adapters, job.gvk)
+                if adapter is not None:
+                    return adapter, job
+        return None
+
     def _ensure_mirror(self, wl: Workload,
                        cluster: MultiKueueCluster) -> None:
+        ext = self._external_job_for(wl)
+        if ext is not None:
+            adapter, job = ext
+            adapter.sync_job(self.hub_jobs,
+                             cluster.environment.external_jobs, job.key,
+                             workload_name=wl.name, origin=self.store_name)
         wstore = cluster.environment.store
         if wl.key in wstore.workloads:
             return
@@ -233,9 +292,14 @@ class MultiKueueController:
         wstore.add_workload(mirror)
 
     def _cleanup_remotes(self, wl: Workload, keep: Optional[str]) -> None:
+        ext = self._external_job_for(wl)
         for name, cluster in self.clusters.items():
             if name == keep or not cluster.active:
                 continue
+            if ext is not None:
+                adapter, job = ext
+                adapter.delete_remote_object(
+                    cluster.environment.external_jobs, job.key)
             wstore = cluster.environment.store
             mirror = wstore.workloads.get(wl.key)
             if mirror is None:

@@ -1,0 +1,184 @@
+"""Config-declared MultiKueue adapters for external (custom) job GVKs.
+
+A Configuration can name job kinds kueue has no built-in integration for
+(``multiKueue.externalFrameworks: [{name: "Kind.v1.example.com"}]``);
+each entry gets a GENERIC adapter with the KEP's default behavior: the
+job object is mirrored to the worker verbatim (minus ``spec.managedBy``,
+plus the prebuilt-workload and origin labels) and its whole ``status``
+is copied back from the remote. Gated by MultiKueueAdaptersForCustomJobs.
+
+Reference parity:
+pkg/controller/admissionchecks/multikueue/externalframeworks/adapter.go:1-232
+(SyncJob/createRemoteObject/syncStatus/DeleteRemoteObject/
+IsJobManagedByKueue/WorkloadKeysFor) and config.go:1-71
+(NewAdapters GVK parse + duplicate aggregation).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu.multikueue.controller import MULTIKUEUE_CONTROLLER_NAME
+
+#: label binding a mirrored job object to its (prebuilt) Workload
+PREBUILT_WORKLOAD_LABEL = "kueue.x-k8s.io/prebuilt-workload-name"
+#: label marking the hub that owns a mirrored object
+MULTIKUEUE_ORIGIN_LABEL = "kueue.x-k8s.io/multikueue-origin"
+
+
+@dataclass(frozen=True)
+class GVK:
+    group: str
+    version: str
+    kind: str
+
+    def __str__(self) -> str:  # "Kind.version.group"
+        return f"{self.kind}.{self.version}.{self.group}"
+
+
+@dataclass
+class MultiKueueExternalFramework:
+    """Configuration.multiKueue.externalFrameworks entry."""
+
+    name: str  # "Kind.version.group"
+
+
+def parse_gvk(name: str) -> GVK:
+    """Parse "Kind.version.group" (schema.ParseKindArg semantics)."""
+    if not name:
+        raise ValueError("name is required")
+    parts = name.split(".", 2)
+    if len(parts) != 3 or not all(parts):
+        raise ValueError(f"invalid GVK format '{name}'")
+    kind, version, group = parts
+    return GVK(group=group, version=version, kind=kind)
+
+
+def new_adapters(
+    configs: list[MultiKueueExternalFramework],
+) -> list["ExternalAdapter"]:
+    """Adapters from config entries; invalid or duplicate entries are
+    aggregated into one error (config.go NewAdapters)."""
+    seen: dict[GVK, MultiKueueExternalFramework] = {}
+    errs: list[str] = []
+    for cfg in configs:
+        try:
+            gvk = parse_gvk(cfg.name)
+        except ValueError as e:
+            errs.append(
+                f"invalid external framework configuration for "
+                f"{cfg.name!r}: {e}")
+            continue
+        if gvk in seen:
+            errs.append(f"duplicate configuration for GVK {gvk}")
+            continue
+        seen[gvk] = cfg
+    if errs:
+        raise ValueError("; ".join(errs))
+    return [ExternalAdapter(gvk) for gvk in seen]
+
+
+@dataclass
+class ExternalJobObject:
+    """Unstructured job analog: an opaque spec/status under a GVK."""
+
+    gvk: GVK
+    name: str = ""
+    namespace: str = "default"
+    labels: dict[str, str] = field(default_factory=dict)
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class ExternalAdapter:
+    """Generic MultiKueue adapter for one external GVK."""
+
+    def __init__(self, gvk: GVK) -> None:
+        self.gvk = gvk
+
+    # -- sync --------------------------------------------------------------
+
+    def sync_job(self, local_jobs: dict[str, ExternalJobObject],
+                 remote_jobs: dict[str, ExternalJobObject], key: str,
+                 workload_name: str, origin: str) -> None:
+        """Ensure the remote object exists; sync status back otherwise
+        (adapter.go SyncJob)."""
+        local = local_jobs.get(key)
+        if local is None:
+            raise KeyError(f"{self.gvk} {key} not found locally")
+        remote = remote_jobs.get(key)
+        if remote is None:
+            mirror = copy.deepcopy(local)
+            # default transformation: strip .spec.managedBy, label with
+            # the prebuilt workload + origin (createRemoteObject)
+            mirror.spec.pop("managedBy", None)
+            mirror.labels[PREBUILT_WORKLOAD_LABEL] = workload_name
+            mirror.labels[MULTIKUEUE_ORIGIN_LABEL] = origin
+            remote_jobs[key] = mirror
+            return
+        # default status sync: copy the entire remote status to local
+        if remote.status and local.status != remote.status:
+            local.status = copy.deepcopy(remote.status)
+
+    def delete_remote_object(
+            self, remote_jobs: dict[str, ExternalJobObject],
+            key: str) -> None:
+        remote_jobs.pop(key, None)
+
+    # -- management gate ---------------------------------------------------
+
+    def is_job_managed_by_kueue(
+            self, jobs: dict[str, ExternalJobObject],
+            key: str) -> tuple[bool, str]:
+        """(managed, reason) — default .spec.managedBy path, behind the
+        MultiKueueAdaptersForCustomJobs gate (adapter.go:168-193)."""
+        from kueue_oss_tpu import features
+
+        if not features.enabled("MultiKueueAdaptersForCustomJobs"):
+            return (False,
+                    "MultiKueueAdaptersForCustomJobs feature gate is "
+                    "disabled")
+        obj = jobs.get(key)
+        if obj is None:
+            raise KeyError(f"{self.gvk} {key} not found")
+        managed_by = obj.spec.get("managedBy")
+        if managed_by != MULTIKUEUE_CONTROLLER_NAME:
+            return (False,
+                    f"Expecting .spec.managedBy to be "
+                    f"{MULTIKUEUE_CONTROLLER_NAME!r} not {managed_by!r}")
+        return True, ""
+
+    # -- watcher surface ---------------------------------------------------
+
+    def workload_keys_for(self, obj: ExternalJobObject) -> list[str]:
+        """Workload keys of interest for a watched object
+        (adapter.go WorkloadKeysFor)."""
+        if obj.gvk != self.gvk:
+            raise ValueError(
+                f"unexpected GVK: expected {self.gvk}, got {obj.gvk}")
+        prebuilt = obj.labels.get(PREBUILT_WORKLOAD_LABEL)
+        if not prebuilt:
+            raise ValueError(
+                f"no prebuilt workload found for {self.gvk.kind}: "
+                f"{obj.key}")
+        return [f"{obj.namespace}/{prebuilt}"]
+
+    def list_objects(
+            self, jobs: dict[str, ExternalJobObject],
+    ) -> list[ExternalJobObject]:
+        """All objects of this adapter's GVK (GetEmptyList analog)."""
+        return [o for o in jobs.values() if o.gvk == self.gvk]
+
+
+def find_adapter(adapters: list[ExternalAdapter],
+                 gvk: GVK) -> Optional[ExternalAdapter]:
+    for a in adapters:
+        if a.gvk == gvk:
+            return a
+    return None

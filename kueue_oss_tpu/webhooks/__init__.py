@@ -206,11 +206,40 @@ def validate_local_queue_update(old: LocalQueue, new: LocalQueue) -> list[str]:
 # ---------------------------------------------------------------------------
 
 
+def sanitize_podsets(wl: Workload) -> bool:
+    """Deduplicate env-var entries in podset templates, keeping only the
+    LAST occurrence of each name, so workload creation succeeds even
+    when the spec carries duplicates (SanitizePodSets gate,
+    kube_features.go:207-212). Returns True if anything changed."""
+    from kueue_oss_tpu import features
+
+    if not features.enabled("SanitizePodSets"):
+        return False
+    changed = False
+    for ps in wl.podsets:
+        if not ps.env:
+            continue
+        seen: set[str] = set()
+        deduped: list[tuple[str, str]] = []
+        for name, value in reversed(ps.env):
+            if name in seen:
+                continue
+            seen.add(name)
+            deduped.append((name, value))
+        deduped.reverse()
+        if deduped != ps.env:
+            ps.env = deduped
+            changed = True
+    return changed
+
+
 def default_workload(wl: Workload, store: Optional[Store] = None) -> None:
-    """Defaulting: podset names, priority from WorkloadPriorityClass."""
+    """Defaulting: podset names, priority from WorkloadPriorityClass,
+    podset-template sanitization (SanitizePodSets)."""
     for i, ps in enumerate(wl.podsets):
         if not ps.name:
             ps.name = "main" if i == 0 else f"ps{i}"
+    sanitize_podsets(wl)
     if store is not None and wl.priority_class and wl.priority == 0:
         pc = store.priority_classes.get(wl.priority_class)
         if pc is not None:

@@ -1,0 +1,208 @@
+"""Round-5 feature-gate surfaces.
+
+SanitizePodSets (webhook env dedup), pod finalizer protocol +
+FailureRecoveryPolicy force-deletion, FastQuotaReleaseInPodIntegration,
+SkipFinalizersForPodsSuspendedByParent, AssignQueueLabelsForPods.
+
+Reference parity: kube_features.go:207-212 (SanitizePodSets),
+pod_controller.go:404-434 (IsActive), constants.go:47-50
+(safe-to-forcefully-delete), reconciler.go:1537 (assignQueueLabels).
+"""
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.jobframework.reconciler import JobReconciler
+from kueue_oss_tpu.jobs.pod import (
+    KUEUE_FINALIZER,
+    RUNNING,
+    SAFE_TO_FORCE_DELETE_ANNOTATION,
+    Pod,
+    PodGroupController,
+)
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.webhooks import default_workload, sanitize_podsets
+
+
+@pytest.fixture(autouse=True)
+def _reset_gates():
+    yield
+    features.reset()
+
+
+class TestSanitizePodSets:
+    def test_dedupes_keeping_last_occurrence(self):
+        wl = Workload(name="w", podsets=[PodSet(
+            name="main", count=1, requests={"cpu": 100},
+            env=[("A", "1"), ("B", "2"), ("A", "3")])])
+        assert sanitize_podsets(wl)
+        assert wl.podsets[0].env == [("B", "2"), ("A", "3")]
+
+    def test_gate_off_leaves_duplicates(self):
+        features.set_gates({"SanitizePodSets": False})
+        wl = Workload(name="w", podsets=[PodSet(
+            name="main", count=1, env=[("A", "1"), ("A", "3")])])
+        assert not sanitize_podsets(wl)
+        assert wl.podsets[0].env == [("A", "1"), ("A", "3")]
+
+    def test_defaulting_path_sanitizes(self):
+        wl = Workload(name="w", podsets=[PodSet(
+            name="main", count=1, env=[("X", "a"), ("X", "b")])])
+        default_workload(wl)
+        assert wl.podsets[0].env == [("X", "b")]
+
+
+def _env():
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=10_000)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    rec = JobReconciler(store, sched)
+    return store, sched, PodGroupController(store, sched, rec)
+
+
+def _group_pods(n=2, total=2, annotations=None):
+    return [Pod(name=f"p{i}", queue_name="lq",
+                requests={"cpu": 100},
+                labels={"kueue.x-k8s.io/pod-group-name": "g"},
+                annotations={"kueue.x-k8s.io/pod-group-total-count":
+                             str(total), **(annotations or {})},
+                creation_time=float(i))
+            for i in range(n)]
+
+
+class TestPodFinalizerProtocol:
+    def test_gated_pod_skips_finalizer_then_pins_on_ungate(self):
+        store, sched, ctrl = _env()
+        for p in _group_pods():
+            ctrl.upsert_pod(p)
+        # gated by the (suspended) parent: no finalizer yet (GA gate)
+        assert all(not p.finalizers for p in ctrl.pods.values())
+        ctrl.reconcile(now=1.0)
+        sched.run_until_quiet(now=2.0, tick=1.0)
+        ctrl.reconcile(now=3.0)
+        assert all(not p.gated for p in ctrl.pods.values())
+        assert all(KUEUE_FINALIZER in p.finalizers
+                   for p in ctrl.pods.values())
+
+    def test_gate_off_pins_immediately(self):
+        features.set_gates(
+            {"SkipFinalizersForPodsSuspendedByParent": False})
+        store, sched, ctrl = _env()
+        for p in _group_pods():
+            ctrl.upsert_pod(p)
+        assert all(KUEUE_FINALIZER in p.finalizers
+                   for p in ctrl.pods.values())
+
+    def test_finalized_pod_terminates_instead_of_vanishing(self):
+        store, sched, ctrl = _env()
+        for p in _group_pods():
+            ctrl.upsert_pod(p)
+        ctrl.reconcile(now=1.0)
+        sched.run_until_quiet(now=2.0, tick=1.0)
+        ctrl.reconcile(now=3.0)
+        ctrl.delete_pod("default/p0", now=4.0)
+        pod = ctrl.pods["default/p0"]
+        assert pod.terminating and pod.key in ctrl.pods
+        # terminal + terminating => finalizer released on next pass
+        ctrl.reconcile(now=5.0)
+        assert "default/p0" not in ctrl.pods
+
+    def test_stuck_terminating_force_deleted_under_policy(self):
+        features.set_gates({"FailureRecoveryPolicy": True})
+        store, sched, ctrl = _env()
+        pods = _group_pods(
+            annotations={SAFE_TO_FORCE_DELETE_ANNOTATION: "true"})
+        for p in pods:
+            ctrl.upsert_pod(p)
+        ctrl.reconcile(now=1.0)
+        sched.run_until_quiet(now=2.0, tick=1.0)
+        ctrl.reconcile(now=3.0)
+        pod = ctrl.pods["default/p0"]
+        pod.phase = RUNNING
+        # deletion requested but the pod never leaves Running (stuck
+        # terminating on a dead node); keep it non-terminal
+        pod.finalizers.append("example.com/guard")
+        ctrl.delete_pod("default/p0", now=10.0)
+        pod.phase = RUNNING
+        ctrl.reconcile(now=20.0)
+        assert "default/p0" in ctrl.pods  # within the timeout: kept
+        ctrl.reconcile(now=10.0 + 301.0)
+        pod = ctrl.pods.get("default/p0")
+        # kueue's finalizer is gone; the pod survives only on the
+        # foreign finalizer (apiserver would drop it once that clears)
+        assert pod is None or KUEUE_FINALIZER not in pod.finalizers
+
+    def test_stuck_terminating_kept_without_optin(self):
+        features.set_gates({"FailureRecoveryPolicy": True})
+        store, sched, ctrl = _env()
+        for p in _group_pods():
+            ctrl.upsert_pod(p)
+        ctrl.reconcile(now=1.0)
+        sched.run_until_quiet(now=2.0, tick=1.0)
+        ctrl.reconcile(now=3.0)
+        pod = ctrl.pods["default/p0"]
+        ctrl.delete_pod("default/p0", now=10.0)
+        pod.phase = RUNNING  # stuck; no safe-to-force-delete annotation
+        ctrl.reconcile(now=10.0 + 301.0)
+        assert KUEUE_FINALIZER in ctrl.pods["default/p0"].finalizers
+
+
+class TestFastQuotaRelease:
+    def test_terminating_running_pod_counts_active_by_default(self):
+        p = Pod(name="p", requests={"cpu": 100})
+        p.phase = RUNNING
+        p.deletion_timestamp = 100.0
+        assert p.active(now=101.0)
+        # ...until stuck past its grace period
+        assert not p.active(now=100.0 + p.deletion_grace_period_s + 1)
+
+    def test_gate_releases_immediately(self):
+        features.set_gates({"FastQuotaReleaseInPodIntegration": True})
+        p = Pod(name="p", requests={"cpu": 100})
+        p.phase = RUNNING
+        p.deletion_timestamp = 100.0
+        assert not p.active(now=100.5)
+
+
+class TestAssignQueueLabelsForPods:
+    def _admitted_workload(self):
+        store, sched, _ = _env()
+        wl = Workload(name="w", queue_name="lq", uid=1,
+                      podsets=[PodSet(name="main", count=1,
+                                      requests={"cpu": 100})])
+        store.add_workload(wl)
+        sched.run_until_quiet(now=1.0, tick=1.0)
+        assert wl.is_quota_reserved
+        rec = JobReconciler(store, sched)
+        return rec, wl
+
+    def test_queue_labels_injected(self):
+        rec, wl = self._admitted_workload()
+        infos = rec._podset_infos(wl)
+        assert infos[0].labels["kueue.x-k8s.io/queue-name"] == "lq"
+        assert infos[0].labels["kueue.x-k8s.io/cluster-queue"] == "cq"
+
+    def test_gate_off_no_labels(self):
+        features.set_gates({"AssignQueueLabelsForPods": False})
+        rec, wl = self._admitted_workload()
+        infos = rec._podset_infos(wl)
+        assert "kueue.x-k8s.io/queue-name" not in infos[0].labels

@@ -1,0 +1,239 @@
+"""MultiKueue external-framework adapters (config-declared custom GVKs).
+
+Reference parity:
+pkg/controller/admissionchecks/multikueue/externalframeworks/adapter.go
+(generic sync/status/delete/managed-by behavior), config.go (GVK parse +
+aggregation), and the MultiKueueAdaptersForCustomJobs /
+MultiKueueAllowInsecureKubeconfigs / MultiKueueClusterProfile gates.
+"""
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import (
+    AdmissionCheckState,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.multikueue.cluster import (
+    InsecureKubeConfig,
+    KubeConfigSource,
+    MultiKueueCluster,
+    WorkerEnvironment,
+)
+from kueue_oss_tpu.multikueue.controller import (
+    MULTIKUEUE_CONTROLLER_NAME,
+    MultiKueueController,
+)
+from kueue_oss_tpu.multikueue.externalframeworks import (
+    PREBUILT_WORKLOAD_LABEL,
+    ExternalJobObject,
+    GVK,
+    MultiKueueExternalFramework,
+    new_adapters,
+    parse_gvk,
+)
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+@pytest.fixture(autouse=True)
+def _reset_gates():
+    yield
+    features.reset()
+
+
+class TestConfigParsing:
+    def test_parse_gvk(self):
+        gvk = parse_gvk("TFJob.v1.kubeflow.org")
+        assert gvk == GVK(group="kubeflow.org", version="v1", kind="TFJob")
+
+    def test_parse_rejects_empty_and_malformed(self):
+        with pytest.raises(ValueError, match="name is required"):
+            parse_gvk("")
+        with pytest.raises(ValueError, match="invalid GVK format"):
+            parse_gvk("JustAKind")
+
+    def test_new_adapters_aggregates_errors(self):
+        with pytest.raises(ValueError) as e:
+            new_adapters([
+                MultiKueueExternalFramework(name="Bad"),
+                MultiKueueExternalFramework(name="TFJob.v1.kubeflow.org"),
+                MultiKueueExternalFramework(name="TFJob.v1.kubeflow.org"),
+            ])
+        msg = str(e.value)
+        assert "invalid GVK format" in msg and "duplicate" in msg
+
+    def test_new_adapters_builds_one_per_gvk(self):
+        adapters = new_adapters([
+            MultiKueueExternalFramework(name="TFJob.v1.kubeflow.org"),
+            MultiKueueExternalFramework(name="FooJob.v2.example.com"),
+        ])
+        assert {str(a.gvk) for a in adapters} == {
+            "TFJob.v1.kubeflow.org", "FooJob.v2.example.com"}
+
+
+def _hub(jobs, adapters):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_cluster_queue(ClusterQueue(
+        name="cq", admission_checks=["multikueue"],
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=8000)])])]))
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    workers = [MultiKueueCluster(name=f"w{i}",
+                                 environment=WorkerEnvironment(f"w{i}"))
+               for i in range(2)]
+    for w in workers:
+        w.environment.store.upsert_resource_flavor(
+            ResourceFlavor(name="default"))
+        w.environment.store.upsert_cluster_queue(ClusterQueue(
+            name="cq", resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources=[
+                    ResourceQuota(name="cpu", nominal=8000)])])]))
+        w.environment.store.upsert_local_queue(
+            LocalQueue(name="lq", cluster_queue="cq"))
+    ctrl = MultiKueueController(store, sched, workers,
+                                external_adapters=adapters,
+                                hub_jobs=jobs)
+    return store, sched, workers, ctrl
+
+
+def _reserve(store, sched, wl):
+    store.add_workload(wl)
+    sched.run_until_quiet(now=1.0, tick=1.0)
+    assert wl.is_quota_reserved
+    assert "multikueue" in wl.status.admission_checks
+
+
+def _mk_ext_job(name="tf-0", managed=True):
+    gvk = parse_gvk("TFJob.v1.kubeflow.org")
+    return ExternalJobObject(
+        gvk=gvk, name=name, namespace="default",
+        labels={PREBUILT_WORKLOAD_LABEL: f"wl-{name}"},
+        spec={"managedBy": MULTIKUEUE_CONTROLLER_NAME if managed else "other",
+              "replicas": 3},
+        status={"phase": "Created"},
+    )
+
+
+def test_external_job_mirrors_and_syncs_status():
+    job = _mk_ext_job()
+    jobs = {job.key: job}
+    adapters = new_adapters(
+        [MultiKueueExternalFramework(name="TFJob.v1.kubeflow.org")])
+    store, sched, workers, ctrl = _hub(jobs, adapters)
+    wl = Workload(name="wl-tf-0", queue_name="lq", uid=1,
+                  podsets=[PodSet(name="main", count=3,
+                                  requests={"cpu": 100})])
+    _reserve(store, sched, wl)
+
+    ctrl.reconcile_all(now=2.0)
+    # mirrored to both nominated workers, managedBy stripped, labels set
+    for w in workers:
+        mirror = w.environment.external_jobs.get(job.key)
+        assert mirror is not None
+        assert "managedBy" not in mirror.spec
+        assert mirror.labels[PREBUILT_WORKLOAD_LABEL] == "wl-tf-0"
+        assert mirror.spec["replicas"] == 3
+
+    # a worker admits its mirror workload; the other mirror is withdrawn
+    for w in workers:
+        w.environment.scheduler.run_until_quiet(now=3.0, tick=1.0)
+    ctrl.reconcile_all(now=4.0)
+    winner = wl.status.cluster_name
+    assert winner is not None
+    loser = next(w for w in workers if w.name != winner)
+    assert job.key not in loser.environment.external_jobs
+
+    # remote status flows back to the hub object wholesale
+    wenv = next(w for w in workers if w.name == winner).environment
+    wenv.external_jobs[job.key].status = {"phase": "Running", "ready": 3}
+    ctrl.reconcile_all(now=5.0)
+    assert job.status == {"phase": "Running", "ready": 3}
+
+
+def test_unmanaged_external_job_blocks_dispatch():
+    job = _mk_ext_job(managed=False)
+    jobs = {job.key: job}
+    adapters = new_adapters(
+        [MultiKueueExternalFramework(name="TFJob.v1.kubeflow.org")])
+    store, sched, workers, ctrl = _hub(jobs, adapters)
+    wl = Workload(name="wl-tf-0", queue_name="lq", uid=1,
+                  podsets=[PodSet(name="main", count=3,
+                                  requests={"cpu": 100})])
+    _reserve(store, sched, wl)
+    ctrl.reconcile_all(now=2.0)
+    for w in workers:
+        assert job.key not in w.environment.external_jobs
+    state = wl.status.admission_checks["multikueue"]
+    assert "managedBy" in state.message
+
+
+def test_gate_off_blocks_custom_adapters():
+    features.set_gates({"MultiKueueAdaptersForCustomJobs": False})
+    job = _mk_ext_job(managed=True)
+    jobs = {job.key: job}
+    adapters = new_adapters(
+        [MultiKueueExternalFramework(name="TFJob.v1.kubeflow.org")])
+    store, sched, workers, ctrl = _hub(jobs, adapters)
+    wl = Workload(name="wl-tf-0", queue_name="lq", uid=1,
+                  podsets=[PodSet(name="main", count=3,
+                                  requests={"cpu": 100})])
+    _reserve(store, sched, wl)
+    ctrl.reconcile_all(now=2.0)
+    for w in workers:
+        assert job.key not in w.environment.external_jobs
+    state = wl.status.admission_checks["multikueue"]
+    assert "feature gate is disabled" in state.message
+
+
+def test_workload_keys_for_reads_prebuilt_label():
+    adapters = new_adapters(
+        [MultiKueueExternalFramework(name="TFJob.v1.kubeflow.org")])
+    job = _mk_ext_job()
+    assert adapters[0].workload_keys_for(job) == ["default/wl-tf-0"]
+    bare = ExternalJobObject(gvk=job.gvk, name="x", namespace="default")
+    with pytest.raises(ValueError, match="no prebuilt workload"):
+        adapters[0].workload_keys_for(bare)
+
+
+class TestKubeConfigGates:
+    def test_insecure_kubeconfig_rejected_by_default(self):
+        with pytest.raises(InsecureKubeConfig, match="TLS"):
+            MultiKueueCluster(
+                name="w", environment=WorkerEnvironment("w"),
+                kubeconfig=KubeConfigSource(location="sec",
+                                            insecure=True))
+
+    def test_insecure_kubeconfig_allowed_with_gate(self):
+        features.set_gates({"MultiKueueAllowInsecureKubeconfigs": True})
+        c = MultiKueueCluster(
+            name="w", environment=WorkerEnvironment("w"),
+            kubeconfig=KubeConfigSource(location="sec", insecure=True))
+        assert c.kubeconfig.insecure
+
+    def test_cluster_profile_needs_gate(self):
+        with pytest.raises(InsecureKubeConfig, match="ClusterProfile"):
+            MultiKueueCluster(
+                name="w", environment=WorkerEnvironment("w"),
+                kubeconfig=KubeConfigSource(
+                    location="prof", location_type="ClusterProfile"))
+        features.set_gates({"MultiKueueClusterProfile": True})
+        c = MultiKueueCluster(
+            name="w", environment=WorkerEnvironment("w"),
+            kubeconfig=KubeConfigSource(
+                location="prof", location_type="ClusterProfile"))
+        assert c.kubeconfig.location_type == "ClusterProfile"

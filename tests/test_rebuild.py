@@ -93,8 +93,6 @@ def test_restart_converges_to_same_final_state(seed):
     queues_b = QueueManager(store_b)
     sched_b = Scheduler(store_b, queues_b)
     cb = sched_b.run_until_quiet(now=300.0, max_cycles=300, tick=1.0)
-    if ca >= 300 or cb >= 300:
-        pytest.skip(f"seed {seed}: no quiescence (preemption ping-pong)")
 
     def final(store):
         admitted = {k for k, w in store.workloads.items()
@@ -104,6 +102,25 @@ def test_restart_converges_to_same_final_state(seed):
                 for r, f in psa.flavors.items()}
             for k in admitted for w in [store.workloads[k]]}
         return admitted, flavors
+
+    if ca >= 300 or cb >= 300:
+        # Livelock seed (preemption ping-pong): both processes run the
+        # same deterministic code over the same recreated store, so
+        # instead of quiescing they must orbit the SAME bounded limit
+        # cycle — restart changes nothing about the visited states.
+        from test_full_kernel_parity import LIMIT_CYCLE_PROBE, freeze_state
+
+        def probe(sched, store):
+            states = set()
+            for c in range(LIMIT_CYCLE_PROBE):
+                sched.schedule(now=600.0 + c)
+                states.add(freeze_state(*final(store)))
+            return states
+
+        assert probe(sched_a, store_a) == probe(sched_b, store_b), (
+            f"seed {seed}: original and restarted processes orbit "
+            f"different limit cycles")
+        return
 
     adm_a, fl_a = final(store_a)
     adm_b, fl_b = final(store_b)
