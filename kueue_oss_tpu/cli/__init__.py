@@ -54,14 +54,43 @@ def _match_selector(labels: dict, selector: str) -> bool:
     return True
 
 
-def _emit(headers: list[str], rows: list[list[str]], output: str) -> str:
-    """Render a listing as a table or as JSON (kueuectl -o json)."""
+def _emit(headers: list[str], rows: list[list[str]], output: str,
+          wide: tuple[list[str], list[list[str]]] | None = None) -> str:
+    """Render a listing as a table, JSON, or YAML (kueuectl -o); `wide`
+    carries the extra (headers, columns) appended under -o wide."""
+    if output == "wide" and wide is not None:
+        headers = headers + wide[0]
+        rows = [r + w for r, w in zip(rows, wide[1])]
     if output == "json":
         import json as _json
 
         keys = [h.lower().replace(" ", "_") for h in headers]
         return _json.dumps([dict(zip(keys, r)) for r in rows], indent=2)
+    if output == "yaml":
+        import yaml as _yaml
+
+        keys = [h.lower().replace(" ", "_") for h in headers]
+        return _yaml.safe_dump([dict(zip(keys, r)) for r in rows],
+                               sort_keys=False)
     return _fmt_table(headers, rows)
+
+
+def _match_fields(fields: dict[str, str], selector: str) -> bool:
+    """kubectl-style field selector: path=value[,path2=value2]; != negates.
+    ``fields`` maps dotted paths to their rendered values."""
+    if not selector:
+        return True
+    for term in selector.split(","):
+        term = term.strip()
+        if "!=" in term:
+            k, v = term.split("!=", 1)
+            if fields.get(k.strip()) == v.strip():
+                return False
+        elif "=" in term:
+            k, v = term.split("=", 1)
+            if fields.get(k.strip()) != v.strip():
+                return False
+    return True
 
 
 def _fmt_table(headers: list[str], rows: list[list[str]]) -> str:
@@ -104,6 +133,20 @@ class Kueuectl:
         ccq.add_argument("--cohort", default=None)
         ccq.add_argument("--nominal-quota", default="",
                          help="flavor:resource=qty[,resource=qty...][;...]")
+        # flag matrix parity: create_clusterqueue.go:162-171
+        ccq.add_argument("--queuing-strategy", default=None,
+                         choices=("StrictFIFO", "BestEffortFIFO"))
+        ccq.add_argument("--namespace-selector", default="",
+                         help="key=value[,key=value...]")
+        ccq.add_argument("--reclaim-within-cohort", default=None,
+                         choices=("Never", "LowerPriority", "Any"))
+        ccq.add_argument("--preemption-within-cluster-queue", default=None,
+                         choices=("Never", "LowerPriority",
+                                  "LowerOrNewerEqualPriority"))
+        ccq.add_argument("--borrowing-limit", default="",
+                         help="flavor:resource=qty[,...][;...]")
+        ccq.add_argument("--lending-limit", default="",
+                         help="flavor:resource=qty[,...][;...]")
         ccq.set_defaults(func=self._create_cq)
         clq = create.add_parser("localqueue")
         clq.add_argument("name")
@@ -120,31 +163,34 @@ class Kueuectl:
                          help="key=value:Effect[,...]")
         crf.set_defaults(func=self._create_rf)
 
+        OUT = ("table", "json", "yaml", "wide")
         lst = sub.add_parser("list").add_subparsers(required=True)
         lcq = lst.add_parser("clusterqueue")
-        lcq.add_argument("-o", "--output", default="table",
-                         choices=("table", "json"))
+        lcq.add_argument("-o", "--output", default="table", choices=OUT)
         lcq.set_defaults(func=self._list_cq)
         llq = lst.add_parser("localqueue")
         llq.add_argument("-n", "--namespace", default=None)
-        llq.add_argument("-o", "--output", default="table",
-                         choices=("table", "json"))
+        llq.add_argument("-A", "--all-namespaces", action="store_true")
+        llq.add_argument("-o", "--output", default="table", choices=OUT)
         llq.set_defaults(func=self._list_lq)
         lwl = lst.add_parser("workload")
         lwl.add_argument("-n", "--namespace", default=None)
+        lwl.add_argument("-A", "--all-namespaces", action="store_true")
         lwl.add_argument("-l", "--selector", default="",
                          help="label selector k=v[,k2=v2]; k!=v negates")
-        lwl.add_argument("-o", "--output", default="table",
-                         choices=("table", "json"))
+        lwl.add_argument("--field-selector", default="",
+                         help="field selector, e.g. status.phase=Pending,"
+                              "spec.queueName=lq")
+        lwl.add_argument("-o", "--output", default="table", choices=OUT)
         lwl.set_defaults(func=self._list_wl)
         lst.add_parser("resourceflavor").set_defaults(func=self._list_rf)
         lst.add_parser("cohort").set_defaults(func=self._list_cohorts)
         ltp = lst.add_parser("topology")
-        ltp.add_argument("-o", "--output", default="table",
-                         choices=("table", "json"))
+        ltp.add_argument("-o", "--output", default="table", choices=OUT)
         ltp.set_defaults(func=self._list_topology)
         lpw = lst.add_parser("pending-workloads")
         lpw.add_argument("--clusterqueue", default=None)
+        lpw.add_argument("-o", "--output", default="table", choices=OUT)
         lpw.set_defaults(func=self._list_pending)
 
         desc = sub.add_parser("describe").add_subparsers(required=True)
@@ -186,8 +232,11 @@ class Kueuectl:
         dlq.add_argument("-n", "--namespace", default="default")
         dlq.set_defaults(func=self._delete_lq)
         dwl = dele.add_parser("workload")
-        dwl.add_argument("name")
+        dwl.add_argument("name", nargs="?", default=None)
         dwl.add_argument("-n", "--namespace", default="default")
+        dwl.add_argument("--all", action="store_true",
+                         help="delete all workloads in the namespace "
+                              "(delete_workload.go --all)")
         dwl.set_defaults(func=self._delete_wl)
 
         # passthrough verbs for object kinds without dedicated commands
@@ -215,9 +264,29 @@ class Kueuectl:
 
     # -- create -------------------------------------------------------------
 
+    @staticmethod
+    def _parse_quota_spec(spec: str, what: str) -> dict[tuple, int]:
+        """'flavor:resource=qty[,resource=qty...][;...]' ->
+        {(flavor, resource): qty}."""
+        out: dict[tuple, int] = {}
+        for group in filter(None, spec.split(";")):
+            flavor, _, rest = group.partition(":")
+            for pair in rest.split(","):
+                resource, _, qty = pair.partition("=")
+                if not qty:
+                    raise CliError(f"bad {what} entry {pair!r}")
+                out[(flavor, resource)] = int(qty)
+        return out
+
     def _create_cq(self, ns) -> str:
+        from kueue_oss_tpu.api.types import PreemptionPolicy
+
         if ns.name in self.store.cluster_queues:
             raise CliError(f"clusterqueue {ns.name!r} already exists")
+        borrow = self._parse_quota_spec(
+            getattr(ns, "borrowing_limit", ""), "--borrowing-limit")
+        lend = self._parse_quota_spec(
+            getattr(ns, "lending_limit", ""), "--lending-limit")
         groups = []
         if ns.nominal_quota:
             for group in ns.nominal_quota.split(";"):
@@ -227,13 +296,34 @@ class Kueuectl:
                     resource, _, qty = pair.partition("=")
                     if not qty:
                         raise CliError(f"bad --nominal-quota entry {pair!r}")
-                    quotas.append(ResourceQuota(name=resource,
-                                                nominal=int(qty)))
+                    quotas.append(ResourceQuota(
+                        name=resource, nominal=int(qty),
+                        borrowing_limit=borrow.get((flavor, resource)),
+                        lending_limit=lend.get((flavor, resource))))
                 groups.append(ResourceGroup(
                     covered_resources=[q.name for q in quotas],
                     flavors=[FlavorQuotas(name=flavor, resources=quotas)]))
+        kwargs = {}
+        if getattr(ns, "queuing_strategy", None):
+            kwargs["queueing_strategy"] = ns.queuing_strategy
+        preemption = PreemptionPolicy()
+        if getattr(ns, "reclaim_within_cohort", None):
+            preemption.reclaim_within_cohort = ns.reclaim_within_cohort
+        if getattr(ns, "preemption_within_cluster_queue", None):
+            preemption.within_cluster_queue = (
+                ns.preemption_within_cluster_queue)
+        if getattr(ns, "namespace_selector", ""):
+            sel = {}
+            for pair in filter(None, ns.namespace_selector.split(",")):
+                k, sep, v = pair.partition("=")
+                if not sep:
+                    raise CliError(
+                        f"bad --namespace-selector entry {pair!r}")
+                sel[k] = v
+            kwargs["namespace_selector"] = sel
         cq = ClusterQueue(name=ns.name, cohort=ns.cohort,
-                          resource_groups=groups)
+                          resource_groups=groups, preemption=preemption,
+                          **kwargs)
         try:
             admit_cluster_queue(cq)
         except ValidationError as e:
@@ -379,32 +469,61 @@ class Kueuectl:
             rows.append([cq.name, cq.cohort or "", cq.queueing_strategy,
                          str(pending), str(admitted),
                          cq.stop_policy])
+        wide_cols = [[
+            ",".join(fq.name for rg in cq.resource_groups
+                     for fq in rg.flavors),
+            cq.preemption.reclaim_within_cohort,
+            str(cq.fair_sharing.weight),
+        ] for cq in sorted(self.store.cluster_queues.values(),
+                           key=lambda c: c.name)]
         return _emit(
             ["NAME", "COHORT", "STRATEGY", "PENDING", "ADMITTED", "STOP"],
-            rows, getattr(ns, "output", "table"))
+            rows, getattr(ns, "output", "table"),
+            wide=(["FLAVORS", "RECLAIM", "FAIR WEIGHT"], wide_cols))
 
     def _list_lq(self, ns) -> str:
+        namespace = (None if getattr(ns, "all_namespaces", False)
+                     else ns.namespace)
         rows = [[lq.namespace, lq.name, lq.cluster_queue, lq.stop_policy]
                 for lq in sorted(self.store.local_queues.values(),
                                  key=lambda l: l.key)
-                if ns.namespace is None or lq.namespace == ns.namespace]
+                if namespace is None or lq.namespace == namespace]
         return _emit(["NAMESPACE", "NAME", "CLUSTERQUEUE", "STOP"], rows,
                      getattr(ns, "output", "table"))
 
     def _list_wl(self, ns) -> str:
         from kueue_oss_tpu.core.workload_info import workload_status
 
+        namespace = (None if getattr(ns, "all_namespaces", False)
+                     else ns.namespace)
         rows = []
+        wide_cols = []
         for wl in sorted(self.store.workloads.values(), key=lambda w: w.key):
-            if ns.namespace is not None and wl.namespace != ns.namespace:
+            if namespace is not None and wl.namespace != namespace:
                 continue
             if not _match_selector(wl.labels, getattr(ns, "selector", "")):
                 continue
+            status = workload_status(wl)
+            fields = {
+                "metadata.name": wl.name,
+                "metadata.namespace": wl.namespace,
+                "spec.queueName": wl.queue_name,
+                "spec.priorityClassName": wl.priority_class or "",
+                "status.phase": status,
+            }
+            if not _match_fields(fields,
+                                 getattr(ns, "field_selector", "")):
+                continue
             rows.append([wl.namespace, wl.name, wl.queue_name,
-                         str(wl.priority), workload_status(wl)])
+                         str(wl.priority), status])
+            adm = wl.status.admission
+            wide_cols.append([
+                adm.cluster_queue if adm is not None else "",
+                str(wl.uid), f"{wl.creation_time:g}"])
         return _emit(
             ["NAMESPACE", "NAME", "LOCALQUEUE", "PRIORITY", "STATUS"], rows,
-            getattr(ns, "output", "table"))
+            getattr(ns, "output", "table"),
+            wide=(["ADMITTED BY", "UID", "CREATED"], wide_cols))
 
     def _list_topology(self, ns) -> str:
         """Topology CRDs with per-level domain counts (the node/topology
@@ -490,9 +609,9 @@ class Kueuectl:
                 if wl is not None:
                     rows.append([wl.namespace, wl.name, name, "inadmissible",
                                  str(effective_priority(wl))])
-        return _fmt_table(
+        return _emit(
             ["NAMESPACE", "NAME", "CLUSTERQUEUE", "POSITION", "PRIORITY"],
-            rows)
+            rows, getattr(ns, "output", "table"))
 
     def _describe_cq(self, ns) -> str:
         cq = self.store.cluster_queues.get(ns.name)
@@ -600,6 +719,16 @@ class Kueuectl:
         return f"localqueue.kueue.x-k8s.io/{ns.name} deleted"
 
     def _delete_wl(self, ns) -> str:
+        if getattr(ns, "all", False):
+            keys = [k for k, w in self.store.workloads.items()
+                    if w.namespace == ns.namespace]
+            for key in keys:
+                self.store.delete_workload(key)
+            return "\n".join(
+                f"workload.kueue.x-k8s.io/{k.split('/', 1)[1]} deleted"
+                for k in sorted(keys)) or "no workloads found"
+        if ns.name is None:
+            raise CliError("a workload name (or --all) is required")
         key = f"{ns.namespace}/{ns.name}"
         if self.store.delete_workload(key) is None:
             raise CliError(f"workload {ns.name!r} not found")

@@ -1,10 +1,12 @@
 """kueueviz-style dashboard backend.
 
 Reference parity: cmd/kueueviz (Go/gin backend streaming cluster state to
-a React frontend over websockets). The dashboard surface here is a JSON
-snapshot API — the same aggregate views the kueueviz frontend renders
-(cluster queues with usage/pending, cohort tree, workload listing) served
-from the store, pollable over HTTP or consumed directly by tooling.
+a React frontend over websockets; per-resource detail views like
+WorkloadDetail.jsx / ClusterQueueDetail.jsx / CohortDetail.jsx). The
+dashboard surface here is a JSON snapshot API plus per-resource DETAIL
+endpoints and an SSE live stream (/api/stream) — store watch events push
+fresh snapshots to connected clients the way the reference's
+useWebSocket.js hook refreshes its views.
 """
 
 from __future__ import annotations
@@ -23,6 +25,23 @@ class Dashboard:
     def __init__(self, store: Store, queues: QueueManager) -> None:
         self.store = store
         self.queues = queues
+        #: bumped on every store event; SSE clients wake on it
+        self._gen = 0
+        self._cond = threading.Condition()
+        store.watch(self._on_event)
+
+    def _on_event(self, event) -> None:
+        with self._cond:
+            self._gen += 1
+            self._cond.notify_all()
+
+    def wait_for_change(self, gen: int, timeout: float = 15.0) -> int:
+        """Block until the store generation passes ``gen`` (or timeout);
+        returns the current generation."""
+        with self._cond:
+            if self._gen == gen:
+                self._cond.wait(timeout)
+            return self._gen
 
     # -- views (kueueviz backend endpoints) ---------------------------------
 
@@ -97,6 +116,127 @@ class Dashboard:
             "workloads": self.workloads_view(),
         }
 
+    # -- per-resource detail views (WorkloadDetail.jsx et al) ---------------
+
+    def workload_detail(self, namespace: str, name: str) -> Optional[dict]:
+        from kueue_oss_tpu.core.workload_info import workload_status
+
+        wl = self.store.workloads.get(f"{namespace}/{name}")
+        if wl is None:
+            return None
+        adm = wl.status.admission
+        return {
+            "namespace": wl.namespace,
+            "name": wl.name,
+            "localQueue": wl.queue_name,
+            "priority": wl.priority,
+            "priorityClass": wl.priority_class,
+            "status": workload_status(wl),
+            "active": wl.active,
+            "podSets": [{
+                "name": ps.name, "count": ps.count,
+                "requests": dict(ps.requests),
+                "minCount": ps.min_count,
+            } for ps in wl.podsets],
+            "conditions": [{
+                "type": t, "status": c.status, "reason": c.reason,
+                "message": c.message,
+                "lastTransitionTime": c.last_transition_time,
+            } for t, c in sorted(wl.status.conditions.items())],
+            "admission": None if adm is None else {
+                "clusterQueue": adm.cluster_queue,
+                "podSetAssignments": [{
+                    "name": psa.name, "count": psa.count,
+                    "flavors": dict(psa.flavors),
+                    "resourceUsage": dict(psa.resource_usage),
+                    "topologyAssignment": None
+                    if psa.topology_assignment is None else {
+                        "levels": list(psa.topology_assignment.levels),
+                        "domains": [{
+                            "values": list(d.values), "count": d.count}
+                            for d in psa.topology_assignment.domains],
+                    },
+                } for psa in adm.podset_assignments],
+            },
+            "admissionChecks": [{
+                "name": n, "state": s.state, "message": s.message,
+            } for n, s in sorted(wl.status.admission_checks.items())],
+        }
+
+    def cluster_queue_detail(self, name: str) -> Optional[dict]:
+        cq = self.store.cluster_queues.get(name)
+        if cq is None:
+            return None
+        base = next((v for v in self.cluster_queues_view()
+                     if v["name"] == name), {})
+        q = self.queues.queues.get(name)
+        pending = []
+        if q is not None:
+            from kueue_oss_tpu.core.workload_info import effective_priority
+
+            for pos, info in enumerate(q.snapshot_order()):
+                pending.append({
+                    "namespace": info.obj.namespace,
+                    "name": info.obj.name,
+                    "position": pos,
+                    "priority": effective_priority(info.obj),
+                })
+            for key in q.inadmissible:
+                wl = self.store.workloads.get(key)
+                if wl is not None:
+                    pending.append({
+                        "namespace": wl.namespace, "name": wl.name,
+                        "position": "inadmissible",
+                        "priority": wl.priority,
+                    })
+        admitted = [
+            {"namespace": wl.namespace, "name": wl.name}
+            for wl in sorted(self.store.workloads.values(),
+                             key=lambda w: w.key)
+            if wl.is_quota_reserved and not wl.is_finished
+            and wl.status.admission is not None
+            and wl.status.admission.cluster_queue == name]
+        return {
+            **base,
+            "preemption": {
+                "withinClusterQueue": cq.preemption.within_cluster_queue,
+                "reclaimWithinCohort": cq.preemption.reclaim_within_cohort,
+            },
+            "fairWeight": cq.fair_sharing.weight,
+            "flavors": [fq.name for rg in cq.resource_groups
+                        for fq in rg.flavors],
+            "admissionChecks": list(cq.admission_checks),
+            "pendingWorkloads": pending,
+            "admittedWorkloads": admitted,
+        }
+
+    def cohort_detail(self, name: str) -> Optional[dict]:
+        cohort = self.store.cohorts.get(name)
+        members = sorted(cq.name for cq in self.store.cluster_queues.values()
+                         if cq.cohort == name)
+        if cohort is None and not members:
+            return None
+        from kueue_oss_tpu.core.snapshot import build_snapshot
+
+        snap = build_snapshot(self.store)
+        cq_views = {v["name"]: v for v in self.cluster_queues_view()}
+        subtree_quota: dict[str, int] = {}
+        subtree_usage: dict[str, int] = {}
+        node = snap.forest.nodes.get(name)
+        if node is not None:
+            for (fl, r), v in node.subtree_quota.items():
+                subtree_quota[f"{fl}/{r}"] = v
+            for (fl, r), v in node.usage.items():
+                subtree_usage[f"{fl}/{r}"] = v
+        return {
+            "name": name,
+            "parent": cohort.parent if cohort is not None else None,
+            "subtreeQuota": subtree_quota,
+            "subtreeUsage": subtree_usage,
+            "clusterQueues": [cq_views.get(m, {"name": m})
+                              for m in members],
+        }
+
 
 class DashboardServer:
     """GET / (HTML dashboard) + /api/clusterqueues | /api/cohorts |
@@ -121,13 +261,53 @@ class DashboardServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/api/stream":
+                    # SSE live refresh (useWebSocket.js analog): push an
+                    # overview snapshot on every store change, with a
+                    # keepalive comment on idle timeouts
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    gen = -1
+                    try:
+                        while True:
+                            new_gen = dash.wait_for_change(gen, timeout=5.0)
+                            if new_gen == gen:
+                                self.wfile.write(b": keepalive\n\n")
+                            else:
+                                gen = new_gen
+                                body = json.dumps(dash.overview())
+                                self.wfile.write(
+                                    f"data: {body}\n\n".encode())
+                            self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        return
+                # per-resource detail endpoints
+                detail = None
+                parts = path.strip("/").split("/")
+                if len(parts) == 4 and parts[:2] == ["api", "workloads"]:
+                    detail = dash.workload_detail(parts[2], parts[3])
+                elif len(parts) == 3 and parts[1] == "clusterqueues":
+                    detail = dash.cluster_queue_detail(parts[2])
+                elif len(parts) == 3 and parts[1] == "cohorts":
+                    detail = dash.cohort_detail(parts[2])
+                if detail is not None:
+                    body = json.dumps(detail).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 routes = {
                     "/api/clusterqueues": dash.cluster_queues_view,
                     "/api/cohorts": dash.cohorts_view,
                     "/api/workloads": dash.workloads_view,
                     "/api/overview": dash.overview,
                 }
-                fn = routes.get(self.path.rstrip("/"))
+                fn = routes.get(path)
                 if fn is None:
                     self.send_response(404)
                     self.end_headers()

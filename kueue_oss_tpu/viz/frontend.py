@@ -2,10 +2,11 @@
 
 One self-contained HTML page served at `/`: fetches the JSON APIs
 (/api/overview) and renders the cohort hierarchy as a nested tree with
-per-ClusterQueue usage bars, plus live-refreshing queue/workload
-tables. Reference: cmd/kueueviz/frontend — the same read-only views
-(queues, cohorts, workloads, status counts) without the React/Vite
-toolchain.
+per-ClusterQueue usage bars, plus live-refreshing queue/workload tables,
+hash-routed per-resource DETAIL views (#/workload/ns/name, #/cq/name,
+#/cohort/name — WorkloadDetail.jsx et al analogs) and live refresh over
+SSE (/api/stream; useWebSocket.js analog) with polling fallback.
+Reference: cmd/kueueviz/frontend without the React/Vite toolchain.
 """
 
 INDEX_HTML = """<!doctype html>
@@ -46,7 +47,10 @@ INDEX_HTML = """<!doctype html>
 </style>
 </head>
 <body>
-<h1>kueue-oss-tpu</h1>
+<h1><a href="#" style="color:inherit;text-decoration:none"
+  onclick="location.hash=''">kueue-oss-tpu</a></h1>
+<div id="detail" style="display:none"></div>
+<div id="main">
 <div id="overview">loading…</div>
 <h2>Cohort tree</h2>
 <div id="tree"></div>
@@ -58,7 +62,9 @@ INDEX_HTML = """<!doctype html>
 <table id="wls"><thead><tr>
   <th>Namespace</th><th>Name</th><th>LocalQueue</th><th>Priority</th>
   <th>Status</th><th>ClusterQueue</th></tr></thead><tbody></tbody></table>
-<footer>auto-refreshes every 2s · JSON at /api/overview</footer>
+</div>
+<footer>live over SSE (/api/stream), 2s polling fallback ·
+JSON at /api/overview</footer>
 <script>
 const fmt = (o) => Object.entries(o || {}).map(
     ([k, v]) => `${k}=${v}`).join(" ") || "—";
@@ -121,17 +127,59 @@ async function refresh() {
         rows.map(r => `<tr>${r.map(c => `<td>${c}</td>`).join("")}</tr>`)
             .join("");
     };
-    fill("cqs", cqs.map(q => [q.name, q.cohort || "—", q.pending,
-                              q.inadmissible, q.reserved,
-                              fmt(q.usage)]));
+    fill("cqs", cqs.map(q => [
+        `<a href="#/cq/${q.name}">${q.name}</a>`,
+        q.cohort ? `<a href="#/cohort/${q.cohort}">${q.cohort}</a>` : "—",
+        q.pending, q.inadmissible, q.reserved, fmt(q.usage)]));
     fill("wls", wls.slice(0, 300).map(w => [
-        w.namespace, w.name, w.localQueue, w.priority,
+        w.namespace,
+        `<a href="#/workload/${w.namespace}/${w.name}">${w.name}</a>`,
+        w.localQueue, w.priority,
         `<span class="pill">${w.status}</span>`,
         w.clusterQueue || "—"]));
   } catch (e) { /* server restarting; retry on next tick */ }
 }
-refresh();
-setInterval(refresh, 2000);
+const obj = (o) => `<table><tbody>` + Object.entries(o || {}).map(
+  ([k, v]) => `<tr><th>${k}</th><td><pre style="margin:0">` +
+    `${typeof v === "object" ? JSON.stringify(v, null, 1) : v}` +
+    `</pre></td></tr>`).join("") + `</tbody></table>`;
+async function renderDetail() {
+  const h = location.hash.replace(/^#\\/?/, "");
+  const main = document.getElementById("main");
+  const det = document.getElementById("detail");
+  if (!h) { main.style.display = ""; det.style.display = "none"; return; }
+  const parts = h.split("/");
+  let url = null, title = "";
+  if (parts[0] === "workload" && parts.length === 3) {
+    url = `/api/workloads/${parts[1]}/${parts[2]}`;
+    title = `Workload ${parts[1]}/${parts[2]}`;
+  } else if (parts[0] === "cq" && parts.length === 2) {
+    url = `/api/clusterqueues/${parts[1]}`;
+    title = `ClusterQueue ${parts[1]}`;
+  } else if (parts[0] === "cohort" && parts.length === 2) {
+    url = `/api/cohorts/${parts[1]}`;
+    title = `Cohort ${parts[1]}`;
+  }
+  if (!url) { location.hash = ""; return; }
+  main.style.display = "none"; det.style.display = "";
+  try {
+    const r = await fetch(url);
+    det.innerHTML = `<h2>${title}</h2>` + (r.ok
+      ? obj(await r.json())
+      : `<p>not found</p>`) +
+      `<p><a href="#" onclick="location.hash=''">← back</a></p>`;
+  } catch (e) { det.innerHTML = `<p>unavailable</p>`; }
+}
+function onChange() { refresh(); renderDetail(); }
+window.addEventListener("hashchange", renderDetail);
+onChange();
+let sse = null;
+try {
+  sse = new EventSource("/api/stream");
+  sse.onmessage = onChange;
+  sse.onerror = () => { /* fall back to polling below */ };
+} catch (e) {}
+setInterval(() => { if (!sse || sse.readyState === 2) onChange(); }, 2000);
 </script>
 </body>
 </html>

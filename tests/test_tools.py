@@ -366,3 +366,140 @@ def test_cli_selectors_json_and_topology_views():
     out = ctl.run(["describe", "topology", "dc"])
     assert "Level 0 (cloud/rack): 2 domains" in out
     assert "cpu=16000" in out
+
+
+def test_cli_round5_option_breadth():
+    """-o yaml|wide, -A, --field-selector, create flag matrix,
+    delete --all (cmd/kueuectl list/create/delete flag parity)."""
+    import yaml as _yaml
+
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    ctl = Kueuectl(store)
+    out = ctl.run([
+        "create", "clusterqueue", "team-a",
+        "--nominal-quota", "default:cpu=4000",
+        "--borrowing-limit", "default:cpu=1000",
+        "--lending-limit", "default:cpu=500",
+        "--queuing-strategy", "StrictFIFO",
+        "--reclaim-within-cohort", "Any",
+        "--preemption-within-cluster-queue", "LowerPriority",
+        "--namespace-selector", "team=a"])
+    assert "created" in out
+    cq = store.cluster_queues["team-a"]
+    assert cq.queueing_strategy == "StrictFIFO"
+    assert cq.preemption.reclaim_within_cohort == "Any"
+    assert cq.preemption.within_cluster_queue == "LowerPriority"
+    assert cq.namespace_selector == {"team": "a"}
+    q = cq.quota_for(("default", "cpu"))
+    assert (q.nominal, q.borrowing_limit, q.lending_limit) == (
+        4000, 1000, 500)
+
+    ctl.run(["create", "localqueue", "lq", "-c", "team-a"])
+    ctl.run(["create", "localqueue", "lq2", "-c", "team-a",
+             "-n", "other"])
+    submit(store, "w1", "lq")
+    store.add_workload(Workload(
+        name="w2", namespace="other", queue_name="lq2",
+        podsets=[PodSet(count=1, requests={"cpu": 1000})]))
+
+    # -A spans namespaces; -n restricts
+    both = ctl.run(["list", "workload", "-A"])
+    assert "w1" in both and "w2" in both
+    one = ctl.run(["list", "workload", "-n", "other"])
+    assert "w2" in one and "w1" not in one
+
+    # field selector on rendered fields
+    sel = ctl.run(["list", "workload", "-A",
+                   "--field-selector", "spec.queueName=lq2"])
+    assert "w2" in sel and "w1" not in sel
+    sel = ctl.run(["list", "workload", "-A",
+                   "--field-selector", "status.phase!=Pending"])
+    assert "w1" not in sel and "w2" not in sel
+
+    # -o yaml round-trips; -o wide appends columns
+    docs = _yaml.safe_load(ctl.run(["list", "workload", "-A",
+                                    "-o", "yaml"]))
+    assert {d["name"] for d in docs} == {"w1", "w2"}
+    wide = ctl.run(["list", "clusterqueue", "-o", "wide"])
+    assert "FLAVORS" in wide and "default" in wide and "Any" in wide
+    wide_wl = ctl.run(["list", "workload", "-A", "-o", "wide"])
+    assert "ADMITTED BY" in wide_wl and "UID" in wide_wl
+    lqs = ctl.run(["list", "localqueue", "-A"])
+    assert "lq2" in lqs
+
+    # delete --all in one namespace only
+    out = ctl.run(["delete", "workload", "--all", "-n", "default"])
+    assert "w1 deleted" in out
+    assert "default/w1" not in store.workloads
+    assert "other/w2" in store.workloads
+
+
+def test_dashboard_detail_views_and_sse():
+    """Per-resource detail endpoints + SSE live stream (kueueviz
+    WorkloadDetail.jsx / useWebSocket.js analogs)."""
+    import http.client
+    import time as _time
+
+    from kueue_oss_tpu.viz import Dashboard, DashboardServer
+
+    store, queues, sched = make_env(nominal=1000)
+    submit(store, "running", "lq-a", t=1.0)
+    submit(store, "waiting", "lq-b", t=2.0)
+    sched.schedule(3.0)
+    dash = Dashboard(store, queues)
+
+    wd = dash.workload_detail("default", "running")
+    assert wd["status"] == "Admitted"
+    assert wd["admission"]["clusterQueue"] == "cq"
+    assert wd["podSets"][0]["requests"] == {"cpu": 1000}
+    assert wd["conditions"], "conditions must be present"
+    assert dash.workload_detail("default", "nope") is None
+
+    cqd = dash.cluster_queue_detail("cq")
+    assert {w["name"] for w in cqd["admittedWorkloads"]} == {"running"}
+    assert any(p["name"] == "waiting" for p in cqd["pendingWorkloads"])
+    assert cqd["preemption"]["withinClusterQueue"] in (
+        "Never", "LowerPriority", "LowerOrNewerEqualPriority", "Any")
+
+    srv = DashboardServer(dash)
+    srv.start()
+    try:
+        wd2 = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/api/workloads/default/running",
+            timeout=5).read())
+        assert wd2["admission"]["clusterQueue"] == "cq"
+        cqd2 = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/api/clusterqueues/cq",
+            timeout=5).read())
+        assert cqd2["name"] == "cq"
+        # missing resources 404 (urllib.error is loaded by
+        # urllib.request at import time)
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/api/clusterqueues/nope",
+                timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        # SSE: a store change pushes a data event
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/api/stream")
+        resp = conn.getresponse()
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        submit(store, "late", "lq-a", t=4.0)  # triggers a store event
+        deadline = _time.monotonic() + 10
+        saw_data = False
+        while _time.monotonic() < deadline:
+            line = resp.fp.readline().decode()
+            if line.startswith("data:"):
+                payload = json.loads(line[5:])
+                names = {w["name"] for w in payload["workloads"]}
+                if "late" in names:
+                    saw_data = True
+                    break
+        assert saw_data, "SSE stream never delivered the store change"
+        conn.close()
+    finally:
+        srv.stop()
