@@ -108,6 +108,10 @@ _DEFAULTS: dict[str, bool] = {
     "SkipFinalizersForPodsSuspendedByParent": True,  # pod.upsert_pod
     # queue provenance labels stamped on created pods (beta, on)
     "AssignQueueLabelsForPods": True,  # reconciler._podset_infos
+    # framework-specific (no reference analog): TAS phase-1 fill-in
+    # counts on the accelerator, phase-2 tie-breaks host-side — the
+    # balanced/multilayer hybrid (tas/snapshot.py _device_fill)
+    "TASDeviceFillCounts": False,
 }
 
 _lock = threading.Lock()

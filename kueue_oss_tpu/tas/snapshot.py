@@ -98,13 +98,16 @@ class Domain:
 
 
 class LeafDomain(Domain):
-    __slots__ = ("free_capacity", "tas_usage", "node")
+    __slots__ = ("free_capacity", "tas_usage", "node", "_remaining")
 
     def __init__(self, domain_id, level_values) -> None:
         super().__init__(domain_id, level_values)
         self.free_capacity: Requests = {}
         self.tas_usage: Requests = {}
         self.node: Optional[Node] = None
+        #: per-call scratch for the device fill path (remaining capacity
+        #: after host-side filtering; None between calls)
+        self._remaining: Optional[Requests] = None
 
 
 @dataclass
@@ -144,6 +147,13 @@ class TASFlavorSnapshot:
             {} for _ in levels]
         self.is_lowest_level_node = (
             bool(levels) and levels[-1] == HOSTNAME_LABEL)
+        #: round-5 hybrid: run phase 1 (fill-in counts — the per-leaf
+        #: capacity division and the per-level roll-up) on the
+        #: accelerator via solver/tas_kernels.fill_counts_ext, keeping
+        #: host-side leaf filtering and EVERY phase-2 tie-break
+        #: (balanced DP included) — see the TASDeviceFillCounts gate
+        self.use_device_fill = False
+        self._device_tree = None  # (parents, lex-ordered domain lists)
 
     # ------------------------------------------------------------------
     # Construction
@@ -575,6 +585,9 @@ class TASFlavorSnapshot:
                 _sub(remaining, leaf.tas_usage)
             if leaf.id in assumed:
                 _sub(remaining, assumed[leaf.id])
+            if self.use_device_fill:
+                leaf._remaining = remaining  # device path consumes below
+                continue
             leaf.state = count_in(req, remaining)
             if leaf.state == 0:
                 limiting = _limiting_resource(req, remaining)
@@ -587,10 +600,103 @@ class TASFlavorSnapshot:
                 _sub(remaining, leader_req)
             leaf.state_with_leader = count_in(req, remaining)
         leader_required = leader is not None
+        if self.use_device_fill:
+            self._device_fill(req, leader_req, slice_size, slice_level_idx,
+                              stats)
+            return stats
         for root in self.roots.values():
             self._roll_up(root, slice_size, slice_level_idx, 0,
                           leader_required)
         return stats
+
+    def _device_fill(self, req: Requests, leader_req: Optional[Requests],
+                     slice_size: int, slice_level_idx: int,
+                     stats: dict) -> None:
+        """Phase 1 on the accelerator: one fill_counts_ext invocation
+        computes every domain's (state, state_with_leader, leader_state,
+        slice_state, slice_state_with_leader) — the division and
+        per-level segment-sum roll-up the host otherwise does
+        recursively (_roll_up). Leaves the host loop's filter decisions
+        intact: a filtered leaf never set ``_remaining`` and exports
+        zero capacity."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kueue_oss_tpu.solver.tas_kernels import fill_counts_ext
+
+        parents, per_level = self._device_tree_arrays()
+        leaves = per_level[-1]
+        vocab = sorted({r for r in req}
+                       | ({r for r in leader_req} if leader_req else set())
+                       | {r for leaf in leaves
+                          for r in (leaf._remaining or {})})
+        R = max(1, len(vocab))
+        ridx = {r: j for j, r in enumerate(vocab)}
+        cap = np.zeros((len(leaves), R), dtype=np.int64)
+        for i, leaf in enumerate(leaves):
+            remaining = leaf._remaining
+            if remaining is None:
+                continue  # filtered out: zero capacity
+            for r, q in remaining.items():
+                cap[i, ridx[r]] = max(0, q)
+            leaf._remaining = None
+        per_pod = np.zeros((R,), dtype=np.int32)
+        for r, q in req.items():
+            per_pod[ridx[r]] = q
+        leader_pp = np.zeros((R,), dtype=np.int32)
+        if leader_req is not None:
+            for r, q in leader_req.items():
+                leader_pp[ridx[r]] = q
+        out = fill_counts_ext(
+            [jnp.asarray(p) for p in parents],
+            jnp.asarray(np.minimum(cap, 1 << 30).astype(np.int32)),
+            jnp.asarray(per_pod), jnp.asarray(leader_pp),
+            jnp.asarray(leader_req is not None),
+            jnp.asarray(np.int32(slice_size)),
+            jnp.asarray(np.int32(slice_level_idx)))
+        for l, doms in enumerate(per_level):
+            st = np.asarray(out[l]["st"])
+            swl = np.asarray(out[l]["swl"])
+            ls = np.asarray(out[l]["ls"])
+            ss = np.asarray(out[l]["ss"])
+            sswl = np.asarray(out[l]["sswl"])
+            for i, dom in enumerate(doms):
+                dom.state = int(st[i])
+                dom.state_with_leader = int(swl[i])
+                dom.leader_state = int(ls[i])
+                dom.slice_state = int(ss[i])
+                dom.slice_state_with_leader = int(sswl[i])
+        # limiting-resource stats for zero-capacity leaves (host parity)
+        for i, leaf in enumerate(leaves):
+            if leaf.state == 0:
+                remaining = {r: int(cap[i, j])
+                             for r, j in ridx.items()}
+                limiting = _limiting_resource(req, remaining)
+                if limiting:
+                    stats["resources"][limiting] = (
+                        stats["resources"].get(limiting, 0) + 1)
+
+    def _device_tree_arrays(self):
+        """Lex-ordered per-level domain lists + parent index arrays
+        (build_levels' layout, cached per snapshot)."""
+        if self._device_tree is None:
+            import numpy as np
+
+            per_level = [sorted(self.domains_per_level[l].values(),
+                                key=lambda d: d.level_values)
+                         for l in range(len(self.levels))]
+            index = [{d.id: i for i, d in enumerate(doms)}
+                     for doms in per_level]
+            parents = []
+            for l, doms in enumerate(per_level):
+                if l == 0:
+                    parents.append(np.zeros(len(doms), dtype=np.int32))
+                else:
+                    parents.append(np.asarray(
+                        [index[l - 1][d.id[:-1]] for d in doms],
+                        dtype=np.int32))
+            self._device_tree = (parents, per_level)
+        return self._device_tree
 
     @staticmethod
     def _untolerated(node: Node, tolerations: list[Toleration]):
@@ -1351,12 +1457,15 @@ def build_tas_flavor_snapshot(
     """Build and initialize a snapshot from ready nodes matching the
     flavor's nodeLabels (tas_flavor.go / tas_nodes_cache.go analog).
     profile_mixed defaults from the TASProfileMixed gate."""
-    if profile_mixed is None:
-        from kueue_oss_tpu import features
+    from kueue_oss_tpu import features
 
+    if profile_mixed is None:
         profile_mixed = features.enabled("TASProfileMixed")
     snap = TASFlavorSnapshot(topology_name, levels, tolerations,
                              profile_mixed=profile_mixed)
+    # round-5 hybrid: phase-1 fill-in counts on the accelerator, every
+    # phase-2 tie-break (balanced DP, multilayer descent) host-side
+    snap.use_device_fill = features.enabled("TASDeviceFillCounts")
     selector = flavor_node_labels or {}
     for node in nodes:
         if not node.ready:

@@ -4,7 +4,24 @@ Scenario shapes mirror the reference's tas_flavor_snapshot_test.go /
 tas_cache_test.go coverage: level selection (required/preferred/
 unconstrained), best-fit domain choice, usage accounting, filtering,
 slices, leader groups, node replacement, and scheduler integration.
+
+The whole matrix runs twice: host recursive roll-up vs phase 1 on the
+accelerator (TASDeviceFillCounts, the round-5 hybrid) — identical
+expected placements in both modes are the device-parity matrix.
 """
+
+import pytest as _pytest
+
+from kueue_oss_tpu import features as _features
+
+
+@_pytest.fixture(autouse=True, params=["host_fill", "device_fill"])
+def _fill_mode(request):
+    if request.param == "device_fill":
+        _features.set_gates({"TASDeviceFillCounts": True})
+    yield
+    _features.reset()
+
 
 from kueue_oss_tpu.api.types import (
     ClusterQueue,

@@ -26,8 +26,14 @@ BLOCK = "cloud/block"
 RACK = "cloud/rack"
 
 
-@pytest.fixture(autouse=True)
-def _reset_gates():
+@pytest.fixture(autouse=True, params=["host_fill", "device_fill"])
+def _reset_gates(request):
+    """The whole balanced/multilayer matrix runs twice: once with the
+    host recursive roll-up, once with phase 1 on the accelerator
+    (TASDeviceFillCounts — the round-5 hybrid). Identical expected
+    placements in both modes ARE the device-parity matrix."""
+    if request.param == "device_fill":
+        features.set_gates({"TASDeviceFillCounts": True})
     yield
     features.reset()
 
