@@ -104,6 +104,24 @@ class AdmissionScope:
     admission_mode: str = "UsageBasedAdmissionFairSharing"
 
 
+@dataclass
+class AdmissionCheckStrategyRule:
+    """Run check `name` only when the workload's flavor assignment uses
+    one of `on_flavors` (empty = every flavor). Reference parity:
+    clusterqueue_types.go AdmissionCheckStrategyRule."""
+
+    name: str
+    on_flavors: list[str] = field(default_factory=list)
+
+
+@dataclass
+class AdmissionChecksStrategy:
+    """Reference parity: clusterqueue_types.go AdmissionChecksStrategy."""
+
+    admission_checks: list[AdmissionCheckStrategyRule] = field(
+        default_factory=list)
+
+
 # ---------------------------------------------------------------------------
 # ResourceFlavor / Topology
 # ---------------------------------------------------------------------------
@@ -251,11 +269,30 @@ class ClusterQueue:
     admission_scope: Optional[AdmissionScope] = None
     namespace_selector: Optional[dict[str, str]] = None  # None selects everything
     admission_checks: list[str] = field(default_factory=list)
+    admission_checks_strategy: Optional[AdmissionChecksStrategy] = None
     stop_policy: str = StopPolicy.NONE
 
     def flavor_resources(self) -> list[FlavorResource]:
         """All (flavor, resource) pairs this CQ defines quota for."""
         return [key for key, _ in iter_quotas(self.resource_groups)]
+
+    def checks_for_flavors(self, flavors) -> list[str]:
+        """Effective admission checks for an assignment using `flavors`:
+        plain admissionChecks always apply; strategy rules apply when
+        onFlavors is empty or intersects the assignment. `flavors=None`
+        (no admission yet) applies EVERY strategy rule (reference:
+        workload.AdmissionChecksForWorkload treats a nil admission as
+        all-checks, admissionchecks.go)."""
+        names = list(self.admission_checks)
+        if self.admission_checks_strategy is not None:
+            fset = None if flavors is None else set(flavors)
+            for rule in self.admission_checks_strategy.admission_checks:
+                if rule.name in names:
+                    continue
+                if (fset is None or not rule.on_flavors
+                        or fset & set(rule.on_flavors)):
+                    names.append(rule.name)
+        return names
 
     def quota_for(self, fr: FlavorResource) -> Optional[ResourceQuota]:
         return _quota_for(self.resource_groups, fr)
@@ -400,6 +437,12 @@ class Admission:
     cluster_queue: str
     podset_assignments: list[PodSetAssignment] = field(default_factory=list)
 
+    def assigned_flavors(self) -> set:
+        """Distinct ResourceFlavor names across all podset assignments
+        (workload.go flavor extraction; feeds checks_for_flavors)."""
+        return {f for psa in self.podset_assignments
+                for f in psa.flavors.values()}
+
 
 class CheckState:
     """Reference parity: workload_types.go CheckState (KEP-993)."""
@@ -508,6 +551,10 @@ class Workload:
     #: while non-empty, the scheduler must not issue preemptions for this
     #: workload (workload_types.go:604,899-917; scheduler.go:411-416)
     preemption_gates: list[str] = field(default_factory=list)
+    #: optimistic-concurrency token, bumped by every store write; the
+    #: merge-patch client path (WorkloadRequestUseMergePatch)
+    #: preconditions on it
+    resource_version: int = 0
     status: WorkloadStatus = field(default_factory=WorkloadStatus)
 
     def __post_init__(self) -> None:

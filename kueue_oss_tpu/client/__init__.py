@@ -213,13 +213,49 @@ class WorkloadClient(_ResourceClient):
     def _delete(self, key):
         return self._store.delete_workload(key)
 
-    def patch_status(self, name: str, fn: Callable[[Workload], None]):
-        """Status-subresource analog: mutate under the client, then
-        re-emit the update event."""
-        wl = self.get(name)
-        fn(wl)
-        self._store.update_workload(wl)
-        return wl
+    def patch_status(self, name: str, fn: Callable[[Workload], None],
+                     cached: Optional[Workload] = None,
+                     retry_on_conflict: bool = True):
+        """Status-subresource update honoring WorkloadRequestUseMergePatch
+        (reference: pkg/workload/workload.go patchStatus:1219-1249).
+
+        - Gate ENABLED (merge patch): re-read the live object, apply
+          `fn` to it, and write back — only the fields `fn` touches
+          change, so concurrent controllers writing other status fields
+          are preserved. A conflicting write between read and write
+          (resource_version moved) retries when `retry_on_conflict`.
+        - Gate DISABLED (legacy SSA-style replace): `fn` runs on the
+          caller's `cached` copy (default: the live object) and the
+          WHOLE status is written back — a stale cache clobbers
+          concurrent writers, which is exactly the behavior the gate
+          exists to fix.
+        """
+        import copy as _copy
+
+        from kueue_oss_tpu import features
+
+        if not features.enabled("WorkloadRequestUseMergePatch"):
+            wl = cached if cached is not None else self.get(name)
+            fn(wl)
+            self._store.update_workload(wl)
+            return wl
+        for _ in range(10 if retry_on_conflict else 1):
+            live = self.get(name)      # NotFound if deleted meanwhile
+            observed = live.resource_version
+            # fn mutates a fresh copy so a conflicting concurrent write
+            # rolls back cleanly (no double-apply on retry, no partial
+            # mutation behind a raised Conflict); the precondition and
+            # the write are one atomic store operation, and a deleted
+            # workload is never resurrected
+            wl = _copy.deepcopy(live)
+            fn(wl)
+            if self._store.update_workload_if(wl, observed):
+                return wl
+            if not retry_on_conflict:
+                raise Conflict(
+                    f"Workload {name!r}: resourceVersion moved past "
+                    f"{observed}")
+        raise Conflict(f"Workload {name!r}: retries exhausted")
 
 
 class Clientset:

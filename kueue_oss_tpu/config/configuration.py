@@ -15,6 +15,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from kueue_oss_tpu.util.tlsconfig import TLSOptions
+
 
 class RequeuingTimestamp:
     """Reference parity: config RequeuingStrategy.Timestamp values."""
@@ -142,6 +144,9 @@ class Configuration:
     object_retention_policies: Optional[ObjectRetentionPolicies] = None
     multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     feature_gates: dict[str, bool] = field(default_factory=dict)
+    #: TLS options for the HTTP servers (reference: Configuration.TLS,
+    #: applied in config.go:182-190 under the TLSOptions gate)
+    tls: Optional["TLSOptions"] = None
 
 
 _REQUEUING_TIMESTAMPS = {RequeuingTimestamp.EVICTION, RequeuingTimestamp.CREATION}
@@ -188,6 +193,18 @@ def validate(cfg: Configuration) -> list[str]:
             if w < 0:
                 errs.append(f"admissionFairSharing.resourceWeights[{r!r}] "
                             "must be >= 0")
+    if cfg.tls is not None:
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.util.tlsconfig import (
+            TLSOptionsError,
+            parse_tls_options,
+        )
+
+        if features.enabled("TLSOptions"):
+            try:
+                parse_tls_options(cfg.tls)
+            except TLSOptionsError as e:
+                errs.append(f"tls: {e}")
     return errs
 
 
@@ -284,6 +301,14 @@ def load(data: Optional[dict] = None) -> Configuration:
     def conv_integrations(d: dict) -> list[str]:
         return list(d.get("frameworks", []))
 
+    def conv_tls(d: dict) -> TLSOptions:
+        return _build(TLSOptions, d, {
+            "minVersion": ("min_version", None),
+            "cipherSuites": ("cipher_suites", list),
+            "certFile": ("cert_file", None),
+            "keyFile": ("key_file", None),
+        })
+
     cfg = _build(Configuration, data, {
         "namespace": ("namespace", None),
         "manageJobsWithoutQueueName": ("manage_jobs_without_queue_name", None),
@@ -295,6 +320,7 @@ def load(data: Optional[dict] = None) -> Configuration:
         "objectRetentionPolicies": ("object_retention_policies", conv_retention),
         "multiKueue": ("multikueue", conv_mk),
         "featureGates": ("feature_gates", dict),
+        "tls": ("tls", conv_tls),
     })
     if "integrations" in data:
         cfg.integrations = conv_integrations(data["integrations"])

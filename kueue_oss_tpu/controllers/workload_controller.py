@@ -247,7 +247,9 @@ class WorkloadReconciler:
         cq = self.store.cluster_queues.get(cq_name) if cq_name else None
         if cq is None:
             return False
-        wanted = list(cq.admission_checks)
+        assigned = (wl.status.admission.assigned_flavors()
+                    if wl.status.admission is not None else None)
+        wanted = cq.checks_for_flavors(assigned)
         # prune states for checks no longer configured; seed missing ones
         for name in list(wl.status.admission_checks):
             if name not in wanted:

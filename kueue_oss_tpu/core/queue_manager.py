@@ -321,6 +321,10 @@ class QueueManager:
         self.current_time = 0.0
         #: solver-managed lazy capacity-freed flushes (set_lazy_flush)
         self.lazy_flush = False
+        #: monotone count of genuinely NEW pending entries (store "add"
+        #: events that queued); the scheduler's solver re-engagement
+        #: gate diffs it to detect fresh arrival floods
+        self.new_pending_total = 0
         #: second-pass queue (second_pass_queue.go): min-heap of
         #: (ready_at, workload key) plus per-key attempt counts driving
         #: the 1s -> 30s exponential backoff
@@ -477,8 +481,16 @@ class QueueManager:
             # a gated workload can't still be popped.
             self.queues[cq].delete(wl.key)
             return False
-        self.queues[cq].push(WorkloadInfo(wl, cluster_queue=cq),
-                             check_no_fit=True)
+        q = self.queues[cq]
+        # fresh-arrival signal for the scheduler's solver re-engagement
+        # gate: count entries becoming tracked for the FIRST time — via
+        # any path (add event, update event, LocalQueue resume sweep) —
+        # so a second flood re-engages the device drain even with zero
+        # finishes. Re-pushes of already-tracked entries don't count.
+        if (wl.key not in q._in_heap and wl.key not in q.inadmissible
+                and wl.key not in q._stale):
+            self.new_pending_total += 1
+        q.push(WorkloadInfo(wl, cluster_queue=cq), check_no_fit=True)
         return True
 
     def requeue_workload(self, info: WorkloadInfo, reason: str) -> bool:

@@ -179,6 +179,7 @@ class Store:
                 pc = self.priority_classes.get(wl.priority_class)
                 if pc is not None:
                     wl.priority = pc.value
+            wl.resource_version += 1
             self.workloads[wl.key] = wl
             self._index_workload(wl)
             self._track_finished(wl)
@@ -186,10 +187,28 @@ class Store:
 
     def update_workload(self, wl: Workload) -> None:
         with self._lock:
+            wl.resource_version += 1
             self.workloads[wl.key] = wl
             self._index_workload(wl)
             self._track_finished(wl)
         self._emit("update", "Workload", wl)
+
+    def update_workload_if(self, wl: Workload, expected_rv: int) -> bool:
+        """Atomic conditional write: lands only if the stored object
+        still exists at exactly `expected_rv` (the apiserver's
+        optimistic-concurrency precondition; backs the client's
+        merge-patch path). Returns False on conflict or deletion —
+        never resurrects a concurrently deleted workload."""
+        with self._lock:
+            live = self.workloads.get(wl.key)
+            if live is None or live.resource_version != expected_rv:
+                return False
+            wl.resource_version = expected_rv + 1
+            self.workloads[wl.key] = wl
+            self._index_workload(wl)
+            self._track_finished(wl)
+        self._emit("update", "Workload", wl)
+        return True
 
     def _track_finished(self, wl: Workload) -> None:
         """The retained-finished gauges count workloads whose FINISHED

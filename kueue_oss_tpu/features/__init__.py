@@ -108,6 +108,18 @@ _DEFAULTS: dict[str, bool] = {
     "SkipFinalizersForPodsSuspendedByParent": True,  # pod.upsert_pod
     # queue provenance labels stamped on created pods (beta, on)
     "AssignQueueLabelsForPods": True,  # reconciler._podset_infos
+    # TLS options (minVersion/cipherSuites) applied to the HTTP servers
+    # (beta, on; kube_features.go TLSOptions)
+    "TLSOptions": True,              # util/tlsconfig build_ssl_context
+    # workload status updates via merge patch instead of SSA-style
+    # replacement (alpha, off; kube_features.go WorkloadRequestUseMergePatch)
+    "WorkloadRequestUseMergePatch": False,  # client patch_status
+    # finalizer removal via resourceVersion-checked patch (beta, on)
+    "RemoveFinalizersWithStrictPatch": True,  # pod release_finalizer
+    # admission-gated-by annotation propagation + validation (alpha, off)
+    "AdmissionGatedBy": False,       # jobframework propagate + webhook
+    # validate admissionChecksStrategy.onFlavors on CQ update (alpha, off)
+    "RejectUpdatesToCQWithInvalidOnFlavors": False,  # webhooks
     # framework-specific (no reference analog): TAS phase-1 fill-in
     # counts on the accelerator, phase-2 tie-breaks host-side — the
     # balanced/multilayer hybrid (tas/snapshot.py _device_fill)

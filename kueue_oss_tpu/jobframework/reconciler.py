@@ -29,6 +29,41 @@ from kueue_oss_tpu.jobframework.registry import (
 from kueue_oss_tpu.scheduler.scheduler import Scheduler
 
 
+#: CSV of scheduling-gate names holding the workload's admission
+#: (reference: constants.AdmissionGatedByAnnotation)
+ADMISSION_GATED_BY_ANNOTATION = "kueue.x-k8s.io/admission-gated-by"
+
+
+def propagate_admission_gated_by(job: GenericJob, wl: Workload) -> bool:
+    """Copy the admission-gated-by annotation job → workload
+    (reference: jobframework.PropagateAdmissionGatedByAnnotation,
+    reconciler.go:1043). Returns True if the workload changed."""
+    val = (getattr(job, "annotations", {}) or {}).get(
+        ADMISSION_GATED_BY_ANNOTATION)
+    if not val or wl.annotations.get(ADMISSION_GATED_BY_ANNOTATION) == val:
+        return False
+    wl.annotations[ADMISSION_GATED_BY_ANNOTATION] = val
+    return True
+
+
+def update_admission_gated_by(store: Store, job: GenericJob,
+                              wl: Workload) -> bool:
+    """Sync later annotation edits (gates may only be removed — the
+    webhook rejects additions) job → workload
+    (reference: jobframework.UpdateAdmissionGatedBy, reconciler.go:1018)."""
+    val = (getattr(job, "annotations", {}) or {}).get(
+        ADMISSION_GATED_BY_ANNOTATION)
+    cur = wl.annotations.get(ADMISSION_GATED_BY_ANNOTATION)
+    if (val or None) == (cur or None):
+        return False
+    if val:
+        wl.annotations[ADMISSION_GATED_BY_ANNOTATION] = val
+    else:
+        wl.annotations.pop(ADMISSION_GATED_BY_ANNOTATION, None)
+    store.update_workload(wl)
+    return True
+
+
 def workload_name_for(job: GenericJob) -> str:
     """Reference parity: jobframework/workload_names.go
     GetWorkloadNameForOwnerWithGVK. Under the ShortWorkloadNames gate,
@@ -173,6 +208,8 @@ class JobReconciler:
             self.store.delete_workload(wl.key)
             wl = self._create_workload(job, podsets, now)
 
+        if features.enabled("AdmissionGatedBy"):
+            update_admission_gated_by(self.store, job, wl)
         self._sync_reclaimable(job, wl)
         self._sync_running_state(job, wl, now)
 
@@ -300,6 +337,8 @@ class JobReconciler:
             creation_time=getattr(job, "creation_time", now) or now,
         )
         wl.owner = f"{job.kind}/{job.key}"
+        if features.enabled("AdmissionGatedBy"):
+            propagate_admission_gated_by(job, wl)
         self.store.add_workload(wl)
         from kueue_oss_tpu import features, metrics
 

@@ -7,6 +7,8 @@ admitted/running).
 
 from __future__ import annotations
 
+import re
+
 from kueue_oss_tpu.jobframework.interface import GenericJob
 
 
@@ -33,7 +35,68 @@ def default_job(job: GenericJob,
             job.do_suspend()
 
 
+#: same constraint as Job spec.managedBy (validation_admissiongatedby.go)
+_MAX_GATE_NAME_LEN = 63
+_GATE_NAME_RE = re.compile(
+    r"^[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?(/[A-Za-z0-9]"
+    r"([-A-Za-z0-9_.]*[A-Za-z0-9])?)?$")
+
+
+def _gated_by(job) -> str:
+    from kueue_oss_tpu.jobframework.reconciler import (
+        ADMISSION_GATED_BY_ANNOTATION,
+    )
+
+    return (getattr(job, "annotations", {}) or {}).get(
+        ADMISSION_GATED_BY_ANNOTATION, "")
+
+
+def _validate_gated_by_format(value: str) -> list[str]:
+    """validation_admissiongatedby.go:90-130 — CSV of qualified gate
+    names, each non-empty, unique, and at most 63 chars."""
+    if not value:
+        return []
+    errs: list[str] = []
+    seen: set[str] = set()
+    for gate in [g.strip() for g in value.split(",")]:
+        if not gate:
+            errs.append("admission-gated-by: cannot contain empty gate "
+                        "names")
+            continue
+        if gate in seen:
+            errs.append(f"admission-gated-by: duplicate gate {gate!r}")
+        seen.add(gate)
+        if len(gate) > _MAX_GATE_NAME_LEN:
+            errs.append(f"admission-gated-by: gate {gate!r} exceeds "
+                        f"{_MAX_GATE_NAME_LEN} chars")
+        elif not _GATE_NAME_RE.match(gate):
+            errs.append(f"admission-gated-by: gate {gate!r} is not a "
+                        "qualified name")
+    return errs
+
+
+def validate_admission_gated_by_update(old, new) -> list[str]:
+    """validation_admissiongatedby.go:45-88 — the annotation cannot be
+    added after creation, and gates may only be removed."""
+    old_val, new_val = _gated_by(old), _gated_by(new)
+    errs: list[str] = []
+    if not old_val and new_val:
+        errs.append("admission-gated-by: cannot add admission gate "
+                    "after creation")
+    if old_val and new_val:
+        old_gates = [g.strip() for g in old_val.split(",")]
+        for gate in [g.strip() for g in new_val.split(",")]:
+            if gate not in old_gates:
+                errs.append("admission-gated-by: can only remove gates, "
+                            "not add new ones")
+                break
+    errs.extend(_validate_gated_by_format(new_val))
+    return errs
+
+
 def validate_job_create(job: GenericJob) -> list[str]:
+    from kueue_oss_tpu import features
+
     errs = []
     for ps in job.pod_sets():
         if ps.count < 0:
@@ -43,13 +106,20 @@ def validate_job_create(job: GenericJob) -> list[str]:
         for r, q in ps.requests.items():
             if q < 0:
                 errs.append(f"podset {ps.name}: negative request {r}")
+    if features.enabled("AdmissionGatedBy"):
+        errs.extend(_validate_gated_by_format(_gated_by(job)))
     return errs
 
 
 def validate_job_update(old: GenericJob, new: GenericJob) -> list[str]:
     """queue-name is immutable while the job is unsuspended
     (validation.go ValidateJobOnUpdate)."""
+    from kueue_oss_tpu import features
+
     errs = validate_job_create(new)
     if old.queue_name != new.queue_name and not old.is_suspended():
         errs.append("queueName is immutable while the job is running")
+    if features.enabled("AdmissionGatedBy"):
+        errs.extend(e for e in validate_admission_gated_by_update(old, new)
+                    if e not in errs)
     return errs

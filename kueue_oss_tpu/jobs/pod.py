@@ -82,6 +82,10 @@ class Pod:
     #: finalizers clear (pod_controller.go DeletionTimestamp handling)
     deletion_timestamp: Optional[float] = None
     deletion_grace_period_s: float = 30.0
+    #: optimistic-concurrency token, bumped by every upsert; the strict
+    #: finalizer patch (RemoveFinalizersWithStrictPatch) preconditions
+    #: on it
+    resource_version: int = 0
 
     @property
     def key(self) -> str:
@@ -201,6 +205,7 @@ class PodGroupController:
     def upsert_pod(self, pod: Pod) -> None:
         from kueue_oss_tpu import features
 
+        pod.resource_version += 1
         self.pods[pod.key] = pod
         # finalizer protocol: kueue pins managed pods so quota accounting
         # survives deletion (pod_controller.go PodFinalizer). A pod still
@@ -255,6 +260,10 @@ class PodGroupController:
         for pod in list(self.pods.values()):
             if not pod.terminating:
                 continue
+            # the strict patch preconditions on the version observed at
+            # the START of this pod's evaluation — edits landing while
+            # settled/force are computed must fail the patch
+            observed_rv = pod.resource_version
             settled = pod.terminal or pod.key in self.excess_pods
             if not settled and pod.group_name is not None:
                 job = self._groups.get((pod.namespace, pod.group_name))
@@ -265,10 +274,29 @@ class PodGroupController:
                      and pod.annotations.get(
                          SAFE_TO_FORCE_DELETE_ANNOTATION) == "true")
             if settled or force:
-                pod.finalizers = [f for f in pod.finalizers
-                                  if f != KUEUE_FINALIZER]
-                if not pod.finalizers:
-                    self._remove_pod(pod, now)
+                if self.remove_finalizer(pod, observed_rv):
+                    if not pod.finalizers:
+                        self._remove_pod(pod, now)
+
+    def remove_finalizer(self, pod: Pod,
+                         observed_rv: Optional[int] = None) -> bool:
+        """Release kueue's finalizer.
+
+        RemoveFinalizersWithStrictPatch (pod_controller.go:924): with the
+        gate on, the removal is a resourceVersion-preconditioned patch —
+        a pod modified since `observed_rv` fails the patch and the caller
+        retries on the next reconcile (the blind merge patch the gate
+        replaces could clobber a concurrent writer's finalizer edits).
+        """
+        from kueue_oss_tpu import features
+
+        if (features.enabled("RemoveFinalizersWithStrictPatch")
+                and observed_rv is not None
+                and pod.resource_version != observed_rv):
+            return False
+        pod.finalizers = [f for f in pod.finalizers if f != KUEUE_FINALIZER]
+        pod.resource_version += 1
+        return True
 
     def mark_phase(self, key: str, phase: str) -> None:
         self.pods[key].phase = phase

@@ -90,6 +90,7 @@ class Scheduler:
         clock=time.monotonic,
         solver=None,
         solver_min_backlog: int = 256,
+        solver_reengage_fraction: float = 0.05,
         eviction_backoff_max_s: float = 3600.0,
     ) -> None:
         self.store = store
@@ -114,6 +115,25 @@ class Scheduler:
         #: trickles stay on the host cycle loop (the deployments' sweet
         #: spot; SURVEY.md §7 incrementality note). 0 = always drain.
         self.solver_min_backlog = solver_min_backlog
+        #: benefit-aware re-engagement: after the flood drain, a batched
+        #: solve re-walks the whole parked backlog (one kernel round per
+        #: backlog-depth entry per CQ), so it only pays off when enough
+        #: capacity has freed since the last drain to admit a flood-sized
+        #: batch. Until freed-capacity events reach
+        #: max(solver_min_backlog, fraction * backlog), trickle churn
+        #: stays on the host cycle loop (which is O(heads) per cycle).
+        #: 0 = re-engage on every pass (pre-round-5 behavior).
+        self.solver_reengage_fraction = solver_reengage_fraction
+        self._solver_drained_once = False
+        self._solver_freed_since_drain = 0
+        #: queues.new_pending_total at the last drain — diffed so a
+        #: fresh arrival flood re-engages even with zero finishes
+        self._solver_arrivals_mark = 0
+        #: arrival-triggered drains back off exponentially while they
+        #: admit nothing (arrivals behind a capacity-blocked backlog);
+        #: any productive drain resets the multiplier
+        self._solver_arrival_mult = 1
+        self._solver_drain_trigger = None
         #: Preemption/generic evictions requeue immediately (ordered by
         #: eviction time, reference workload.Ordering). Only controller
         #: evictions that pass an explicit backoff_base_s (PodsReady
@@ -320,10 +340,33 @@ class Scheduler:
             # draining floods (eager flushes there are O(parked) per
             # finish — millions of heap pushes per run); at trickle
             # scale the host path runs with exact eager semantics.
-            if self.queues.solver_backlog_count() < self.solver_min_backlog:
+            backlog = self.queues.solver_backlog_count()
+            if backlog < self.solver_min_backlog:
                 if self.queues.lazy_flush:
                     self.queues.set_lazy_flush(False)  # materializes
+                # flood fully processed: the next crossing is a fresh
+                # flood and re-engages the device drain unconditionally
+                self._solver_drained_once = False
                 return False
+            if self._solver_drained_once and self.solver_reengage_fraction:
+                # benefit gate: a re-drain re-walks the parked backlog
+                # (rounds scale with its per-CQ depth), so it must be
+                # able to admit a flood-sized batch — enough
+                # capacity-freeing events (finishes/evictions) OR fresh
+                # arrivals since the last drain. Otherwise the trickle
+                # stays on host cycles.
+                need = max(self.solver_min_backlog,
+                           int(self.solver_reengage_fraction * backlog))
+                arrivals = (self.queues.new_pending_total
+                            - self._solver_arrivals_mark)
+                freed_ok = self._solver_freed_since_drain >= need
+                arrivals_ok = arrivals >= need * self._solver_arrival_mult
+                if not (freed_ok or arrivals_ok):
+                    if self.queues.lazy_flush:
+                        self.queues.set_lazy_flush(False)
+                    return False
+                self._solver_drain_trigger = (
+                    "freed" if freed_ok else "arrivals")
             if not self.queues.lazy_flush:
                 self.queues.set_lazy_flush(True)
         try:
@@ -331,7 +374,20 @@ class Scheduler:
                                   verify=True)
         except UnsupportedProblem:
             self.queues.materialize_stale_all()
+            self._solver_drain_trigger = None
             return False
+        self._solver_drained_once = True
+        self._solver_freed_since_drain = 0
+        self._solver_arrivals_mark = self.queues.new_pending_total
+        if getattr(self, "_solver_drain_trigger", None) == "arrivals":
+            if result.admitted < self.solver_min_backlog // 4:
+                self._solver_arrival_mult = min(
+                    64, self._solver_arrival_mult * 2)
+            else:
+                self._solver_arrival_mult = 1
+        elif result.admitted:
+            self._solver_arrival_mult = 1
+        self._solver_drain_trigger = None
         for key in result.admitted_keys:
             wl = self.store.workloads.get(key)
             if wl is not None and wl.status.admission is not None:
@@ -885,8 +941,10 @@ class Scheduler:
         if wl.status.requeue_state is not None:
             wl.status.requeue_state.requeue_at = None
         cq_spec = self.store.cluster_queues[e.info.cluster_queue]
-        if cq_spec.admission_checks:
-            for name in cq_spec.admission_checks:
+        effective_checks = cq_spec.checks_for_flavors(
+            admission.assigned_flavors())
+        if effective_checks:
+            for name in effective_checks:
                 from kueue_oss_tpu.api.types import AdmissionCheckState
                 wl.status.admission_checks.setdefault(
                     name, AdmissionCheckState(name=name))
@@ -974,6 +1032,8 @@ class Scheduler:
               if wl.status.admission is not None
               else self.store.cluster_queue_for(wl))
         was_reserved = wl.is_quota_reserved
+        if was_reserved:
+            self._solver_freed_since_drain += 1
         wl.set_condition(WorkloadConditionType.EVICTED, True, reason=reason,
                          message=message, now=now)
         if preemption_reason:
@@ -1147,6 +1207,8 @@ class Scheduler:
               else self.store.cluster_queue_for(wl))
         wl.set_condition(WorkloadConditionType.FINISHED, True,
                          reason="JobFinished", now=now)
+        if wl.is_quota_reserved:
+            self._solver_freed_since_drain += 1
         self.store.update_workload(wl)
         if cq:
             # the retained-finished GAUGES are maintained by the Store's

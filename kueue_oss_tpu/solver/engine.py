@@ -100,6 +100,11 @@ class SolverEngine:
         #: TAS CQs admitted to the device path for the CURRENT drain
         #: (computed by pending_backlog, read by the apply path)
         self._drain_tas_ready: set[str] = set()
+        #: victim-search lanes per round, throughput mode: lanes sized
+        #: to the CQ count (host-cycle parity — no head deferral) up to
+        #: this cap. Narrow lanes lower per-round latency, wide lanes
+        #: cut round counts ~10x on park-heavy shapes (see _size_caps).
+        self.h_max_cap = 1024
 
     def supported(self) -> bool:
         """Whether the drain can run on-device.
@@ -378,7 +383,15 @@ class SolverEngine:
         """Size the full kernel's static caps from the problem.
 
         h_max bounds victim searches per round: capping it only delays
-        later preempt-mode heads a round, so a modest cap is safe. p_max
+        later preempt-mode heads a round, so any cap is safe — but the
+        host cycle has NO such deferral (every head searches every
+        cycle, scheduler.go:286-467), so a cap below the CQ count both
+        diverges from host round semantics and throttles NoCandidates
+        resolution to h_max classes per round (the round-5 churn
+        profile: 49 park-only rounds at h=64 vs 5 at h=1024 on the
+        50k x 1k shape). Production drains therefore size lanes to the
+        CQ count up to `h_max_cap`; the stepped serve-loop path can run
+        a narrow-lane variant for per-round latency. p_max
         bounds candidates per search and MUST cover the largest possible
         candidate set. Candidates are always CONCURRENTLY-ADMITTED
         workloads with nonzero usage in the preemptor's cohort tree
@@ -395,7 +408,7 @@ class SolverEngine:
         powers of two to reuse compiled kernels.
         """
         C = problem.n_cqs
-        h_max = max(1, min(C, 64))
+        h_max = max(1, _pow2(min(C, self.h_max_cap)))
         root_of_cq = problem.cq_root
         wl_root = root_of_cq[np.minimum(problem.wl_cqid[:-1], C - 1)]
         counts = np.bincount(wl_root, minlength=problem.n_nodes + 1)
@@ -648,9 +661,14 @@ class SolverEngine:
         if wl.status.requeue_state is not None:
             wl.status.requeue_state.requeue_at = None
         cq_spec = self.store.cluster_queues[cq_name]
-        if cq_spec.admission_checks:
+        # flavors ACTUALLY assigned (host-path parity: scheduler._admit
+        # uses admission.assigned_flavors() too) — flavor_of covers every
+        # resource the CQ defines, not just the ones this workload uses
+        effective_checks = cq_spec.checks_for_flavors(
+            admission.assigned_flavors())
+        if effective_checks:
             from kueue_oss_tpu.api.types import AdmissionCheckState
-            for ac_name in cq_spec.admission_checks:
+            for ac_name in effective_checks:
                 wl.status.admission_checks.setdefault(
                     ac_name, AdmissionCheckState(name=ac_name))
         else:

@@ -633,10 +633,12 @@ class TASFlavorSnapshot:
         R = max(1, len(vocab))
         ridx = {r: j for j, r in enumerate(vocab)}
         cap = np.zeros((len(leaves), R), dtype=np.int64)
+        unfiltered = np.zeros((len(leaves),), dtype=bool)
         for i, leaf in enumerate(leaves):
             remaining = leaf._remaining
             if remaining is None:
                 continue  # filtered out: zero capacity
+            unfiltered[i] = True
             for r, q in remaining.items():
                 cap[i, ridx[r]] = max(0, q)
             leaf._remaining = None
@@ -666,9 +668,12 @@ class TASFlavorSnapshot:
                 dom.leader_state = int(ls[i])
                 dom.slice_state = int(ss[i])
                 dom.slice_state_with_leader = int(sswl[i])
-        # limiting-resource stats for zero-capacity leaves (host parity)
+        # limiting-resource stats for zero-capacity leaves (host parity:
+        # taint/selector/domain-filtered leaves were already counted
+        # under their own stats keys by the host filter loop and must
+        # not double-count as resource-limited)
         for i, leaf in enumerate(leaves):
-            if leaf.state == 0:
+            if unfiltered[i] and leaf.state == 0:
                 remaining = {r: int(cap[i, j])
                              for r, j in ridx.items()}
                 limiting = _limiting_resource(req, remaining)

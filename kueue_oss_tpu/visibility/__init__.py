@@ -97,7 +97,11 @@ class VisibilityServer:
     GET /apis/visibility/v1beta2/namespaces/<ns>/localqueues/<lq>/pendingworkloads
     """
 
-    def __init__(self, service: VisibilityService, port: int = 0) -> None:
+    def __init__(self, service: VisibilityService, port: int = 0,
+                 tls=None) -> None:
+        """`tls`: a parsed util.tlsconfig.TLS — applied via
+        build_ssl_context (no-op unless the TLSOptions gate is on and a
+        cert/key pair is configured; reference: config.go:182-190)."""
         svc = service
 
         class Handler(BaseHTTPRequestHandler):
@@ -126,6 +130,15 @@ class VisibilityServer:
                 self.wfile.write(body)
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.tls_active = False
+        if tls is not None:
+            from kueue_oss_tpu.util.tlsconfig import build_ssl_context
+
+            ctx = build_ssl_context(tls)
+            if ctx is not None and tls.cert_file and tls.key_file:
+                self._httpd.socket = ctx.wrap_socket(
+                    self._httpd.socket, server_side=True)
+                self.tls_active = True
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

@@ -63,6 +63,13 @@ _FUNGIBILITY = {FlavorFungibilityPolicy.BORROW,
 
 
 def validate_cluster_queue(cq: ClusterQueue) -> list[str]:
+    return (_validate_cluster_queue_core(cq)
+            + _validate_ac_on_flavors(cq))
+
+
+def _validate_cluster_queue_core(cq: ClusterQueue) -> list[str]:
+    """Everything except the admissionChecksStrategy.onFlavors check,
+    whose update path has gate-dependent legacy exemptions."""
     errs = _check_name(cq.name, "clusterQueue")
     for i, rg in enumerate(cq.resource_groups):
         covered = set(rg.covered_resources)
@@ -127,9 +134,50 @@ def validate_cluster_queue(cq: ClusterQueue) -> list[str]:
     return errs
 
 
+def _cq_flavor_names(cq: ClusterQueue) -> set[str]:
+    return {fq.name for rg in cq.resource_groups for fq in rg.flavors}
+
+
+def _validate_ac_on_flavors(cq: ClusterQueue,
+                            old: Optional[ClusterQueue] = None) -> list[str]:
+    """admissionChecksStrategy onFlavors must name flavors of this CQ.
+
+    On update with the RejectUpdatesToCQWithInvalidOnFlavors gate
+    DISABLED, rules carried over unchanged from the old spec are
+    exempt (legacy CQs persisted with invalid onFlavors must remain
+    updatable) as long as the CQ's flavor set did not change; with the
+    gate enabled every rule is validated. Reference:
+    clusterqueue_webhook.go validateAdmissionCheckOnFlavorsUpdate."""
+    from kueue_oss_tpu import features
+
+    strategy = cq.admission_checks_strategy
+    if strategy is None:
+        return []
+    valid = _cq_flavor_names(cq)
+    old_rules: dict[str, frozenset] = {}
+    if (old is not None
+            and not features.enabled("RejectUpdatesToCQWithInvalidOnFlavors")
+            and old.admission_checks_strategy is not None
+            and _cq_flavor_names(old) == valid):
+        old_rules = {r.name: frozenset(r.on_flavors)
+                     for r in old.admission_checks_strategy.admission_checks}
+    errs = []
+    for i, rule in enumerate(strategy.admission_checks):
+        if old_rules.get(rule.name) == frozenset(rule.on_flavors):
+            continue
+        for fl in rule.on_flavors:
+            if fl not in valid:
+                errs.append(
+                    f"admissionChecksStrategy.admissionChecks[{i}]"
+                    f".onFlavors: {fl!r} is not a flavor of this "
+                    f"ClusterQueue (allowed: {sorted(valid)})")
+    return errs
+
+
 def validate_cluster_queue_update(old: ClusterQueue,
                                   new: ClusterQueue) -> list[str]:
-    return validate_cluster_queue(new)
+    return (_validate_cluster_queue_core(new)
+            + _validate_ac_on_flavors(new, old=old))
 
 
 # ---------------------------------------------------------------------------

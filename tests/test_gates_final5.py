@@ -1,0 +1,429 @@
+"""The last five reference feature gates (gate registry now 56/56 vs
+kube_features.go).
+
+- TLSOptions: config TLS options parsed/validated and applied to the
+  HTTP servers as an ssl context (tlsconfig.go:36-90, config.go:182-190)
+- WorkloadRequestUseMergePatch: client patch_status merge-patch vs
+  SSA-replace semantics (workload.go:1219-1249)
+- RemoveFinalizersWithStrictPatch: resourceVersion-preconditioned
+  finalizer release (pod_controller.go:924)
+- AdmissionGatedBy: annotation propagation job -> workload + webhook
+  create/update rules (validation_admissiongatedby.go, reconciler.go:1018)
+- RejectUpdatesToCQWithInvalidOnFlavors: admissionChecksStrategy
+  onFlavors validation on CQ update (clusterqueue_webhook.go:139-185)
+"""
+
+import copy
+import ssl
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.api.types import (
+    AdmissionChecksStrategy,
+    AdmissionCheckStrategyRule,
+    ClusterQueue,
+    Condition,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.client import Clientset, Conflict
+from kueue_oss_tpu.core.store import Store
+
+
+@pytest.fixture(autouse=True)
+def _reset_gates():
+    yield
+    features.reset()
+
+
+def _cq(name="cq", flavors=("f1", "f2"), strategy=None):
+    return ClusterQueue(
+        name=name,
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name=f, resources=[
+                ResourceQuota(name="cpu", nominal=10)])
+                for f in flavors])],
+        admission_checks_strategy=strategy)
+
+
+# ---------------------------------------------------------------------------
+# TLSOptions
+# ---------------------------------------------------------------------------
+
+
+class TestTLSOptions:
+    def test_parse_rejects_pre_tls12(self):
+        from kueue_oss_tpu.util.tlsconfig import (
+            TLSOptions,
+            TLSOptionsError,
+            parse_tls_options,
+        )
+
+        with pytest.raises(TLSOptionsError, match="VersionTLS12"):
+            parse_tls_options(TLSOptions(min_version="VersionTLS11"))
+        with pytest.raises(TLSOptionsError, match="VersionTLS12"):
+            parse_tls_options(TLSOptions(min_version="VersionTLS10"))
+
+    def test_parse_versions_and_default(self):
+        from kueue_oss_tpu.util.tlsconfig import (
+            TLSOptions,
+            parse_tls_options,
+        )
+
+        assert (parse_tls_options(TLSOptions()).min_version
+                == ssl.TLSVersion.TLSv1_2)
+        assert (parse_tls_options(
+            TLSOptions(min_version="VersionTLS13")).min_version
+            == ssl.TLSVersion.TLSv1_3)
+
+    def test_parse_rejects_unknown_cipher(self):
+        from kueue_oss_tpu.util.tlsconfig import (
+            TLSOptions,
+            TLSOptionsError,
+            parse_tls_options,
+        )
+
+        with pytest.raises(TLSOptionsError, match="cipher"):
+            parse_tls_options(TLSOptions(
+                cipher_suites=["TLS_NOT_A_REAL_SUITE"]))
+
+    def test_build_context_applies_min_version(self):
+        from kueue_oss_tpu.util.tlsconfig import (
+            TLSOptions,
+            build_ssl_context,
+            parse_tls_options,
+        )
+
+        tls = parse_tls_options(TLSOptions(min_version="VersionTLS13"))
+        ctx = build_ssl_context(tls)
+        assert ctx is not None
+        assert ctx.minimum_version == ssl.TLSVersion.TLSv1_3
+
+    def test_gate_off_builds_nothing(self):
+        from kueue_oss_tpu.util.tlsconfig import (
+            TLSOptions,
+            build_ssl_context,
+            parse_tls_options,
+        )
+
+        features.set_gates({"TLSOptions": False})
+        tls = parse_tls_options(TLSOptions(min_version="VersionTLS13"))
+        assert build_ssl_context(tls) is None
+
+    def test_config_load_and_validate(self):
+        from kueue_oss_tpu.config import configuration as cfgmod
+
+        cfg = cfgmod.load({"tls": {"minVersion": "VersionTLS11"}})
+        assert cfg.tls is not None
+        errs = cfgmod.validate(cfg)
+        assert any("tls:" in e for e in errs)
+        # gate off: legacy configs with bad TLS options load unchecked
+        features.set_gates({"TLSOptions": False})
+        assert not [e for e in cfgmod.validate(cfg) if "tls" in e]
+
+    def test_visibility_server_accepts_tls_param(self):
+        from kueue_oss_tpu.util.tlsconfig import (
+            TLSOptions,
+            parse_tls_options,
+        )
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.visibility import (
+            VisibilityServer,
+            VisibilityService,
+        )
+
+        store = Store()
+        srv = VisibilityServer(
+            VisibilityService(QueueManager(store)), port=0,
+            tls=parse_tls_options(TLSOptions(min_version="VersionTLS12")))
+        # no cert/key configured: server stays plaintext but accepts the
+        # options (config.go only wires TLSOpts; serving certs come from
+        # the cert manager)
+        assert not srv.tls_active
+        srv.start()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# WorkloadRequestUseMergePatch
+# ---------------------------------------------------------------------------
+
+
+class TestWorkloadRequestUseMergePatch:
+    def _store(self):
+        store = Store()
+        wl = Workload(name="w", podsets=[PodSet(name="main", count=1,
+                                                requests={"cpu": 1})])
+        store.add_workload(wl)
+        return store
+
+    def test_merge_patch_preserves_concurrent_writer(self):
+        features.set_gates({"WorkloadRequestUseMergePatch": True})
+        store = self._store()
+        wls = Clientset(store).workloads("default")
+        stale = copy.deepcopy(wls.get("w"))  # controller A's cache
+        # controller B writes a condition meanwhile
+        wls.patch_status("w", lambda wl: wl.status.conditions.update(
+            {"B": Condition(type="B", status=True)}))
+        # controller A patches using an update fn: merge patch re-reads,
+        # so B's condition survives even though A's cache is stale
+        wls.patch_status("w", lambda wl: wl.status.conditions.update(
+            {"A": Condition(type="A", status=True)}), cached=stale)
+        conds = wls.get("w").status.conditions
+        assert "A" in conds and "B" in conds
+
+    def test_legacy_replace_clobbers_from_stale_cache(self):
+        features.set_gates({"WorkloadRequestUseMergePatch": False})
+        store = self._store()
+        wls = Clientset(store).workloads("default")
+        stale = copy.deepcopy(wls.get("w"))
+        wls.patch_status("w", lambda wl: wl.status.conditions.update(
+            {"B": Condition(type="B", status=True)}))
+        wls.patch_status("w", lambda wl: wl.status.conditions.update(
+            {"A": Condition(type="A", status=True)}), cached=stale)
+        conds = wls.get("w").status.conditions
+        assert "A" in conds and "B" not in conds  # clobbered
+
+    def test_conflict_without_retry_raises(self):
+        features.set_gates({"WorkloadRequestUseMergePatch": True})
+        store = self._store()
+        wls = Clientset(store).workloads("default")
+
+        def bump_mid_patch(wl):
+            # simulate a concurrent writer landing between read and write
+            live = store.workloads[wl.key]
+            live.resource_version += 1
+
+        with pytest.raises(Conflict):
+            wls.patch_status("w", bump_mid_patch, retry_on_conflict=False)
+
+
+# ---------------------------------------------------------------------------
+# RemoveFinalizersWithStrictPatch
+# ---------------------------------------------------------------------------
+
+
+class TestRemoveFinalizersWithStrictPatch:
+    def _controller(self):
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.jobs.pod import PodGroupController
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+        store = Store()
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        return PodGroupController(store, sched, None)
+
+    def test_strict_patch_fails_on_moved_resource_version(self):
+        from kueue_oss_tpu.jobs.pod import KUEUE_FINALIZER, Pod
+
+        ctl = self._controller()
+        pod = Pod(name="p", finalizers=[KUEUE_FINALIZER])
+        observed = pod.resource_version
+        pod.resource_version += 1  # concurrent writer
+        assert not ctl.remove_finalizer(pod, observed)
+        assert KUEUE_FINALIZER in pod.finalizers
+        # retry with the fresh observation succeeds
+        assert ctl.remove_finalizer(pod, pod.resource_version)
+        assert KUEUE_FINALIZER not in pod.finalizers
+
+    def test_gate_off_blind_patch_ignores_conflict(self):
+        from kueue_oss_tpu.jobs.pod import KUEUE_FINALIZER, Pod
+
+        features.set_gates({"RemoveFinalizersWithStrictPatch": False})
+        ctl = self._controller()
+        pod = Pod(name="p", finalizers=[KUEUE_FINALIZER])
+        observed = pod.resource_version
+        pod.resource_version += 1
+        assert ctl.remove_finalizer(pod, observed)
+        assert KUEUE_FINALIZER not in pod.finalizers
+
+
+# ---------------------------------------------------------------------------
+# AdmissionGatedBy
+# ---------------------------------------------------------------------------
+
+
+class _FakeJob:
+    kind = "FakeJob"
+    namespace = "default"
+    queue_name = "lq"
+
+    def __init__(self, annotations=None):
+        self.annotations = annotations or {}
+        self.suspended = True
+
+    def is_suspended(self):
+        return self.suspended
+
+    def pod_sets(self):
+        return [PodSet(name="main", count=1, requests={"cpu": 1})]
+
+
+class TestAdmissionGatedBy:
+    def test_propagates_to_workload(self):
+        from kueue_oss_tpu.jobframework.reconciler import (
+            ADMISSION_GATED_BY_ANNOTATION,
+            propagate_admission_gated_by,
+        )
+
+        job = _FakeJob({ADMISSION_GATED_BY_ANNOTATION: "example.com/gate"})
+        wl = Workload(name="w")
+        assert propagate_admission_gated_by(job, wl)
+        assert (wl.annotations[ADMISSION_GATED_BY_ANNOTATION]
+                == "example.com/gate")
+
+    def test_update_syncs_removal(self):
+        from kueue_oss_tpu.jobframework.reconciler import (
+            ADMISSION_GATED_BY_ANNOTATION,
+            update_admission_gated_by,
+        )
+
+        store = Store()
+        wl = Workload(name="w", annotations={
+            ADMISSION_GATED_BY_ANNOTATION: "a,b"})
+        store.add_workload(wl)
+        job = _FakeJob({ADMISSION_GATED_BY_ANNOTATION: "a"})
+        assert update_admission_gated_by(store, job, wl)
+        assert wl.annotations[ADMISSION_GATED_BY_ANNOTATION] == "a"
+        job.annotations.clear()
+        assert update_admission_gated_by(store, job, wl)
+        assert ADMISSION_GATED_BY_ANNOTATION not in wl.annotations
+
+    def test_webhook_rejects_add_after_creation(self):
+        from kueue_oss_tpu.jobframework.reconciler import (
+            ADMISSION_GATED_BY_ANNOTATION,
+        )
+        from kueue_oss_tpu.jobframework.webhook import (
+            validate_admission_gated_by_update,
+        )
+
+        old = _FakeJob()
+        new = _FakeJob({ADMISSION_GATED_BY_ANNOTATION: "g1"})
+        errs = validate_admission_gated_by_update(old, new)
+        assert any("cannot add" in e for e in errs)
+        # removal is fine
+        assert not validate_admission_gated_by_update(new, old)
+        # adding a NEW gate to an existing list is rejected
+        grown = _FakeJob({ADMISSION_GATED_BY_ANNOTATION: "g1,g2"})
+        errs = validate_admission_gated_by_update(new, grown)
+        assert any("only remove" in e for e in errs)
+
+    def test_webhook_format_rules(self):
+        from kueue_oss_tpu.jobframework.reconciler import (
+            ADMISSION_GATED_BY_ANNOTATION,
+        )
+        from kueue_oss_tpu.jobframework.webhook import validate_job_create
+
+        features.set_gates({"AdmissionGatedBy": True})
+        bad = _FakeJob({ADMISSION_GATED_BY_ANNOTATION: "a,,b"})
+        assert any("empty gate" in e for e in validate_job_create(bad))
+        dup = _FakeJob({ADMISSION_GATED_BY_ANNOTATION: "a,a"})
+        assert any("duplicate" in e for e in validate_job_create(dup))
+        long = _FakeJob({ADMISSION_GATED_BY_ANNOTATION: "x" * 64})
+        assert any("exceeds" in e for e in validate_job_create(long))
+        # gate off: annotation ignored entirely
+        features.set_gates({"AdmissionGatedBy": False})
+        assert not validate_job_create(bad)
+
+
+# ---------------------------------------------------------------------------
+# RejectUpdatesToCQWithInvalidOnFlavors (+ admissionChecksStrategy wiring)
+# ---------------------------------------------------------------------------
+
+
+class TestRejectUpdatesToCQWithInvalidOnFlavors:
+    def test_create_always_validates(self):
+        from kueue_oss_tpu.webhooks import validate_cluster_queue
+
+        cq = _cq(strategy=AdmissionChecksStrategy(admission_checks=[
+            AdmissionCheckStrategyRule(name="prov", on_flavors=["ghost"])]))
+        errs = validate_cluster_queue(cq)
+        assert any("onFlavors" in e and "ghost" in e for e in errs)
+
+    def test_update_gate_off_allows_unchanged_legacy_rules(self):
+        from kueue_oss_tpu.webhooks import validate_cluster_queue_update
+
+        features.set_gates(
+            {"RejectUpdatesToCQWithInvalidOnFlavors": False})
+        legacy = AdmissionChecksStrategy(admission_checks=[
+            AdmissionCheckStrategyRule(name="prov", on_flavors=["ghost"])])
+        old = _cq(strategy=legacy)
+        new = _cq(strategy=copy.deepcopy(legacy))
+        new.queueing_strategy = "StrictFIFO"  # unrelated update
+        assert not [e for e in validate_cluster_queue_update(old, new)
+                    if "onFlavors" in e]
+        # but a CHANGED rule is validated even with the gate off
+        new2 = _cq(strategy=AdmissionChecksStrategy(admission_checks=[
+            AdmissionCheckStrategyRule(name="prov",
+                                       on_flavors=["ghost", "f1"])]))
+        assert [e for e in validate_cluster_queue_update(old, new2)
+                if "onFlavors" in e]
+
+    def test_update_gate_on_rejects_legacy_rules(self):
+        from kueue_oss_tpu.webhooks import validate_cluster_queue_update
+
+        features.set_gates({"RejectUpdatesToCQWithInvalidOnFlavors": True})
+        legacy = AdmissionChecksStrategy(admission_checks=[
+            AdmissionCheckStrategyRule(name="prov", on_flavors=["ghost"])])
+        old = _cq(strategy=legacy)
+        new = _cq(strategy=copy.deepcopy(legacy))
+        errs = validate_cluster_queue_update(old, new)
+        assert any("onFlavors" in e and "ghost" in e for e in errs)
+
+    def test_strategy_checks_seed_by_assigned_flavor(self):
+        """A strategy rule bound to f2 must not gate admissions that
+        assigned f1 (workload.AdmissionChecksForWorkload analog)."""
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+        store = Store()
+        from kueue_oss_tpu.api.types import ResourceFlavor
+
+        store.upsert_resource_flavor(ResourceFlavor(name="f1"))
+        store.upsert_resource_flavor(ResourceFlavor(name="f2"))
+        cq = _cq(strategy=AdmissionChecksStrategy(admission_checks=[
+            AdmissionCheckStrategyRule(name="prov", on_flavors=["f2"])]))
+        store.upsert_cluster_queue(cq)
+        store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        store.add_workload(Workload(
+            name="w", queue_name="lq",
+            podsets=[PodSet(name="main", count=1, requests={"cpu": 1})]))
+        queues = QueueManager(store)
+        Scheduler(store, queues).run_until_quiet(now=0.0, max_cycles=10)
+        wl = store.workloads["default/w"]
+        assert wl.is_quota_reserved
+        # f1 fits first => rule bound to f2 does not apply => no check
+        # states pending, workload goes straight to Admitted
+        assert not wl.status.admission_checks
+        assert wl.is_admitted
+
+    def test_strategy_checks_gate_matching_flavor(self):
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+        store = Store()
+        from kueue_oss_tpu.api.types import ResourceFlavor
+
+        store.upsert_resource_flavor(ResourceFlavor(name="f1"))
+        cq = _cq(flavors=("f1",),
+                 strategy=AdmissionChecksStrategy(admission_checks=[
+                     AdmissionCheckStrategyRule(name="prov",
+                                                on_flavors=["f1"])]))
+        store.upsert_cluster_queue(cq)
+        store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+        store.add_workload(Workload(
+            name="w", queue_name="lq",
+            podsets=[PodSet(name="main", count=1, requests={"cpu": 1})]))
+        queues = QueueManager(store)
+        Scheduler(store, queues).run_until_quiet(now=0.0, max_cycles=10)
+        wl = store.workloads["default/w"]
+        assert wl.is_quota_reserved
+        assert "prov" in wl.status.admission_checks
+        assert not wl.is_admitted  # two-phase: waiting on the check

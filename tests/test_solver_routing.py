@@ -1,0 +1,160 @@
+"""Benefit-aware flood/trickle routing (round 5).
+
+A batched device drain re-walks the parked backlog (kernel rounds scale
+with per-CQ backlog depth), so Scheduler(solver="auto") engages it for
+floods and for mass capacity-freeing events, and leaves trickle churn on
+the host cycle loop (O(heads) per cycle, NoFit-hash parking).
+
+Reference framing: the reference has no device path — its scheduler IS
+the trickle loop — so the routing contract is framework-specific: the
+solver path must (a) drain the initial flood, (b) not run a full
+export+solve per trickle event, (c) re-engage when enough capacity
+frees to admit a flood-sized batch, and (d) stay correct either way.
+"""
+
+import pytest
+
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+
+def _store(n_cqs=4, quota=8):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="f"))
+    for i in range(n_cqs):
+        store.upsert_cluster_queue(ClusterQueue(
+            name=f"cq{i}",
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f", resources=[
+                    ResourceQuota(name="cpu", nominal=quota)])])]))
+        store.upsert_local_queue(LocalQueue(
+            name=f"lq{i}", cluster_queue=f"cq{i}"))
+    return store
+
+
+def _flood(store, n, start=0):
+    for i in range(start, start + n):
+        store.add_workload(Workload(
+            name=f"w{i}", queue_name=f"lq{i % 4}", uid=i + 1,
+            creation_time=float(i),
+            podsets=[PodSet(name="main", count=1, requests={"cpu": 1})]))
+
+
+class _DrainCounter:
+    def __init__(self, engine):
+        self.engine = engine
+        self.calls = 0
+        self._orig = engine.drain
+
+    def __call__(self, *a, **k):
+        self.calls += 1
+        return self._orig(*a, **k)
+
+
+@pytest.fixture
+def sched():
+    store = _store()
+    queues = QueueManager(store)
+    s = Scheduler(store, queues, solver="auto", solver_min_backlog=16)
+    engine = s._solver_engine()
+    counter = _DrainCounter(engine)
+    engine.drain = counter
+    return store, queues, s, counter
+
+
+def test_flood_engages_solver(sched):
+    store, queues, s, counter = sched
+    _flood(store, 64)
+    s.run_until_quiet(now=0.0)
+    assert counter.calls >= 1
+    admitted = sum(1 for w in store.workloads.values()
+                   if w.is_quota_reserved)
+    assert admitted == 32  # 4 CQs x 8 cpu
+
+
+def test_trickle_churn_stays_on_host(sched):
+    store, queues, s, counter = sched
+    _flood(store, 64)
+    s.run_until_quiet(now=0.0)
+    flood_calls = counter.calls
+    # a handful of finishes free a few seats: backlog is still >= 16,
+    # but the freed batch is far below the re-engage threshold
+    admitted = [k for k, w in store.workloads.items()
+                if w.is_quota_reserved]
+    for k in admitted[:3]:
+        s.finish_workload(k, now=1.0)
+    s.run_until_quiet(now=1.0)
+    assert counter.calls == flood_calls  # no new device drain
+    # the host cycles still backfilled the freed seats
+    admitted_now = sum(1 for w in store.workloads.values()
+                       if w.is_quota_reserved and not w.is_finished)
+    assert admitted_now == 32
+
+
+def test_mass_free_reengages_solver(sched):
+    store, queues, s, counter = sched
+    _flood(store, 64)
+    s.run_until_quiet(now=0.0)
+    flood_calls = counter.calls
+    # finish EVERY admitted workload: freed >= solver_min_backlog
+    admitted = [k for k, w in store.workloads.items()
+                if w.is_quota_reserved]
+    assert len(admitted) == 32
+    for k in admitted:
+        s.finish_workload(k, now=1.0)
+    # ...and 16 is >= max(min_backlog, 0.05 * backlog)
+    s.run_until_quiet(now=1.0)
+    assert counter.calls > flood_calls
+    admitted_now = sum(1 for w in store.workloads.values()
+                       if w.is_quota_reserved and not w.is_finished)
+    assert admitted_now == 32
+
+
+def test_backlog_exhaustion_resets_flood_detection(sched):
+    store, queues, s, counter = sched
+    _flood(store, 20)  # only 20: backlog crosses 16, drains, empties
+    s.run_until_quiet(now=0.0)
+    first_calls = counter.calls
+    assert first_calls >= 1
+    # everything admitted or parked below the min-backlog threshold =>
+    # the NEXT flood is fresh and engages unconditionally
+    for k in [k for k, w in store.workloads.items()
+              if w.is_quota_reserved]:
+        s.finish_workload(k, now=1.0)
+    _flood(store, 64, start=100)
+    s.run_until_quiet(now=2.0)
+    assert counter.calls > first_calls
+    admitted_now = sum(1 for w in store.workloads.values()
+                       if w.is_quota_reserved and not w.is_finished)
+    assert admitted_now == 32
+
+
+def test_zero_fraction_restores_always_drain():
+    store = _store()
+    queues = QueueManager(store)
+    s = Scheduler(store, queues, solver="auto", solver_min_backlog=16,
+                  solver_reengage_fraction=0.0)
+    engine = s._solver_engine()
+    counter = _DrainCounter(engine)
+    engine.drain = counter
+    _flood(store, 64)
+    s.run_until_quiet(now=0.0)
+    calls = counter.calls
+    admitted = [k for k, w in store.workloads.items()
+                if w.is_quota_reserved]
+    for k in admitted[:2]:
+        s.finish_workload(k, now=1.0)
+    s.run_until_quiet(now=1.0)
+    assert counter.calls > calls  # pre-round-5 behavior: every pass
