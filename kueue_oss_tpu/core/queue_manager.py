@@ -321,10 +321,13 @@ class QueueManager:
         self.current_time = 0.0
         #: solver-managed lazy capacity-freed flushes (set_lazy_flush)
         self.lazy_flush = False
-        #: monotone count of genuinely NEW pending entries (store "add"
-        #: events that queued); the scheduler's solver re-engagement
-        #: gate diffs it to detect fresh arrival floods
+        #: monotone count of genuinely NEW pending entries; the
+        #: scheduler's solver re-engagement gate diffs it to detect
+        #: fresh arrival floods. Keys ever counted are remembered so
+        #: eviction-backoff requeues and other re-adds of known
+        #: workloads don't masquerade as arrivals.
         self.new_pending_total = 0
+        self._counted_pending: set[str] = set()
         #: second-pass queue (second_pass_queue.go): min-heap of
         #: (ready_at, workload key) plus per-key attempt counts driving
         #: the 1s -> 30s exponential backoff
@@ -431,6 +434,7 @@ class QueueManager:
             if verb in ("add", "update"):
                 self.add_or_update_workload(obj)
             elif verb == "delete":
+                self._counted_pending.discard(obj.key)
                 cq = self._cq_for(obj)
                 if cq is not None:
                     self.queues[cq].delete(obj.key)
@@ -483,12 +487,13 @@ class QueueManager:
             return False
         q = self.queues[cq]
         # fresh-arrival signal for the scheduler's solver re-engagement
-        # gate: count entries becoming tracked for the FIRST time — via
-        # any path (add event, update event, LocalQueue resume sweep) —
-        # so a second flood re-engages the device drain even with zero
-        # finishes. Re-pushes of already-tracked entries don't count.
-        if (wl.key not in q._in_heap and wl.key not in q.inadmissible
-                and wl.key not in q._stale):
+        # gate: count each workload key ONCE, the first time it queues —
+        # via any path (add event, update event, LocalQueue resume
+        # sweep) — so a second flood re-engages the device drain even
+        # with zero finishes, while eviction-backoff requeues and other
+        # re-adds of known workloads don't masquerade as arrivals.
+        if wl.key not in self._counted_pending:
+            self._counted_pending.add(wl.key)
             self.new_pending_total += 1
         q.push(WorkloadInfo(wl, cluster_queue=cq), check_no_fit=True)
         return True

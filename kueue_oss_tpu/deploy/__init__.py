@@ -1,0 +1,185 @@
+"""Deploy-manifest tooling: kustomize loader + chart renderer.
+
+Reference parity: config/components/* (kustomize bases the reference
+ships) and charts/kueue (its helm chart). The analogs live in
+deploy/manifests (base + overlays) and deploy/chart (values.yaml +
+templates). Since the toolchain here has no helm binary, the chart is
+rendered by this module: `${a.b.c}` tokens substitute from deep-merged
+values (scalars inline; mappings/lists splice as YAML), and a template
+whose first line carries `enabled: ${flag}` is skipped when the flag
+resolves false.
+
+CLI:
+    python -m kueue_oss_tpu.deploy render [--values my.yaml] [--set a.b=c]
+    python -m kueue_oss_tpu.deploy build deploy/manifests/overlays/dev
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, Optional
+
+import yaml
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+CHART_DIR = REPO_ROOT / "deploy" / "chart"
+MANIFESTS_DIR = REPO_ROOT / "deploy" / "manifests"
+
+_TOKEN = re.compile(r"\$\{([A-Za-z0-9_.]+)\}")
+
+
+class DeployError(ValueError):
+    pass
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _lookup(values: dict, dotted: str):
+    cur: Any = values
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise DeployError(f"chart value {dotted!r} is not defined")
+        cur = cur[part]
+    return cur
+
+
+def _substitute(text: str, values: dict) -> str:
+    """Replace ${a.b.c}. A token that resolves to a mapping or list is
+    spliced as flow-style YAML (valid inline in a block document)."""
+
+    def repl(m: re.Match) -> str:
+        v = _lookup(values, m.group(1))
+        if isinstance(v, (dict, list)):
+            return json.dumps(v)  # JSON is a YAML subset
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        return str(v)
+
+    return _TOKEN.sub(repl, text)
+
+
+def render_chart(chart_dir: Path = CHART_DIR,
+                 values_override: Optional[dict] = None) -> dict[str, list]:
+    """Render every template with values.yaml deep-merged under the
+    override; returns {template_name: [parsed docs]}."""
+    values = yaml.safe_load((chart_dir / "values.yaml").read_text()) or {}
+    values = _deep_merge(values, values_override or {})
+    out: dict[str, list] = {}
+    for tpl in sorted((chart_dir / "templates").glob("*.yaml")):
+        text = tpl.read_text()
+        first = text.splitlines()[0] if text else ""
+        m = re.match(r"#\s*enabled:\s*\$\{([A-Za-z0-9_.]+)\}", first)
+        if m and not _lookup(values, m.group(1)):
+            continue
+        rendered = _substitute(text, values)
+        docs = [d for d in yaml.safe_load_all(rendered) if d is not None]
+        out[tpl.name] = docs
+    return out
+
+
+def _apply_json_patch(doc: dict, ops: list) -> None:
+    """The subset of RFC-6902 kustomize patches the overlays use
+    (replace/add/remove on dict paths and list indices, `-` append)."""
+    for op in ops:
+        path = [p for p in op["path"].split("/") if p]
+        parent: Any = doc
+        for part in path[:-1]:
+            parent = (parent[int(part)] if isinstance(parent, list)
+                      else parent[part])
+        leaf = path[-1]
+        kind = op["op"]
+        if isinstance(parent, list):
+            if kind == "add" and leaf == "-":
+                parent.append(op["value"])
+            elif kind == "add":
+                parent.insert(int(leaf), op["value"])
+            elif kind == "replace":
+                parent[int(leaf)] = op["value"]
+            elif kind == "remove":
+                del parent[int(leaf)]
+            else:
+                raise DeployError(f"unsupported patch op {kind!r}")
+        else:
+            if kind in ("add", "replace"):
+                parent[leaf] = op["value"]
+            elif kind == "remove":
+                parent.pop(leaf, None)
+            else:
+                raise DeployError(f"unsupported patch op {kind!r}")
+
+
+def build_kustomize(directory: Path) -> list[dict]:
+    """Resolve a kustomization: recurse into resource dirs, load
+    resource files, apply the overlay's JSON patches by target."""
+    directory = Path(directory)
+    kustomization = yaml.safe_load(
+        (directory / "kustomization.yaml").read_text())
+    docs: list[dict] = []
+    for res in kustomization.get("resources", []):
+        path = directory / res
+        if path.is_dir():
+            docs.extend(build_kustomize(path))
+        else:
+            docs.extend(d for d in yaml.safe_load_all(path.read_text())
+                        if d is not None)
+    for patch in kustomization.get("patches", []):
+        target = patch.get("target", {})
+        ops = yaml.safe_load(patch["patch"])
+        matched = False
+        for doc in docs:
+            if target.get("kind") and doc.get("kind") != target["kind"]:
+                continue
+            name = doc.get("metadata", {}).get("name")
+            if target.get("name") and name != target["name"]:
+                continue
+            _apply_json_patch(doc, ops)
+            matched = True
+        if not matched:
+            raise DeployError(f"patch target matched nothing: {target}")
+    return docs
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(prog="kueue_oss_tpu.deploy")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pr = sub.add_parser("render", help="render the chart to stdout")
+    pr.add_argument("--values", help="values override YAML file")
+    pr.add_argument("--set", action="append", default=[],
+                    metavar="a.b=v", help="inline value override")
+    pb = sub.add_parser("build", help="resolve a kustomization dir")
+    pb.add_argument("directory")
+    args = p.parse_args(argv)
+    if args.cmd == "render":
+        override: dict = {}
+        if args.values:
+            override = yaml.safe_load(Path(args.values).read_text()) or {}
+        for item in getattr(args, "set"):
+            dotted, _, raw = item.partition("=")
+            cur = override
+            parts = dotted.split(".")
+            for part in parts[:-1]:
+                cur = cur.setdefault(part, {})
+            cur[parts[-1]] = yaml.safe_load(raw)
+        rendered = render_chart(values_override=override)
+        docs = [d for lst in rendered.values() for d in lst]
+    else:
+        docs = build_kustomize(Path(args.directory))
+    yaml.safe_dump_all(docs, sys.stdout, sort_keys=False)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

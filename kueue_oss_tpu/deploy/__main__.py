@@ -1,0 +1,3 @@
+from kueue_oss_tpu.deploy import main
+
+raise SystemExit(main())

@@ -47,17 +47,58 @@ class TLS:
     key_file: Optional[str] = None
 
 
-def _ciphers_settable(names: list[str]) -> bool:
-    """Validation must match what build_ssl_context will actually do:
-    set_ciphers() rejects TLS 1.3 suite names that get_ciphers() lists,
-    so the only sound check is attempting the call on a throwaway
-    context."""
+#: TLS 1.3 suites are configured by OpenSSL's set_ciphersuites, which
+#: the Python ssl module does not expose; they are on by default, so
+#: naming them validates as a no-op (documented in build_ssl_context).
+_TLS13_SUITES = {
+    "TLS_AES_128_GCM_SHA256",
+    "TLS_AES_256_GCM_SHA384",
+    "TLS_CHACHA20_POLY1305_SHA256",
+    "TLS_AES_128_CCM_SHA256",
+    "TLS_AES_128_CCM_8_SHA256",
+}
+
+
+def _iana_to_openssl(name: str) -> str:
+    """Translate an IANA suite name (the format the reference's config
+    uses, e.g. TLS_ECDHE_RSA_WITH_AES_128_GCM_SHA256) to OpenSSL's
+    (ECDHE-RSA-AES128-GCM-SHA256), which set_ciphers understands."""
+    s = name
+    if s.startswith("TLS_"):
+        s = s[4:]
+    s = s.replace("_WITH_", "_")
+    s = s.replace("_", "-")
+    for bits in ("128", "256"):
+        s = s.replace(f"AES-{bits}", f"AES{bits}")
+        s = s.replace(f"CAMELLIA-{bits}", f"CAMELLIA{bits}")
+    s = s.replace("3DES-EDE-CBC", "DES-CBC3")
+    return s
+
+
+def _settable(cipher_string: str) -> bool:
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     try:
-        ctx.set_ciphers(":".join(names))
+        ctx.set_ciphers(cipher_string)
         return True
     except ssl.SSLError:
         return False
+
+
+def _resolve_ciphers(names: list[str]) -> tuple[list[str], list[str]]:
+    """Per name: accept TLS 1.3 suites as no-ops; otherwise accept the
+    OpenSSL spelling directly or via the IANA translation. Returns
+    (openssl_names_for_set_ciphers, invalid_names)."""
+    resolved, bad = [], []
+    for name in names:
+        if name in _TLS13_SUITES:
+            continue
+        if _settable(name):
+            resolved.append(name)
+        elif _settable(_iana_to_openssl(name)):
+            resolved.append(_iana_to_openssl(name))
+        else:
+            bad.append(name)
+    return resolved, bad
 
 
 def parse_tls_options(cfg: Optional[TLSOptions]) -> Optional[TLS]:
@@ -81,11 +122,12 @@ def parse_tls_options(cfg: Optional[TLSOptions]) -> Optional[TLS]:
         version = _VERSIONS[cfg.min_version]
     suites = []
     if cfg.cipher_suites:
-        if not _ciphers_settable(cfg.cipher_suites):
-            errs.append(f"invalid cipher suites: {cfg.cipher_suites}. "
-                        "Please use secure cipher names")
+        resolved, bad = _resolve_ciphers(cfg.cipher_suites)
+        if bad:
+            errs.append(f"invalid cipher suites: {bad}. Please use "
+                        "secure cipher names (IANA or OpenSSL format)")
         else:
-            suites = list(cfg.cipher_suites)
+            suites = resolved
     if errs:
         raise TLSOptionsError("; ".join(errs))
     return TLS(min_version=version, cipher_suites=suites,
