@@ -98,7 +98,11 @@ def validate_job_create(job: GenericJob) -> list[str]:
     from kueue_oss_tpu import features
 
     errs = []
+    seen_ps: set[str] = set()
     for ps in job.pod_sets():
+        if ps.name in seen_ps:
+            errs.append(f"podset {ps.name}: duplicate podset name")
+        seen_ps.add(ps.name)
         if ps.count < 0:
             errs.append(f"podset {ps.name}: negative count")
         if ps.min_count is not None and not 0 < ps.min_count <= ps.count:
@@ -108,6 +112,11 @@ def validate_job_create(job: GenericJob) -> list[str]:
                 errs.append(f"podset {ps.name}: negative request {r}")
     if features.enabled("AdmissionGatedBy"):
         errs.extend(_validate_gated_by_format(_gated_by(job)))
+    # per-framework rules (the reference's *_webhook.go ValidateCreate
+    # bodies); an integration opts in by defining validate()
+    custom = getattr(job, "validate", None)
+    if callable(custom):
+        errs.extend(custom())
     return errs
 
 
@@ -122,4 +131,9 @@ def validate_job_update(old: GenericJob, new: GenericJob) -> list[str]:
     if features.enabled("AdmissionGatedBy"):
         errs.extend(e for e in validate_admission_gated_by_update(old, new)
                     if e not in errs)
+    # per-framework update rules (the reference's *_webhook.go
+    # ValidateUpdate bodies beyond the shared queue-name check)
+    custom = getattr(new, "validate_update", None)
+    if callable(custom):
+        errs.extend(custom(old))
     return errs

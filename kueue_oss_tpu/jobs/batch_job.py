@@ -19,6 +19,10 @@ from kueue_oss_tpu.api.types import PodSet, PodSetTopologyRequest, Toleration
 from kueue_oss_tpu.jobframework.interface import BaseJob, PodSetInfo
 from kueue_oss_tpu.jobframework.registry import integration_manager
 
+#: job_webhook.go JobCompletionsEqualParallelismAnnotation
+SYNC_COMPLETIONS_ANNOTATION = (
+    "kueue.x-k8s.io/job-completions-equal-parallelism")
+
 
 @integration_manager.register
 @dataclass
@@ -36,6 +40,32 @@ class BatchJob(BaseJob):
     topology_request: Optional[PodSetTopologyRequest] = None
     #: live status counters (job.Status)
     succeeded: int = 0
+    #: batchv1 CompletionMode ("NonIndexed" | "Indexed")
+    completion_mode: str = "NonIndexed"
+
+    def validate(self) -> list[str]:
+        """job_webhook.go validatePartialAdmissionCreate +
+        validateSyncCompletionCreate."""
+        errs = []
+        if self.min_parallelism is not None and not (
+                0 < self.min_parallelism < self.parallelism):
+            errs.append(
+                f"minParallelism {self.min_parallelism}: should be "
+                f"between 0 and {self.parallelism - 1}")
+        sync = self.annotations.get(SYNC_COMPLETIONS_ANNOTATION)
+        if sync is not None:
+            if sync.lower() not in ("true", "false"):
+                errs.append(f"{SYNC_COMPLETIONS_ANNOTATION}: "
+                            f"{sync!r} is not a boolean")
+            elif sync.lower() == "true":
+                if self.completion_mode != "Indexed":
+                    errs.append(f"{SYNC_COMPLETIONS_ANNOTATION}: should "
+                                "not be enabled for NonIndexed jobs")
+                if (self.completions or 1) != self.parallelism:
+                    errs.append(
+                        "completions: should be equal to parallelism "
+                        f"when {SYNC_COMPLETIONS_ANNOTATION} is true")
+        return errs
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(

@@ -48,6 +48,20 @@ class JobSet(BaseJob):
             node_selector=dict(rj.node_selector),
         ) for rj in self.replicated_jobs]
 
+    def validate(self) -> list[str]:
+        """jobset_webhook.go: replicated-job names must be unique and
+        replicas positive (duplicate names would collapse podsets)."""
+        errs = []
+        seen: set[str] = set()
+        for rj in self.replicated_jobs:
+            if rj.name in seen:
+                errs.append(f"replicatedJobs: duplicate name {rj.name!r}")
+            seen.add(rj.name)
+            if rj.replicas < 1:
+                errs.append(f"replicatedJobs {rj.name}: replicas must "
+                            "be >= 1")
+        return errs
+
     def run_with_podsets_info(self, infos: list[PodSetInfo]) -> None:
         if len(infos) != len(self.replicated_jobs):
             raise ValueError(

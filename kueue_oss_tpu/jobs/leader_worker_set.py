@@ -45,6 +45,16 @@ class LeaderWorkerSet(BaseJob):
     worker_requests: dict[str, int] = field(default_factory=dict)
     topology_request: Optional[PodSetTopologyRequest] = None
 
+    def validate(self) -> list[str]:
+        """leaderworkerset_webhook.go: size and replicas must be
+        positive (a zero-size group has no leader to admit)."""
+        errs = []
+        if self.size < 1:
+            errs.append("size: must be >= 1")
+        if self.replicas < 1:
+            errs.append("replicas: must be >= 1")
+        return errs
+
     def pod_sets(self) -> list[PodSet]:
         podsets = [PodSet(name="leader", count=self.replicas,
                           requests=dict(self.leader_requests))]

@@ -57,6 +57,18 @@ class MPIJob(BaseJob):
                 topology_request=self.worker_topology_request))
         return sets
 
+    def validate(self) -> list[str]:
+        """mpijob_webhook.go validateCommon: worker count sanity and
+        launcher-as-worker consistency (a launcher that counts as a
+        worker needs the worker template to exist)."""
+        errs = []
+        if self.worker_count < 0:
+            errs.append("mpiReplicaSpecs.Worker: replicas must be >= 0")
+        if self.run_launcher_as_worker and self.worker_count <= 0:
+            errs.append("runLauncherAsWorker: requires a Worker replica "
+                        "spec to take the template from")
+        return errs
+
     def run_with_podsets_info(self, infos: list[PodSetInfo]) -> None:
         expected = 1 + (1 if self.worker_count > 0 else 0)
         if len(infos) != expected:

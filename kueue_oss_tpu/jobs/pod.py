@@ -40,6 +40,11 @@ KUEUE_FINALIZER = "kueue.x-k8s.io/managed"
 #: opt-in annotation for FailureRecoveryPolicy force-deletion
 #: (constants.go SafeToForcefullyDeleteAnnotationKey)
 SAFE_TO_FORCE_DELETE_ANNOTATION = "kueue.x-k8s.io/safe-to-forcefully-delete"
+MANAGED_LABEL = "kueue.x-k8s.io/managed"
+RETRIABLE_IN_GROUP_ANNOTATION = "kueue.x-k8s.io/retriable-in-group"
+#: TAS topology request annotations (pod_webhook.go validateTopologyRequest)
+REQUIRED_TOPOLOGY_ANNOTATION = "kueue.x-k8s.io/podset-required-topology"
+PREFERRED_TOPOLOGY_ANNOTATION = "kueue.x-k8s.io/podset-preferred-topology"
 
 PENDING = "Pending"
 RUNNING = "Running"
@@ -201,6 +206,69 @@ class PodGroupController:
         self.stuck_termination_timeout_s = stuck_termination_timeout_s
 
     # -- pod lifecycle -----------------------------------------------------
+
+    @staticmethod
+    def validate_pod(pod: Pod) -> list[str]:
+        """Pod admission webhook (pod_webhook.go validateCommon):
+        managed-label value, group-name label / group-total annotation
+        both-or-neither with a positive-int total and an RFC-1123 group
+        name, and topology required/preferred mutual exclusion."""
+        import re as _re
+
+        errs: list[str] = []
+        managed = pod.labels.get(MANAGED_LABEL)
+        if managed is not None and managed != "true":
+            errs.append(f"labels[{MANAGED_LABEL}]: managed label value "
+                        "can only be 'true'")
+        group = pod.group_name
+        total_raw = pod.annotations.get(POD_GROUP_TOTAL_ANNOTATION)
+        if group is None and total_raw is not None:
+            errs.append(
+                f"labels[{POD_GROUP_LABEL}]: both the "
+                f"'{POD_GROUP_TOTAL_ANNOTATION}' annotation and the "
+                f"'{POD_GROUP_LABEL}' label should be set")
+        if group is not None:
+            if not _re.match(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$", group):
+                errs.append(f"labels[{POD_GROUP_LABEL}]: {group!r} is "
+                            "not a valid RFC-1123 name")
+            if total_raw is None:
+                errs.append(
+                    f"annotations[{POD_GROUP_TOTAL_ANNOTATION}]: both "
+                    f"the '{POD_GROUP_TOTAL_ANNOTATION}' annotation and "
+                    f"the '{POD_GROUP_LABEL}' label should be set")
+        if total_raw is not None:
+            try:
+                if int(total_raw) <= 0:
+                    errs.append(
+                        f"annotations[{POD_GROUP_TOTAL_ANNOTATION}]: "
+                        "must be a positive integer")
+            except ValueError:
+                errs.append(
+                    f"annotations[{POD_GROUP_TOTAL_ANNOTATION}]: "
+                    f"{total_raw!r} is not an integer")
+        if (pod.annotations.get(REQUIRED_TOPOLOGY_ANNOTATION)
+                and pod.annotations.get(PREFERRED_TOPOLOGY_ANNOTATION)):
+            errs.append(
+                f"annotations[{REQUIRED_TOPOLOGY_ANNOTATION}]: required "
+                "and preferred topology are mutually exclusive")
+        return errs
+
+    @staticmethod
+    def validate_pod_update(old: Pod, new: Pod) -> list[str]:
+        """pod_webhook.go ValidateUpdate: an unretriable pod group
+        cannot be converted to retriable, and group membership is
+        immutable."""
+        errs = PodGroupController.validate_pod(new)
+        old_r = old.annotations.get(RETRIABLE_IN_GROUP_ANNOTATION)
+        new_r = new.annotations.get(RETRIABLE_IN_GROUP_ANNOTATION)
+        if (new.group_name is not None and old_r == "false"
+                and new_r != "false"):
+            errs.append(
+                f"annotations[{RETRIABLE_IN_GROUP_ANNOTATION}]: "
+                "unretriable pod group can't be converted to retriable")
+        if old.group_name != new.group_name:
+            errs.append(f"labels[{POD_GROUP_LABEL}]: immutable")
+        return errs
 
     def upsert_pod(self, pod: Pod) -> None:
         from kueue_oss_tpu import features

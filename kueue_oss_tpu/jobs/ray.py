@@ -83,6 +83,30 @@ class _RayBase(BaseJob):
             for wg in self.worker_groups)
         return podsets
 
+    def validate(self) -> list[str]:
+        """raycluster_webhook.go:135-163: in-tree autoscaling only for
+        elastic jobs (ElasticJobsViaWorkloadSlices + opted-in); at most
+        7 worker groups (8 podsets minus the head); no group may take
+        the reserved head name."""
+        from kueue_oss_tpu import features, workloadslicing
+
+        errs = []
+        if self.autoscaling and not (
+                features.enabled("ElasticJobsViaWorkloadSlices")
+                and workloadslicing.enabled(self)):
+            errs.append(
+                "enableInTreeAutoscaling: a kueue managed job can use "
+                "autoscaling only when the ElasticJobsViaWorkloadSlices "
+                "feature gate is on and the job is an elastic job")
+        if len(self.worker_groups) > 7:
+            errs.append(f"workerGroupSpecs: too many worker groups "
+                        f"({len(self.worker_groups)} > 7)")
+        for wg in self.worker_groups:
+            if wg.name == "head":
+                errs.append('workerGroupSpecs: "head" is reserved for '
+                            "the head group")
+        return errs
+
     def pod_sets(self) -> list[PodSet]:
         return self.cluster_pod_sets()
 
@@ -111,9 +135,24 @@ class RayJob(_RayBase):
     #: live status (rayv1.JobDeploymentStatus)
     deployment_status: str = "New"
     job_status: str = ""
+    #: rayjob spec.shutdownAfterJobFinishes
+    shutdown_after_job_finishes: bool = True
 
     def skip(self) -> bool:
         return bool(self.cluster_selector)
+
+    def validate(self) -> list[str]:
+        """rayjob_webhook.go:110-140 on top of the cluster rules; both
+        rules apply only to kueue-managed jobs (a cluster_selector job
+        is skipped entirely, so its cluster lifecycle is not ours)."""
+        errs = super().validate()
+        if self.queue_name and self.cluster_selector:
+            errs.append("clusterSelector: a kueue managed job should "
+                        "not use an existing cluster")
+        elif self.queue_name and not self.shutdown_after_job_finishes:
+            errs.append("shutdownAfterJobFinishes: a kueue managed job "
+                        "should delete the cluster after finishing")
+        return errs
 
     def pod_sets(self) -> list[PodSet]:
         podsets = self.cluster_pod_sets()

@@ -72,6 +72,11 @@ def _iana_to_openssl(name: str) -> str:
         s = s.replace(f"AES-{bits}", f"AES{bits}")
         s = s.replace(f"CAMELLIA-{bits}", f"CAMELLIA{bits}")
     s = s.replace("3DES-EDE-CBC", "DES-CBC3")
+    # OpenSSL spells ChaCha20 suites without the HMAC suffix...
+    if s.endswith("CHACHA20-POLY1305-SHA256"):
+        s = s[: -len("-SHA256")]
+    # ...and CBC suites without the CBC token (ECDHE-RSA-AES128-SHA)
+    s = s.replace("-CBC-", "-")
     return s
 
 

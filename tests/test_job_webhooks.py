@@ -1,0 +1,191 @@
+"""Per-framework webhook validation (round 5).
+
+Reference parity: pkg/controller/jobs/*/{job,raycluster,rayjob,mpijob,
+jobset,leaderworkerset}_webhook.go ValidateCreate bodies, dispatched
+through jobframework.validate_job_create (an integration opts in by
+defining validate() / validate_update(old)).
+"""
+
+import pytest
+
+from kueue_oss_tpu import features
+from kueue_oss_tpu.jobframework.webhook import (
+    validate_job_create,
+    validate_job_update,
+)
+from kueue_oss_tpu.jobs.batch_job import (
+    SYNC_COMPLETIONS_ANNOTATION,
+    BatchJob,
+)
+from kueue_oss_tpu.jobs.job_set import JobSet, ReplicatedJob
+from kueue_oss_tpu.jobs.leader_worker_set import LeaderWorkerSet
+from kueue_oss_tpu.jobs.mpi_job import MPIJob
+from kueue_oss_tpu.jobs.ray import RayCluster, RayJob, WorkerGroup
+
+
+@pytest.fixture(autouse=True)
+def _reset_gates():
+    yield
+    features.reset()
+
+
+class TestBatchJobWebhook:
+    def test_min_parallelism_bounds(self):
+        job = BatchJob(name="j", queue_name="lq", parallelism=4,
+                       min_parallelism=4)
+        assert any("minParallelism" in e for e in validate_job_create(job))
+        job.min_parallelism = 3
+        assert not validate_job_create(job)
+
+    def test_sync_completions_requires_indexed_and_equal(self):
+        job = BatchJob(name="j", queue_name="lq", parallelism=4,
+                       completions=2, annotations={
+                           SYNC_COMPLETIONS_ANNOTATION: "true"})
+        errs = validate_job_create(job)
+        assert any("NonIndexed" in e for e in errs)
+        assert any("equal to parallelism" in e for e in errs)
+        job.completion_mode = "Indexed"
+        job.completions = 4
+        assert not validate_job_create(job)
+
+    def test_sync_completions_bool_format(self):
+        job = BatchJob(name="j", queue_name="lq", annotations={
+            SYNC_COMPLETIONS_ANNOTATION: "yes"})
+        assert any("not a boolean" in e for e in validate_job_create(job))
+
+
+class TestRayWebhook:
+    def test_autoscaling_needs_elastic_gate(self):
+        job = RayCluster(name="rc", queue_name="lq", autoscaling=True)
+        assert any("autoscaling" in e for e in validate_job_create(job))
+
+    def test_worker_group_limit_and_reserved_name(self):
+        job = RayCluster(name="rc", queue_name="lq", worker_groups=[
+            WorkerGroup(name=f"g{i}") for i in range(8)])
+        assert any("too many worker groups" in e
+                   for e in validate_job_create(job))
+        job2 = RayCluster(name="rc", queue_name="lq", worker_groups=[
+            WorkerGroup(name="head")])
+        assert any("reserved for the head group" in e
+                   for e in validate_job_create(job2))
+
+    def test_rayjob_cluster_selector_and_shutdown(self):
+        job = RayJob(name="rj", queue_name="lq",
+                     cluster_selector={"ray.io/cluster": "c"})
+        assert any("clusterSelector" in e for e in validate_job_create(job))
+        job2 = RayJob(name="rj", queue_name="lq",
+                      shutdown_after_job_finishes=False)
+        assert any("shutdownAfterJobFinishes" in e
+                   for e in validate_job_create(job2))
+        ok = RayJob(name="rj", queue_name="lq",
+                    worker_groups=[WorkerGroup(name="workers")])
+        assert not validate_job_create(ok)
+
+
+class TestOtherFrameworkWebhooks:
+    def test_jobset_duplicate_replicated_job(self):
+        job = JobSet(name="js", queue_name="lq", replicated_jobs=[
+            ReplicatedJob(name="a"), ReplicatedJob(name="a")])
+        assert any("duplicate name" in e for e in validate_job_create(job))
+
+    def test_lws_size_bounds(self):
+        job = LeaderWorkerSet(name="lws", queue_name="lq", size=0)
+        assert any("size" in e for e in validate_job_create(job))
+
+    def test_mpi_launcher_as_worker_needs_worker_spec(self):
+        job = MPIJob(name="m", queue_name="lq",
+                     run_launcher_as_worker=True, worker_count=0)
+        assert any("runLauncherAsWorker" in e
+                   for e in validate_job_create(job))
+
+    def test_update_dispatches_custom_rules(self):
+        old = RayJob(name="rj", queue_name="lq",
+                     worker_groups=[WorkerGroup(name="w")])
+        new = RayJob(name="rj", queue_name="lq",
+                     worker_groups=[WorkerGroup(name="w")],
+                     shutdown_after_job_finishes=False)
+        assert any("shutdownAfterJobFinishes" in e
+                   for e in validate_job_update(old, new))
+
+    def test_duplicate_podset_names_rejected_globally(self):
+        job = RayCluster(name="rc", queue_name="lq", worker_groups=[
+            WorkerGroup(name="w"), WorkerGroup(name="w")])
+        assert any("duplicate podset name" in e
+                   for e in validate_job_create(job))
+
+
+class TestPodWebhook:
+    def _ctl(self):
+        from kueue_oss_tpu.jobs.pod import PodGroupController
+
+        return PodGroupController
+
+    def test_managed_label_value(self):
+        from kueue_oss_tpu.jobs.pod import MANAGED_LABEL, Pod
+
+        ctl = self._ctl()
+        assert any("managed label" in e for e in ctl.validate_pod(
+            Pod(name="p", labels={MANAGED_LABEL: "yes"})))
+        assert not ctl.validate_pod(
+            Pod(name="p", labels={MANAGED_LABEL: "true"}))
+
+    def test_group_metadata_both_or_neither(self):
+        from kueue_oss_tpu.jobs.pod import (
+            POD_GROUP_LABEL,
+            POD_GROUP_TOTAL_ANNOTATION,
+            Pod,
+        )
+
+        ctl = self._ctl()
+        only_label = Pod(name="p", labels={POD_GROUP_LABEL: "g"})
+        assert any("should be set" in e
+                   for e in ctl.validate_pod(only_label))
+        only_ann = Pod(name="p", annotations={
+            POD_GROUP_TOTAL_ANNOTATION: "3"})
+        assert any("should be set" in e for e in ctl.validate_pod(only_ann))
+        bad_total = Pod(name="p", labels={POD_GROUP_LABEL: "g"},
+                        annotations={POD_GROUP_TOTAL_ANNOTATION: "x"})
+        assert any("not an integer" in e
+                   for e in ctl.validate_pod(bad_total))
+        zero = Pod(name="p", labels={POD_GROUP_LABEL: "g"},
+                   annotations={POD_GROUP_TOTAL_ANNOTATION: "0"})
+        assert any("positive" in e for e in ctl.validate_pod(zero))
+        ok = Pod(name="p", labels={POD_GROUP_LABEL: "g"},
+                 annotations={POD_GROUP_TOTAL_ANNOTATION: "3"})
+        assert not ctl.validate_pod(ok)
+
+    def test_unretriable_cannot_become_retriable(self):
+        from kueue_oss_tpu.jobs.pod import (
+            POD_GROUP_LABEL,
+            POD_GROUP_TOTAL_ANNOTATION,
+            RETRIABLE_IN_GROUP_ANNOTATION,
+            Pod,
+        )
+
+        ctl = self._ctl()
+        base = {POD_GROUP_LABEL: "g"}
+        ann = {POD_GROUP_TOTAL_ANNOTATION: "2"}
+        old = Pod(name="p", labels=dict(base), annotations={
+            **ann, RETRIABLE_IN_GROUP_ANNOTATION: "false"})
+        new = Pod(name="p", labels=dict(base), annotations=dict(ann))
+        assert any("unretriable" in e
+                   for e in ctl.validate_pod_update(old, new))
+        # staying unretriable is fine
+        same = Pod(name="p", labels=dict(base), annotations={
+            **ann, RETRIABLE_IN_GROUP_ANNOTATION: "false"})
+        assert not ctl.validate_pod_update(old, same)
+
+    def test_group_membership_immutable(self):
+        from kueue_oss_tpu.jobs.pod import (
+            POD_GROUP_LABEL,
+            POD_GROUP_TOTAL_ANNOTATION,
+            Pod,
+        )
+
+        ctl = self._ctl()
+        old = Pod(name="p", labels={POD_GROUP_LABEL: "g1"},
+                  annotations={POD_GROUP_TOTAL_ANNOTATION: "2"})
+        new = Pod(name="p", labels={POD_GROUP_LABEL: "g2"},
+                  annotations={POD_GROUP_TOTAL_ANNOTATION: "2"})
+        assert any("immutable" in e
+                   for e in ctl.validate_pod_update(old, new))
