@@ -152,6 +152,9 @@ class Kueuectl:
         clq.add_argument("name")
         clq.add_argument("-c", "--clusterqueue", required=True)
         clq.add_argument("-n", "--namespace", default="default")
+        clq.add_argument("-i", "--ignore-unknown-cq", action="store_true",
+                         help="create even if the cluster queue does not "
+                              "exist (create_localqueue.go:106)")
         clq.set_defaults(func=self._create_lq)
         crf = create.add_parser("resourceflavor")
         crf.add_argument("name")
@@ -167,10 +170,16 @@ class Kueuectl:
         lst = sub.add_parser("list").add_subparsers(required=True)
         lcq = lst.add_parser("clusterqueue")
         lcq.add_argument("-o", "--output", default="table", choices=OUT)
+        lcq.add_argument("--active", default=None,
+                         choices=("true", "false"),
+                         help="filter by whether the queue can admit "
+                              "(list_clusterqueue.go:122)")
         lcq.set_defaults(func=self._list_cq)
         llq = lst.add_parser("localqueue")
         llq.add_argument("-n", "--namespace", default=None)
         llq.add_argument("-A", "--all-namespaces", action="store_true")
+        llq.add_argument("-c", "--clusterqueue", default=None,
+                         help="only queues feeding this cluster queue")
         llq.add_argument("-o", "--output", default="table", choices=OUT)
         llq.set_defaults(func=self._list_lq)
         lwl = lst.add_parser("workload")
@@ -181,9 +190,16 @@ class Kueuectl:
         lwl.add_argument("--field-selector", default="",
                          help="field selector, e.g. status.phase=Pending,"
                               "spec.queueName=lq")
+        lwl.add_argument("--status", action="append", default=None,
+                         choices=("all", "pending", "quotareserved",
+                                  "admitted", "finished"),
+                         help="filter workloads by status; repeatable "
+                              "(list_workload.go:129)")
         lwl.add_argument("-o", "--output", default="table", choices=OUT)
         lwl.set_defaults(func=self._list_wl)
-        lst.add_parser("resourceflavor").set_defaults(func=self._list_rf)
+        lrf = lst.add_parser("resourceflavor")
+        lrf.add_argument("-o", "--output", default="table", choices=OUT)
+        lrf.set_defaults(func=self._list_rf)
         lst.add_parser("cohort").set_defaults(func=self._list_cohorts)
         ltp = lst.add_parser("topology")
         ltp.add_argument("-o", "--output", default="table", choices=OUT)
@@ -197,6 +213,13 @@ class Kueuectl:
         dscq = desc.add_parser("clusterqueue")
         dscq.add_argument("name")
         dscq.set_defaults(func=self._describe_cq)
+        dslq = desc.add_parser("localqueue")
+        dslq.add_argument("name")
+        dslq.add_argument("-n", "--namespace", default="default")
+        dslq.set_defaults(func=self._describe_lq)
+        dsrf = desc.add_parser("resourceflavor")
+        dsrf.add_argument("name")
+        dsrf.set_defaults(func=self._describe_rf)
         dstp = desc.add_parser("topology")
         dstp.add_argument("name")
         dstp.set_defaults(func=self._describe_topology)
@@ -335,7 +358,8 @@ class Kueuectl:
         key = f"{ns.namespace}/{ns.name}"
         if key in self.store.local_queues:
             raise CliError(f"localqueue {key!r} already exists")
-        if ns.clusterqueue not in self.store.cluster_queues:
+        if (ns.clusterqueue not in self.store.cluster_queues
+                and not getattr(ns, "ignore_unknown_cq", False)):
             raise CliError(f"clusterqueue {ns.clusterqueue!r} not found")
         lq = LocalQueue(name=ns.name, namespace=ns.namespace,
                         cluster_queue=ns.clusterqueue)
@@ -453,9 +477,17 @@ class Kueuectl:
     # -- list ---------------------------------------------------------------
 
     def _list_cq(self, ns) -> str:
+        active_filter = getattr(ns, "active", None)
         rows = []
+        wide_cols = []
         for cq in sorted(self.store.cluster_queues.values(),
                          key=lambda c: c.name):
+            # active = admitting new workloads (list_clusterqueue.go:122:
+            # no Hold/HoldAndDrain stop policy)
+            is_active = cq.stop_policy == StopPolicy.NONE
+            if active_filter is not None and (
+                    is_active != (active_filter == "true")):
+                continue
             pending = admitted = 0
             for wl in self.store.workloads.values():
                 if self.store.cluster_queue_for(wl) != cq.name:
@@ -469,13 +501,12 @@ class Kueuectl:
             rows.append([cq.name, cq.cohort or "", cq.queueing_strategy,
                          str(pending), str(admitted),
                          cq.stop_policy])
-        wide_cols = [[
-            ",".join(fq.name for rg in cq.resource_groups
-                     for fq in rg.flavors),
-            cq.preemption.reclaim_within_cohort,
-            str(cq.fair_sharing.weight),
-        ] for cq in sorted(self.store.cluster_queues.values(),
-                           key=lambda c: c.name)]
+            wide_cols.append([
+                ",".join(fq.name for rg in cq.resource_groups
+                         for fq in rg.flavors),
+                cq.preemption.reclaim_within_cohort,
+                str(cq.fair_sharing.weight),
+            ])
         return _emit(
             ["NAME", "COHORT", "STRATEGY", "PENDING", "ADMITTED", "STOP"],
             rows, getattr(ns, "output", "table"),
@@ -484,10 +515,12 @@ class Kueuectl:
     def _list_lq(self, ns) -> str:
         namespace = (None if getattr(ns, "all_namespaces", False)
                      else ns.namespace)
+        cq_filter = getattr(ns, "clusterqueue", None)
         rows = [[lq.namespace, lq.name, lq.cluster_queue, lq.stop_policy]
                 for lq in sorted(self.store.local_queues.values(),
                                  key=lambda l: l.key)
-                if namespace is None or lq.namespace == namespace]
+                if (namespace is None or lq.namespace == namespace)
+                and (cq_filter is None or lq.cluster_queue == cq_filter)]
         return _emit(["NAMESPACE", "NAME", "CLUSTERQUEUE", "STOP"], rows,
                      getattr(ns, "output", "table"))
 
@@ -514,6 +547,16 @@ class Kueuectl:
             if not _match_fields(fields,
                                  getattr(ns, "field_selector", "")):
                 continue
+            statuses = getattr(ns, "status", None)
+            if statuses and "all" not in statuses:
+                # list_workload.go:129 status classes; QuotaReserved is
+                # a distinct phase from fully Admitted (two-phase checks)
+                cls = ("finished" if wl.is_finished
+                       else "admitted" if wl.is_admitted
+                       else "quotareserved" if wl.is_quota_reserved
+                       else "pending")
+                if cls not in statuses:
+                    continue
             rows.append([wl.namespace, wl.name, wl.queue_name,
                          str(wl.priority), status])
             adm = wl.status.admission
@@ -566,12 +609,72 @@ class Kueuectl:
         return "\n".join(lines)
 
     def _list_rf(self, ns) -> str:
+        flavors = sorted(self.store.resource_flavors.values(),
+                         key=lambda r: r.name)
         rows = [[rf.name,
                  ",".join(f"{k}={v}" for k, v in sorted(rf.node_labels.items())),
                  rf.topology_name or ""]
-                for rf in sorted(self.store.resource_flavors.values(),
-                                 key=lambda r: r.name)]
-        return _fmt_table(["NAME", "NODELABELS", "TOPOLOGY"], rows)
+                for rf in flavors]
+        def _tol(t) -> str:
+            op = getattr(t, "operator", "Equal")
+            body = t.key if op == "Exists" else f"{t.key}={t.value}"
+            return f"{body}:{t.effect}" if t.effect else body
+
+        wide_cols = [[
+            ",".join(f"{t.key}={t.value}:{t.effect}"
+                     for t in rf.node_taints),
+            ",".join(_tol(t) for t in rf.tolerations),
+        ] for rf in flavors]
+        return _emit(["NAME", "NODELABELS", "TOPOLOGY"], rows,
+                     getattr(ns, "output", "table"),
+                     wide=(["TAINTS", "TOLERATIONS"], wide_cols))
+
+    def _describe_lq(self, ns) -> str:
+        key = f"{ns.namespace}/{ns.name}"
+        lq = self.store.local_queues.get(key)
+        if lq is None:
+            raise CliError(f"localqueue {key!r} not found")
+        # one source of truth: the LocalQueue controller's status
+        # (counts, Active condition, exposed flavors) — exactly what the
+        # reference's describe prints from .status
+        from kueue_oss_tpu.controllers.core_controllers import (
+            LocalQueueReconciler,
+        )
+
+        st = LocalQueueReconciler(self.store).reconcile(key)
+        lines = [f"Name: {lq.name}", f"Namespace: {lq.namespace}",
+                 f"ClusterQueue: {lq.cluster_queue}",
+                 f"StopPolicy: {lq.stop_policy}",
+                 f"Active: {st.active} ({st.reason})",
+                 f"Pending Workloads: {st.pending_workloads}",
+                 f"Reserving Workloads: {st.reserving_workloads}",
+                 f"Admitted Workloads: {st.admitted_workloads}"]
+        if st.flavors:
+            lines.append(f"Flavors: {', '.join(st.flavors)}")
+        return "\n".join(lines)
+
+    def _describe_rf(self, ns) -> str:
+        rf = self.store.resource_flavors.get(ns.name)
+        if rf is None:
+            raise CliError(f"resourceflavor {ns.name!r} not found")
+        lines = [f"Name: {rf.name}"]
+        if rf.node_labels:
+            lines.append("Node Labels:")
+            lines.extend(f"  {k}: {v}"
+                         for k, v in sorted(rf.node_labels.items()))
+        if rf.node_taints:
+            lines.append("Node Taints:")
+            lines.extend(f"  {t.key}={t.value}:{t.effect}"
+                         for t in rf.node_taints)
+        if rf.topology_name:
+            lines.append(f"Topology: {rf.topology_name}")
+        used_by = sorted(
+            cq.name for cq in self.store.cluster_queues.values()
+            if any(fq.name == rf.name for rg in cq.resource_groups
+                   for fq in rg.flavors))
+        if used_by:
+            lines.append(f"Used By ClusterQueues: {', '.join(used_by)}")
+        return "\n".join(lines)
 
     def _list_cohorts(self, ns) -> str:
         """Cohort forest with member counts (kueuectl list cohort)."""

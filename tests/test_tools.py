@@ -503,3 +503,50 @@ def test_dashboard_detail_views_and_sse():
         conn.close()
     finally:
         srv.stop()
+
+
+def test_cli_round5_option_breadth():
+    """--status / --active / -c filters, describe localqueue and
+    resourceflavor, -i ignore-unknown-cq (round-5 verb options)."""
+    store, queues, sched = make_env()
+    ctl = Kueuectl(store, queues=queues)
+    for i, lq in enumerate(("lq-a", "lq-b")):
+        store.add_workload(Workload(
+            name=f"w{i}", queue_name=lq,
+            podsets=[PodSet(name="main", count=1, requests={"cpu": 100})]))
+    sched.run_until_quiet(now=0.0)
+    # everything fits: admitted filter sees both, pending sees none
+    admitted = ctl.run(["list", "workload", "-A", "--status", "admitted"])
+    assert "w0" in admitted and "w1" in admitted
+    pending = ctl.run(["list", "workload", "-A", "--status", "pending"])
+    assert "w0" not in pending and "w1" not in pending
+    both = ctl.run(["list", "workload", "-A", "--status", "pending",
+                    "--status", "admitted"])
+    assert "w0" in both
+
+    # localqueue filter by cluster queue
+    out = ctl.run(["list", "localqueue", "-A", "-c", "cq"])
+    assert "lq-a" in out
+    assert "lq-a" not in ctl.run(["list", "localqueue", "-A", "-c", "no"])
+
+    # active filter: a stopped CQ is inactive
+    ctl.run(["stop", "clusterqueue", "cq"])
+    assert "cq" not in ctl.run(["list", "clusterqueue", "--active", "true"])
+    assert "cq" in ctl.run(["list", "clusterqueue", "--active", "false"])
+    ctl.run(["resume", "clusterqueue", "cq"])
+
+    # describe localqueue / resourceflavor
+    desc = ctl.run(["describe", "localqueue", "lq-a"])
+    assert "ClusterQueue: cq" in desc and "Admitted Workloads: 1" in desc
+    rf = ctl.run(["describe", "resourceflavor", "default"])
+    assert "Used By ClusterQueues: cq" in rf
+
+    # ignore-unknown-cq creates a dangling LocalQueue without error
+    out = ctl.run(["create", "localqueue", "lq-x", "-c", "ghost", "-i"])
+    assert "created" in out
+    with pytest.raises(CliError):
+        ctl.run(["create", "localqueue", "lq-y", "-c", "ghost"])
+
+    # resourceflavor list output modes include wide
+    wide = ctl.run(["list", "resourceflavor", "-o", "wide"])
+    assert "TAINTS" in wide
