@@ -127,6 +127,12 @@ class AdmissionChecksStrategy:
 # ---------------------------------------------------------------------------
 
 
+def format_taint(t) -> str:
+    """Canonical `key=value:Effect` rendering shared by kueuectl and
+    the dashboard."""
+    return f"{t.key}={t.value}:{t.effect}"
+
+
 @dataclass
 class ResourceFlavor:
     """Reference parity: resourceflavor_types.go."""

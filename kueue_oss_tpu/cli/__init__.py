@@ -478,16 +478,24 @@ class Kueuectl:
 
     def _list_cq(self, ns) -> str:
         active_filter = getattr(ns, "active", None)
+        cq_rec = None
+        if active_filter is not None:
+            # the controller's Active condition is the source of truth
+            # (stop policy, missing flavors/checks, cohort cycles —
+            # cq_controller.py), not a narrower inline predicate
+            from kueue_oss_tpu.controllers.cq_controller import (
+                ClusterQueueReconciler,
+            )
+
+            cq_rec = ClusterQueueReconciler(self.store)
         rows = []
         wide_cols = []
         for cq in sorted(self.store.cluster_queues.values(),
                          key=lambda c: c.name):
-            # active = admitting new workloads (list_clusterqueue.go:122:
-            # no Hold/HoldAndDrain stop policy)
-            is_active = cq.stop_policy == StopPolicy.NONE
-            if active_filter is not None and (
-                    is_active != (active_filter == "true")):
-                continue
+            if cq_rec is not None:
+                is_active = cq_rec.reconcile(cq.name).active
+                if is_active != (active_filter == "true"):
+                    continue
             pending = admitted = 0
             for wl in self.store.workloads.values():
                 if self.store.cluster_queue_for(wl) != cq.name:
@@ -529,6 +537,9 @@ class Kueuectl:
 
         namespace = (None if getattr(ns, "all_namespaces", False)
                      else ns.namespace)
+        statuses = getattr(ns, "status", None)
+        if statuses and "all" in statuses:
+            statuses = None
         rows = []
         wide_cols = []
         for wl in sorted(self.store.workloads.values(), key=lambda w: w.key):
@@ -547,8 +558,7 @@ class Kueuectl:
             if not _match_fields(fields,
                                  getattr(ns, "field_selector", "")):
                 continue
-            statuses = getattr(ns, "status", None)
-            if statuses and "all" not in statuses:
+            if statuses:
                 # list_workload.go:129 status classes; QuotaReserved is
                 # a distinct phase from fully Admitted (two-phase checks)
                 cls = ("finished" if wl.is_finished
@@ -615,14 +625,15 @@ class Kueuectl:
                  ",".join(f"{k}={v}" for k, v in sorted(rf.node_labels.items())),
                  rf.topology_name or ""]
                 for rf in flavors]
+        from kueue_oss_tpu.api.types import format_taint
+
         def _tol(t) -> str:
             op = getattr(t, "operator", "Equal")
             body = t.key if op == "Exists" else f"{t.key}={t.value}"
             return f"{body}:{t.effect}" if t.effect else body
 
         wide_cols = [[
-            ",".join(f"{t.key}={t.value}:{t.effect}"
-                     for t in rf.node_taints),
+            ",".join(format_taint(t) for t in rf.node_taints),
             ",".join(_tol(t) for t in rf.tolerations),
         ] for rf in flavors]
         return _emit(["NAME", "NODELABELS", "TOPOLOGY"], rows,
@@ -641,7 +652,14 @@ class Kueuectl:
             LocalQueueReconciler,
         )
 
-        st = LocalQueueReconciler(self.store).reconcile(key)
+        from kueue_oss_tpu.controllers.cq_controller import (
+            ClusterQueueReconciler,
+        )
+
+        st = LocalQueueReconciler(
+            self.store,
+            cq_reconciler=ClusterQueueReconciler(self.store),
+        ).reconcile(key)
         lines = [f"Name: {lq.name}", f"Namespace: {lq.namespace}",
                  f"ClusterQueue: {lq.cluster_queue}",
                  f"StopPolicy: {lq.stop_policy}",
@@ -663,15 +681,13 @@ class Kueuectl:
             lines.extend(f"  {k}: {v}"
                          for k, v in sorted(rf.node_labels.items()))
         if rf.node_taints:
+            from kueue_oss_tpu.api.types import format_taint
+
             lines.append("Node Taints:")
-            lines.extend(f"  {t.key}={t.value}:{t.effect}"
-                         for t in rf.node_taints)
+            lines.extend(f"  {format_taint(t)}" for t in rf.node_taints)
         if rf.topology_name:
             lines.append(f"Topology: {rf.topology_name}")
-        used_by = sorted(
-            cq.name for cq in self.store.cluster_queues.values()
-            if any(fq.name == rf.name for rg in cq.resource_groups
-                   for fq in rg.flavors))
+        used_by = self.store.cluster_queues_using_flavor(rf.name)
         if used_by:
             lines.append(f"Used By ClusterQueues: {', '.join(used_by)}")
         return "\n".join(lines)

@@ -259,6 +259,14 @@ class Store:
 
     # -- readers -----------------------------------------------------------
 
+    def cluster_queues_using_flavor(self, flavor_name: str) -> list[str]:
+        """Sorted ClusterQueues whose resource groups reference the
+        flavor (shared by kueuectl describe/list and the dashboard)."""
+        return sorted(
+            cq.name for cq in self.cluster_queues.values()
+            if any(fq.name == flavor_name for rg in cq.resource_groups
+                   for fq in rg.flavors))
+
     def cluster_queue_for(self, wl: Workload) -> Optional[str]:
         lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
         return lq.cluster_queue if lq is not None else None

@@ -109,11 +109,95 @@ class Dashboard:
             })
         return out
 
+    def local_queues_view(self) -> list[dict]:
+        from kueue_oss_tpu.controllers.core_controllers import (
+            LocalQueueReconciler,
+        )
+
+        counts = LocalQueueReconciler(self.store)._counts_by_lq()
+        out = []
+        for lq in sorted(self.store.local_queues.values(),
+                         key=lambda l: l.key):
+            pending, reserving, admitted = counts.get(
+                (lq.namespace, lq.name), (0, 0, 0))
+            out.append({"namespace": lq.namespace, "name": lq.name,
+                        "clusterQueue": lq.cluster_queue,
+                        "stopPolicy": lq.stop_policy,
+                        "pending": pending, "reserving": reserving,
+                        "admitted": admitted})
+        return out
+
+    def resource_flavors_view(self) -> list[dict]:
+        from kueue_oss_tpu.api.types import format_taint
+
+        out = []
+        for rf in sorted(self.store.resource_flavors.values(),
+                         key=lambda r: r.name):
+            out.append({
+                "name": rf.name,
+                "nodeLabels": dict(rf.node_labels),
+                "taints": [format_taint(t) for t in rf.node_taints],
+                "topology": rf.topology_name,
+                "usedBy": self.store.cluster_queues_using_flavor(rf.name),
+            })
+        return out
+
+    def topologies_view(self) -> list[dict]:
+        # distinct label-prefix tuples per level in ONE node pass — the
+        # SSE loop serializes overview() per store change per client, so
+        # this must not build Domain trees (snapshot construction is
+        # O(topologies x nodes x levels) with allocation-heavy rollups)
+        out = []
+        nodes = list(self.store.nodes.values())
+        for t in sorted(self.store.topologies.values(),
+                        key=lambda t: t.name):
+            per_level: list[set] = [set() for _ in t.levels]
+            for n in nodes:
+                values = []
+                for li, key in enumerate(t.levels):
+                    v = (n.name if key == "kubernetes.io/hostname"
+                         else n.labels.get(key))
+                    if v is None:
+                        break
+                    values.append(v)
+                    per_level[li].add(tuple(values))
+            out.append({
+                "name": t.name,
+                "levels": list(t.levels),
+                "domainsPerLevel": [len(s) for s in per_level],
+                "flavors": sorted(
+                    rf.name for rf in self.store.resource_flavors.values()
+                    if rf.topology_name == t.name),
+            })
+        return out
+
+    def admission_checks_view(self) -> list[dict]:
+        # workloads currently gated per check (AdmissionChecks.jsx)
+        waiting: dict[str, int] = {}
+        for wl in self.store.workloads.values():
+            for name, st in wl.status.admission_checks.items():
+                if st.state in ("Pending", "Retry"):
+                    waiting[name] = waiting.get(name, 0) + 1
+        out = []
+        for ac in sorted(self.store.admission_checks.values(),
+                         key=lambda a: a.name):
+            out.append({
+                "name": ac.name,
+                "controller": ac.controller_name,
+                "active": ac.status.active,
+                "waitingWorkloads": waiting.get(ac.name, 0),
+            })
+        return out
+
     def overview(self) -> dict:
         return {
             "clusterQueues": self.cluster_queues_view(),
             "cohorts": self.cohorts_view(),
             "workloads": self.workloads_view(),
+            "localQueues": self.local_queues_view(),
+            "resourceFlavors": self.resource_flavors_view(),
+            "topologies": self.topologies_view(),
+            "admissionChecks": self.admission_checks_view(),
         }
 
     # -- per-resource detail views (WorkloadDetail.jsx et al) ---------------
@@ -305,6 +389,10 @@ class DashboardServer:
                     "/api/clusterqueues": dash.cluster_queues_view,
                     "/api/cohorts": dash.cohorts_view,
                     "/api/workloads": dash.workloads_view,
+                    "/api/localqueues": dash.local_queues_view,
+                    "/api/resourceflavors": dash.resource_flavors_view,
+                    "/api/topologies": dash.topologies_view,
+                    "/api/admissionchecks": dash.admission_checks_view,
                     "/api/overview": dash.overview,
                 }
                 fn = routes.get(path)

@@ -58,10 +58,27 @@ INDEX_HTML = """<!doctype html>
 <table id="cqs"><thead><tr>
   <th>Name</th><th>Cohort</th><th>Pending</th><th>Inadmissible</th>
   <th>Reserving</th><th>Usage</th></tr></thead><tbody></tbody></table>
+<h2>LocalQueues</h2>
+<table id="lqs"><thead><tr>
+  <th>Namespace</th><th>Name</th><th>ClusterQueue</th><th>Pending</th>
+  <th>Reserving</th><th>Admitted</th><th>Stop</th></tr></thead>
+  <tbody></tbody></table>
 <h2>Workloads</h2>
 <table id="wls"><thead><tr>
   <th>Namespace</th><th>Name</th><th>LocalQueue</th><th>Priority</th>
   <th>Status</th><th>ClusterQueue</th></tr></thead><tbody></tbody></table>
+<h2>ResourceFlavors</h2>
+<table id="rfs"><thead><tr>
+  <th>Name</th><th>Node labels</th><th>Taints</th><th>Topology</th>
+  <th>Used by</th></tr></thead><tbody></tbody></table>
+<h2>Topologies</h2>
+<table id="tps"><thead><tr>
+  <th>Name</th><th>Levels</th><th>Domains per level</th><th>Flavors</th>
+  </tr></thead><tbody></tbody></table>
+<h2>AdmissionChecks</h2>
+<table id="acs"><thead><tr>
+  <th>Name</th><th>Controller</th><th>Active</th><th>Waiting workloads</th>
+  </tr></thead><tbody></tbody></table>
 </div>
 <footer>live over SSE (/api/stream), 2s polling fallback ·
 JSON at /api/overview</footer>
@@ -137,6 +154,20 @@ async function refresh() {
         w.localQueue, w.priority,
         `<span class="pill">${w.status}</span>`,
         w.clusterQueue || "—"]));
+    fill("lqs", (o.localQueues || []).map(q => [
+        q.namespace, q.name,
+        `<a href="#/cq/${q.clusterQueue}">${q.clusterQueue}</a>`,
+        q.pending, q.reserving, q.admitted, q.stopPolicy]));
+    fill("rfs", (o.resourceFlavors || []).map(f => [
+        f.name, fmt(f.nodeLabels), (f.taints || []).join(", ") || "—",
+        f.topology || "—", (f.usedBy || []).join(", ") || "—"]));
+    fill("tps", (o.topologies || []).map(t => [
+        t.name, (t.levels || []).join(" › "),
+        (t.domainsPerLevel || []).join("/"),
+        (t.flavors || []).join(", ") || "—"]));
+    fill("acs", (o.admissionChecks || []).map(a => [
+        a.name, a.controller || "—", a.active ? "yes" : "no",
+        a.waitingWorkloads]));
   } catch (e) { /* server restarting; retry on next tick */ }
 }
 const obj = (o) => `<table><tbody>` + Object.entries(o || {}).map(

@@ -550,3 +550,51 @@ def test_cli_round5_option_breadth():
     # resourceflavor list output modes include wide
     wide = ctl.run(["list", "resourceflavor", "-o", "wide"])
     assert "TAINTS" in wide
+
+
+def test_viz_round5_resource_views():
+    """LocalQueue / ResourceFlavor / Topology / AdmissionCheck API views
+    (kueueviz per-resource pages analog)."""
+    import urllib.request
+
+    from kueue_oss_tpu.api.types import AdmissionCheck, Node, Topology
+    from kueue_oss_tpu.viz import Dashboard, DashboardServer
+
+    store, queues, sched = make_env()
+    store.upsert_topology(Topology(name="tp", levels=["rack", "host"]))
+    store.upsert_node(Node(name="n1", labels={"rack": "r1", "host": "n1"},
+                           allocatable={"cpu": 8}))
+    store.upsert_admission_check(AdmissionCheck(
+        name="prov", controller_name="kueue.x-k8s.io/provisioning-request"))
+    store.add_workload(Workload(
+        name="w", queue_name="lq-a",
+        podsets=[PodSet(name="main", count=1, requests={"cpu": 1})]))
+    sched.run_until_quiet(now=0.0)
+    dash = Dashboard(store, queues)
+    lqs = {q["name"]: q for q in dash.local_queues_view()}
+    assert lqs["lq-a"]["admitted"] == 1
+    assert lqs["lq-a"]["clusterQueue"] == "cq"
+    rfs = dash.resource_flavors_view()
+    assert rfs[0]["name"] == "default" and rfs[0]["usedBy"] == ["cq"]
+    tps = dash.topologies_view()
+    assert tps[0]["levels"] == ["rack", "host"]
+    assert tps[0]["domainsPerLevel"] == [1, 1]
+    acs = dash.admission_checks_view()
+    assert acs[0]["name"] == "prov" and acs[0]["active"]
+
+    srv = DashboardServer(dash, port=0)
+    srv.start()
+    try:
+        for path in ("localqueues", "resourceflavors", "topologies",
+                     "admissionchecks"):
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/api/{path}").read()
+            assert body.startswith(b"[")
+        overview = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/api/overview").read())
+        assert "resourceFlavors" in overview
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/").read().decode()
+        assert "AdmissionChecks" in html and "Topologies" in html
+    finally:
+        srv.stop()
