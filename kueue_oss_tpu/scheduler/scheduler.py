@@ -134,6 +134,11 @@ class Scheduler:
         #: any productive drain resets the multiplier
         self._solver_arrival_mult = 1
         self._solver_drain_trigger = None
+        #: adaptive routing cost estimates (EMAs): drain wall PER
+        #: EXPORTED WORKLOAD (drain cost scales with backlog) and the
+        #: host cycle's per-admission cost; None until measured
+        self._drain_cost_ema: Optional[float] = None
+        self._host_s_per_adm: Optional[float] = None
         #: Preemption/generic evictions requeue immediately (ordered by
         #: eviction time, reference workload.Ordering). Only controller
         #: evictions that pass an explicit backoff_base_s (PodsReady
@@ -166,6 +171,7 @@ class Scheduler:
 
     def schedule(self, now: Optional[float] = None) -> CycleStats:
         start = self.clock()
+        wall0 = time.monotonic()
         now = now if now is not None else start
         self.cycle_count += 1
         stats = CycleStats(cycle=self.cycle_count)
@@ -204,6 +210,14 @@ class Scheduler:
             self._requeue_and_update(e)
 
         stats.duration_s = self.clock() - start
+        if stats.admitted:
+            # the adaptive solver gate compares against the drain's
+            # time.monotonic wall — measure in the same time domain
+            # (self.clock may be injected/simulated)
+            per_adm = (time.monotonic() - wall0) / stats.admitted
+            self._host_s_per_adm = (
+                per_adm if self._host_s_per_adm is None
+                else 0.7 * self._host_s_per_adm + 0.3 * per_adm)
         self.log.info("cycle finished", v=2, cycle=stats.cycle,
                       heads=stats.heads, admitted=stats.admitted,
                       preempted=stats.preempted,
@@ -349,29 +363,63 @@ class Scheduler:
                 self._solver_drained_once = False
                 return False
             if self._solver_drained_once and self.solver_reengage_fraction:
-                # benefit gate: a re-drain re-walks the parked backlog
-                # (rounds scale with its per-CQ depth), so it must be
-                # able to admit a flood-sized batch — enough
-                # capacity-freeing events (finishes/evictions) OR fresh
-                # arrivals since the last drain. Otherwise the trickle
-                # stays on host cycles.
-                need = max(self.solver_min_backlog,
-                           int(self.solver_reengage_fraction * backlog))
+                # benefit gate: a re-drain re-walks the parked backlog,
+                # so it must beat the host cycles it would replace. Once
+                # both cost estimates exist the gate is ADAPTIVE — the
+                # measured drain wall vs the host's per-admission cost
+                # times the batch plausibly admittable now — so the same
+                # default routes churn to the host on a slow backend
+                # (1-core XLA:CPU: drains cost seconds) and to the
+                # device on a fast one (local TPU: drains cost
+                # milliseconds). Until estimates exist, fall back to the
+                # flood-sized-batch rule.
                 arrivals = (self.queues.new_pending_total
                             - self._solver_arrivals_mark)
-                freed_ok = self._solver_freed_since_drain >= need
-                arrivals_ok = arrivals >= need * self._solver_arrival_mult
-                if not (freed_ok or arrivals_ok):
+                batch = min(self._solver_freed_since_drain + arrivals,
+                            backlog)
+                if (self._drain_cost_ema is not None
+                        and self._host_s_per_adm is not None):
+                    # drain wall scales ~linearly with the exported
+                    # backlog (per-round vmaps are O(W)), so predict
+                    # from the per-workload EMA at the CURRENT size —
+                    # a flat EMA lags badly while a flood ramps up.
+                    # Purely arrival-driven attempts also pay the
+                    # unproductive-drain backoff multiplier (a blocked
+                    # head plus an arrival trickle must not re-drain at
+                    # a fixed threshold forever).
+                    predicted = self._drain_cost_ema * backlog
+                    if self._solver_freed_since_drain == 0:
+                        predicted *= self._solver_arrival_mult
+                    ok = batch * self._host_s_per_adm >= predicted
+                else:
+                    need = max(self.solver_min_backlog,
+                               int(self.solver_reengage_fraction
+                                   * backlog))
+                    ok = (self._solver_freed_since_drain >= need
+                          or arrivals >= need * self._solver_arrival_mult)
+                if not ok:
                     if self.queues.lazy_flush:
                         self.queues.set_lazy_flush(False)
                     return False
+                # a drain any freed capacity helped justify is "freed";
+                # only zero-freed attempts count against the arrivals
+                # backoff when they turn out unproductive
                 self._solver_drain_trigger = (
-                    "freed" if freed_ok else "arrivals")
+                    "freed" if self._solver_freed_since_drain > 0
+                    else "arrivals")
             if not self.queues.lazy_flush:
                 self.queues.set_lazy_flush(True)
         try:
+            backlog_now = max(1, self.queues.solver_backlog_count())
+            t0 = time.monotonic()
             result = engine.drain(now=now if now is not None else 0.0,
                                   verify=True)
+            per_wl = (time.monotonic() - t0) / backlog_now
+            if self._drain_cost_ema is None:
+                self._drain_cost_ema = per_wl
+            else:
+                self._drain_cost_ema = (0.7 * self._drain_cost_ema
+                                        + 0.3 * per_wl)
         except UnsupportedProblem:
             self.queues.materialize_stale_all()
             self._solver_drain_trigger = None

@@ -88,6 +88,10 @@ def test_trickle_churn_stays_on_host(sched):
     store, queues, s, counter = sched
     _flood(store, 64)
     s.run_until_quiet(now=0.0)
+    # pin the gate to the fallback threshold rule (the adaptive
+    # path is timing-dependent and has its own test)
+    s._drain_cost_ema = None
+    s._host_s_per_adm = None
     flood_calls = counter.calls
     # a handful of finishes free a few seats: backlog is still >= 16,
     # but the freed batch is far below the re-engage threshold
@@ -107,6 +111,10 @@ def test_mass_free_reengages_solver(sched):
     store, queues, s, counter = sched
     _flood(store, 64)
     s.run_until_quiet(now=0.0)
+    # pin the gate to the fallback threshold rule (the adaptive
+    # path is timing-dependent and has its own test)
+    s._drain_cost_ema = None
+    s._host_s_per_adm = None
     flood_calls = counter.calls
     # finish EVERY admitted workload: freed >= solver_min_backlog
     admitted = [k for k, w in store.workloads.items()
@@ -151,6 +159,10 @@ def test_zero_fraction_restores_always_drain():
     engine.drain = counter
     _flood(store, 64)
     s.run_until_quiet(now=0.0)
+    # pin the gate to the fallback threshold rule (the adaptive
+    # path is timing-dependent and has its own test)
+    s._drain_cost_ema = None
+    s._host_s_per_adm = None
     calls = counter.calls
     admitted = [k for k, w in store.workloads.items()
                 if w.is_quota_reserved]
@@ -158,3 +170,33 @@ def test_zero_fraction_restores_always_drain():
         s.finish_workload(k, now=1.0)
     s.run_until_quiet(now=1.0)
     assert counter.calls > calls  # pre-round-5 behavior: every pass
+
+
+def test_adaptive_gate_routes_by_measured_costs(sched):
+    """With cost estimates present, the gate compares the admittable
+    batch's host cost against the drain wall: a slow device skips, a
+    fast device engages — same default, hardware-appropriate routing."""
+    store, queues, s, counter = sched
+    _flood(store, 64)
+    s.run_until_quiet(now=0.0)
+    flood_calls = counter.calls
+    admitted = [k for k, w in store.workloads.items()
+                if w.is_quota_reserved]
+    # slow device (per-workload drain cost ~31ms => ~1s at this
+    # backlog) vs cheap host admissions: stay on host
+    s._drain_cost_ema = 1.0 / 32
+    s._host_s_per_adm = 0.000001
+    for k in admitted[:8]:
+        s.finish_workload(k, now=1.0)
+    s.run_until_quiet(now=1.0)
+    assert counter.calls == flood_calls
+    # fast device (sub-ms drains): the same batch size engages it
+    # (re-pin both EMAs: the slow phase blended real timings in)
+    s._drain_cost_ema = 0.0000001
+    s._host_s_per_adm = 0.01
+    admitted = [k for k, w in store.workloads.items()
+                if w.is_quota_reserved and not w.is_finished]
+    for k in admitted[:8]:
+        s.finish_workload(k, now=2.0)
+    s.run_until_quiet(now=2.0)
+    assert counter.calls > flood_calls
