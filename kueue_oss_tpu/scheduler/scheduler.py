@@ -377,36 +377,44 @@ class Scheduler:
                             - self._solver_arrivals_mark)
                 batch = min(self._solver_freed_since_drain + arrivals,
                             backlog)
+                freed = self._solver_freed_since_drain
                 if (self._drain_cost_ema is not None
                         and self._host_s_per_adm is not None):
                     # drain wall scales ~linearly with the exported
                     # backlog (per-round vmaps are O(W)), so predict
                     # from the per-workload EMA at the CURRENT size —
                     # a flat EMA lags badly while a flood ramps up.
-                    # Purely arrival-driven attempts also pay the
-                    # unproductive-drain backoff multiplier (a blocked
-                    # head plus an arrival trickle must not re-drain at
-                    # a fixed threshold forever).
+                    # Arrival-assisted attempts pay the unproductive-
+                    # drain backoff multiplier (a blocked head plus an
+                    # arrival trickle must not re-drain at a fixed
+                    # threshold forever); freed capacity alone never
+                    # does.
                     predicted = self._drain_cost_ema * backlog
-                    if self._solver_freed_since_drain == 0:
-                        predicted *= self._solver_arrival_mult
-                    ok = batch * self._host_s_per_adm >= predicted
+                    freed_ok = freed * self._host_s_per_adm >= predicted
+                    arrivals_ok = (batch * self._host_s_per_adm
+                                   >= predicted
+                                   * self._solver_arrival_mult)
                 else:
                     need = max(self.solver_min_backlog,
                                int(self.solver_reengage_fraction
                                    * backlog))
-                    ok = (self._solver_freed_since_drain >= need
-                          or arrivals >= need * self._solver_arrival_mult)
-                if not ok:
+                    freed_ok = freed >= need
+                    arrivals_ok = (arrivals
+                                   >= need * self._solver_arrival_mult)
+                if not (freed_ok or arrivals_ok):
+                    # an over-estimated drain cost must not latch the
+                    # gate shut (the EMA only resamples when a drain
+                    # RUNS — e.g. a first-drain XLA compile or a GC
+                    # pause inflates it): decay it slightly per skipped
+                    # evaluation so outliers erode and a probe drain
+                    # eventually re-measures
+                    if self._drain_cost_ema is not None:
+                        self._drain_cost_ema *= 0.99
                     if self.queues.lazy_flush:
                         self.queues.set_lazy_flush(False)
                     return False
-                # a drain any freed capacity helped justify is "freed";
-                # only zero-freed attempts count against the arrivals
-                # backoff when they turn out unproductive
                 self._solver_drain_trigger = (
-                    "freed" if self._solver_freed_since_drain > 0
-                    else "arrivals")
+                    "freed" if freed_ok else "arrivals")
             if not self.queues.lazy_flush:
                 self.queues.set_lazy_flush(True)
         try:

@@ -105,6 +105,13 @@ class SolverEngine:
         #: this cap. Narrow lanes lower per-round latency, wide lanes
         #: cut round counts ~10x on park-heavy shapes (see _size_caps).
         self.h_max_cap = 1024
+        #: per-round search-work budget in lane-option-group units
+        #: (each lane runs K x g victim searches): on an accelerator
+        #: the lanes vectorize so the budget is generous; on the CPU
+        #: fallback they serialize, so multi-flavor/multi-group shapes
+        #: trade lanes for rounds at roughly constant work. None =
+        #: choose by backend at first drain.
+        self.h_work_budget = None
 
     def supported(self) -> bool:
         """Whether the drain can run on-device.
@@ -408,7 +415,21 @@ class SolverEngine:
         powers of two to reuse compiled kernels.
         """
         C = problem.n_cqs
-        h_max = max(1, _pow2(min(C, self.h_max_cap)))
+        if self.h_work_budget is None:
+            import jax
+
+            self.h_work_budget = (8192 if jax.default_backend() != "cpu"
+                                  else 512)
+        K = problem.wl_req.shape[1] if problem.wl_req.ndim == 3 else 1
+        g = max(1, int(problem.cq_ngroups.max()) if C else 1)
+        # round the budgeted lane count DOWN to a power of two so the
+        # budget is actually enforced; the 64-lane floor overrides it
+        # for very wide K x g shapes (fewer lanes than that defers too
+        # many heads per round to ever converge quickly)
+        lane_cap = _pow2(max(
+            1, self.h_work_budget // max(K * g, 1)) + 1) // 2
+        lane_cap = max(64, lane_cap)
+        h_max = max(1, _pow2(min(C, self.h_max_cap, lane_cap)))
         root_of_cq = problem.cq_root
         wl_root = root_of_cq[np.minimum(problem.wl_cqid[:-1], C - 1)]
         counts = np.bincount(wl_root, minlength=problem.n_nodes + 1)
