@@ -900,16 +900,17 @@ def main() -> None:
                  "before remote execution completes — the earlier "
                  "'1.69ms drain' was shorter than one tunnel RTT and is "
                  "disavowed; tunnel_rtt_ms reports the transport floor). "
-                 "Production drains run wide victim-search lanes "
-                 "(h=min(C,1024)): the 50k x 1k drain fell from 49 "
-                 "park-throttled rounds to 5 and host-cycle parity "
+                 "Production drains size victim-search lanes from a "
+                 "per-round work budget (lanes x options x groups; "
+                 "backend-aware): the 50k x 1k drain fell from 49 "
+                 "park-throttled rounds to 8 and host-cycle parity "
                  "improved (the host defers no heads). solver=auto "
-                 "routes by benefit — floods and mass capacity-freeing "
-                 "events drain on the device, trickle churn stays on "
-                 "the O(heads) host loop — so the solver-backed "
-                 "reference protocols converge toward the host numbers "
-                 "on the 1-core XLA:CPU fallback instead of losing 2-3x; "
-                 "the single-core CPU backend cannot show the kernel's "
+                 "routes adaptively by measured cost EMAs — drains "
+                 "engage where their predicted wall beats the host "
+                 "cycles they replace — so the solver-backed reference "
+                 "protocols converge toward the host numbers on the "
+                 "1-core XLA:CPU fallback instead of losing 2-3x; the "
+                 "single-core CPU backend cannot show the kernel's "
                  "data-parallel advantage, which is the TPU thesis"),
     }), flush=True)
 
