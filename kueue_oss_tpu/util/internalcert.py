@@ -1,0 +1,134 @@
+"""Internal certificate bootstrap + rotation.
+
+Reference parity: pkg/util/cert (internal cert bootstrap the manager
+uses when cert-manager isn't installed; config/components/internalcert)
+— a self-signed serving certificate is generated on first start and
+rotated before expiry, so the TLS-enabled HTTP servers (visibility,
+dashboard, webhook) can serve without external PKI. Pairs with
+util/tlsconfig: `ensure_cert` returns (cert_file, key_file) ready for
+TLSOptions.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from pathlib import Path
+from typing import Optional
+
+CERT_NAME = "tls.crt"
+KEY_NAME = "tls.key"
+
+
+def _pair_valid_until(cert_path: Path,
+                      key_path: Path) -> Optional[datetime.datetime]:
+    """Expiry of a HEALTHY pair: the cert parses, the key parses, and
+    the key matches the cert's public key (a crash mid-rotation or a
+    corrupt file must regenerate, not serve a broken chain forever)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import serialization
+
+    try:
+        cert = x509.load_pem_x509_certificate(cert_path.read_bytes())
+        key = serialization.load_pem_private_key(
+            key_path.read_bytes(), password=None)
+    except (ValueError, TypeError, OSError):
+        return None
+    if (key.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo)
+            != cert.public_key().public_bytes(
+                serialization.Encoding.DER,
+                serialization.PublicFormat.SubjectPublicKeyInfo)):
+        return None
+    return cert.not_valid_after_utc
+
+
+def _write_private(path: Path, data: bytes) -> None:
+    """0600 atomic write (the key must never be world-readable)."""
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+
+
+def _write_atomic(path: Path, data: bytes) -> None:
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, path)
+
+
+def ensure_cert(directory: str | Path,
+                common_name: str = "kueue-tpu-controller",
+                dns_names: tuple[str, ...] = ("localhost",),
+                validity_days: int = 365,
+                rotate_before_days: int = 30,
+                now: Optional[datetime.datetime] = None,
+                ) -> tuple[str, str]:
+    """Return (cert_file, key_file), generating or ROTATING the
+    self-signed pair when absent, unparsable, or within
+    `rotate_before_days` of expiry (cert.go rotation contract)."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    cert_path = directory / CERT_NAME
+    key_path = directory / KEY_NAME
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+
+    if cert_path.exists() and key_path.exists():
+        not_after = _pair_valid_until(cert_path, key_path)
+        if (not_after is not None
+                and not_after - now
+                > datetime.timedelta(days=rotate_before_days)):
+            return str(cert_path), str(key_path)
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    subject = x509.Name([
+        x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=validity_days))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.DNSName(n) for n in dns_names]),
+            critical=False)
+        # a SERVING leaf, not a CA (pkg/util/cert parity): clients
+        # trusting it must not implicitly trust a signer
+        .add_extension(
+            x509.BasicConstraints(ca=False, path_length=None),
+            critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True, key_encipherment=True,
+                content_commitment=False, data_encipherment=False,
+                key_agreement=False, key_cert_sign=False,
+                crl_sign=False, encipher_only=False,
+                decipher_only=False),
+            critical=True)
+        .add_extension(
+            x509.ExtendedKeyUsage([ExtendedKeyUsageOID.SERVER_AUTH]),
+            critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    # key first, cert last, both atomic: a crash between the renames
+    # leaves new-key + old-cert, which the health check above detects
+    # as a mismatch and regenerates on the next start
+    _write_private(key_path, key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    _write_atomic(cert_path, cert.public_bytes(
+        serialization.Encoding.PEM))
+    return str(cert_path), str(key_path)

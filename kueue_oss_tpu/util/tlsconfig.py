@@ -139,12 +139,16 @@ def parse_tls_options(cfg: Optional[TLSOptions]) -> Optional[TLS]:
                cert_file=cfg.cert_file, key_file=cfg.key_file)
 
 
-def build_ssl_context(tls: Optional[TLS]) -> Optional[ssl.SSLContext]:
+def build_ssl_context(tls: Optional[TLS],
+                      bootstrap_dir: Optional[str] = None,
+                      ) -> Optional[ssl.SSLContext]:
     """BuildTLSOptions analog: None when the gate is off or no options.
 
     The returned context has minimum_version and cipher suites applied;
-    cert/key are loaded when provided (servers without a cert keep the
-    context for tests that only inspect applied options).
+    cert/key are loaded when provided. With `bootstrap_dir` and no
+    configured cert, a self-signed pair is generated/rotated there
+    (util/internalcert — the reference's internal-cert path when
+    cert-manager is absent).
     """
     from kueue_oss_tpu import features
 
@@ -155,6 +159,11 @@ def build_ssl_context(tls: Optional[TLS]) -> Optional[ssl.SSLContext]:
     if tls.cipher_suites:
         # ssl expects an OpenSSL cipher string; names join with ':'
         ctx.set_ciphers(":".join(tls.cipher_suites))
-    if tls.cert_file and tls.key_file:
-        ctx.load_cert_chain(tls.cert_file, tls.key_file)
+    cert_file, key_file = tls.cert_file, tls.key_file
+    if not (cert_file and key_file) and bootstrap_dir:
+        from kueue_oss_tpu.util.internalcert import ensure_cert
+
+        cert_file, key_file = ensure_cert(bootstrap_dir)
+    if cert_file and key_file:
+        ctx.load_cert_chain(cert_file, key_file)
     return ctx

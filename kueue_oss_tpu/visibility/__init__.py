@@ -98,10 +98,13 @@ class VisibilityServer:
     """
 
     def __init__(self, service: VisibilityService, port: int = 0,
-                 tls=None) -> None:
+                 tls=None, tls_bootstrap_dir=None) -> None:
         """`tls`: a parsed util.tlsconfig.TLS — applied via
         build_ssl_context (no-op unless the TLSOptions gate is on and a
-        cert/key pair is configured; reference: config.go:182-190)."""
+        cert/key pair is available; reference: config.go:182-190).
+        Without a configured pair, `tls_bootstrap_dir` generates and
+        rotates a self-signed one (util/internalcert — the reference's
+        internal-cert path when cert-manager is absent)."""
         svc = service
 
         class Handler(BaseHTTPRequestHandler):
@@ -132,8 +135,16 @@ class VisibilityServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.tls_active = False
         if tls is not None:
+            import dataclasses
+
             from kueue_oss_tpu.util.tlsconfig import build_ssl_context
 
+            if not (tls.cert_file and tls.key_file) and tls_bootstrap_dir:
+                from kueue_oss_tpu.util.internalcert import ensure_cert
+
+                cert_file, key_file = ensure_cert(tls_bootstrap_dir)
+                tls = dataclasses.replace(
+                    tls, cert_file=cert_file, key_file=key_file)
             ctx = build_ssl_context(tls)
             if ctx is not None and tls.cert_file and tls.key_file:
                 self._httpd.socket = ctx.wrap_socket(

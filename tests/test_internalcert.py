@@ -1,0 +1,100 @@
+"""Internal certificate bootstrap + rotation (pkg/util/cert analog)."""
+
+import datetime
+import ssl
+
+from kueue_oss_tpu.util.internalcert import ensure_cert
+from kueue_oss_tpu.util.tlsconfig import (
+    TLSOptions,
+    build_ssl_context,
+    parse_tls_options,
+)
+
+
+def test_bootstrap_creates_loadable_pair(tmp_path):
+    cert, key = ensure_cert(tmp_path, dns_names=("localhost", "kueue"))
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)  # raises if invalid
+
+    from cryptography import x509
+
+    parsed = x509.load_pem_x509_certificate(open(cert, "rb").read())
+    sans = parsed.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName).value
+    assert set(sans.get_values_for_type(x509.DNSName)) == {
+        "localhost", "kueue"}
+
+
+def test_valid_cert_is_reused(tmp_path):
+    cert1, key1 = ensure_cert(tmp_path)
+    stamp = open(cert1, "rb").read()
+    cert2, _ = ensure_cert(tmp_path)
+    assert cert2 == cert1
+    assert open(cert2, "rb").read() == stamp  # not regenerated
+
+
+def test_near_expiry_rotates(tmp_path):
+    cert1, _ = ensure_cert(tmp_path, validity_days=365)
+    stamp = open(cert1, "rb").read()
+    # pretend it is 350 days later: inside the 30-day rotation window
+    later = (datetime.datetime.now(datetime.timezone.utc)
+             + datetime.timedelta(days=350))
+    cert2, _ = ensure_cert(tmp_path, validity_days=365, now=later)
+    assert open(cert2, "rb").read() != stamp  # rotated
+
+
+def test_garbage_cert_regenerates(tmp_path):
+    (tmp_path / "tls.crt").write_text("not a cert")
+    (tmp_path / "tls.key").write_text("not a key")
+    cert, key = ensure_cert(tmp_path)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+
+
+def test_tlsconfig_bootstrap_integration(tmp_path):
+    tls = parse_tls_options(TLSOptions(min_version="VersionTLS12"))
+    ctx = build_ssl_context(tls, bootstrap_dir=str(tmp_path))
+    assert ctx is not None
+    assert (tmp_path / "tls.crt").exists()
+    # a context with a loaded chain can wrap a server socket
+    import socket
+
+    s = socket.socket()
+    try:
+        wrapped = ctx.wrap_socket(s, server_side=True,
+                                  do_handshake_on_connect=False)
+        wrapped.close()
+    finally:
+        s.close()
+
+
+def test_visibility_server_serves_https_with_bootstrap(tmp_path):
+    """End-to-end: a TLS-enabled visibility server with a bootstrapped
+    internal cert answers an HTTPS request."""
+    import json
+    import ssl as _ssl
+    import urllib.request
+
+    from kueue_oss_tpu.core.queue_manager import QueueManager
+    from kueue_oss_tpu.core.store import Store
+    from kueue_oss_tpu.visibility import VisibilityServer, VisibilityService
+
+    store = Store()
+    srv = VisibilityServer(
+        VisibilityService(QueueManager(store)), port=0,
+        tls=parse_tls_options(TLSOptions(min_version="VersionTLS12")),
+        tls_bootstrap_dir=str(tmp_path))
+    assert srv.tls_active
+    srv.start()
+    try:
+        client = _ssl.create_default_context(cafile=str(tmp_path / "tls.crt"))
+        client.check_hostname = False
+        resp = urllib.request.urlopen(
+            f"https://127.0.0.1:{srv.port}/apis/visibility/v1beta2/"
+            "clusterqueues/none/pendingworkloads",
+            context=client)
+        assert resp.status == 200 or resp.status == 404
+    except urllib.error.HTTPError as e:
+        assert e.code == 404  # unknown CQ is fine; TLS handshake worked
+    finally:
+        srv.stop()
