@@ -1,0 +1,141 @@
+"""Pallas TPU kernel for the TAS phase-1 leaf-state computation.
+
+The hottest regular op in topology-aware placement is fillInCounts'
+leaf pass (tas_flavor_snapshot.go:1568): for every leaf domain, the
+number of pods that fit is ``min over resources of capacity // request``
+(and, with a leader podset, the same over the capacity left after
+hosting the leader). It is pure VPU work — elementwise integer division
+and a lane-axis min-reduction over a [D_leaves, R] tile — so it maps
+onto an (8, 128) vector-unit tile directly: leaves ride the sublane
+axis, the resource vocabulary pads to one 128-lane register row.
+
+``leaf_states`` is the fused kernel producing the plain state, the
+with-leader state, and the leader-fit flag in ONE pass over the
+capacity tile (the jnp reference reads the tile three times);
+``tas_kernels.fill_counts_ext`` routes through it on TPU backends (or
+when KUEUE_TPU_PALLAS=1; =0 disables), with the jnp path as the
+fallback and the parity oracle (tests/test_pallas_tas.py runs the
+kernel in interpret mode against it).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BIG = np.int32(1 << 30)
+
+#: sublane tile for the leaf axis; lane axis is the 128-wide resource row
+_TILE_D = 256
+_LANES = 128
+
+
+def use_pallas() -> bool:
+    env = os.environ.get("KUEUE_TPU_PALLAS")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def interpret_mode() -> bool:
+    """Pallas lowers natively only on TPU (Mosaic); every other backend
+    runs the kernel in interpret mode so KUEUE_TPU_PALLAS=1 exercises
+    the exact kernel code path anywhere (slow but correct)."""
+    return jax.default_backend() != "tpu"
+
+
+def _leaf_states_kernel(cap_ref, req_ref, leader_ref, flags_ref,
+                        st_ref, swl_ref, ls_ref):
+    cap = cap_ref[:]                                   # [TILE_D, LANES]
+    req = req_ref[:]                                   # [1, LANES]
+    leader = leader_ref[:]                             # [1, LANES]
+    has_leader = flags_ref[0, 0] > 0
+    nz = req > 0
+    safe_req = jnp.maximum(req, 1)
+    per_dom = jnp.where(nz, cap // safe_req, BIG)
+    st = jnp.min(per_dom, axis=1)                      # [TILE_D]
+    lnz = leader > 0
+    fits_leader = jnp.all(~lnz | (cap >= leader), axis=1) & has_leader
+    rem = cap - jnp.where(fits_leader[:, None], leader, 0)
+    per_dom_l = jnp.where(nz, rem // safe_req, BIG)
+    swl = jnp.min(per_dom_l, axis=1)
+    # outputs are [TILE_D, 1] columns (sublane-major); Mosaic pads the
+    # single lane internally
+    st_ref[:] = jnp.minimum(st, BIG)[:, None]
+    swl_ref[:] = jnp.minimum(swl, BIG)[:, None]
+    ls_ref[:] = fits_leader.astype(jnp.int32)[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def leaf_states(leaf_capacity, per_pod, leader_per_pod, has_leader,
+                interpret: bool = False):
+    """Fused phase-1 leaf pass.
+
+    leaf_capacity [D, R] int32; per_pod / leader_per_pod [R] int32;
+    has_leader scalar bool. Returns (st [D], swl [D], ls [D] int32) —
+    exactly fill_counts_ext's leaf-level st/swl/ls.
+    """
+    from jax.experimental import pallas as pl
+
+    D, R = leaf_capacity.shape
+    if R > _LANES:
+        raise ValueError(f"resource vocabulary {R} exceeds one lane row")
+    d_pad = max(_TILE_D, -(-D // _TILE_D) * _TILE_D)
+    cap = jnp.zeros((d_pad, _LANES), dtype=jnp.int32)
+    cap = cap.at[:D, :R].set(leaf_capacity.astype(jnp.int32))
+    req = jnp.zeros((1, _LANES), dtype=jnp.int32)
+    req = req.at[0, :R].set(per_pod.astype(jnp.int32))
+    leader = jnp.zeros((1, _LANES), dtype=jnp.int32)
+    leader = leader.at[0, :R].set(leader_per_pod.astype(jnp.int32))
+    flags = jnp.asarray(has_leader, dtype=jnp.int32).reshape(1, 1)
+
+    grid = (d_pad // _TILE_D,)
+    st, swl, ls = pl.pallas_call(
+        _leaf_states_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE_D, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((1, _LANES), lambda i: (0, 0)),
+            pl.BlockSpec((1, _LANES), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_TILE_D, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE_D, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE_D, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((d_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((d_pad, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(cap, req, leader, flags)
+    return st[:D, 0], swl[:D, 0], ls[:D, 0]
+
+
+def leaf_states_reference(leaf_capacity, per_pod, leader_per_pod,
+                          has_leader):
+    """The jnp formulation (fill_counts_ext's leaf block) — fallback on
+    non-TPU backends and the parity oracle for the kernel."""
+    nz = per_pod > 0
+    per_dom = jnp.where(nz[None, :],
+                        leaf_capacity // jnp.maximum(per_pod, 1)[None, :],
+                        BIG)
+    st = jnp.minimum(jnp.min(per_dom, axis=1), BIG)
+    lnz = leader_per_pod > 0
+    fits_leader = jnp.all(
+        ~lnz[None, :] | (leaf_capacity >= leader_per_pod[None, :]),
+        axis=1) & has_leader
+    rem = leaf_capacity - jnp.where(fits_leader[:, None],
+                                    leader_per_pod[None, :], 0)
+    per_dom_l = jnp.where(nz[None, :],
+                          rem // jnp.maximum(per_pod, 1)[None, :], BIG)
+    swl = jnp.minimum(jnp.min(per_dom_l, axis=1), BIG)
+    return st, swl, fits_leader.astype(jnp.int32)

@@ -296,22 +296,19 @@ def fill_counts_ext(parents, leaf_capacity, per_pod, leader_per_pod,
     sswl (slices with leader). ``slice_level``/``slice_size`` are traced
     scalars; levels are a static Python loop.
     """
+    from kueue_oss_tpu.solver import pallas_tas
+
     n_levels = len(parents)
-    nz = per_pod > 0
-    per_dom = jnp.where(nz[None, :],
-                        leaf_capacity // jnp.maximum(per_pod, 1)[None, :],
-                        BIG)
-    st = jnp.minimum(jnp.min(per_dom, axis=1), BIG)        # [D_leaf]
-    lnz = leader_per_pod > 0
-    fits_leader = jnp.all(~lnz[None, :]
-                          | (leaf_capacity >= leader_per_pod[None, :]),
-                          axis=1) & has_leader
-    rem = leaf_capacity - jnp.where(fits_leader[:, None],
-                                    leader_per_pod[None, :], 0)
-    per_dom_l = jnp.where(nz[None, :],
-                          rem // jnp.maximum(per_pod, 1)[None, :], BIG)
-    swl = jnp.minimum(jnp.min(per_dom_l, axis=1), BIG)
-    ls = fits_leader.astype(jnp.int32)
+    if (pallas_tas.use_pallas()
+            and leaf_capacity.shape[1] <= 128):
+        # the fused Pallas leaf pass (one tile read for st/swl/ls);
+        # non-TPU backends run the same kernel in interpret mode
+        st, swl, ls = pallas_tas.leaf_states(
+            leaf_capacity, per_pod, leader_per_pod, has_leader,
+            interpret=pallas_tas.interpret_mode())
+    else:
+        st, swl, ls = pallas_tas.leaf_states_reference(
+            leaf_capacity, per_pod, leader_per_pod, has_leader)
 
     leaf_l = n_levels - 1
     at_sl = leaf_l == slice_level

@@ -71,12 +71,32 @@ def ensure_cert(directory: str | Path,
     """Return (cert_file, key_file), generating or ROTATING the
     self-signed pair when absent, unparsable, or within
     `rotate_before_days` of expiry (cert.go rotation contract)."""
+    import fcntl
+
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     cert_path = directory / CERT_NAME
     key_path = directory / KEY_NAME
     now = now or datetime.datetime.now(datetime.timezone.utc)
 
+    # serialize bootstrap across processes sharing the directory
+    # (visibility + dashboard + webhook servers starting concurrently
+    # must not interleave the key/cert renames into a mismatched pair)
+    lock = open(directory / ".bootstrap.lock", "w")
+    fcntl.flock(lock, fcntl.LOCK_EX)
+    try:
+        return _ensure_cert_locked(cert_path, key_path, common_name,
+                                   dns_names, validity_days,
+                                   rotate_before_days, now)
+    finally:
+        fcntl.flock(lock, fcntl.LOCK_UN)
+        lock.close()
+
+
+def _ensure_cert_locked(cert_path: Path, key_path: Path,
+                        common_name: str, dns_names: tuple[str, ...],
+                        validity_days: int, rotate_before_days: int,
+                        now: datetime.datetime) -> tuple[str, str]:
     if cert_path.exists() and key_path.exists():
         not_after = _pair_valid_until(cert_path, key_path)
         if (not_after is not None

@@ -166,4 +166,7 @@ def build_ssl_context(tls: Optional[TLS],
         cert_file, key_file = ensure_cert(bootstrap_dir)
     if cert_file and key_file:
         ctx.load_cert_chain(cert_file, key_file)
+        #: servers key their "serve TLS" decision off this (a context
+        #: without a chain is still returned for option inspection)
+        ctx.kueue_cert_loaded = True
     return ctx

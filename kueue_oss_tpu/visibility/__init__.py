@@ -135,18 +135,14 @@ class VisibilityServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.tls_active = False
         if tls is not None:
-            import dataclasses
-
             from kueue_oss_tpu.util.tlsconfig import build_ssl_context
 
-            if not (tls.cert_file and tls.key_file) and tls_bootstrap_dir:
-                from kueue_oss_tpu.util.internalcert import ensure_cert
-
-                cert_file, key_file = ensure_cert(tls_bootstrap_dir)
-                tls = dataclasses.replace(
-                    tls, cert_file=cert_file, key_file=key_file)
-            ctx = build_ssl_context(tls)
-            if ctx is not None and tls.cert_file and tls.key_file:
+            # one bootstrap path: build_ssl_context generates/rotates
+            # the internal cert ONLY when the TLSOptions gate is on
+            # (no key material written for a gated-off config)
+            ctx = build_ssl_context(tls, bootstrap_dir=tls_bootstrap_dir)
+            if ctx is not None and getattr(ctx, "kueue_cert_loaded",
+                                           False):
                 self._httpd.socket = ctx.wrap_socket(
                     self._httpd.socket, server_side=True)
                 self.tls_active = True
