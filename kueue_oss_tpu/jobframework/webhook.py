@@ -37,9 +37,20 @@ def default_job(job: GenericJob,
 
 #: same constraint as Job spec.managedBy (validation_admissiongatedby.go)
 _MAX_GATE_NAME_LEN = 63
-_GATE_NAME_RE = re.compile(
-    r"^[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?(/[A-Za-z0-9]"
-    r"([-A-Za-z0-9_.]*[A-Za-z0-9])?)?$")
+_NAME_PART_RE = re.compile(r"^[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?$")
+
+
+def is_qualified_name(value: str) -> bool:
+    """k8s qualified name: optional `prefix/` (DNS subdomain, <=253)
+    plus a name part (<=63) — metavalidation.ValidateLabelName's shape
+    and length rules, shared by the gate-name and topology-level
+    checks."""
+    prefix, sep, name = value.rpartition("/")
+    if sep and (not prefix or len(prefix) > 253
+                or not _NAME_PART_RE.match(prefix)):
+        return False
+    return bool(name) and len(name) <= 63 and bool(
+        _NAME_PART_RE.match(name))
 
 
 def _gated_by(job) -> str:
@@ -69,7 +80,7 @@ def _validate_gated_by_format(value: str) -> list[str]:
         if len(gate) > _MAX_GATE_NAME_LEN:
             errs.append(f"admission-gated-by: gate {gate!r} exceeds "
                         f"{_MAX_GATE_NAME_LEN} chars")
-        elif not _GATE_NAME_RE.match(gate):
+        elif not is_qualified_name(gate):
             errs.append(f"admission-gated-by: gate {gate!r} is not a "
                         "qualified name")
     return errs
@@ -94,6 +105,60 @@ def validate_admission_gated_by_update(old, new) -> list[str]:
     return errs
 
 
+def validate_tas_podset_request(ps) -> list[str]:
+    """Shared TAS topology-request validation
+    (jobframework/tas_validation.go ValidateTASPodSetRequest): at most
+    one topology mode; level values are label names; slice topology
+    and slice size come as a pair; a podset group excludes slices and
+    needs a required/preferred mode."""
+    tr = ps.topology_request
+    if tr is None:
+        return []
+    p = f"podset {ps.name}"
+    errs: list[str] = []
+    modes = ((tr.required is not None) + (tr.preferred is not None)
+             + (1 if tr.unconstrained else 0))
+    if modes > 1:
+        errs.append(f"{p}: must not contain more than one topology "
+                    "annotation (required, preferred, unconstrained)")
+    for what, val in (("required", tr.required),
+                      ("preferred", tr.preferred),
+                      ("slice required",
+                       tr.podset_slice_required_topology)):
+        if val is not None and not is_qualified_name(val):
+            errs.append(f"{p}: {what} topology {val!r} is not a valid "
+                        "label name")
+    # nested multi-layer slice constraints (KEP multi-layer topology):
+    # each layer needs a valid level label and a positive size — a zero
+    # size would divide-by-zero in the scheduler's slice roll-up
+    for i, layer in enumerate(tr.podset_slice_constraints):
+        if not is_qualified_name(layer.topology):
+            errs.append(f"{p}: slice constraint [{i}] topology "
+                        f"{layer.topology!r} is not a valid label name")
+        if layer.size <= 0:
+            errs.append(f"{p}: slice constraint [{i}] size must be a "
+                        "positive integer")
+    if (tr.podset_slice_required_topology is not None
+            and tr.podset_slice_size is None):
+        errs.append(f"{p}: slice size must be set when slice topology "
+                    "is specified")
+    if (tr.podset_slice_size is not None
+            and tr.podset_slice_required_topology is None):
+        errs.append(f"{p}: slice size may not be set without slice "
+                    "topology")
+    if tr.podset_slice_size is not None and tr.podset_slice_size <= 0:
+        errs.append(f"{p}: slice size must be a positive integer")
+    if tr.podset_group_name is not None:
+        if tr.podset_slice_size is not None or (
+                tr.podset_slice_required_topology is not None):
+            errs.append(f"{p}: podset group may not be combined with "
+                        "slice topology")
+        if tr.required is None and tr.preferred is None:
+            errs.append(f"{p}: podset group requires a required or "
+                        "preferred topology")
+    return errs
+
+
 def validate_job_create(job: GenericJob) -> list[str]:
     from kueue_oss_tpu import features
 
@@ -110,6 +175,8 @@ def validate_job_create(job: GenericJob) -> list[str]:
         for r, q in ps.requests.items():
             if q < 0:
                 errs.append(f"podset {ps.name}: negative request {r}")
+        if features.enabled("TopologyAwareScheduling"):
+            errs.extend(validate_tas_podset_request(ps))
     if features.enabled("AdmissionGatedBy"):
         errs.extend(_validate_gated_by_format(_gated_by(job)))
     # per-framework rules (the reference's *_webhook.go ValidateCreate

@@ -251,6 +251,14 @@ class PodGroupController:
             errs.append(
                 f"annotations[{REQUIRED_TOPOLOGY_ANNOTATION}]: required "
                 "and preferred topology are mutually exclusive")
+        from kueue_oss_tpu.jobframework.webhook import is_qualified_name
+
+        for ann in (REQUIRED_TOPOLOGY_ANNOTATION,
+                    PREFERRED_TOPOLOGY_ANNOTATION):
+            val = pod.annotations.get(ann)
+            if val and not is_qualified_name(val):
+                errs.append(f"annotations[{ann}]: {val!r} is not a "
+                            "valid label name")
         return errs
 
     @staticmethod

@@ -312,10 +312,15 @@ def validate_workload(wl: Workload) -> list[str]:
         for r, q in ps.requests.items():
             if q < 0:
                 errs.append(f"podSet {ps.name}: negative request for {r}")
-        tr = ps.topology_request
-        if tr is not None and tr.required and tr.preferred:
-            errs.append(f"podSet {ps.name}: topology required and preferred "
-                        "are mutually exclusive")
+        # one shared TAS topology-request validator (tas_validation.go):
+        # workloads created directly get the same rules as job webhooks
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.jobframework.webhook import (
+            validate_tas_podset_request,
+        )
+
+        if features.enabled("TopologyAwareScheduling"):
+            errs.extend(validate_tas_podset_request(ps))
     return errs
 
 

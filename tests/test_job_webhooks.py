@@ -189,3 +189,70 @@ class TestPodWebhook:
                   annotations={POD_GROUP_TOTAL_ANNOTATION: "2"})
         assert any("immutable" in e
                    for e in ctl.validate_pod_update(old, new))
+
+
+class TestTASPodSetRequestValidation:
+    """Shared TAS topology-request rules (tas_validation.go analog)."""
+
+    def _job(self, tr):
+        from kueue_oss_tpu.api.types import PodSetTopologyRequest
+
+        job = BatchJob(name="j", queue_name="lq", parallelism=8)
+        job.topology_request = tr
+        return job
+
+    def test_multiple_modes_rejected(self):
+        from kueue_oss_tpu.api.types import PodSetTopologyRequest
+
+        job = self._job(PodSetTopologyRequest(
+            required="rack", preferred="rack"))
+        assert any("more than one topology" in e
+                   for e in validate_job_create(job))
+
+    def test_bad_label_name(self):
+        from kueue_oss_tpu.api.types import PodSetTopologyRequest
+
+        job = self._job(PodSetTopologyRequest(required="-bad-"))
+        assert any("not a valid label name" in e
+                   for e in validate_job_create(job))
+        ok = self._job(PodSetTopologyRequest(
+            required="cloud.provider.com/topology-rack"))
+        assert not validate_job_create(ok)
+
+    def test_slice_pairing(self):
+        from kueue_oss_tpu.api.types import PodSetTopologyRequest
+
+        no_size = self._job(PodSetTopologyRequest(
+            required="rack", podset_slice_required_topology="host"))
+        assert any("slice size must be set" in e
+                   for e in validate_job_create(no_size))
+        no_topo = self._job(PodSetTopologyRequest(
+            required="rack", podset_slice_size=4))
+        assert any("may not be set without" in e
+                   for e in validate_job_create(no_topo))
+        zero = self._job(PodSetTopologyRequest(
+            required="rack", podset_slice_required_topology="host",
+            podset_slice_size=0))
+        assert any("positive integer" in e
+                   for e in validate_job_create(zero))
+
+    def test_group_rules(self):
+        from kueue_oss_tpu.api.types import PodSetTopologyRequest
+
+        combined = self._job(PodSetTopologyRequest(
+            required="rack", podset_group_name="g",
+            podset_slice_required_topology="host", podset_slice_size=2))
+        assert any("may not be combined" in e
+                   for e in validate_job_create(combined))
+        no_mode = self._job(PodSetTopologyRequest(
+            unconstrained=True, podset_group_name="g"))
+        assert any("requires a required or preferred" in e
+                   for e in validate_job_create(no_mode))
+
+    def test_gate_off_skips(self):
+        from kueue_oss_tpu.api.types import PodSetTopologyRequest
+
+        features.set_gates({"TopologyAwareScheduling": False})
+        job = self._job(PodSetTopologyRequest(
+            required="rack", preferred="rack"))
+        assert not validate_job_create(job)

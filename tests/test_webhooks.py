@@ -149,7 +149,8 @@ def test_workload_validation():
         name="a", count=1,
         topology_request=PodSetTopologyRequest(required="rack",
                                                preferred="block"))])
-    assert any("mutually exclusive" in e for e in validate_workload(wl))
+    assert any("more than one topology" in e
+               for e in validate_workload(wl))
 
 
 def test_workload_defaulting_priority_class():
