@@ -134,6 +134,20 @@ def _warm_solver_programs(config) -> None:
         f"{_time.monotonic() - t0:.1f}s")
 
 
+def _degradation_counts() -> dict:
+    """Solver-backend degradation events recorded by this process
+    (scenario subprocesses start with a clean registry, so these are
+    per-scenario counts)."""
+    from kueue_oss_tpu import metrics as kmetrics
+
+    return {
+        "solver_fallback_count": int(
+            kmetrics.solver_fallback_total.total()),
+        "breaker_trips": int(
+            kmetrics.solver_breaker_trips_total.total()),
+    }
+
+
 def run_scenario(scenario: str) -> dict:
     """Executed inside a fresh subprocess: one timed drain."""
     import numpy as np
@@ -558,6 +572,7 @@ def run_scenario(scenario: str) -> dict:
             "sim_wall_ms": stats.sim_wall_ms,
             "cycles": stats.cycles,
             "adm_per_s": stats.admissions_per_real_second,
+            **_degradation_counts(),
         }
 
     if scenario == "sim_large":
@@ -583,6 +598,99 @@ def run_scenario(scenario: str) -> dict:
             "seconds": stats.real_seconds,
             "cycles": stats.cycles,
             "adm_per_s": stats.admissions_per_real_second,
+            **_degradation_counts(),
+        }
+
+    if scenario == "chaos":
+        # seeded fault storm (kueue_oss_tpu/chaos) through the full
+        # scheduler routing: the sidecar crashes, garbles frames, and
+        # returns corrupt plans on a seeded schedule; the run must
+        # finish with full capacity admitted via retries + host-cycle
+        # fallback, and the JSON tail records the degradation events
+        # (docs/ROBUSTNESS.md).
+        import tempfile
+
+        from kueue_oss_tpu.api.types import (
+            ClusterQueue,
+            FlavorQuotas,
+            LocalQueue,
+            PodSet,
+            ResourceFlavor,
+            ResourceGroup,
+            ResourceQuota,
+            Workload,
+        )
+        from kueue_oss_tpu.chaos import (
+            CORRUPT_PLAN,
+            CRASH,
+            GARBLE,
+            OK,
+            TRUNCATE,
+            ChaosSolverServer,
+            FaultInjector,
+        )
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.core.store import Store
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+        from kueue_oss_tpu.solver.engine import SolverEngine
+        from kueue_oss_tpu.solver.service import SolverClient
+
+        n_cqs = int(os.environ.get("BENCH_CHAOS_CQS", "8"))
+        quota = int(os.environ.get("BENCH_CHAOS_QUOTA", "32"))
+        n_wl = int(os.environ.get("BENCH_CHAOS_WL", "1024"))
+        store = Store()
+        store.upsert_resource_flavor(ResourceFlavor(name="f"))
+        for i in range(n_cqs):
+            store.upsert_cluster_queue(ClusterQueue(
+                name=f"cq{i}", resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="f", resources=[
+                        ResourceQuota(name="cpu", nominal=quota)])])]))
+            store.upsert_local_queue(LocalQueue(
+                name=f"lq{i}", cluster_queue=f"cq{i}"))
+        for i in range(n_wl):
+            store.add_workload(Workload(
+                name=f"w{i}", queue_name=f"lq{i % n_cqs}", uid=i + 1,
+                creation_time=float(i),
+                podsets=[PodSet(name="main", count=1,
+                                requests={"cpu": 1})]))
+        queues = QueueManager(store)
+        path = os.path.join(tempfile.mkdtemp(), "solver.sock")
+        # deterministic fault prefix (a small backlog may need only a
+        # couple of solver calls — the storm must still be exercised),
+        # then the seeded weighted mix
+        injector = FaultInjector(
+            schedule=[CRASH, GARBLE, CORRUPT_PLAN],
+            weights={CRASH: 2, GARBLE: 1, TRUNCATE: 1,
+                     CORRUPT_PLAN: 1, OK: 3},
+            seed=int(os.environ.get("BENCH_CHAOS_SEED", "42")))
+        srv = ChaosSolverServer(path, injector)
+        srv.serve_in_background()
+        try:
+            sched = Scheduler(store, queues, solver_min_backlog=64)
+            engine = SolverEngine(
+                store, queues, scheduler=sched,
+                remote=SolverClient(path, timeout_s=30.0, max_retries=1,
+                                    backoff_base_s=0.01))
+            sched.solver = engine
+            t0 = time.monotonic()
+            cycles = sched.run_until_quiet(now=0.0, tick=1.0)
+            elapsed = time.monotonic() - t0
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        admitted = sum(1 for w in store.workloads.values()
+                       if w.is_quota_reserved)
+        return {
+            "scenario": scenario,
+            "workloads": n_wl,
+            "capacity": n_cqs * quota,
+            "admitted": admitted,
+            "cycles": cycles,
+            "seconds": elapsed,
+            "faults_injected": injector.faults_injected(),
+            "faults_by_kind": injector.injected,
+            **_degradation_counts(),
         }
 
     if scenario == "parity":
@@ -784,6 +892,14 @@ def main() -> None:
     except Exception as e:
         log(f"[sim_large] did not complete: {e}")
         sim_large = None
+    # seeded fault storm through the chaos harness (host backend; the
+    # scenario's point is the control plane surviving, not kernel speed)
+    try:
+        chaos = measure("chaos", extra_env={"BENCH_CPU": "1"},
+                        timeout=900)
+    except Exception as e:
+        log(f"[chaos] did not complete: {e}")
+        chaos = None
     log(f"total bench time {time.monotonic() - t_start:.1f}s")
 
     # HEADLINE: the reference's own protocol — same shape, same
@@ -866,6 +982,18 @@ def main() -> None:
             extra["tas_slice_leader_decisions_per_s"] = round(
                 tas["ext_workloads"] / tas["ext_seconds"], 1)
             extra["tas_slice_leader_placed"] = tas["ext_placed"]
+    if chaos is not None:
+        extra["chaos_admitted"] = chaos["admitted"]
+        extra["chaos_capacity"] = chaos["capacity"]
+        extra["chaos_faults_injected"] = chaos["faults_injected"]
+        extra["chaos_seconds"] = round(chaos["seconds"], 3)
+    # degradation events across every solver-routed scenario, so the
+    # perf trajectory records backend faults alongside throughput
+    solver_runs = [sim, sim_solver_cpu, sim_solver_dev, sim_large, chaos]
+    extra["solver_fallback_count"] = sum(
+        r.get("solver_fallback_count", 0) for r in solver_runs if r)
+    extra["breaker_trips"] = sum(
+        r.get("breaker_trips", 0) for r in solver_runs if r)
     # honest per-scenario backend labels (a scenario that fell back to
     # the CPU must not masquerade as a TPU number)
     for name, plat in scenario_platform.items():

@@ -1,0 +1,255 @@
+"""Deterministic fault-injection harness for the solver backend.
+
+The resilience layer (solver/resilience.py, SolverClient deadlines and
+retries, the engine's plan-sanity guard) claims the control plane
+survives a crashing, hanging, or garbage-spewing solver sidecar without
+stalling admissions. This module *proves* it: a seeded injector decides,
+per request, which failure mode the sidecar exhibits, and a chaos
+server wraps the real solve path with those faults. The same injector
+drives the `chaos`-marked tests (tier-1: fully deterministic, injected
+clocks, no sleeps in the fast subset) and bench.py's chaos scenario.
+
+Failure modes (FAULTS):
+
+  ok            -- serve the request normally
+  crash_pre     -- close the connection before reading the request
+  crash         -- read the request, then die without replying
+                   (sidecar killed mid-request: client sees EOF)
+  hang          -- hold the connection open and never reply (client's
+                   per-call deadline is the only way out)
+  truncate      -- declare a full frame but send only part of it
+  oversize      -- declare a frame above the client's max-frame guard
+  garble        -- well-framed response whose npz payload is noise
+  corrupt_plan  -- a *decodable* plan with out-of-bounds indices and
+                   admitted null/padding rows (exercises the engine's
+                   plan-sanity guard, not the transport)
+  slow          -- delay the (correct) response by ``slow_s``
+
+Node flap (the non-sidecar failure in the model) is injected by
+``NodeFlapInjector`` against the store's node objects.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import random
+import socketserver
+import struct
+import time
+from typing import Optional
+
+import numpy as np
+
+from kueue_oss_tpu.solver.service import (
+    SolverServer,
+    _recv,
+    _send,
+    deserialize_problem,
+    respond,
+)
+
+OK = "ok"
+CRASH_PRE = "crash_pre"
+CRASH = "crash"
+HANG = "hang"
+TRUNCATE = "truncate"
+OVERSIZE = "oversize"
+GARBLE = "garble"
+CORRUPT_PLAN = "corrupt_plan"
+SLOW = "slow"
+
+FAULTS = (OK, CRASH_PRE, CRASH, HANG, TRUNCATE, OVERSIZE, GARBLE,
+          CORRUPT_PLAN, SLOW)
+
+#: ceiling on how long a "hang" holds its connection open server-side;
+#: the client's deadline fires long before this in any sane config —
+#: it only bounds thread lifetime if a test dies mid-hang
+_HANG_CAP_S = 30.0
+
+
+class FaultInjector:
+    """Seeded per-request fault decisions, usable two ways.
+
+    - ``schedule``: an explicit fault sequence consumed in order
+      (deterministic tests: "crash, then serve"). After the schedule is
+      exhausted the injector falls through to the random mode.
+    - ``weights``: {fault: weight} sampled with the seeded RNG (chaos
+      sweeps in bench.py). With neither, every request is served.
+
+    ``injected`` counts what was actually injected, for assertions and
+    the bench JSON tail.
+    """
+
+    def __init__(self, schedule=(), seed: int = 0,
+                 weights: Optional[dict] = None,
+                 slow_s: float = 0.01) -> None:
+        for f in list(schedule) + list(weights or {}):
+            if f not in FAULTS:
+                raise ValueError(f"unknown fault {f!r}; one of {FAULTS}")
+        self.schedule = list(schedule)
+        self._i = 0
+        self._rng = random.Random(seed)
+        self.weights = dict(weights or {})
+        self.slow_s = slow_s
+        self.injected: dict[str, int] = {}
+
+    def next_fault(self) -> str:
+        if self._i < len(self.schedule):
+            fault = self.schedule[self._i]
+            self._i += 1
+        elif self.weights:
+            fault = self._rng.choices(
+                list(self.weights), weights=list(self.weights.values()))[0]
+        else:
+            fault = OK
+        self.injected[fault] = self.injected.get(fault, 0) + 1
+        return fault
+
+    def faults_injected(self) -> int:
+        """Requests that got anything other than normal service."""
+        return sum(n for f, n in self.injected.items() if f != OK)
+
+
+def _corrupt_plan_response(header: dict, blob: bytes) -> tuple[dict, bytes]:
+    """A decodable response whose plan violates every invariant the
+    sanity guard checks: all rows (null + padding included) admitted,
+    flavor options far out of range."""
+    problem = deserialize_problem(header["meta"], blob)
+    W1 = problem.wl_cqid.shape[0]
+    admitted = np.ones(W1, dtype=bool)
+    parked = np.zeros(W1, dtype=bool)
+    admit_round = np.zeros(W1, dtype=np.int32)
+    rounds = np.int32(1)
+    if header["full"]:
+        g = max(1, int(header.get("g_max", 1)))
+        opt = np.full((W1, g), 1 << 20, dtype=np.int32)
+        names = ["admitted", "opt", "admit_round", "parked", "rounds",
+                 "usage", "wl_usage", "victim_reason"]
+        arrays = [admitted, opt, admit_round, parked, rounds,
+                  np.zeros(1, np.int32), np.zeros(1, np.int32),
+                  np.zeros(W1, np.int32)]
+    else:
+        opt = np.full((W1,), 1 << 20, dtype=np.int32)
+        names = ["admitted", "opt", "admit_round", "parked", "rounds",
+                 "usage"]
+        arrays = [admitted, opt, admit_round, parked, rounds,
+                  np.zeros(1, np.int32)]
+    buf = io.BytesIO()
+    np.savez(buf, **dict(zip(names, arrays)))
+    return {"ok": True, "names": names}, buf.getvalue()
+
+
+class _ChaosHandler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:  # noqa: C901 - one branch per fault
+        injector: FaultInjector = self.server.injector
+        fault = injector.next_fault()
+        if fault == CRASH_PRE:
+            return
+        try:
+            header, blob = _recv(self.request, self.server.max_frame_bytes)
+        except ConnectionError:
+            return
+        if fault == CRASH:
+            return
+        if fault == HANG:
+            try:
+                # never reply; unblock (and release the thread) when the
+                # client's deadline fires and it closes the socket
+                self.request.settimeout(_HANG_CAP_S)
+                self.request.recv(1)
+            except OSError:
+                pass
+            return
+        if fault == OVERSIZE:
+            h = json.dumps({"ok": True, "names": ["admitted"]}).encode()
+            try:
+                self.request.sendall(
+                    struct.pack(">II", len(h), 0xFFFF_FFF0))
+                self.request.sendall(h)
+            except OSError:
+                pass
+            return
+        if fault == TRUNCATE:
+            h = json.dumps({"ok": True, "names": ["admitted"]}).encode()
+            try:
+                # declare 128 payload bytes, deliver 64, close
+                self.request.sendall(struct.pack(">II", len(h), 128))
+                self.request.sendall(h)
+                self.request.sendall(b"\x00" * 64)
+            except OSError:
+                pass
+            return
+        if fault == GARBLE:
+            junk = bytes(injector._rng.getrandbits(8) for _ in range(96))
+            try:
+                _send(self.request,
+                      {"ok": True, "names": ["admitted", "opt"]}, junk)
+            except OSError:
+                pass
+            return
+        if fault == CORRUPT_PLAN:
+            try:
+                resp_h, resp_b = _corrupt_plan_response(header, blob)
+                _send(self.request, resp_h, resp_b)
+            except OSError:
+                pass
+            return
+        if fault == SLOW:
+            time.sleep(injector.slow_s)
+        # healthy tail: the production respond path, shared verbatim
+        respond(self.request, header, blob)
+
+
+class ChaosSolverServer(SolverServer):
+    """A SolverServer whose handler consults a FaultInjector per request.
+
+    Drop-in for the production sidecar in tests and bench runs:
+    ``ChaosSolverServer(path, FaultInjector(schedule=["crash", "ok"]))``.
+    """
+
+    def __init__(self, socket_path: str, injector: FaultInjector,
+                 max_frame_bytes: Optional[int] = None) -> None:
+        super().__init__(socket_path, max_frame_bytes=max_frame_bytes)
+        self.injector = injector
+        self.RequestHandlerClass = _ChaosHandler
+
+
+class NodeFlapInjector:
+    """Seeded node-readiness flapping against the store.
+
+    ``flap_down`` marks nodes NotReady (specific names, or a seeded
+    sample); ``flap_up`` restores them. Pairing the two inside/outside
+    the failure controller's grace period drives the flap-recovery path
+    (controllers/failure_recovery.py) deterministically.
+    """
+
+    def __init__(self, store, seed: int = 0) -> None:
+        self.store = store
+        self._rng = random.Random(seed)
+        self._down: list[str] = []
+
+    def flap_down(self, count: int = 1,
+                  names: Optional[list[str]] = None) -> list[str]:
+        if names is None:
+            pool = sorted(n for n, node in self.store.nodes.items()
+                          if node.ready)
+            names = self._rng.sample(pool, min(count, len(pool)))
+        for n in names:
+            node = self.store.nodes[n]
+            node.ready = False
+            self.store.upsert_node(node)
+        self._down.extend(names)
+        return list(names)
+
+    def flap_up(self, names: Optional[list[str]] = None) -> list[str]:
+        if names is None:
+            names, self._down = self._down, []
+        else:
+            self._down = [n for n in self._down if n not in names]
+        for n in names:
+            node = self.store.nodes.get(n)
+            if node is not None:
+                node.ready = True
+                self.store.upsert_node(node)
+        return list(names)

@@ -126,6 +126,34 @@ class MultiKueueConfig:
 
 
 @dataclass
+class SolverBackendConfig:
+    """Resilience knobs for the remote TPU solver sidecar (no reference
+    analog — the reference's scheduler is in-process; docs/ROBUSTNESS.md
+    describes the failure model these govern).
+
+    Environment overrides (read by solver/service.py when a knob is not
+    given programmatically): KUEUE_SOLVER_SOCKET (enables the remote
+    backend under Scheduler(solver="auto")), KUEUE_SOLVER_TIMEOUT_S,
+    KUEUE_SOLVER_MAX_FRAME_MB.
+    """
+
+    #: unix socket of the sidecar; None = solve in-process
+    socket_path: Optional[str] = None
+    #: per-call deadline covering every retry of one solve
+    timeout_seconds: float = 600.0
+    #: re-attempts (fresh connection each) on transport faults
+    max_retries: int = 2
+    retry_backoff_base_seconds: float = 0.05
+    retry_backoff_max_seconds: float = 2.0
+    #: frames above this are rejected before allocating
+    max_frame_bytes: int = 256 << 20
+    #: consecutive failures that trip the circuit breaker open
+    breaker_failure_threshold: int = 3
+    #: how long a tripped breaker refuses calls before one probe
+    breaker_cooldown_seconds: float = 30.0
+
+
+@dataclass
 class Configuration:
     """Reference parity: configuration_types.go Configuration."""
 
@@ -143,6 +171,7 @@ class Configuration:
     resources: ResourcesConfig = field(default_factory=ResourcesConfig)
     object_retention_policies: Optional[ObjectRetentionPolicies] = None
     multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
+    solver: SolverBackendConfig = field(default_factory=SolverBackendConfig)
     feature_gates: dict[str, bool] = field(default_factory=dict)
     #: TLS options for the HTTP servers (reference: Configuration.TLS,
     #: applied in config.go:182-190 under the TLSOptions gate)
@@ -185,6 +214,21 @@ def validate(cfg: Configuration) -> list[str]:
     if cfg.multikueue.dispatcher_name not in _DISPATCHERS:
         errs.append(f"multiKueue.dispatcherName {cfg.multikueue.dispatcher_name!r} "
                     f"not in {sorted(_DISPATCHERS)}")
+    sv = cfg.solver
+    if sv.timeout_seconds <= 0:
+        errs.append("solver.timeout must be > 0")
+    if sv.max_retries < 0:
+        errs.append("solver.maxRetries must be >= 0")
+    if sv.retry_backoff_base_seconds < 0:
+        errs.append("solver.retryBackoffBase must be >= 0")
+    if sv.retry_backoff_max_seconds < 0:
+        errs.append("solver.retryBackoffMax must be >= 0")
+    if sv.max_frame_bytes <= 0:
+        errs.append("solver.maxFrameBytes must be > 0")
+    if sv.breaker_failure_threshold < 1:
+        errs.append("solver.breakerFailureThreshold must be >= 1")
+    if sv.breaker_cooldown_seconds < 0:
+        errs.append("solver.breakerCooldown must be >= 0")
     afs = cfg.admission_fair_sharing
     if afs is not None:
         if afs.usage_half_life_time_seconds < 0:
@@ -298,6 +342,18 @@ def load(data: Optional[dict] = None) -> Configuration:
             "dispatcherName": ("dispatcher_name", None),
         })
 
+    def conv_solver(d: dict) -> SolverBackendConfig:
+        return _build(SolverBackendConfig, d, {
+            "socketPath": ("socket_path", None),
+            "timeout": ("timeout_seconds", float),
+            "maxRetries": ("max_retries", int),
+            "retryBackoffBase": ("retry_backoff_base_seconds", float),
+            "retryBackoffMax": ("retry_backoff_max_seconds", float),
+            "maxFrameBytes": ("max_frame_bytes", int),
+            "breakerFailureThreshold": ("breaker_failure_threshold", int),
+            "breakerCooldown": ("breaker_cooldown_seconds", float),
+        })
+
     def conv_integrations(d: dict) -> list[str]:
         return list(d.get("frameworks", []))
 
@@ -319,6 +375,7 @@ def load(data: Optional[dict] = None) -> Configuration:
         "resources": ("resources", conv_resources),
         "objectRetentionPolicies": ("object_retention_policies", conv_retention),
         "multiKueue": ("multikueue", conv_mk),
+        "solver": ("solver", conv_solver),
         "featureGates": ("feature_gates", dict),
         "tls": ("tls", conv_tls),
     })

@@ -403,6 +403,32 @@ solver_plan_fallbacks_total = registry.register(Counter(
     "kueue_tpu_solver_plan_fallbacks_total",
     "Solver plans rejected by the host oracle re-check", ()))
 
+# -- solver backend resilience (sidecar transport + circuit breaker) ---------
+
+solver_remote_retries_total = registry.register(Counter(
+    "kueue_tpu_solver_remote_retries_total",
+    "Remote solve attempts retried after a transport fault", ()))
+solver_remote_failures_total = registry.register(Counter(
+    "kueue_tpu_solver_remote_failures_total",
+    "Remote solve attempt failures by kind "
+    "(timeout/protocol/connection/server)", ("kind",)))
+solver_deadline_exceeded_total = registry.register(Counter(
+    "kueue_tpu_solver_deadline_exceeded_total",
+    "Remote solves abandoned at the per-call deadline", ()))
+solver_fallback_total = registry.register(Counter(
+    "kueue_tpu_solver_fallback_total",
+    "Backlog drains degraded to the host cycle path by reason",
+    ("reason",)))
+solver_breaker_trips_total = registry.register(Counter(
+    "kueue_tpu_solver_breaker_trips_total",
+    "Solver circuit breaker transitions into the open state", ()))
+solver_breaker_state = registry.register(Gauge(
+    "kueue_tpu_solver_breaker_state",
+    "Solver breaker state (0 closed, 1 half-open, 2 open)", ()))
+solver_plan_rejected_total = registry.register(Counter(
+    "kueue_tpu_solver_plan_rejected_total",
+    "Imported plans rejected wholesale by the sanity guard", ()))
+
 
 # -- recording helpers (reference: pkg/metrics exported funcs) ---------------
 

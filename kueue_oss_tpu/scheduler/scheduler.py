@@ -91,6 +91,7 @@ class Scheduler:
         solver=None,
         solver_min_backlog: int = 256,
         solver_reengage_fraction: float = 0.05,
+        solver_config=None,
         eviction_backoff_max_s: float = 3600.0,
     ) -> None:
         self.store = store
@@ -109,6 +110,10 @@ class Scheduler:
         #: back to host cycles for unsupported shapes / rejected entries.
         self.solver = solver
         self._solver_instance = None
+        #: config.SolverBackendConfig for the "auto" engine: remote
+        #: socket, client deadlines/retries, and breaker thresholds.
+        #: None = built-in defaults (+ KUEUE_SOLVER_* env overrides).
+        self.solver_config = solver_config
         #: skip the device drain below this many active pending
         #: workloads: a batched solve pays a fixed host-side export cost
         #: per invocation, so backlog FLOODS go to the device while
@@ -323,11 +328,44 @@ class Scheduler:
             return None
         if self.solver == "auto":
             if self._solver_instance is None:
+                import os
+
                 from kueue_oss_tpu.solver.engine import SolverEngine
 
+                # solver_config.socket_path (programmatic, wins) or the
+                # KUEUE_SOLVER_SOCKET env fallback routes the auto
+                # engine's solves through the sidecar; the engine's
+                # circuit breaker then governs remote health (a tripped
+                # breaker degrades drains to the host cycle until a
+                # probe succeeds)
+                cfg = self.solver_config
+                remote = None
+                health = None
+                sock = (cfg.socket_path
+                        if cfg is not None and cfg.socket_path
+                        else os.environ.get("KUEUE_SOLVER_SOCKET"))
+                if sock:
+                    from kueue_oss_tpu.solver.service import SolverClient
+
+                    if cfg is not None:
+                        import dataclasses
+
+                        remote = SolverClient.from_config(
+                            dataclasses.replace(cfg, socket_path=sock))
+                    else:
+                        remote = SolverClient(sock)
+                if cfg is not None:
+                    from kueue_oss_tpu.solver.resilience import (
+                        SolverHealth,
+                    )
+
+                    health = SolverHealth(
+                        cfg.breaker_failure_threshold,
+                        cfg.breaker_cooldown_seconds)
                 self._solver_instance = SolverEngine(
                     self.store, self.queues, scheduler=self,
-                    enable_fair_sharing=self.enable_fair_sharing)
+                    enable_fair_sharing=self.enable_fair_sharing,
+                    remote=remote, health=health)
             return self._solver_instance
         return self.solver
 
@@ -341,6 +379,7 @@ class Scheduler:
         engine = self._solver_engine()
         if engine is None or not self.queues.has_pending():
             return False
+        from kueue_oss_tpu.solver.resilience import SolverUnavailable
         from kueue_oss_tpu.solver.tensors import UnsupportedProblem
 
         if not engine.supported():
@@ -431,6 +470,16 @@ class Scheduler:
         except UnsupportedProblem:
             self.queues.materialize_stale_all()
             self._solver_drain_trigger = None
+            return False
+        except SolverUnavailable as e:
+            # backend crashed/hung/returned garbage, or the breaker is
+            # open: the admission round completes on the host cycle loop
+            # below — never an exception, never a stall past the
+            # client's deadline (engine.health un-trips via probes)
+            self.queues.materialize_stale_all()
+            self._solver_drain_trigger = None
+            self.log.info("solver backend unavailable; host-cycle "
+                          "fallback", v=1, error=str(e))
             return False
         self._solver_drained_once = True
         self._solver_freed_since_drain = 0

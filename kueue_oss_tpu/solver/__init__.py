@@ -13,3 +13,7 @@ reference; parity tests diff the two on randomized scenarios.
 from kueue_oss_tpu.solver.tensors import SolverProblem, export_problem  # noqa: F401
 from kueue_oss_tpu.solver.kernels import solve_backlog  # noqa: F401
 from kueue_oss_tpu.solver.engine import SolverEngine  # noqa: F401
+from kueue_oss_tpu.solver.resilience import (  # noqa: F401
+    SolverHealth,
+    SolverUnavailable,
+)

@@ -27,6 +27,7 @@ from kueue_oss_tpu.core.store import Store
 from kueue_oss_tpu.core.workload_info import WorkloadInfo
 from kueue_oss_tpu import metrics
 from kueue_oss_tpu.solver.kernels import solve_backlog, to_device
+from kueue_oss_tpu.solver.resilience import SolverHealth, SolverUnavailable
 from kueue_oss_tpu.solver.tensors import (
     ExportCache,
     SolverProblem,
@@ -61,7 +62,7 @@ class SolverEngine:
 
     def __init__(self, store: Store, queues: QueueManager,
                  scheduler=None, enable_fair_sharing: bool = False,
-                 remote=None) -> None:
+                 remote=None, health: Optional[SolverHealth] = None) -> None:
         self.store = store
         self.queues = queues
         #: host scheduler whose eviction state machine applies the plan's
@@ -75,6 +76,10 @@ class SolverEngine:
         #: separate sidecar process (SURVEY §2.4); export, verify, and
         #: commit stay in this process
         self.remote = remote
+        #: circuit breaker over the remote backend: a tripped breaker
+        #: short-circuits drains into SolverUnavailable (host-cycle
+        #: fallback) instead of re-probing a dead sidecar every pass
+        self.health = health if health is not None else SolverHealth()
         #: pad the workload axis to at least this size before solving.
         #: Callers that drain repeatedly while the backlog grows (the
         #: scheduler serve loop, the perf Simulator) set it to the
@@ -300,6 +305,12 @@ class SolverEngine:
         if not self.supported():
             raise UnsupportedProblem(
                 "admission-scope or weighted fair-sharing CQs present")
+        if self.remote is not None and not self.health.allow():
+            # open breaker: refuse without touching the socket so the
+            # admission round proceeds on the host path immediately
+            metrics.solver_fallback_total.inc("breaker_open")
+            raise SolverUnavailable(
+                "solver backend breaker is open (cooling down)")
         pending = self.pending_backlog()
         if self.needs_full_kernel(pending):
             return self._drain_full(now, verify=verify, pending=pending)
@@ -314,7 +325,7 @@ class SolverEngine:
         t0 = time.monotonic()
         if self.remote is not None:
             (admitted, opt, admit_round, parked, rounds,
-             _usage) = self.remote.solve(problem, full=False)
+             _usage) = self._remote_solve(problem, 6, full=False)
         else:
             tensors = to_device(problem)
             (admitted, opt, admit_round, parked, rounds,
@@ -323,6 +334,13 @@ class SolverEngine:
         opt = np.asarray(opt)
         admit_round = np.asarray(admit_round)
         parked = np.asarray(parked)
+        if self.remote is not None:
+            # guard IMPORTED plans only: the in-process kernel is
+            # trusted (a local bug should fail tests loudly, not
+            # silently degrade), and the local hot path stays free of
+            # the O(W) validation passes
+            self._check_plan(problem, admitted, opt, admit_round,
+                             parked, rounds=rounds, full=False)
         result.rounds = int(rounds)
         result.solver_time_s = time.monotonic() - t0
         metrics.solver_cycle_duration_seconds.observe(
@@ -335,6 +353,143 @@ class SolverEngine:
         metrics.solver_cycle_duration_seconds.observe(
             "apply", value=result.apply_time_s)
         return result
+
+    # -- backend resilience ------------------------------------------------
+
+    def _remote_solve(self, problem: SolverProblem, expect: int, **kw):
+        """One remote solve with breaker accounting.
+
+        Any transport/backend fault (including a malformed result tuple)
+        counts against the circuit breaker and surfaces as
+        SolverUnavailable so the scheduler degrades to the host cycle.
+        Success is NOT recorded here — only a plan that also passes the
+        sanity guard counts as a healthy backend response.
+        """
+        try:
+            out = tuple(self.remote.solve(problem, **kw))
+        except SolverUnavailable:
+            self.health.record_failure()
+            metrics.solver_fallback_total.inc("backend_error")
+            raise
+        except (OSError, TimeoutError) as e:
+            # custom remote stubs may surface raw socket errors
+            self.health.record_failure()
+            metrics.solver_fallback_total.inc("backend_error")
+            raise SolverUnavailable(f"solver backend fault: {e!r}") from e
+        if len(out) != expect:
+            self.health.record_failure()
+            metrics.solver_fallback_total.inc("backend_error")
+            raise SolverUnavailable(
+                f"solver backend returned {len(out)} arrays, "
+                f"expected {expect}")
+        return out
+
+    def _check_plan(self, problem: SolverProblem, admitted, opt,
+                    admit_round, parked, victim_reason=None, rounds=None,
+                    full: bool = False) -> None:
+        """Sanity-guard an imported plan BEFORE any store mutation.
+
+        A divergent plan — wrong shapes/dtypes, out-of-bounds flavor
+        options, admissions/parkings of null or padding rows — is a
+        backend fault: the whole plan is rejected (store untouched, the
+        breaker incremented when remote) rather than committed as
+        corrupt state. Committed usage is always recomputed host-side
+        from the store's own request vectors, so quota arithmetic can
+        never be driven by imported tensors; this guard closes the
+        remaining index/flag surface.
+        """
+        fault = self._plan_fault(problem, admitted, opt, admit_round,
+                                 parked, victim_reason, rounds, full)
+        if fault is None:
+            if self.remote is not None:
+                self.health.record_success()
+            return
+        metrics.solver_plan_rejected_total.inc()
+        if self.remote is not None:
+            self.health.record_failure()
+            metrics.solver_fallback_total.inc("plan_rejected")
+        raise SolverUnavailable(f"divergent solver plan rejected: {fault}")
+
+    @staticmethod
+    def _plan_fault(problem: SolverProblem, admitted, opt, admit_round,
+                    parked, victim_reason, rounds,
+                    full: bool) -> Optional[str]:
+        """Reason the plan is unusable, or None if it checks out."""
+        W1 = problem.wl_cqid.shape[0]
+        W = W1 - 1
+        C = problem.n_cqs
+        for name, arr in (("admitted", admitted), ("parked", parked),
+                          ("admit_round", admit_round)):
+            if arr.ndim != 1 or arr.shape[0] != W1:
+                return f"{name} shape {arr.shape} != ({W1},)"
+        if victim_reason is not None:
+            if victim_reason.ndim != 1 or victim_reason.shape[0] != W1:
+                return (f"victim_reason shape {victim_reason.shape} "
+                        f"!= ({W1},)")
+            # the eviction loop calls int(victim_reason[w]) BEFORE other
+            # guards could fire — a non-integral dtype must fail here,
+            # not mid-apply after evictions committed
+            if not (victim_reason.dtype == np.bool_
+                    or np.issubdtype(victim_reason.dtype, np.integer)):
+                return (f"victim_reason dtype {victim_reason.dtype} "
+                        "is not integral")
+        want_opt_ndim = 2 if full else 1
+        if opt.ndim != want_opt_ndim or opt.shape[0] != W1:
+            return f"opt shape {opt.shape} incompatible with ({W1}, ...)"
+        for name, arr in (("opt", opt), ("admit_round", admit_round)):
+            if not np.issubdtype(arr.dtype, np.integer):
+                return f"{name} dtype {arr.dtype} is not integral"
+        for name, arr in (("admitted", admitted), ("parked", parked)):
+            if not (arr.dtype == np.bool_
+                    or np.issubdtype(arr.dtype, np.integer)):
+                return f"{name} dtype {arr.dtype} is not a flag"
+        if rounds is not None:
+            r = np.asarray(rounds)
+            if r.size != 1 or not (
+                    r.dtype == np.bool_
+                    or np.issubdtype(r.dtype, np.integer)):
+                return f"rounds is not an integer scalar ({r.dtype}, " \
+                       f"size {r.size})"
+        cq = problem.wl_cqid[:W]
+        adm = admitted[:W].astype(bool)
+        prk = parked[:W].astype(bool)
+        if bool((cq[adm] >= C).any()):
+            return "plan admits a null/padding row"
+        if bool((cq[prk] >= C).any()):
+            return "plan parks a null/padding row"
+        if not full and bool((adm & prk).any()):
+            return "row both admitted and parked"
+        rnd = admit_round[:W]
+        floor = -1 if full else 0
+        if bool((rnd[adm] < floor).any()):
+            return f"admitted row with admit_round below {floor}"
+        # flavor-option decode bounds, only for rows the apply path
+        # actually decodes (full: newly admitted rows, admit_round >= 0)
+        n_opt = np.array(
+            [len(problem.cq_option_flavors[name])
+             for name in problem.cq_names], dtype=np.int64)
+        decode = adm & (rnd >= 0) if full else adm
+        if not decode.any():
+            return None
+        cq_d = cq[decode]
+        if full:
+            ng = problem.cq_ngroups
+            if ng is None:
+                ng = np.ones(C, dtype=np.int64)
+            need_g = int(ng[cq_d].max())
+            if opt.shape[1] < need_g:
+                return (f"opt group axis {opt.shape[1]} narrower than "
+                        f"{need_g} resource groups")
+            rows = opt[:W][decode]
+            used = np.arange(opt.shape[1])[None, :] < ng[cq_d][:, None]
+            bad = used & ((rows < 0) | (rows >= n_opt[cq_d][:, None]))
+            if bool(bad.any()):
+                return "flavor option index out of range"
+        else:
+            o = opt[:W][decode]
+            if bool(((o < 0) | (o >= n_opt[cq_d])).any()):
+                return "flavor option index out of range"
+        return None
 
     # -- plan application --------------------------------------------------
 
@@ -547,8 +702,8 @@ class SolverEngine:
         t0 = time.monotonic()
         if self.remote is not None:
             (admitted, opt, admit_round, parked, rounds, _usage,
-             _wl_usage, victim_reason) = self.remote.solve(
-                problem, full=True, g_max=g_max, h_max=h_max,
+             _wl_usage, victim_reason) = self._remote_solve(
+                problem, 8, full=True, g_max=g_max, h_max=h_max,
                 p_max=p_max, fs_enabled=self.enable_fair_sharing)
         else:
             tensors = to_device_full(problem)
@@ -561,6 +716,11 @@ class SolverEngine:
         admit_round = np.asarray(admit_round)
         parked = np.asarray(parked)
         victim_reason = np.asarray(victim_reason)
+        if self.remote is not None:
+            # imported plans only (see the lean drain's note)
+            self._check_plan(problem, admitted, opt, admit_round,
+                             parked, victim_reason=victim_reason,
+                             rounds=rounds, full=True)
         result.rounds = int(rounds)
         result.solver_time_s = time.monotonic() - t0
         metrics.solver_cycle_duration_seconds.observe(

@@ -1,0 +1,110 @@
+"""Resilience primitives for the solver backend.
+
+The TPU solver is an *opt-in* backend: the host BestEffortFIFO cycle is
+the reference behavior, and the control plane must survive the solver
+sidecar crashing, hanging, or returning garbage without ever stalling an
+admission round (ROADMAP north star; Aryl/Gavel treat scheduler-backend
+failure as a first-class event for the same reason — a stalled admission
+loop starves the whole cluster).
+
+Two pieces live here:
+
+- ``SolverUnavailable`` — the single fault type the scheduler routing
+  sees. Transport errors, exhausted deadlines, server-reported failures,
+  and sanity-guard plan rejections all collapse into it; the scheduler's
+  reaction is always the same (degrade to the host cycle).
+- ``SolverHealth`` — a closed → open → half-open circuit breaker. The
+  engine consults ``allow()`` before touching the remote backend,
+  records each outcome, and a tripped breaker short-circuits drains to
+  the host path until a cooldown expires; then a single probe call
+  either closes the breaker or re-opens it for another cooldown.
+
+The clock is injected so breaker tests (and the chaos harness) run with
+a fake clock — no sleeps.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kueue_oss_tpu import metrics
+
+#: breaker states (exported for tests/metrics; gauge encodes the index)
+CLOSED = "closed"
+HALF_OPEN = "half-open"
+OPEN = "open"
+
+_STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class SolverUnavailable(Exception):
+    """The solver backend cannot produce a usable plan right now.
+
+    Raised by SolverClient after retries/deadline are exhausted, by the
+    engine when the breaker is open or the imported plan fails the
+    sanity guard. The scheduler treats it exactly like an unsupported
+    problem shape: the admission round completes on the host path.
+    """
+
+
+class SolverHealth:
+    """Circuit breaker over the remote solver backend.
+
+    closed     -- calls flow; ``failure_threshold`` consecutive failures
+                  trip the breaker open.
+    open       -- calls are refused without touching the socket until
+                  ``cooldown_s`` has elapsed.
+    half-open  -- after the cooldown one probe call is allowed; success
+                  closes the breaker, failure re-opens it (and restarts
+                  the cooldown).
+
+    Single-threaded by design: the scheduler loop is the only caller, so
+    allow()/record_*() pairs never interleave.
+    """
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 30.0,
+                 clock=time.monotonic) -> None:
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.cooldown_s = cooldown_s
+        self.clock = clock
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        #: total closed/half-open -> open transitions (mirrors the
+        #: kueue_tpu_solver_breaker_trips_total counter)
+        self.trips = 0
+        self._opened_at = 0.0
+        # the state gauge is written only on TRANSITIONS: SolverEngine
+        # default-constructs a SolverHealth per instance, and a fresh
+        # (closed) breaker must not overwrite the gauge while another
+        # engine's live breaker is open
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        metrics.solver_breaker_state.set(value=_STATE_CODE[state])
+
+    def allow(self) -> bool:
+        """Whether a remote call may be attempted right now."""
+        if self.state == OPEN:
+            if self.clock() - self._opened_at >= self.cooldown_s:
+                self._set_state(HALF_OPEN)  # next call is the probe
+                return True
+            return False
+        return True
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.state != CLOSED:
+            self._set_state(CLOSED)
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if (self.state == HALF_OPEN
+                or self.consecutive_failures >= self.failure_threshold):
+            self._trip()
+
+    def _trip(self) -> None:
+        if self.state != OPEN:
+            self.trips += 1
+            metrics.solver_breaker_trips_total.inc()
+        self._opened_at = self.clock()
+        self._set_state(OPEN)

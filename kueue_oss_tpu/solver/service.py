@@ -17,6 +17,19 @@ what a gRPC stub would generate; no proto toolchain is assumed in the
 image). The client side plugs into SolverEngine via `remote=`: the
 engine still exports, verifies, and commits — only the solve itself
 crosses the process boundary.
+
+Resilience (this layer's failure contract):
+
+- a truncated frame, EOF mid-frame, undecodable header/npz, or a frame
+  above ``max_frame_bytes`` raises ``SolverProtocolError`` — never a
+  confusing struct/zipfile error, and never an allocation sized by an
+  attacker-controlled length prefix;
+- ``SolverClient.solve`` runs under a per-call deadline with bounded
+  retries (exponential backoff + seeded jitter, fresh connection per
+  attempt = automatic reconnect) and collapses exhaustion into
+  ``SolverUnavailable`` for the engine/breaker to act on;
+- the server catches solve-side exceptions and reports them in-band
+  (``{"ok": false}``) so one bad request cannot wedge a handler thread.
 """
 
 from __future__ import annotations
@@ -25,14 +38,18 @@ import dataclasses
 import io
 import json
 import os
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
+from kueue_oss_tpu import metrics
+from kueue_oss_tpu.solver.resilience import SolverUnavailable
 from kueue_oss_tpu.solver.tensors import SolverProblem
 
 #: SolverProblem fields shipped as arrays; the rest go in the header
@@ -45,6 +62,34 @@ _ARRAY_FIELDS = [
 _META_FIELDS = ["n_resources", "ts_evict_base", "admit_rank_base", "scale"]
 
 
+class SolverProtocolError(ConnectionError):
+    """Garbled wire state: short read/EOF mid-frame, oversized frame, or
+    an undecodable header/payload. Distinct from plain ConnectionError so
+    callers can tell a *misbehaving* peer from an absent one."""
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def default_timeout_s() -> float:
+    """Per-call deadline; KUEUE_SOLVER_TIMEOUT_S overrides the 600 s
+    default (the pre-robustness hardcode) without a code change."""
+    return _env_float("KUEUE_SOLVER_TIMEOUT_S", 600.0)
+
+
+def default_max_frame_bytes() -> int:
+    """Frame-size guard; KUEUE_SOLVER_MAX_FRAME_MB overrides 256 MiB.
+    Checked BEFORE allocating, on both sides of the wire."""
+    return int(_env_float("KUEUE_SOLVER_MAX_FRAME_MB", 256.0) * (1 << 20))
+
+
 def _send(sock: socket.socket, header: dict, blob: bytes) -> None:
     h = json.dumps(header).encode()
     sock.sendall(struct.pack(">II", len(h), len(blob)))
@@ -52,20 +97,50 @@ def _send(sock: socket.socket, header: dict, blob: bytes) -> None:
     sock.sendall(blob)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None,
+                clock=time.monotonic) -> bytes:
+    """Read exactly n bytes; with ``deadline`` (absolute, in ``clock``
+    units) the whole read is bounded, not just each recv: a peer
+    dripping one byte per op-timeout would otherwise reset the clock on
+    every chunk and stall far past the caller's budget."""
     buf = b""
     while len(buf) < n:
+        if deadline is not None:
+            remaining = deadline - clock()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"deadline exhausted mid-frame: got {len(buf)} of "
+                    f"{n} bytes")
+            sock.settimeout(remaining)
         chunk = sock.recv(n - len(buf))
         if not chunk:
-            raise ConnectionError("peer closed")
+            raise SolverProtocolError(
+                f"peer closed mid-frame: got {len(buf)} of {n} bytes")
         buf += chunk
     return buf
 
 
-def _recv(sock: socket.socket) -> tuple[dict, bytes]:
-    hlen, blen = struct.unpack(">II", _recv_exact(sock, 8))
-    header = json.loads(_recv_exact(sock, hlen))
-    return header, _recv_exact(sock, blen)
+def _recv(sock: socket.socket,
+          max_frame_bytes: Optional[int] = None,
+          deadline: Optional[float] = None,
+          clock=time.monotonic) -> tuple[dict, bytes]:
+    if max_frame_bytes is None:
+        max_frame_bytes = default_max_frame_bytes()
+    hlen, blen = struct.unpack(
+        ">II", _recv_exact(sock, 8, deadline, clock))
+    if hlen + blen > max_frame_bytes:
+        # reject before allocating: the length prefix is peer-controlled
+        raise SolverProtocolError(
+            f"frame of {hlen + blen} bytes exceeds the "
+            f"{max_frame_bytes}-byte limit")
+    try:
+        header = json.loads(_recv_exact(sock, hlen, deadline, clock))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise SolverProtocolError(f"undecodable frame header: {e}") from e
+    if not isinstance(header, dict):
+        raise SolverProtocolError("frame header is not a JSON object")
+    return header, _recv_exact(sock, blen, deadline, clock)
 
 
 def serialize_problem(p: SolverProblem) -> tuple[dict, bytes]:
@@ -88,49 +163,88 @@ def deserialize_problem(meta: dict, blob: bytes) -> SolverProblem:
     return SolverProblem(**kwargs)
 
 
+def solve_request(header: dict, blob: bytes) -> tuple[dict, bytes]:
+    """Run one solve for a decoded request; returns (header, npz blob).
+
+    Shared by the production handler and the chaos harness (which wraps
+    it to corrupt/delay/drop the response deterministically).
+    """
+    problem = deserialize_problem(header["meta"], blob)
+    if header["full"]:
+        from kueue_oss_tpu.solver.full_kernels import (
+            solve_backlog_full,
+            to_device_full,
+        )
+
+        out = solve_backlog_full(
+            to_device_full(problem), header["g_max"],
+            header["h_max"], header["p_max"],
+            fs_enabled=header["fs_enabled"])
+        names = ["admitted", "opt", "admit_round", "parked",
+                 "rounds", "usage", "wl_usage", "victim_reason"]
+    else:
+        from kueue_oss_tpu.solver.kernels import (
+            solve_backlog,
+            to_device,
+        )
+
+        out = solve_backlog(to_device(problem))
+        names = ["admitted", "opt", "admit_round", "parked",
+                 "rounds", "usage"]
+    buf = io.BytesIO()
+    np.savez(buf, **{n: np.asarray(v) for n, v in zip(names, out)})
+    return {"ok": True, "names": names}, buf.getvalue()
+
+
+def respond(sock: socket.socket, header: dict, blob: bytes) -> None:
+    """Solve a decoded request and reply on ``sock``; solve-side
+    exceptions are reported in-band, a vanished client is ignored.
+    Shared by the production handler and the chaos harness's healthy
+    tail, so the two cannot drift apart."""
+    try:
+        resp_header, resp_blob = solve_request(header, blob)
+    except Exception as e:  # report in-band; don't wedge the thread
+        resp_header, resp_blob = {"ok": False, "error": repr(e)}, b""
+    try:
+        _send(sock, resp_header, resp_blob)
+    except OSError:
+        return  # client gave up (deadline) mid-response
+
+
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self) -> None:
         try:
-            header, blob = _recv(self.request)
-        except ConnectionError:
-            return
-        problem = deserialize_problem(header["meta"], blob)
-        if header["full"]:
-            from kueue_oss_tpu.solver.full_kernels import (
-                solve_backlog_full,
-                to_device_full,
-            )
-
-            out = solve_backlog_full(
-                to_device_full(problem), header["g_max"],
-                header["h_max"], header["p_max"],
-                fs_enabled=header["fs_enabled"])
-            names = ["admitted", "opt", "admit_round", "parked",
-                     "rounds", "usage", "wl_usage", "victim_reason"]
-        else:
-            from kueue_oss_tpu.solver.kernels import (
-                solve_backlog,
-                to_device,
-            )
-
-            out = solve_backlog(to_device(problem))
-            names = ["admitted", "opt", "admit_round", "parked",
-                     "rounds", "usage"]
-        buf = io.BytesIO()
-        np.savez(buf, **{n: np.asarray(v) for n, v in zip(names, out)})
-        _send(self.request, {"ok": True, "names": names}, buf.getvalue())
+            # the read is deadline-bounded: a client that stalls
+            # mid-frame must not pin this handler thread forever (the
+            # server joins handler threads on close)
+            header, blob = _recv(
+                self.request, self.server.max_frame_bytes,
+                deadline=time.monotonic() + self.server.read_timeout_s)
+        except (ConnectionError, TimeoutError):
+            return  # covers SolverProtocolError: drop the bad request
+        respond(self.request, header, blob)
 
 
 class SolverServer(socketserver.ThreadingUnixStreamServer):
     """The sidecar process body: `SolverServer(path).serve_forever()`."""
 
     allow_reuse_address = True
+    # handler threads must not block process exit: a wedged client
+    # connection would otherwise hang server_close() (block_on_close
+    # joins non-daemon handler threads)
+    daemon_threads = True
 
-    def __init__(self, socket_path: str) -> None:
+    def __init__(self, socket_path: str,
+                 max_frame_bytes: Optional[int] = None,
+                 read_timeout_s: Optional[float] = None) -> None:
         if os.path.exists(socket_path):
             os.unlink(socket_path)
         super().__init__(socket_path, _Handler)
         self.socket_path = socket_path
+        self.max_frame_bytes = (max_frame_bytes if max_frame_bytes
+                                is not None else default_max_frame_bytes())
+        self.read_timeout_s = (read_timeout_s if read_timeout_s
+                               is not None else default_timeout_s())
 
     def serve_in_background(self) -> threading.Thread:
         t = threading.Thread(target=self.serve_forever, daemon=True)
@@ -139,25 +253,132 @@ class SolverServer(socketserver.ThreadingUnixStreamServer):
 
 
 class SolverClient:
-    """Engine-side stub: SolverEngine(remote=SolverClient(path))."""
+    """Engine-side stub: SolverEngine(remote=SolverClient(path)).
 
-    def __init__(self, socket_path: str, timeout_s: float = 600.0) -> None:
+    Every ``solve`` runs under a per-call deadline (``timeout_s``) with
+    up to ``max_retries`` re-attempts on transport faults. Each attempt
+    opens a fresh connection (automatic reconnect after a sidecar
+    restart) and backs off exponentially with seeded jitter between
+    attempts. Exhaustion — deadline or retries — raises
+    ``SolverUnavailable`` for the engine's circuit breaker.
+
+    ``clock``/``sleep`` are injectable so the chaos tests drive the
+    deadline/backoff logic without real waiting.
+    """
+
+    def __init__(self, socket_path: str,
+                 timeout_s: Optional[float] = None,
+                 max_retries: int = 2,
+                 backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 2.0,
+                 max_frame_bytes: Optional[int] = None,
+                 jitter_seed: int = 0,
+                 clock=time.monotonic,
+                 sleep=time.sleep) -> None:
         self.socket_path = socket_path
-        self.timeout_s = timeout_s
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else default_timeout_s())
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.max_frame_bytes = (max_frame_bytes if max_frame_bytes
+                                is not None else default_max_frame_bytes())
+        self._rng = random.Random(jitter_seed)
+        self._clock = clock
+        self._sleep = sleep
+
+    @classmethod
+    def from_config(cls, cfg) -> "SolverClient":
+        """Build from a config.SolverBackendConfig."""
+        if cfg.socket_path is None:
+            raise ValueError("solver.socketPath is required for a remote "
+                             "solver backend")
+        return cls(cfg.socket_path,
+                   timeout_s=cfg.timeout_seconds,
+                   max_retries=cfg.max_retries,
+                   backoff_base_s=cfg.retry_backoff_base_seconds,
+                   backoff_max_s=cfg.retry_backoff_max_seconds,
+                   max_frame_bytes=cfg.max_frame_bytes)
 
     def solve(self, problem: SolverProblem, *, full: bool,
               g_max: int = 1, h_max: int = 32, p_max: int = 128,
               fs_enabled: bool = False):
         meta, blob = serialize_problem(problem)
+        header = {"meta": meta, "full": full, "g_max": g_max,
+                  "h_max": h_max, "p_max": p_max,
+                  "fs_enabled": fs_enabled}
+        # enforce the frame guard on our OWN request too: a server-side
+        # rejection of an oversized frame shows up as a reset/EOF and
+        # would be misread as a transient connection fault and retried
+        # (deterministically) every drain
+        n_frame = len(json.dumps(header).encode()) + len(blob)
+        if n_frame > self.max_frame_bytes:
+            raise SolverUnavailable(
+                f"request frame of {n_frame} bytes exceeds the "
+                f"{self.max_frame_bytes}-byte limit (problem too large "
+                "for the remote backend)")
+        deadline = self._clock() + self.timeout_s
+        attempt = 0
+        last_err: Optional[BaseException] = None
+        while True:
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                metrics.solver_deadline_exceeded_total.inc()
+                raise SolverUnavailable(
+                    f"solver call deadline ({self.timeout_s}s) exhausted "
+                    f"after {attempt} attempt(s): {last_err!r}"
+                ) from last_err
+            try:
+                return self._solve_once(header, blob, remaining)
+            except (TimeoutError, socket.timeout) as e:
+                last_err = e
+                metrics.solver_remote_failures_total.inc("timeout")
+            except SolverProtocolError as e:
+                last_err = e
+                metrics.solver_remote_failures_total.inc("protocol")
+            except OSError as e:  # conn refused/reset, missing socket, …
+                last_err = e
+                metrics.solver_remote_failures_total.inc("connection")
+            attempt += 1
+            if attempt > self.max_retries:
+                raise SolverUnavailable(
+                    f"solver call failed after {attempt} attempt(s): "
+                    f"{last_err!r}") from last_err
+            metrics.solver_remote_retries_total.inc()
+            delay = min(self.backoff_base_s * (2 ** (attempt - 1)),
+                        self.backoff_max_s)
+            delay += self._rng.uniform(0, delay)  # full jitter
+            delay = min(delay, max(0.0, deadline - self._clock()))
+            if delay > 0:
+                self._sleep(delay)
+
+    def _solve_once(self, header: dict, blob: bytes, budget_s: float):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.settimeout(self.timeout_s)
+        sock.settimeout(budget_s)  # bounds connect and the send as ops
+        op_deadline = self._clock() + budget_s
         try:
             sock.connect(self.socket_path)
-            _send(sock, {"meta": meta, "full": full, "g_max": g_max,
-                         "h_max": h_max, "p_max": p_max,
-                         "fs_enabled": fs_enabled}, blob)
-            header, body = _recv(sock)
+            _send(sock, header, blob)
+            # the WHOLE response read shares one deadline — a slow-drip
+            # peer must not reset the timer per chunk
+            resp, body = _recv(sock, self.max_frame_bytes,
+                               deadline=op_deadline, clock=self._clock)
         finally:
             sock.close()
-        data = np.load(io.BytesIO(body))
-        return tuple(data[n] for n in header["names"])
+        if not resp.get("ok", False):
+            # the sidecar is up but the solve itself failed; a retry
+            # would deterministically fail again, so don't burn the
+            # deadline on it
+            metrics.solver_remote_failures_total.inc("server")
+            raise SolverUnavailable(
+                f"solver sidecar reported failure: "
+                f"{resp.get('error', 'unknown')}")
+        names = resp.get("names")
+        if not isinstance(names, list) or not names:
+            raise SolverProtocolError("response header carries no names")
+        try:
+            data = np.load(io.BytesIO(body))
+            return tuple(data[n] for n in names)
+        except Exception as e:  # zipfile/np decode errors on corruption
+            raise SolverProtocolError(
+                f"undecodable plan payload: {e!r}") from e

@@ -34,6 +34,16 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running perf/scale tests (excluded from "
+        "the tier-1 run)")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests; the default subset is "
+        "deterministic (seeded injector, injected clocks) and runs in "
+        "tier-1")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

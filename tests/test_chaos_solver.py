@@ -1,0 +1,577 @@
+"""Fault-injected solver backend: the chaos harness proves the
+resilience layer.
+
+Failure model (docs/ROBUSTNESS.md): the solver sidecar can crash
+mid-request, hang, or return garbage — the control plane must complete
+every admission round on the host path, bounded by the client's
+per-call deadline, and a tripped circuit breaker must stop re-probing a
+dead sidecar until its cooldown expires. All tests here are
+deterministic: seeded injectors, explicit fault schedules, injected
+clocks for the breaker, injected sleep for the retry backoff.
+"""
+
+import os
+import socket
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from kueue_oss_tpu import metrics
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.chaos import (
+    CORRUPT_PLAN,
+    CRASH,
+    CRASH_PRE,
+    GARBLE,
+    HANG,
+    OK,
+    SLOW,
+    TRUNCATE,
+    ChaosSolverServer,
+    FaultInjector,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.solver.engine import SolverEngine
+from kueue_oss_tpu.solver.resilience import (
+    CLOSED,
+    OPEN,
+    SolverHealth,
+    SolverUnavailable,
+)
+from kueue_oss_tpu.solver.service import (
+    SolverClient,
+    SolverProtocolError,
+    SolverServer,
+    _recv,
+    _recv_exact,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# scenario plumbing (the solver-routing test shape: 4 CQs x 8 cpu)
+# ---------------------------------------------------------------------------
+
+
+def _store(n_cqs=4, quota=8):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="f"))
+    for i in range(n_cqs):
+        store.upsert_cluster_queue(ClusterQueue(
+            name=f"cq{i}",
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f", resources=[
+                    ResourceQuota(name="cpu", nominal=quota)])])]))
+        store.upsert_local_queue(LocalQueue(
+            name=f"lq{i}", cluster_queue=f"cq{i}"))
+    return store
+
+
+def _flood(store, n, start=0):
+    for i in range(start, start + n):
+        store.add_workload(Workload(
+            name=f"w{i}", queue_name=f"lq{i % 4}", uid=i + 1,
+            creation_time=float(i),
+            podsets=[PodSet(name="main", count=1, requests={"cpu": 1})]))
+
+
+def _sock_path():
+    return os.path.join(tempfile.mkdtemp(), "solver.sock")
+
+
+def _client(path, timeout_s=5.0, retries=2):
+    # injected no-op sleep: backoff logic runs, the test doesn't wait
+    return SolverClient(path, timeout_s=timeout_s, max_retries=retries,
+                        backoff_base_s=0.001, sleep=lambda _s: None)
+
+
+@pytest.fixture()
+def chaos_env():
+    """store + queues + a chaos server whose injector tests configure."""
+    servers = []
+
+    def make(schedule=(), weights=None, seed=0, n_wl=24, **client_kw):
+        store = _store()
+        _flood(store, n_wl)
+        queues = QueueManager(store)
+        path = _sock_path()
+        injector = FaultInjector(schedule=schedule, weights=weights,
+                                 seed=seed)
+        srv = ChaosSolverServer(path, injector)
+        srv.serve_in_background()
+        servers.append(srv)
+        engine = SolverEngine(store, queues,
+                              remote=_client(path, **client_kw))
+        return store, queues, engine, injector
+
+    yield make
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _admitted(store):
+    return {k for k, w in store.workloads.items() if w.is_quota_reserved}
+
+
+def _host_only_admitted(n_wl=24):
+    store = _store()
+    _flood(store, n_wl)
+    queues = QueueManager(store)
+    Scheduler(store, queues).run_until_quiet(now=0.0, tick=1.0)
+    return _admitted(store)
+
+
+# ---------------------------------------------------------------------------
+# protocol-level guards (satellite: _recv_exact / frame-size)
+# ---------------------------------------------------------------------------
+
+
+def test_short_read_raises_protocol_error():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"1234")
+        a.close()
+        with pytest.raises(SolverProtocolError, match="mid-frame"):
+            _recv_exact(b, 8)
+    finally:
+        b.close()
+
+
+def test_oversized_frame_rejected_before_allocating():
+    a, b = socket.socketpair()
+    try:
+        import struct
+
+        a.sendall(struct.pack(">II", 8, 0xFFFF_FF00))
+        with pytest.raises(SolverProtocolError, match="exceeds"):
+            _recv(b, max_frame_bytes=1 << 20)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_garbage_header_raises_protocol_error():
+    a, b = socket.socketpair()
+    try:
+        import struct
+
+        a.sendall(struct.pack(">II", 4, 0) + b"\xff\xfe{!")
+        with pytest.raises(SolverProtocolError, match="header"):
+            _recv(b, max_frame_bytes=1 << 20)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_slow_drip_bounded_by_one_shared_deadline():
+    """A peer dripping chunks must not reset the timer per recv: the
+    whole frame read shares one absolute deadline (injected clock —
+    the TimeoutError fires without any real waiting)."""
+    a, b = socket.socketpair()
+    try:
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.6
+            return t[0]
+
+        a.sendall(b"1234")  # 4 of the 8 requested bytes, then silence
+        with pytest.raises(TimeoutError, match="deadline exhausted"):
+            _recv_exact(b, 8, deadline=1.0, clock=clock)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_client_timeout_configurable_from_env(monkeypatch):
+    monkeypatch.setenv("KUEUE_SOLVER_TIMEOUT_S", "7.5")
+    monkeypatch.setenv("KUEUE_SOLVER_MAX_FRAME_MB", "1")
+    c = SolverClient("/nonexistent.sock")
+    assert c.timeout_s == 7.5
+    assert c.max_frame_bytes == 1 << 20
+    # explicit args always win over the environment
+    c2 = SolverClient("/nonexistent.sock", timeout_s=3.0,
+                      max_frame_bytes=64)
+    assert c2.timeout_s == 3.0 and c2.max_frame_bytes == 64
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine (injected clock, no sleeps)
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_state_machine():
+    now = [0.0]
+    h = SolverHealth(failure_threshold=3, cooldown_s=10.0,
+                     clock=lambda: now[0])
+    assert h.state == CLOSED and h.allow()
+    h.record_failure()
+    h.record_failure()
+    assert h.state == CLOSED, "below threshold stays closed"
+    h.record_failure()
+    assert h.state == OPEN and h.trips == 1
+    assert not h.allow(), "open refuses while cooling down"
+    now[0] = 9.9
+    assert not h.allow()
+    now[0] = 10.0
+    assert h.allow(), "cooldown expiry allows one probe"
+    h.record_failure()  # probe failed
+    assert h.state == OPEN and h.trips == 2
+    now[0] = 25.0
+    assert h.allow()
+    h.record_success()  # probe succeeded
+    assert h.state == CLOSED and h.consecutive_failures == 0
+    h.record_failure()
+    assert h.state == CLOSED, "failure count reset by the success"
+
+
+# ---------------------------------------------------------------------------
+# client retry / reconnect behavior
+# ---------------------------------------------------------------------------
+
+
+def test_crash_then_ok_retries_and_succeeds(chaos_env):
+    retries0 = metrics.solver_remote_retries_total.total()
+    store, queues, engine, injector = chaos_env(schedule=[CRASH, OK])
+    result = engine.drain(now=0.0)
+    assert result.admitted == 24
+    assert injector.injected == {CRASH: 1, OK: 1}
+    assert metrics.solver_remote_retries_total.total() == retries0 + 1
+    assert engine.health.state == CLOSED
+
+
+@pytest.mark.parametrize("fault", [CRASH_PRE, TRUNCATE, GARBLE])
+def test_transport_faults_recover_on_retry(chaos_env, fault):
+    store, queues, engine, injector = chaos_env(schedule=[fault, OK])
+    result = engine.drain(now=0.0)
+    assert result.admitted == 24
+    assert injector.injected[OK] == 1
+
+
+def test_retries_exhausted_raises_solver_unavailable(chaos_env):
+    store, queues, engine, _ = chaos_env(
+        schedule=[CRASH, CRASH, CRASH], retries=2)
+    with pytest.raises(SolverUnavailable):
+        engine.drain(now=0.0)
+    assert engine.health.consecutive_failures == 1, \
+        "one drain = one breaker-visible failure, however many retries"
+    assert _admitted(store) == set()
+
+
+def test_hang_bounded_by_deadline(chaos_env):
+    store, queues, engine, _ = chaos_env(
+        weights={HANG: 1}, timeout_s=0.3, retries=0)
+    t0 = time.monotonic()
+    with pytest.raises(SolverUnavailable):
+        engine.drain(now=0.0)
+    assert time.monotonic() - t0 < 5.0, \
+        "a hung sidecar must not stall past the configured deadline"
+
+
+def test_slow_response_within_deadline_succeeds(chaos_env):
+    store, queues, engine, injector = chaos_env(
+        schedule=[SLOW], timeout_s=10.0)
+    injector.slow_s = 0.05
+    result = engine.drain(now=0.0)
+    assert result.admitted == 24
+
+
+# ---------------------------------------------------------------------------
+# plan-sanity guard (acceptance: corrupt plans rejected, store unchanged)
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_plan_rejected_store_unchanged(chaos_env):
+    rejected0 = metrics.solver_plan_rejected_total.total()
+    store, queues, engine, _ = chaos_env(schedule=[CORRUPT_PLAN],
+                                         retries=0)
+    fp_before = queues.membership_fingerprint()
+    with pytest.raises(SolverUnavailable, match="divergent"):
+        engine.drain(now=0.0)
+    assert _admitted(store) == set(), "no corrupt admission committed"
+    assert queues.membership_fingerprint() == fp_before
+    assert metrics.solver_plan_rejected_total.total() == rejected0 + 1
+    assert engine.health.consecutive_failures == 1, \
+        "a divergent plan is a backend fault and counts on the breaker"
+
+
+def test_plan_fault_catches_out_of_bounds_and_padding_rows():
+    store = _store()
+    _flood(store, 6)
+    queues = QueueManager(store)
+    engine = SolverEngine(store, queues)
+    problem, _ = engine.export()
+    from kueue_oss_tpu.solver.tensors import pad_workloads
+
+    problem = pad_workloads(problem, 8)
+    W1 = problem.wl_cqid.shape[0]
+    ok_adm = np.zeros(W1, dtype=bool)
+    ok_adm[:6] = True
+    opt = np.zeros(W1, dtype=np.int32)
+    rnd = np.zeros(W1, dtype=np.int32)
+    parked = np.zeros(W1, dtype=bool)
+    good = engine._plan_fault(problem, ok_adm, opt, rnd, parked,
+                              None, np.int32(1), False)
+    assert good is None
+    # padding row admitted
+    bad = ok_adm.copy()
+    bad[7] = True
+    assert "null/padding" in engine._plan_fault(
+        problem, bad, opt, rnd, parked, None, np.int32(1), False)
+    # flavor option out of range
+    bad_opt = opt.copy()
+    bad_opt[2] = 99
+    assert "option index" in engine._plan_fault(
+        problem, ok_adm, bad_opt, rnd, parked, None, np.int32(1), False)
+    # wrong shape
+    assert "shape" in engine._plan_fault(
+        problem, ok_adm[:4], opt, rnd, parked, None, np.int32(1), False)
+    # float-typed plan array
+    assert "not integral" in engine._plan_fault(
+        problem, ok_adm, opt.astype(np.float32), rnd, parked, None,
+        np.int32(1), False)
+    # admitted and parked overlap (lean plans keep them disjoint)
+    bad_park = parked.copy()
+    bad_park[1] = True
+    assert "both admitted and parked" in engine._plan_fault(
+        problem, ok_adm, opt, rnd, bad_park, None, np.int32(1), False)
+    # full-plan victim_reason with a non-integral dtype must fail the
+    # guard, not int() mid-apply after evictions were committed
+    opt2 = np.zeros((W1, 1), dtype=np.int32)
+    vr_float = np.zeros(W1, dtype=np.float32)
+    assert "victim_reason dtype" in engine._plan_fault(
+        problem, ok_adm, opt2, rnd, parked, vr_float, np.int32(1), True)
+
+
+# ---------------------------------------------------------------------------
+# breaker wiring through the engine + scheduler routing
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trips_then_probe_untrips():
+    """Dead sidecar trips the breaker; drains stop touching the socket;
+    after the (fake-clock) cooldown one probe against a revived sidecar
+    closes it again."""
+    fails0 = metrics.solver_remote_failures_total.total()
+    trips0 = metrics.solver_breaker_trips_total.total()
+    now = [0.0]
+    store = _store()
+    _flood(store, 24)
+    queues = QueueManager(store)
+    path = _sock_path()  # nothing listening: connect fails instantly
+    health = SolverHealth(failure_threshold=3, cooldown_s=30.0,
+                          clock=lambda: now[0])
+    engine = SolverEngine(store, queues, health=health,
+                          remote=_client(path, retries=0))
+    for _ in range(3):
+        with pytest.raises(SolverUnavailable):
+            engine.drain(now=0.0)
+    assert health.state == OPEN
+    assert metrics.solver_breaker_trips_total.total() == trips0 + 1
+    fails_at_trip = metrics.solver_remote_failures_total.total()
+    assert fails_at_trip == fails0 + 3
+    # open breaker: refused without a connection attempt
+    with pytest.raises(SolverUnavailable, match="breaker"):
+        engine.drain(now=0.0)
+    assert metrics.solver_remote_failures_total.total() == fails_at_trip
+    # sidecar restarts; cooldown expires; the probe closes the breaker
+    srv = SolverServer(path)
+    srv.serve_in_background()
+    try:
+        now[0] = 31.0
+        result = engine.drain(now=0.0)
+        assert result.admitted == 24
+        assert health.state == CLOSED
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_scheduler_completes_round_on_host_when_sidecar_dead():
+    """Acceptance: killing the sidecar mid-drain must not stall or fail
+    the admission round — the host path finishes it with admitted-set
+    parity vs an uninjected host-only run."""
+    fallbacks0 = metrics.solver_fallback_total.total()
+    store = _store()
+    _flood(store, 24)
+    queues = QueueManager(store)
+    path = _sock_path()
+    injector = FaultInjector(weights={CRASH: 1}, seed=7)
+    srv = ChaosSolverServer(path, injector)
+    srv.serve_in_background()
+    try:
+        s = Scheduler(store, queues, solver_min_backlog=8)
+        engine = SolverEngine(store, queues, scheduler=s,
+                              remote=_client(path, retries=1))
+        s.solver = engine
+        s.run_until_quiet(now=0.0, tick=1.0)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    assert injector.faults_injected() >= 1, "the drain did hit the fault"
+    assert _admitted(store) == _host_only_admitted(24)
+    assert metrics.solver_fallback_total.total() > fallbacks0
+
+
+def test_scheduler_parity_under_mixed_fault_storm():
+    """Seeded storm of crashes, garbled frames, corrupt plans, and
+    healthy responses: the final admitted set must match the host-only
+    run exactly (solver successes and host fallbacks are equivalent)."""
+    store = _store()
+    _flood(store, 24)
+    queues = QueueManager(store)
+    path = _sock_path()
+    injector = FaultInjector(
+        weights={CRASH: 2, GARBLE: 1, TRUNCATE: 1, CORRUPT_PLAN: 1, OK: 3},
+        seed=42)
+    srv = ChaosSolverServer(path, injector)
+    srv.serve_in_background()
+    try:
+        s = Scheduler(store, queues, solver_min_backlog=8)
+        engine = SolverEngine(store, queues, scheduler=s,
+                              remote=_client(path, retries=1))
+        s.solver = engine
+        s.run_until_quiet(now=0.0, tick=1.0)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    assert _admitted(store) == _host_only_admitted(24)
+
+
+def test_breaker_open_routes_straight_to_host():
+    """A tripped breaker must not even attempt the socket: the drain
+    degrades instantly and host cycles admit everything."""
+    store = _store()
+    _flood(store, 24)
+    queues = QueueManager(store)
+    now = [0.0]
+    health = SolverHealth(failure_threshold=1, cooldown_s=1e9,
+                          clock=lambda: now[0])
+    health.record_failure()  # pre-tripped
+    assert health.state == OPEN
+    s = Scheduler(store, queues, solver_min_backlog=8)
+    engine = SolverEngine(store, queues, scheduler=s, health=health,
+                          remote=_client("/nonexistent.sock", retries=0))
+    s.solver = engine
+    fails0 = metrics.solver_remote_failures_total.total()
+    s.run_until_quiet(now=0.0, tick=1.0)
+    assert metrics.solver_remote_failures_total.total() == fails0, \
+        "open breaker means zero connection attempts"
+    assert _admitted(store) == _host_only_admitted(24)
+
+
+def test_auto_solver_honors_solver_config(monkeypatch):
+    """Configuration.solver drives the auto engine end to end: client
+    deadlines/retries and the breaker thresholds (the knobs must not be
+    config-file decoration)."""
+    from kueue_oss_tpu.config.configuration import SolverBackendConfig
+
+    monkeypatch.delenv("KUEUE_SOLVER_SOCKET", raising=False)
+    cfg = SolverBackendConfig(
+        socket_path="/tmp/cfg-solver.sock", timeout_seconds=12.0,
+        max_retries=5, breaker_failure_threshold=7,
+        breaker_cooldown_seconds=99.0)
+    store = _store()
+    queues = QueueManager(store)
+    s = Scheduler(store, queues, solver="auto", solver_config=cfg)
+    engine = s._solver_engine()
+    assert isinstance(engine.remote, SolverClient)
+    assert engine.remote.socket_path == "/tmp/cfg-solver.sock"
+    assert engine.remote.timeout_s == 12.0
+    assert engine.remote.max_retries == 5
+    assert engine.health.failure_threshold == 7
+    assert engine.health.cooldown_s == 99.0
+    # a programmatic socket path wins over a (possibly stale) env var;
+    # the env is a fallback for configs that leave socketPath unset
+    monkeypatch.setenv("KUEUE_SOLVER_SOCKET", "/tmp/env-solver.sock")
+    s2 = Scheduler(_store(), QueueManager(_store()), solver="auto",
+                   solver_config=cfg)
+    assert s2._solver_engine().remote.socket_path == "/tmp/cfg-solver.sock"
+    import dataclasses
+
+    s3 = Scheduler(_store(), QueueManager(_store()), solver="auto",
+                   solver_config=dataclasses.replace(cfg,
+                                                     socket_path=None))
+    assert s3._solver_engine().remote.socket_path == "/tmp/env-solver.sock"
+
+
+def test_auto_solver_picks_up_env_socket(monkeypatch):
+    path = _sock_path()
+    monkeypatch.setenv("KUEUE_SOLVER_SOCKET", path)
+    store = _store()
+    queues = QueueManager(store)
+    s = Scheduler(store, queues, solver="auto")
+    engine = s._solver_engine()
+    assert isinstance(engine.remote, SolverClient)
+    assert engine.remote.socket_path == path
+    monkeypatch.delenv("KUEUE_SOLVER_SOCKET")
+    s2 = Scheduler(_store(), QueueManager(_store()), solver="auto")
+    assert s2._solver_engine().remote is None
+
+
+def test_remote_engine_still_matches_local_under_clean_server():
+    """Regression guard: the resilience layer must not change the happy
+    path — remote and local drains produce the same plan."""
+    path = _sock_path()
+    srv = SolverServer(path)
+    srv.serve_in_background()
+    try:
+        store_l = _store()
+        _flood(store_l, 24)
+        queues_l = QueueManager(store_l)
+        SolverEngine(store_l, queues_l).drain(now=0.0)
+
+        store_r = _store()
+        _flood(store_r, 24)
+        queues_r = QueueManager(store_r)
+        engine = SolverEngine(store_r, queues_r, remote=_client(path))
+        engine.drain(now=0.0)
+        assert _admitted(store_r) == _admitted(store_l)
+        assert engine.health.state == CLOSED
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# node-flap injector (chaos side of the failure-recovery model)
+# ---------------------------------------------------------------------------
+
+
+def test_node_flap_injector_round_trips():
+    from kueue_oss_tpu.api.types import Node
+    from kueue_oss_tpu.chaos import NodeFlapInjector
+
+    store = Store()
+    for i in range(6):
+        store.upsert_node(Node(name=f"n{i}", labels={},
+                               allocatable={"cpu": 4}))
+    flapper = NodeFlapInjector(store, seed=3)
+    downed = flapper.flap_down(count=2)
+    assert len(downed) == 2
+    assert all(not store.nodes[n].ready for n in downed)
+    # the seed makes the victim choice reproducible
+    store2 = Store()
+    for i in range(6):
+        store2.upsert_node(Node(name=f"n{i}", labels={},
+                                allocatable={"cpu": 4}))
+    assert NodeFlapInjector(store2, seed=3).flap_down(count=2) == downed
+    restored = flapper.flap_up()
+    assert sorted(restored) == sorted(downed)
+    assert all(node.ready for node in store.nodes.values())

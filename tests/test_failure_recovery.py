@@ -194,6 +194,40 @@ def test_flapping_node_pruned_from_unhealthy_list():
         features.reset()
 
 
+def test_flap_inside_grace_period_is_forgotten_entirely():
+    """A node that goes NotReady and recovers INSIDE the grace period
+    must be dropped from the controller's _not_ready_since tracking and
+    trigger neither an eviction nor a replacement — the assignment is
+    untouched, as if the flap never happened."""
+    from kueue_oss_tpu.chaos import NodeFlapInjector
+
+    env = Env(grace=30.0)
+    wl = env.submit_and_admit()
+    hosts_before = env.assigned_hosts(wl)
+    victim = sorted(hosts_before)[0]
+    flapper = NodeFlapInjector(env.store, seed=1)
+    flapper.flap_down(names=[victim])
+    env.nfc.reconcile(env.t + 1)     # observed: the NotReady clock starts
+    assert victim in env.nfc._not_ready_since
+    flapper.flap_up()                # recovers at t+10, inside the grace
+    env.nfc.reconcile(env.t + 10)
+    assert victim not in env.nfc._not_ready_since, \
+        "recovery inside the grace period clears the tracking entry"
+    assert wl.status.unhealthy_nodes == []
+    # long after the original grace deadline: nothing fires
+    env.nfc.reconcile(env.t + 1000)
+    assert not wl.is_evicted
+    assert wl.is_admitted
+    assert env.assigned_hosts(wl) == hosts_before, \
+        "no replacement for a flap that healed in time"
+    # and the grace clock does NOT resume from the old observation: a
+    # fresh failure starts a fresh window
+    flapper.flap_down(names=[victim])
+    env.nfc.reconcile(env.t + 1001)
+    assert wl.status.unhealthy_nodes == []
+    assert env.assigned_hosts(wl) == hosts_before
+
+
 def test_preexisting_unhealthy_state_times_out_after_restart():
     """Regression: a restarted controller must still evict a workload whose
     unhealthy_nodes pre-date it, once the recovery timeout elapses."""

@@ -62,6 +62,31 @@ def test_problem_serialization_roundtrip():
     assert back.ts_evict_base == problem.ts_evict_base
 
 
+def test_server_reports_solve_errors_in_band(server):
+    """A request the sidecar cannot solve (garbage meta) must come back
+    as an in-band {"ok": false} — surfaced as SolverUnavailable without
+    burning the retry budget — and must not wedge the handler thread:
+    the same server serves the next good request."""
+    import socket
+
+    from kueue_oss_tpu.solver.service import _recv, _send
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(10.0)
+    try:
+        sock.connect(server)
+        _send(sock, {"meta": {"bogus": 1}, "full": False}, b"not-an-npz")
+        header, body = _recv(sock)
+    finally:
+        sock.close()
+    assert header["ok"] is False and "error" in header
+
+    store, queues = _setup(3)
+    engine = SolverEngine(store, queues, remote=SolverClient(server))
+    result = engine.drain(now=200.0)
+    assert result.admitted > 0, "server still healthy after the bad request"
+
+
 @pytest.mark.parametrize("seed", [3, 7])
 def test_remote_engine_matches_local(seed, server):
     store_l, queues_l = _setup(seed)
