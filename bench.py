@@ -693,6 +693,59 @@ def run_scenario(scenario: str) -> dict:
             **_degradation_counts(),
         }
 
+    if scenario == "recorder":
+        # flight-recorder overhead on the 50k x 1k host cycle-latency
+        # shape: identical twin stores run the same N host cycles with
+        # the recorder off, then on; the JSON tail reports the relative
+        # overhead (<2% acceptance bar, docs/OBSERVABILITY.md) plus the
+        # decision-event volume and per-reason skip counts the enabled
+        # run produced.
+        from kueue_oss_tpu import metrics as kmetrics
+        from kueue_oss_tpu import obs
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+        n_cycles = int(os.environ.get("BENCH_RECORDER_CYCLES", "10"))
+
+        def timed_cycles(enabled: bool) -> tuple[float, int]:
+            store, queues, _ = _build(preemption=True, small=small)
+            sched = Scheduler(store, queues)
+            obs.recorder.clear()
+            obs.recorder.enabled = enabled
+            t0 = time.monotonic()
+            for c in range(n_cycles):
+                sched.schedule(now=float(c))
+            return time.monotonic() - t0, len(store.workloads)
+
+        reps = int(os.environ.get("BENCH_RECORDER_REPS", "3"))
+        _, n_wl = timed_cycles(False)       # warm-up (imports, caches)
+        t_offs, t_ons = [], []
+        events = skips = None
+        for _ in range(reps):               # alternate; min beats noise
+            t_offs.append(timed_cycles(False)[0])
+            ev0 = kmetrics.decision_events_total.total()
+            sk0 = kmetrics.decision_skips_total.collect()
+            t_ons.append(timed_cycles(True)[0])
+            if events is None:              # one enabled run's counts
+                events = int(
+                    kmetrics.decision_events_total.total() - ev0)
+                skips = {
+                    k[0]: int(v - sk0.get(k, 0)) for k, v in
+                    kmetrics.decision_skips_total.collect().items()
+                    if v - sk0.get(k, 0)}
+        obs.recorder.enabled = True
+        t_off, t_on = min(t_offs), min(t_ons)
+        overhead = (t_on - t_off) / t_off * 100 if t_off > 0 else 0.0
+        return {
+            "scenario": scenario,
+            "workloads": n_wl,
+            "cycles": n_cycles,
+            "seconds_recorder_off": round(t_off, 3),
+            "seconds_recorder_on": round(t_on, 3),
+            "recorder_overhead_pct": round(overhead, 2),
+            "decision_events_total": events,
+            "skips_by_reason": skips,
+        }
+
     if scenario == "parity":
         # 1/10-scale contended preemption drain: kernel vs host
         store_h, queues_h, _ = _build(preemption=True, small=True)
@@ -900,6 +953,14 @@ def main() -> None:
     except Exception as e:
         log(f"[chaos] did not complete: {e}")
         chaos = None
+    # flight-recorder overhead on the 50k x 1k host cycle shape (host
+    # backend: the recorder instruments the host path)
+    try:
+        recorder = measure("recorder", extra_env={"BENCH_CPU": "1"},
+                           timeout=1800)
+    except Exception as e:
+        log(f"[recorder] did not complete: {e}")
+        recorder = None
     log(f"total bench time {time.monotonic() - t_start:.1f}s")
 
     # HEADLINE: the reference's own protocol — same shape, same
@@ -987,6 +1048,14 @@ def main() -> None:
         extra["chaos_capacity"] = chaos["capacity"]
         extra["chaos_faults_injected"] = chaos["faults_injected"]
         extra["chaos_seconds"] = round(chaos["seconds"], 3)
+    if recorder is not None:
+        # flight-recorder cost + decision volume (docs/OBSERVABILITY.md:
+        # the overhead bar is <2% on this shape)
+        extra["recorder_overhead_pct"] = recorder[
+            "recorder_overhead_pct"]
+        extra["decision_events_total"] = recorder[
+            "decision_events_total"]
+        extra["decision_skips_by_reason"] = recorder["skips_by_reason"]
     # degradation events across every solver-routed scenario, so the
     # perf trajectory records backend faults alongside throughput
     solver_runs = [sim, sim_solver_cpu, sim_solver_dev, sim_large, chaos]

@@ -40,11 +40,17 @@ class Dumper:
                 "active": [i.key for i in q.snapshot_order()],
                 "inadmissible": sorted(q.inadmissible),
             }
+        from kueue_oss_tpu import obs
+
         return {
             "cluster_queues": sorted(self.store.cluster_queues),
             "cohorts": sorted(self.store.cohorts),
             "admitted_workloads": admitted,
             "pending_workloads": pending,
+            # newest flight-recorder decisions: the dump should answer
+            # "why is this pending?" without a live dashboard
+            "recent_decisions": [
+                ev.to_dict() for ev in obs.recorder.events()[-100:]],
         }
 
     def dump_text(self, out: Optional[TextIO] = None) -> str:

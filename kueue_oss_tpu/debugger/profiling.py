@@ -151,14 +151,27 @@ class Tracer:
             yield
         finally:
             dur = self.clock() - t0
-            entry = (name, threading.get_ident(),
-                     int(t0 * 1e6), int(dur * 1e6), args or None)
-            with self._lock:
-                if len(self._spans) < self.max_spans:
-                    self._spans.append(entry)
-                else:
-                    self._spans[self._next % self.max_spans] = entry
-                self._next += 1
+            self._push((name, threading.get_ident(),
+                        int(t0 * 1e6), int(dur * 1e6), args or None))
+
+    def add_span(self, name: str, ts_us: int, dur_us: int,
+                 tid: Optional[int] = None, **args) -> None:
+        """Record an externally-timed span (e.g. a sidecar solve whose
+        timing arrived over the wire) into the same ring, so host and
+        remote activity export as one Chrome-trace timeline."""
+        if not self.enabled:
+            return
+        self._push((name,
+                    threading.get_ident() if tid is None else tid,
+                    int(ts_us), int(dur_us), args or None))
+
+    def _push(self, entry: tuple) -> None:
+        with self._lock:
+            if len(self._spans) < self.max_spans:
+                self._spans.append(entry)
+            else:
+                self._spans[self._next % self.max_spans] = entry
+            self._next += 1
 
     def spans(self) -> list[tuple]:
         with self._lock:
@@ -267,7 +280,11 @@ class DebugServer:
 def attach_to_scheduler(scheduler, tracer: Tracer) -> None:
     """Wrap the scheduler's cycle phases in tracer spans: one
     'schedule' span per cycle with nested 'snapshot' / 'nominate'
-    phases (the reference logs per-phase durations at V(2))."""
+    phases (the reference logs per-phase durations at V(2)). The tracer
+    is also published on the scheduler so the solver engine's drain and
+    imported sidecar spans land in the SAME ring — one merged timeline
+    keyed by cycle id."""
+    scheduler.tracer = tracer
     orig_schedule = scheduler.schedule
     orig_nominate = scheduler._nominate
 

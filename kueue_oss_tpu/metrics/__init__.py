@@ -62,7 +62,10 @@ class Counter(_Series):
                 del self._values[key]
 
     def collect(self) -> dict[LabelValues, float]:
-        return dict(self._values)
+        # a concurrent inc()/set() during a scrape would otherwise raise
+        # "dictionary changed size during iteration" inside dict()
+        with self._lock:
+            return dict(self._values)
 
 
 class Gauge(Counter):
@@ -131,7 +134,12 @@ class Histogram(_Series):
                 del self._values[key]
 
     def collect(self):
-        return dict(self._values)
+        # copy the per-key bucket lists too: observe() mutates them in
+        # place, so a shallow dict copy would still hand the renderer a
+        # list another thread is updating mid-iteration
+        with self._lock:
+            return {k: (list(counts), total, n)
+                    for k, (counts, total, n) in self._values.items()}
 
 
 class Registry:
@@ -428,6 +436,16 @@ solver_breaker_state = registry.register(Gauge(
 solver_plan_rejected_total = registry.register(Counter(
     "kueue_tpu_solver_plan_rejected_total",
     "Imported plans rejected wholesale by the sanity guard", ()))
+
+# -- decision flight recorder (obs/) -----------------------------------------
+
+decision_events_total = registry.register(Counter(
+    "kueue_decision_events_total",
+    "Flight-recorder decision events by kind", ("kind",)))
+decision_skips_total = registry.register(Counter(
+    "kueue_decision_skips_total",
+    "Workload skip/fallback decisions by bounded reason slug",
+    ("reason",)))
 
 
 # -- recording helpers (reference: pkg/metrics exported funcs) ---------------

@@ -162,6 +162,18 @@ class Assignment:
         reasons = [r for ps in self.podsets for r in ps.reasons]
         return "; ".join(dict.fromkeys(reasons)) if reasons else "couldn't assign flavors"
 
+    def skip_detail(self) -> dict:
+        """Structured no-fit explanation for the decision flight
+        recorder: the representative mode plus each podset's reason
+        list, preserved verbatim instead of being discarded with the
+        skipped entry (the flattened ``message()`` loses the
+        podset association)."""
+        return {
+            "mode": MODE_NAMES[self.representative_mode()],
+            "podsets": {ps.name: list(ps.reasons)
+                        for ps in self.podsets if ps.reasons},
+        }
+
     def counts(self) -> list[int]:
         return [ps.count for ps in self.podsets]
 

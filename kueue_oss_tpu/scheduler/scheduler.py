@@ -27,7 +27,7 @@ from kueue_oss_tpu.core.snapshot import (
     build_snapshot,
 )
 from kueue_oss_tpu.core.store import Store
-from kueue_oss_tpu import metrics
+from kueue_oss_tpu import metrics, obs
 from kueue_oss_tpu.core.workload_info import (
     WorkloadInfo,
     effective_per_pod_requests,
@@ -201,6 +201,14 @@ class Scheduler:
         snapshot = build_snapshot(self.store)
         entries, inadmissible = self._nominate(heads, snapshot, now)
         stats.inadmissible = len(inadmissible)
+        for e in inadmissible:
+            # flight recorder: the nomination-stage rejection reason
+            # (inactive/missing CQ, namespace mismatch) is the answer to
+            # "why is my job still pending?" for these workloads
+            obs.recorder.record(
+                obs.SKIPPED, e.info.key, cycle=self.cycle_count,
+                cluster_queue=e.info.cluster_queue,
+                reason=e.inadmissible_msg, reason_slug="inadmissible")
 
         iterator = self._make_iterator(entries, snapshot)
         preempted_workloads: dict[str, WorkloadInfo] = {}
@@ -467,9 +475,14 @@ class Scheduler:
             else:
                 self._drain_cost_ema = (0.7 * self._drain_cost_ema
                                         + 0.3 * per_wl)
-        except UnsupportedProblem:
+        except UnsupportedProblem as e:
             self.queues.materialize_stale_all()
             self._solver_drain_trigger = None
+            obs.recorder.record(
+                obs.SOLVER_FALLBACK, obs.CYCLE_SCOPE,
+                cycle=self.cycle_count + 1, path=obs.SOLVER,
+                reason=str(e) or "problem shape unsupported on-device",
+                reason_slug="unsupported")
             return False
         except SolverUnavailable as e:
             # backend crashed/hung/returned garbage, or the breaker is
@@ -745,6 +758,16 @@ class Scheduler:
     # Entry processing
     # ------------------------------------------------------------------
 
+    def _record_skip(self, e: Entry, slug: str,
+                     detail: Optional[dict] = None) -> None:
+        """Flight-recorder emission for a skipped entry: the bounded slug
+        feeds the per-reason counters, the free-form inadmissible_msg
+        (the flavor assigner's no-fit text included) survives verbatim."""
+        obs.recorder.record(
+            obs.SKIPPED, e.info.key, cycle=self.cycle_count,
+            cluster_queue=e.info.cluster_queue,
+            reason=e.inadmissible_msg, reason_slug=slug, detail=detail)
+
     def _process_entry(self, e: Entry, snapshot: Snapshot,
                        preempted_workloads: dict[str, WorkloadInfo],
                        stats: CycleStats, now: float) -> None:
@@ -761,11 +784,15 @@ class Scheduler:
             e.status = SKIPPED
             e.inadmissible_msg = "A more favorable variant is already admitted"
             stats.skipped += 1
+            self._record_skip(e, "variant_raced")
             return
 
         mode = e.assignment.representative_mode()
         if mode == fa.NO_FIT:
             stats.skipped += 1
+            # the flavor assigner's human-readable no-fit reason
+            # (inadmissible_msg) is kept, not discarded with the entry
+            self._record_skip(e, "no_fit", detail=e.assignment.skip_detail())
             return
 
         if mode == fa.PREEMPT and not e.preemption_targets:
@@ -774,6 +801,7 @@ class Scheduler:
             # (scheduler.go reserveCapacityForUnreclaimablePreempt).
             cq.add_usage(self._quota_to_reserve(e, cq))
             stats.skipped += 1
+            self._record_skip(e, "no_candidates")
             return
 
         if (mode == fa.PREEMPT
@@ -784,6 +812,7 @@ class Scheduler:
             e.status = SKIPPED
             e.inadmissible_msg = "Workload requires preemption, but it's gated"
             stats.skipped += 1
+            self._record_skip(e, "preemption_gated")
             return
 
         # One cohort-conflicting admission per cycle: skip overlapping targets.
@@ -792,6 +821,7 @@ class Scheduler:
             e.inadmissible_msg = (
                 "Workload has overlapping preemption targets with another workload")
             stats.skipped += 1
+            self._record_skip(e, "cohort_conflict")
             return
 
         # In-flight preemption guard (preemption.go:207-221 + the
@@ -808,6 +838,7 @@ class Scheduler:
                 e.inadmissible_msg = (
                     "Workload is waiting for previously issued preemptions")
                 stats.skipped += 1
+                self._record_skip(e, "pending_preemption")
                 return
 
         usage = e.assignment_usage()
@@ -817,6 +848,7 @@ class Scheduler:
             e.inadmissible_msg = (
                 "Workload no longer fits after processing another workload")
             stats.skipped += 1
+            self._record_skip(e, "lost_race")
             return
         for t in e.preemption_targets:
             preempted_workloads[t.info.key] = t.info
@@ -863,6 +895,12 @@ class Scheduler:
                 # next attempt must start from the best flavor again.
                 e.info.last_assignment = None
                 stats.preempted += 1
+                obs.recorder.record(
+                    obs.NOMINATED, e.info.key, cycle=self.cycle_count,
+                    cluster_queue=e.info.cluster_queue,
+                    reason=e.inadmissible_msg,
+                    reason_slug="pending_migration",
+                    detail={"migrated_sibling": sibling.key})
                 return
 
         # Delayed topology assignment: on a CQ gated by admission checks
@@ -1012,6 +1050,8 @@ class Scheduler:
         wl = self.store.workloads.get(e.info.key)
         if wl is None:
             e.status = SKIPPED
+            e.inadmissible_msg = "Workload vanished from the store"
+            self._record_skip(e, "vanished")
             return
         delay_tas = self._delays_topology(e)
         admission = Admission(
@@ -1073,6 +1113,16 @@ class Scheduler:
                                         now - wl.creation_time,
                                         lq=wl.queue_name,
                                         namespace=wl.namespace)
+        obs.recorder.record(
+            obs.ASSIGNED, wl.key, cycle=self.cycle_count,
+            cluster_queue=e.info.cluster_queue,
+            reason=f"Quota reserved in ClusterQueue {e.info.cluster_queue}",
+            detail={
+                "flavors": {psa.name: dict(psa.flavors)
+                            for psa in admission.podset_assignments},
+                "borrows": e.assignment.borrows(),
+                "admitted": wl.is_admitted,
+            })
         # cohort subtree admission counters (metrics.go cohort_subtree_*)
         if e.cq_snapshot is not None and e.cq_snapshot.has_parent():
             for node in e.cq_snapshot.path_parent_to_root():
@@ -1111,13 +1161,20 @@ class Scheduler:
             f". Pending the preemption of {len(e.preemption_targets)} workload(s)")
         e.requeue_reason = RequeueReason.PENDING_PREEMPTION
         e.info.last_assignment = None
+        obs.recorder.record(
+            obs.NOMINATED, e.info.key, cycle=self.cycle_count,
+            cluster_queue=e.info.cluster_queue,
+            reason=e.inadmissible_msg, reason_slug="preempting",
+            detail={"targets": [t.info.key for t in e.preemption_targets]})
 
     def evict_workload(self, key: str, reason: str, message: str, now: float,
                        preemption_reason: str = "",
                        backoff_base_s: Optional[float] = None,
                        backoff_max_s: Optional[float] = None,
                        requeue: bool = True,
-                       underlying_cause: str = "") -> None:
+                       underlying_cause: str = "",
+                       decision_path: str = obs.HOST,
+                       decision_cycle: Optional[int] = None) -> None:
         """Evict + finalize: release quota and requeue (the reference splits
         this between the scheduler patch and the Workload controller).
 
@@ -1190,6 +1247,12 @@ class Scheduler:
                       message, now=now)
         self.log.info("workload evicted", v=2, workload=wl.key,
                       reason=reason, preemption=bool(preemption_reason))
+        obs.recorder.record(
+            obs.PREEMPTED if preemption_reason else obs.EVICTED, wl.key,
+            cycle=(decision_cycle if decision_cycle is not None
+                   else self.cycle_count),
+            cluster_queue=cq or "", path=decision_path, reason=message,
+            reason_slug=preemption_reason or reason)
         # the eviction is now observable: clear pending expectations
         self.preemption_expectations.observe(wl.uid)
         self.evicted_total[wl.key] = self.evicted_total.get(wl.key, 0) + 1

@@ -9,6 +9,7 @@ verifying solver plans before assuming, SURVEY.md §7 step 4).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -25,7 +26,7 @@ from kueue_oss_tpu.api.types import (
 from kueue_oss_tpu.core.queue_manager import QueueManager
 from kueue_oss_tpu.core.store import Store
 from kueue_oss_tpu.core.workload_info import WorkloadInfo
-from kueue_oss_tpu import metrics
+from kueue_oss_tpu import metrics, obs
 from kueue_oss_tpu.solver.kernels import solve_backlog, to_device
 from kueue_oss_tpu.solver.resilience import SolverHealth, SolverUnavailable
 from kueue_oss_tpu.solver.tensors import (
@@ -117,6 +118,22 @@ class SolverEngine:
         #: trade lanes for rounds at roughly constant work. None =
         #: choose by backend at first drain.
         self.h_work_budget = None
+        #: debugger.Tracer for drain spans; when unset, the scheduler's
+        #: attached tracer (attach_to_scheduler) is used, so host cycle
+        #: spans and solver/sidecar spans land in ONE Chrome trace
+        self.tracer = None
+        #: total drains started; the obs cycle id for engines used
+        #: standalone (no scheduler whose cycle_count anchors the drain)
+        self.drain_count = 0
+        #: cycle id tagged on this drain's DecisionEvents and spans — the
+        #: host cycle the drain serves (scheduler.cycle_count + 1), so a
+        #: merged trace groups the drain with the cycle it replaced
+        self._drain_cycle = 0
+
+    def _tracer(self):
+        if self.tracer is not None:
+            return self.tracer
+        return getattr(self.scheduler, "tracer", None)
 
     def supported(self) -> bool:
         """Whether the drain can run on-device.
@@ -279,6 +296,13 @@ class SolverEngine:
                 ta = placements.get(info.key)
                 if ta is None:
                     metrics.solver_plan_fallbacks_total.inc()
+                    obs.recorder.record(
+                        obs.SOLVER_FALLBACK, info.key,
+                        cycle=self._drain_cycle, cluster_queue=cq_name,
+                        path=obs.SOLVER,
+                        reason="device TAS placement failed; workload "
+                               "stays queued for the host mop-up cycle",
+                        reason_slug="tas_place_failed")
                     continue  # host mop-up places (or rejects) it
                 topo_of[info.key] = ta
             kept.append(cand)
@@ -305,12 +329,28 @@ class SolverEngine:
         if not self.supported():
             raise UnsupportedProblem(
                 "admission-scope or weighted fair-sharing CQs present")
+        self.drain_count += 1
+        self._drain_cycle = (self.scheduler.cycle_count + 1
+                             if self.scheduler is not None
+                             else self.drain_count)
         if self.remote is not None and not self.health.allow():
             # open breaker: refuse without touching the socket so the
             # admission round proceeds on the host path immediately
             metrics.solver_fallback_total.inc("breaker_open")
+            obs.recorder.record(
+                obs.SOLVER_FALLBACK, obs.CYCLE_SCOPE,
+                cycle=self._drain_cycle, path=obs.SOLVER,
+                reason="solver backend breaker is open (cooling down); "
+                       "admissions degrade to the host cycle",
+                reason_slug="breaker_open")
             raise SolverUnavailable(
                 "solver backend breaker is open (cooling down)")
+        tracer = self._tracer()
+        with (tracer.span("solver_drain", cycle=self._drain_cycle)
+              if tracer is not None else contextlib.nullcontext()):
+            return self._drain(now, verify)
+
+    def _drain(self, now: float, verify: bool) -> DrainResult:
         pending = self.pending_backlog()
         if self.needs_full_kernel(pending):
             return self._drain_full(now, verify=verify, pending=pending)
@@ -365,24 +405,65 @@ class SolverEngine:
         Success is NOT recorded here — only a plan that also passes the
         sanity guard counts as a healthy backend response.
         """
+        # duck-typed trace propagation: a SolverClient ships the cycle id
+        # over the wire so the sidecar's solve span comes back tagged;
+        # arbitrary remote stubs without the attribute still work
+        if hasattr(self.remote, "trace_cycle"):
+            self.remote.trace_cycle = self._drain_cycle
         try:
             out = tuple(self.remote.solve(problem, **kw))
-        except SolverUnavailable:
+        except SolverUnavailable as e:
             self.health.record_failure()
             metrics.solver_fallback_total.inc("backend_error")
+            self._record_backend_fallback(str(e))
             raise
         except (OSError, TimeoutError) as e:
             # custom remote stubs may surface raw socket errors
             self.health.record_failure()
             metrics.solver_fallback_total.inc("backend_error")
+            self._record_backend_fallback(repr(e))
             raise SolverUnavailable(f"solver backend fault: {e!r}") from e
         if len(out) != expect:
             self.health.record_failure()
             metrics.solver_fallback_total.inc("backend_error")
+            self._record_backend_fallback(
+                f"backend returned {len(out)} arrays, expected {expect}")
             raise SolverUnavailable(
                 f"solver backend returned {len(out)} arrays, "
                 f"expected {expect}")
+        self._import_sidecar_spans()
         return out
+
+    def _record_backend_fallback(self, reason: str) -> None:
+        obs.recorder.record(
+            obs.SOLVER_FALLBACK, obs.CYCLE_SCOPE, cycle=self._drain_cycle,
+            path=obs.SOLVER, reason=reason, reason_slug="backend_error")
+
+    def _import_sidecar_spans(self) -> None:
+        """Merge the sidecar's solve spans (returned in the response
+        header) into the host tracer. The two processes have unrelated
+        perf_counter origins, so spans are END-ALIGNED at the moment the
+        response arrived — the duration and the shared cycle id are the
+        signal; the sub-millisecond start skew is not."""
+        tracer = self._tracer()
+        spans = getattr(self.remote, "last_spans", None)
+        if tracer is None or not spans:
+            return
+        now_us = int(tracer.clock() * 1e6)
+        for sp in spans:
+            # span import is best-effort diagnostics: a version-skewed
+            # or garbled spans entry must not abort the drain (the plan
+            # itself is separately sanity-guarded)
+            try:
+                dur_us = int(sp.get("dur_us", 0))
+                args = {str(k): v
+                        for k, v in dict(sp.get("args") or {}).items()
+                        if k not in ("name", "ts_us", "dur_us", "tid")}
+                args.setdefault("cycle", self._drain_cycle)
+                tracer.add_span(str(sp.get("name", "sidecar_solve")),
+                                now_us - dur_us, dur_us, tid=0, **args)
+            except Exception:
+                continue
 
     def _check_plan(self, problem: SolverProblem, admitted, opt,
                     admit_round, parked, victim_reason=None, rounds=None,
@@ -408,6 +489,11 @@ class SolverEngine:
         if self.remote is not None:
             self.health.record_failure()
             metrics.solver_fallback_total.inc("plan_rejected")
+        obs.recorder.record(
+            obs.SOLVER_FALLBACK, obs.CYCLE_SCOPE, cycle=self._drain_cycle,
+            path=obs.SOLVER,
+            reason=f"divergent solver plan rejected: {fault}",
+            reason_slug="plan_rejected")
         raise SolverUnavailable(f"divergent solver plan rejected: {fault}")
 
     @staticmethod
@@ -549,6 +635,12 @@ class SolverEngine:
         for passed, (wl, cq_name, flavor, info, _) in zip(ok, candidates):
             if not passed:
                 metrics.solver_plan_fallbacks_total.inc()
+                obs.recorder.record(
+                    obs.SOLVER_FALLBACK, wl.key, cycle=self._drain_cycle,
+                    cluster_queue=cq_name, path=obs.SOLVER,
+                    reason="host oracle re-check rejected the plan entry;"
+                           " workload stays queued for the host cycle",
+                    reason_slug="oracle_rejected")
                 continue
             flavor_of = {r: flavor for psr in info.total_requests
                          for r in psr.requests}
@@ -559,6 +651,15 @@ class SolverEngine:
         for w in np.nonzero(parked[:problem.n_workloads])[0]:
             cq_name = problem.cq_names[problem.wl_cqid[w]]
             self.queues.queues[cq_name].park(problem.wl_keys[w])
+            self._record_parked(problem.wl_keys[w], cq_name)
+
+    def _record_parked(self, key: str, cq_name: str) -> None:
+        obs.recorder.record(
+            obs.SKIPPED, key, cycle=self._drain_cycle,
+            cluster_queue=cq_name, path=obs.SOLVER,
+            reason="parked inadmissible by the solver plan: no flavor "
+                   "option fits at current capacity",
+            reason_slug="solver_parked")
 
     # -- full (preemption-capable) drain -----------------------------------
 
@@ -777,7 +878,9 @@ class SolverEngine:
             evictor.evict_workload(
                 key, reason="Preempted",
                 message="Preempted by the solver drain plan",
-                now=now, preemption_reason=reason)
+                now=now, preemption_reason=reason,
+                decision_path=obs.SOLVER,
+                decision_cycle=self._drain_cycle)
             if not admitted[w]:
                 result.evicted += 1
                 result.evicted_keys.append(key)
@@ -825,6 +928,12 @@ class SolverEngine:
         for passed, (wl, cq_name, flavor_of, info, _) in zip(ok, candidates):
             if not passed:
                 metrics.solver_plan_fallbacks_total.inc()
+                obs.recorder.record(
+                    obs.SOLVER_FALLBACK, wl.key, cycle=self._drain_cycle,
+                    cluster_queue=cq_name, path=obs.SOLVER,
+                    reason="host oracle re-check rejected the plan entry;"
+                           " workload stays queued for the host cycle",
+                    reason_slug="oracle_rejected")
                 continue
             self._commit_admission(wl, cq_name, flavor_of, info, now,
                                    result, topology=topo_of.get(wl.key))
@@ -833,6 +942,7 @@ class SolverEngine:
         for w in np.nonzero(parked[:W] & ~admitted[:W])[0]:
             cq_name = problem.cq_names[problem.wl_cqid[w]]
             self.queues.queues[cq_name].park(problem.wl_keys[w])
+            self._record_parked(problem.wl_keys[w], cq_name)
 
     def _commit_admission(self, wl, cq_name: str,
                           flavor_of: dict[str, str], info: WorkloadInfo,
@@ -901,5 +1011,15 @@ class SolverEngine:
             metrics.admitted_workload(cq_name, now - wl.creation_time,
                                       lq=wl.queue_name,
                                       namespace=wl.namespace)
+        obs.recorder.record(
+            obs.SOLVER_ADMITTED, key, cycle=self._drain_cycle,
+            cluster_queue=cq_name, path=obs.SOLVER,
+            reason=f"Admitted by the solver drain plan into "
+                   f"ClusterQueue {cq_name}",
+            detail={
+                "flavors": dict(flavor_of),
+                "placed_with_topology": topology is not None,
+                "admitted": wl.is_admitted,
+            })
         result.admitted += 1
         result.admitted_keys.append(key)

@@ -168,7 +168,13 @@ def solve_request(header: dict, blob: bytes) -> tuple[dict, bytes]:
 
     Shared by the production handler and the chaos harness (which wraps
     it to corrupt/delay/drop the response deterministically).
+
+    The optional ``trace_cycle`` header field is the host scheduler's
+    cycle id: the response carries a ``spans`` list timing the sidecar
+    solve, tagged with that cycle, so the engine can merge it into the
+    host Tracer's Chrome-trace export as one timeline.
     """
+    t0 = time.perf_counter()
     problem = deserialize_problem(header["meta"], blob)
     if header["full"]:
         from kueue_oss_tpu.solver.full_kernels import (
@@ -193,7 +199,13 @@ def solve_request(header: dict, blob: bytes) -> tuple[dict, bytes]:
                  "rounds", "usage"]
     buf = io.BytesIO()
     np.savez(buf, **{n: np.asarray(v) for n, v in zip(names, out)})
-    return {"ok": True, "names": names}, buf.getvalue()
+    span_args = {"full": bool(header["full"])}
+    if header.get("trace_cycle") is not None:
+        span_args["cycle"] = header["trace_cycle"]
+    spans = [{"name": "sidecar_solve",
+              "dur_us": int((time.perf_counter() - t0) * 1e6),
+              "args": span_args}]
+    return {"ok": True, "names": names, "spans": spans}, buf.getvalue()
 
 
 def respond(sock: socket.socket, header: dict, blob: bytes) -> None:
@@ -286,6 +298,12 @@ class SolverClient:
         self._rng = random.Random(jitter_seed)
         self._clock = clock
         self._sleep = sleep
+        #: host cycle id shipped in the next request's header (set by
+        #: SolverEngine before each solve) so sidecar spans come back
+        #: tagged with the cycle they served
+        self.trace_cycle: Optional[int] = None
+        #: sidecar spans from the LAST successful solve's response header
+        self.last_spans: list[dict] = []
 
     @classmethod
     def from_config(cls, cfg) -> "SolverClient":
@@ -307,6 +325,9 @@ class SolverClient:
         header = {"meta": meta, "full": full, "g_max": g_max,
                   "h_max": h_max, "p_max": p_max,
                   "fs_enabled": fs_enabled}
+        if self.trace_cycle is not None:
+            header["trace_cycle"] = int(self.trace_cycle)
+        self.last_spans = []
         # enforce the frame guard on our OWN request too: a server-side
         # rejection of an oversized frame shows up as a reset/EOF and
         # would be misread as a transient connection fault and retried
@@ -376,6 +397,8 @@ class SolverClient:
         names = resp.get("names")
         if not isinstance(names, list) or not names:
             raise SolverProtocolError("response header carries no names")
+        spans = resp.get("spans")
+        self.last_spans = spans if isinstance(spans, list) else []
         try:
             data = np.load(io.BytesIO(body))
             return tuple(data[n] for n in names)

@@ -22,9 +22,16 @@ from kueue_oss_tpu.core.store import Store
 
 
 class Dashboard:
-    def __init__(self, store: Store, queues: QueueManager) -> None:
+    def __init__(self, store: Store, queues: QueueManager,
+                 recorder=None) -> None:
+        from kueue_oss_tpu import obs
+
         self.store = store
         self.queues = queues
+        #: decision flight recorder backing /api/decisions and the
+        #: per-workload explain endpoint (defaults to the process-wide
+        #: journal the scheduler/solver emit into)
+        self.recorder = recorder if recorder is not None else obs.recorder
         #: bumped on every store event; SSE clients wake on it
         self._gen = 0
         self._cond = threading.Condition()
@@ -189,6 +196,25 @@ class Dashboard:
             })
         return out
 
+    def solver_view(self) -> dict:
+        """Solver-backend resilience at a glance: breaker state and the
+        PR-3 degradation counters, so a tripped breaker is visible on
+        the overview without scraping /metrics."""
+        from kueue_oss_tpu import metrics, obs
+
+        return {
+            "breakerState": obs.breaker_state_name(),
+            "breakerTrips": int(
+                metrics.solver_breaker_trips_total.total()),
+            "fallbacks": {k[0]: int(v) for k, v in
+                          metrics.solver_fallback_total.collect().items()},
+            "remoteFailures": {
+                k[0]: int(v) for k, v in
+                metrics.solver_remote_failures_total.collect().items()},
+            "planRejected": int(
+                metrics.solver_plan_rejected_total.total()),
+        }
+
     def overview(self) -> dict:
         return {
             "clusterQueues": self.cluster_queues_view(),
@@ -198,7 +224,24 @@ class Dashboard:
             "resourceFlavors": self.resource_flavors_view(),
             "topologies": self.topologies_view(),
             "admissionChecks": self.admission_checks_view(),
+            "solver": self.solver_view(),
         }
+
+    # -- flight-recorder views (obs/) ---------------------------------------
+
+    def workload_explain(self, namespace: str, name: str) -> Optional[dict]:
+        """The workload's decision history, newest-first — the answer to
+        'why is my job still pending?'. None only when the workload is
+        unknown AND the journal has nothing for it."""
+        key = f"{namespace}/{name}"
+        events = self.recorder.explain(key)
+        if not events and key not in self.store.workloads:
+            return None
+        return {"workload": key,
+                "events": [ev.to_dict() for ev in events]}
+
+    def decisions_view(self, last_cycles: int = 10) -> dict:
+        return {"cycles": self.recorder.decisions(last_cycles)}
 
     # -- per-resource detail views (WorkloadDetail.jsx et al) ---------------
 
@@ -346,6 +389,34 @@ class DashboardServer:
                     self.wfile.write(body)
                     return
                 path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/metrics":
+                    # Prometheus text exposition (registry render)
+                    from kueue_oss_tpu import metrics as kmetrics
+
+                    body = kmetrics.registry.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/api/decisions":
+                    from urllib.parse import parse_qs, urlparse
+
+                    qs = parse_qs(urlparse(self.path).query)
+                    try:
+                        n = int(qs.get("cycles", ["10"])[0])
+                    except ValueError:
+                        n = 10
+                    body = json.dumps(dash.decisions_view(n)).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path == "/api/stream":
                     # SSE live refresh (useWebSocket.js analog): push an
                     # overview snapshot on every store change, with a
@@ -371,7 +442,14 @@ class DashboardServer:
                 # per-resource detail endpoints
                 detail = None
                 parts = path.strip("/").split("/")
-                if len(parts) == 4 and parts[:2] == ["api", "workloads"]:
+                if (len(parts) == 5 and parts[:2] == ["api", "workloads"]
+                        and parts[4] == "explain"):
+                    detail = dash.workload_explain(parts[2], parts[3])
+                    if detail is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                elif len(parts) == 4 and parts[:2] == ["api", "workloads"]:
                     detail = dash.workload_detail(parts[2], parts[3])
                 elif len(parts) == 3 and parts[1] == "clusterqueues":
                     detail = dash.cluster_queue_detail(parts[2])

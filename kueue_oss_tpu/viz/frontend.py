@@ -81,7 +81,8 @@ INDEX_HTML = """<!doctype html>
   </tr></thead><tbody></tbody></table>
 </div>
 <footer>live over SSE (/api/stream), 2s polling fallback ·
-JSON at /api/overview</footer>
+JSON at /api/overview · decision traces at /api/decisions ·
+Prometheus at /metrics</footer>
 <script>
 const fmt = (o) => Object.entries(o || {}).map(
     ([k, v]) => `${k}=${v}`).join(" ") || "—";
@@ -131,12 +132,19 @@ async function refresh() {
     const cqs = o.clusterQueues, wls = o.workloads;
     const counts = {};
     for (const w of wls) counts[w.status] = (counts[w.status] || 0) + 1;
+    const sv = o.solver || {};
+    const fallbacks = Object.values(sv.fallbacks || {})
+      .reduce((a, b) => a + b, 0);
     document.getElementById("overview").innerHTML =
       `<span><b>${cqs.length}</b> ClusterQueues</span>` +
       `<span><b>${o.cohorts.length}</b> Cohorts</span>` +
       `<span><b>${wls.length}</b> Workloads</span>` +
       Object.entries(counts)
-        .map(([k, v]) => `<span><b>${v}</b> ${k}</span>`).join("");
+        .map(([k, v]) => `<span><b>${v}</b> ${k}</span>`).join("") +
+      `<span>solver breaker <b>${sv.breakerState || "closed"}</b>` +
+      (sv.breakerTrips ? ` (${sv.breakerTrips} trips)` : "") +
+      `</span>` +
+      (fallbacks ? `<span><b>${fallbacks}</b> host fallbacks</span>` : "");
     document.getElementById("tree").innerHTML =
       renderTree(o.cohorts, cqs);
     const fill = (id, rows) => {
@@ -195,9 +203,22 @@ async function renderDetail() {
   main.style.display = "none"; det.style.display = "";
   try {
     const r = await fetch(url);
-    det.innerHTML = `<h2>${title}</h2>` + (r.ok
-      ? obj(await r.json())
-      : `<p>not found</p>`) +
+    let body = r.ok ? obj(await r.json()) : `<p>not found</p>`;
+    if (parts[0] === "workload" && r.ok) {
+      // the flight recorder's answer to "why is my job still pending?"
+      const ex = await fetch(url + "/explain");
+      if (ex.ok) {
+        const events = (await ex.json()).events || [];
+        body += `<h3>Decision trace (newest first)</h3>` +
+          `<table><thead><tr><th>cycle</th><th>path</th><th>kind</th>` +
+          `<th>reason</th></tr></thead><tbody>` +
+          events.map(e => `<tr><td>${e.cycle}</td><td>${e.path}</td>` +
+            `<td><span class="pill">${e.kind}</span></td>` +
+            `<td>${e.reason || e.reasonSlug || ""}</td></tr>`).join("") +
+          `</tbody></table>`;
+      }
+    }
+    det.innerHTML = `<h2>${title}</h2>` + body +
       `<p><a href="#" onclick="location.hash=''">← back</a></p>`;
   } catch (e) { det.innerHTML = `<p>unavailable</p>`; }
 }

@@ -119,6 +119,90 @@ def test_quota_gauges_and_clear():
         "cq", "default", "cpu") == 0
 
 
+def test_collect_race_with_concurrent_writes():
+    """A dashboard scrape (render/collect) racing inc/observe must not
+    raise 'dictionary changed size during iteration': collect() now
+    copies under the series lock. Hammer with a writer thread churning
+    NEW label values (each insert grows the dict) while readers render."""
+    import threading
+
+    c = metrics.Counter("t_race_total", "t", ("a",))
+    h = metrics.Histogram("t_race_h", "t", ("a",), buckets=(1.0, 10.0))
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            c.inc(f"lbl{i}")
+            h.observe(f"lbl{i}", value=float(i % 20))
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                c.collect()
+                h.collect()
+            except RuntimeError as e:  # pragma: no cover - the bug
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=writer)] + [
+        threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, f"collect raced a concurrent write: {errors[0]!r}"
+
+
+def test_gauge_replace_prefix_zero_fill_then_drop():
+    """A drained sample first reports one scrape of 0, then drops off
+    entirely; churned label sets must not accumulate forever."""
+    g = metrics.Gauge("t_rp", "t", ("lq", "resource"))
+    g.replace_prefix(("a",), {("cpu",): 5.0, ("mem",): 3.0})
+    assert g.value("a", "cpu") == 5.0
+    assert g.value("a", "mem") == 3.0
+    # mem leaves the update set: one zero-fill scrape...
+    g.replace_prefix(("a",), {("cpu",): 7.0})
+    assert g.value("a", "cpu") == 7.0
+    assert g.collect()[("a", "mem")] == 0.0
+    # ...then the stale sample drops off entirely
+    g.replace_prefix(("a",), {("cpu",): 7.0})
+    assert ("a", "mem") not in g.collect()
+    # other prefixes are never touched
+    g.replace_prefix(("b",), {("cpu",): 1.0})
+    g.replace_prefix(("a",), {})
+    assert g.value("b", "cpu") == 1.0
+    # an empty update zero-fills, then clears, the whole prefix
+    assert g.collect()[("a", "cpu")] == 0.0
+    g.replace_prefix(("a",), {})
+    assert all(k[0] != "a" for k in g.collect())
+
+
+def test_histogram_bucket_edge_values_inclusive():
+    """Prometheus le buckets are INCLUSIVE upper bounds: an observation
+    exactly on a bucket edge counts in that bucket (and all above)."""
+    h = metrics.Histogram("t_edge", "t", buckets=(1.0, 5.0, 10.0))
+    h.observe(value=1.0)   # == first edge
+    h.observe(value=5.0)   # == middle edge
+    h.observe(value=10.0)  # == last edge
+    counts, total, n = h.collect()[()]
+    assert counts == [1, 2, 3]
+    assert n == 3 and total == 16.0
+    r = metrics.Registry()
+    r.register(h)
+    rendered = r.render()
+    assert 't_edge_bucket{le="1.0"} 1' in rendered
+    assert 't_edge_bucket{le="5.0"} 2' in rendered
+    assert 't_edge_bucket{le="10.0"} 3' in rendered
+    assert 't_edge_bucket{le="+Inf"} 3' in rendered
+
+
 def test_render_exposition_format():
     store, queues, sched = _mk_env()
     store.add_workload(Workload(

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Offline "why is my job still pending?" — the /api/explain answer
+from a flight-recorder journal dump.
+
+The dashboard answers live from the in-process recorder; this prints
+the SAME reason chain from a journal written with
+``obs.recorder.dump_jsonl(path)`` (or fetched from a live dashboard
+with ``--url``), so a post-mortem needs only the dump file.
+
+Usage:
+    python tools/explain.py --journal decisions.jsonl default/my-job
+    python tools/explain.py --journal decisions.jsonl            # summary
+    python tools/explain.py --journal decisions.jsonl --cycles 5
+    python tools/explain.py --url http://127.0.0.1:8080 default/my-job
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+# allow running straight from a checkout: tools/ sits next to the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kueue_oss_tpu.obs import CYCLE_SCOPE, DecisionEvent, load_jsonl  # noqa: E402
+
+
+def _fmt_event(ev: DecisionEvent) -> str:
+    line = (f"  cycle {ev.cycle:>6}  [{ev.path:>6}] {ev.kind:<16} "
+            f"{ev.reason or ev.reason_slug or '(no reason recorded)'}")
+    if ev.breaker != "closed":
+        line += f"  (breaker {ev.breaker})"
+    if ev.detail:
+        line += f"\n{'':16}detail: {json.dumps(ev.detail, sort_keys=True)}"
+    return line
+
+
+def explain_workload(events: list[DecisionEvent], key: str,
+                     out) -> int:
+    chain = [ev for ev in events if ev.workload == key]
+    chain.sort(key=lambda ev: ev.seq, reverse=True)
+    if not chain:
+        print(f"no decisions recorded for workload {key}", file=out)
+        return 1
+    newest = chain[0]
+    print(f"workload {key} — {len(chain)} decision(s), newest first "
+          f"(latest: {newest.kind}"
+          + (f" in ClusterQueue {newest.cluster_queue}"
+             if newest.cluster_queue else "") + ")", file=out)
+    for ev in chain:
+        print(_fmt_event(ev), file=out)
+    return 0
+
+
+def summarize(events: list[DecisionEvent], out) -> int:
+    latest: dict[str, DecisionEvent] = {}
+    for ev in events:
+        if ev.workload == CYCLE_SCOPE:
+            continue
+        cur = latest.get(ev.workload)
+        if cur is None or ev.seq > cur.seq:
+            latest[ev.workload] = ev
+    if not latest:
+        print("journal holds no per-workload decisions", file=out)
+        return 1
+    print(f"{len(latest)} workload(s) in the journal; latest decision "
+          "each:", file=out)
+    for key in sorted(latest):
+        ev = latest[key]
+        print(f"  {key:<40} cycle {ev.cycle:>6} [{ev.path:>6}] "
+              f"{ev.kind:<16} {ev.reason_slug or ev.reason[:60]}",
+              file=out)
+    return 0
+
+
+def show_cycles(events: list[DecisionEvent], n: int, out) -> int:
+    by_cycle: dict[int, list[DecisionEvent]] = {}
+    for ev in events:
+        by_cycle.setdefault(ev.cycle, []).append(ev)
+    for c in sorted(by_cycle, reverse=True)[:n]:
+        print(f"cycle {c}:", file=out)
+        for ev in sorted(by_cycle[c], key=lambda e: e.seq):
+            who = ev.workload if ev.workload != CYCLE_SCOPE else "(cycle)"
+            print(f"  [{ev.path:>6}] {ev.kind:<16} {who:<40} "
+                  f"{ev.reason_slug or ev.reason[:60]}", file=out)
+    return 0
+
+
+def _fetch_url(url: str, key: str) -> list[DecisionEvent]:
+    ns, name = key.split("/", 1)
+    try:
+        data = json.loads(urllib.request.urlopen(
+            f"{url.rstrip('/')}/api/workloads/{ns}/{name}/explain",
+            timeout=10).read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return []  # unknown workload: same answer as an empty journal
+        raise SystemExit(f"dashboard returned HTTP {e.code} for {key}")
+    except urllib.error.URLError as e:
+        raise SystemExit(f"dashboard unreachable at {url}: {e.reason}")
+    return [DecisionEvent.from_dict(d) for d in data.get("events", [])]
+
+
+def main(argv=None, out=None) -> int:
+    out = out or sys.stdout
+    p = argparse.ArgumentParser(
+        prog="explain.py",
+        description="Explain workload admission decisions from a "
+                    "flight-recorder journal dump (or a live dashboard).")
+    p.add_argument("workload", nargs="?",
+                   help="workload key (namespace/name); omit for a "
+                        "per-workload summary")
+    p.add_argument("--journal", help="journal dump path (JSONL, written "
+                                     "by recorder.dump_jsonl)")
+    p.add_argument("--url", help="live dashboard base URL instead of a "
+                                 "journal (requires a workload key)")
+    p.add_argument("--cycles", type=int, default=0,
+                   help="show the last N cycles' full decision groups")
+    args = p.parse_args(argv)
+
+    if args.url:
+        if not args.workload:
+            p.error("--url requires a workload key")
+        return explain_workload(_fetch_url(args.url, args.workload),
+                                args.workload, out)
+    if not args.journal:
+        p.error("--journal (or --url) is required")
+    events = load_jsonl(args.journal)
+    if args.cycles:
+        return show_cycles(events, args.cycles, out)
+    if args.workload:
+        return explain_workload(events, args.workload, out)
+    return summarize(events, out)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
