@@ -693,6 +693,99 @@ def run_scenario(scenario: str) -> dict:
             **_degradation_counts(),
         }
 
+    if scenario == "delta":
+        # delta-sync steady state on the 50k x 1k churn shape
+        # (docs/SOLVER_PROTOCOL.md): a real sidecar on a unix socket,
+        # engine sessions on. Cycle 0 ships the full SYNC; each churn
+        # cycle then finishes ~0.5% of the admitted set, submits the
+        # same number of new arrivals, and drains — steady-state cycles
+        # must ship DELTA frames. Reports wire bytes per cycle vs the
+        # full frame, the resync count, and the steady-state solve wall
+        # p50 (the engine's solve window ends at host-side scalar
+        # fetches, per the round-5 timing discipline).
+        import tempfile
+
+        import numpy as np
+
+        from kueue_oss_tpu import metrics as kmetrics
+        from kueue_oss_tpu.api.types import PodSet, Workload
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+        from kueue_oss_tpu.solver.service import SolverClient, SolverServer
+
+        store, queues, engine = _build(preemption=True, small=small)
+        sched = Scheduler(store, queues)
+        engine.scheduler = sched
+        path = os.path.join(tempfile.mkdtemp(), "solver.sock")
+        srv = SolverServer(path)
+        srv.serve_in_background()
+        n_wl = len(store.workloads)
+        churn = int(os.environ.get("BENCH_DELTA_CHURN",
+                                   str(max(1, n_wl // 200))))
+        n_cycles = int(os.environ.get("BENCH_DELTA_CYCLES", "8"))
+        warm_cycles = 2
+        # keep ONE padded capacity across the run: churned arrivals must
+        # not cross a power-of-two boundary and force resyncs
+        engine.pad_to = n_wl + churn * (n_cycles + warm_cycles) + 1
+        try:
+            engine.remote = SolverClient(path)
+            resync0 = kmetrics.solver_resync_total.total()
+            engine.drain(now=0.0, verify=True)
+            full_frame = engine.remote.last_frame
+            lqs = sorted({w.queue_name for w in store.workloads.values()})
+            proto = next(iter(store.workloads.values()))
+            req = dict(proto.podsets[0].requests)
+            uid = max(w.uid for w in store.workloads.values()) + 1
+            t_base = max(w.creation_time
+                         for w in store.workloads.values()) + 1.0
+
+            def churn_cycle(cyc):
+                admitted = [k for k, w in store.workloads.items()
+                            if w.is_quota_reserved and not w.is_finished]
+                for k in admitted[:churn]:
+                    sched.finish_workload(k, now=float(cyc))
+                for j in range(churn):
+                    i = uid + cyc * churn + j
+                    store.add_workload(Workload(
+                        name=f"churn-{cyc}-{j}",
+                        queue_name=lqs[i % len(lqs)], uid=i,
+                        creation_time=t_base + cyc * churn + j,
+                        podsets=[PodSet(name="main", count=1,
+                                        requests=dict(req))]))
+                result = engine.drain(now=float(cyc), verify=True)
+                return result, engine.remote.last_frame
+
+            for c in range(1, warm_cycles + 1):  # churn settles in
+                churn_cycle(c)
+            frames, solve_walls = [], []
+            for c in range(warm_cycles + 1, warm_cycles + 1 + n_cycles):
+                result, frame = churn_cycle(c)
+                frames.append(frame)
+                solve_walls.append(result.solver_time_s)
+            resyncs = int(kmetrics.solver_resync_total.total() - resync0)
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        delta_frames = [n for kind, n in frames if kind == "delta"]
+        delta_bytes = (float(np.median(delta_frames))
+                       if delta_frames else 0.0)
+        walls_ms = np.asarray(solve_walls) * 1000
+        return {
+            "scenario": scenario,
+            "workloads": n_wl,
+            "churn_per_cycle": churn,
+            "cycles": n_cycles,
+            "full_frame_bytes": int(full_frame[1]),
+            "delta_bytes_per_cycle": delta_bytes,
+            "bytes_ratio": (round(full_frame[1] / delta_bytes, 1)
+                            if delta_bytes else None),
+            "delta_frames": len(delta_frames),
+            "nondelta_frames": len(frames) - len(delta_frames),
+            "resync_count": resyncs,
+            "frames_by_kind": engine.remote.frames_by_kind,
+            "cycle_ms_p50": float(np.percentile(walls_ms, 50)),
+            "cycle_ms_p99": float(np.percentile(walls_ms, 99)),
+        }
+
     if scenario == "recorder":
         # flight-recorder overhead on the 50k x 1k host cycle-latency
         # shape: identical twin stores run the same N host cycles with
@@ -961,6 +1054,15 @@ def main() -> None:
     except Exception as e:
         log(f"[recorder] did not complete: {e}")
         recorder = None
+    # delta-sync steady state on the 50k x 1k churn shape: wire bytes
+    # per cycle vs the full sync frame + resync count
+    # (docs/SOLVER_PROTOCOL.md acceptance: steady-state deltas ship
+    # >= 50x fewer payload bytes than a full-sync cycle)
+    try:
+        delta = measure_with_fallback("delta", 2400)
+    except Exception as e:
+        log(f"[delta] did not complete: {e}")
+        delta = None
     log(f"total bench time {time.monotonic() - t_start:.1f}s")
 
     # HEADLINE: the reference's own protocol — same shape, same
@@ -1056,6 +1158,17 @@ def main() -> None:
         extra["decision_events_total"] = recorder[
             "decision_events_total"]
         extra["decision_skips_by_reason"] = recorder["skips_by_reason"]
+    if delta is not None:
+        # delta-sync sessions: steady-state wire cost vs the full sync
+        # frame, plus the forced-resync count and the steady-state
+        # solve wall on the churn shape (docs/SOLVER_PROTOCOL.md)
+        extra["delta_bytes_per_cycle"] = delta["delta_bytes_per_cycle"]
+        extra["delta_full_frame_bytes"] = delta["full_frame_bytes"]
+        extra["delta_bytes_ratio"] = delta["bytes_ratio"]
+        extra["resync_count"] = delta["resync_count"]
+        extra["delta_cycle_ms_p50_50k_1k"] = round(
+            delta["cycle_ms_p50"], 2)
+        extra["delta_churn_per_cycle"] = delta["churn_per_cycle"]
     # degradation events across every solver-routed scenario, so the
     # perf trajectory records backend faults alongside throughput
     solver_runs = [sim, sim_solver_cpu, sim_solver_dev, sim_large, chaos]

@@ -111,12 +111,29 @@ class FaultInjector:
         return sum(n for f, n in self.injected.items() if f != OK)
 
 
-def _corrupt_plan_response(header: dict, blob: bytes) -> tuple[dict, bytes]:
+def _corrupt_plan_response(header: dict, blob: bytes,
+                           server=None) -> tuple[dict, bytes]:
     """A decodable response whose plan violates every invariant the
     sanity guard checks: all rows (null + padding included) admitted,
-    flavor options far out of range."""
+    flavor options far out of range.
+
+    Session frames are covered too: a SYNC/legacy request carries the
+    problem inline; for a DELTA the workload-axis width comes from the
+    server's resident session (no session -> an in-band resync, which
+    is itself a valid fault for the client's fallback path)."""
+    if header.get("kind") == "delta":
+        sess = (server.get_session(str(header.get("sid", "")))
+                if server is not None else None)
+        if sess is None or sess.kwargs is None:
+            return {"ok": False, "resync": "session_missing"}, b""
+        W1 = sess.kwargs["wl_cqid"].shape[0]
+        return _corrupt_plan_arrays(header, W1)
     problem = deserialize_problem(header["meta"], blob)
     W1 = problem.wl_cqid.shape[0]
+    return _corrupt_plan_arrays(header, W1)
+
+
+def _corrupt_plan_arrays(header: dict, W1: int) -> tuple[dict, bytes]:
     admitted = np.ones(W1, dtype=bool)
     parked = np.zeros(W1, dtype=bool)
     admit_round = np.zeros(W1, dtype=np.int32)
@@ -190,7 +207,8 @@ class _ChaosHandler(socketserver.StreamRequestHandler):
             return
         if fault == CORRUPT_PLAN:
             try:
-                resp_h, resp_b = _corrupt_plan_response(header, blob)
+                resp_h, resp_b = _corrupt_plan_response(
+                    header, blob, self.server)
                 _send(self.request, resp_h, resp_b)
             except OSError:
                 pass
@@ -198,7 +216,9 @@ class _ChaosHandler(socketserver.StreamRequestHandler):
         if fault == SLOW:
             time.sleep(injector.slow_s)
         # healthy tail: the production respond path, shared verbatim
-        respond(self.request, header, blob)
+        # (session frames included: the chaos server inherits the
+        # production session store)
+        respond(self.request, header, blob, self.server)
 
 
 class ChaosSolverServer(SolverServer):

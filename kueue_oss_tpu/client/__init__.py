@@ -229,6 +229,17 @@ class WorkloadClient(_ResourceClient):
           WHOLE status is written back — a stale cache clobbers
           concurrent writers, which is exactly the behavior the gate
           exists to fix.
+
+        ALIASING HAZARD (gate enabled): the merge-patch path swaps a
+        deepcopy into the store, so any pre-existing in-memory
+        reference to the old object — a queued WorkloadInfo wrapper, a
+        snapshot entry, a captured `cached` — keeps pointing at the
+        STALE object until the store's update event re-syncs it. The
+        legacy path mutated in place, so old references saw the write
+        immediately. Callers holding long-lived references must
+        re-fetch after a patch (or subscribe to store events) rather
+        than reading through a pre-patch pointer; see
+        docs/SOLVER_PROTOCOL.md "Known hazards".
         """
         import copy as _copy
 

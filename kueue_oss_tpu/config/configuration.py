@@ -151,6 +151,11 @@ class SolverBackendConfig:
     breaker_failure_threshold: int = 3
     #: how long a tripped breaker refuses calls before one probe
     breaker_cooldown_seconds: float = 30.0
+    #: delta-sync sessions (docs/SOLVER_PROTOCOL.md): ship dirty-row
+    #: deltas against sidecar-resident problem state instead of the
+    #: full padded problem per drain. None = KUEUE_SOLVER_SESSIONS env
+    #: (default on); False forces the stateless legacy frames.
+    sessions_enabled: Optional[bool] = None
 
 
 @dataclass
@@ -352,6 +357,7 @@ def load(data: Optional[dict] = None) -> Configuration:
             "maxFrameBytes": ("max_frame_bytes", int),
             "breakerFailureThreshold": ("breaker_failure_threshold", int),
             "breakerCooldown": ("breaker_cooldown_seconds", float),
+            "sessionsEnabled": ("sessions_enabled", bool),
         })
 
     def conv_integrations(d: dict) -> list[str]:

@@ -43,14 +43,27 @@ _NAME_PART_RE = re.compile(r"^[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?$")
 def is_qualified_name(value: str) -> bool:
     """k8s qualified name: optional `prefix/` (DNS subdomain, <=253)
     plus a name part (<=63) — metavalidation.ValidateLabelName's shape
-    and length rules, shared by the gate-name and topology-level
-    checks."""
+    and length rules, shared by the topology-level checks."""
     prefix, sep, name = value.rpartition("/")
     if sep and (not prefix or len(prefix) > 253
                 or not _NAME_PART_RE.match(prefix)):
         return False
     return bool(name) and len(name) <= 63 and bool(
         _NAME_PART_RE.match(name))
+
+
+def is_domain_prefixed_path(value: str) -> bool:
+    """validation.IsDomainPrefixedPath: a REQUIRED `prefix/name` form
+    with a DNS-subdomain prefix. Admission gate names use this (the
+    reference's validation_admissiongatedby.go), so bare names like
+    'mygate' are rejected; topology label names keep the
+    prefix-optional qualified-name rules above."""
+    prefix, sep, name = value.partition("/")
+    if not sep or not prefix or not name:
+        return False
+    if len(prefix) > 253 or not _NAME_PART_RE.match(prefix):
+        return False
+    return is_qualified_name(name)
 
 
 def _gated_by(job) -> str:
@@ -80,9 +93,9 @@ def _validate_gated_by_format(value: str) -> list[str]:
         if len(gate) > _MAX_GATE_NAME_LEN:
             errs.append(f"admission-gated-by: gate {gate!r} exceeds "
                         f"{_MAX_GATE_NAME_LEN} chars")
-        elif not is_qualified_name(gate):
+        elif not is_domain_prefixed_path(gate):
             errs.append(f"admission-gated-by: gate {gate!r} is not a "
-                        "qualified name")
+                        "domain-prefixed path (want 'prefix/name')")
     return errs
 
 

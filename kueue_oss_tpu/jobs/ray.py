@@ -149,7 +149,9 @@ class RayJob(_RayBase):
         if self.queue_name and self.cluster_selector:
             errs.append("clusterSelector: a kueue managed job should "
                         "not use an existing cluster")
-        elif self.queue_name and not self.shutdown_after_job_finishes:
+        # independent of the clusterSelector rule: the reference rayjob
+        # webhook reports both violations when both are present
+        if self.queue_name and not self.shutdown_after_job_finishes:
             errs.append("shutdownAfterJobFinishes: a kueue managed job "
                         "should delete the cluster after finishing")
         return errs

@@ -437,6 +437,21 @@ solver_plan_rejected_total = registry.register(Counter(
     "kueue_tpu_solver_plan_rejected_total",
     "Imported plans rejected wholesale by the sanity guard", ()))
 
+# -- delta-sync solver sessions (docs/SOLVER_PROTOCOL.md) --------------------
+
+solver_resync_total = registry.register(Counter(
+    "kueue_tpu_solver_resync_total",
+    "Session full-resyncs forced by a sidecar state divergence, by "
+    "reason (session_missing/epoch_mismatch/checksum_mismatch/...)",
+    ("reason",)))
+solver_session_frames_total = registry.register(Counter(
+    "kueue_tpu_solver_session_frames_total",
+    "Solver request frames shipped by kind (sync/delta/resync/legacy)",
+    ("kind",)))
+solver_session_bytes_total = registry.register(Counter(
+    "kueue_tpu_solver_session_bytes_total",
+    "Solver request payload bytes shipped by frame kind", ("kind",)))
+
 # -- decision flight recorder (obs/) -----------------------------------------
 
 decision_events_total = registry.register(Counter(

@@ -17,3 +17,9 @@ from kueue_oss_tpu.solver.resilience import (  # noqa: F401
     SolverHealth,
     SolverUnavailable,
 )
+from kueue_oss_tpu.solver.delta import (  # noqa: F401
+    DeviceResidentProblem,
+    HostDeltaSession,
+    ProblemDelta,
+    SessionFrame,
+)

@@ -27,6 +27,10 @@ from kueue_oss_tpu.core.queue_manager import QueueManager
 from kueue_oss_tpu.core.store import Store
 from kueue_oss_tpu.core.workload_info import WorkloadInfo
 from kueue_oss_tpu import metrics, obs
+from kueue_oss_tpu.solver.delta import (
+    DeviceResidentProblem,
+    HostDeltaSession,
+)
 from kueue_oss_tpu.solver.kernels import solve_backlog, to_device
 from kueue_oss_tpu.solver.resilience import SolverHealth, SolverUnavailable
 from kueue_oss_tpu.solver.tensors import (
@@ -129,6 +133,25 @@ class SolverEngine:
         #: host cycle the drain serves (scheduler.cycle_count + 1), so a
         #: merged trace groups the drain with the cycle it replaced
         self._drain_cycle = 0
+        #: delta-sync sessions (docs/SOLVER_PROTOCOL.md): successive
+        #: drains re-encode the padded problem into a stable slot space
+        #: and ship only the dirty-row delta; the sidecar (remote) or
+        #: the resident device buffers (in-process) hold the rest. One
+        #: session per kernel kind — lean and full exports differ.
+        import os as _os
+
+        self.use_sessions = _os.environ.get(
+            "KUEUE_SOLVER_SESSIONS") != "0"
+        self._delta_sessions: dict[str, HostDeltaSession] = {}
+        #: in-process resident device tensors keyed by session epoch, so
+        #: the non-remote path stops re-uploading the full problem too
+        self._device_states: dict[str, DeviceResidentProblem] = {}
+        #: single worker for pipelined drain dispatch: the remote solve
+        #: round-trip overlaps host-side apply prework
+        self._solve_pool = None
+        #: apply prework computed during the overlap window (consumed
+        #: and cleared by the apply paths)
+        self._prework: Optional[dict] = None
 
     def _tracer(self):
         if self.tracer is not None:
@@ -261,12 +284,14 @@ class SolverEngine:
                 return False
         return True
 
-    def _compute_tas_assignments(self, candidates):
+    def _compute_tas_assignments(self, candidates, snapshot=None):
         """Device-place admitted TAS candidates in admission order.
 
         Returns (kept_candidates, topology_by_workload_key); candidates
         whose placement failed are dropped — they stay in their heaps
-        for the host mop-up cycles after the drain."""
+        for the host mop-up cycles after the drain. ``snapshot`` is the
+        pipelined-dispatch prework (lean drains only — the full path's
+        evictions invalidate a pre-built snapshot)."""
         tas_items = []
         for cand in candidates:
             _wl, cq_name, flavor_of, info, _usage = cand
@@ -281,7 +306,8 @@ class SolverEngine:
 
         if self._tas_placer is None:
             self._tas_placer = DeviceTASPlacer(self.store)
-        snapshot = build_snapshot(self.store)
+        if snapshot is None:
+            snapshot = build_snapshot(self.store)
         placements = self._tas_placer.place_batch(snapshot, tas_items)
         # only candidates actually submitted for placement can fail out
         # of the plan; a TAS-CQ candidate with no flavored resources has
@@ -348,7 +374,13 @@ class SolverEngine:
         tracer = self._tracer()
         with (tracer.span("solver_drain", cycle=self._drain_cycle)
               if tracer is not None else contextlib.nullcontext()):
-            return self._drain(now, verify)
+            try:
+                return self._drain(now, verify)
+            finally:
+                # prework computed for a drain that failed before its
+                # apply must never leak into the next drain (stale
+                # workload refs would bypass the store lookups)
+                self._prework = None
 
     def _drain(self, now: float, verify: bool) -> DrainResult:
         pending = self.pending_backlog()
@@ -361,13 +393,15 @@ class SolverEngine:
         self._pad_hwm = max(self._pad_hwm,
                             _pow2(max(problem.n_workloads, self.pad_to)))
         problem = pad_workloads(problem, self._pad_hwm)
+        problem, frame = self._session_encode("lean", problem)
 
         t0 = time.monotonic()
         if self.remote is not None:
             (admitted, opt, admit_round, parked, rounds,
-             _usage) = self._remote_solve(problem, 6, full=False)
+             _usage) = self._dispatch_remote(
+                problem, 6, frame, "lean", verify, full=False)
         else:
-            tensors = to_device(problem)
+            tensors = self._local_tensors(problem, frame, full=False)
             (admitted, opt, admit_round, parked, rounds,
              _usage) = solve_backlog(tensors)
         admitted = np.asarray(admitted)
@@ -393,6 +427,102 @@ class SolverEngine:
         metrics.solver_cycle_duration_seconds.observe(
             "apply", value=result.apply_time_s)
         return result
+
+    # -- delta-sync sessions + pipelined dispatch --------------------------
+
+    def _session_encode(self, kind: str, problem: SolverProblem):
+        """Stable slot/rank re-encoding + the SessionFrame to ship.
+
+        Returns (problem, None) with sessions disabled — the drain then
+        behaves exactly like the pre-session engine. A remote client
+        configured for legacy frames (sessions_enabled=false) disables
+        the whole session layer: there is no point paying the stable
+        re-encoding for deltas that would never be sent.
+        """
+        if not self.use_sessions:
+            return problem, None
+        if (self.remote is not None
+                and not getattr(self.remote, "use_sessions", True)):
+            return problem, None
+        sess = self._delta_sessions.get(kind)
+        if sess is None:
+            # the full kernel has no wl_rank tensor (FIFO order rides
+            # the timestamp ranks); neutralizing it keeps per-CQ rank
+            # ripples off the full session's wire
+            neutral = ("wl_rank",) if kind == "full" else ()
+            sess = HostDeltaSession(cache=self.export_cache,
+                                    neutral_fields=neutral)
+            self._delta_sessions[kind] = sess
+        return sess.advance(problem)
+
+    def _local_tensors(self, problem: SolverProblem, frame, *,
+                       full: bool):
+        """In-process path: resident device buffers keyed by session
+        epoch — a delta epoch scatters only the dirty rows to the
+        device instead of re-uploading the padded problem."""
+        if frame is None:
+            if full:
+                from kueue_oss_tpu.solver.full_kernels import (
+                    to_device_full,
+                )
+
+                return to_device_full(problem)
+            return to_device(problem)
+        kind = "full" if full else "lean"
+        dev = self._device_states.get(kind)
+        if dev is None:
+            dev = self._device_states[kind] = DeviceResidentProblem()
+        return dev.update(problem, frame, full)
+
+    def _dispatch_remote(self, problem: SolverProblem, expect: int,
+                         frame, session_key: str, verify: bool,
+                         **solve_kw):
+        """Pipelined drain dispatch: the remote solve round-trip runs on
+        a worker thread while this thread computes the apply prework
+        (snapshot for the verify/TAS paths, workload-ref prefetch), so
+        the wire RTT overlaps host work instead of adding to it."""
+        kw = dict(solve_kw)
+        if frame is not None and getattr(self.remote,
+                                         "supports_sessions", False):
+            kw["frame"] = frame
+            kw["session_key"] = session_key
+        pool = self._solve_executor()
+        fut = pool.submit(self._remote_solve, problem, expect, **kw)
+        try:
+            self._prework = self._build_prework(
+                problem, verify, full=bool(solve_kw.get("full")))
+        except Exception:
+            self._prework = None  # prework is an optimization only
+        return fut.result()
+
+    def _solve_executor(self):
+        if self._solve_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._solve_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="solver-dispatch")
+        return self._solve_pool
+
+    def _build_prework(self, problem: SolverProblem, verify: bool,
+                       full: bool) -> dict:
+        """Plan-independent apply preparation, safe to compute before
+        the plan arrives. The full path cannot pre-build the oracle
+        snapshot (its evictions change usage before the verify), so it
+        only prefetches workload refs; the lean path pre-builds the
+        snapshot its verify/TAS placement would otherwise build after
+        the response."""
+        pre: dict = {}
+        if not full and (verify or self._drain_tas_ready):
+            from kueue_oss_tpu.core.snapshot import build_snapshot
+
+            pre["snapshot"] = build_snapshot(self.store)
+        pre["wl_of"] = {k: self.store.workloads.get(k)
+                        for k in problem.wl_keys if k}
+        return pre
+
+    def _take_prework(self) -> dict:
+        pre, self._prework = (self._prework or {}), None
+        return pre
 
     # -- backend resilience ------------------------------------------------
 
@@ -586,13 +716,16 @@ class SolverEngine:
         # Collect the committed plan entries in admission order first, so
         # the optional oracle verification can run as one batched native
         # call (SURVEY.md §7 step 4 verify-then-assume pattern).
+        pre = self._take_prework()
+        wl_of = pre.get("wl_of")
         adm_ws = np.nonzero(admitted[:-1])[0]
         order = adm_ws[np.argsort(admit_round[adm_ws], kind="stable")]
         candidates = []
         declared_of: dict[str, set] = {}
         for w in order:
             key = problem.wl_keys[w]
-            wl = self.store.workloads.get(key)
+            wl = (wl_of.get(key) if wl_of is not None
+                  else self.store.workloads.get(key))
             if wl is None or wl.is_quota_reserved or not wl.active:
                 continue
             cq_name = problem.cq_names[problem.wl_cqid[w]]
@@ -614,7 +747,8 @@ class SolverEngine:
                     plan_usage[fr] = plan_usage.get(fr, 0) + q
             candidates.append((wl, cq_name, flavor, info, plan_usage))
 
-        candidates, topo_of = self._compute_tas_assignments(candidates)
+        candidates, topo_of = self._compute_tas_assignments(
+            candidates, snapshot=pre.get("snapshot"))
 
         if verify and candidates:
             # Verify-then-fallback (scheduler.go:427 fits re-check): plan
@@ -622,10 +756,13 @@ class SolverEngine:
             # workloads stay queued for the host scheduler path. The
             # sequential fits/add_usage walk runs in native code when the
             # toolchain is available (kueue_oss_tpu/native/oracle.cpp).
+            # The snapshot comes from the pipelined-dispatch prework
+            # when it overlapped the solve (no mutations since export).
             from kueue_oss_tpu.core.snapshot import build_snapshot
             from kueue_oss_tpu.native import BatchOracle
 
-            oracle = BatchOracle(build_snapshot(self.store).forest.cqs)
+            snapshot = pre.get("snapshot") or build_snapshot(self.store)
+            oracle = BatchOracle(snapshot.forest.cqs)
             ok = oracle.verify_and_apply(
                 [(cq_name, usage)
                  for _, cq_name, _, _, usage in candidates])
@@ -766,10 +903,7 @@ class SolverEngine:
         like Scheduler._issue_preemptions → evict_workload), then
         admissions in (round, entry-order), then parking decisions.
         """
-        from kueue_oss_tpu.solver.full_kernels import (
-            solve_backlog_full,
-            to_device_full,
-        )
+        from kueue_oss_tpu.solver.full_kernels import solve_backlog_full
 
         result = DrainResult()
         if pending is None:
@@ -799,15 +933,17 @@ class SolverEngine:
         self._pad_hwm = max(self._pad_hwm,
                             _pow2(max(problem.n_workloads, self.pad_to)))
         problem = pad_workloads(problem, self._pad_hwm)
+        problem, frame = self._session_encode("full", problem)
 
         t0 = time.monotonic()
         if self.remote is not None:
             (admitted, opt, admit_round, parked, rounds, _usage,
-             _wl_usage, victim_reason) = self._remote_solve(
-                problem, 8, full=True, g_max=g_max, h_max=h_max,
-                p_max=p_max, fs_enabled=self.enable_fair_sharing)
+             _wl_usage, victim_reason) = self._dispatch_remote(
+                problem, 8, frame, "full", verify, full=True,
+                g_max=g_max, h_max=h_max, p_max=p_max,
+                fs_enabled=self.enable_fair_sharing)
         else:
-            tensors = to_device_full(problem)
+            tensors = self._local_tensors(problem, frame, full=True)
             (admitted, opt, admit_round, parked, rounds, _usage,
              _wl_usage, victim_reason) = solve_backlog_full(
                 tensors, g_max, h_max, p_max,
@@ -858,6 +994,13 @@ class SolverEngine:
         reason_of = dict(_VARIANT_REASON)
         reason_of[V_FAIR_SHARING] = IN_COHORT_FAIR_SHARING
 
+        pre = self._take_prework()
+        wl_of = pre.get("wl_of")
+
+        def lookup(key):
+            return (wl_of.get(key) if wl_of is not None
+                    else self.store.workloads.get(key))
+
         W = problem.n_workloads
         wl_admitted0 = problem.wl_admitted0
 
@@ -870,7 +1013,7 @@ class SolverEngine:
             & ~(admitted[:W] & (admit_round[:W] < 0)))[0]
         for w in evict_ws:
             key = problem.wl_keys[w]
-            wl = self.store.workloads.get(key)
+            wl = lookup(key)
             if wl is None or not wl.is_quota_reserved:
                 continue
             reason = reason_of.get(int(victim_reason[w]),
@@ -891,7 +1034,7 @@ class SolverEngine:
         candidates = []
         for w in order:
             key = problem.wl_keys[w]
-            wl = self.store.workloads.get(key)
+            wl = lookup(key)
             if wl is None or wl.is_quota_reserved or not wl.active:
                 continue
             cq_name = problem.cq_names[problem.wl_cqid[w]]

@@ -7,10 +7,25 @@ process, so the control plane never blocks on device compilation and
 the solver can sit on the TPU host while the scheduler runs elsewhere.
 
 Wire contract (BASELINE.json: tensor export ≙ Cache.Snapshot, plan
-import ≙ assume path):
+import ≙ assume path; docs/SOLVER_PROTOCOL.md has the full spec):
 
-  request  = header JSON {caps, fs_enabled, full} + npz(SolverProblem arrays)
-  response = header JSON {rounds}             + npz(plan arrays)
+  legacy (stateless) request:
+    header {kind?: "solve", caps, fs_enabled, full} + npz(problem arrays)
+    response = header JSON {ok, names, spans} + npz(full plan arrays)
+
+  session frames (delta-sync, the production path):
+    SYNC:  header {kind: "sync", sid, epoch, checksum, meta, caps...}
+           + npz(problem arrays) — (re)opens session ``sid`` with the
+           full padded problem pinned on the sidecar across drains
+    DELTA: header {kind: "delta", sid, epoch, base_epoch, checksum,
+           meta_delta, caps...} + npz(dirty rows + small replacements)
+    responses are COMPACT: header {ok, compact, epoch, spans} + npz of
+    decided rows only (admitted/parked/evicted indices), not eight full
+    W-sized arrays
+    RESYNC: any session/epoch/checksum mismatch answers in-band
+    {ok: false, resync: <reason>} and the client falls back to a full
+    SYNC (counted in metrics.solver_resync_total — never silently wrong;
+    the engine's plan guard still validates every imported plan)
 
 Transport is a length-prefixed unix-domain socket (protocol framing is
 what a gRPC stub would generate; no proto toolchain is assumed in the
@@ -34,7 +49,6 @@ Resilience (this layer's failure contract):
 
 from __future__ import annotations
 
-import dataclasses
 import io
 import json
 import os
@@ -49,17 +63,23 @@ from typing import Optional
 import numpy as np
 
 from kueue_oss_tpu import metrics
+from kueue_oss_tpu.solver.delta import (
+    ARRAY_FIELDS,
+    META_FIELDS,
+    DeviceResidentProblem,
+    SessionFrame,
+    apply_delta,
+    deserialize_delta,
+    serialize_delta,
+    state_checksum,
+)
 from kueue_oss_tpu.solver.resilience import SolverUnavailable
 from kueue_oss_tpu.solver.tensors import SolverProblem
 
 #: SolverProblem fields shipped as arrays; the rest go in the header
-_ARRAY_FIELDS = [
-    f.name for f in dataclasses.fields(SolverProblem)
-    if f.name not in ("fr_list", "node_names", "cq_names", "wl_keys",
-                      "cq_option_flavors", "cq_resource_group", "scale",
-                      "n_resources", "ts_evict_base", "admit_rank_base")
-]
-_META_FIELDS = ["n_resources", "ts_evict_base", "admit_rank_base", "scale"]
+#: (canonical list lives in solver/delta.py, shared with the delta layer)
+_ARRAY_FIELDS = ARRAY_FIELDS
+_META_FIELDS = META_FIELDS
 
 
 class SolverProtocolError(ConnectionError):
@@ -163,58 +183,211 @@ def deserialize_problem(meta: dict, blob: bytes) -> SolverProblem:
     return SolverProblem(**kwargs)
 
 
-def solve_request(header: dict, blob: bytes) -> tuple[dict, bytes]:
+def _solve_kernel(tensors, header: dict):
+    """Run the jitted kernel matching the request params; returns
+    (out tuple, legacy array names)."""
+    if header["full"]:
+        from kueue_oss_tpu.solver.full_kernels import solve_backlog_full
+
+        out = solve_backlog_full(
+            tensors, header["g_max"], header["h_max"], header["p_max"],
+            fs_enabled=header["fs_enabled"])
+        names = ["admitted", "opt", "admit_round", "parked",
+                 "rounds", "usage", "wl_usage", "victim_reason"]
+    else:
+        from kueue_oss_tpu.solver.kernels import solve_backlog
+
+        out = solve_backlog(tensors)
+        names = ["admitted", "opt", "admit_round", "parked",
+                 "rounds", "usage"]
+    return out, names
+
+
+def _spans(header: dict, t0: float) -> list[dict]:
+    span_args = {"full": bool(header["full"]),
+                 "kind": header.get("kind", "solve")}
+    if header.get("trace_cycle") is not None:
+        span_args["cycle"] = header["trace_cycle"]
+    return [{"name": "sidecar_solve",
+             "dur_us": int((time.perf_counter() - t0) * 1e6),
+             "args": span_args}]
+
+
+def compact_plan(out, full: bool) -> dict[str, np.ndarray]:
+    """Encode a plan as decided rows only: admitted indices (+ their
+    flavor options and rounds), parked indices, and nonzero
+    victim-reason rows — a few KB instead of eight W-sized arrays."""
+    admitted = np.asarray(out[0]).astype(bool)
+    opt = np.asarray(out[1])
+    admit_round = np.asarray(out[2])
+    parked = np.asarray(out[3]).astype(bool)
+    adm_idx = np.nonzero(admitted)[0].astype(np.int32)
+    arrays = {
+        "adm_idx": adm_idx,
+        "adm_opt": opt[adm_idx].astype(np.int32),
+        "adm_round": admit_round[adm_idx].astype(np.int32),
+        "park_idx": np.nonzero(parked)[0].astype(np.int32),
+        "rounds": np.asarray(out[4]),
+    }
+    if full:
+        vr = np.asarray(out[7])
+        vr_idx = np.nonzero(vr)[0].astype(np.int32)
+        arrays["vr_idx"] = vr_idx
+        arrays["vr_val"] = vr[vr_idx].astype(np.int32)
+    return arrays
+
+
+def expand_compact_plan(data, W1: int, full: bool, g_max: int):
+    """Client-side inverse of compact_plan: rebuild the dense arrays the
+    engine's plan guard and apply paths consume. Reconstruction is pure
+    scatter — overlaps or out-of-range indices in a corrupt response
+    survive into the dense arrays for the sanity guard to reject."""
+    adm_idx = np.asarray(data["adm_idx"])
+    adm_opt = np.asarray(data["adm_opt"])
+    admitted = np.zeros(W1, dtype=bool)
+    parked = np.zeros(W1, dtype=bool)
+    admitted[adm_idx] = True
+    parked[np.asarray(data["park_idx"])] = True
+    if full:
+        g = adm_opt.shape[1] if adm_opt.ndim == 2 else max(1, g_max)
+        opt = np.zeros((W1, g), dtype=np.int32)
+        admit_round = np.full(W1, -1, dtype=np.int32)
+    else:
+        opt = np.zeros(W1, dtype=np.int32)
+        admit_round = np.zeros(W1, dtype=np.int32)
+    opt[adm_idx] = adm_opt
+    admit_round[adm_idx] = np.asarray(data["adm_round"])
+    rounds = np.asarray(data["rounds"])
+    usage = np.zeros(1, dtype=np.int32)  # engine ignores usage tensors
+    if not full:
+        return admitted, opt, admit_round, parked, rounds, usage
+    victim = np.zeros(W1, dtype=np.int32)
+    victim[np.asarray(data["vr_idx"])] = np.asarray(data["vr_val"])
+    return (admitted, opt, admit_round, parked, rounds, usage,
+            np.zeros(1, dtype=np.int32), victim)
+
+
+class _SidecarSession:
+    """Resident state for one (sid) delta-sync session: the problem's
+    numpy mirror + the device tensors pinned across drains."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.kwargs: Optional[dict] = None
+        self.meta: Optional[dict] = None
+        self.epoch = -1
+        self.device = DeviceResidentProblem()
+
+
+def _resync(reason: str) -> tuple[dict, bytes]:
+    return {"ok": False, "resync": reason}, b""
+
+
+def _session_request(header: dict, blob: bytes,
+                     server) -> tuple[dict, bytes]:
+    """Handle a SYNC or DELTA frame against the server's session store."""
+    t0 = time.perf_counter()
+    kind = header["kind"]
+    sid = str(header.get("sid", ""))
+    if kind == "sync":
+        data = np.load(io.BytesIO(blob))
+        kwargs = {name: (np.array(data[name]) if name in data else None)
+                  for name in _ARRAY_FIELDS}
+        meta = {k: int(v) for k, v in dict(header["meta"]).items()}
+        want = header.get("checksum")
+        if want is not None and state_checksum(kwargs, meta) != int(want):
+            # a sync that decoded but doesn't match its own checksum is
+            # transport corruption, not a session-state divergence
+            return {"ok": False, "error": "sync frame checksum mismatch"
+                    }, b""
+        sess = (server.session(sid) if server is not None
+                else _SidecarSession())
+        with sess.lock:
+            sess.kwargs, sess.meta = kwargs, meta
+            sess.epoch = int(header.get("epoch", 0))
+            problem = SolverProblem(**kwargs, **meta)
+            frame = SessionFrame(epoch=sess.epoch,
+                                 checksum=int(want or 0), delta=None)
+            tensors = sess.device.update(problem, frame,
+                                         bool(header["full"]))
+            out, _names = _solve_kernel(tensors, header)
+            arrays = compact_plan(out, bool(header["full"]))
+            epoch = sess.epoch
+    else:  # delta
+        sess = server.get_session(sid) if server is not None else None
+        if sess is None:
+            return _resync("session_missing")
+        with sess.lock:
+            if sess.kwargs is None:
+                return _resync("session_missing")
+            if int(header["base_epoch"]) != sess.epoch:
+                return _resync("epoch_mismatch")
+            delta = deserialize_delta(header, blob)
+            apply_delta(sess.kwargs, sess.meta, delta)
+            sess.epoch = delta.epoch
+            if state_checksum(sess.kwargs, sess.meta) != delta.checksum:
+                # resident state diverged from the host's: drop the
+                # session so the client re-seeds it with a full SYNC
+                server.drop_session(sid)
+                return _resync("checksum_mismatch")
+            problem = SolverProblem(**sess.kwargs, **sess.meta)
+            frame = SessionFrame(epoch=delta.epoch,
+                                 checksum=delta.checksum, delta=delta)
+            tensors = sess.device.update(problem, frame,
+                                         bool(header["full"]))
+            out, _names = _solve_kernel(tensors, header)
+            arrays = compact_plan(out, bool(header["full"]))
+            epoch = sess.epoch
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return {"ok": True, "compact": True, "epoch": epoch,
+            "spans": _spans(header, t0)}, buf.getvalue()
+
+
+def solve_request(header: dict, blob: bytes,
+                  server=None) -> tuple[dict, bytes]:
     """Run one solve for a decoded request; returns (header, npz blob).
 
     Shared by the production handler and the chaos harness (which wraps
-    it to corrupt/delay/drop the response deterministically).
+    it to corrupt/delay/drop the response deterministically). ``server``
+    carries the session store for SYNC/DELTA frames; without it, SYNC
+    degrades to a stateless solve and DELTA answers resync.
 
     The optional ``trace_cycle`` header field is the host scheduler's
     cycle id: the response carries a ``spans`` list timing the sidecar
     solve, tagged with that cycle, so the engine can merge it into the
     host Tracer's Chrome-trace export as one timeline.
     """
+    kind = header.get("kind", "solve")
+    if kind in ("sync", "delta"):
+        if kind == "delta" and server is None:
+            return _resync("session_unsupported")
+        return _session_request(header, blob, server)
     t0 = time.perf_counter()
     problem = deserialize_problem(header["meta"], blob)
     if header["full"]:
-        from kueue_oss_tpu.solver.full_kernels import (
-            solve_backlog_full,
-            to_device_full,
-        )
+        from kueue_oss_tpu.solver.full_kernels import to_device_full
 
-        out = solve_backlog_full(
-            to_device_full(problem), header["g_max"],
-            header["h_max"], header["p_max"],
-            fs_enabled=header["fs_enabled"])
-        names = ["admitted", "opt", "admit_round", "parked",
-                 "rounds", "usage", "wl_usage", "victim_reason"]
+        tensors = to_device_full(problem)
     else:
-        from kueue_oss_tpu.solver.kernels import (
-            solve_backlog,
-            to_device,
-        )
+        from kueue_oss_tpu.solver.kernels import to_device
 
-        out = solve_backlog(to_device(problem))
-        names = ["admitted", "opt", "admit_round", "parked",
-                 "rounds", "usage"]
+        tensors = to_device(problem)
+    out, names = _solve_kernel(tensors, header)
     buf = io.BytesIO()
     np.savez(buf, **{n: np.asarray(v) for n, v in zip(names, out)})
-    span_args = {"full": bool(header["full"])}
-    if header.get("trace_cycle") is not None:
-        span_args["cycle"] = header["trace_cycle"]
-    spans = [{"name": "sidecar_solve",
-              "dur_us": int((time.perf_counter() - t0) * 1e6),
-              "args": span_args}]
-    return {"ok": True, "names": names, "spans": spans}, buf.getvalue()
+    return {"ok": True, "names": names,
+            "spans": _spans(header, t0)}, buf.getvalue()
 
 
-def respond(sock: socket.socket, header: dict, blob: bytes) -> None:
+def respond(sock: socket.socket, header: dict, blob: bytes,
+            server=None) -> None:
     """Solve a decoded request and reply on ``sock``; solve-side
     exceptions are reported in-band, a vanished client is ignored.
     Shared by the production handler and the chaos harness's healthy
     tail, so the two cannot drift apart."""
     try:
-        resp_header, resp_blob = solve_request(header, blob)
+        resp_header, resp_blob = solve_request(header, blob, server)
     except Exception as e:  # report in-band; don't wedge the thread
         resp_header, resp_blob = {"ok": False, "error": repr(e)}, b""
     try:
@@ -234,7 +407,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 deadline=time.monotonic() + self.server.read_timeout_s)
         except (ConnectionError, TimeoutError):
             return  # covers SolverProtocolError: drop the bad request
-        respond(self.request, header, blob)
+        respond(self.request, header, blob, self.server)
 
 
 class SolverServer(socketserver.ThreadingUnixStreamServer):
@@ -248,7 +421,8 @@ class SolverServer(socketserver.ThreadingUnixStreamServer):
 
     def __init__(self, socket_path: str,
                  max_frame_bytes: Optional[int] = None,
-                 read_timeout_s: Optional[float] = None) -> None:
+                 read_timeout_s: Optional[float] = None,
+                 max_sessions: int = 4) -> None:
         if os.path.exists(socket_path):
             os.unlink(socket_path)
         super().__init__(socket_path, _Handler)
@@ -257,11 +431,53 @@ class SolverServer(socketserver.ThreadingUnixStreamServer):
                                 is not None else default_max_frame_bytes())
         self.read_timeout_s = (read_timeout_s if read_timeout_s
                                is not None else default_timeout_s())
+        #: delta-sync session store (sid -> _SidecarSession), LRU-capped
+        #: so abandoned sessions can't accumulate resident problems
+        self.sessions: dict[str, _SidecarSession] = {}
+        self._sessions_lock = threading.Lock()
+        self.max_sessions = max(1, int(max_sessions))
+
+    def session(self, sid: str) -> _SidecarSession:
+        with self._sessions_lock:
+            sess = self.sessions.pop(sid, None)
+            if sess is None:
+                sess = _SidecarSession()
+            self.sessions[sid] = sess  # re-insert = LRU touch
+            while len(self.sessions) > self.max_sessions:
+                self.sessions.pop(next(iter(self.sessions)))
+            return sess
+
+    def get_session(self, sid: str) -> Optional[_SidecarSession]:
+        with self._sessions_lock:
+            sess = self.sessions.pop(sid, None)
+            if sess is not None:
+                self.sessions[sid] = sess
+            return sess
+
+    def drop_session(self, sid: str) -> None:
+        with self._sessions_lock:
+            self.sessions.pop(sid, None)
 
     def serve_in_background(self) -> threading.Thread:
         t = threading.Thread(target=self.serve_forever, daemon=True)
         t.start()
         return t
+
+
+class _ClientSession:
+    """Client-side view of one sidecar session (per engine kernel kind)."""
+
+    __slots__ = ("sid", "acked_epoch")
+
+    def __init__(self) -> None:
+        self.sid = os.urandom(8).hex()
+        self.acked_epoch = -1
+
+
+class _ResyncRequested(Exception):
+    def __init__(self, reason: str) -> None:
+        super().__init__(reason)
+        self.reason = reason
 
 
 class SolverClient:
@@ -274,9 +490,20 @@ class SolverClient:
     attempts. Exhaustion — deadline or retries — raises
     ``SolverUnavailable`` for the engine's circuit breaker.
 
+    With a ``frame`` (a delta-session SessionFrame from the engine's
+    HostDeltaSession), the request goes out as a DELTA when the sidecar
+    is known to hold the frame's base epoch, else a full SYNC; an
+    in-band resync answer falls back to a SYNC within the same call
+    (once — a second resync demand is a backend fault). Duplicate
+    delivery is safe: the sidecar's epoch guard rejects an already-
+    applied delta with a resync, which the SYNC fallback absorbs.
+
     ``clock``/``sleep`` are injectable so the chaos tests drive the
     deadline/backoff logic without real waiting.
     """
+
+    #: engines check this before routing session frames here
+    supports_sessions = True
 
     def __init__(self, socket_path: str,
                  timeout_s: Optional[float] = None,
@@ -286,7 +513,8 @@ class SolverClient:
                  max_frame_bytes: Optional[int] = None,
                  jitter_seed: int = 0,
                  clock=time.monotonic,
-                 sleep=time.sleep) -> None:
+                 sleep=time.sleep,
+                 sessions: Optional[bool] = None) -> None:
         self.socket_path = socket_path
         self.timeout_s = (timeout_s if timeout_s is not None
                           else default_timeout_s())
@@ -304,6 +532,15 @@ class SolverClient:
         self.trace_cycle: Optional[int] = None
         #: sidecar spans from the LAST successful solve's response header
         self.last_spans: list[dict] = []
+        if sessions is None:
+            sessions = os.environ.get("KUEUE_SOLVER_SESSIONS") != "0"
+        self.use_sessions = bool(sessions)
+        self._sessions: dict[str, _ClientSession] = {}
+        #: wire accounting for bench/diagnostics: bytes per frame kind
+        #: and the last successful frame's (kind, bytes)
+        self.bytes_by_kind: dict[str, int] = {}
+        self.frames_by_kind: dict[str, int] = {}
+        self.last_frame: Optional[tuple[str, int]] = None
 
     @classmethod
     def from_config(cls, cfg) -> "SolverClient":
@@ -316,18 +553,32 @@ class SolverClient:
                    max_retries=cfg.max_retries,
                    backoff_base_s=cfg.retry_backoff_base_seconds,
                    backoff_max_s=cfg.retry_backoff_max_seconds,
-                   max_frame_bytes=cfg.max_frame_bytes)
+                   max_frame_bytes=cfg.max_frame_bytes,
+                   sessions=getattr(cfg, "sessions_enabled", None))
 
-    def solve(self, problem: SolverProblem, *, full: bool,
-              g_max: int = 1, h_max: int = 32, p_max: int = 128,
-              fs_enabled: bool = False):
-        meta, blob = serialize_problem(problem)
-        header = {"meta": meta, "full": full, "g_max": g_max,
-                  "h_max": h_max, "p_max": p_max,
-                  "fs_enabled": fs_enabled}
+    # -- payload builders --------------------------------------------------
+
+    def _base_params(self, full: bool, g_max: int, h_max: int,
+                     p_max: int, fs_enabled: bool) -> dict:
+        params = {"full": full, "g_max": g_max, "h_max": h_max,
+                  "p_max": p_max, "fs_enabled": fs_enabled}
         if self.trace_cycle is not None:
-            header["trace_cycle"] = int(self.trace_cycle)
-        self.last_spans = []
+            params["trace_cycle"] = int(self.trace_cycle)
+        return params
+
+    def _build_payload(self, mode: str, problem: SolverProblem,
+                       params: dict, frame, st) -> tuple[dict, bytes]:
+        if mode == "legacy":
+            meta, blob = serialize_problem(problem)
+            header = {**params, "meta": meta}
+        elif mode == "delta":
+            dh, blob = serialize_delta(frame.delta)
+            header = {**params, **dh, "kind": "delta", "sid": st.sid}
+        else:  # sync / resync
+            meta, blob = serialize_problem(problem)
+            header = {**params, "meta": meta, "kind": "sync",
+                      "sid": st.sid, "epoch": frame.epoch,
+                      "checksum": frame.checksum}
         # enforce the frame guard on our OWN request too: a server-side
         # rejection of an oversized frame shows up as a reset/EOF and
         # would be misread as a transient connection fault and retried
@@ -338,8 +589,37 @@ class SolverClient:
                 f"request frame of {n_frame} bytes exceeds the "
                 f"{self.max_frame_bytes}-byte limit (problem too large "
                 "for the remote backend)")
+        return header, blob
+
+    def _account(self, kind: str, header: dict, blob: bytes) -> None:
+        n = len(json.dumps(header).encode()) + len(blob)
+        self.bytes_by_kind[kind] = self.bytes_by_kind.get(kind, 0) + n
+        self.frames_by_kind[kind] = self.frames_by_kind.get(kind, 0) + 1
+        self.last_frame = (kind, n)
+        metrics.solver_session_frames_total.inc(kind)
+        metrics.solver_session_bytes_total.inc(kind, by=float(n))
+
+    # -- the call ----------------------------------------------------------
+
+    def solve(self, problem: SolverProblem, *, full: bool,
+              g_max: int = 1, h_max: int = 32, p_max: int = 128,
+              fs_enabled: bool = False, frame=None,
+              session_key: str = "default"):
+        params = self._base_params(full, g_max, h_max, p_max, fs_enabled)
+        self.last_spans = []
+        st = None
+        mode = "legacy"
+        if frame is not None and self.use_sessions:
+            st = self._sessions.setdefault(session_key, _ClientSession())
+            mode = ("delta" if (frame.delta is not None
+                                and st.acked_epoch
+                                == frame.delta.base_epoch)
+                    else "sync")
+        header, blob = self._build_payload(mode, problem, params,
+                                           frame, st)
         deadline = self._clock() + self.timeout_s
         attempt = 0
+        resynced = False
         last_err: Optional[BaseException] = None
         while True:
             remaining = deadline - self._clock()
@@ -350,7 +630,27 @@ class SolverClient:
                     f"after {attempt} attempt(s): {last_err!r}"
                 ) from last_err
             try:
-                return self._solve_once(header, blob, remaining)
+                out = self._solve_once(header, blob, remaining,
+                                       problem, params)
+                if st is not None:
+                    st.acked_epoch = frame.epoch
+                self._account("resync" if resynced else mode,
+                              header, blob)
+                return out
+            except _ResyncRequested as e:
+                # the sidecar lost (or never had) our session state:
+                # fall back to a full SYNC within this same call. Does
+                # not count against the transport retry budget — the
+                # sidecar is demonstrably alive.
+                metrics.solver_resync_total.inc(e.reason)
+                if mode != "delta" or resynced:
+                    raise SolverUnavailable(
+                        f"sidecar demanded resync twice: {e.reason}")
+                resynced = True
+                mode = "sync"
+                header, blob = self._build_payload(
+                    "sync", problem, params, frame, st)
+                continue
             except (TimeoutError, socket.timeout) as e:
                 last_err = e
                 metrics.solver_remote_failures_total.inc("timeout")
@@ -373,7 +673,8 @@ class SolverClient:
             if delay > 0:
                 self._sleep(delay)
 
-    def _solve_once(self, header: dict, blob: bytes, budget_s: float):
+    def _solve_once(self, header: dict, blob: bytes, budget_s: float,
+                    problem: SolverProblem, params: dict):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.settimeout(budget_s)  # bounds connect and the send as ops
         op_deadline = self._clock() + budget_s
@@ -387,6 +688,8 @@ class SolverClient:
         finally:
             sock.close()
         if not resp.get("ok", False):
+            if isinstance(resp.get("resync"), str):
+                raise _ResyncRequested(resp["resync"])
             # the sidecar is up but the solve itself failed; a retry
             # would deterministically fail again, so don't burn the
             # deadline on it
@@ -394,14 +697,21 @@ class SolverClient:
             raise SolverUnavailable(
                 f"solver sidecar reported failure: "
                 f"{resp.get('error', 'unknown')}")
-        names = resp.get("names")
-        if not isinstance(names, list) or not names:
-            raise SolverProtocolError("response header carries no names")
         spans = resp.get("spans")
         self.last_spans = spans if isinstance(spans, list) else []
         try:
             data = np.load(io.BytesIO(body))
+            if resp.get("compact"):
+                return expand_compact_plan(
+                    data, problem.wl_cqid.shape[0],
+                    bool(params["full"]), int(params["g_max"]))
+            names = resp.get("names")
+            if not isinstance(names, list) or not names:
+                raise SolverProtocolError(
+                    "response header carries no names")
             return tuple(data[n] for n in names)
+        except SolverProtocolError:
+            raise
         except Exception as e:  # zipfile/np decode errors on corruption
             raise SolverProtocolError(
                 f"undecodable plan payload: {e!r}") from e

@@ -151,6 +151,14 @@ class SolverProblem:
     wl_ts_buf: Optional[np.ndarray] = None          # [W+1] int32
     lq_penalty0: Optional[np.ndarray] = None        # [L+1] float32
     cq_afs: Optional[np.ndarray] = None             # [C] bool
+    #: host-only raw inputs behind the dense encodings above; the
+    #: delta-session layer (solver/delta.py) re-ranks them with stable
+    #: order-preserving ids so churn doesn't dirty every row. Never
+    #: serialized to the sidecar.
+    wl_raw_ts: Optional[np.ndarray] = None          # [W+1] float64
+    wl_raw_admit_ts: Optional[np.ndarray] = None    # [W+1] float64
+    wl_class_tok: Optional[np.ndarray] = None       # [W+1] int64 (-1 none)
+    class_tok_root: Optional[np.ndarray] = None     # [n_toks] int32
     n_resources: int = 1
     #: timestamp rank assigned to round-r evictions: ts_evict_base + r
     ts_evict_base: int = 0
@@ -224,6 +232,9 @@ def pad_workloads(problem: SolverProblem, target_w: int) -> SolverProblem:
         wl_lq=pad1(problem.wl_lq, 0),
         wl_afs_penalty=pad1(problem.wl_afs_penalty, 0.0),
         wl_ts_buf=pad1(problem.wl_ts_buf, 0),
+        wl_raw_ts=pad1(problem.wl_raw_ts, 0.0),
+        wl_raw_admit_ts=pad1(problem.wl_raw_admit_ts, 0.0),
+        wl_class_tok=pad1(problem.wl_class_tok, -1),
         wl_keys=list(problem.wl_keys) + [""] * pad,
     )
 
@@ -301,15 +312,39 @@ class ExportCache:
         self._cq_gen = -1
         self._cq_covered: list[set] = []
         self._cq_allowed_keys: list[list[frozenset]] = []
+        #: delta-session dirty tracking (solver/delta.py): workload keys
+        #: and CQ names touched since the last consume_dirty(). These
+        #: feed the ProblemDelta emit stats and the no-change fast path;
+        #: the delta itself stays content-based (compared, not inferred)
+        #: so queue-order churn that produces no store event is still
+        #: caught.
+        self.dirty_keys: set[str] = set()
+        self.dirty_cqs: set[str] = set()
+        self.events_seen = 0
         if subscribe:
             store.watch(self._on_event)
 
     def _on_event(self, event) -> None:
         verb, kind, obj = event
+        self.events_seen += 1
         if kind == "Workload":
             self.rows.pop(obj.key, None)
+            self.dirty_keys.add(obj.key)
+            lq = self.store.local_queues.get(
+                f"{obj.namespace}/{obj.queue_name}")
+            if lq is not None:
+                self.dirty_cqs.add(lq.cluster_queue)
         else:
             self.spec_gen += 1
+            name = getattr(obj, "name", None)
+            if kind == "ClusterQueue" and name:
+                self.dirty_cqs.add(name)
+
+    def consume_dirty(self) -> tuple[set[str], set[str]]:
+        """Return-and-clear the dirty sets (one delta emission's worth)."""
+        keys, cqs = self.dirty_keys, self.dirty_cqs
+        self.dirty_keys, self.dirty_cqs = set(), set()
+        return keys, cqs
 
     # -- derived-table lifecycle ------------------------------------------
 
@@ -733,10 +768,13 @@ def export_problem(
     )
 
     wl_ts_buf = np.zeros(W + 1, dtype=np.int32)
+    wl_raw_ts = np.zeros(W + 1, dtype=np.float64)
+    wl_raw_admit_ts = np.zeros(W + 1, dtype=np.float64)
     n_ts = 0
     n_admit_rank = 0
     if W:
         raw_ts = np.fromiter((r.raw_ts for r in rows), np.float64, W)
+        wl_raw_ts[:W] = raw_ts
         distinct_ts, inv_ts = np.unique(raw_ts, return_inverse=True)
         n_ts = len(distinct_ts)
         wl_ts[:W] = inv_ts
@@ -750,6 +788,7 @@ def export_problem(
         raw_admit = np.fromiter(
             (r.admit_ts for r in rows[n_pending:]), np.float64,
             W - n_pending)
+        wl_raw_admit_ts[n_pending:W] = raw_admit
         distinct_admit, inv_a = np.unique(raw_admit, return_inverse=True)
         n_admit_rank = len(distinct_admit)
         wl_admit_rank[n_pending:W] = inv_a + 1
@@ -877,6 +916,10 @@ def export_problem(
         wl_ts_buf=wl_ts_buf,
         lq_penalty0=lq_penalty0,
         cq_afs=cq_afs,
+        wl_raw_ts=wl_raw_ts,
+        wl_raw_admit_ts=wl_raw_admit_ts,
+        wl_class_tok=np.concatenate([toks, [-1]]).astype(np.int64),
+        class_tok_root=np.asarray(cache._tok_root, dtype=np.int32),
         n_resources=len(resources),
         ts_evict_base=n_ts + 1,
         admit_rank_base=n_admit_rank + 2,

@@ -45,11 +45,18 @@ def _pair_valid_until(cert_path: Path,
 
 
 def _write_private(path: Path, data: bytes) -> None:
-    """0600 atomic write (the key must never be world-readable)."""
+    """0600 atomic write (the key must never be world-readable).
+
+    os.write may write fewer bytes than asked (signals, quotas); loop
+    until everything is on disk so the rename can never persist a
+    truncated private key."""
     tmp = path.with_suffix(path.suffix + ".tmp")
     fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     try:
-        os.write(fd, data)
+        view = memoryview(data)
+        while view:
+            written = os.write(fd, view)
+            view = view[written:]
     finally:
         os.close(fd)
     os.replace(tmp, path)

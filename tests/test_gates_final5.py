@@ -332,6 +332,30 @@ class TestAdmissionGatedBy:
         features.set_gates({"AdmissionGatedBy": False})
         assert not validate_job_create(bad)
 
+    def test_gate_names_require_domain_prefix(self):
+        """validation.IsDomainPrefixedPath: gates must be 'prefix/name';
+        bare names are rejected upstream and here (ADVICE.md round 5).
+        Topology label names keep the prefix-OPTIONAL qualified-name
+        rules."""
+        from kueue_oss_tpu.jobframework.reconciler import (
+            ADMISSION_GATED_BY_ANNOTATION,
+        )
+        from kueue_oss_tpu.jobframework.webhook import (
+            is_qualified_name,
+            validate_job_create,
+        )
+
+        features.set_gates({"AdmissionGatedBy": True})
+        bare = _FakeJob({ADMISSION_GATED_BY_ANNOTATION: "mygate"})
+        assert any("domain-prefixed" in e
+                   for e in validate_job_create(bare))
+        ok = _FakeJob(
+            {ADMISSION_GATED_BY_ANNOTATION: "example.com/gate"})
+        assert not validate_job_create(ok)
+        # topology label names are unaffected: bare qualified names pass
+        assert is_qualified_name("mygate")
+        assert is_qualified_name("example.com/level")
+
 
 # ---------------------------------------------------------------------------
 # RejectUpdatesToCQWithInvalidOnFlavors (+ admissionChecksStrategy wiring)

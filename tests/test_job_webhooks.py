@@ -81,6 +81,17 @@ class TestRayWebhook:
                     worker_groups=[WorkerGroup(name="workers")])
         assert not validate_job_create(ok)
 
+    def test_rayjob_reports_both_violations_independently(self):
+        """The reference rayjob webhook reports clusterSelector AND
+        shutdownAfterJobFinishes when both are violated — not an
+        either/or (ADVICE.md round 5)."""
+        job = RayJob(name="rj", queue_name="lq",
+                     cluster_selector={"ray.io/cluster": "c"},
+                     shutdown_after_job_finishes=False)
+        errs = validate_job_create(job)
+        assert any("clusterSelector" in e for e in errs)
+        assert any("shutdownAfterJobFinishes" in e for e in errs)
+
 
 class TestOtherFrameworkWebhooks:
     def test_jobset_duplicate_replicated_job(self):

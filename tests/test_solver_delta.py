@@ -1,0 +1,428 @@
+"""Delta-sync solver sessions (docs/SOLVER_PROTOCOL.md).
+
+Correctness contract under test:
+
+1. property-style replay — randomized store event sequences (create /
+   admit / evict / finish / delete / quota-edit); after every event
+   batch, the delta applied to a shadow sidecar state must be
+   BIT-IDENTICAL to a fresh full sync of the same export (checksums and
+   arrays both), whatever mix of deltas and full syncs the session
+   chose to emit;
+2. the wire path — a real sidecar serves SYNC then DELTA frames, plans
+   match a sessionless engine exactly, and steady-state frames are
+   deltas, not syncs;
+3. forced desync — a dropped DELTA (sidecar crash mid-cycle) leaves the
+   sidecar behind; the next drain must recover through an in-band
+   RESYNC (counted in metrics), re-seed bit-identical sidecar state,
+   and still produce the host-parity plan;
+4. the in-process resident device path reuses buffers across drains
+   (delta scatter updates, not full re-uploads) without changing plans.
+"""
+
+import os
+import random
+import tempfile
+
+import numpy as np
+import pytest
+
+from kueue_oss_tpu import metrics
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.solver.delta import (
+    HostDeltaSession,
+    StableRanker,
+    apply_delta,
+    deserialize_delta,
+    problem_wire_state,
+    serialize_delta,
+    state_checksum,
+)
+from kueue_oss_tpu.solver.engine import SolverEngine
+from kueue_oss_tpu.solver.service import (
+    SolverClient,
+    SolverServer,
+    expand_compact_plan,
+)
+from kueue_oss_tpu.solver.tensors import pad_workloads
+
+
+def _store(n_cqs=4, quota=8, preemption=True):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="f"))
+    for i in range(n_cqs):
+        store.upsert_cluster_queue(ClusterQueue(
+            name=f"cq{i}",
+            preemption=(PreemptionPolicy(
+                within_cluster_queue="LowerPriority")
+                if preemption else PreemptionPolicy()),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f", resources=[
+                    ResourceQuota(name="cpu", nominal=quota)])])]))
+        store.upsert_local_queue(LocalQueue(
+            name=f"lq{i}", cluster_queue=f"cq{i}"))
+    return store
+
+
+def _wl(i, prio=0, cpu=1):
+    return Workload(
+        name=f"w{i}", queue_name=f"lq{i % 4}", uid=i + 1, priority=prio,
+        creation_time=float(i),
+        podsets=[PodSet(name="main", count=1, requests={"cpu": cpu})])
+
+
+def _sock_path():
+    return os.path.join(tempfile.mkdtemp(), "solver.sock")
+
+
+def _admitted(store):
+    return {k for k, w in store.workloads.items() if w.is_quota_reserved}
+
+
+# ---------------------------------------------------------------------------
+# stable ranker unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_stable_ranker_preserves_order_and_identity():
+    r = StableRanker(gap=8)
+    vals = np.asarray([3.0, 1.0, 2.0])
+    r.update(vals)
+    first = {v: int(x) for v, x in zip(vals, r.rank(vals))}
+    assert first[1.0] < first[2.0] < first[3.0]
+    # appends keep existing ranks; order still strict
+    r.update(np.asarray([10.0, 2.5]))
+    after = {v: int(x) for v, x in
+             zip([1.0, 2.0, 2.5, 3.0, 10.0],
+                 r.rank(np.asarray([1.0, 2.0, 2.5, 3.0, 10.0])))}
+    for v in (1.0, 2.0, 3.0):
+        assert after[v] == first[v], "existing ranks must not move"
+    assert (after[1.0] < after[2.0] < after[2.5] < after[3.0]
+            < after[10.0])
+
+
+def test_stable_ranker_renumbers_on_gap_exhaustion():
+    r = StableRanker(gap=2)
+    r.update(np.asarray([0.0, 1.0]))
+    # repeated midpoint inserts exhaust a gap of 2 quickly
+    renumbered = False
+    for k in range(4):
+        renumbered |= r.update(np.asarray([0.1 + k * 0.01]))
+    assert renumbered, "exhausted gap must report a renumber"
+    vals = np.asarray(sorted([0.0, 1.0, 0.1, 0.11, 0.12, 0.13]))
+    ranks = r.rank(vals)
+    assert (np.diff(ranks) > 0).all(), "order survives the renumber"
+
+
+# ---------------------------------------------------------------------------
+# property-style replay: delta-applied state == fresh full sync
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 7, 23])
+def test_delta_replay_bit_identical_over_random_event_sequences(seed):
+    rng = random.Random(seed)
+    store = _store(quota=6)
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched)
+    session = HostDeltaSession(cache=engine.export_cache,
+                               neutral_fields=("wl_rank",))
+    next_uid = [0]
+
+    def submit(n):
+        for _ in range(n):
+            i = next_uid[0]
+            next_uid[0] += 1
+            store.add_workload(_wl(i, prio=rng.randrange(3)))
+
+    submit(16)
+    sidecar = None  # (kwargs, meta) shadow of the remote state
+    syncs = deltas = 0
+    for step in range(14):
+        # one random event batch: the store/queue churn mix of the
+        # acceptance criteria (create/admit/evict/finish/delete and a
+        # quota edit, which flows through the node-axis repl path)
+        op = rng.randrange(5)
+        if op == 0:
+            submit(rng.randrange(1, 4))
+        elif op == 1:
+            engine.drain(now=float(step))  # admissions (solver path)
+        elif op == 2:
+            admitted = sorted(_admitted(store))
+            for k in admitted[:rng.randrange(0, 3)]:
+                sched.finish_workload(k, now=float(step))
+        elif op == 3:
+            admitted = sorted(_admitted(store))
+            if admitted:
+                sched.evict_workload(
+                    admitted[rng.randrange(len(admitted))],
+                    reason="Preempted", message="chaos", now=float(step))
+        else:
+            cq = store.cluster_queues[f"cq{rng.randrange(4)}"]
+            cq.resource_groups[0].flavors[0].resources[0].nominal = (
+                rng.randrange(4, 9))
+            store.upsert_cluster_queue(cq)
+
+        problem = _export_full_problem(engine, now=float(step))
+        if problem is None:
+            continue
+        problem = pad_workloads(problem, 64)
+        slotted, frame = session.advance(problem)
+        kwargs, meta = problem_wire_state(slotted)
+        assert state_checksum(kwargs, meta) == frame.checksum
+        if frame.delta is None or sidecar is None:
+            syncs += 1
+            sidecar = ({k: (None if v is None else v.copy())
+                        for k, v in kwargs.items()}, dict(meta))
+        else:
+            deltas += 1
+            # full wire roundtrip of the delta, then replay
+            dh, blob = serialize_delta(frame.delta)
+            delta = deserialize_delta(dh, blob)
+            apply_delta(sidecar[0], sidecar[1], delta)
+        # BIT-IDENTICAL: checksum and every array
+        assert state_checksum(*sidecar) == frame.checksum
+        for name, arr in kwargs.items():
+            if arr is None:
+                assert sidecar[0][name] is None
+            else:
+                assert np.array_equal(sidecar[0][name], arr), name
+    assert deltas > 0, "the sequence must exercise the delta path"
+
+
+# helper used by the replay test: one full-kernel export of the
+# current backlog exactly as _drain_full would build it
+def _export_full_problem(engine, now=0.0):
+    pending = engine.pending_backlog()
+    parked_map = {}
+    for name, q in engine.queues.queues.items():
+        if not q.inadmissible:
+            continue
+        infos = [i for k, i in q.inadmissible.items()
+                 if k not in q._stale]
+        if infos:
+            parked_map[name] = infos
+    from kueue_oss_tpu.solver.tensors import export_problem
+
+    problem = export_problem(engine.store, pending,
+                             include_admitted=True, parked=parked_map,
+                             now=now, cache=engine.export_cache)
+    return problem if problem.n_workloads else None
+
+
+# ---------------------------------------------------------------------------
+# wire path: sync -> deltas, parity, resident device reuse
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    path = _sock_path()
+    srv = SolverServer(path)
+    srv.serve_in_background()
+    yield path, srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _churn_run(engine, store, sched, cycles=4, churn=2):
+    uid = [100]
+    for cyc in range(1, cycles + 1):
+        admitted = sorted(k for k, w in store.workloads.items()
+                          if w.is_quota_reserved and not w.is_finished)
+        for k in admitted[:churn]:
+            sched.finish_workload(k, now=float(cyc))
+        for _ in range(churn):
+            store.add_workload(_wl(uid[0]))
+            uid[0] += 1
+        engine.drain(now=float(cyc))
+
+
+def test_remote_session_ships_deltas_with_exact_parity(server):
+    path, srv = server
+    store = _store()
+    for i in range(48):
+        store.add_workload(_wl(i))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched,
+                          remote=SolverClient(path))
+    engine.pad_to = 128
+    engine.drain(now=0.0)
+    assert engine.remote.frames_by_kind.get("sync") == 1
+    _churn_run(engine, store, sched, cycles=4)
+    assert engine.remote.frames_by_kind.get("delta", 0) >= 2, \
+        "steady-state churn cycles must ship DELTA frames"
+    # the sidecar session is resident: one full upload + delta scatters
+    sess = next(iter(srv.sessions.values()))
+    assert sess.device.delta_updates >= 2
+
+    # parity: identical run with sessions disabled
+    store2 = _store()
+    for i in range(48):
+        store2.add_workload(_wl(i))
+    queues2 = QueueManager(store2)
+    sched2 = Scheduler(store2, queues2)
+    engine2 = SolverEngine(store2, queues2, scheduler=sched2)
+    engine2.use_sessions = False
+    engine2.pad_to = 128
+    engine2.drain(now=0.0)
+    _churn_run(engine2, store2, sched2, cycles=4)
+    assert _admitted(store) == _admitted(store2)
+
+
+def test_dropped_delta_forces_resync_and_recovers(server):
+    """A DELTA the sidecar never saw (lost mid-transport / sidecar
+    wiped) must resolve through RESYNC: counted, bit-identical state
+    re-seeded, plan unchanged vs the host cycle."""
+    path, srv = server
+    store = _store()
+    for i in range(48):
+        store.add_workload(_wl(i))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched,
+                          remote=SolverClient(path))
+    engine.pad_to = 128
+    engine.drain(now=0.0)
+    _churn_run(engine, store, sched, cycles=2)
+    assert engine.remote.frames_by_kind.get("delta", 0) >= 1
+
+    # simulate the sidecar losing the session (restart/crash): the next
+    # delta must come back resync=session_missing and recover in-call
+    resyncs0 = metrics.solver_resync_total.total()
+    with srv._sessions_lock:
+        srv.sessions.clear()
+    _churn_run(engine, store, sched, cycles=1)
+    assert metrics.solver_resync_total.total() == resyncs0 + 1
+    assert metrics.solver_resync_total.collect().get(
+        ("session_missing",), 0) >= 1
+    assert engine.remote.frames_by_kind.get("resync", 0) >= 1
+
+    # re-seeded sidecar state is bit-identical to the host session's
+    sess_host = engine._delta_sessions["full"]
+    sidecar = next(iter(srv.sessions.values()))
+    assert sidecar.epoch == sess_host.epoch
+    host_kwargs, host_meta = sess_host._last
+    assert (state_checksum(sidecar.kwargs, sidecar.meta)
+            == state_checksum(host_kwargs, host_meta))
+
+    # and the overall plan still matches the host-only path
+    store_h = _store()
+    for i in range(48):
+        store_h.add_workload(_wl(i))
+    queues_h = QueueManager(store_h)
+    sched_h = Scheduler(store_h, queues_h)
+    engine_h = SolverEngine(store_h, queues_h, scheduler=sched_h)
+    engine_h.use_sessions = False
+    engine_h.pad_to = 128
+    engine_h.drain(now=0.0)
+    _churn_run(engine_h, store_h, sched_h, cycles=3)
+    assert _admitted(store) == _admitted(store_h)
+
+
+def test_checksum_mismatch_drops_session_and_resyncs(server):
+    """Corrupted resident sidecar state (bit-flip) must be caught by the
+    DELTA checksum, answered with RESYNC, and healed by the SYNC."""
+    path, srv = server
+    store = _store()
+    for i in range(48):
+        store.add_workload(_wl(i))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched,
+                          remote=SolverClient(path))
+    engine.pad_to = 128
+    engine.drain(now=0.0)
+    _churn_run(engine, store, sched, cycles=2)
+    sidecar = next(iter(srv.sessions.values()))
+    with sidecar.lock:
+        sidecar.kwargs["wl_prio"][0] += 1  # silent divergence
+    resyncs0 = metrics.solver_resync_total.collect().get(
+        ("checksum_mismatch",), 0)
+    _churn_run(engine, store, sched, cycles=1)
+    assert metrics.solver_resync_total.collect().get(
+        ("checksum_mismatch",), 0) == resyncs0 + 1
+    sidecar2 = next(iter(srv.sessions.values()))
+    host_kwargs, host_meta = engine._delta_sessions["full"]._last
+    assert (state_checksum(sidecar2.kwargs, sidecar2.meta)
+            == state_checksum(host_kwargs, host_meta))
+
+
+def test_local_resident_device_reuses_buffers_with_same_plans():
+    store = _store()
+    for i in range(48):
+        store.add_workload(_wl(i))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched)
+    engine.pad_to = 128
+    engine.drain(now=0.0)
+    _churn_run(engine, store, sched, cycles=3)
+    dev = engine._device_states["full"]
+    assert dev.delta_updates >= 2, \
+        "steady-state local drains must scatter deltas, not re-upload"
+
+    store2 = _store()
+    for i in range(48):
+        store2.add_workload(_wl(i))
+    queues2 = QueueManager(store2)
+    sched2 = Scheduler(store2, queues2)
+    engine2 = SolverEngine(store2, queues2, scheduler=sched2)
+    engine2.use_sessions = False
+    engine2.pad_to = 128
+    engine2.drain(now=0.0)
+    _churn_run(engine2, store2, sched2, cycles=3)
+    assert _admitted(store) == _admitted(store2)
+
+
+def test_compact_plan_roundtrip_preserves_guard_visible_corruption():
+    """expand_compact_plan is pure scatter: a compact response that
+    admits padding rows or overlaps admitted/parked must survive into
+    the dense arrays so the engine's sanity guard can reject it."""
+    data = {
+        "adm_idx": np.asarray([0, 5], dtype=np.int32),   # 5 = padding
+        "adm_opt": np.asarray([0, 3], dtype=np.int32),
+        "adm_round": np.asarray([0, 1], dtype=np.int32),
+        "park_idx": np.asarray([0], dtype=np.int32),     # overlaps
+        "rounds": np.int32(1),
+    }
+    admitted, opt, admit_round, parked, rounds, _usage = (
+        expand_compact_plan(data, 7, full=False, g_max=1))
+    assert admitted[5] and admitted[0] and parked[0]
+    assert opt[5] == 3 and int(rounds) == 1
+    assert bool((admitted & parked).any())
+
+
+def test_session_prunes_oversized_rankers():
+    """Rankers must not hold dead timestamps forever: once the registry
+    dwarfs the live problem, the session resets them and rides the full
+    sync it forces (reason=ranker_prune)."""
+    store = _store()
+    for i in range(8):
+        store.add_workload(_wl(i))
+    queues = QueueManager(store)
+    engine = SolverEngine(store, queues)
+    session = HostDeltaSession(cache=engine.export_cache)
+    problem = _export_full_problem(engine)
+    problem = pad_workloads(problem, 16)
+    session.advance(problem)
+    session._ts.update(np.arange(5000, dtype=np.float64) + 1e6)
+    assert session._ts.size > 4096
+    _slotted, frame = session.advance(problem)
+    assert frame.full_reason == "ranker_prune"
+    assert session._ts.size < 4096, "rankers rebuilt from live rows only"
