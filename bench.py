@@ -786,6 +786,143 @@ def run_scenario(scenario: str) -> dict:
             "cycle_ms_p99": float(np.percentile(walls_ms, 99)),
         }
 
+    if scenario == "multichip":
+        # PRODUCTION multi-chip path — no dry-run entry point left: the
+        # engine + delta-session stack drains the large fit-only shape
+        # on the mesh arm (sharded resident state, donated row
+        # scatters, compact plans), with churn cycles measuring the
+        # steady state and a single-chip twin proving the plans stay
+        # identical. Runs on a virtual host mesh when no multi-chip
+        # accelerator is attached (honest mesh_devices/platform labels;
+        # the virtual mesh exercises the same XLA partitioner).
+        import numpy as np
+
+        from kueue_oss_tpu import metrics as kmetrics
+        from kueue_oss_tpu.api.types import PodSet, Workload
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+        from kueue_oss_tpu.solver import meshutil
+
+        mesh = meshutil.detect_mesh()
+        n_dev = meshutil.mesh_devices(mesh)
+        if n_dev < 2:
+            return {"scenario": scenario, "skipped": True,
+                    "reason": "single device; no mesh to measure"}
+
+        def build_env():
+            store, queues, engine = _build(preemption=False, small=small)
+            if len(store.workloads) % n_dev == 0:
+                # force the uneven-shard padding path (W % n_dev != 0)
+                proto = next(iter(store.workloads.values()))
+                store.add_workload(Workload(
+                    name="uneven-extra", queue_name=proto.queue_name,
+                    uid=10_000_000, creation_time=0.5,
+                    podsets=[PodSet(name="main", count=1,
+                                    requests=dict(
+                                        proto.podsets[0].requests))]))
+            sched = Scheduler(store, queues)
+            engine.scheduler = sched
+            return store, queues, sched, engine
+
+        store, queues, sched, engine = build_env()
+        n_wl = len(store.workloads)
+        churn = int(os.environ.get("BENCH_MC_CHURN",
+                                   str(max(1, n_wl // 200))))
+        n_cycles = int(os.environ.get("BENCH_MC_CYCLES", "6"))
+        warm = 2
+        lqs = sorted({w.queue_name for w in store.workloads.values()})
+        proto = next(iter(store.workloads.values()))
+        req = dict(proto.podsets[0].requests)
+        uid0 = max(w.uid for w in store.workloads.values()) + 1
+        t_base = max(w.creation_time
+                     for w in store.workloads.values()) + 1.0
+
+        def run_trace(engine, store, sched, tag):
+            engine.pad_to = n_wl + churn * (n_cycles + warm) + 1
+            t0 = time.monotonic()
+            engine.drain(now=0.0, verify=True)
+            first_wall = time.monotonic() - t0
+            walls = []
+            for cyc in range(1, warm + n_cycles + 1):
+                admitted = [k for k, w in store.workloads.items()
+                            if w.is_quota_reserved and not w.is_finished]
+                for k in admitted[:churn]:
+                    sched.finish_workload(k, now=float(cyc))
+                for j in range(churn):
+                    i = uid0 + cyc * churn + j
+                    store.add_workload(Workload(
+                        name=f"churn-{tag}-{cyc}-{j}",
+                        queue_name=lqs[i % len(lqs)], uid=i,
+                        creation_time=t_base + cyc * churn + j,
+                        podsets=[PodSet(name="main", count=1,
+                                        requests=dict(req))]))
+                result = engine.drain(now=float(cyc), verify=True)
+                if cyc > warm:
+                    walls.append(result.solver_time_s)
+            return first_wall, walls
+
+        engine.mesh_force = True
+        engine.mesh_min_workloads = 0
+        first_wall, walls = run_trace(engine, store, sched, "m")
+        assert engine.last_drain_arm == "mesh", engine.last_drain_arm
+        mesh_admitted = {k for k, w in store.workloads.items()
+                        if w.is_quota_reserved}
+
+        # single-chip twin over the byte-identical churn trace
+        store2, queues2, sched2, engine2 = build_env()
+        engine2.mesh_mode = "off"
+        _fw2, walls2 = run_trace(engine2, store2, sched2, "m")
+        single_admitted = {k for k, w in store2.workloads.items()
+                          if w.is_quota_reserved}
+
+        dev = engine._device_states.get("lean-mesh")
+        sess = engine._delta_sessions.get("lean")
+        imb = kmetrics.solver_shard_imbalance
+        walls_ms = np.asarray(walls) * 1000
+        walls2_ms = np.asarray(walls2) * 1000
+
+        # preemption drain (full kernel, lane-sharded) through the
+        # production engine at the 1/10 contended shape
+        store_p, queues_p, engine_p = _build(preemption=True, small=True)
+        engine_p.scheduler = Scheduler(store_p, queues_p)
+        engine_p.mesh_force = True
+        engine_p.mesh_min_workloads = 0
+        t0 = time.monotonic()
+        rp = engine_p.drain(now=0.0, verify=True)
+        preempt_wall = time.monotonic() - t0
+
+        return {
+            "scenario": scenario,
+            "workloads": n_wl,
+            "mesh_devices": n_dev,
+            "uneven_shards": n_wl % n_dev != 0,
+            "churn_per_cycle": churn,
+            "cycles": n_cycles,
+            "first_drain_seconds": round(first_wall, 3),
+            "mesh_drain_ms_p50": float(np.percentile(walls_ms, 50)),
+            "single_drain_ms_p50": float(np.percentile(walls2_ms, 50)),
+            "shard_imbalance_mean": round(
+                imb.sum() / max(imb.count(), 1), 4),
+            "plans_identical": mesh_admitted == single_admitted,
+            "donated_update_bytes_per_cycle": (
+                dev.donated_update_bytes // max(dev.delta_updates, 1)
+                if dev else 0),
+            "avoided_copy_bytes_per_cycle": (
+                dev.avoided_copy_bytes // max(dev.delta_updates, 1)
+                if dev else 0),
+            "full_upload_bytes": (
+                dev.full_upload_bytes // max(dev.full_uploads, 1)
+                if dev else 0),
+            "delta_epochs": dev.delta_updates if dev else 0,
+            "full_uploads": dev.full_uploads if dev else 0,
+            "session_delta_syncs": sess.delta_syncs if sess else 0,
+            "session_full_syncs": sess.full_syncs if sess else 0,
+            "preempt_mesh_admitted": rp.admitted,
+            "preempt_mesh_rounds": rp.rounds,
+            "preempt_mesh_seconds": round(preempt_wall, 3),
+            "preempt_mesh_arm": engine_p.last_drain_arm,
+            **_degradation_counts(),
+        }
+
     if scenario == "recorder":
         # flight-recorder overhead on the 50k x 1k host cycle-latency
         # shape: identical twin stores run the same N host cycles with
@@ -1063,6 +1200,18 @@ def main() -> None:
     except Exception as e:
         log(f"[delta] did not complete: {e}")
         delta = None
+    # the production multi-chip path (mesh-resident sessions, donated
+    # row scatters, sharded drain) on a virtual 8-device host mesh —
+    # same XLA partitioner as real multi-chip; labeled honestly
+    try:
+        multichip = measure("multichip", extra_env={
+            "BENCH_CPU": "1",
+            "XLA_FLAGS": ("--xla_force_host_platform_device_count=8 "
+                          "--xla_cpu_parallel_codegen_split_count=1 "
+                          "--xla_cpu_max_isa=AVX")}, timeout=2400)
+    except Exception as e:
+        log(f"[multichip] did not complete: {e}")
+        multichip = None
     log(f"total bench time {time.monotonic() - t_start:.1f}s")
 
     # HEADLINE: the reference's own protocol — same shape, same
@@ -1169,6 +1318,25 @@ def main() -> None:
         extra["delta_cycle_ms_p50_50k_1k"] = round(
             delta["cycle_ms_p50"], 2)
         extra["delta_churn_per_cycle"] = delta["churn_per_cycle"]
+    if multichip is not None and not multichip.get("skipped"):
+        # production mesh path (docs/SOLVER_PROTOCOL.md "Mesh-resident
+        # sessions"): the steady-state drain p50 on the mesh arm, the
+        # per-cycle donated scatter bytes vs the full-problem copy a
+        # re-upload would ship, shard imbalance, and the parity bit
+        extra["mesh_devices"] = multichip["mesh_devices"]
+        extra["mesh_drain_ms_p50"] = round(
+            multichip["mesh_drain_ms_p50"], 2)
+        extra["mesh_single_drain_ms_p50"] = round(
+            multichip["single_drain_ms_p50"], 2)
+        extra["mesh_shard_imbalance"] = multichip["shard_imbalance_mean"]
+        extra["mesh_plans_identical"] = multichip["plans_identical"]
+        extra["mesh_donated_update_bytes"] = multichip[
+            "donated_update_bytes_per_cycle"]
+        extra["mesh_avoided_copy_bytes"] = multichip[
+            "avoided_copy_bytes_per_cycle"]
+        extra["mesh_uneven_shards"] = multichip["uneven_shards"]
+        extra["mesh_preempt_seconds"] = multichip["preempt_mesh_seconds"]
+        extra["mesh_platform"] = "cpu_virtual_mesh"
     # degradation events across every solver-routed scenario, so the
     # perf trajectory records backend faults alongside throughput
     solver_runs = [sim, sim_solver_cpu, sim_solver_dev, sim_large, chaos]

@@ -26,7 +26,11 @@ Failure modes (FAULTS):
   slow          -- delay the (correct) response by ``slow_s``
 
 Node flap (the non-sidecar failure in the model) is injected by
-``NodeFlapInjector`` against the store's node objects.
+``NodeFlapInjector`` against the store's node objects. Accelerator
+device loss and mesh shrink (the multi-chip failure modes) are
+injected by ``MeshFaultInjector`` through the engine's
+``solve_fault_hook`` seam, driving the mesh -> single-chip -> host
+fallback chain deterministically.
 """
 
 from __future__ import annotations
@@ -233,6 +237,74 @@ class ChaosSolverServer(SolverServer):
         super().__init__(socket_path, max_frame_bytes=max_frame_bytes)
         self.injector = injector
         self.RequestHandlerClass = _ChaosHandler
+
+
+class MeshFaultInjector:
+    """Deterministic device-loss / mesh-shrink injection for the
+    engine's multi-chip drain arms (docs/ROBUSTNESS.md "Mesh faults").
+
+    Wires itself into ``SolverEngine.solve_fault_hook`` — the hook runs
+    immediately before each local solve, tagged with the arm about to
+    execute, so raising there is indistinguishable from the XLA runtime
+    erroring at dispatch time (the closest a virtual-device test rig
+    gets to yanking a chip). The engine's contract under test:
+
+      mesh fault   -> the SAME drain re-runs on the single-chip arm
+                      (solver_fallback_total{reason="mesh_error"});
+      both arms    -> SolverUnavailable, and the scheduler finishes the
+                      admission round on host cycles
+                      (reason="device_error") — the full
+                      mesh -> single-chip -> host chain, never silent;
+      mesh shrink  -> refresh_mesh(max_devices=n) re-detects a narrower
+                      mesh; the next drain re-pads, the session rides
+                      the forced full sync, plans stay bit-identical.
+    """
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self._mesh_faults = 0
+        self._all_faults = 0
+        self.injected: dict[str, int] = {}
+        engine.solve_fault_hook = self._hook
+
+    def _count(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def _hook(self, arm: str) -> None:
+        if self._all_faults > 0:
+            if arm == "single":
+                self._all_faults -= 1  # terminal arm = one drain
+            self._count(f"{arm}_lost")
+            raise RuntimeError(
+                f"injected device loss ({arm} arm unavailable)")
+        if arm == "mesh" and self._mesh_faults > 0:
+            self._mesh_faults -= 1
+            self._count("mesh_lost")
+            raise RuntimeError("injected mesh device loss")
+
+    def lose_mesh(self, times: int = 1) -> None:
+        """The next ``times`` mesh-arm solves fail (ICI/device loss)."""
+        self._mesh_faults += int(times)
+
+    def lose_all(self, times: int = 1) -> None:
+        """The next ``times`` drains fail on EVERY local arm — the
+        whole accelerator is gone; only host cycles remain."""
+        self._all_faults += int(times)
+
+    def shrink(self, n_devices: int) -> int:
+        """Shrink the engine's mesh to ``n_devices`` (a partial device
+        loss); returns the re-detected width."""
+        self._count(f"shrink_{n_devices}")
+        return self.engine.refresh_mesh(max_devices=n_devices)
+
+    def restore(self) -> int:
+        """Heal: clear pending faults and re-detect the full mesh."""
+        self._mesh_faults = 0
+        self._all_faults = 0
+        return self.engine.refresh_mesh()
+
+    def faults_injected(self) -> int:
+        return sum(self.injected.values())
 
 
 class NodeFlapInjector:

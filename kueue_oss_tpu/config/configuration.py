@@ -156,6 +156,13 @@ class SolverBackendConfig:
     #: full padded problem per drain. None = KUEUE_SOLVER_SESSIONS env
     #: (default on); False forces the stateless legacy frames.
     sessions_enabled: Optional[bool] = None
+    #: multi-chip mesh for the sharded drain (docs/SOLVER_PROTOCOL.md
+    #: "Mesh-resident sessions"): "auto" (default; a 1-D ``wl`` mesh
+    #: over all local devices when jax.device_count() > 1), "off", or
+    #: an explicit device count. None = KUEUE_SOLVER_MESH env, falling
+    #: back to auto. Routing between the mesh and single-chip arms
+    #: stays adaptive (measured cost EMAs) even when a mesh exists.
+    mesh: Optional[str] = None
 
 
 @dataclass
@@ -234,6 +241,12 @@ def validate(cfg: Configuration) -> list[str]:
         errs.append("solver.breakerFailureThreshold must be >= 1")
     if sv.breaker_cooldown_seconds < 0:
         errs.append("solver.breakerCooldown must be >= 0")
+    if sv.mesh is not None:
+        m = str(sv.mesh).strip().lower()
+        known = {"auto", "on", "off", "none", "true", "false", "disabled"}
+        if m not in known and not m.isdigit():
+            errs.append(f"solver.mesh {sv.mesh!r} must be 'auto', 'off', "
+                        "or a non-negative device count")
     afs = cfg.admission_fair_sharing
     if afs is not None:
         if afs.usage_half_life_time_seconds < 0:
@@ -358,6 +371,7 @@ def load(data: Optional[dict] = None) -> Configuration:
             "breakerFailureThreshold": ("breaker_failure_threshold", int),
             "breakerCooldown": ("breaker_cooldown_seconds", float),
             "sessionsEnabled": ("sessions_enabled", bool),
+            "mesh": ("mesh", str),
         })
 
     def conv_integrations(d: dict) -> list[str]:

@@ -452,6 +452,18 @@ solver_session_bytes_total = registry.register(Counter(
     "kueue_tpu_solver_session_bytes_total",
     "Solver request payload bytes shipped by frame kind", ("kind",)))
 
+# -- mesh-sharded drains (solver/sharded.py, docs/SOLVER_PROTOCOL.md) --------
+
+solver_mesh_devices = registry.register(Gauge(
+    "kueue_tpu_solver_mesh_devices",
+    "Devices in the solver mesh used by the most recent drain "
+    "(0 = single-chip / host path)", ()))
+solver_shard_imbalance = registry.register(Histogram(
+    "kueue_tpu_solver_shard_imbalance",
+    "Real-row imbalance across mesh shards per drain "
+    "((max - min) / mean occupied rows; 0 = perfectly even)", (),
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0)))
+
 # -- decision flight recorder (obs/) -----------------------------------------
 
 decision_events_total = registry.register(Counter(

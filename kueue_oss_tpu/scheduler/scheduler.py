@@ -373,7 +373,8 @@ class Scheduler:
                 self._solver_instance = SolverEngine(
                     self.store, self.queues, scheduler=self,
                     enable_fair_sharing=self.enable_fair_sharing,
-                    remote=remote, health=health)
+                    remote=remote, health=health,
+                    mesh_mode=(cfg.mesh if cfg is not None else None))
             return self._solver_instance
         return self.solver
 

@@ -377,9 +377,10 @@ class SessionFrame:
 
 
 #: pad_workloads-equivalent inert fill per W-axis array; wl_cqid/wl_rank
-#: fills are resolved at slot time (C / BIG)
+#: fills are resolved at slot time (C / BIG). wl_uid fills with BIG so
+#: a recycled slot can never alias a legitimate uid-0 workload.
 _ROW_FILL = {
-    "wl_prio": 0, "wl_ts": 0, "wl_uid": 0, "wl_req": 0,
+    "wl_prio": 0, "wl_ts": 0, "wl_uid": BIG, "wl_req": 0,
     "wl_valid": False, "wl_parked0": False, "wl_admitted0": False,
     "wl_evicted0": False, "wl_admit_rank": 0, "ad_usage": 0,
     "wl_lq": 0, "wl_afs_penalty": 0.0, "wl_ts_buf": 0,
@@ -642,21 +643,53 @@ _FULL_ROW_TENSORS = {
 }
 
 
+def _tree_nbytes(t) -> int:
+    return sum(int(getattr(a, "nbytes", 0)) for a in t)
+
+
 class DeviceResidentProblem:
     """Padded problem tensors pinned on device across drains.
 
     A full sync uploads everything once; each delta epoch then updates
-    only the dirty rows with an ``.at[rows].set`` scatter (plus the
-    small node/CQ replacement arrays), so steady-state drains ship a
-    few KB to the device instead of the whole padded problem.
+    only the dirty rows with a **donated** ``.at[rows].set`` scatter
+    (plus the small node/CQ replacement arrays), so steady-state drains
+    ship a few KB to the device instead of the whole padded problem —
+    and the scatter itself reuses the resident buffer (XLA input/output
+    aliasing) instead of materializing a second full padded copy.
+
+    With a ``mesh``, the lean problem's workload-axis tensors live
+    block-sharded over the mesh's ``wl`` axis (tree/CQ state
+    replicated) whenever the padded axis divides evenly; donated
+    scatters preserve the placement, so delta rows land directly on
+    their owning shard. The full kernel's tensors stay replicated (its
+    mesh parallelism shards victim-search lanes, not workload rows).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, mesh=None, axis: str = "wl") -> None:
+        self.mesh = mesh
+        self.axis = axis
+        #: problems narrower than this stay unsharded even with a mesh
+        #: (the mesh is the large-backlog path; callers set it from
+        #: their mesh_min_workloads policy)
+        self.mesh_min_rows = 0
         self.kind: Optional[str] = None
         self.epoch = -1
         self.tensors = None
         self.full_uploads = 0
         self.delta_updates = 0
+        #: whether the CURRENT resident tensors are mesh-placed
+        self.mesh_placed = False
+        #: donated-scatter accounting for bench/diagnostics: bytes
+        #: actually shipped by row updates vs the full-problem bytes a
+        #: per-drain re-upload (or a non-donated scatter's output copy)
+        #: would have materialized
+        self.donated_update_bytes = 0
+        self.avoided_copy_bytes = 0
+        self.full_upload_bytes = 0
+        #: _apply faults healed by a fresh full upload (never silent —
+        #: the engine's mesh-fault accounting reads this)
+        self.apply_faults = 0
+        self._scatter_cache: dict = {}
 
     def update(self, problem: SolverProblem, frame: Optional[SessionFrame],
                full: bool):
@@ -666,7 +699,14 @@ class DeviceResidentProblem:
                 or delta.base_epoch != self.epoch):
             self.tensors = self._full_upload(problem, full)
         else:
-            self._apply(problem, delta, full)
+            try:
+                self._apply(problem, delta, full)
+            except Exception:
+                # a partially-applied donated update leaves consumed
+                # buffers behind; drop the resident state and re-seed
+                # from the authoritative host problem
+                self.apply_faults += 1
+                self.tensors = self._full_upload(problem, full)
         self.kind = kind
         self.epoch = frame.epoch if frame is not None else self.epoch + 1
         return self.tensors
@@ -680,8 +720,57 @@ class DeviceResidentProblem:
             from kueue_oss_tpu.solver.kernels import to_device
 
             t = to_device(problem)
+        self.mesh_placed = False
+        if self.mesh is not None and not full:
+            from kueue_oss_tpu.solver.sharded import maybe_place_lean
+
+            t, self.mesh_placed = maybe_place_lean(
+                t, problem, self.mesh, self.mesh_min_rows, self.axis)
         self.full_uploads += 1
+        self.full_upload_bytes += _tree_nbytes(t)
         return t
+
+    def _replicated(self, arr: np.ndarray):
+        """Place a small replacement array consistently with the
+        resident tensors (replicated over the mesh when sharded)."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self.mesh_placed:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            np.ascontiguousarray(arr),
+            NamedSharding(self.mesh, PartitionSpec()))
+
+    def _scatter(self, buf, idx: np.ndarray, vals: np.ndarray):
+        """Donated row scatter: out aliases ``buf``, so no full padded
+        copy is materialized per delta epoch. The dirty-row count is
+        bucketed to a power of two (padded with idempotent repeats of
+        the last row) so one jitted program per (shape, dtype, bucket,
+        sharding) serves every epoch."""
+        import jax
+
+        self.donated_update_bytes += int(idx.nbytes) + int(vals.nbytes)
+        self.avoided_copy_bytes += int(buf.nbytes)
+        n = idx.shape[0]
+        cap = _pow2(max(1, n))
+        if cap != n:
+            idx = np.concatenate([idx, np.repeat(idx[-1:], cap - n)])
+            vals = np.concatenate(
+                [vals, np.repeat(vals[-1:], cap - n, axis=0)])
+        sharding = getattr(buf, "sharding", None)
+        key = (buf.shape, str(buf.dtype), cap, sharding)
+        fn = self._scatter_cache.get(key)
+        if fn is None:
+            kw = {}
+            if self.mesh_placed and sharding is not None:
+                kw["out_shardings"] = sharding
+            fn = jax.jit(lambda b, i, v: b.at[i].set(v),
+                         donate_argnums=0, **kw)
+            self._scatter_cache[key] = fn
+        return fn(buf, idx, vals)
 
     def _apply(self, problem: SolverProblem, delta: ProblemDelta,
                full: bool) -> None:
@@ -695,16 +784,17 @@ class DeviceResidentProblem:
             tname = row_map.get(name)
             if tname is None:
                 continue
-            updates[tname] = getattr(t, tname).at[
-                jnp.asarray(idx)].set(jnp.asarray(vals))
+            updates[tname] = self._scatter(
+                getattr(t, tname), np.asarray(idx),
+                np.ascontiguousarray(vals))
         for name, arr in delta.repl.items():
             if name in tensor_fields:
-                updates[name] = jnp.asarray(arr)
+                updates[name] = self._replicated(arr)
         # derived fields whose inputs changed
         if "cq_node" in delta.repl or "parent" in delta.repl:
             is_cq = np.zeros(problem.parent.shape[0], dtype=bool)
             is_cq[problem.cq_node] = True
-            updates["is_cq"] = jnp.asarray(is_cq)
+            updates["is_cq"] = self._replicated(is_cq)
         if full:
             if "cq_opt_group" in delta.repl:
                 C, K = problem.cq_opt_group.shape

@@ -67,7 +67,8 @@ class SolverEngine:
 
     def __init__(self, store: Store, queues: QueueManager,
                  scheduler=None, enable_fair_sharing: bool = False,
-                 remote=None, health: Optional[SolverHealth] = None) -> None:
+                 remote=None, health: Optional[SolverHealth] = None,
+                 mesh_mode: Optional[str] = None) -> None:
         self.store = store
         self.queues = queues
         #: host scheduler whose eviction state machine applies the plan's
@@ -152,6 +153,51 @@ class SolverEngine:
         #: apply prework computed during the overlap window (consumed
         #: and cleared by the apply paths)
         self._prework: Optional[dict] = None
+        #: mesh-sharded drains (solver/sharded.py): mesh mode string
+        #: from SolverBackendConfig.mesh / KUEUE_SOLVER_MESH — "auto"
+        #: (default; mesh when jax.device_count() > 1), "off", or an
+        #: explicit device count. The mesh itself resolves lazily.
+        self.mesh_mode = mesh_mode
+        self._mesh_obj = None
+        self._mesh_resolved = False
+        #: chaos/device-loss cap on mesh width (refresh_mesh)
+        self._mesh_max_devices = 0
+        #: a mesh drain fault (device loss, compile failure) trips this;
+        #: drains degrade to single-chip until refresh_mesh() re-probes
+        #: or the retry cooldown elapses (timed half-open, mirroring
+        #: the SolverHealth breaker — a transient fault must not
+        #: disable the mesh for the process lifetime)
+        self._mesh_broken = False
+        self._mesh_broken_at = 0.0
+        self.mesh_retry_cooldown_s = 300.0
+        #: backlogs below this stay single-chip: the mesh is the
+        #: LARGE-backlog path — tiny problems would pay per-shape SPMD
+        #: compiles for collectives they cannot amortize
+        self.mesh_min_workloads = 1024
+        #: pin drains to the mesh arm regardless of cost estimates
+        #: (bench measurement + parity tests; never set in production —
+        #: the whole point of the EMA router is measured routing)
+        self.mesh_force = False
+        #: adaptive arm routing: measured solve wall PER EXPORTED
+        #: WORKLOAD by (kernel kind, arm in {"single", "mesh"}); the
+        #: mesh arm engages only while its measured wall beats the
+        #: single-chip arm's (each arm is probed once, the losing arm
+        #: decays so a regressing winner gets re-measured). The HOST arm
+        #: of the triple lives in the scheduler's _drain_cost_ema /
+        #: _host_s_per_adm gate, which prices whatever arm ran here
+        #: against host cycles.
+        self._arm_ema: dict[tuple[str, str], float] = {}
+        #: arms whose compile-tainted first sample was discarded: the
+        #: probe drain pays one-time SPMD compilation + the full
+        #: resident upload, which would inflate the EMA ~100x and latch
+        #: the router against the arm; only warm samples are recorded
+        self._arm_warm: set[tuple[str, str]] = set()
+        #: chaos injection point: called with the arm name ("mesh" /
+        #: "single") right before each local solve; raising simulates a
+        #: device loss on that arm (kueue_oss_tpu/chaos MeshFaultInjector)
+        self.solve_fault_hook = None
+        #: arm that served the most recent local solve (diagnostics)
+        self.last_drain_arm: Optional[str] = None
 
     def _tracer(self):
         if self.tracer is not None:
@@ -390,9 +436,10 @@ class SolverEngine:
         problem, pending = self.export(pending)
         if problem.n_workloads == 0:
             return result
+        n_live = problem.n_workloads
         self._pad_hwm = max(self._pad_hwm,
                             _pow2(max(problem.n_workloads, self.pad_to)))
-        problem = pad_workloads(problem, self._pad_hwm)
+        problem = pad_workloads(problem, self._pad_target())
         problem, frame = self._session_encode("lean", problem)
 
         t0 = time.monotonic()
@@ -401,9 +448,9 @@ class SolverEngine:
              _usage) = self._dispatch_remote(
                 problem, 6, frame, "lean", verify, full=False)
         else:
-            tensors = self._local_tensors(problem, frame, full=False)
             (admitted, opt, admit_round, parked, rounds,
-             _usage) = solve_backlog(tensors)
+             _usage) = self._local_solve(problem, frame, full=False,
+                                         n_live=n_live)
         admitted = np.asarray(admitted)
         opt = np.asarray(opt)
         admit_round = np.asarray(admit_round)
@@ -428,7 +475,213 @@ class SolverEngine:
             "apply", value=result.apply_time_s)
         return result
 
+    # -- mesh routing (solver/meshutil.py, solver/sharded.py) --------------
+
+    def _mesh(self):
+        """The resolved solver mesh, or None (single device / off /
+        tripped by a mesh fault). A tripped mesh self-heals after
+        ``mesh_retry_cooldown_s`` (timed half-open: one probe drain
+        re-measures; another fault re-trips and restarts the clock)."""
+        if self._mesh_broken:
+            if (time.monotonic() - self._mesh_broken_at
+                    < self.mesh_retry_cooldown_s):
+                return None
+            self.refresh_mesh(self._mesh_max_devices)
+        if not self._mesh_resolved:
+            from kueue_oss_tpu.solver import meshutil
+
+            try:
+                self._mesh_obj = meshutil.detect_mesh(
+                    self.mesh_mode, self._mesh_max_devices)
+            except Exception:
+                self._mesh_obj = None  # backend init failure != crash
+            self._mesh_resolved = True
+        return self._mesh_obj
+
+    def refresh_mesh(self, max_devices: int = 0) -> int:
+        """Re-detect the mesh (recovery probe, or the chaos harness's
+        mesh-shrink: ``max_devices`` caps the width the way a lost
+        device shrinks the usable slice). Drops mesh-resident device
+        state and the mesh arm's cost estimate so the new topology is
+        re-measured from scratch. Returns the new device count."""
+        from kueue_oss_tpu.solver import meshutil
+
+        self._mesh_max_devices = max_devices
+        self._mesh_broken = False
+        self._mesh_resolved = False
+        for kind in ("lean", "full"):
+            self._device_states.pop(kind + "-mesh", None)
+            self._arm_ema.pop((kind, "mesh"), None)
+            self._arm_warm.discard((kind, "mesh"))
+        return meshutil.mesh_devices(self._mesh())
+
+    def _pick_mesh_arm(self, kind: str, n_workloads: int):
+        """The mesh to drain on, or None for single-chip — cost-EMA
+        routing with one probe per arm."""
+        mesh = self._mesh()
+        if mesh is None:
+            return None
+        if self.mesh_force:
+            return mesh
+        if n_workloads < self.mesh_min_workloads:
+            return None
+        e_mesh = self._arm_ema.get((kind, "mesh"))
+        e_single = self._arm_ema.get((kind, "single"))
+        if e_mesh is None:
+            return mesh          # probe the mesh arm first
+        if e_single is None:
+            return None          # then the single-chip arm
+        if e_mesh <= e_single:
+            # decay the skipped arm so an out-of-date estimate erodes
+            # and the loser eventually re-probes (same rationale as the
+            # scheduler's _drain_cost_ema decay)
+            self._arm_ema[(kind, "single")] = e_single * 0.98
+            return mesh
+        self._arm_ema[(kind, "mesh")] = e_mesh * 0.98
+        return None
+
+    def _note_arm_wall(self, kind: str, arm: str, wall_s: float,
+                       n_workloads: int) -> None:
+        key = (kind, arm)
+        if key not in self._arm_warm:
+            # compile-tainted probe sample: discard it (the arm stays
+            # unmeasured, so the router probes it once more, warm)
+            self._arm_warm.add(key)
+            return
+        per_wl = wall_s / max(1, n_workloads)
+        prev = self._arm_ema.get(key)
+        self._arm_ema[key] = (
+            per_wl if prev is None else 0.7 * prev + 0.3 * per_wl)
+
+    def _note_mesh_failure(self, e: BaseException, kind: str) -> None:
+        """A mesh drain fault (device loss / compile abort / injected):
+        count it, drop the possibly-corrupt mesh-resident state, and
+        degrade to single-chip until refresh_mesh() or the retry
+        cooldown re-probes. Never silent — metered AND journaled."""
+        self._mesh_broken = True
+        self._mesh_broken_at = time.monotonic()
+        self._arm_warm.discard((kind, "mesh"))
+        self._device_states.pop(kind + "-mesh", None)
+        metrics.solver_fallback_total.inc("mesh_error")
+        metrics.solver_mesh_devices.set(value=0)
+        obs.recorder.record(
+            obs.SOLVER_FALLBACK, obs.CYCLE_SCOPE, cycle=self._drain_cycle,
+            path=obs.SOLVER,
+            reason=f"mesh drain failed ({e!r}); degrading to the "
+                   "single-chip solver arm",
+            reason_slug="mesh_error")
+
+    def _local_solve(self, problem: SolverProblem, frame, *, full: bool,
+                     n_live: Optional[int] = None, **caps):
+        """In-process solve with the mesh -> single-chip fallback chain.
+
+        The mesh arm (when routed) drains the resident mesh-placed
+        state through the sharded SPMD program; any fault there is
+        counted and the SAME drain re-runs on the single-chip arm. A
+        single-chip fault escalates to SolverUnavailable so the
+        scheduler completes the admission round on host cycles — the
+        full chain is mesh -> single-chip -> host, every hop metered.
+        Outputs are materialized to numpy INSIDE each arm's window so
+        device faults surface here, not mid-apply.
+        """
+        import time as _time
+
+        from kueue_oss_tpu.solver import meshutil
+
+        kind = "full" if full else "lean"
+        # arm routing keys off the LIVE backlog, not the padded
+        # capacity: the sticky pad high-water mark must not keep a
+        # 3-workload trickle on the mesh arm after one large flood
+        if n_live is None:
+            n_live = meshutil.live_rows(problem.wl_cqid, problem.n_cqs)
+        W = n_live
+        mesh = self._pick_mesh_arm(kind, W)
+        if mesh is not None:
+            try:
+                # ONLY the fault-prone device work lives in the guarded
+                # block: bookkeeping below must not turn a metrics
+                # hiccup into a discarded plan + tripped mesh
+                if self.solve_fault_hook is not None:
+                    self.solve_fault_hook("mesh")
+                t0 = _time.monotonic()
+                tensors = self._local_tensors(problem, frame, full=full,
+                                              mesh=mesh)
+                if full:
+                    from kueue_oss_tpu.solver.full_kernels import (
+                        solve_backlog_full,
+                    )
+
+                    out = solve_backlog_full(tensors, mesh=mesh, **caps)
+                else:
+                    out = meshutil.lean_mesh_solver(mesh)(tensors)
+                out = tuple(np.asarray(a) for a in out)
+                wall = _time.monotonic() - t0
+            except Exception as e:
+                self._note_mesh_failure(e, kind)
+            else:
+                self._note_arm_wall(kind, "mesh", wall, W)
+                self.last_drain_arm = "mesh"
+                metrics.solver_mesh_devices.set(
+                    value=meshutil.mesh_devices(mesh))
+                if not full:
+                    # row-shard skew exists only on the lean drain; the
+                    # full kernel shards lanes with replicated rows
+                    metrics.solver_shard_imbalance.observe(
+                        value=meshutil.shard_imbalance(
+                            problem.wl_cqid, problem.n_cqs, mesh))
+                return out
+        try:
+            if self.solve_fault_hook is not None:
+                self.solve_fault_hook("single")
+            t0 = _time.monotonic()
+            tensors = self._local_tensors(problem, frame, full=full)
+            if full:
+                from kueue_oss_tpu.solver.full_kernels import (
+                    solve_backlog_full,
+                )
+
+                out = solve_backlog_full(tensors, **caps)
+            else:
+                out = solve_backlog(tensors)
+            out = tuple(np.asarray(a) for a in out)
+        except Exception as e:
+            # the single-chip arm died too (whole accelerator gone):
+            # degrade the round to host cycles, counted, never silent
+            self._device_states.pop(kind, None)
+            metrics.solver_fallback_total.inc("device_error")
+            metrics.solver_mesh_devices.set(value=0)
+            obs.recorder.record(
+                obs.SOLVER_FALLBACK, obs.CYCLE_SCOPE,
+                cycle=self._drain_cycle, path=obs.SOLVER,
+                reason=f"local solver backend fault ({e!r}); admissions "
+                       "degrade to the host cycle",
+                reason_slug="device_error")
+            raise SolverUnavailable(
+                f"local solver backend fault: {e!r}") from e
+        self._note_arm_wall(kind, "single", _time.monotonic() - t0, W)
+        self.last_drain_arm = "single"
+        metrics.solver_mesh_devices.set(value=0)
+        return out
+
     # -- delta-sync sessions + pipelined dispatch --------------------------
+
+    def _pad_target(self) -> int:
+        """Sticky pad target: the pow2 high-water mark, mesh-aligned
+        (meshutil.align_pad_target) so the padded workload axis plus
+        the null row block-shards evenly over the mesh. Alignment is
+        applied whenever a mesh is AVAILABLE — even on drains routed to
+        the single-chip arm — so session slot indices map to stable
+        (shard, local-row) coordinates across drains and both arms
+        solve the byte-identical padded problem. A remote sidecar's
+        advertised mesh width (learned from its session responses —
+        the client host may have no accelerators at all) joins the
+        alignment via lcm; the one-time capacity change when it is
+        first learned rides a counted shape_change full sync."""
+        from kueue_oss_tpu.solver.meshutil import align_pad_target
+
+        remote_w = (getattr(self.remote, "remote_mesh_devices", 0)
+                    if self.remote is not None else 0)
+        return align_pad_target(self._pad_hwm, self._mesh(), remote_w)
 
     def _session_encode(self, kind: str, problem: SolverProblem):
         """Stable slot/rank re-encoding + the SessionFrame to ship.
@@ -456,22 +709,37 @@ class SolverEngine:
         return sess.advance(problem)
 
     def _local_tensors(self, problem: SolverProblem, frame, *,
-                       full: bool):
+                       full: bool, mesh=None):
         """In-process path: resident device buffers keyed by session
         epoch — a delta epoch scatters only the dirty rows to the
-        device instead of re-uploading the padded problem."""
+        device (donated, so no full padded copy materializes) instead
+        of re-uploading the padded problem. With a ``mesh`` the lean
+        resident state lives sharded over the ``wl`` axis; mesh and
+        single-chip arms keep separate resident copies so arm flips
+        cannot corrupt each other's donated buffers."""
         if frame is None:
             if full:
                 from kueue_oss_tpu.solver.full_kernels import (
                     to_device_full,
                 )
 
-                return to_device_full(problem)
-            return to_device(problem)
+                t = to_device_full(problem)
+            else:
+                t = to_device(problem)
+            if mesh is not None and not full:
+                from kueue_oss_tpu.solver.sharded import maybe_place_lean
+
+                # same placement policy as the resident path; routing
+                # already cleared the live-row floor for this drain
+                t, _placed = maybe_place_lean(t, problem, mesh)
+            return t
         kind = "full" if full else "lean"
+        if mesh is not None:
+            kind = kind + "-mesh"
         dev = self._device_states.get(kind)
         if dev is None:
-            dev = self._device_states[kind] = DeviceResidentProblem()
+            dev = self._device_states[kind] = DeviceResidentProblem(
+                mesh=mesh)
         return dev.update(problem, frame, full)
 
     def _dispatch_remote(self, problem: SolverProblem, expect: int,
@@ -903,8 +1171,6 @@ class SolverEngine:
         like Scheduler._issue_preemptions → evict_workload), then
         admissions in (round, entry-order), then parking decisions.
         """
-        from kueue_oss_tpu.solver.full_kernels import solve_backlog_full
-
         result = DrainResult()
         if pending is None:
             pending = self.pending_backlog()
@@ -930,9 +1196,10 @@ class SolverEngine:
             return result
         g_max = int(problem.cq_ngroups.max())
         h_max, p_max = self._size_caps(problem)
+        n_live = problem.n_workloads
         self._pad_hwm = max(self._pad_hwm,
                             _pow2(max(problem.n_workloads, self.pad_to)))
-        problem = pad_workloads(problem, self._pad_hwm)
+        problem = pad_workloads(problem, self._pad_target())
         problem, frame = self._session_encode("full", problem)
 
         t0 = time.monotonic()
@@ -943,10 +1210,10 @@ class SolverEngine:
                 g_max=g_max, h_max=h_max, p_max=p_max,
                 fs_enabled=self.enable_fair_sharing)
         else:
-            tensors = self._local_tensors(problem, frame, full=True)
             (admitted, opt, admit_round, parked, rounds, _usage,
-             _wl_usage, victim_reason) = solve_backlog_full(
-                tensors, g_max, h_max, p_max,
+             _wl_usage, victim_reason) = self._local_solve(
+                problem, frame, full=True, n_live=n_live, g_max=g_max,
+                h_max=h_max, p_max=p_max,
                 fs_enabled=self.enable_fair_sharing)
         admitted = np.asarray(admitted)
         opt = np.asarray(opt)

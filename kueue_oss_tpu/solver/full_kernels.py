@@ -1058,6 +1058,8 @@ def _run_searches(t, usage, wl_usage, admitted, evicted, ts,
 
     from jax.sharding import PartitionSpec as P
 
+    from kueue_oss_tpu.solver.meshutil import pvary, shard_map
+
     W_null = t.wl_cqid.shape[0] - 1
     n_dev = mesh.shape[axis]
     L = flat_w.shape[0]
@@ -1079,11 +1081,10 @@ def _run_searches(t, usage, wl_usage, admitted, evicted, ts,
     def shard_body(hw, rq, av, cd, *rep):
         # mark the replicated state varying-over-mesh so while_loop
         # carries inside the search have consistent manual-axes types
-        rep = jax.tree_util.tree_map(
-            lambda x: jax.lax.pcast(x, (axis,), to="varying"), rep)
+        rep = jax.tree_util.tree_map(lambda x: pvary(x, axis), rep)
         return vsearch(hw, rq, av, cd, *rep)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis),
@@ -1461,11 +1462,15 @@ _solver_cache: dict = {}
 
 
 def solve_backlog_full(t: FullTensors, g_max: int, h_max: int = 32,
-                       p_max: int = 128, fs_enabled: bool = False):
+                       p_max: int = 128, fs_enabled: bool = False,
+                       mesh=None, axis: str = "wl"):
     """Cached-jit entry point; (g_max, h_max, p_max, fs) are compile-time.
 
     The fair-sharing gates are baked in at trace time, so they join the
-    cache key — a gate flip must not serve a stale compilation."""
+    cache key — a gate flip must not serve a stale compilation. With a
+    ``mesh``, the victim-search lanes shard across its devices
+    (_run_searches); the mesh joins the key so single-chip and mesh
+    programs coexist."""
     from kueue_oss_tpu import features
 
     gates = ()
@@ -1473,9 +1478,10 @@ def solve_backlog_full(t: FullTensors, g_max: int, h_max: int = 32,
         gates = (features.enabled("FairSharingPreemptWithinNominal"),
                  features.enabled("FairSharingPrioritizeNonBorrowing"),
                  features.enabled("PrioritySortingWithinCohort"))
-    key = (g_max, h_max, p_max, fs_enabled, gates)
+    key = (g_max, h_max, p_max, fs_enabled, gates, mesh, axis)
     fn = _solver_cache.get(key)
     if fn is None:
-        fn = make_full_solver(g_max, h_max, p_max, fs_enabled)
+        fn = make_full_solver(g_max, h_max, p_max, fs_enabled,
+                              mesh=mesh, axis=axis)
         _solver_cache[key] = fn
     return fn(t)

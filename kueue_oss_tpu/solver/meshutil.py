@@ -1,0 +1,195 @@
+"""Mesh plumbing shared by the engine, the sidecar, and the bench.
+
+The multi-chip kernels (solver/sharded.py, full_kernels mesh lanes)
+need three things every production call site repeats: a portable
+``shard_map`` (the API moved between jax releases; the image's jax
+still ships it under ``jax.experimental``), mesh *detection* (config /
+env / device-count auto), and a cache of jitted mesh drains so every
+drain of the same (mesh, shape) reuses one compiled SPMD program.
+Centralizing them here keeps `engine.py` and `service.py` free of
+version probing and makes the sidecar's placement decisions identical
+to the in-process engine's.
+
+Mesh mode grammar (``SolverBackendConfig.mesh`` / ``KUEUE_SOLVER_MESH``):
+
+- ``auto`` (default): build a 1-D ``wl`` mesh over all local devices
+  when ``jax.device_count() > 1``; single-chip otherwise.
+- ``off`` / ``none`` / ``0`` / ``1`` — and any unrecognized string —
+  never build a mesh (unknown values fail CLOSED: a typo must not
+  enable the multi-chip path).
+- an integer ``n``: mesh over the first ``n`` local devices; fewer
+  available devices means NO mesh, never a silently narrower one.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+MESH_AXIS = "wl"
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """Version-portable shard_map.
+
+    On a jax new enough to expose ``jax.shard_map`` the default
+    varying-axes checking runs (the kernels mark their per-shard
+    carries with :func:`pvary`); on the older ``jax.experimental``
+    spelling the replication checker is disabled instead — it predates
+    varying-type annotations, and the drain carries deliberately mix
+    replicated tree state with shard-varying workload rows."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as esm
+
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def pvary(x, axis: str):
+    """Mark a replicated value varying over ``axis`` where the running
+    jax tracks varying-axes types (``jax.lax.pcast``, paired with the
+    ``jax.shard_map`` spelling above); identity on older jax, where the
+    value is already just a per-device array inside shard_map."""
+    import jax
+
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, (axis,), to="varying")
+    return x
+
+
+def parse_mesh_mode(mode: Optional[str]) -> Optional[int]:
+    """Normalize a mesh mode string to a device-count request.
+
+    Returns None for "off", -1 for "auto" (all devices), or a positive
+    explicit device count. Unknown strings FAIL CLOSED (off): a typo-ed
+    env var intended to disable the multi-chip path must never enable
+    it — config-file values are additionally validated at load
+    (configuration.validate).
+    """
+    if mode is None:
+        import os
+
+        mode = os.environ.get("KUEUE_SOLVER_MESH") or "auto"
+    mode = str(mode).strip().lower()
+    if mode in ("auto", "on", "true", ""):
+        return -1
+    try:
+        n = int(mode)
+    except ValueError:
+        return None  # "off"/"none"/"disabled"/typos: all off
+    return n if n > 1 else None
+
+
+def detect_mesh(mode: Optional[str] = None, max_devices: int = 0):
+    """Build the 1-D ``wl`` mesh the mode asks for, or None.
+
+    An explicit device count requires at least that many local devices
+    — fewer yields no mesh (fail closed) rather than a silently
+    narrower layout. ``max_devices`` (when > 0) caps the mesh width —
+    the chaos harness's mesh-shrink injection re-detects with a lower
+    cap, the way a real device loss shrinks the usable slice.
+    """
+    want = parse_mesh_mode(mode)
+    if want is None:
+        return None
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if want < 0:
+        n = len(devices)
+    elif want > len(devices):
+        # pinned width unavailable: no mesh, not a silently narrower
+        # one (the docstring contract — an explicit count REQUIRES at
+        # least that many devices; solver_mesh_devices reports 0)
+        return None
+    else:
+        n = want
+    if max_devices > 0:
+        n = min(n, max_devices)
+    if n < 2:
+        return None
+    return Mesh(np.array(devices[:n]), (MESH_AXIS,))
+
+
+def mesh_devices(mesh) -> int:
+    return int(mesh.shape[MESH_AXIS]) if mesh is not None else 0
+
+
+def mesh_divisible(mesh, w1: int) -> bool:
+    """Whether a [W+1]-row workload axis block-shards evenly."""
+    return mesh is not None and w1 % mesh_devices(mesh) == 0
+
+
+def align_pad_target(target_w: int, mesh, extra_width: int = 0) -> int:
+    """Grow a pad target so the padded axis (target_w + null row)
+    splits evenly over the mesh — and over ``extra_width`` when given
+    (the REMOTE sidecar's advertised mesh, which need not match the
+    client's local device count; lcm covers both). Sticky with a
+    monotone pad high-water mark: the same widths always yield the same
+    alignment, so session slot coordinates (shard, local row) stay
+    stable across drains."""
+    import math
+
+    widths = [w for w in (mesh_devices(mesh), int(extra_width)) if w > 1]
+    if not widths:
+        return target_w
+    m = math.lcm(*widths)
+    return target_w + (-(target_w + 1)) % m
+
+
+def live_rows(wl_cqid, n_cqs: int) -> int:
+    """Real (non-padding, non-null, non-recycled) workload rows in a
+    padded export — the count the mesh floors gate on. ONE definition,
+    shared by engine routing, resident placement, and the sidecar."""
+    import numpy as np
+
+    return int((np.asarray(wl_cqid[:-1]) < n_cqs).sum())
+
+
+def shard_imbalance(wl_cqid, n_cqs: int, mesh) -> float:
+    """Real-row imbalance across shards: (max - min) / mean occupied
+    rows per shard (0.0 = perfectly even). Padding and recycled session
+    slots count as empty."""
+    import numpy as np
+
+    n = mesh_devices(mesh)
+    if n < 2:
+        return 0.0
+    occ = np.asarray(wl_cqid) < n_cqs
+    if occ.shape[0] % n != 0:
+        # defense in depth: callers only observe row-sharded (lean)
+        # drains, whose padded axis always divides; a non-divisible
+        # axis has no block shards to skew
+        return 0.0
+    per = occ.reshape(n, -1).sum(axis=1).astype(np.float64)
+    mean = float(per.mean())
+    if mean <= 0:
+        return 0.0
+    return float((per.max() - per.min()) / mean)
+
+
+#: jitted lean mesh drains keyed by (mesh, axis); shapes key further
+#: inside jit's own cache
+_lean_cache: dict = {}
+
+
+def lean_mesh_solver(mesh, axis: str = MESH_AXIS):
+    """Cached jitted production lean drain for ``mesh`` — the full
+    solve_backlog contract (admitted, opt, admit_round, parked, rounds,
+    usage), bit-identical to the single-chip kernel."""
+    import jax
+
+    key = (mesh, axis)
+    fn = _lean_cache.get(key)
+    if fn is None:
+        from kueue_oss_tpu.solver.sharded import make_sharded_drain
+
+        fn = jax.jit(make_sharded_drain(mesh, axis))
+        _lean_cache[key] = fn
+    return fn

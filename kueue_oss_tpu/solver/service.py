@@ -183,21 +183,28 @@ def deserialize_problem(meta: dict, blob: bytes) -> SolverProblem:
     return SolverProblem(**kwargs)
 
 
-def _solve_kernel(tensors, header: dict):
+def _solve_kernel(tensors, header: dict, mesh=None):
     """Run the jitted kernel matching the request params; returns
-    (out tuple, legacy array names)."""
+    (out tuple, legacy array names). With a ``mesh`` the full kernel
+    shards its victim-search lanes and the lean kernel runs the
+    sharded SPMD drain (bit-identical plans either way)."""
     if header["full"]:
         from kueue_oss_tpu.solver.full_kernels import solve_backlog_full
 
         out = solve_backlog_full(
             tensors, header["g_max"], header["h_max"], header["p_max"],
-            fs_enabled=header["fs_enabled"])
+            fs_enabled=header["fs_enabled"], mesh=mesh)
         names = ["admitted", "opt", "admit_round", "parked",
                  "rounds", "usage", "wl_usage", "victim_reason"]
     else:
-        from kueue_oss_tpu.solver.kernels import solve_backlog
+        if mesh is not None:
+            from kueue_oss_tpu.solver.meshutil import lean_mesh_solver
 
-        out = solve_backlog(tensors)
+            out = lean_mesh_solver(mesh)(tensors)
+        else:
+            from kueue_oss_tpu.solver.kernels import solve_backlog
+
+            out = solve_backlog(tensors)
         names = ["admitted", "opt", "admit_round", "parked",
                  "rounds", "usage"]
     return out, names
@@ -269,18 +276,77 @@ def expand_compact_plan(data, W1: int, full: bool, g_max: int):
 
 class _SidecarSession:
     """Resident state for one (sid) delta-sync session: the problem's
-    numpy mirror + the device tensors pinned across drains."""
+    numpy mirror + the device tensors pinned across drains (mesh-placed
+    over the sidecar's ``wl`` mesh when one is detected and the padded
+    axis shards evenly)."""
 
-    def __init__(self) -> None:
+    def __init__(self, mesh=None) -> None:
         self.lock = threading.Lock()
         self.kwargs: Optional[dict] = None
         self.meta: Optional[dict] = None
         self.epoch = -1
-        self.device = DeviceResidentProblem()
+        self.device = DeviceResidentProblem(mesh=mesh)
 
 
 def _resync(reason: str) -> tuple[dict, bytes]:
     return {"ok": False, "resync": reason}, b""
+
+
+def _solve_mesh(server, sess, full: bool, n_live: int):
+    """The mesh this solve should run on, or None. Lean solves follow
+    the session's resident placement; full solves lane-shard over the
+    server mesh when the LIVE row count clears the floor (the tensors
+    stay replicated)."""
+    if not full:
+        return sess.device.mesh if sess.device.mesh_placed else None
+    if server is None or getattr(server, "mesh", None) is None:
+        return None
+    if n_live < getattr(server, "mesh_min_workloads", 0):
+        return None
+    return server.mesh
+
+
+def _solve_resilient(server, sess, tensors, header: dict,
+                     problem: SolverProblem, frame):
+    """Mesh solve with the sidecar-side mesh -> single-chip fallback.
+
+    Mirrors the in-process engine's chain: a mesh fault (device loss,
+    SPMD compile abort) trips the SERVER mesh, re-seeds the session's
+    resident state unsharded, and serves the same request single-chip —
+    one slow request instead of a permanently failing sidecar. Counted
+    in this process's solver_fallback_total{mesh_error}; never silent.
+    Successful mesh solves report this process's mesh width gauge and
+    shard-imbalance histogram, exactly like the in-process engine arm.
+    """
+    from kueue_oss_tpu.solver import meshutil
+
+    mesh = _solve_mesh(server, sess, bool(header["full"]),
+                       meshutil.live_rows(problem.wl_cqid,
+                                          problem.n_cqs))
+    if mesh is not None:
+        try:
+            out = _solve_kernel(tensors, header, mesh)[0]
+            metrics.solver_mesh_devices.set(
+                value=meshutil.mesh_devices(mesh))
+            if not header["full"]:
+                # row-shard skew exists only on the lean (row-sharded)
+                # drain; full drains lane-shard with replicated rows
+                metrics.solver_shard_imbalance.observe(
+                    value=meshutil.shard_imbalance(
+                        problem.wl_cqid, problem.n_cqs, mesh))
+            return out
+        except Exception:
+            metrics.solver_fallback_total.inc("mesh_error")
+            metrics.solver_mesh_devices.set(value=0)
+            if server is not None:
+                server.mesh = None
+            sess.device.mesh = None
+            sess.device.tensors = None  # force an unsharded re-seed
+            tensors = sess.device.update(problem, frame,
+                                         bool(header["full"]))
+    out = _solve_kernel(tensors, header, None)[0]
+    metrics.solver_mesh_devices.set(value=0)
+    return out
 
 
 def _session_request(header: dict, blob: bytes,
@@ -310,7 +376,8 @@ def _session_request(header: dict, blob: bytes,
                                  checksum=int(want or 0), delta=None)
             tensors = sess.device.update(problem, frame,
                                          bool(header["full"]))
-            out, _names = _solve_kernel(tensors, header)
+            out = _solve_resilient(server, sess, tensors, header,
+                                   problem, frame)
             arrays = compact_plan(out, bool(header["full"]))
             epoch = sess.epoch
     else:  # delta
@@ -335,12 +402,21 @@ def _session_request(header: dict, blob: bytes,
                                  checksum=delta.checksum, delta=delta)
             tensors = sess.device.update(problem, frame,
                                          bool(header["full"]))
-            out, _names = _solve_kernel(tensors, header)
+            out = _solve_resilient(server, sess, tensors, header,
+                                   problem, frame)
             arrays = compact_plan(out, bool(header["full"]))
             epoch = sess.epoch
     buf = io.BytesIO()
     np.savez(buf, **arrays)
+    from kueue_oss_tpu.solver.meshutil import mesh_devices
+
+    # advertise the sidecar's mesh width so a mesh-less client can
+    # re-pad its next drains to a shardable axis (engine._pad_target);
+    # without this, a CPU-only control plane would ship pow2+1 rows
+    # forever and the accelerator sidecar could never shard them
     return {"ok": True, "compact": True, "epoch": epoch,
+            "mesh_devices": mesh_devices(getattr(server, "mesh", None)
+                                         if server is not None else None),
             "spans": _spans(header, t0)}, buf.getvalue()
 
 
@@ -422,7 +498,9 @@ class SolverServer(socketserver.ThreadingUnixStreamServer):
     def __init__(self, socket_path: str,
                  max_frame_bytes: Optional[int] = None,
                  read_timeout_s: Optional[float] = None,
-                 max_sessions: int = 4) -> None:
+                 max_sessions: int = 4,
+                 mesh_mode: Optional[str] = None,
+                 mesh_min_workloads: int = 1024) -> None:
         if os.path.exists(socket_path):
             os.unlink(socket_path)
         super().__init__(socket_path, _Handler)
@@ -436,12 +514,26 @@ class SolverServer(socketserver.ThreadingUnixStreamServer):
         self.sessions: dict[str, _SidecarSession] = {}
         self._sessions_lock = threading.Lock()
         self.max_sessions = max(1, int(max_sessions))
+        #: sidecar mesh detection (solver/meshutil.py): sessions place
+        #: their resident lean tensors over the mesh and solve via the
+        #: sharded SPMD drain; full solves lane-shard. KUEUE_SOLVER_MESH
+        #: / mesh_mode governs it exactly like the in-process engine.
+        try:
+            from kueue_oss_tpu.solver.meshutil import detect_mesh
+
+            self.mesh = detect_mesh(mesh_mode)
+        except Exception:
+            self.mesh = None
+        #: problems narrower than this solve single-chip even with a
+        #: mesh (the mesh is the large-backlog path)
+        self.mesh_min_workloads = int(mesh_min_workloads)
 
     def session(self, sid: str) -> _SidecarSession:
         with self._sessions_lock:
             sess = self.sessions.pop(sid, None)
             if sess is None:
-                sess = _SidecarSession()
+                sess = _SidecarSession(mesh=self.mesh)
+                sess.device.mesh_min_rows = self.mesh_min_workloads
             self.sessions[sid] = sess  # re-insert = LRU touch
             while len(self.sessions) > self.max_sessions:
                 self.sessions.pop(next(iter(self.sessions)))
@@ -532,6 +624,10 @@ class SolverClient:
         self.trace_cycle: Optional[int] = None
         #: sidecar spans from the LAST successful solve's response header
         self.last_spans: list[dict] = []
+        #: the sidecar's advertised mesh width (session responses);
+        #: the engine aligns its pad target to it so the sidecar can
+        #: shard the resident problem (0 = unknown / no sidecar mesh)
+        self.remote_mesh_devices = 0
         if sessions is None:
             sessions = os.environ.get("KUEUE_SOLVER_SESSIONS") != "0"
         self.use_sessions = bool(sessions)
@@ -699,6 +795,10 @@ class SolverClient:
                 f"{resp.get('error', 'unknown')}")
         spans = resp.get("spans")
         self.last_spans = spans if isinstance(spans, list) else []
+        try:
+            self.remote_mesh_devices = int(resp.get("mesh_devices", 0))
+        except (TypeError, ValueError):
+            self.remote_mesh_devices = 0
         try:
             data = np.load(io.BytesIO(body))
             if resp.get("compact"):

@@ -12,12 +12,22 @@ small collectives, all riding ICI:
 
 The nomination + admission scan then runs replicated (identical on every
 device — it only touches [C]- and [N,F]-sized state), and each device
-updates the admitted/parked flags for its own workload shard. This keeps
-per-round collective volume at ~C*K*F ints regardless of backlog size.
+updates the admitted/parked/option/round plan state for its own workload
+shard. This keeps per-round collective volume at ~C*K*F ints regardless
+of backlog size.
+
+The drain is the PRODUCTION lean path, not a dry-run harness: it
+returns the full ``solve_backlog`` contract — (admitted, opt,
+admit_round, parked, rounds, usage) — bit-identical to the single-chip
+kernel on the same padded problem, so `SolverEngine` and the sidecar
+route large backlogs here without changing a byte of the apply path
+(engine mesh routing: solver/engine.py; placement + resident state:
+solver/delta.py DeviceResidentProblem; detection: solver/meshutil.py).
 """
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 
 import jax
@@ -34,17 +44,25 @@ from kueue_oss_tpu.solver.kernels import (
     potential_available_all,
     refresh_cohort_usage,
 )
+from kueue_oss_tpu.solver.meshutil import pvary, shard_map
 from kueue_oss_tpu.solver.tensors import BIG, SolverProblem
+
+#: NamedSharding specs for the lean ProblemTensors: workload axis
+#: sharded, node/CQ state replicated. Shared by the engine's resident
+#: device state and the ad-hoc solve path below.
+LEAN_WL_FIELDS = ("wl_cqid", "wl_rank", "wl_prio", "wl_ts", "wl_uid",
+                  "wl_req", "wl_valid")
 
 
 def pad_workloads(p: SolverProblem, multiple: int) -> SolverProblem:
     """Pad the workload axis so (W+1) divides evenly across the mesh.
 
-    Padding rows replicate the null-workload row (rank BIG, no options),
-    so they are never selected as heads.
+    Padding rows replicate the null-workload row (rank BIG, null CQ id,
+    no options), so they are never selected as heads. Fills must not
+    alias real rows: ``wl_uid`` pads with BIG (a real uid-0 row must
+    stay distinguishable from padding), every flag with its inert
+    value.
     """
-    import dataclasses
-
     W1 = p.wl_cqid.shape[0]
     target = ((W1 + multiple - 1) // multiple) * multiple
     pad = target - W1
@@ -62,10 +80,52 @@ def pad_workloads(p: SolverProblem, multiple: int) -> SolverProblem:
         wl_rank=pad1(p.wl_rank, BIG),
         wl_prio=pad1(p.wl_prio, 0),
         wl_ts=pad1(p.wl_ts, 0),
-        wl_uid=pad1(p.wl_uid, 0),
+        wl_uid=pad1(p.wl_uid, BIG),
         wl_req=pad1(p.wl_req, 0),
         wl_valid=pad1(p.wl_valid, False),
     )
+
+
+def lean_shardings(mesh: Mesh, axis: str = "wl") -> dict:
+    """field -> NamedSharding for mesh-placing lean problem tensors."""
+    row = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    return {f: (row if f in LEAN_WL_FIELDS else rep)
+            for f in ProblemTensors._fields}
+
+
+def place_lean_tensors(t: ProblemTensors, mesh: Mesh,
+                       axis: str = "wl") -> ProblemTensors:
+    """Mesh-place lean tensors: workload rows block-sharded over the
+    ``wl`` axis, tree/CQ state replicated. Requires an evenly divisible
+    padded axis (meshutil.align_pad_target)."""
+    n_dev = mesh.shape[axis]
+    W1 = t.wl_cqid.shape[0]
+    if W1 % n_dev != 0:
+        raise ValueError(
+            f"workload axis of {W1} rows does not shard over {n_dev} "
+            "devices; pad with meshutil.align_pad_target first")
+    sh = lean_shardings(mesh, axis)
+    return t._replace(**{
+        f: jax.device_put(getattr(t, f), sh[f])
+        for f in ProblemTensors._fields})
+
+
+def maybe_place_lean(t: ProblemTensors, problem: SolverProblem, mesh,
+                     min_rows: int = 0,
+                     axis: str = "wl") -> tuple[ProblemTensors, bool]:
+    """Mesh-place lean tensors when the policy allows: a mesh exists,
+    the padded axis divides evenly, and the LIVE row count clears
+    ``min_rows``. One placement policy, shared by the resident device
+    state and the engine's sessionless path. Returns (tensors,
+    placed)."""
+    from kueue_oss_tpu.solver.meshutil import live_rows, mesh_divisible
+
+    if (mesh is None
+            or not mesh_divisible(mesh, problem.wl_cqid.shape[0])
+            or live_rows(problem.wl_cqid, problem.n_cqs) < min_rows):
+        return t, False
+    return place_lean_tensors(t, mesh, axis), True
 
 
 def _local_heads(t_local, C, w_offset, admitted, parked):
@@ -84,7 +144,13 @@ def _local_heads(t_local, C, w_offset, admitted, parked):
 
 
 def make_sharded_drain(mesh: Mesh, axis: str = "wl"):
-    """Build the sharded drain fn for a mesh; call with sharded tensors."""
+    """Build the sharded PRODUCTION drain for a mesh.
+
+    Call with mesh-placed (or host) tensors whose padded workload axis
+    divides evenly; returns the full solve_backlog tuple (admitted,
+    opt, admit_round, parked, rounds, usage), bit-identical to the
+    single-chip kernel on the same padded problem.
+    """
 
     n_dev = mesh.shape[axis]
 
@@ -93,7 +159,6 @@ def make_sharded_drain(mesh: Mesh, axis: str = "wl"):
         W1 = t.wl_rank.shape[0]
         K = t.wl_req.shape[1]
         F = t.wl_req.shape[2]
-        W_null = W1 - 1
         shard = W1 // n_dev
 
         node_specs = ProblemTensors(
@@ -106,9 +171,9 @@ def make_sharded_drain(mesh: Mesh, axis: str = "wl"):
         )
 
         @partial(
-            jax.shard_map, mesh=mesh,
+            shard_map, mesh=mesh,
             in_specs=(node_specs,),
-            out_specs=(P(axis), P(axis), P(), P()),
+            out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
         )
         def run(tl: ProblemTensors):
             my = jax.lax.axis_index(axis)
@@ -119,7 +184,8 @@ def make_sharded_drain(mesh: Mesh, axis: str = "wl"):
                 return state[-2] & (state[-1] < W1 + C + 2)
 
             def body(state):
-                usage, admitted, parked, cursor_c, prev_head, _, rounds = state
+                (usage, admitted, parked, opt, admit_round, cursor_c,
+                 prev_head, _, rounds) = state
 
                 # --- head selection across shards (2x pmin over ICI) ---
                 min_rank_l, head_w_l = _local_heads(
@@ -196,10 +262,16 @@ def make_sharded_drain(mesh: Mesh, axis: str = "wl"):
                 # --- scatter results back to the local shard -----------
                 adm_slot = adm_c[:C]
                 park_slot = park_c[:C]
-                # Scatter-or (duplicate clipped indices from non-owned
-                # slots must not clobber owned writes).
-                admitted = admitted.at[lw].max(mine & adm_slot)
+                # Scatter-or / scatter-max (duplicate clipped indices
+                # from non-owned slots must not clobber owned writes; a
+                # row is admitted at most once, so max with the inert
+                # fill is exact).
+                newly = mine & adm_slot
+                admitted = admitted.at[lw].max(newly)
                 parked = parked.at[lw].max(mine & park_slot)
+                opt = opt.at[lw].max(jnp.where(newly, k_chosen, 0))
+                admit_round = admit_round.at[lw].max(
+                    jnp.where(newly, rounds, -1))
                 keep = is_head & ~adm_slot
                 cursor_next = jnp.where(keep, next_cursor, 0)
                 cursor_changed = jnp.any(
@@ -212,23 +284,26 @@ def make_sharded_drain(mesh: Mesh, axis: str = "wl"):
                 progress = (any_admitted
                             | jnp.any(park_slot & is_head)
                             | cursor_changed)
-                return (usage, admitted, parked, cursor_c, head_w,
-                        progress, rounds + 1)
+                return (usage, admitted, parked, opt, admit_round,
+                        cursor_c, head_w, progress, rounds + 1)
 
             init = (
                 tl.usage0,
-                # admitted/parked are per-shard state: mark them varying
-                # over the mesh axis so the carry types line up.
-                jax.lax.pcast(jnp.zeros((shard,), dtype=bool), (axis,), to='varying'),
-                jax.lax.pcast(jnp.zeros((shard,), dtype=bool), (axis,), to='varying'),
+                # admitted/parked/opt/admit_round are per-shard plan
+                # state: mark them varying over the mesh axis so the
+                # carry types line up.
+                pvary(jnp.zeros((shard,), dtype=bool), axis),
+                pvary(jnp.zeros((shard,), dtype=bool), axis),
+                pvary(jnp.zeros((shard,), dtype=jnp.int32), axis),
+                pvary(jnp.full((shard,), -1, dtype=jnp.int32), axis),
                 jnp.zeros((C + 1,), dtype=jnp.int32),
                 jnp.full((C,), BIG, dtype=jnp.int32),
                 jnp.ones((), dtype=bool),
                 jnp.zeros((), dtype=jnp.int32),
             )
-            usage, admitted, parked, _, _, _, rounds = jax.lax.while_loop(
-                cond, body, init)
-            return admitted, parked, rounds, usage
+            (usage, admitted, parked, opt, admit_round, _, _, _,
+             rounds) = jax.lax.while_loop(cond, body, init)
+            return admitted, opt, admit_round, parked, rounds, usage
 
         return run(t)
 
@@ -264,30 +339,27 @@ def solve_backlog_full_sharded(problem: SolverProblem, mesh: Mesh,
 
 def solve_backlog_sharded(problem: SolverProblem, mesh: Mesh,
                           axis: str = "wl"):
-    """Shard, place, and drain a problem over the mesh. Returns
-    (admitted [W+1] bool on host, parked, rounds, usage)."""
+    """Shard, place, and drain a problem over the mesh.
+
+    Returns the full plan on host: (admitted [W+1] bool, opt [W+1]
+    int32, admit_round [W+1] int32, parked [W+1] bool, rounds int,
+    usage [N+1, F]) — the same contract as ``solve_backlog``, sliced
+    back to the caller's row count.
+    """
     from kueue_oss_tpu.solver.kernels import to_device
+    from kueue_oss_tpu.solver.meshutil import lean_mesh_solver
 
     n_dev = mesh.shape[axis]
     padded = pad_workloads(problem, n_dev)
-    t = to_device(padded)
-    sharding = NamedSharding(mesh, P(axis))
-    rep = NamedSharding(mesh, P())
-    t = t._replace(
-        wl_cqid=jax.device_put(t.wl_cqid, sharding),
-        wl_rank=jax.device_put(t.wl_rank, sharding),
-        wl_prio=jax.device_put(t.wl_prio, sharding),
-        wl_ts=jax.device_put(t.wl_ts, sharding),
-        wl_uid=jax.device_put(t.wl_uid, sharding),
-        wl_req=jax.device_put(t.wl_req, sharding),
-        wl_valid=jax.device_put(t.wl_valid, sharding),
-        usage0=jax.device_put(t.usage0, rep),
-    )
-    drain = jax.jit(make_sharded_drain(mesh, axis))
-    admitted, parked, rounds, usage = drain(t)
+    t = place_lean_tensors(to_device(padded), mesh, axis)
+    admitted, opt, admit_round, parked, rounds, usage = (
+        lean_mesh_solver(mesh, axis)(t))
     W1 = problem.wl_cqid.shape[0]
     admitted = np.asarray(admitted)[:W1].copy()
     parked = np.asarray(parked)[:W1].copy()
+    opt = np.asarray(opt)[:W1].copy()
+    admit_round = np.asarray(admit_round)[:W1].copy()
     admitted[-1] = False
     parked[-1] = False
-    return admitted, parked, int(np.asarray(rounds)), np.asarray(usage)
+    return (admitted, opt, admit_round, parked, int(np.asarray(rounds)),
+            np.asarray(usage))

@@ -12,6 +12,7 @@ borrowing limits, usage) plus the queue heads' request vectors.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from dataclasses import dataclass, field
 from typing import Optional
@@ -194,12 +195,13 @@ def pad_workloads(problem: SolverProblem, target_w: int) -> SolverProblem:
 
     Padding rows carry the null CQ id (C) so head selection's segment
     reduction drops them, no valid options, and no initial state — they
-    are inert. Power-of-two bucketing keeps the jitted kernels' shape
-    cache small when drains run repeatedly over a changing backlog
-    (the Simulator drains after every event batch).
+    are inert. Fills must never alias a real row: ``wl_uid`` pads with
+    BIG, not 0 (a legitimate uid-0 workload must stay distinguishable
+    from padding in any uid-keyed comparison or diagnostic decode).
+    Power-of-two bucketing keeps the jitted kernels' shape cache small
+    when drains run repeatedly over a changing backlog (the Simulator
+    drains after every event batch).
     """
-    import dataclasses
-
     W = problem.n_workloads
     if target_w <= W:
         return problem
@@ -220,7 +222,7 @@ def pad_workloads(problem: SolverProblem, target_w: int) -> SolverProblem:
         wl_rank=pad1(problem.wl_rank, BIG),
         wl_prio=pad1(problem.wl_prio, 0),
         wl_ts=pad1(problem.wl_ts, 0),
-        wl_uid=pad1(problem.wl_uid, 0),
+        wl_uid=pad1(problem.wl_uid, BIG),
         wl_req=pad1(problem.wl_req, 0),
         wl_valid=pad1(problem.wl_valid, False),
         wl_parked0=pad1(problem.wl_parked0, False),

@@ -575,3 +575,91 @@ def test_node_flap_injector_round_trips():
     restored = flapper.flap_up()
     assert sorted(restored) == sorted(downed)
     assert all(node.ready for node in store.nodes.values())
+
+
+# ---------------------------------------------------------------------------
+# mesh faults: device loss / mesh shrink -> mesh -> single-chip -> host
+# (docs/ROBUSTNESS.md "Mesh faults"; the multi-chip failure model)
+# ---------------------------------------------------------------------------
+
+
+def _mesh_engine(n_wl=24):
+    store = _store()
+    _flood(store, n_wl)
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched)
+    engine.mesh_min_workloads = 0
+    engine.mesh_force = True
+    sched.solver = engine
+    sched.solver_min_backlog = 0
+    return store, queues, sched, engine
+
+
+def test_mesh_device_loss_falls_back_to_single_chip_in_same_drain():
+    from kueue_oss_tpu.chaos import MeshFaultInjector
+
+    store, queues, sched, engine = _mesh_engine()
+    injector = MeshFaultInjector(engine)
+    before = metrics.solver_fallback_total.collect().get(
+        ("mesh_error",), 0)
+    injector.lose_mesh(1)
+    result = engine.drain(now=0.0)
+    # the SAME drain completed on the single-chip arm; counted fallback
+    assert engine.last_drain_arm == "single"
+    assert result.admitted > 0
+    assert metrics.solver_fallback_total.collect().get(
+        ("mesh_error",), 0) == before + 1
+    assert injector.injected.get("mesh_lost") == 1
+    # mesh stays tripped (no re-probe) until an explicit refresh heals it
+    assert engine._mesh() is None
+    assert injector.restore() > 1
+    assert engine._mesh() is not None
+    assert _admitted(store) == _host_only_admitted()
+
+
+def test_full_device_loss_degrades_round_to_host_cycles():
+    """Both local arms gone -> SolverUnavailable -> the scheduler
+    finishes the admission round on host cycles; every hop counted."""
+    from kueue_oss_tpu.chaos import MeshFaultInjector
+
+    store, queues, sched, engine = _mesh_engine()
+    injector = MeshFaultInjector(engine)
+    mesh0 = metrics.solver_fallback_total.collect().get(
+        ("mesh_error",), 0)
+    dev0 = metrics.solver_fallback_total.collect().get(
+        ("device_error",), 0)
+    injector.lose_all(1)
+    cycles = sched.run_until_quiet(now=0.0, tick=1.0)
+    assert cycles >= 1
+    assert _admitted(store) == _host_only_admitted()
+    assert metrics.solver_fallback_total.collect().get(
+        ("mesh_error",), 0) == mesh0 + 1
+    assert metrics.solver_fallback_total.collect().get(
+        ("device_error",), 0) == dev0 + 1
+    assert injector.injected == {"mesh_lost": 1, "single_lost": 1}
+
+
+def test_mesh_shrink_repads_and_keeps_plans_bit_identical():
+    from kueue_oss_tpu.chaos import MeshFaultInjector
+
+    store, queues, sched, engine = _mesh_engine()
+    injector = MeshFaultInjector(engine)
+    engine.drain(now=0.0)
+    assert engine.last_drain_arm == "mesh"
+    sess = engine._delta_sessions["lean"]
+    syncs0 = sess.full_syncs
+    # partial device loss: 8 -> 4 devices; next drain re-pads, the
+    # session rides the forced full sync, and the plan still matches
+    # the host-only scheduler exactly
+    assert injector.shrink(4) == 4
+    _flood(store, 8, start=100)
+    engine.drain(now=1.0)
+    assert engine.last_drain_arm == "mesh"
+    assert sess.full_syncs > syncs0  # shape change = full sync, counted
+    store_h = _store()
+    _flood(store_h, 24)
+    _flood(store_h, 8, start=100)
+    qh = QueueManager(store_h)
+    Scheduler(store_h, qh).run_until_quiet(now=0.0, tick=1.0)
+    assert _admitted(store) == _admitted(store_h)

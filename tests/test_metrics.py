@@ -213,3 +213,59 @@ def test_render_exposition_format():
     assert '# TYPE kueue_admitted_workloads_total counter' in text
     assert 'kueue_admitted_workloads_total{cluster_queue="cq"} 1' in text
     assert 'kueue_admission_attempt_duration_seconds_count{result="success"} 1' in text
+
+
+def test_solver_mesh_devices_gauge_tracks_active_mesh():
+    """kueue_tpu_solver_mesh_devices: drain-scoped mesh width; 0 means
+    single-chip / host path (the fallback chain resets it)."""
+    g = metrics.solver_mesh_devices
+    assert g.value() == 0  # reset state: nothing reported yet
+    g.set(value=8)
+    assert g.value() == 8
+    g.set(value=0)  # mesh fault / single-chip drain zeroes it
+    assert g.value() == 0
+    rendered = metrics.registry.render()
+    assert "# TYPE kueue_tpu_solver_mesh_devices gauge" in rendered
+    assert "kueue_tpu_solver_mesh_devices 0" in rendered
+
+
+def test_solver_shard_imbalance_histogram_buckets():
+    """kueue_tpu_solver_shard_imbalance: (max-min)/mean occupied rows
+    per mesh drain; perfectly-even drains land in every bucket
+    (value 0), pathological skew only in +Inf."""
+    h = metrics.solver_shard_imbalance
+    h.observe(value=0.0)    # perfectly even
+    h.observe(value=0.3)    # mild skew
+    h.observe(value=100.0)  # pathological: beyond the top bucket
+    counts, total, n = h.collect()[()]
+    assert n == 3 and total == 100.3
+    by_edge = dict(zip(h.buckets, counts))
+    assert by_edge[0.01] == 1          # only the even drain
+    assert by_edge[0.5] == 2           # even + mild
+    assert by_edge[8.0] == 2           # 100.0 exceeds every edge
+    rendered = metrics.registry.render()
+    assert ('kueue_tpu_solver_shard_imbalance_bucket{le="+Inf"} 3'
+            in rendered)
+
+
+def test_mesh_drain_reports_mesh_metrics():
+    """A production engine drain routed to the mesh arm must report the
+    mesh width gauge and one imbalance observation (tests the engine
+    wiring, not just the series)."""
+    store, queues, sched = _mk_env()
+    for i in range(8):
+        store.add_workload(Workload(
+            name=f"mw{i}", queue_name="lq", uid=i + 1,
+            creation_time=float(i),
+            podsets=[PodSet(count=1, requests={"cpu": 100})]))
+    from kueue_oss_tpu.solver.engine import SolverEngine
+
+    engine = SolverEngine(store, queues, scheduler=sched)
+    engine.mesh_min_workloads = 0
+    engine.mesh_force = True
+    n0 = metrics.solver_shard_imbalance.total_count()
+    result = engine.drain(now=0.0)
+    assert result.admitted == 8
+    assert engine.last_drain_arm == "mesh"
+    assert metrics.solver_mesh_devices.value() >= 2
+    assert metrics.solver_shard_imbalance.total_count() == n0 + 1

@@ -30,7 +30,15 @@ def run_both(store, eight_devices):
     t = to_device(problem)
     adm1, opt1, rnd1, parked1, rounds1, usage1 = solve_backlog(t)
     mesh = make_mesh(eight_devices)
-    adm8, parked8, rounds8, usage8 = solve_backlog_sharded(problem, mesh)
+    adm8, opt8, rnd8, parked8, rounds8, usage8 = solve_backlog_sharded(
+        problem, mesh)
+    # the sharded drain is the PRODUCTION lean path: the whole plan —
+    # flavor options and admit rounds included — must be bit-identical,
+    # not just the admitted set
+    W1 = problem.wl_cqid.shape[0]
+    assert (np.asarray(opt1)[:W1] == opt8).all()
+    assert (np.asarray(rnd1)[:W1] == rnd8).all()
+    assert int(rounds1) == rounds8
     return (np.asarray(adm1), np.asarray(parked1), np.asarray(usage1),
             adm8, parked8, usage8, problem)
 

@@ -426,3 +426,274 @@ def test_session_prunes_oversized_rankers():
     _slotted, frame = session.advance(problem)
     assert frame.full_reason == "ranker_prune"
     assert session._ts.size < 4096, "rankers rebuilt from live rows only"
+
+
+# ---------------------------------------------------------------------------
+# mesh-resident sessions (docs/SOLVER_PROTOCOL.md "Mesh-resident sessions")
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [5, 19])
+def test_mesh_resident_replay_bit_identical_uneven_shards(
+        seed, eight_devices):
+    """The randomized event-replay property, extended to the mesh:
+    delta-applied MESH-resident device state must stay bit-identical to
+    a fresh full sync AND to the single-chip resident path after every
+    event batch — with a padded axis whose real rows do NOT divide
+    evenly over the 8 shards (W % n_dev != 0)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from kueue_oss_tpu.solver.delta import DeviceResidentProblem
+    from kueue_oss_tpu.solver.kernels import solve_backlog, to_device
+    from kueue_oss_tpu.solver.meshutil import (
+        align_pad_target,
+        lean_mesh_solver,
+    )
+
+    mesh = Mesh(np.asarray(eight_devices), ("wl",))
+    rng = random.Random(seed)
+    store = _store(quota=6, preemption=False)
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched,
+                          mesh_mode="off")
+    session = HostDeltaSession(cache=engine.export_cache)
+    dev_mesh = DeviceResidentProblem(mesh=mesh)
+    dev_one = DeviceResidentProblem()
+    # padded so W1 = 56 shards evenly over 8 devices while the REAL
+    # row count (<= ~30) never does (uneven shard occupancy every step)
+    target = align_pad_target(48, mesh)
+    assert (target + 1) % 8 == 0
+    next_uid = [0]
+
+    def submit(n):
+        for _ in range(n):
+            i = next_uid[0]
+            next_uid[0] += 1
+            store.add_workload(_wl(i, prio=rng.randrange(3)))
+
+    submit(12)
+    deltas = 0
+    for step in range(10):
+        op = rng.randrange(4)
+        if op == 0:
+            submit(rng.randrange(1, 3))
+        elif op == 1:
+            engine.drain(now=float(step))
+        elif op == 2:
+            admitted = sorted(_admitted(store))
+            for k in admitted[:rng.randrange(0, 3)]:
+                sched.finish_workload(k, now=float(step))
+        else:
+            cq = store.cluster_queues[f"cq{rng.randrange(4)}"]
+            cq.resource_groups[0].flavors[0].resources[0].nominal = (
+                rng.randrange(4, 9))
+            store.upsert_cluster_queue(cq)
+        problem, _ = engine.export()
+        if problem.n_workloads == 0:
+            continue
+        problem = pad_workloads(problem, target)
+        slotted, frame = session.advance(problem)
+        tm = dev_mesh.update(slotted, frame, False)
+        t1 = dev_one.update(slotted, frame, False)
+        assert dev_mesh.mesh_placed
+        if frame.delta is not None:
+            deltas += 1
+        fresh = to_device(slotted)
+        for f in fresh._fields:
+            assert np.array_equal(np.asarray(getattr(tm, f)),
+                                  np.asarray(getattr(fresh, f))), f
+            assert np.array_equal(np.asarray(getattr(t1, f)),
+                                  np.asarray(getattr(fresh, f))), f
+        # and the PLANS from the resident states are bit-identical
+        out_m = lean_mesh_solver(mesh)(tm)
+        out_s = solve_backlog(t1)
+        for a, b in zip(out_m, out_s):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert deltas > 0, "the sequence must exercise the delta path"
+    assert dev_mesh.delta_updates > 0
+    assert dev_mesh.donated_update_bytes > 0
+    assert dev_mesh.avoided_copy_bytes > dev_mesh.donated_update_bytes
+
+
+def test_mesh_single_host_churn_plans_bit_identical(eight_devices):
+    """Acceptance: randomized churn replays produce bit-identical
+    admitted/parked/victim plans across the host (sessionless fresh
+    sync), single-chip resident, and mesh-resident session paths —
+    preemption shapes included (full kernel, lane-sharded)."""
+    rng = random.Random(77)
+
+    def build():
+        store = _store(quota=6, preemption=True)
+        for i in range(24):
+            store.add_workload(_wl(i, prio=i % 3))
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        return store, queues, sched
+
+    store_h, q_h, s_h = build()
+    e_h = SolverEngine(store_h, q_h, scheduler=s_h, mesh_mode="off")
+    e_h.use_sessions = False
+    store_s, q_s, s_s = build()
+    e_s = SolverEngine(store_s, q_s, scheduler=s_s, mesh_mode="off")
+    store_m, q_m, s_m = build()
+    e_m = SolverEngine(store_m, q_m, scheduler=s_m)
+    e_m.mesh_min_workloads = 0
+    e_m.mesh_force = True
+    for e in (e_h, e_s, e_m):
+        e.pad_to = 64
+    uid = [1000]
+    for cyc in range(4):
+        results = []
+        for store, sched, engine in ((store_h, s_h, e_h),
+                                     (store_s, s_s, e_s),
+                                     (store_m, s_m, e_m)):
+            admitted = sorted(k for k, w in store.workloads.items()
+                              if w.is_quota_reserved
+                              and not w.is_finished)
+            finish = admitted[:2]
+            for k in finish:
+                sched.finish_workload(k, now=float(cyc))
+            for j in range(2):
+                store.add_workload(_wl(uid[0] + j, prio=(cyc + j) % 3))
+            results.append(engine.drain(now=float(cyc)))
+        uid[0] += 2
+        # single-chip resident vs mesh-resident: BIT-IDENTICAL plan
+        # application — same keys in the same order, same victims (the
+        # two arms drain the byte-identical session encoding)
+        assert results[1].admitted_keys == results[2].admitted_keys, cyc
+        assert results[1].evicted_keys == results[2].evicted_keys, cyc
+        # vs the sessionless fresh-sync path the PLAN (sets, victims)
+        # matches; within one admit round the apply tie-break is slot
+        # order vs export order, so key order may legally differ there
+        assert (set(results[0].admitted_keys)
+                == set(results[1].admitted_keys)), cyc
+        assert (results[0].evicted_keys == results[1].evicted_keys), cyc
+        assert (_admitted(store_h) == _admitted(store_s)
+                == _admitted(store_m)), cyc
+    assert e_m.last_drain_arm == "mesh"
+    dev = e_m._device_states.get("full-mesh") or e_m._device_states.get(
+        "lean-mesh")
+    assert dev is not None and dev.delta_updates > 0
+
+
+def test_mesh_sidecar_session_resync_recovery(server, eight_devices):
+    """Mesh-resident sessions over the WIRE: the sidecar shards its
+    resident lean state over the virtual mesh, ships compact plans,
+    and a forced session loss recovers through RESYNC with plans still
+    matching the mesh-less host path bit-for-bit."""
+    path, srv = server
+    srv.mesh_min_workloads = 0
+    for sess in list(srv.sessions.values()):
+        sess.device.mesh_min_rows = 0
+    store = _store(preemption=False)
+    for i in range(48):
+        store.add_workload(_wl(i))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched,
+                          remote=SolverClient(path))
+    engine.pad_to = 64
+    engine.drain(now=0.0)
+    assert engine.remote.frames_by_kind.get("sync") == 1
+    _churn_run(engine, store, sched, cycles=2)
+    assert engine.remote.frames_by_kind.get("delta", 0) >= 1
+    sidecar = next(iter(srv.sessions.values()))
+    if srv.mesh is not None:
+        assert sidecar.device.mesh_placed, \
+            "sidecar lean resident state must shard over the mesh"
+    # forced desync: the sidecar loses the session mid-churn
+    resyncs0 = metrics.solver_resync_total.total()
+    with srv._sessions_lock:
+        srv.sessions.clear()
+    _churn_run(engine, store, sched, cycles=1)
+    assert metrics.solver_resync_total.total() == resyncs0 + 1
+    # re-seeded mesh-resident state serves deltas again
+    _churn_run(engine, store, sched, cycles=1)
+    sidecar2 = next(iter(srv.sessions.values()))
+    assert sidecar2.device.delta_updates >= 1
+    # parity vs the sessionless, mesh-less path
+    store_h = _store(preemption=False)
+    for i in range(48):
+        store_h.add_workload(_wl(i))
+    queues_h = QueueManager(store_h)
+    sched_h = Scheduler(store_h, queues_h)
+    engine_h = SolverEngine(store_h, queues_h, scheduler=sched_h,
+                            mesh_mode="off")
+    engine_h.use_sessions = False
+    engine_h.pad_to = 64
+    engine_h.drain(now=0.0)
+    _churn_run(engine_h, store_h, sched_h, cycles=4)
+    assert _admitted(store) == _admitted(store_h)
+
+
+def test_sidecar_mesh_fault_serves_single_chip_and_trips(
+        server, monkeypatch, eight_devices):
+    """A sidecar-side mesh fault (device loss / SPMD compile abort)
+    must not wedge the sidecar: the SAME request is served single-chip,
+    the server mesh trips off (no per-request flapping), and the
+    resident session state re-seeds unsharded."""
+    path, srv = server
+    if srv.mesh is None:
+        pytest.skip("no sidecar mesh detected")
+    srv.mesh_min_workloads = 0
+
+    from kueue_oss_tpu.solver import meshutil
+
+    calls = {"n": 0}
+
+    def boom(mesh, axis="wl"):
+        calls["n"] += 1
+        raise RuntimeError("injected sidecar mesh loss")
+
+    monkeypatch.setattr(meshutil, "lean_mesh_solver", boom)
+    store = _store(preemption=False)
+    for i in range(48):
+        store.add_workload(_wl(i))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched,
+                          remote=SolverClient(path))
+    engine.pad_to = 64
+    result = engine.drain(now=0.0)  # served despite the mesh fault
+    assert calls["n"] == 1
+    assert result.admitted == 32
+    assert srv.mesh is None, "sidecar mesh must trip off, not flap"
+    sess = next(iter(srv.sessions.values()))
+    assert not sess.device.mesh_placed
+    # subsequent drains stay single-chip and never touch the mesh again
+    monkeypatch.undo()
+    _churn_run(engine, store, sched, cycles=1)
+    assert calls["n"] == 1
+
+
+def test_meshless_client_learns_sidecar_width_and_repads(server):
+    """A control plane with NO local mesh (CPU-only host) must still
+    let the accelerator sidecar shard: the session response advertises
+    the sidecar's mesh width, the client records it, and the next
+    drain re-pads to a shardable axis (one counted shape_change sync),
+    after which the sidecar's resident state is mesh-placed."""
+    path, srv = server
+    if srv.mesh is None:
+        pytest.skip("no sidecar mesh detected")
+    srv.mesh_min_workloads = 0
+    store = _store(preemption=False)
+    for i in range(48):
+        store.add_workload(_wl(i))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched,
+                          remote=SolverClient(path), mesh_mode="off")
+    engine.pad_to = 64
+    engine.drain(now=0.0)
+    # first drain shipped an unaligned pow2+1 axis; the response taught
+    # the client the sidecar's width
+    assert engine.remote.remote_mesh_devices == 8
+    sess0 = next(iter(srv.sessions.values()))
+    assert not sess0.device.mesh_placed
+    _churn_run(engine, store, sched, cycles=1)
+    # second drain re-padded to a shardable axis: sidecar now sharded
+    sess = next(iter(srv.sessions.values()))
+    assert sess.device.mesh_placed
+    assert sess.kwargs["wl_cqid"].shape[0] % 8 == 0
