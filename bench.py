@@ -976,6 +976,92 @@ def run_scenario(scenario: str) -> dict:
             "skips_by_reason": skips,
         }
 
+    if scenario == "whatif":
+        # TPU-batched counterfactual planning (docs/SIMULATOR.md): S
+        # scenario variants of the padded admission problem vmapped
+        # into ONE dispatch, vs the same S scenarios solved
+        # sequentially through the single-problem kernel (the parity
+        # oracle). Measurement protocol: both programs execute once to
+        # compile OUTSIDE the timing windows; plans must stay
+        # bit-identical between the two paths.
+        import numpy as np
+
+        from kueue_oss_tpu.sim import (
+            arrival_sweep,
+            check_parity,
+            cross,
+            pending_backlog,
+            quota_sweep,
+            solve_scenarios,
+            solve_scenarios_sequential,
+        )
+        from kueue_oss_tpu.sim.batch import pow2
+        from kueue_oss_tpu.solver.tensors import (
+            ExportCache,
+            export_problem,
+            pad_workloads,
+        )
+
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.perf.generator import (
+            GeneratorConfig,
+            generate,
+        )
+
+        # the planning sweet spot: MANY scenarios over a contended
+        # moderate backlog. (A 50k-row contended drain batches poorly
+        # on one CPU core — vmapped while_loop lanes all run to the
+        # batch's max round count, so round-skew eats the win; the
+        # scenario axis is the dimension the TPU VPU parallelizes.)
+        n_scen = int(os.environ.get("BENCH_WHATIF_S", "128"))
+        config = GeneratorConfig.large_scale(preemption=False)
+        config.n_cohorts = int(os.environ.get("BENCH_WHATIF_COHORTS", "2"))
+        config.cqs_per_cohort = int(os.environ.get("BENCH_WHATIF_CQS", "4"))
+        for wc, n in zip(config.classes, (14, 4, 2)):
+            wc.count = n
+        store, schedule = generate(config)
+        for g in schedule:
+            store.add_workload(g.workload)
+        queues = QueueManager(store)
+        pending = pending_backlog(store, queues)
+        problem = export_problem(
+            store, pending, cache=ExportCache(store, subscribe=False))
+        W = problem.n_workloads
+        problem = pad_workloads(problem, pow2(W))
+        specs = cross(quota_sweep((0.25, 0.5, 0.75, 1.25, 1.5, 2.0, 3.0)),
+                      arrival_sweep((0.5, 0.75, 1.25, 1.5, 2.0, 2.5, 3.0)))
+        if len(specs) < n_scen:  # tile the grid to the requested width
+            specs = (specs * (n_scen // len(specs) + 1))
+        specs = specs[:n_scen]
+        overlays = [s.overlay(problem, replicas=1) for s in specs]
+        # NOTE replicas=1: the bench sweep masks arrivals only downward
+        # (no clone materialization), keeping one export for both paths
+        log(f"[whatif] {len(specs)} scenarios x {W} workloads "
+            f"(padded {problem.n_workloads})")
+        solve_scenarios(problem, overlays)          # compile (vmapped)
+        batch = solve_scenarios(problem, overlays)  # timed inside
+        solve_scenarios_sequential(problem, overlays[:1])  # compile
+        seq = solve_scenarios_sequential(problem, overlays)
+        pr = check_parity(batch, seq, range(len(specs)))
+        vs = batch.solve_seconds
+        ss = seq.solve_seconds
+        return {
+            "scenario": scenario,
+            "scenarios": len(specs),
+            "workloads": W,
+            "padded_workloads": problem.n_workloads,
+            "cluster_queues": problem.n_cqs,
+            "batch_width": batch.batch_width,
+            "vmapped_wall_s": round(vs, 6),
+            "sequential_wall_s": round(ss, 6),
+            "scenarios_per_sec": round(len(specs) / vs, 2) if vs else 0.0,
+            "vmapped_speedup": round(ss / vs, 2) if vs else 0.0,
+            "plans_identical": pr.identical,
+            "rounds_max": int(np.asarray(batch.rounds).max()),
+            "admitted_base": int(np.asarray(
+                batch.admitted[0]).sum()),
+        }
+
     if scenario == "parity":
         # 1/10-scale contended preemption drain: kernel vs host
         store_h, queues_h, _ = _build(preemption=True, small=True)
@@ -1212,6 +1298,16 @@ def main() -> None:
     except Exception as e:
         log(f"[multichip] did not complete: {e}")
         multichip = None
+    # batched what-if planning: S counterfactual scenarios in one
+    # vmapped dispatch vs the sequential oracle (docs/SIMULATOR.md);
+    # host backend — the measurement is batching leverage, not device
+    # speed, and must run everywhere the planning surfaces do
+    try:
+        whatif = measure("whatif", extra_env={"BENCH_CPU": "1"},
+                         timeout=1200)
+    except Exception as e:
+        log(f"[whatif] did not complete: {e}")
+        whatif = None
     log(f"total bench time {time.monotonic() - t_start:.1f}s")
 
     # HEADLINE: the reference's own protocol — same shape, same
@@ -1337,6 +1433,15 @@ def main() -> None:
         extra["mesh_uneven_shards"] = multichip["uneven_shards"]
         extra["mesh_preempt_seconds"] = multichip["preempt_mesh_seconds"]
         extra["mesh_platform"] = "cpu_virtual_mesh"
+    if whatif is not None:
+        # what-if engine acceptance: >1 vmapped-vs-sequential speedup,
+        # plans bit-identical between the two paths
+        extra["whatif_scenarios"] = whatif["scenarios"]
+        extra["whatif_batch_width"] = whatif["batch_width"]
+        extra["whatif_scenarios_per_sec"] = whatif["scenarios_per_sec"]
+        extra["whatif_vmapped_speedup"] = whatif["vmapped_speedup"]
+        extra["whatif_plans_identical"] = whatif["plans_identical"]
+        extra["whatif_workloads"] = whatif["workloads"]
     # degradation events across every solver-routed scenario, so the
     # perf trajectory records backend faults alongside throughput
     solver_runs = [sim, sim_solver_cpu, sim_solver_dev, sim_large, chaos]

@@ -166,6 +166,33 @@ class SolverBackendConfig:
 
 
 @dataclass
+class SimulatorConfig:
+    """What-if engine knobs (kueue_oss_tpu/sim/, docs/SIMULATOR.md).
+
+    No reference analog — the reference Kueue has no counterfactual
+    simulator; these bound the TPU-batched scenario sweeps the planning
+    surfaces (tools/simulate.py, GET /api/whatif) may dispatch.
+    """
+
+    #: hard cap on scenarios per batch (one vmapped dispatch solves
+    #: them all; the cap bounds device memory, not correctness)
+    max_scenarios: int = 256
+    #: leading scenarios cross-checked bit-identically against the
+    #: sequential single-problem oracle per run (0 disables)
+    parity_scenarios: int = 2
+    #: pad the scenario axis to a power of two so growing sweeps reuse
+    #: one compiled batch program
+    pad_pow2: bool = True
+    #: scenario-axis mesh sharding mode (the solver mesh grammar:
+    #: "off" / "auto" / an explicit device count). Default OFF — the
+    #: what-if batch is a planning tool; it engages the mesh only when
+    #: asked, never by ambient device count.
+    mesh: str = "off"
+    #: batches below this width stay single-device even with a mesh
+    min_batch_for_mesh: int = 16
+
+
+@dataclass
 class Configuration:
     """Reference parity: configuration_types.go Configuration."""
 
@@ -184,6 +211,7 @@ class Configuration:
     object_retention_policies: Optional[ObjectRetentionPolicies] = None
     multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     solver: SolverBackendConfig = field(default_factory=SolverBackendConfig)
+    simulator: SimulatorConfig = field(default_factory=SimulatorConfig)
     feature_gates: dict[str, bool] = field(default_factory=dict)
     #: TLS options for the HTTP servers (reference: Configuration.TLS,
     #: applied in config.go:182-190 under the TLSOptions gate)
@@ -247,6 +275,19 @@ def validate(cfg: Configuration) -> list[str]:
         if m not in known and not m.isdigit():
             errs.append(f"solver.mesh {sv.mesh!r} must be 'auto', 'off', "
                         "or a non-negative device count")
+    sim = cfg.simulator
+    if sim.max_scenarios < 1:
+        errs.append("simulator.maxScenarios must be >= 1")
+    if sim.parity_scenarios < 0:
+        errs.append("simulator.parityScenarios must be >= 0")
+    if sim.min_batch_for_mesh < 1:
+        errs.append("simulator.minBatchForMesh must be >= 1")
+    if sim.mesh is not None:
+        m = str(sim.mesh).strip().lower()
+        known = {"auto", "on", "off", "none", "true", "false", "disabled"}
+        if m not in known and not m.isdigit():
+            errs.append(f"simulator.mesh {sim.mesh!r} must be 'auto', "
+                        "'off', or a non-negative device count")
     afs = cfg.admission_fair_sharing
     if afs is not None:
         if afs.usage_half_life_time_seconds < 0:
@@ -374,6 +415,15 @@ def load(data: Optional[dict] = None) -> Configuration:
             "mesh": ("mesh", str),
         })
 
+    def conv_sim(d: dict) -> SimulatorConfig:
+        return _build(SimulatorConfig, d, {
+            "maxScenarios": ("max_scenarios", int),
+            "parityScenarios": ("parity_scenarios", int),
+            "padPow2": ("pad_pow2", bool),
+            "mesh": ("mesh", str),
+            "minBatchForMesh": ("min_batch_for_mesh", int),
+        })
+
     def conv_integrations(d: dict) -> list[str]:
         return list(d.get("frameworks", []))
 
@@ -396,6 +446,7 @@ def load(data: Optional[dict] = None) -> Configuration:
         "objectRetentionPolicies": ("object_retention_policies", conv_retention),
         "multiKueue": ("multikueue", conv_mk),
         "solver": ("solver", conv_solver),
+        "simulator": ("simulator", conv_sim),
         "featureGates": ("feature_gates", dict),
         "tls": ("tls", conv_tls),
     })

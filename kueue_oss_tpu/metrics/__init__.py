@@ -474,6 +474,28 @@ decision_skips_total = registry.register(Counter(
     "Workload skip/fallback decisions by bounded reason slug",
     ("reason",)))
 
+# -- what-if engine (kueue_oss_tpu/sim/, docs/SIMULATOR.md) ------------------
+
+whatif_scenarios_total = registry.register(Counter(
+    "kueue_tpu_whatif_scenarios_total",
+    "Counterfactual scenarios solved by the what-if engine, by mode "
+    "(batched/sequential/trace)", ("mode",)))
+whatif_batches_total = registry.register(Counter(
+    "kueue_tpu_whatif_batches_total",
+    "Vmapped what-if batch dispatches", ()))
+whatif_batch_width = registry.register(Histogram(
+    "kueue_tpu_whatif_batch_width",
+    "Scenario-axis width of what-if batch dispatches (pow2-padded)", (),
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)))
+whatif_duration_seconds = registry.register(Histogram(
+    "kueue_tpu_whatif_duration_seconds",
+    "What-if engine wall time by phase (build/solve/parity/report)",
+    ("phase",)))
+whatif_parity_failures_total = registry.register(Counter(
+    "kueue_tpu_whatif_parity_failures_total",
+    "What-if batches whose vmapped plans diverged from the sequential "
+    "oracle (must stay 0; a nonzero count is a kernel bug)", ()))
+
 
 # -- recording helpers (reference: pkg/metrics exported funcs) ---------------
 

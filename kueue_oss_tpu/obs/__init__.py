@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import itertools
 import json
+import logging
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -40,6 +42,8 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from kueue_oss_tpu import metrics
+
+logger = logging.getLogger(__name__)
 
 # -- event kinds (the per-workload outcome vocabulary) ----------------------
 
@@ -149,10 +153,15 @@ class FlightRecorder:
     def record(self, kind: str, workload: str, *, cycle: int = 0,
                cluster_queue: str = "", path: str = HOST,
                reason: str = "", reason_slug: str = "",
-               detail: Optional[dict] = None) -> Optional[DecisionEvent]:
+               detail: Optional[dict] = None,
+               breaker: Optional[str] = None) -> Optional[DecisionEvent]:
+        """``breaker`` defaults to the LIVE breaker state; the journal
+        replay layer passes the recorded value through so a replayed
+        incident keeps its breaker tags."""
         if not self.enabled:
             return None
-        breaker = breaker_state_name()
+        if breaker is None:
+            breaker = breaker_state_name()
         ev = DecisionEvent(
             seq=next(self._seq), ts=self.clock(), cycle=cycle, kind=kind,
             workload=workload, cluster_queue=cluster_queue, path=path,
@@ -210,10 +219,22 @@ class FlightRecorder:
                          for ev in self.events()) + "\n"
 
     def dump_jsonl(self, path: str) -> int:
+        """Atomically write the journal: a crash mid-dump must never
+        leave a half-written file where a previous complete journal
+        stood (replay/simulation consume these dumps). The write goes
+        to a same-directory temp file and lands via ``os.replace``."""
         events = self.events()
-        with open(path, "w") as f:
-            for ev in events:
-                f.write(json.dumps(ev.to_dict()) + "\n")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                for ev in events:
+                    f.write(json.dumps(ev.to_dict()) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         return len(events)
 
     def clear(self) -> None:
@@ -224,15 +245,42 @@ class FlightRecorder:
 
 def load_jsonl(path: str) -> list[DecisionEvent]:
     """Load a journal dump written by ``dump_jsonl`` (tools/explain.py's
-    offline input). Blank lines are skipped; a malformed line raises —
-    a truncated journal should fail loudly, not silently explain less."""
+    and the sim replay layer's offline input).
+
+    Blank lines are skipped. Torn or corrupt lines (a journal written
+    by a pre-atomic dump that crashed mid-write, or one truncated in
+    transit) are SKIPPED with one counted warning instead of raising:
+    a damaged tail must not poison replay of the millions of intact
+    events before it. The skip count of the MOST RECENT call is kept
+    on the function as ``load_jsonl.last_skipped`` — best-effort
+    module-level state (concurrent loads race on it); a diagnostic,
+    not an API."""
     out = []
+    skipped = 0
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
-            if line:
-                out.append(DecisionEvent.from_dict(json.loads(line)))
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+                if not isinstance(d, dict):
+                    raise ValueError("journal line is not an object")
+                out.append(DecisionEvent.from_dict(d))
+            except (ValueError, TypeError, KeyError):
+                skipped += 1
+                if skipped == 1:
+                    logger.warning(
+                        "journal %s: skipping corrupt line %d "
+                        "(torn write?)", path, lineno)
+    if skipped > 1:
+        logger.warning("journal %s: skipped %d corrupt line(s) total",
+                       path, skipped)
+    load_jsonl.last_skipped = skipped
     return out
+
+
+load_jsonl.last_skipped = 0
 
 
 #: process-wide recorder (the metrics.registry idiom); tests swap or

@@ -52,9 +52,15 @@ class Simulator:
     """
 
     def __init__(self, store: Store, schedule: list[GeneratedWorkload],
-                 enable_fair_sharing: bool = False, solver=None) -> None:
+                 enable_fair_sharing: bool = False, solver=None,
+                 timed_hooks=None) -> None:
         self.store = store
         self.schedule = schedule
+        #: [(at_ms, fn(simulator, now_ms))] — virtual-time injection
+        #: points (the sim/ what-if engine schedules chaos node flaps
+        #: here); hooks run inside the event loop at their timestamp,
+        #: before the scheduler runs to quiescence at that instant
+        self.timed_hooks = list(timed_hooks or [])
         self.queues = QueueManager(store)
         self.scheduler = Scheduler(store, self.queues,
                                    enable_fair_sharing=enable_fair_sharing,
@@ -88,6 +94,9 @@ class Simulator:
         for g in self.schedule:
             events.append((g.arrival_ms, seq, "arrive", g))
             seq += 1
+        for at_ms, fn in self.timed_hooks:
+            events.append((float(at_ms), seq, "hook", fn))
+            seq += 1
         heapq.heapify(events)
         admitted_at: dict[str, float] = {}
         tta_sum: dict[str, float] = {}
@@ -107,6 +116,8 @@ class Simulator:
             for k, g in batch:
                 if k == "arrive":
                     self.store.add_workload(g.workload)
+                elif k == "hook":
+                    g(self, now_ms)
                 elif k == "finish":
                     g, admit_ts = g
                     # stale if the workload was preempted since admission

@@ -1,0 +1,38 @@
+"""What-if engine: TPU-batched counterfactual simulation & capacity
+planning (docs/SIMULATOR.md).
+
+Public surface:
+
+- :class:`ScenarioSpec` / sweep constructors (scenario layer);
+- :class:`WhatIfEngine` / :func:`simulate_trace` (execution);
+- :func:`solve_scenarios` / :func:`solve_scenarios_sequential`
+  (batched solve layer, for direct tensor-level use);
+- :class:`WhatIfReport` (report layer);
+- journal replay (:mod:`kueue_oss_tpu.sim.replay`).
+"""
+
+from kueue_oss_tpu.sim.batch import (  # noqa: F401
+    BatchSolveResult,
+    check_parity,
+    solve_scenarios,
+    solve_scenarios_sequential,
+)
+from kueue_oss_tpu.sim.engine import (  # noqa: F401
+    WhatIfEngine,
+    pending_backlog,
+    simulate_trace,
+)
+from kueue_oss_tpu.sim.replay import (  # noqa: F401
+    journal_baseline,
+    kind_counts_per_cycle,
+    load_events,
+    replay,
+)
+from kueue_oss_tpu.sim.report import WhatIfReport, scenario_kpis  # noqa: F401
+from kueue_oss_tpu.sim.scenario import (  # noqa: F401
+    FlapEvent,
+    ScenarioSpec,
+    arrival_sweep,
+    cross,
+    quota_sweep,
+)

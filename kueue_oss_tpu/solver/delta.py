@@ -47,7 +47,7 @@ from typing import Optional
 
 import numpy as np
 
-from kueue_oss_tpu.solver.tensors import BIG, SolverProblem
+from kueue_oss_tpu.solver.tensors import BIG, SolverProblem, pow2
 
 #: SolverProblem fields that ride the wire as arrays. Host-only decode
 #: tables (fr_list, wl_keys, ...) and the raw stable-encoding inputs
@@ -76,13 +76,6 @@ NON_W_FIELDS = tuple(f for f in ARRAY_FIELDS if f not in W_AXIS_FIELDS)
 #: a delta dirtying more than this fraction of rows costs more than a
 #: full sync saves; degrade (counted as reason="dense_delta")
 DENSE_DELTA_FRACTION = 0.5
-
-
-def _pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
 
 
 # ---------------------------------------------------------------------------
@@ -611,7 +604,7 @@ class HostDeltaSession:
         max_tok = int(toks.max()) if toks.size else -1
         if p.class_tok_root is not None:
             max_tok = max(max_tok, len(p.class_tok_root) - 1)
-        self._class_cs = max(self._class_cs, _pow2(max_tok + 2))
+        self._class_cs = max(self._class_cs, pow2(max_tok + 2))
         cs = self._class_cs
         wl_class = np.full(W + 1, cs - 1, dtype=np.int32)
         pos = toks >= 0
@@ -686,6 +679,10 @@ class DeviceResidentProblem:
         self.donated_update_bytes = 0
         self.avoided_copy_bytes = 0
         self.full_upload_bytes = 0
+        #: full syncs that reused (donated) the previous epoch's
+        #: resident buffers instead of allocating a second full set —
+        #: forced-resync storms stop double-allocating device memory
+        self.donated_full_syncs = 0
         #: _apply faults healed by a fresh full upload (never silent —
         #: the engine's mesh-fault accounting reads this)
         self.apply_faults = 0
@@ -703,24 +700,58 @@ class DeviceResidentProblem:
                 self._apply(problem, delta, full)
             except Exception:
                 # a partially-applied donated update leaves consumed
-                # buffers behind; drop the resident state and re-seed
+                # buffers behind; drop the resident state (so the heal
+                # can never donate FROM consumed buffers) and re-seed
                 # from the authoritative host problem
                 self.apply_faults += 1
+                self.tensors = None
                 self.tensors = self._full_upload(problem, full)
         self.kind = kind
         self.epoch = frame.epoch if frame is not None else self.epoch + 1
         return self.tensors
 
     def _full_upload(self, problem: SolverProblem, full: bool):
+        import jax
+        import jax.numpy as jnp
+
         if full:
-            from kueue_oss_tpu.solver.full_kernels import to_device_full
+            from kueue_oss_tpu.solver.full_kernels import host_tensors_full
 
-            t = to_device_full(problem)
+            host = host_tensors_full(problem)
         else:
-            from kueue_oss_tpu.solver.kernels import to_device
+            from kueue_oss_tpu.solver.kernels import host_tensors
 
-            t = to_device(problem)
-        self.mesh_placed = False
+            host = host_tensors(problem)
+        kind = "full" if full else "lean"
+        prev = self.tensors if self.kind == kind else None
+        if prev is not None and self._donation_compatible(prev, host):
+            # ROADMAP open item: a forced resync (shape-stable session
+            # reset, checksum heal, chaos storm) used to allocate a
+            # SECOND full set of resident buffers while the previous
+            # epoch's set was still live. Donating the old buffers
+            # rewrites them in place — same placement, no double
+            # allocation — and rides the existing donated/avoided-copy
+            # accounting. mesh_placed is preserved: identical shapes
+            # keep the divisibility the original placement required.
+            du, ac = self.donated_update_bytes, self.avoided_copy_bytes
+            try:
+                t = self._donated_overwrite(prev, host)
+            except Exception:
+                # roll back the per-buffer byte accounting of a
+                # donation that did not complete, then re-seed fresh
+                self.donated_update_bytes = du
+                self.avoided_copy_bytes = ac
+                self.apply_faults += 1
+                self.mesh_placed = False
+                t = jax.tree_util.tree_map(jnp.asarray, host)
+            else:
+                self.donated_full_syncs += 1
+                self.full_uploads += 1
+                self.full_upload_bytes += _tree_nbytes(t)
+                return t
+        else:
+            self.mesh_placed = False
+            t = jax.tree_util.tree_map(jnp.asarray, host)
         if self.mesh is not None and not full:
             from kueue_oss_tpu.solver.sharded import maybe_place_lean
 
@@ -729,6 +760,45 @@ class DeviceResidentProblem:
         self.full_uploads += 1
         self.full_upload_bytes += _tree_nbytes(t)
         return t
+
+    @staticmethod
+    def _donation_compatible(prev, host) -> bool:
+        """Every resident buffer must match its replacement's shape and
+        dtype exactly — XLA aliases donated inputs to outputs only then,
+        and a mismatch means the compiled shapes changed anyway."""
+        import numpy as np
+
+        for old, new in zip(prev, host):
+            new = np.asarray(new)
+            if (tuple(old.shape) != tuple(new.shape)
+                    or old.dtype != new.dtype):
+                return False
+        return True
+
+    def _donated_overwrite(self, prev, host):
+        """Rewrite every resident buffer in place with the new epoch's
+        content (donated whole-array set; output aliases the donated
+        input, preserving each buffer's sharding)."""
+        import jax
+        import numpy as np
+
+        out = []
+        for old, new in zip(prev, host):
+            new = np.ascontiguousarray(new)
+            self.donated_update_bytes += int(new.nbytes)
+            self.avoided_copy_bytes += int(old.nbytes)
+            sharding = getattr(old, "sharding", None)
+            key = ("overwrite", old.shape, str(old.dtype), sharding)
+            fn = self._scatter_cache.get(key)
+            if fn is None:
+                kw = {}
+                if self.mesh_placed and sharding is not None:
+                    kw["out_shardings"] = sharding
+                fn = jax.jit(lambda b, v: b.at[...].set(v),
+                             donate_argnums=0, **kw)
+                self._scatter_cache[key] = fn
+            out.append(fn(old, new))
+        return type(prev)(*out)
 
     def _replicated(self, arr: np.ndarray):
         """Place a small replacement array consistently with the
@@ -755,7 +825,7 @@ class DeviceResidentProblem:
         self.donated_update_bytes += int(idx.nbytes) + int(vals.nbytes)
         self.avoided_copy_bytes += int(buf.nbytes)
         n = idx.shape[0]
-        cap = _pow2(max(1, n))
+        cap = pow2(max(1, n))
         if cap != n:
             idx = np.concatenate([idx, np.repeat(idx[-1:], cap - n)])
             vals = np.concatenate(

@@ -39,14 +39,8 @@ from kueue_oss_tpu.solver.tensors import (
     UnsupportedProblem,
     export_problem,
     pad_workloads,
+    pow2,
 )
-
-
-def _pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
 
 
 @dataclass
@@ -438,7 +432,7 @@ class SolverEngine:
             return result
         n_live = problem.n_workloads
         self._pad_hwm = max(self._pad_hwm,
-                            _pow2(max(problem.n_workloads, self.pad_to)))
+                            pow2(max(problem.n_workloads, self.pad_to)))
         problem = pad_workloads(problem, self._pad_target())
         problem, frame = self._session_encode("lean", problem)
 
@@ -1108,10 +1102,10 @@ class SolverEngine:
         # budget is actually enforced; the 64-lane floor overrides it
         # for very wide K x g shapes (fewer lanes than that defers too
         # many heads per round to ever converge quickly)
-        lane_cap = _pow2(max(
+        lane_cap = pow2(max(
             1, self.h_work_budget // max(K * g, 1)) + 1) // 2
         lane_cap = max(64, lane_cap)
-        h_max = max(1, _pow2(min(C, self.h_max_cap, lane_cap)))
+        h_max = max(1, pow2(min(C, self.h_max_cap, lane_cap)))
         root_of_cq = problem.cq_root
         wl_root = root_of_cq[np.minimum(problem.wl_cqid[:-1], C - 1)]
         counts = np.bincount(wl_root, minlength=problem.n_nodes + 1)
@@ -1157,7 +1151,7 @@ class SolverEngine:
             p_max = min(pop, max(8, cap))
         else:
             p_max = pop
-        return h_max, _pow2(max(8, p_max))
+        return h_max, pow2(max(8, p_max))
 
     def _drain_full(
             self, now: float, verify: bool = False,
@@ -1198,7 +1192,7 @@ class SolverEngine:
         h_max, p_max = self._size_caps(problem)
         n_live = problem.n_workloads
         self._pad_hwm = max(self._pad_hwm,
-                            _pow2(max(problem.n_workloads, self.pad_to)))
+                            pow2(max(problem.n_workloads, self.pad_to)))
         problem = pad_workloads(problem, self._pad_target())
         problem, frame = self._session_encode("full", problem)
 

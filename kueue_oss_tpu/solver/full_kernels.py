@@ -133,7 +133,9 @@ class FullTensors(NamedTuple):
     admit_rank_base: jnp.ndarray  # scalar int32
 
 
-def to_device_full(p: SolverProblem) -> FullTensors:
+def host_tensors_full(p: SolverProblem) -> FullTensors:
+    """The full kernel's input tensors as HOST (numpy) arrays — see
+    kernels.host_tensors for why this is split from the upload."""
     import numpy as np
 
     is_cq = np.zeros(p.parent.shape[0], dtype=bool)
@@ -150,65 +152,66 @@ def to_device_full(p: SolverProblem) -> FullTensors:
             opt_pos[c, k] = counts.get(g, 0)
             counts[g] = counts.get(g, 0) + 1
     return FullTensors(
-        parent=jnp.asarray(p.parent),
-        depth=jnp.asarray(p.depth),
-        height=jnp.asarray(p.height),
-        has_parent=jnp.asarray(p.has_parent),
-        is_cq=jnp.asarray(is_cq),
-        path=jnp.asarray(p.path),
-        subtree=jnp.asarray(p.subtree),
-        local_quota=jnp.asarray(p.local_quota),
-        nominal=jnp.asarray(p.nominal),
-        has_borrow=jnp.asarray(p.has_borrow),
-        borrow_limit=jnp.asarray(p.borrow_limit),
-        usage0=jnp.asarray(p.usage0),
-        cq_node=jnp.asarray(p.cq_node),
-        cq_strict=jnp.asarray(p.cq_strict),
-        cq_try_next=jnp.asarray(p.cq_try_next),
-        cq_nflavors=jnp.asarray(p.cq_nflavors),
-        cq_within_policy=jnp.asarray(p.cq_within_policy),
-        cq_reclaim_policy=jnp.asarray(p.cq_reclaim_policy),
-        cq_bwc_forbidden=jnp.asarray(p.cq_bwc_forbidden),
-        cq_bwc_threshold=jnp.asarray(p.cq_bwc_threshold),
-        cq_preempt_try_next=jnp.asarray(p.cq_preempt_try_next),
-        cq_pref_pob=jnp.asarray(p.cq_pref_pob),
-        cq_fair_weight=jnp.asarray(p.cq_fair_weight),
-        cq_root=jnp.asarray(p.cq_root),
-        cq_opt_group=jnp.asarray(p.cq_opt_group),
-        cq_opt_pos=jnp.asarray(opt_pos),
-        cq_ngroups=jnp.asarray(p.cq_ngroups),
-        wl_cqid=jnp.asarray(p.wl_cqid),
-        wl_prio=jnp.asarray(p.wl_prio),
-        wl_ts0=jnp.asarray(p.wl_ts),
-        wl_uid=jnp.asarray(p.wl_uid),
-        wl_req=jnp.asarray(p.wl_req),
-        wl_valid=jnp.asarray(p.wl_valid),
-        wl_parked0=jnp.asarray(p.wl_parked0),
-        wl_admitted0=jnp.asarray(p.wl_admitted0),
-        wl_evicted0=jnp.asarray(p.wl_evicted0),
-        wl_admit_rank0=jnp.asarray(p.wl_admit_rank),
-        ad_usage=jnp.asarray(p.ad_usage),
-        fr_resource=jnp.asarray(p.fr_resource),
-        res_onehot=jnp.asarray(
-            np.eye(p.n_resources, dtype=np.int32)[p.fr_resource]),
-        node_fair_weight=jnp.asarray(p.node_fair_weight),
-        wl_class=jnp.asarray(p.wl_class),
-        class_root=jnp.asarray(p.class_root),
-        wl_lq=jnp.asarray(p.wl_lq if p.wl_lq is not None
-                          else np.zeros(p.wl_cqid.shape[0], np.int32)),
-        wl_ts_buf=jnp.asarray(p.wl_ts_buf if p.wl_ts_buf is not None
-                              else p.wl_ts),
-        wl_afs_penalty=jnp.asarray(
+        parent=p.parent,
+        depth=p.depth,
+        height=p.height,
+        has_parent=p.has_parent,
+        is_cq=is_cq,
+        path=p.path,
+        subtree=p.subtree,
+        local_quota=p.local_quota,
+        nominal=p.nominal,
+        has_borrow=p.has_borrow,
+        borrow_limit=p.borrow_limit,
+        usage0=p.usage0,
+        cq_node=p.cq_node,
+        cq_strict=p.cq_strict,
+        cq_try_next=p.cq_try_next,
+        cq_nflavors=p.cq_nflavors,
+        cq_within_policy=p.cq_within_policy,
+        cq_reclaim_policy=p.cq_reclaim_policy,
+        cq_bwc_forbidden=p.cq_bwc_forbidden,
+        cq_bwc_threshold=p.cq_bwc_threshold,
+        cq_preempt_try_next=p.cq_preempt_try_next,
+        cq_pref_pob=p.cq_pref_pob,
+        cq_fair_weight=p.cq_fair_weight,
+        cq_root=p.cq_root,
+        cq_opt_group=p.cq_opt_group,
+        cq_opt_pos=opt_pos,
+        cq_ngroups=p.cq_ngroups,
+        wl_cqid=p.wl_cqid,
+        wl_prio=p.wl_prio,
+        wl_ts0=p.wl_ts,
+        wl_uid=p.wl_uid,
+        wl_req=p.wl_req,
+        wl_valid=p.wl_valid,
+        wl_parked0=p.wl_parked0,
+        wl_admitted0=p.wl_admitted0,
+        wl_evicted0=p.wl_evicted0,
+        wl_admit_rank0=p.wl_admit_rank,
+        ad_usage=p.ad_usage,
+        fr_resource=p.fr_resource,
+        res_onehot=np.eye(p.n_resources, dtype=np.int32)[p.fr_resource],
+        node_fair_weight=p.node_fair_weight,
+        wl_class=p.wl_class,
+        class_root=p.class_root,
+        wl_lq=(p.wl_lq if p.wl_lq is not None
+               else np.zeros(p.wl_cqid.shape[0], np.int32)),
+        wl_ts_buf=(p.wl_ts_buf if p.wl_ts_buf is not None else p.wl_ts),
+        wl_afs_penalty=(
             p.wl_afs_penalty if p.wl_afs_penalty is not None
             else np.zeros(p.wl_cqid.shape[0], np.float32)),
-        lq_penalty0=jnp.asarray(
-            p.lq_penalty0 if p.lq_penalty0 is not None
-            else np.zeros(1, np.float32)),
-        cq_afs=jnp.asarray(p.cq_afs if p.cq_afs is not None
-                           else np.zeros(p.cq_node.shape[0], bool)),
-        ts_evict_base=jnp.asarray(p.ts_evict_base, dtype=jnp.int32),
-        admit_rank_base=jnp.asarray(p.admit_rank_base, dtype=jnp.int32),
+        lq_penalty0=(p.lq_penalty0 if p.lq_penalty0 is not None
+                     else np.zeros(1, np.float32)),
+        cq_afs=(p.cq_afs if p.cq_afs is not None
+                else np.zeros(p.cq_node.shape[0], bool)),
+        ts_evict_base=np.asarray(p.ts_evict_base, dtype=np.int32),
+        admit_rank_base=np.asarray(p.admit_rank_base, dtype=np.int32),
     )
+
+
+def to_device_full(p: SolverProblem) -> FullTensors:
+    return jax.tree_util.tree_map(jnp.asarray, host_tensors_full(p))
 
 
 # ---------------------------------------------------------------------------

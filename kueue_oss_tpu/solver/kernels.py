@@ -23,6 +23,7 @@ batched phases onto the VPU and the scan stays on-chip.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -64,36 +65,46 @@ class ProblemTensors(NamedTuple):
     wl_valid: jnp.ndarray
 
 
-def to_device(p: SolverProblem) -> ProblemTensors:
+def host_tensors(p: SolverProblem) -> ProblemTensors:
+    """The lean kernel's input tensors as HOST (numpy) arrays.
+
+    Split out of :func:`to_device` so callers that reuse resident device
+    buffers (DeviceResidentProblem's donated full-sync overwrite) can
+    build the new content without first materializing a second full set
+    of device buffers."""
     import numpy as np
 
     is_cq = np.zeros(p.parent.shape[0], dtype=bool)
     is_cq[p.cq_node] = True
     return ProblemTensors(
-        parent=jnp.asarray(p.parent),
-        depth=jnp.asarray(p.depth),
-        height=jnp.asarray(p.height),
-        has_parent=jnp.asarray(p.has_parent),
-        is_cq=jnp.asarray(is_cq),
-        path=jnp.asarray(p.path),
-        subtree=jnp.asarray(p.subtree),
-        local_quota=jnp.asarray(p.local_quota),
-        nominal=jnp.asarray(p.nominal),
-        has_borrow=jnp.asarray(p.has_borrow),
-        borrow_limit=jnp.asarray(p.borrow_limit),
-        usage0=jnp.asarray(p.usage0),
-        cq_node=jnp.asarray(p.cq_node),
-        cq_strict=jnp.asarray(p.cq_strict),
-        cq_try_next=jnp.asarray(p.cq_try_next),
-        cq_nflavors=jnp.asarray(p.cq_nflavors),
-        wl_cqid=jnp.asarray(p.wl_cqid),
-        wl_rank=jnp.asarray(p.wl_rank),
-        wl_prio=jnp.asarray(p.wl_prio),
-        wl_ts=jnp.asarray(p.wl_ts),
-        wl_uid=jnp.asarray(p.wl_uid),
-        wl_req=jnp.asarray(p.wl_req),
-        wl_valid=jnp.asarray(p.wl_valid),
+        parent=p.parent,
+        depth=p.depth,
+        height=p.height,
+        has_parent=p.has_parent,
+        is_cq=is_cq,
+        path=p.path,
+        subtree=p.subtree,
+        local_quota=p.local_quota,
+        nominal=p.nominal,
+        has_borrow=p.has_borrow,
+        borrow_limit=p.borrow_limit,
+        usage0=p.usage0,
+        cq_node=p.cq_node,
+        cq_strict=p.cq_strict,
+        cq_try_next=p.cq_try_next,
+        cq_nflavors=p.cq_nflavors,
+        wl_cqid=p.wl_cqid,
+        wl_rank=p.wl_rank,
+        wl_prio=p.wl_prio,
+        wl_ts=p.wl_ts,
+        wl_uid=p.wl_uid,
+        wl_req=p.wl_req,
+        wl_valid=p.wl_valid,
     )
+
+
+def to_device(p: SolverProblem) -> ProblemTensors:
+    return jax.tree_util.tree_map(jnp.asarray, host_tensors(p))
 
 
 # ---------------------------------------------------------------------------
@@ -406,8 +417,7 @@ def _select_heads(t: ProblemTensors, admitted, parked):
     return jnp.where(has_head, head_w, W_null).astype(jnp.int32)
 
 
-@jax.jit
-def solve_backlog(t: ProblemTensors):
+def _solve_backlog_impl(t: ProblemTensors):
     """Drain the backlog: run reference-equivalent cycles until quiescent.
 
     Returns (admitted [W+1] bool, chosen_option [W+1] int32,
@@ -480,3 +490,57 @@ def solve_backlog(t: ProblemTensors):
     admitted = admitted.at[W_null].set(False)
     parked = parked.at[W_null].set(False)
     return admitted, opt, admit_round, parked, rounds, usage
+
+
+solve_backlog = jax.jit(_solve_backlog_impl)
+
+
+# ---------------------------------------------------------------------------
+# Scenario-batched entry (kueue_oss_tpu/sim what-if engine)
+# ---------------------------------------------------------------------------
+
+#: ProblemTensors fields a scenario overlay may vary per scenario. The
+#: lean drain is pure int/bool arithmetic, so a vmapped batch is
+#: bit-identical to solving each scenario alone (the batched while_loop
+#: freezes finished lanes with a select, never perturbing their state).
+BATCHABLE_FIELDS = frozenset({
+    "nominal", "subtree", "local_quota", "has_borrow", "borrow_limit",
+    "usage0", "wl_cqid", "wl_rank", "wl_prio", "wl_ts", "wl_valid",
+    "wl_req",
+})
+
+
+@functools.lru_cache(maxsize=None)
+def _batched_solver(fields: frozenset):
+    """Jitted vmap of the lean drain over a leading scenario axis.
+
+    Only the overlay's ``fields`` carry the [S, ...] axis; everything
+    else (notably the large wl_req tensor when quota-only sweeps leave
+    it untouched) broadcasts unbatched, so an S-way batch does not cost
+    S copies of the whole problem."""
+    axes = ProblemTensors(
+        **{f: (0 if f in fields else None)
+           for f in ProblemTensors._fields})
+    return jax.jit(jax.vmap(_solve_backlog_impl, in_axes=(axes,)))
+
+
+def solve_backlog_batched(t: ProblemTensors, overrides: dict):
+    """Solve S counterfactual variants of one padded problem in ONE
+    device dispatch.
+
+    ``overrides`` maps BATCHABLE_FIELDS names to stacked [S, ...] arrays
+    (scenario variants of the corresponding base array); unnamed fields
+    are shared across the batch. Returns the solve_backlog tuple with a
+    leading scenario axis on every output.
+    """
+    if not overrides:
+        raise ValueError("batched solve needs at least one scenario-"
+                         "varying field (use solve_backlog otherwise)")
+    bad = set(overrides) - BATCHABLE_FIELDS
+    if bad:
+        raise ValueError(
+            f"fields {sorted(bad)} cannot vary per scenario; "
+            f"batchable: {sorted(BATCHABLE_FIELDS)}")
+    fn = _batched_solver(frozenset(overrides))
+    return fn(t._replace(**{k: jnp.asarray(v)
+                            for k, v in overrides.items()}))

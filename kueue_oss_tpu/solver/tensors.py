@@ -54,6 +54,16 @@ class UnsupportedProblem(Exception):
     """Raised when a scenario needs the oracle path (solver fallback)."""
 
 
+def pow2(n: int) -> int:
+    """Next power of two >= n — THE bucketing primitive for every
+    padded axis (workload rows, scenario batches, scatter widths), so
+    a future padding-policy change has one home."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 #: preemption-policy encoding shared with the kernels
 POLICY_NEVER = 0
 POLICY_LOWER_PRIORITY = 1

@@ -23,11 +23,15 @@ from kueue_oss_tpu.core.store import Store
 
 class Dashboard:
     def __init__(self, store: Store, queues: QueueManager,
-                 recorder=None) -> None:
+                 recorder=None, sim_config=None) -> None:
         from kueue_oss_tpu import obs
 
         self.store = store
         self.queues = queues
+        #: SimulatorConfig governing /api/whatif sweeps (an operator's
+        #: Configuration.simulator block plugs in here); None = the
+        #: defaults (mesh off, 256-scenario cap, 2 parity checks)
+        self.sim_config = sim_config
         #: decision flight recorder backing /api/decisions and the
         #: per-workload explain endpoint (defaults to the process-wide
         #: journal the scheduler/solver emit into)
@@ -227,6 +231,37 @@ class Dashboard:
             "solver": self.solver_view(),
         }
 
+    # -- what-if planning (sim/, docs/SIMULATOR.md) ------------------------
+
+    def whatif_view(self, factors=None, target: str = "*",
+                    arrival=None, max_scenarios: int = 64) -> dict:
+        """Counterfactual sweep over the LIVE store's current backlog:
+        quota factors (x arrival factors when given) on the matched CQ
+        or cohort, solved in one vmapped dispatch. The capacity-planning
+        answer straight from the dashboard."""
+        from kueue_oss_tpu.config.configuration import SimulatorConfig
+        from kueue_oss_tpu.sim import (
+            WhatIfEngine,
+            arrival_sweep,
+            cross,
+            quota_sweep,
+        )
+        from kueue_oss_tpu.solver.tensors import UnsupportedProblem
+
+        factors = list(factors or (0.5, 1.5, 2.0))
+        specs = quota_sweep(factors, target=target)
+        if arrival:
+            specs = cross(specs, arrival_sweep(list(arrival)))
+        cfg = (self.sim_config if self.sim_config is not None
+               else SimulatorConfig())
+        specs = specs[:max(1, min(max_scenarios, cfg.max_scenarios))]
+        engine = WhatIfEngine(self.store, self.queues, config=cfg)
+        try:
+            report = engine.run(specs)
+        except (UnsupportedProblem, ValueError) as e:
+            return {"error": str(e)}
+        return report.to_dict()
+
     # -- flight-recorder views (obs/) ---------------------------------------
 
     def workload_explain(self, namespace: str, name: str) -> Optional[dict]:
@@ -412,6 +447,37 @@ class DashboardServer:
                         n = 10
                     body = json.dumps(dash.decisions_view(n)).encode()
                     self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/api/whatif":
+                    from urllib.parse import parse_qs, urlparse
+
+                    qs = parse_qs(urlparse(self.path).query)
+
+                    def floats(key):
+                        # malformed numbers are a caller error: answer
+                        # 400, never a silently different sweep
+                        raw = ",".join(qs.get(key, []))
+                        try:
+                            return [float(x) for x in raw.split(",")
+                                    if x.strip()]
+                        except ValueError:
+                            raise ValueError(
+                                f"{key} must be comma-separated "
+                                f"numbers, got {raw!r}")
+
+                    try:
+                        view = dash.whatif_view(
+                            factors=floats("factors") or None,
+                            target=qs.get("target", ["*"])[0],
+                            arrival=floats("arrival") or None)
+                    except ValueError as e:
+                        view = {"error": str(e)}
+                    body = json.dumps(view).encode()
+                    self.send_response(400 if "error" in view else 200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()

@@ -79,10 +79,24 @@ INDEX_HTML = """<!doctype html>
 <table id="acs"><thead><tr>
   <th>Name</th><th>Controller</th><th>Active</th><th>Waiting workloads</th>
   </tr></thead><tbody></tbody></table>
+<h2>What-if planner</h2>
+<div id="whatif-form">
+  quota factors <input id="wi-factors" value="0.5,1.5,2" size="12">
+  on <input id="wi-target" value="*" size="10"
+           title="CQ or cohort name glob; a cohort scales its subtree">
+  arrival <input id="wi-arrival" value="" size="10"
+                 placeholder="e.g. 0.5,2">
+  <button onclick="runWhatIf()">simulate</button>
+  <span id="wi-status" class="frac"></span>
+</div>
+<table id="wis" style="display:none"><thead><tr>
+  <th>Scenario</th><th>Workloads</th><th>Admitted</th><th>Parked</th>
+  <th>Utilization</th><th>Fairness drift</th><th>Rounds</th>
+  </tr></thead><tbody></tbody></table>
 </div>
 <footer>live over SSE (/api/stream), 2s polling fallback ·
 JSON at /api/overview · decision traces at /api/decisions ·
-Prometheus at /metrics</footer>
+what-if planning at /api/whatif · Prometheus at /metrics</footer>
 <script>
 const fmt = (o) => Object.entries(o || {}).map(
     ([k, v]) => `${k}=${v}`).join(" ") || "—";
@@ -177,6 +191,33 @@ async function refresh() {
         a.name, a.controller || "—", a.active ? "yes" : "no",
         a.waitingWorkloads]));
   } catch (e) { /* server restarting; retry on next tick */ }
+}
+async function runWhatIf() {
+  const status = document.getElementById("wi-status");
+  const table = document.getElementById("wis");
+  status.textContent = "solving…";
+  const params = new URLSearchParams();
+  params.set("factors", document.getElementById("wi-factors").value);
+  params.set("target", document.getElementById("wi-target").value);
+  const arr = document.getElementById("wi-arrival").value.trim();
+  if (arr) params.set("arrival", arr);
+  try {
+    const r = await fetch("/api/whatif?" + params.toString());
+    const rep = await r.json();
+    if (rep.error) { status.textContent = rep.error; return; }
+    const t = rep.timing || {};
+    status.textContent = `${(rep.scenarios || []).length} scenarios in ` +
+      `one dispatch (${t.scenarios_per_sec || "?"}/s, parity ` +
+      `${rep.parity && rep.parity.identical ? "ok" : "FAILED"})`;
+    table.style.display = "";
+    document.querySelector("#wis tbody").innerHTML =
+      (rep.scenarios || []).map(s => `<tr><td>${s.name}</td>` +
+        `<td>${s.workloads}</td><td>${s.admitted}</td>` +
+        `<td>${s.parked}</td>` +
+        `<td>${(s.utilization * 100).toFixed(0)}%</td>` +
+        `<td>${s.fairness_drift}</td><td>${s.rounds}</td></tr>`)
+      .join("");
+  } catch (e) { status.textContent = "what-if unavailable"; }
 }
 const obj = (o) => `<table><tbody>` + Object.entries(o || {}).map(
   ([k, v]) => `<tr><th>${k}</th><td><pre style="margin:0">` +

@@ -42,6 +42,9 @@ def pytest_configure(config):
         "markers", "chaos: fault-injection tests; the default subset is "
         "deterministic (seeded injector, injected clocks) and runs in "
         "tier-1")
+    config.addinivalue_line(
+        "markers", "sim: what-if engine tests (kueue_oss_tpu/sim/); "
+        "deterministic, CPU-backend, runs in tier-1")
 
 
 @pytest.fixture(scope="session")

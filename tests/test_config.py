@@ -68,6 +68,37 @@ def test_load_full_tree():
     assert validate(cfg) == []
 
 
+def test_load_simulator_config():
+    cfg = load({"simulator": {
+        "maxScenarios": 512,
+        "parityScenarios": 4,
+        "padPow2": False,
+        "mesh": "8",
+        "minBatchForMesh": 32,
+    }})
+    sim = cfg.simulator
+    assert sim.max_scenarios == 512
+    assert sim.parity_scenarios == 4
+    assert sim.pad_pow2 is False
+    assert sim.mesh == "8"
+    assert sim.min_batch_for_mesh == 32
+    assert validate(cfg) == []
+    # defaults: the what-if mesh is opt-in, never ambient
+    assert load({}).simulator.mesh == "off"
+    assert load({}).simulator.max_scenarios == 256
+
+
+def test_validate_rejects_bad_simulator_values():
+    cfg = load({"simulator": {"maxScenarios": 0, "parityScenarios": -1,
+                              "mesh": "tpu-please",
+                              "minBatchForMesh": 0}})
+    joined = "\n".join(validate(cfg))
+    assert "simulator.maxScenarios" in joined
+    assert "simulator.parityScenarios" in joined
+    assert "simulator.mesh" in joined
+    assert "simulator.minBatchForMesh" in joined
+
+
 def test_validate_rejects_bad_values():
     cfg = load({
         "waitForPodsReady": {"enable": True, "timeout": -5,
