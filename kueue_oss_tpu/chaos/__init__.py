@@ -31,6 +31,13 @@ device loss and mesh shrink (the multi-chip failure modes) are
 injected by ``MeshFaultInjector`` through the engine's
 ``solve_fault_hook`` seam, driving the mesh -> single-chip -> host
 fallback chain deterministically.
+
+Control-plane crash/restart (the durability failure modes,
+docs/DURABILITY.md) is injected by ``CrashPointInjector`` through the
+``persist.hooks`` crash points (pre_fsync, torn_tail,
+post_fsync_pre_apply, mid_checkpoint, mid_drain); the subprocess
+driver ``python -m kueue_oss_tpu.persist.crashtest`` pairs each kill
+with a recovery run and asserts byte-identical convergence.
 """
 
 from __future__ import annotations
@@ -305,6 +312,62 @@ class MeshFaultInjector:
 
     def faults_injected(self) -> int:
         return sum(self.injected.values())
+
+
+class CrashPointInjector:
+    """Kill -9 the control plane at a named durability point
+    (docs/DURABILITY.md; docs/ROBUSTNESS.md fault taxonomy).
+
+    Two usage modes:
+
+    - **subprocess** (the restart fault): ``env()`` returns the
+      environment that arms the point inside a child control plane —
+      ``persist/crashtest.py`` consumes it, SIGKILLs itself at the
+      point, and a second invocation with ``--phase recover`` proves
+      recovery. This is the production-faithful mode: the process
+      really dies, nothing flushes.
+    - **in-process** (unit tests): ``arm(mode="raise")`` makes the
+      point raise :class:`kueue_oss_tpu.persist.hooks.CrashPoint`
+      instead of killing, so a test can assert on the half-written
+      state directly.
+
+    Points: pre_fsync, torn_tail, post_fsync_pre_apply,
+    mid_checkpoint, mid_drain (``persist.hooks.CRASH_POINTS``).
+    """
+
+    def __init__(self, point: str, after: int = 0,
+                 mode: str = "kill") -> None:
+        from kueue_oss_tpu.persist import hooks
+
+        if point not in hooks.CRASH_POINTS:
+            raise ValueError(f"unknown crash point {point!r}; "
+                             f"one of {hooks.CRASH_POINTS}")
+        self.point = point
+        self.after = int(after)
+        self.mode = mode
+
+    def arm(self) -> "CrashPointInjector":
+        from kueue_oss_tpu.persist import hooks
+
+        hooks.arm(self.point, after=self.after, mode=self.mode)
+        return self
+
+    def disarm(self) -> None:
+        from kueue_oss_tpu.persist import hooks
+
+        hooks.disarm()
+
+    def __enter__(self) -> "CrashPointInjector":
+        return self.arm()
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+    def env(self) -> dict:
+        """Environment arming this point in a child process."""
+        return {"KUEUE_CRASH_POINT": self.point,
+                "KUEUE_CRASH_AFTER": str(self.after),
+                "KUEUE_CRASH_MODE": self.mode}
 
 
 class NodeFlapInjector:

@@ -166,6 +166,42 @@ class SolverBackendConfig:
 
 
 @dataclass
+class PersistenceConfig:
+    """Durable control plane knobs (kueue_oss_tpu/persist/,
+    docs/DURABILITY.md).
+
+    No reference analog — the reference delegates durability to the
+    apiserver/etcd; here the control plane carries its own write-ahead
+    log and checkpoints.
+    """
+
+    #: master switch; when False nothing is logged or checkpointed
+    enabled: bool = False
+    #: durability directory (wal-*.log + checkpoint-*.ckpt); required
+    #: when enabled
+    dir: Optional[str] = None
+    #: WAL fsync policy: "always" (every record durable before the
+    #: append returns), "batch" (group commit at cycle end / every
+    #: batch_records — the <5% overhead default; WAL file order still
+    #: fences intents before their events), "off" (tests/bench only)
+    fsync: str = "batch"
+    #: group-commit width under fsync=batch
+    batch_records: int = 64
+    #: checkpoint after this many WAL records...
+    checkpoint_interval_records: int = 10_000
+    #: ...or after this many seconds with any records pending
+    #: (0 disables the time trigger)
+    checkpoint_interval_seconds: float = 300.0
+    #: validated checkpoints retained (older ones and their WAL
+    #: segments are pruned on checkpoint success)
+    keep_checkpoints: int = 2
+    #: background invariant-auditor cadence; 0 disables the thread
+    audit_interval_seconds: float = 0.0
+    #: let the auditor rebuild drifted derived indexes automatically
+    audit_auto_heal: bool = False
+
+
+@dataclass
 class SimulatorConfig:
     """What-if engine knobs (kueue_oss_tpu/sim/, docs/SIMULATOR.md).
 
@@ -212,6 +248,8 @@ class Configuration:
     multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     solver: SolverBackendConfig = field(default_factory=SolverBackendConfig)
     simulator: SimulatorConfig = field(default_factory=SimulatorConfig)
+    persistence: PersistenceConfig = field(
+        default_factory=PersistenceConfig)
     feature_gates: dict[str, bool] = field(default_factory=dict)
     #: TLS options for the HTTP servers (reference: Configuration.TLS,
     #: applied in config.go:182-190 under the TLSOptions gate)
@@ -288,6 +326,23 @@ def validate(cfg: Configuration) -> list[str]:
         if m not in known and not m.isdigit():
             errs.append(f"simulator.mesh {sim.mesh!r} must be 'auto', "
                         "'off', or a non-negative device count")
+    per = cfg.persistence
+    if per.enabled and not per.dir:
+        errs.append("persistence.dir is required when persistence is "
+                    "enabled")
+    if per.fsync not in ("always", "batch", "off"):
+        errs.append(f"persistence.fsync {per.fsync!r} must be "
+                    "'always', 'batch', or 'off'")
+    if per.batch_records < 1:
+        errs.append("persistence.batchRecords must be >= 1")
+    if per.checkpoint_interval_records < 1:
+        errs.append("persistence.checkpointIntervalRecords must be >= 1")
+    if per.checkpoint_interval_seconds < 0:
+        errs.append("persistence.checkpointInterval must be >= 0")
+    if per.keep_checkpoints < 1:
+        errs.append("persistence.keepCheckpoints must be >= 1")
+    if per.audit_interval_seconds < 0:
+        errs.append("persistence.auditInterval must be >= 0")
     afs = cfg.admission_fair_sharing
     if afs is not None:
         if afs.usage_half_life_time_seconds < 0:
@@ -415,6 +470,20 @@ def load(data: Optional[dict] = None) -> Configuration:
             "mesh": ("mesh", str),
         })
 
+    def conv_persist(d: dict) -> PersistenceConfig:
+        return _build(PersistenceConfig, d, {
+            "enabled": ("enabled", None),
+            "dir": ("dir", str),
+            "fsync": ("fsync", str),
+            "batchRecords": ("batch_records", int),
+            "checkpointIntervalRecords": (
+                "checkpoint_interval_records", int),
+            "checkpointInterval": ("checkpoint_interval_seconds", float),
+            "keepCheckpoints": ("keep_checkpoints", int),
+            "auditInterval": ("audit_interval_seconds", float),
+            "auditAutoHeal": ("audit_auto_heal", None),
+        })
+
     def conv_sim(d: dict) -> SimulatorConfig:
         return _build(SimulatorConfig, d, {
             "maxScenarios": ("max_scenarios", int),
@@ -447,6 +516,7 @@ def load(data: Optional[dict] = None) -> Configuration:
         "multiKueue": ("multikueue", conv_mk),
         "solver": ("solver", conv_solver),
         "simulator": ("simulator", conv_sim),
+        "persistence": ("persistence", conv_persist),
         "featureGates": ("feature_gates", dict),
         "tls": ("tls", conv_tls),
     })

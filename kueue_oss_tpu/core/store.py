@@ -60,6 +60,11 @@ class Store:
         #: generation of the global request-shaping config (LimitRanges /
         #: resource transformations) the info cache was computed under
         self._info_cache_gen = -1
+        #: persist.PersistenceManager wired by attach(); the scheduler
+        #: and solver engine write decision intents / cycle-end flushes
+        #: through this handle (docs/DURABILITY.md). None = volatile
+        #: store (clones, simulations, tests).
+        self.persistence = None
 
     def clone(self) -> "Store":
         """Deep copy of all objects into a fresh Store — no watchers, a

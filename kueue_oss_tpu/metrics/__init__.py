@@ -496,6 +496,51 @@ whatif_parity_failures_total = registry.register(Counter(
     "What-if batches whose vmapped plans diverged from the sequential "
     "oracle (must stay 0; a nonzero count is a kernel bug)", ()))
 
+# -- durable control plane (persist/, docs/DURABILITY.md) --------------------
+
+wal_records_total = registry.register(Counter(
+    "kueue_wal_records_total",
+    "Write-ahead-log records appended, by kind (event/intent)",
+    ("kind",)))
+wal_bytes_total = registry.register(Counter(
+    "kueue_wal_bytes_total",
+    "Write-ahead-log bytes appended (frame headers included)", ()))
+wal_fsyncs_total = registry.register(Counter(
+    "kueue_wal_fsyncs_total",
+    "fsync barriers issued by the write-ahead log", ()))
+checkpoints_total = registry.register(Counter(
+    "kueue_checkpoints_total",
+    "Store checkpoints by outcome (written/failed)", ("outcome",)))
+checkpoint_duration_seconds = registry.register(Histogram(
+    "kueue_checkpoint_duration_seconds",
+    "Wall time of one atomic checkpoint (serialize + fsync + rotate)",
+    ()))
+recovery_total = registry.register(Counter(
+    "kueue_recovery_total",
+    "Recoveries by source (checkpoint/wal_only/empty)", ("source",)))
+recovery_replayed_records = registry.register(Gauge(
+    "kueue_recovery_replayed_records",
+    "WAL records replayed by the most recent recovery", ()))
+invariant_audits_total = registry.register(Counter(
+    "kueue_invariant_audits_total",
+    "Invariant auditor passes completed", ()))
+invariant_violations_total = registry.register(Counter(
+    "kueue_invariant_violations_total",
+    "Accounting invariant violations detected, by check "
+    "(must stay 0; a nonzero count means derived state drifted from "
+    "the admission records)", ("check",)))
+invariant_heals_total = registry.register(Counter(
+    "kueue_invariant_heals_total",
+    "Auto-heal index rebuilds performed by the invariant auditor", ()))
+invariant_audit_errors_total = registry.register(Counter(
+    "kueue_invariant_audit_errors_total",
+    "Background audit passes that crashed internally (an auditor "
+    "defect, NOT state drift — the violations counter stays clean)",
+    ()))
+invariant_last_violations = registry.register(Gauge(
+    "kueue_invariant_last_violations",
+    "Violations found by the most recent audit pass", ()))
+
 
 # -- recording helpers (reference: pkg/metrics exported funcs) ---------------
 

@@ -222,7 +222,12 @@ class FlightRecorder:
         """Atomically write the journal: a crash mid-dump must never
         leave a half-written file where a previous complete journal
         stood (replay/simulation consume these dumps). The write goes
-        to a same-directory temp file and lands via ``os.replace``."""
+        to a same-directory temp file, lands via ``os.replace``, and
+        the directory is fsynced too — an fsynced file behind an
+        un-fsynced rename is not durable across power loss (the same
+        discipline as the persist/ checkpoint writer)."""
+        from kueue_oss_tpu.util.fsutil import fsync_dir
+
         events = self.events()
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
@@ -232,6 +237,7 @@ class FlightRecorder:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
+            fsync_dir(os.path.dirname(os.path.abspath(path)))
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)

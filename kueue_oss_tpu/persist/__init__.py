@@ -1,0 +1,57 @@
+"""Durable control plane: write-ahead log, checkpoints, recovery, auditor.
+
+The reference Kueue survives controller restarts because the apiserver
+(etcd) is its durable store — the cache and queues rebuild from watches
+on start. This reproduction keeps the whole control plane in process
+memory, so until this subsystem a crash lost every admission decision
+ever made. ``persist`` closes that gap (docs/DURABILITY.md):
+
+- :mod:`codec` — canonical (byte-stable) serialization of every API
+  object and of a whole :class:`~kueue_oss_tpu.core.store.Store`;
+- :mod:`wal` — a CRC-framed, fsynced write-ahead log fed by
+  ``Store._emit`` events plus scheduler decision intents;
+- :mod:`checkpoint` — atomic periodic checkpoints (tmp file +
+  ``os.replace`` + directory fsync) with WAL truncation on success;
+- :mod:`manager` — :class:`PersistenceManager`, the wiring: store
+  watch -> WAL, intent fencing, checkpoint cadence, and recovery
+  (newest valid checkpoint + replay of the WAL suffix, tolerant of a
+  torn tail);
+- :mod:`auditor` — :class:`InvariantAuditor`, recomputing per-CQ usage
+  and cohort subtree quota from admitted workloads via the
+  ``core/quota.py`` formulas and diffing against store accounting;
+- :mod:`hooks` — named crash points for the chaos harness
+  (``kueue_oss_tpu/chaos`` ``CrashPointInjector`` +
+  ``persist/crashtest.py`` subprocess driver).
+"""
+
+from kueue_oss_tpu.persist.auditor import InvariantAuditor, Violation
+from kueue_oss_tpu.persist.checkpoint import fsync_dir
+from kueue_oss_tpu.persist.codec import (
+    canonical_dump,
+    from_dict,
+    store_from_dict,
+    store_to_dict,
+    to_dict,
+)
+from kueue_oss_tpu.persist.manager import (
+    PersistenceManager,
+    RecoveryResult,
+    apply_event,
+)
+from kueue_oss_tpu.persist.wal import WriteAheadLog, replay_wal
+
+__all__ = [
+    "InvariantAuditor",
+    "PersistenceManager",
+    "RecoveryResult",
+    "Violation",
+    "WriteAheadLog",
+    "apply_event",
+    "canonical_dump",
+    "from_dict",
+    "fsync_dir",
+    "replay_wal",
+    "store_from_dict",
+    "store_to_dict",
+    "to_dict",
+]

@@ -1,0 +1,113 @@
+"""Atomic store checkpoints (docs/DURABILITY.md).
+
+A checkpoint file is one header line of JSON metadata (id, payload
+sha256, payload size) followed by the canonical store dump. The write
+is crash-atomic: same-directory temp file, flush + fsync, ``os.replace``
+onto the final name, then a **directory fsync** so the rename itself
+survives power loss (the same fix applied to ``obs.dump_jsonl`` — an
+fsynced file behind an un-fsynced rename is not durable).
+
+Loading validates the sha256 over the payload; a torn or corrupt
+checkpoint (a crash between temp-write and replace leaves only the
+temp file, which is never considered) is skipped and the next-newest
+one is used — recovery never trusts an unverified snapshot.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from typing import Optional
+
+from kueue_oss_tpu.persist import hooks
+from kueue_oss_tpu.util.fsutil import fsync_dir
+
+__all__ = ["CorruptCheckpoint", "checkpoint_path", "fsync_dir",
+           "list_checkpoints", "load_checkpoint", "newest_valid",
+           "write_checkpoint"]
+
+_NAME = re.compile(r"^checkpoint-(\d+)\.ckpt$")
+
+
+class CorruptCheckpoint(ValueError):
+    pass
+
+
+def checkpoint_path(dir_path: str, ckpt_id: int) -> str:
+    return os.path.join(dir_path, f"checkpoint-{ckpt_id:08d}.ckpt")
+
+
+def write_checkpoint(dir_path: str, ckpt_id: int, state: bytes,
+                     extra_meta: Optional[dict] = None) -> str:
+    meta = {
+        "version": 1,
+        "id": int(ckpt_id),
+        "sha256": hashlib.sha256(state).hexdigest(),
+        "size": len(state),
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    path = checkpoint_path(dir_path, ckpt_id)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(meta, sort_keys=True,
+                               separators=(",", ":")).encode())
+            f.write(b"\n")
+            f.write(state)
+            f.flush()
+            os.fsync(f.fileno())
+        hooks.crash_if("mid_checkpoint")
+        os.replace(tmp, path)
+        fsync_dir(dir_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_checkpoint(path: str) -> tuple[dict, bytes]:
+    """Returns (meta, state bytes); raises CorruptCheckpoint when the
+    header is unparseable or the payload fails its hash."""
+    with open(path, "rb") as f:
+        header = f.readline()
+        state = f.read()
+    try:
+        meta = json.loads(header)
+    except ValueError as e:
+        raise CorruptCheckpoint(f"{path}: bad header: {e}") from e
+    if not isinstance(meta, dict) or "sha256" not in meta:
+        raise CorruptCheckpoint(f"{path}: header is not checkpoint meta")
+    if len(state) != meta.get("size"):
+        raise CorruptCheckpoint(
+            f"{path}: payload {len(state)}B != declared {meta.get('size')}B")
+    if hashlib.sha256(state).hexdigest() != meta["sha256"]:
+        raise CorruptCheckpoint(f"{path}: payload hash mismatch")
+    return meta, state
+
+
+def list_checkpoints(dir_path: str) -> list[tuple[int, str]]:
+    """(id, path) of every checkpoint file, newest first."""
+    out = []
+    try:
+        names = os.listdir(dir_path)
+    except FileNotFoundError:
+        return out
+    for name in names:
+        m = _NAME.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(dir_path, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def newest_valid(dir_path: str) -> Optional[tuple[dict, bytes]]:
+    """The newest checkpoint that passes validation, or None."""
+    for _ckpt_id, path in list_checkpoints(dir_path):
+        try:
+            return load_checkpoint(path)
+        except (CorruptCheckpoint, OSError):
+            continue
+    return None

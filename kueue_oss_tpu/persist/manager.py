@@ -1,0 +1,454 @@
+"""PersistenceManager: WAL wiring, checkpoints, recovery.
+
+One manager owns one durability directory:
+
+    <dir>/checkpoint-<n>.ckpt   atomic snapshots (checkpoint.py)
+    <dir>/wal-<n>.log           records appended AFTER checkpoint n
+
+``attach(store)`` subscribes to ``Store._emit``: every mutation event
+becomes one WAL ``event`` record carrying the full post-mutation
+object. Scheduler decision paths additionally call ``intent()`` BEFORE
+mutating — the intent is fsynced (a write barrier) and carries the
+workload's pre-mutation resource_version, so recovery can verify which
+decisions applied (a following event at rv+1) and which the crash ate
+(the scheduler simply redoes those from the recovered state).
+
+Checkpoints rotate the WAL: sync the active segment, write
+checkpoint n+1 atomically, open wal-(n+1).log, then delete segments
+and checkpoints the retention window no longer needs. A crash at any
+point leaves a recoverable prefix: an unpublished checkpoint temp file
+is never considered, and an unrotated WAL still pairs with the
+previous checkpoint.
+
+Recovery = newest valid checkpoint + replay of its WAL segment,
+tolerant of a torn tail. Replay applies events RAW (no version bumps,
+no metric side effects) with a resource-version guard so records that
+raced on the emit path converge to the newest state. With
+``emit=True`` every applied object is re-emitted through the store's
+watch stream, so a promoted replica's watch-driven caches
+(QueueManager heaps) warm during the replay itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from kueue_oss_tpu import metrics
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.persist import checkpoint as ckpt
+from kueue_oss_tpu.persist import codec, hooks
+from kueue_oss_tpu.persist.wal import FSYNC_BATCH, WriteAheadLog, replay_wal
+
+_SEG = re.compile(r"^wal-(\d+)\.log$")
+
+
+def _segment_path(dir_path: str, seg: int) -> str:
+    return os.path.join(dir_path, f"wal-{seg:08d}.log")
+
+
+def apply_event(store: Store, verb: str, kind: str, obj_dict: dict,
+                emit: bool = False) -> bool:
+    """Apply one WAL event record to the store, raw.
+
+    Objects land verbatim (their recorded post-mutation state). For
+    workloads, a record older than the stored resource_version is
+    skipped: watchers run outside the store lock, so two racing writes
+    can reach the WAL in either order — last-state-wins converges both
+    orders to the same store. Returns True when the record changed the
+    store.
+    """
+    if kind not in codec.KINDS:
+        return False
+    attr, _cls, key_of = codec.KINDS[kind]
+    obj = codec.from_dict(kind, obj_dict)
+    key = key_of(obj)
+    changed = True
+    with store._lock:
+        target = getattr(store, attr)
+        if verb == "delete":
+            if kind == "Workload":
+                live = target.get(key)
+                if (live is not None
+                        and live.resource_version > obj.resource_version):
+                    # the record order raced a newer re-insert (watchers
+                    # run outside the store lock): last state wins, the
+                    # stale delete is dropped — mirroring the update
+                    # branch's guard
+                    return False
+            existed = target.pop(key, None) is not None
+            if kind == "Workload":
+                store._admitted.pop(key, None)
+                store._admitted_infos.pop(key, None)
+                store._finished_counted.discard(key)
+            elif kind == "ClusterQueue":
+                store.cq_generation.pop(key, None)
+            changed = existed
+        else:
+            if kind == "Workload":
+                live = target.get(key)
+                if (live is not None
+                        and live.resource_version > obj.resource_version):
+                    return False
+                target[key] = obj
+                store._index_workload(obj)
+                if obj.is_finished:
+                    store._finished_counted.add(key)
+            else:
+                target[key] = obj
+                if kind == "ClusterQueue":
+                    store.cq_generation[key] = (
+                        store.cq_generation.get(key, 0) + 1)
+    if changed and emit:
+        store._emit(verb, kind, obj)
+    return changed
+
+
+@dataclass
+class RecoveryResult:
+    store: Store
+    checkpoint_id: int = 0
+    replayed_events: int = 0
+    replayed_intents: int = 0
+    unapplied_intents: int = 0
+    fence_violations: int = 0
+    torn_tail: bool = False
+
+    def to_dict(self) -> dict:
+        return {"checkpoint_id": self.checkpoint_id,
+                "replayed_events": self.replayed_events,
+                "replayed_intents": self.replayed_intents,
+                "unapplied_intents": self.unapplied_intents,
+                "fence_violations": self.fence_violations,
+                "torn_tail": self.torn_tail}
+
+
+class PersistenceManager:
+    def __init__(self, dir_path: str, fsync: str = FSYNC_BATCH,
+                 batch_records: int = 64,
+                 checkpoint_interval_records: int = 10_000,
+                 checkpoint_interval_seconds: float = 300.0,
+                 keep_checkpoints: int = 2,
+                 audit_interval_seconds: float = 0.0,
+                 audit_auto_heal: bool = False,
+                 clock=time.monotonic) -> None:
+        self.dir = dir_path
+        os.makedirs(dir_path, exist_ok=True)
+        self.fsync = fsync
+        self.batch_records = batch_records
+        self.checkpoint_interval_records = checkpoint_interval_records
+        self.checkpoint_interval_seconds = checkpoint_interval_seconds
+        self.keep_checkpoints = max(1, keep_checkpoints)
+        #: background invariant-auditor cadence; attach() starts the
+        #: thread when > 0 (PersistenceConfig.audit_interval_seconds)
+        self.audit_interval_seconds = audit_interval_seconds
+        self.audit_auto_heal = audit_auto_heal
+        self.auditor = None
+        self.clock = clock
+        self._lock = threading.RLock()
+        self.store: Optional[Store] = None
+        self._replaying = False
+        self._records_since_ckpt = 0
+        self._last_ckpt_at = clock()
+        # a crash between checkpoint temp-write and publish leaves the
+        # temp file behind; it is never trusted, so sweep it on start
+        for name in os.listdir(dir_path):
+            if ".ckpt.tmp." in name:
+                try:
+                    os.unlink(os.path.join(dir_path, name))
+                except OSError:
+                    pass
+        ckpts = ckpt.list_checkpoints(dir_path)
+        self.segment = ckpts[0][0] if ckpts else 0
+        self.wal = WriteAheadLog(_segment_path(dir_path, self.segment),
+                                 fsync=fsync, batch_records=batch_records)
+
+    @classmethod
+    def from_config(cls, cfg) -> "PersistenceManager":
+        """Build from config.PersistenceConfig (dir required)."""
+        if not cfg.dir:
+            raise ValueError("persistence.dir is required")
+        return cls(cfg.dir, fsync=cfg.fsync,
+                   batch_records=cfg.batch_records,
+                   checkpoint_interval_records=(
+                       cfg.checkpoint_interval_records),
+                   checkpoint_interval_seconds=(
+                       cfg.checkpoint_interval_seconds),
+                   keep_checkpoints=cfg.keep_checkpoints,
+                   audit_interval_seconds=cfg.audit_interval_seconds,
+                   audit_auto_heal=cfg.audit_auto_heal)
+
+    # -- logging -----------------------------------------------------------
+
+    def attach(self, store: Store) -> None:
+        """Subscribe to the store's watch stream and become its
+        ``store.persistence`` handle (the scheduler and solver engine
+        find the intent/flush surface there). With a configured audit
+        cadence, the background invariant auditor starts here too."""
+        self.store = store
+        store.persistence = self
+        store.watch(self._on_event)
+        if self.audit_interval_seconds > 0 and self.auditor is None:
+            from kueue_oss_tpu.persist.auditor import InvariantAuditor
+
+            self.auditor = InvariantAuditor(
+                store, auto_heal=self.audit_auto_heal)
+            self.auditor.start(interval_s=self.audit_interval_seconds)
+
+    def _on_event(self, event) -> None:
+        if self._replaying:
+            return
+        verb, kind, obj = event
+        if kind not in codec.KINDS:
+            return
+        rec = {"t": "event", "verb": verb, "kind": kind,
+               "obj": codec.to_dict(obj)}
+        with self._lock:
+            self.wal.append(rec, kind="event")
+            self._records_since_ckpt += 1
+
+    def intent(self, op: str, wl_key: str, rv: int, *, cycle: int = 0,
+               cluster_queue: str = "", detail: Optional[dict] = None
+               ) -> None:
+        """Durable decision record, written BEFORE the store mutation.
+
+        ``rv`` is the workload's pre-mutation resource_version — the
+        fence ``update_workload_if`` preconditions on; the mutation the
+        intent announces lands at rv+1, which is how recovery tells an
+        applied decision from a lost one.
+
+        Durability follows the configured fsync policy: the intent is
+        appended to the same WAL strictly before its event, so file
+        order alone guarantees recovery never sees an event without
+        its fence — a per-intent fsync under group commit would buy
+        nothing (this control plane has no external side effects
+        between intent and apply) while costing one fsync per admitted
+        workload on drain-heavy cycles.
+        """
+        rec = {"t": "intent", "op": op, "wl": wl_key, "rv": int(rv),
+               "cycle": int(cycle), "cq": cluster_queue}
+        if detail:
+            rec["detail"] = detail
+        with self._lock:
+            self.wal.append(rec, kind="intent")
+            self._records_since_ckpt += 1
+        hooks.crash_if("post_fsync_pre_apply")
+
+    def flush(self) -> None:
+        """Cycle-end group commit + checkpoint cadence check."""
+        with self._lock:
+            self.wal.sync()
+        self.maybe_checkpoint()
+
+    # -- checkpoints -------------------------------------------------------
+
+    def maybe_checkpoint(self) -> bool:
+        if self.store is None:
+            return False
+        with self._lock:
+            due = (self._records_since_ckpt
+                   >= self.checkpoint_interval_records)
+            if (not due and self.checkpoint_interval_seconds > 0
+                    and self._records_since_ckpt > 0):
+                due = (self.clock() - self._last_ckpt_at
+                       >= self.checkpoint_interval_seconds)
+            if not due:
+                return False
+        self.checkpoint()
+        return True
+
+    def checkpoint(self) -> int:
+        """Atomic checkpoint + WAL rotation; returns the new id."""
+        if self.store is None:
+            raise RuntimeError("no store attached")
+        t0 = time.monotonic()
+        with self._lock:
+            self.wal.sync()
+            state = codec.canonical_dump(self.store)
+            new_id = self.segment + 1
+            try:
+                # open the NEW segment before publishing the
+                # checkpoint: if this fails (ENOSPC, EMFILE) nothing
+                # was published and appends continue into the old
+                # segment, still covered by the old checkpoint. The
+                # reverse order would strand post-checkpoint records
+                # in a segment recovery never replays. A stray empty
+                # wal-(n+1).log from a crash between these steps is
+                # harmless — replay visits it and finds nothing.
+                new_wal = WriteAheadLog(
+                    _segment_path(self.dir, new_id),
+                    fsync=self.fsync, batch_records=self.batch_records)
+                try:
+                    ckpt.write_checkpoint(self.dir, new_id, state)
+                except BaseException:
+                    new_wal.close()
+                    raise
+            except Exception:
+                metrics.checkpoints_total.inc("failed")
+                raise
+            # rotate: records from here on belong to the new segment
+            old_wal, self.wal = self.wal, new_wal
+            old_wal.close()
+            ckpt.fsync_dir(self.dir)
+            self.segment = new_id
+            self._records_since_ckpt = 0
+            self._last_ckpt_at = self.clock()
+            self._prune(new_id)
+        metrics.checkpoints_total.inc("written")
+        metrics.checkpoint_duration_seconds.observe(
+            value=time.monotonic() - t0)
+        return new_id
+
+    def _prune(self, newest_id: int) -> None:
+        """WAL truncation on checkpoint success: drop checkpoints
+        beyond the retention window and every WAL segment older than
+        the oldest retained checkpoint."""
+        kept = 0
+        oldest_kept = newest_id
+        for ckpt_id, path in ckpt.list_checkpoints(self.dir):
+            kept += 1
+            if kept <= self.keep_checkpoints:
+                oldest_kept = min(oldest_kept, ckpt_id)
+                continue
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        for name in os.listdir(self.dir):
+            m = _SEG.match(name)
+            if m and int(m.group(1)) < oldest_kept:
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+
+    # -- recovery ----------------------------------------------------------
+
+    def recover(self, store: Optional[Store] = None,
+                emit: bool = False) -> RecoveryResult:
+        """Rebuild state: newest valid checkpoint + WAL suffix replay.
+
+        ``store=None`` builds a fresh Store. Passing an existing store
+        (a promoted replica warming up) SYNCS it to durable state —
+        upserts for everything durable, deletes for anything the store
+        holds that durable state does not (a re-promoted ex-leader may
+        carry objects deleted during its time as follower); with
+        ``emit=True`` every applied change re-emits through the watch
+        stream so watch-driven caches warm in the same pass.
+        """
+        loaded = ckpt.newest_valid(self.dir)
+        # durable state is always materialized into a fresh raw store
+        # first — a pure function of checkpoint + log, independent of
+        # whatever the target store currently holds
+        result = RecoveryResult(store=Store())
+        self._replaying = True
+        try:
+            if loaded is not None:
+                meta, state = loaded
+                result.checkpoint_id = int(meta["id"])
+                codec.store_from_dict(json.loads(state),
+                                      store=result.store)
+            self._replay_segments(result, emit=False,
+                                  start=result.checkpoint_id)
+            # the active segment's torn tail may have been truncated
+            # away when this manager opened it — still a torn tail
+            result.torn_tail = (result.torn_tail
+                                or self.wal.truncated_bytes > 0)
+            # the uid floor must cover WAL-replayed workloads too (and
+            # WAL-only recoveries, which never touch the checkpoint
+            # branch): a re-issued uid would alias queue-order
+            # tie-breaks and session slots
+            codec.advance_uid_floor(max(
+                (wl.uid for wl in result.store.workloads.values()),
+                default=0))
+            if store is not None:
+                self._sync_into(store, result.store, emit=emit)
+                result.store = store
+        finally:
+            self._replaying = False
+        metrics.recovery_total.inc(
+            "checkpoint" if loaded is not None else
+            ("wal_only" if result.replayed_events else "empty"))
+        metrics.recovery_replayed_records.set(
+            value=result.replayed_events + result.replayed_intents)
+        return result
+
+    @staticmethod
+    def _sync_into(target: Store, durable: Store, emit: bool) -> None:
+        """Make `target` mirror `durable`: delete extras, upsert the
+        rest, all raw (no version bumps), then re-emit each change so
+        the target's watchers track the sync. Emission follows the
+        store convention (outside the lock, after the mutation)."""
+        events: list[tuple[str, str, object]] = []
+        with target._lock:
+            for kind, (attr, _cls, _key_of) in codec.KINDS.items():
+                src = getattr(durable, attr)
+                dst = getattr(target, attr)
+                for key in [k for k in dst if k not in src]:
+                    gone = dst.pop(key)
+                    if kind == "ClusterQueue":
+                        target.cq_generation.pop(key, None)
+                    events.append(("delete", kind, gone))
+                for key, obj in src.items():
+                    dst[key] = obj
+                    events.append(("update", kind, obj))
+            target.namespaces = {ns: dict(labels) for ns, labels
+                                 in durable.namespaces.items()}
+            target.cq_generation = dict(durable.cq_generation)
+            codec.rebuild_indexes(target)
+        if emit:
+            for verb, kind, obj in events:
+                target._emit(verb, kind, obj)
+
+    def _replay_segments(self, result: RecoveryResult, emit: bool,
+                         start: int) -> None:
+        seg_ids = sorted(
+            int(m.group(1)) for m in
+            (_SEG.match(n) for n in os.listdir(self.dir)) if m)
+        #: intent fences awaiting their apply event: wl key -> [rv]
+        pending: dict[str, list[int]] = {}
+        for seg in seg_ids:
+            if seg < start:
+                continue
+            records, torn = replay_wal(_segment_path(self.dir, seg))
+            result.torn_tail = result.torn_tail or torn
+            for rec in records:
+                if rec.get("t") == "intent":
+                    result.replayed_intents += 1
+                    pending.setdefault(rec["wl"], []).append(
+                        int(rec["rv"]))
+                    continue
+                if rec.get("t") != "event":
+                    continue
+                result.replayed_events += 1
+                kind, verb = rec["kind"], rec["verb"]
+                if kind == "Workload":
+                    key = rec["obj"].get("namespace", "") + "/" + \
+                        rec["obj"].get("name", "")
+                    fences = pending.get(key)
+                    if fences:
+                        rv = int(rec["obj"].get("resource_version", 0))
+                        if verb == "delete" or rv == fences[0] + 1:
+                            fences.pop(0)
+                        elif rv > fences[0] + 1:
+                            # the fence's mutation was skipped but a
+                            # LATER write landed: the optimistic
+                            # precondition was violated
+                            result.fence_violations += 1
+                            fences.pop(0)
+                        if not fences:
+                            pending.pop(key, None)
+                apply_event(result.store, verb, kind, rec["obj"],
+                            emit=emit)
+        result.unapplied_intents = sum(len(v) for v in pending.values())
+
+    def close(self) -> None:
+        if self.auditor is not None:
+            self.auditor.stop()
+        with self._lock:
+            self.wal.close()

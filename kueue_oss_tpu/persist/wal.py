@@ -1,0 +1,220 @@
+"""CRC-framed write-ahead log (docs/DURABILITY.md).
+
+Frame layout, all integers big-endian:
+
+    magic  2B  b"KW"
+    ver    1B  0x01
+    len    4B  payload byte length
+    crc    4B  zlib.crc32 of the payload
+    payload    canonical JSON record
+
+Records are appended by the PersistenceManager: ``event`` records carry
+the full post-mutation object from ``Store._emit`` (state replay);
+``intent`` records fence scheduler decisions (admit/evict/preempt)
+BEFORE the store mutation they announce, carrying the workload's
+pre-mutation resource_version — the same optimistic-concurrency token
+``Store.update_workload_if`` preconditions on, so recovery can tell an
+applied decision (a following event at rv+1) from one the crash ate.
+
+Durability policy (`fsync`):
+
+  always -- fsync after every append (the crash harness's setting:
+            every acknowledged record survives SIGKILL)
+  batch  -- group commit: fsync every `batch_records` appends and on
+            explicit sync() — the scheduler flushes at cycle end, so at
+            most one cycle's tail is exposed to a crash (default; the
+            <5% wal_overhead_pct budget lives here)
+  off    -- never fsync (bench twins, throwaway dirs)
+
+Intents follow the same policy: they are appended to the same file
+strictly before the event they fence, so ORDER (not an extra fsync)
+is what guarantees recovery never sees an event without its intent.
+
+Replay tolerates a torn tail: a short header, short payload, bad magic
+or CRC mismatch ends the scan at the last complete record (exactly the
+state an interrupted append leaves behind).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Iterator, Optional
+
+from kueue_oss_tpu import metrics
+from kueue_oss_tpu.persist import hooks
+
+MAGIC = b"KW"
+VERSION = 1
+_HEADER = struct.Struct(">2sBII")
+
+FSYNC_ALWAYS = "always"
+FSYNC_BATCH = "batch"
+FSYNC_OFF = "off"
+FSYNC_MODES = (FSYNC_ALWAYS, FSYNC_BATCH, FSYNC_OFF)
+
+
+def encode_frame(record: dict) -> bytes:
+    # ONE canonical encoding across every durability surface: WAL
+    # payloads and checkpoint dumps must stay byte-aligned for the
+    # same object (persist/codec.py owns the settings)
+    from kueue_oss_tpu.persist.codec import canonical_json
+
+    payload = canonical_json(record)
+    return _HEADER.pack(MAGIC, VERSION, len(payload),
+                        zlib.crc32(payload)) + payload
+
+
+class WriteAheadLog:
+    """One append-only segment file."""
+
+    def __init__(self, path: str, fsync: str = FSYNC_BATCH,
+                 batch_records: int = 64) -> None:
+        if fsync not in FSYNC_MODES:
+            raise ValueError(f"fsync {fsync!r} not in {FSYNC_MODES}")
+        self.path = path
+        self.fsync = fsync
+        self.batch_records = max(1, int(batch_records))
+        # A crash can leave a torn frame at the tail; appending after
+        # it would strand every later record behind an unreadable
+        # frame, so re-opening a segment first truncates it back to
+        # its last complete frame.
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        self.truncated_bytes = 0
+        if size:
+            valid = valid_prefix_len(path)
+            if valid < size:
+                self.truncated_bytes = size - valid
+                with open(path, "r+b") as f:
+                    f.truncate(valid)
+                    f.flush()
+                    os.fsync(f.fileno())
+        # buffering=0: a record handed to the OS survives a SIGKILL of
+        # THIS process even before fsync; only power loss can eat it.
+        self._f = open(path, "ab", buffering=0)
+        self._unsynced = 0
+        self.records_appended = 0
+        self.bytes_appended = 0
+
+    def append(self, record: dict, kind: str = "event",
+               sync: Optional[bool] = None) -> int:
+        """Append one record; returns bytes written. `sync=True` forces
+        the record durable before returning (intents)."""
+        frame = encode_frame(record)
+        if hooks.should_fire("pre_fsync"):
+            # the record never reaches disk: durable state = everything
+            # before it (deterministic stand-in for a lost page cache).
+            # Close before killing: under mode="raise" a survivor must
+            # not keep appending a CRC-valid log with a silently
+            # dropped record in the middle (same discipline as
+            # torn_tail below).
+            self._fsync()
+            self._f.close()
+            hooks.kill()
+        if hooks.should_fire("torn_tail"):
+            # half a frame lands durably, then the power cut. Close the
+            # handle first: under mode="raise" (in-process tests) a
+            # survivor must not keep appending past a durable torn
+            # frame — replay would stop there and silently lose every
+            # later record; a closed file fails the next append loudly.
+            self._f.write(frame[:max(1, len(frame) // 2)])
+            if self.fsync != FSYNC_OFF:
+                os.fsync(self._f.fileno())
+            self._f.close()
+            hooks.kill()
+        self._f.write(frame)
+        self._unsynced += 1
+        self.records_appended += 1
+        self.bytes_appended += len(frame)
+        metrics.wal_records_total.inc(kind)
+        metrics.wal_bytes_total.inc(by=len(frame))
+        force = sync if sync is not None else (self.fsync == FSYNC_ALWAYS)
+        if force or (self.fsync == FSYNC_BATCH
+                     and self._unsynced >= self.batch_records):
+            self._fsync()
+        return len(frame)
+
+    def sync(self) -> None:
+        """Group-commit barrier: make every appended record durable."""
+        if self._unsynced:
+            self._fsync()
+
+    def _fsync(self) -> None:
+        if self.fsync == FSYNC_OFF:
+            self._unsynced = 0
+            return
+        os.fsync(self._f.fileno())
+        self._unsynced = 0
+        metrics.wal_fsyncs_total.inc()
+
+    def close(self) -> None:
+        if self._f.closed:
+            return
+        self.sync()
+        self._f.close()
+
+
+def _scan(path: str) -> Iterator[tuple[int, int, dict]]:
+    """The ONE frame scanner: yield (offset, frame length, record) for
+    each fully valid frame, stopping at the first invalid one. Every
+    consumer (replay, truncation boundaries, reopen-truncation) shares
+    these validity rules — a frame one path accepts and another
+    rejects would let appends continue past a frame recovery stops at,
+    permanently hiding later records."""
+    with open(path, "rb") as f:
+        off = 0
+        while True:
+            header = f.read(_HEADER.size)
+            if len(header) < _HEADER.size:
+                return
+            try:
+                magic, ver, length, crc = _HEADER.unpack(header)
+            except struct.error:
+                return
+            if magic != MAGIC or ver != VERSION:
+                return
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                return
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                return
+            yield off, _HEADER.size + length, rec
+            off += _HEADER.size + length
+
+
+def replay_wal(path: str) -> tuple[list[dict], bool]:
+    """Read every complete record; returns (records, torn_tail).
+
+    torn_tail is True when the file ends in an incomplete or corrupt
+    frame — expected after a crash mid-append, and the reason WAL
+    replay stops at the last complete record instead of raising.
+    """
+    records: list[dict] = []
+    end = 0
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return records, False
+    for off, length, rec in _scan(path):
+        records.append(rec)
+        end = off + length
+    return records, end < size
+
+
+def valid_prefix_len(path: str) -> int:
+    """Byte length of the longest complete-frame prefix."""
+    return sum(n for _off, n in iter_frames(path))
+
+
+def iter_frames(path: str) -> Iterator[tuple[int, int]]:
+    """(offset, frame length) of each complete frame — the truncation
+    property test cuts the file at every one of these boundaries."""
+    for off, length, _rec in _scan(path):
+        yield off, length

@@ -196,6 +196,7 @@ class Scheduler:
                 metrics.report_pending_workloads(cq_name, *counts)
             if self._cycle_touched_cqs:
                 self._flush_metrics(build_snapshot(self.store), entries=[])
+            self._persist_flush()
             return stats
 
         snapshot = build_snapshot(self.store)
@@ -241,7 +242,16 @@ class Scheduler:
                   else metrics.CycleResult.INADMISSIBLE)
         metrics.observe_admission_attempt(result, stats.duration_s)
         self._flush_metrics(snapshot, entries)
+        self._persist_flush()
         return stats
+
+    def _persist_flush(self) -> None:
+        """Cycle-end durability barrier: the WAL's group commit lands
+        every record this cycle produced (docs/DURABILITY.md), and the
+        checkpoint cadence gets its periodic look."""
+        p = getattr(self.store, "persistence", None)
+        if p is not None:
+            p.flush()
 
     def _flush_metrics(self, snapshot: Snapshot, entries: list[Entry]) -> None:
         for cq_name, counts in self.queues.drain_dirty_pending_counts().items():
@@ -1054,6 +1064,15 @@ class Scheduler:
             e.inadmissible_msg = "Workload vanished from the store"
             self._record_skip(e, "vanished")
             return
+        p = getattr(self.store, "persistence", None)
+        if p is not None:
+            # decision intent BEFORE the store mutation, fenced by the
+            # pre-write resource version (the update_workload_if token):
+            # recovery matches it to the event at rv+1, or redoes the
+            # admission from the recovered state (docs/DURABILITY.md)
+            p.intent("admit", wl.key, rv=wl.resource_version,
+                     cycle=self.cycle_count,
+                     cluster_queue=e.info.cluster_queue)
         delay_tas = self._delays_topology(e)
         admission = Admission(
             cluster_queue=e.info.cluster_queue,
@@ -1194,6 +1213,14 @@ class Scheduler:
         cq = (wl.status.admission.cluster_queue
               if wl.status.admission is not None
               else self.store.cluster_queue_for(wl))
+        p = getattr(self.store, "persistence", None)
+        if p is not None:
+            p.intent("preempt" if preemption_reason else "evict",
+                     wl.key, rv=wl.resource_version,
+                     cycle=(decision_cycle if decision_cycle is not None
+                            else self.cycle_count),
+                     cluster_queue=cq or "",
+                     detail={"reason": reason})
         was_reserved = wl.is_quota_reserved
         if was_reserved:
             self._solver_freed_since_drain += 1

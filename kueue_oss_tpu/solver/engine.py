@@ -41,6 +41,7 @@ from kueue_oss_tpu.solver.tensors import (
     pad_workloads,
     pow2,
 )
+from kueue_oss_tpu.persist import hooks as persist_hooks
 
 
 @dataclass
@@ -421,6 +422,11 @@ class SolverEngine:
                 # apply must never leak into the next drain (stale
                 # workload refs would bypass the store lookups)
                 self._prework = None
+                # durability barrier: a drain's plan applications are
+                # group-committed before the scheduler builds on them
+                persistence = getattr(self.store, "persistence", None)
+                if persistence is not None:
+                    persistence.flush()
 
     def _drain(self, now: float, verify: bool) -> DrainResult:
         pending = self.pending_backlog()
@@ -676,6 +682,20 @@ class SolverEngine:
         remote_w = (getattr(self.remote, "remote_mesh_devices", 0)
                     if self.remote is not None else 0)
         return align_pad_target(self._pad_hwm, self._mesh(), remote_w)
+
+    def reset_sessions(self, reason: str = "restart") -> None:
+        """Drop delta-sync session and resident-device state so the
+        next drain of each kind opens with a full SYNC.
+
+        The recovery path calls this after rebuilding a store
+        (docs/DURABILITY.md): resident device buffers and sidecar
+        session state are gone by design across a restart, and a
+        warmed-by-replay store must never be diffed against slot state
+        from before the failover."""
+        if self._delta_sessions or self._device_states:
+            metrics.solver_resync_total.inc(reason)
+        self._delta_sessions.clear()
+        self._device_states.clear()
 
     def _session_encode(self, kind: str, problem: SolverProblem):
         """Stable slot/rank re-encoding + the SessionFrame to ship.
@@ -1354,6 +1374,16 @@ class SolverEngine:
                           topology: Optional[TopologyAssignment] = None,
                           ) -> None:
         key = wl.key
+        persistence = getattr(self.store, "persistence", None)
+        if persistence is not None:
+            # plan-entry intent before the store mutation, fenced like
+            # the host path's (scheduler._admit; docs/DURABILITY.md) —
+            # a drain killed mid-apply redoes the uncommitted suffix
+            # from the recovered backlog
+            persistence.intent("admit", key, rv=wl.resource_version,
+                               cycle=self._drain_cycle,
+                               cluster_queue=cq_name,
+                               detail={"path": "solver"})
         admission = Admission(
             cluster_queue=cq_name,
             podset_assignments=[
@@ -1395,6 +1425,7 @@ class SolverEngine:
             wl.set_condition(WorkloadConditionType.ADMITTED, True,
                              reason="Admitted", now=now)
         self.store.update_workload(wl)
+        persist_hooks.crash_if("mid_drain")
         self.queues.queues[cq_name].delete(key)
         if (self.queues.afs is not None
                 and cq_spec.admission_scope is not None

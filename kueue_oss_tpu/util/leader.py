@@ -81,11 +81,21 @@ class Replica:
     cache rebuild.
     """
 
-    def __init__(self, identity: str, scheduler, lease: Lease) -> None:
+    def __init__(self, identity: str, scheduler, lease: Lease,
+                 warm=None) -> None:
         self.identity = identity
         self.scheduler = scheduler
         self.lease = lease
         self.tracker = RoleTracker()
+        #: durability hook (docs/DURABILITY.md): called on every
+        #: follower->leader transition BEFORE the first scheduling pass,
+        #: so a cold replica (fresh process after the old leader died)
+        #: warms its store by checkpoint+WAL replay — typically
+        #: ``PersistenceManager.recover(store=..., emit=True)``, which
+        #: also streams the replay through the store's watchers so the
+        #: QueueManager heaps rebuild in the same pass. In-process
+        #: replicas sharing a watch-driven store leave it None.
+        self.warm = warm
 
     @property
     def is_leader(self) -> bool:
@@ -96,6 +106,10 @@ class Replica:
         """Renew/acquire the lease; schedule if leader. Returns cycles
         run (0 as follower)."""
         if self.lease.try_acquire(self.identity):
+            if self.tracker.role != LEADER and self.warm is not None:
+                # promoted: catch the store up to durable state before
+                # taking traffic
+                self.warm()
             self.tracker.set_role(LEADER)
             return self.scheduler.run_until_quiet(
                 now=now, max_cycles=max_cycles, tick=tick)

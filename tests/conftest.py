@@ -45,6 +45,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "sim: what-if engine tests (kueue_oss_tpu/sim/); "
         "deterministic, CPU-backend, runs in tier-1")
+    config.addinivalue_line(
+        "markers", "durability: durable-control-plane tests "
+        "(kueue_oss_tpu/persist/): WAL/checkpoint/recovery property "
+        "tests and the crash-point chaos suite (seeded subprocess "
+        "kill -9 + recover); deterministic, runs in tier-1")
 
 
 @pytest.fixture(scope="session")

@@ -99,6 +99,68 @@ def test_validate_rejects_bad_simulator_values():
     assert "simulator.minBatchForMesh" in joined
 
 
+def test_load_persistence_config():
+    cfg = load({"persistence": {
+        "enabled": True,
+        "dir": "/var/lib/kueue",
+        "fsync": "always",
+        "batchRecords": 128,
+        "checkpointIntervalRecords": 5000,
+        "checkpointInterval": 120.5,
+        "keepCheckpoints": 3,
+        "auditInterval": 60.0,
+        "auditAutoHeal": True,
+    }})
+    per = cfg.persistence
+    assert per.enabled is True
+    assert per.dir == "/var/lib/kueue"
+    assert per.fsync == "always"
+    assert per.batch_records == 128
+    assert per.checkpoint_interval_records == 5000
+    assert per.checkpoint_interval_seconds == 120.5
+    assert per.keep_checkpoints == 3
+    assert per.audit_interval_seconds == 60.0
+    assert per.audit_auto_heal is True
+    assert validate(cfg) == []
+    # defaults: durability is opt-in; group commit is the default policy
+    assert load({}).persistence.enabled is False
+    assert load({}).persistence.fsync == "batch"
+
+
+def test_validate_rejects_bad_persistence_values():
+    cfg = load({"persistence": {
+        "enabled": True,  # but no dir
+        "fsync": "sometimes",
+        "batchRecords": 0,
+        "checkpointIntervalRecords": 0,
+        "checkpointInterval": -1,
+        "keepCheckpoints": 0,
+        "auditInterval": -5,
+    }})
+    joined = "\n".join(validate(cfg))
+    assert "persistence.dir" in joined
+    assert "persistence.fsync" in joined
+    assert "persistence.batchRecords" in joined
+    assert "persistence.checkpointIntervalRecords" in joined
+    assert "persistence.checkpointInterval" in joined
+    assert "persistence.keepCheckpoints" in joined
+    assert "persistence.auditInterval" in joined
+
+
+def test_persistence_manager_from_config(tmp_path):
+    from kueue_oss_tpu.persist import PersistenceManager
+
+    cfg = load({"persistence": {
+        "enabled": True, "dir": str(tmp_path), "fsync": "off",
+        "keepCheckpoints": 4}})
+    mgr = PersistenceManager.from_config(cfg.persistence)
+    assert mgr.dir == str(tmp_path)
+    assert mgr.keep_checkpoints == 4
+    mgr.close()
+    with pytest.raises(ValueError):
+        PersistenceManager.from_config(load({}).persistence)
+
+
 def test_validate_rejects_bad_values():
     cfg = load({
         "waitForPodsReady": {"enable": True, "timeout": -5,

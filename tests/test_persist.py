@@ -1,0 +1,903 @@
+"""Durable control plane (kueue_oss_tpu/persist/, docs/DURABILITY.md).
+
+Covers the WAL/checkpoint/recovery subsystem end-to-end:
+
+1. codec fidelity: randomized stores round-trip byte-identically;
+2. checkpoint -> restore -> canonical dump byte-identical to source;
+3. WAL replay after truncation at EVERY record boundary converges to
+   the exact prefix state; torn (mid-frame) tails land on the floor;
+4. the Store mutation API surface vs emitted events — the WAL cannot
+   afford a silent mutation;
+5. intent fencing (applied vs crash-eaten decisions);
+6. the invariant auditor (clean store, corrupted index, auto-heal);
+7. the crash-point chaos suite: a subprocess control plane SIGKILLed
+   at each named point, recovered, and byte-compared against the
+   no-crash run (persist/crashtest.py);
+8. leader failover: a promoted Replica warms its store by replay
+   before taking traffic.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import random
+
+import pytest
+
+from kueue_oss_tpu import metrics, persist
+from kueue_oss_tpu.api.types import (
+    Admission,
+    AdmissionCheck,
+    AdmissionCheckState,
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    Node,
+    PodSet,
+    PodSetAssignment,
+    PodSetTopologyRequest,
+    PreemptionPolicy,
+    PreemptionPolicyValue,
+    RequeueState,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Taint,
+    Toleration,
+    Topology,
+    TopologyAssignment,
+    TopologyDomainAssignment,
+    Workload,
+    WorkloadConditionType,
+    WorkloadPriorityClass,
+    WorkloadSchedulingStatsEviction,
+)
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.persist import checkpoint as pckpt
+from kueue_oss_tpu.persist import hooks
+from kueue_oss_tpu.persist import wal as pwal
+
+pytestmark = pytest.mark.durability
+
+
+# ---------------------------------------------------------------------------
+# randomized store builder (pure Python — no scheduler, fast)
+# ---------------------------------------------------------------------------
+
+
+def _random_store(seed: int) -> Store:
+    rng = random.Random(seed)
+    store = Store()
+    flavors = [f"fl-{i}" for i in range(rng.randint(1, 3))]
+    for f in flavors:
+        store.upsert_resource_flavor(ResourceFlavor(
+            name=f,
+            node_labels={"pool": f} if rng.random() < 0.5 else {},
+            node_taints=[Taint(key="k", value="v")]
+            if rng.random() < 0.3 else [],
+            tolerations=[Toleration(key="k", operator="Exists")]
+            if rng.random() < 0.3 else []))
+    store.upsert_cohort(Cohort(name="root"))
+    store.upsert_cohort(Cohort(name="mid", parent="root"))
+    cqs = []
+    for i in range(rng.randint(1, 4)):
+        name = f"cq-{i}"
+        cqs.append(name)
+        store.upsert_cluster_queue(ClusterQueue(
+            name=name,
+            cohort=rng.choice([None, "root", "mid"]),
+            labels={"team": f"t{i}"},
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu", "memory"],
+                flavors=[FlavorQuotas(name=f, resources=[
+                    ResourceQuota(
+                        name="cpu", nominal=rng.randint(1, 64) * 1000,
+                        borrowing_limit=(rng.randint(0, 8) * 1000
+                                         if rng.random() < 0.4 else None),
+                        lending_limit=(rng.randint(0, 8) * 1000
+                                       if rng.random() < 0.3 else None)),
+                    ResourceQuota(name="memory",
+                                  nominal=rng.randint(1, 64) << 30),
+                ]) for f in rng.sample(flavors,
+                                       rng.randint(1, len(flavors)))])],
+            preemption=PreemptionPolicy(
+                within_cluster_queue=rng.choice([
+                    PreemptionPolicyValue.NEVER,
+                    PreemptionPolicyValue.LOWER_PRIORITY])),
+        ))
+        store.upsert_local_queue(LocalQueue(
+            name=f"lq-{i}", cluster_queue=name))
+    store.upsert_priority_class(WorkloadPriorityClass("high", 100))
+    store.upsert_admission_check(AdmissionCheck(
+        name="prov", controller_name="c", parameters={"p": "1"}))
+    for i in range(rng.randint(0, 3)):
+        store.upsert_node(Node(
+            name=f"node-{i}", labels={"zone": f"z{i % 2}"},
+            allocatable={"cpu": 64000}, ready=rng.random() < 0.9))
+    for i in range(rng.randint(2, 14)):
+        lq_i = rng.randrange(len(cqs))
+        wl = Workload(
+            name=f"wl-{i}", queue_name=f"lq-{lq_i}",
+            priority=rng.choice([0, 0, 50]),
+            priority_class=rng.choice([None, "high"]),
+            labels={"app": f"a{i % 3}"},
+            annotations=({"note": "x"} if rng.random() < 0.3 else {}),
+            uid=1000 + i, creation_time=float(rng.randint(0, 100)),
+            active=rng.random() < 0.95,
+            max_execution_time=(600.0 if rng.random() < 0.2 else None),
+            owner=(f"Job/default/j{i}" if rng.random() < 0.5 else None),
+            preemption_gates=(["gate"] if rng.random() < 0.1 else []),
+            podsets=[PodSet(
+                name="main", count=rng.randint(1, 4),
+                requests={"cpu": rng.randint(1, 4) * 500,
+                          "memory": rng.randint(1, 4) << 28},
+                min_count=(1 if rng.random() < 0.2 else None),
+                env=[("A", "1"), ("A", "2")]
+                if rng.random() < 0.3 else [],
+                topology_request=(PodSetTopologyRequest(
+                    required="kubernetes.io/hostname")
+                    if rng.random() < 0.2 else None))])
+        wl.resource_version = rng.randint(0, 5)
+        now = float(rng.randint(100, 200))
+        if rng.random() < 0.5:
+            fl = rng.choice(flavors)
+            wl.status.admission = Admission(
+                cluster_queue=cqs[lq_i],
+                podset_assignments=[PodSetAssignment(
+                    name="main", flavors={"cpu": fl, "memory": fl},
+                    # usage must equal the podset's total requests or
+                    # the auditor would (rightly) flag the admission
+                    resource_usage=dict(
+                        wl.podsets[0].total_requests()),
+                    count=wl.podsets[0].count,
+                    topology_assignment=(TopologyAssignment(
+                        levels=["kubernetes.io/hostname"],
+                        domains=[TopologyDomainAssignment(
+                            values=["node-0"], count=1)])
+                        if rng.random() < 0.3 else None))])
+            wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                             reason="QuotaReserved", now=now)
+            if rng.random() < 0.7:
+                wl.set_condition(WorkloadConditionType.ADMITTED, True,
+                                 reason="Admitted", now=now)
+            if rng.random() < 0.3:
+                wl.set_condition(WorkloadConditionType.FINISHED, True,
+                                 reason="JobFinished", now=now + 1)
+            if rng.random() < 0.3:
+                wl.status.admission_checks["prov"] = AdmissionCheckState(
+                    name="prov", state="Ready", retry_count=1)
+            wl.status.reclaimable_pods = (
+                {"main": 1} if rng.random() < 0.2 else {})
+        elif rng.random() < 0.4:
+            wl.set_condition(WorkloadConditionType.EVICTED, True,
+                             reason="Preempted", message="m", now=now)
+            wl.status.requeue_state = RequeueState(
+                count=rng.randint(1, 3), requeue_at=now + 30.0)
+            wl.status.eviction_stats = [WorkloadSchedulingStatsEviction(
+                reason="Preempted", count=1)]
+        store.add_workload(wl)
+    return store
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_codec_roundtrip_randomized(seed):
+    store = _random_store(seed)
+    d1 = persist.canonical_dump(store)
+    restored = persist.store_from_dict(json.loads(d1))
+    assert persist.canonical_dump(restored) == d1
+    # the rebuilt indexes match the restored objects' state
+    assert set(restored._admitted) == {
+        k for k, w in restored.workloads.items()
+        if w.is_quota_reserved and not w.is_finished}
+    assert restored._finished_counted == {
+        k for k, w in restored.workloads.items() if w.is_finished}
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_checkpoint_restore_byte_identical(seed, tmp_path):
+    store = _random_store(seed)
+    mgr = persist.PersistenceManager(str(tmp_path), fsync="off")
+    mgr.attach(store)
+    mgr.checkpoint()
+    mgr.close()
+    rr = persist.PersistenceManager(str(tmp_path), fsync="off").recover()
+    assert rr.checkpoint_id == 1
+    assert persist.canonical_dump(rr.store) == persist.canonical_dump(
+        store)
+
+
+def test_checkpoint_restore_mid_flight_scheduler_store(tmp_path):
+    # a real mid-flight store (admissions, evictions, parked entries)
+    # from the rebuild suite's scenario builder
+    from test_rebuild import _mid_flight
+
+    store, _queues, _sched = _mid_flight(5)
+    mgr = persist.PersistenceManager(str(tmp_path), fsync="off")
+    mgr.attach(store)
+    mgr.checkpoint()
+    mgr.close()
+    rr = persist.PersistenceManager(str(tmp_path), fsync="off").recover()
+    assert persist.canonical_dump(rr.store) == persist.canonical_dump(
+        store)
+
+
+# ---------------------------------------------------------------------------
+# WAL truncation properties
+# ---------------------------------------------------------------------------
+
+
+def _scripted_run(dir_path: str) -> Store:
+    """A store driven through upserts/updates/deletes with persistence
+    attached — a WAL of ~30 mixed records."""
+    store = Store()
+    mgr = persist.PersistenceManager(dir_path, fsync="off")
+    mgr.attach(store)
+    src = _random_store(99)
+    for cohort in src.cohorts.values():
+        store.upsert_cohort(cohort)
+    for rf in src.resource_flavors.values():
+        store.upsert_resource_flavor(rf)
+    for cq in src.cluster_queues.values():
+        store.upsert_cluster_queue(cq)
+    for lq in src.local_queues.values():
+        store.upsert_local_queue(lq)
+    for node in src.nodes.values():
+        store.upsert_node(node)
+    for wl in src.workloads.values():
+        store.add_workload(wl)
+    keys = sorted(store.workloads)
+    for key in keys[::3]:
+        wl = store.workloads[key]
+        wl.set_condition(WorkloadConditionType.FINISHED, True,
+                         reason="JobFinished", now=300.0)
+        store.update_workload(wl)
+    for key in keys[::5]:
+        store.delete_workload(key)
+    store.delete_node(next(iter(store.nodes), "none"))
+    mgr.flush()
+    mgr.close()
+    return store
+
+
+def test_wal_replay_truncated_at_every_record_boundary(tmp_path):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    final = _scripted_run(str(run_dir))
+    wal_path = os.path.join(str(run_dir), "wal-00000000.log")
+    records, torn = pwal.replay_wal(wal_path)
+    assert not torn and len(records) >= 20
+
+    # expected state after each record prefix, built incrementally
+    expected = []
+    prefix_store = Store()
+    for rec in records:
+        persist.apply_event(prefix_store, rec["verb"], rec["kind"],
+                            rec["obj"])
+        expected.append(persist.canonical_dump(prefix_store))
+    assert expected[-1] == persist.canonical_dump(final)
+
+    frames = list(pwal.iter_frames(wal_path))
+    blob = open(wal_path, "rb").read()
+    trunc_dir = tmp_path / "trunc"
+    for k, (off, length) in enumerate(frames):
+        trunc_dir.mkdir(exist_ok=True)
+        with open(trunc_dir / "wal-00000000.log", "wb") as f:
+            f.write(blob[:off + length])
+        rr = persist.PersistenceManager(str(trunc_dir),
+                                        fsync="off").recover()
+        assert persist.canonical_dump(rr.store) == expected[k], (
+            f"replay diverged at record boundary {k}")
+        assert not rr.torn_tail
+        shutil.rmtree(trunc_dir)
+
+
+def test_wal_replay_torn_mid_frame_lands_on_floor(tmp_path):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _scripted_run(str(run_dir))
+    wal_path = os.path.join(str(run_dir), "wal-00000000.log")
+    records, _ = pwal.replay_wal(wal_path)
+    frames = list(pwal.iter_frames(wal_path))
+    blob = open(wal_path, "rb").read()
+    rng = random.Random(7)
+    for _ in range(12):
+        k = rng.randrange(1, len(frames))
+        off, length = frames[k]
+        cut = off + rng.randrange(1, length)  # strictly inside frame k
+        torn_dir = tmp_path / "torn"
+        torn_dir.mkdir()
+        with open(torn_dir / "wal-00000000.log", "wb") as f:
+            f.write(blob[:cut])
+        got, torn = pwal.replay_wal(str(torn_dir / "wal-00000000.log"))
+        assert torn and len(got) == k
+        rr = persist.PersistenceManager(str(torn_dir),
+                                        fsync="off").recover()
+        assert rr.torn_tail and rr.replayed_events <= k
+        shutil.rmtree(torn_dir)
+
+
+def test_wal_reopen_truncates_torn_tail_before_appending(tmp_path):
+    path = str(tmp_path / "wal.log")
+    w = pwal.WriteAheadLog(path, fsync="off")
+    w.append({"a": 1})
+    w.close()
+    with open(path, "ab") as f:
+        f.write(b"KW\x01garbage-torn-frame")
+    w2 = pwal.WriteAheadLog(path, fsync="off")
+    assert w2.truncated_bytes > 0
+    w2.append({"b": 2})
+    w2.close()
+    records, torn = pwal.replay_wal(path)
+    assert [r for r in records] == [{"a": 1}, {"b": 2}] and not torn
+
+
+# ---------------------------------------------------------------------------
+# Store._emit coverage: the mutation API surface vs emitted events
+# ---------------------------------------------------------------------------
+
+
+def test_every_store_mutator_emits_exactly_one_event():
+    """Diff the Store mutation API surface against emitted verbs: a
+    mutator added without an event would silently starve the WAL, so
+    an unknown mutator name FAILS this test until a recipe (and its
+    emit) exists."""
+    surface = {
+        n for n in dir(Store)
+        if n.startswith(("upsert_", "delete_", "add_", "update_"))
+        and callable(getattr(Store, n))}
+
+    wl = Workload(name="w", queue_name="lq", uid=1)
+    fin = Workload(name="fin", queue_name="lq", uid=2)
+    recipes = {
+        "upsert_cluster_queue": lambda s: s.upsert_cluster_queue(
+            ClusterQueue(name="cq")),
+        "delete_cluster_queue": lambda s: s.delete_cluster_queue("cq"),
+        "upsert_cohort": lambda s: s.upsert_cohort(Cohort(name="c")),
+        "upsert_local_queue": lambda s: s.upsert_local_queue(
+            LocalQueue(name="lq", cluster_queue="cq")),
+        "delete_local_queue": lambda s: s.delete_local_queue(
+            "default/lq"),
+        "upsert_resource_flavor": lambda s: s.upsert_resource_flavor(
+            ResourceFlavor(name="f")),
+        "upsert_topology": lambda s: s.upsert_topology(
+            Topology(name="t")),
+        "upsert_admission_check": lambda s: s.upsert_admission_check(
+            AdmissionCheck(name="ac")),
+        "upsert_priority_class": lambda s: s.upsert_priority_class(
+            WorkloadPriorityClass(name="p", value=1)),
+        "upsert_node": lambda s: s.upsert_node(Node(name="n")),
+        "delete_node": lambda s: s.delete_node("n"),
+        "add_workload": lambda s: s.add_workload(wl),
+        "update_workload": lambda s: s.update_workload(wl),
+        "update_workload_if": lambda s: s.update_workload_if(
+            wl, wl.resource_version),
+        "delete_workload": lambda s: s.delete_workload("default/w"),
+    }
+    assert set(recipes) == surface, (
+        "Store mutation surface changed; update the recipe table AND "
+        "make sure the new mutator emits exactly one event "
+        f"(missing: {sorted(surface ^ set(recipes))})")
+
+    store = Store()
+    events = []
+    store.watch(events.append)
+    for name in recipes:  # dict order = the valid call sequence above
+        before = len(events)
+        recipes[name](store)
+        got = events[before:]
+        assert len(got) == 1, (
+            f"{name} emitted {len(got)} events; the WAL needs exactly 1")
+        verb, kind, _obj = got[0]
+        assert kind in persist.codec.KINDS, (
+            f"{name} emitted kind {kind!r} the durability codec cannot "
+            "serialize")
+        expected_verb = ("delete" if name.startswith("delete_")
+                         else "add" if name == "add_workload"
+                         else verb)
+        assert verb == expected_verb
+
+    # the FINISHED transition tracked by _track_finished rides the one
+    # update event — no extra emission, no missed one
+    before = len(events)
+    store.add_workload(fin)
+    fin.set_condition(WorkloadConditionType.FINISHED, True,
+                      reason="JobFinished", now=1.0)
+    store.update_workload(fin)
+    assert len(events) - before == 2
+    # deleting a missing object mutates nothing and must emit nothing
+    before = len(events)
+    store.delete_workload("default/never-existed")
+    store.delete_node("never-existed")
+    store.delete_cluster_queue("never-existed")
+    store.delete_local_queue("default/never-existed")
+    assert len(events) == before
+
+
+# ---------------------------------------------------------------------------
+# intent fencing
+# ---------------------------------------------------------------------------
+
+
+def test_intent_fencing_applied_vs_lost(tmp_path):
+    store = Store()
+    mgr = persist.PersistenceManager(str(tmp_path), fsync="off")
+    mgr.attach(store)
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    wl = Workload(name="w", queue_name="lq", uid=5)
+    store.add_workload(wl)  # rv -> 1
+
+    # applied decision: intent at rv, event lands at rv+1
+    mgr.intent("admit", wl.key, rv=wl.resource_version, cycle=1)
+    wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                     reason="QuotaReserved", now=1.0)
+    store.update_workload(wl)  # rv -> 2
+    # lost decision: intent whose mutation never happened
+    mgr.intent("evict", wl.key, rv=wl.resource_version, cycle=2)
+    mgr.flush()
+    mgr.close()
+
+    rr = persist.PersistenceManager(str(tmp_path), fsync="off").recover()
+    assert rr.replayed_intents == 2
+    assert rr.unapplied_intents == 1
+    assert rr.fence_violations == 0
+    assert rr.store.workloads["default/w"].is_quota_reserved
+
+
+def test_intent_fence_violation_detected(tmp_path):
+    store = Store()
+    mgr = persist.PersistenceManager(str(tmp_path), fsync="off")
+    mgr.attach(store)
+    wl = Workload(name="w", queue_name="lq", uid=5)
+    store.add_workload(wl)  # rv 1
+    mgr.intent("admit", wl.key, rv=wl.resource_version)
+    store.update_workload(wl)  # rv 2: fence honored
+    mgr.intent("admit", wl.key, rv=0)  # stale fence
+    store.update_workload(wl)  # rv 3 != 0+1: violation
+    mgr.flush()
+    mgr.close()
+    rr = persist.PersistenceManager(str(tmp_path), fsync="off").recover()
+    assert rr.fence_violations == 1
+
+
+# ---------------------------------------------------------------------------
+# invariant auditor
+# ---------------------------------------------------------------------------
+
+
+def _admitted_store() -> Store:
+    store = _random_store(3)
+    # make sure at least one workload is genuinely admitted
+    if not store._admitted:
+        wl = next(iter(store.workloads.values()))
+        wl.status.admission = Admission(
+            cluster_queue=next(iter(store.cluster_queues)),
+            podset_assignments=[PodSetAssignment(
+                name="main",
+                flavors={"cpu": "fl-0", "memory": "fl-0"},
+                resource_usage=dict(wl.podsets[0].total_requests()),
+                count=wl.podsets[0].count)])
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                         reason="QuotaReserved", now=1.0)
+        store.update_workload(wl)
+    return store
+
+
+def test_auditor_clean_on_healthy_store():
+    aud = persist.InvariantAuditor(_admitted_store())
+    assert aud.audit() == []
+    assert metrics.invariant_last_violations.value() == 0
+
+
+def test_auditor_detects_and_heals_index_drift():
+    store = _admitted_store()
+    dropped = next(iter(store._admitted))
+    store._admitted.pop(dropped)  # simulated index corruption
+    aud = persist.InvariantAuditor(store)
+    v0 = metrics.invariant_violations_total.value("admitted_index")
+    violations = aud.audit()
+    checks = {v.check for v in violations}
+    assert "admitted_index" in checks and "usage_mismatch" in checks
+    assert metrics.invariant_violations_total.value(
+        "admitted_index") > v0
+    aud.auto_heal = True
+    assert aud.audit() == []
+    assert aud.heals_run == 1
+    assert dropped in store._admitted
+
+
+def test_auditor_detects_finished_tracking_drift():
+    store = _admitted_store()
+    store._finished_counted.add("default/ghost-finished")
+    aud = persist.InvariantAuditor(store, auto_heal=True)
+    # auto-heal rebuilds, then the re-audit is clean
+    assert aud.audit() == []
+    assert "default/ghost-finished" not in store._finished_counted
+
+
+def test_auditor_confirmed_two_pass():
+    store = _admitted_store()
+    aud = persist.InvariantAuditor(store)
+    assert aud.audit_confirmed() == []
+    # persistent drift survives both passes and is reported
+    dropped = next(iter(store._admitted))
+    store._admitted.pop(dropped)
+    assert {v.check for v in aud.audit_confirmed()} >= {
+        "admitted_index"}
+    # a phantom that resolves between the passes is NOT reported: heal
+    # the store as a side effect of the first pass
+    store._admitted.pop(next(iter(store._admitted)), None)
+    real_audit = aud._audit_locked
+
+    calls = {"n": 0}
+
+    def flaky_audit():
+        calls["n"] += 1
+        out = real_audit()
+        if calls["n"] == 1:
+            from kueue_oss_tpu.persist.codec import rebuild_indexes
+
+            rebuild_indexes(store)  # "the in-flight write lands"
+        return out
+
+    aud._audit_locked = flaky_audit
+    assert aud.audit_confirmed() == []
+
+
+def test_auditor_background_thread_runs_and_stops():
+    aud = persist.InvariantAuditor(_admitted_store())
+    aud.start(interval_s=0.01)
+    deadline = 50
+    while aud.audits_run == 0 and deadline:
+        import time
+
+        time.sleep(0.01)
+        deadline -= 1
+    aud.stop()
+    assert aud.audits_run >= 1
+    assert aud.last_violations == []
+
+
+# ---------------------------------------------------------------------------
+# crash-point chaos suite (subprocess kill -9 + recover)
+# ---------------------------------------------------------------------------
+
+_DRIVER = [sys.executable, "-m", "kueue_oss_tpu.persist.crashtest"]
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_driver(dir_path: str, phase: str, env_extra=None,
+                solver: bool = False):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("KUEUE_SOLVER_SOCKET", None)
+    env.update(env_extra or {})
+    cmd = _DRIVER + ["--dir", dir_path, "--phase", phase]
+    if solver:
+        cmd.append("--solver")
+    return subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=_REPO, timeout=240)
+
+
+@pytest.fixture(scope="module")
+def baseline_dump(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("crash-baseline"))
+    proc = _run_driver(d, "run")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return open(os.path.join(d, "final.dump"), "rb").read()
+
+
+@pytest.mark.parametrize("point,after", [
+    ("pre_fsync", 12),
+    ("torn_tail", 20),
+    ("post_fsync_pre_apply", 6),
+    ("mid_checkpoint", 0),
+])
+def test_crash_point_recovery_byte_identical(point, after, tmp_path,
+                                             baseline_dump):
+    from kueue_oss_tpu.chaos import CrashPointInjector
+
+    d = str(tmp_path)
+    crash = _run_driver(d, "run",
+                        env_extra=CrashPointInjector(point, after).env())
+    assert crash.returncode == -9, (
+        f"{point}: expected SIGKILL, got rc={crash.returncode}\n"
+        f"{crash.stderr[-1500:]}")
+    rec = _run_driver(d, "recover")
+    assert rec.returncode == 0, rec.stderr[-2000:]
+    status = json.loads(rec.stdout.strip().splitlines()[-1])
+    assert status["audit_violations"] == []
+    if point == "torn_tail":
+        assert status["torn_tail"] is True
+    got = open(os.path.join(d, "final.dump"), "rb").read()
+    assert got == baseline_dump, (
+        f"{point}: recovered end state diverged from the no-crash run "
+        f"({status})")
+
+
+@pytest.fixture(scope="module")
+def baseline_dump_solver(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("crash-baseline-solver"))
+    proc = _run_driver(d, "run", solver=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    status = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert status["session_first_frame_sync"]
+    return open(os.path.join(d, "final.dump"), "rb").read()
+
+
+def test_crash_mid_drain_recovery_and_session_resync(
+        tmp_path, baseline_dump_solver):
+    """kill -9 after the third committed solver-plan admission; the
+    recovered control plane must RESYNC its sessions (first frame a
+    full SYNC — resident device state is gone by design), finish the
+    scenario, and land byte-identical to the no-crash solver run."""
+    from kueue_oss_tpu.chaos import CrashPointInjector
+
+    d = str(tmp_path)
+    crash = _run_driver(
+        d, "run", solver=True,
+        env_extra=CrashPointInjector("mid_drain", after=2).env())
+    assert crash.returncode == -9, crash.stderr[-1500:]
+    rec = _run_driver(d, "recover", solver=True)
+    assert rec.returncode == 0, rec.stderr[-2000:]
+    status = json.loads(rec.stdout.strip().splitlines()[-1])
+    assert status["session_first_frame_sync"], status
+    assert status["audit_violations"] == []
+    got = open(os.path.join(d, "final.dump"), "rb").read()
+    assert got == baseline_dump_solver
+
+
+def test_recover_over_completed_run_is_noop(tmp_path, baseline_dump):
+    d = str(tmp_path)
+    proc = _run_driver(d, "run")
+    assert proc.returncode == 0
+    rec = _run_driver(d, "recover")
+    assert rec.returncode == 0, rec.stderr[-2000:]
+    assert open(os.path.join(d, "final.dump"),
+                "rb").read() == baseline_dump
+
+
+# ---------------------------------------------------------------------------
+# leader failover: warm by replay before taking traffic
+# ---------------------------------------------------------------------------
+
+
+def test_promoted_replica_warms_store_by_replay(tmp_path):
+    from kueue_oss_tpu.core.queue_manager import QueueManager
+    from kueue_oss_tpu.persist.crashtest import (
+        BATCH_A,
+        ensure_batch,
+        ensure_objects,
+    )
+    from kueue_oss_tpu.scheduler.scheduler import Scheduler
+    from kueue_oss_tpu.util.leader import Lease, Replica
+
+    # leader A: persistence attached, admits a batch, then dies
+    mgr_a = persist.PersistenceManager(str(tmp_path), fsync="off")
+    store_a = Store()
+    mgr_a.attach(store_a)
+    sched_a = Scheduler(store_a, QueueManager(store_a))
+    clock = [0.0]
+    lease = Lease(duration_s=10.0, clock=lambda: clock[0])
+    rep_a = Replica("a", sched_a, lease)
+    ensure_objects(store_a)
+    ensure_batch(store_a, BATCH_A)
+    assert rep_a.tick(now=20.0) > 0 and rep_a.is_leader
+    mgr_a.flush()
+    mgr_a.close()
+    dump_a = persist.canonical_dump(store_a)
+    admitted_a = {k for k, w in store_a.workloads.items()
+                  if w.is_quota_reserved}
+    assert admitted_a  # the scenario admits
+
+    # replica B: fresh process — empty store, warm-by-replay hook
+    store_b = Store()
+    queues_b = QueueManager(store_b)
+    sched_b = Scheduler(store_b, queues_b)
+    mgr_b = persist.PersistenceManager(str(tmp_path), fsync="off")
+    warmed = []
+
+    def warm():
+        rr = mgr_b.recover(store=store_b, emit=True)
+        mgr_b.attach(store_b)
+        warmed.append(rr)
+
+    rep_b = Replica("b", sched_b, lease, warm=warm)
+    clock[0] = 100.0  # A's lease expired (A is dead)
+    rep_b.tick(now=100.0)
+    assert rep_b.is_leader and len(warmed) == 1
+    assert persist.canonical_dump(store_b) == dump_a
+    # warm streamed through the watchers: the queue manager knows the
+    # CQs and has no stale pending state for admitted workloads
+    assert set(queues_b.queues) == set(store_b.cluster_queues)
+
+    # the promoted leader takes NEW traffic and keeps logging it
+    # (finish one recovered admission first — batch A fills both CQs)
+    sched_b.finish_workload("default/a0", now=100.5)
+    wl = Workload(name="post-failover", queue_name="lq-a", uid=777,
+                  creation_time=100.0,
+                  podsets=[PodSet(name="main", count=1,
+                                  requests={"cpu": 1000})])
+    store_b.add_workload(wl)
+    rep_b.tick(now=101.0)
+    assert len(warmed) == 1  # warm fires on PROMOTION, not every tick
+    assert store_b.workloads["default/post-failover"].is_quota_reserved
+    mgr_b.flush()
+    mgr_b.close()
+    rr2 = persist.PersistenceManager(str(tmp_path), fsync="off").recover()
+    assert rr2.store.workloads[
+        "default/post-failover"].is_quota_reserved
+
+
+def test_warm_sync_deletes_objects_absent_from_durable_state(tmp_path):
+    """A re-promoted ex-leader may hold objects deleted while it was a
+    follower; warming must remove them, not just upsert on top."""
+    store = Store()
+    mgr = persist.PersistenceManager(str(tmp_path), fsync="off")
+    mgr.attach(store)
+    store.upsert_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    store.add_workload(Workload(name="kept", queue_name="lq", uid=1))
+    mgr.checkpoint()
+    mgr.flush()
+    mgr.close()
+
+    # stale replica: holds an extra workload and node durable state
+    # never saw (or saw deleted)
+    stale = Store()
+    deletes = []
+    stale.watch(lambda ev: deletes.append(ev) if ev[0] == "delete"
+                else None)
+    stale.add_workload(Workload(name="ghost", queue_name="lq", uid=9))
+    stale.upsert_node(Node(name="ghost-node"))
+    mgr2 = persist.PersistenceManager(str(tmp_path), fsync="off")
+    rr = mgr2.recover(store=stale, emit=True)
+    mgr2.close()
+    assert rr.store is stale
+    assert "default/ghost" not in stale.workloads
+    assert "ghost-node" not in stale.nodes
+    assert "default/kept" in stale.workloads
+    assert {(v, k) for v, k, _ in deletes} == {
+        ("delete", "Workload"), ("delete", "Node")}
+    assert persist.canonical_dump(stale) == persist.canonical_dump(
+        persist.PersistenceManager(str(tmp_path), fsync="off")
+        .recover().store)
+
+
+def test_apply_event_stale_delete_dropped():
+    """A delete record that raced a newer re-insert on the emit path
+    must lose to the newer state, like stale updates do."""
+    store = Store()
+    wl = Workload(name="w", queue_name="lq", uid=1)
+    store.add_workload(wl)  # rv 1
+    old = persist.to_dict(wl)  # deletion-time state at rv 1
+    store.update_workload(wl)  # re-insert bumped to rv 2
+    assert not persist.apply_event(store, "delete", "Workload", old)
+    assert "default/w" in store.workloads
+    # a delete carrying the newest rv applies normally
+    assert persist.apply_event(store, "delete", "Workload",
+                               persist.to_dict(wl))
+    assert "default/w" not in store.workloads
+
+
+# ---------------------------------------------------------------------------
+# satellites: obs dir fsync, session reset, checkpoint corruption
+# ---------------------------------------------------------------------------
+
+
+def test_obs_dump_jsonl_fsyncs_directory(tmp_path, monkeypatch):
+    from kueue_oss_tpu import obs
+    from kueue_oss_tpu.util import fsutil
+
+    calls = []
+    monkeypatch.setattr(fsutil, "fsync_dir",
+                        lambda d: calls.append(d))
+    rec = obs.FlightRecorder(max_events=16)
+    rec.record(obs.ASSIGNED, "default/w", cycle=1)
+    path = tmp_path / "journal.jsonl"
+    assert rec.dump_jsonl(str(path)) == 1
+    assert calls == [str(tmp_path)]
+    assert len(obs.load_jsonl(str(path))) == 1
+
+
+def test_engine_reset_sessions_forces_resync():
+    from kueue_oss_tpu.core.queue_manager import QueueManager
+    from kueue_oss_tpu.solver.engine import SolverEngine
+
+    store = Store()
+    engine = SolverEngine(store, QueueManager(store))
+    engine._delta_sessions["lean"] = object()
+    engine._device_states["lean"] = object()
+    before = metrics.solver_resync_total.value("restart")
+    engine.reset_sessions(reason="restart")
+    assert engine._delta_sessions == {} and engine._device_states == {}
+    assert metrics.solver_resync_total.value("restart") == before + 1
+    # idempotent: nothing resident -> no spurious resync count
+    engine.reset_sessions(reason="restart")
+    assert metrics.solver_resync_total.value("restart") == before + 1
+
+
+def test_recovery_skips_corrupt_checkpoint_falls_back(tmp_path):
+    store = _random_store(4)
+    mgr = persist.PersistenceManager(str(tmp_path), fsync="off")
+    mgr.attach(store)
+    mgr.checkpoint()  # checkpoint-1 (valid)
+    wl = Workload(name="late", queue_name="lq-0", uid=4242)
+    store.add_workload(wl)
+    mgr.checkpoint()  # checkpoint-2
+    mgr.close()
+    # corrupt the newest checkpoint's payload
+    path = pckpt.checkpoint_path(str(tmp_path), 2)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-10] + b"XXXXXXXXXX")
+    rr = persist.PersistenceManager(str(tmp_path), fsync="off").recover()
+    # fell back to checkpoint-1 + its WAL segment, which still carries
+    # the late add — no data lost, just a longer replay
+    assert rr.checkpoint_id == 1
+    assert "default/late" in rr.store.workloads
+    assert persist.canonical_dump(rr.store) == persist.canonical_dump(
+        store)
+
+
+def test_wal_only_recovery_advances_uid_floor(tmp_path):
+    """A fresh process recovering from the WAL alone (no checkpoint)
+    must not re-issue recovered uids: queue-order tie-breaks and
+    session slots key on uid."""
+    store = Store()
+    mgr = persist.PersistenceManager(str(tmp_path), fsync="off")
+    mgr.attach(store)
+    for i in range(5):
+        store.add_workload(Workload(name=f"w{i}", queue_name="lq",
+                                    uid=0))  # auto-assigned uids
+    mgr.flush()
+    mgr.close()
+    max_uid = max(wl.uid for wl in store.workloads.values())
+    rr = persist.PersistenceManager(str(tmp_path), fsync="off").recover()
+    fresh = Workload(name="fresh", queue_name="lq", uid=0)
+    assert fresh.uid > max_uid, (
+        f"recovery re-issued uid {fresh.uid} (recovered max {max_uid})")
+    recovered_uids = {wl.uid for wl in rr.store.workloads.values()}
+    assert fresh.uid not in recovered_uids
+
+
+def test_from_config_starts_background_auditor(tmp_path):
+    from kueue_oss_tpu.config.configuration import PersistenceConfig
+
+    cfg = PersistenceConfig(enabled=True, dir=str(tmp_path),
+                            fsync="off", audit_interval_seconds=0.01,
+                            audit_auto_heal=True)
+    mgr = persist.PersistenceManager.from_config(cfg)
+    store = _admitted_store()
+    mgr.attach(store)
+    assert mgr.auditor is not None and mgr.auditor.auto_heal
+    import time
+
+    deadline = 100
+    while mgr.auditor.audits_run == 0 and deadline:
+        time.sleep(0.01)
+        deadline -= 1
+    mgr.close()
+    assert mgr.auditor.audits_run >= 1
+    assert mgr.auditor.last_violations == []
+    # interval 0 (the default) must NOT start a thread
+    mgr2 = persist.PersistenceManager(str(tmp_path), fsync="off")
+    mgr2.attach(Store())
+    assert mgr2.auditor is None
+    mgr2.close()
+
+
+def test_crash_point_raise_mode_in_process(tmp_path):
+    from kueue_oss_tpu.chaos import CrashPointInjector
+
+    store = Store()
+    mgr = persist.PersistenceManager(str(tmp_path), fsync="always")
+    mgr.attach(store)
+    with CrashPointInjector("post_fsync_pre_apply", mode="raise"):
+        with pytest.raises(hooks.CrashPoint):
+            mgr.intent("admit", "default/w", rv=0)
+    # the intent IS durable; the "mutation" never happened
+    mgr.close()
+    rr = persist.PersistenceManager(str(tmp_path),
+                                    fsync="off").recover()
+    assert rr.replayed_intents == 1 and rr.unapplied_intents == 1
