@@ -229,6 +229,51 @@ class SimulatorConfig:
 
 
 @dataclass
+class SLOConfig:
+    """Queue-wait SLO objectives (kueue_oss_tpu/obs/health.py,
+    docs/OBSERVABILITY.md "Cluster health & SLOs").
+
+    The SLI is time-to-admit: an admission is good when its
+    creation→quota-reservation wait is within the threshold; alerts
+    use multi-window burn rates over the fast/slow windows.
+    """
+
+    #: fraction of admissions that must land within the threshold
+    queue_wait_target: float = 0.99
+    #: "good" admission bound, seconds from creation to quota reserve
+    queue_wait_threshold_seconds: float = 300.0
+    #: fast burn window (catches live regressions)
+    fast_window_seconds: float = 300.0
+    #: slow burn window (suppresses blips)
+    slow_window_seconds: float = 3600.0
+    #: alert fires when BOTH windows burn above this; clears when the
+    #: fast window recovers
+    burn_rate_threshold: float = 6.0
+    #: starvation watchdog: oldest-pending age per CQ above this is
+    #: flagged starved regardless of burn rates
+    starvation_threshold_seconds: float = 1800.0
+
+
+@dataclass
+class ObservabilityConfig:
+    """Cluster health layer switches (kueue_oss_tpu/obs/):
+    flight recorder, cycle ledger, histogram exemplars, SLO engine.
+    Applied to the process-wide obs state via ``obs.configure``."""
+
+    #: decision flight recorder (PR 4) master switch
+    recorder_enabled: bool = True
+    #: per-cycle ledger rows (obs/ledger.py)
+    ledger_enabled: bool = True
+    #: ledger ring capacity (newest rows kept)
+    ledger_max_cycles: int = 4096
+    #: exemplars on the wait-time histograms (OpenMetrics exposition)
+    exemplars: bool = True
+    #: queue-wait SLI feeding + burn-rate alerting
+    slo_enabled: bool = True
+    slo: SLOConfig = field(default_factory=SLOConfig)
+
+
+@dataclass
 class Configuration:
     """Reference parity: configuration_types.go Configuration."""
 
@@ -250,6 +295,8 @@ class Configuration:
     simulator: SimulatorConfig = field(default_factory=SimulatorConfig)
     persistence: PersistenceConfig = field(
         default_factory=PersistenceConfig)
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig)
     feature_gates: dict[str, bool] = field(default_factory=dict)
     #: TLS options for the HTTP servers (reference: Configuration.TLS,
     #: applied in config.go:182-190 under the TLSOptions gate)
@@ -343,6 +390,24 @@ def validate(cfg: Configuration) -> list[str]:
         errs.append("persistence.keepCheckpoints must be >= 1")
     if per.audit_interval_seconds < 0:
         errs.append("persistence.auditInterval must be >= 0")
+    ob = cfg.observability
+    if ob.ledger_max_cycles < 1:
+        errs.append("observability.ledgerMaxCycles must be >= 1")
+    slo = ob.slo
+    if not (0.0 < slo.queue_wait_target <= 1.0):
+        errs.append("observability.slo.queueWaitTarget must be in "
+                    "(0, 1]")
+    if slo.queue_wait_threshold_seconds <= 0:
+        errs.append("observability.slo.queueWaitThreshold must be > 0")
+    if slo.fast_window_seconds <= 0:
+        errs.append("observability.slo.fastWindow must be > 0")
+    if slo.slow_window_seconds < slo.fast_window_seconds:
+        errs.append("observability.slo.slowWindow must be >= fastWindow")
+    if slo.burn_rate_threshold <= 0:
+        errs.append("observability.slo.burnRateThreshold must be > 0")
+    if slo.starvation_threshold_seconds < 0:
+        errs.append("observability.slo.starvationThreshold must be "
+                    ">= 0")
     afs = cfg.admission_fair_sharing
     if afs is not None:
         if afs.usage_half_life_time_seconds < 0:
@@ -484,6 +549,28 @@ def load(data: Optional[dict] = None) -> Configuration:
             "auditAutoHeal": ("audit_auto_heal", None),
         })
 
+    def conv_slo(d: dict) -> SLOConfig:
+        return _build(SLOConfig, d, {
+            "queueWaitTarget": ("queue_wait_target", float),
+            "queueWaitThreshold": (
+                "queue_wait_threshold_seconds", float),
+            "fastWindow": ("fast_window_seconds", float),
+            "slowWindow": ("slow_window_seconds", float),
+            "burnRateThreshold": ("burn_rate_threshold", float),
+            "starvationThreshold": (
+                "starvation_threshold_seconds", float),
+        })
+
+    def conv_obs(d: dict) -> ObservabilityConfig:
+        return _build(ObservabilityConfig, d, {
+            "recorderEnabled": ("recorder_enabled", None),
+            "ledgerEnabled": ("ledger_enabled", None),
+            "ledgerMaxCycles": ("ledger_max_cycles", int),
+            "exemplars": ("exemplars", None),
+            "sloEnabled": ("slo_enabled", None),
+            "slo": ("slo", conv_slo),
+        })
+
     def conv_sim(d: dict) -> SimulatorConfig:
         return _build(SimulatorConfig, d, {
             "maxScenarios": ("max_scenarios", int),
@@ -517,6 +604,7 @@ def load(data: Optional[dict] = None) -> Configuration:
         "solver": ("solver", conv_solver),
         "simulator": ("simulator", conv_sim),
         "persistence": ("persistence", conv_persist),
+        "observability": ("observability", conv_obs),
         "featureGates": ("feature_gates", dict),
         "tls": ("tls", conv_tls),
     })

@@ -81,46 +81,117 @@ class SamplingProfiler:
                  max_depth: int = 40) -> None:
         self.interval = interval
         self.max_depth = max_depth
+        self._lock = threading.Lock()
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+        #: a fixed-window sample_for() is in flight (distinct from the
+        #: background-session _thread; both exclude each other)
+        self._busy = False
+        self._leaf_counts: dict[str, int] = {}
+        self._stack_counts: dict[tuple, int] = {}
+        self._samples = 0
+        self._started_at = 0.0
 
-    def sample_for(self, seconds: float, top: int = 30) -> str:
-        me = threading.get_ident()
-        leaf_counts: dict[str, int] = {}
-        stack_counts: dict[tuple, int] = {}
-        samples = 0
-        end = time.monotonic() + seconds
-        while time.monotonic() < end:
-            for tid, frame in sys._current_frames().items():
-                if tid == me:
-                    continue
-                stack = []
-                f = frame
-                while f is not None and len(stack) < self.max_depth:
-                    code = f.f_code
-                    stack.append(
-                        f"{code.co_name} "
-                        f"({code.co_filename.rsplit('/', 1)[-1]}"
-                        f":{f.f_lineno})")
-                    f = f.f_back
-                if not stack:
-                    continue
-                samples += 1
-                leaf_counts[stack[0]] = leaf_counts.get(stack[0], 0) + 1
-                key = tuple(reversed(stack))
-                stack_counts[key] = stack_counts.get(key, 0) + 1
-            time.sleep(self.interval)
-        lines = [f"{samples} samples over {seconds:.2f}s "
+    @property
+    def running(self) -> bool:
+        return self._thread is not None
+
+    def _sample_once(self, skip_tids: set) -> None:
+        for tid, frame in sys._current_frames().items():
+            if tid in skip_tids:
+                continue
+            stack = []
+            f = frame
+            while f is not None and len(stack) < self.max_depth:
+                code = f.f_code
+                stack.append(
+                    f"{code.co_name} "
+                    f"({code.co_filename.rsplit('/', 1)[-1]}"
+                    f":{f.f_lineno})")
+                f = f.f_back
+            if not stack:
+                continue
+            self._samples += 1
+            self._leaf_counts[stack[0]] = (
+                self._leaf_counts.get(stack[0], 0) + 1)
+            key = tuple(reversed(stack))
+            self._stack_counts[key] = self._stack_counts.get(key, 0) + 1
+
+    def _report(self, seconds: float, top: int) -> str:
+        lines = [f"{self._samples} samples over {seconds:.2f}s "
                  f"({self.interval * 1000:.0f}ms interval)", "",
                  "top functions (leaf samples):"]
-        for name, n in sorted(leaf_counts.items(),
+        for name, n in sorted(self._leaf_counts.items(),
                               key=lambda kv: -kv[1])[:top]:
             lines.append(f"  {n:6d}  {name}")
         lines += ["", "top stacks:"]
-        for stack, n in sorted(stack_counts.items(),
+        for stack, n in sorted(self._stack_counts.items(),
                                key=lambda kv: -kv[1])[:5]:
             lines.append(f"  {n:6d} samples:")
             for fr in stack[-10:]:
                 lines.append(f"          {fr}")
         return "\n".join(lines)
+
+    def _reset(self) -> None:
+        self._leaf_counts = {}
+        self._stack_counts = {}
+        self._samples = 0
+
+    def sample_for(self, seconds: float, top: int = 30) -> str:
+        """Blocking window: sample every thread but this one for
+        ``seconds``, return the aggregated report. The lock guards only
+        the admission check — holding it across the window would make
+        concurrent start/stop requests block for ``seconds`` and then
+        run anyway, instead of failing fast with the 409 the endpoints
+        promise."""
+        with self._lock:
+            if self._thread is not None or self._busy:
+                raise RuntimeError(
+                    "a sampling session is active; stop it "
+                    "first (/debug/pprof/sample/stop)")
+            self._busy = True
+            self._reset()
+        try:
+            me = {threading.get_ident()}
+            end = time.monotonic() + seconds
+            while time.monotonic() < end:
+                self._sample_once(me)
+                time.sleep(self.interval)
+            return self._report(seconds, top)
+        finally:
+            self._busy = False
+
+    def start(self) -> None:
+        """Begin open-ended background sampling (the
+        /debug/pprof/sample/start endpoint): a daemon thread samples
+        every OTHER thread until stop(). One session at a time."""
+        with self._lock:
+            if self._thread is not None or self._busy:
+                raise RuntimeError("sampling profiler already running")
+            self._reset()
+            self._stop = threading.Event()
+            self._started_at = time.monotonic()
+
+            def run(stop=self._stop):
+                skip = {threading.get_ident()}
+                while not stop.is_set():
+                    self._sample_once(skip)
+                    stop.wait(self.interval)
+
+            self._thread = threading.Thread(
+                target=run, daemon=True, name="sampling-profiler")
+            self._thread.start()
+
+    def stop(self, top: int = 30) -> str:
+        """End the background session and return its report."""
+        with self._lock:
+            if self._thread is None:
+                raise RuntimeError("sampling profiler not running")
+            self._stop.set()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+            self._stop = None
+            return self._report(time.monotonic() - self._started_at, top)
 
 
 class Tracer:
@@ -207,7 +278,10 @@ class DebugServer:
     """HTTP debug endpoints (the pprofBindAddress analog):
 
     - ``GET /debug/pprof/profile?seconds=S`` — profile the process for
-      S seconds, return the pstats summary;
+      S seconds, return the sampling report;
+    - ``GET /debug/pprof/sample/start`` / ``.../sample/stop`` — the
+      open-ended analog: start background sampling now, fetch the
+      report whenever the incident is over (no fixed window up front);
     - ``GET /debug/trace`` — the tracer's Chrome-trace JSON;
     - ``GET /debug/trace/clear`` — reset the span ring.
     """
@@ -249,7 +323,22 @@ class DebugServer:
                         return
                     # sampling profiler: sees every thread's stack, not
                     # just this handler thread (cProfile would not)
-                    self._reply(200, sampler.sample_for(seconds))
+                    try:
+                        self._reply(200, sampler.sample_for(seconds))
+                    except RuntimeError as e:
+                        self._reply(409, str(e))
+                elif url.path == "/debug/pprof/sample/start":
+                    try:
+                        sampler.start()
+                    except RuntimeError as e:
+                        self._reply(409, str(e))
+                    else:
+                        self._reply(200, "sampling started")
+                elif url.path == "/debug/pprof/sample/stop":
+                    try:
+                        self._reply(200, sampler.stop())
+                    except RuntimeError as e:
+                        self._reply(409, str(e))
                 elif url.path == "/debug/trace":
                     if outer.tracer is None:
                         self._reply(404, "no tracer attached")

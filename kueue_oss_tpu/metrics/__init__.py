@@ -10,6 +10,7 @@ minimalkueue's metrics endpoint.
 from __future__ import annotations
 
 import threading
+from bisect import bisect_left
 from typing import Iterable, Optional
 
 # Default histogram buckets mirroring prometheus.DefBuckets plus the
@@ -18,6 +19,11 @@ DEF_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 WAIT_BUCKETS = tuple(1 * 2 ** i for i in range(15))  # 1s .. ~4.5h
 
 LabelValues = tuple[str, ...]
+
+#: process-wide exemplar switch (obs.configure / bench twins): with it
+#: off, Histogram.observe drops exemplar payloads before taking the
+#: lock, so the disabled cost is one module-attribute read
+exemplars_enabled = True
 
 
 class _Series:
@@ -104,9 +110,28 @@ class Histogram(_Series):
         self.buckets = tuple(sorted(buckets))
         #: key -> (bucket counts, sum, count)
         self._values: dict[LabelValues, tuple[list[int], float, int]] = {}
+        #: key -> bucket index -> (exemplar labels, value, optional ts);
+        #: index len(buckets) is the +Inf bucket. One exemplar per
+        #: bucket (the newest), the OpenMetrics convention — it links a
+        #: latency bucket back to the exact decision (cycle/workload)
+        #: that landed there.
+        self._exemplars: dict[
+            LabelValues, dict[int, tuple[dict, float, float]]] = {}
 
-    def observe(self, *label_values: str, value: float) -> None:
+    def observe(self, *label_values: str, value: float,
+                exemplar: Optional[dict] = None,
+                exemplar_ts: Optional[float] = None) -> None:
+        """``exemplar`` is a small {label: value} dict (e.g.
+        {"cycle": 17, "workload": "ns/w"}) attached to the bucket this
+        observation falls in and emitted in the OpenMetrics
+        exposition; ignored while ``exemplars_enabled`` is False.
+        Stored as given — values stringify at render/accessor time, so
+        the admission hot path pays one tuple store, not a dict
+        rebuild (the bench.py slo scenario's exemplar_overhead_pct
+        twin measures exactly this path)."""
         key = self._key(label_values)
+        if exemplar is not None and not exemplars_enabled:
+            exemplar = None
         with self._lock:
             counts, total, n = self._values.get(
                 key, ([0] * len(self.buckets), 0.0, 0))
@@ -114,17 +139,44 @@ class Histogram(_Series):
                 if value <= b:
                     counts[i] += 1
             self._values[key] = (counts, total + value, n + 1)
+            if exemplar is not None:
+                # first bucket with edge >= value == the le bucket the
+                # observation lands in (len(buckets) == +Inf); the
+                # timestamp is optional in the OpenMetrics grammar, so
+                # the hot path never calls time.time() itself
+                idx = bisect_left(self.buckets, value)
+                self._exemplars.setdefault(key, {})[idx] = (
+                    exemplar, float(value), exemplar_ts)
 
     def count(self, *label_values: str) -> int:
-        v = self._values.get(self._key(label_values))
-        return v[2] if v else 0
+        # reads hold the lock too: observe() replaces the value tuple,
+        # and a torn (counts, sum, n) read would hand the caller a sum
+        # from one generation and a count from another
+        key = self._key(label_values)
+        with self._lock:
+            v = self._values.get(key)
+            return v[2] if v else 0
 
     def sum(self, *label_values: str) -> float:
-        v = self._values.get(self._key(label_values))
-        return v[1] if v else 0.0
+        key = self._key(label_values)
+        with self._lock:
+            v = self._values.get(key)
+            return v[1] if v else 0.0
 
     def total_count(self) -> int:
-        return sum(v[2] for v in self._values.values())
+        with self._lock:
+            return sum(v[2] for v in self._values.values())
+
+    def exemplars(self, *label_values: str
+                  ) -> dict[int, tuple[dict, float, Optional[float]]]:
+        """Bucket index -> (labels, value, ts) snapshot for one key,
+        label values stringified (the exposition's view)."""
+        key = self._key(label_values)
+        with self._lock:
+            raw = dict(self._exemplars.get(key, {}))
+        return {i: ({str(k): str(v) for k, v in labels.items()},
+                    value, ts)
+                for i, (labels, value, ts) in raw.items()}
 
     def delete_matching(self, **by_label: str) -> None:
         idx = {self.labels.index(k): v for k, v in by_label.items()}
@@ -132,6 +184,7 @@ class Histogram(_Series):
             for key in [k for k in self._values
                         if all(k[i] == v for i, v in idx.items())]:
                 del self._values[key]
+                self._exemplars.pop(key, None)
 
     def collect(self):
         # copy the per-key bucket lists too: observe() mutates them in
@@ -141,45 +194,104 @@ class Histogram(_Series):
             return {k: (list(counts), total, n)
                     for k, (counts, total, n) in self._values.items()}
 
+    def collect_exemplars(self):
+        with self._lock:
+            return {k: dict(v) for k, v in self._exemplars.items()}
+
 
 class Registry:
     def __init__(self) -> None:
         self._series: dict[str, _Series] = {}
+        # register()/get() race the exposition path (a scrape iterating
+        # the series dict while a late import registers a new one);
+        # all three now share this lock
+        self._lock = threading.Lock()
 
     def register(self, s: _Series) -> _Series:
-        self._series[s.name] = s
+        with self._lock:
+            self._series[s.name] = s
         return s
 
     def get(self, name: str) -> Optional[_Series]:
-        return self._series.get(name)
+        with self._lock:
+            return self._series.get(name)
 
-    def render(self) -> str:
-        """Prometheus text exposition format."""
+    def _series_snapshot(self) -> list[_Series]:
+        with self._lock:
+            return list(self._series.values())
+
+    def render(self, openmetrics: bool = False) -> str:
+        """Text exposition: Prometheus 0.0.4 by default, OpenMetrics
+        with ``openmetrics=True`` — same series, plus per-bucket
+        exemplars (``# {labels} value ts``) and the ``# EOF``
+        terminator. Exemplars only exist in the OpenMetrics form; the
+        classic format has no grammar for them."""
         out: list[str] = []
-        for s in self._series.values():
-            out.append(f"# HELP {s.name} {s.help}")
-            out.append(f"# TYPE {s.name} {s.kind}")
+        for s in self._series_snapshot():
+            family = s.name
+            if (openmetrics and s.kind == "counter"
+                    and family.endswith("_total")):
+                # the OpenMetrics grammar names a counter FAMILY
+                # suffix-free and requires its sample to be
+                # <family>_total; emitting both with the suffix makes
+                # a real Prometheus scrape fail to parse
+                family = family[:-len("_total")]
+            out.append(f"# HELP {family} {_escape_help(s.help)}")
+            out.append(f"# TYPE {family} {s.kind}")
             if isinstance(s, Histogram):
+                ex_of = s.collect_exemplars() if openmetrics else {}
                 for key, (counts, total, n) in sorted(s.collect().items()):
                     base = _fmt_labels(s.labels, key)
-                    for b, c in zip(s.buckets, counts):
+                    exemplars = ex_of.get(key, {})
+                    for i, (b, c) in enumerate(zip(s.buckets, counts)):
                         le = _merge_labels(base, f'le="{b}"')
-                        out.append(f"{s.name}_bucket{le} {c}")
+                        out.append(f"{s.name}_bucket{le} {c}"
+                                   + _fmt_exemplar(exemplars.get(i)))
                     inf = _merge_labels(base, 'le="+Inf"')
-                    out.append(f"{s.name}_bucket{inf} {n}")
+                    out.append(f"{s.name}_bucket{inf} {n}"
+                               + _fmt_exemplar(
+                                   exemplars.get(len(s.buckets))))
                     out.append(f"{s.name}_sum{base} {total}")
                     out.append(f"{s.name}_count{base} {n}")
             else:
                 for key, v in sorted(s.collect().items()):  # type: ignore[attr-defined]
                     out.append(f"{s.name}{_fmt_labels(s.labels, key)} {v}")
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus/OpenMetrics label-value escaping: backslash, double
+    quote, newline. Recorder reason strings and CQ names flow into
+    labels verbatim — an unescaped quote or newline would corrupt the
+    whole exposition for every scraper."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    # HELP lines escape backslash and newline only (the exposition
+    # grammar; quotes are legal there)
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_labels(names: tuple[str, ...], values: LabelValues) -> str:
     if not names:
         return ""
-    pairs = ",".join(f'{k}="{v}"' for k, v in zip(names, values))
+    pairs = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in zip(names, values))
     return "{" + pairs + "}"
+
+
+def _fmt_exemplar(ex: Optional[tuple[dict, float, Optional[float]]]) -> str:
+    if ex is None:
+        return ""
+    labels, value, ts = ex
+    pairs = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    tail = f" {round(ts, 3)}" if ts is not None else ""
+    return " # {" + pairs + "} " + f"{value}" + tail
 
 
 def _merge_labels(base: str, extra: str) -> str:
@@ -496,6 +608,31 @@ whatif_parity_failures_total = registry.register(Counter(
     "What-if batches whose vmapped plans diverged from the sequential "
     "oracle (must stay 0; a nonzero count is a kernel bug)", ()))
 
+# -- cluster health layer (obs/health.py + obs/ledger.py,
+# docs/OBSERVABILITY.md "Cluster health & SLOs") -----------------------------
+
+slo_burn_rate = registry.register(Gauge(
+    "kueue_slo_burn_rate",
+    "Queue-wait SLO burn rate per scope/key/window (1.0 = exactly "
+    "consuming the error budget; alerting thresholds sit well above)",
+    ("scope", "key", "window")))
+slo_alerts_firing = registry.register(Gauge(
+    "kueue_slo_alerts_firing",
+    "Burn-rate alerts currently firing per scope/key (0 or 1)",
+    ("scope", "key")))
+slo_alert_transitions_total = registry.register(Counter(
+    "kueue_slo_alert_transitions_total",
+    "Burn-rate alert state transitions by direction (fired/cleared)",
+    ("scope", "key", "state")))
+starvation_oldest_pending_seconds = registry.register(Gauge(
+    "kueue_starvation_oldest_pending_seconds",
+    "Age of the oldest pending workload per CQ at the last SLO "
+    "evaluation (the starvation watchdog's primary signal)",
+    ("cluster_queue",)))
+ledger_records_total = registry.register(Counter(
+    "kueue_ledger_records_total",
+    "Cycle-ledger rows recorded, by kind (host/solver)", ("kind",)))
+
 # -- durable control plane (persist/, docs/DURABILITY.md) --------------------
 
 wal_records_total = registry.register(Counter(
@@ -621,10 +758,15 @@ def _cq_labels(cq: str) -> tuple:
 
 
 def admitted_workload(cq: str, wait_s: float, lq: str = "",
-                      namespace: str = "default") -> None:
+                      namespace: str = "default",
+                      exemplar: Optional[dict] = None) -> None:
+    """``exemplar`` (e.g. {"cycle": "17", "workload": "ns/w"}) rides
+    the wait-time histogram so a latency bucket links back to the
+    exact ledger row and decision chain (docs/OBSERVABILITY.md)."""
     admitted_workloads_total.inc(*_cq_labels(cq))
     admission_wait_time_seconds.observe(*_cq_labels(cq),
-                                        value=max(wait_s, 0.0))
+                                        value=max(wait_s, 0.0),
+                                        exemplar=exemplar)
     if lq and _lq_metrics_enabled():
         local_queue_admitted_workloads_total.inc(lq, namespace)
         local_queue_admission_wait_time_seconds.observe(
@@ -632,10 +774,12 @@ def admitted_workload(cq: str, wait_s: float, lq: str = "",
 
 
 def quota_reserved_workload(cq: str, wait_s: float, lq: str = "",
-                            namespace: str = "default") -> None:
+                            namespace: str = "default",
+                            exemplar: Optional[dict] = None) -> None:
     quota_reserved_workloads_total.inc(*_cq_labels(cq))
     quota_reserved_wait_time_seconds.observe(*_cq_labels(cq),
-                                             value=max(wait_s, 0.0))
+                                             value=max(wait_s, 0.0),
+                                             exemplar=exemplar)
     if lq and _lq_metrics_enabled():
         local_queue_quota_reserved_workloads_total.inc(lq, namespace)
         local_queue_quota_reserved_wait_time_seconds.observe(
@@ -688,5 +832,7 @@ def clear_cluster_queue_metrics(cq: str) -> None:
 
 def reset_all() -> None:
     """Test helper: drop every recorded sample (registry keeps its series)."""
-    for s in registry._series.values():
+    for s in registry._series_snapshot():
         s._values = {}  # type: ignore[attr-defined]
+        if isinstance(s, Histogram):
+            s._exemplars = {}

@@ -226,27 +226,60 @@ class FlightRecorder:
         the directory is fsynced too — an fsynced file behind an
         un-fsynced rename is not durable across power loss (the same
         discipline as the persist/ checkpoint writer)."""
-        from kueue_oss_tpu.util.fsutil import fsync_dir
-
         events = self.events()
-        tmp = f"{path}.tmp.{os.getpid()}"
-        try:
-            with open(tmp, "w") as f:
-                for ev in events:
-                    f.write(json.dumps(ev.to_dict()) + "\n")
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-            fsync_dir(os.path.dirname(os.path.abspath(path)))
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        _atomic_write_jsonl(path, (ev.to_dict() for ev in events))
         return len(events)
+
+    def restore(self, events: list[DecisionEvent]) -> int:
+        """Replace the journal with a persisted dump (the recovery
+        path, docs/DURABILITY.md): the ring, the per-workload index,
+        and the seq counter all continue from the restored state so
+        post-restart events keep a monotone journal order."""
+        with self._lock:
+            self._ring.clear()
+            self._by_workload.clear()
+            top = 0
+            for ev in events[-self.max_events:]:
+                self._ring.append(ev)
+                top = max(top, ev.seq)
+                if ev.workload == CYCLE_SCOPE:
+                    continue
+                dq = self._by_workload.get(ev.workload)
+                if dq is None:
+                    dq = deque(maxlen=self.per_workload)
+                    self._by_workload[ev.workload] = dq
+                    if len(self._by_workload) > self.max_workloads:
+                        self._by_workload.popitem(last=False)
+                else:
+                    self._by_workload.move_to_end(ev.workload)
+                dq.append(ev)
+            self._seq = itertools.count(top + 1)
+            return len(self._ring)
 
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
             self._by_workload.clear()
+
+
+def _atomic_write_jsonl(path: str, dicts) -> None:
+    """Shared durable-JSONL writer: same-directory temp file, fsync,
+    ``os.replace``, directory fsync (the checkpoint writer's
+    discipline — used by both the decision journal and the ledger)."""
+    from kueue_oss_tpu.util.fsutil import fsync_dir
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            for d in dicts:
+                f.write(json.dumps(d) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        fsync_dir(os.path.dirname(os.path.abspath(path)))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load_jsonl(path: str) -> list[DecisionEvent]:
@@ -261,6 +294,20 @@ def load_jsonl(path: str) -> list[DecisionEvent]:
     on the function as ``load_jsonl.last_skipped`` — best-effort
     module-level state (concurrent loads race on it); a diagnostic,
     not an API."""
+    out, skipped = _tolerant_load_jsonl(path, DecisionEvent.from_dict,
+                                        "journal")
+    load_jsonl.last_skipped = skipped
+    return out
+
+
+load_jsonl.last_skipped = 0
+
+
+def _tolerant_load_jsonl(path: str, parse, label: str
+                         ) -> tuple[list, int]:
+    """Shared tolerant JSONL reader (the decision journal's and the
+    cycle ledger's one torn-line policy): blank lines skipped, corrupt
+    lines skipped with one counted warning. Returns (rows, skipped)."""
     out = []
     skipped = 0
     with open(path) as f:
@@ -271,24 +318,58 @@ def load_jsonl(path: str) -> list[DecisionEvent]:
             try:
                 d = json.loads(line)
                 if not isinstance(d, dict):
-                    raise ValueError("journal line is not an object")
-                out.append(DecisionEvent.from_dict(d))
+                    raise ValueError(f"{label} line is not an object")
+                out.append(parse(d))
             except (ValueError, TypeError, KeyError):
                 skipped += 1
                 if skipped == 1:
                     logger.warning(
-                        "journal %s: skipping corrupt line %d "
-                        "(torn write?)", path, lineno)
+                        "%s %s: skipping corrupt line %d "
+                        "(torn write?)", label, path, lineno)
     if skipped > 1:
-        logger.warning("journal %s: skipped %d corrupt line(s) total",
-                       path, skipped)
-    load_jsonl.last_skipped = skipped
-    return out
-
-
-load_jsonl.last_skipped = 0
+        logger.warning("%s %s: skipped %d corrupt line(s) total",
+                       label, path, skipped)
+    return out, skipped
 
 
 #: process-wide recorder (the metrics.registry idiom); tests swap or
 #: clear() it via the autouse fixture
 recorder = FlightRecorder()
+
+# -- cluster health layer (ledger + SLO engine; imported AFTER the
+# recorder exists — both modules may import this package lazily) ------------
+
+from kueue_oss_tpu.obs.health import (  # noqa: E402
+    SLOEngine,
+    oldest_pending,
+)
+from kueue_oss_tpu.obs.health import slo as slo_engine  # noqa: E402
+from kueue_oss_tpu.obs.ledger import (  # noqa: E402
+    HOST_CYCLE,
+    SOLVER_DRAIN,
+    CycleLedger,
+    CycleRecord,
+    load_ledger_jsonl,
+)
+from kueue_oss_tpu.obs.ledger import ledger as cycle_ledger  # noqa: E402
+
+
+def configure(obs_cfg) -> None:
+    """Apply a config.ObservabilityConfig to the process-wide obs
+    state: the recorder/ledger switches and bounds, the metrics
+    exemplar switch, and the SLO engine's objectives (windows and
+    alert state reset — a reconfigured objective starts clean)."""
+    recorder.enabled = obs_cfg.recorder_enabled
+    cycle_ledger.enabled = obs_cfg.ledger_enabled
+    if obs_cfg.ledger_max_cycles != cycle_ledger.max_cycles:
+        cycle_ledger.resize(obs_cfg.ledger_max_cycles)
+    metrics.exemplars_enabled = obs_cfg.exemplars
+    s = obs_cfg.slo
+    slo_engine.enabled = obs_cfg.slo_enabled
+    slo_engine.reconfigure(
+        target=s.queue_wait_target,
+        threshold_s=s.queue_wait_threshold_seconds,
+        fast_window_s=s.fast_window_seconds,
+        slow_window_s=s.slow_window_seconds,
+        burn_threshold=s.burn_rate_threshold,
+        starvation_threshold_s=s.starvation_threshold_seconds)

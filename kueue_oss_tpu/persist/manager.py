@@ -46,10 +46,15 @@ from kueue_oss_tpu.persist import codec, hooks
 from kueue_oss_tpu.persist.wal import FSYNC_BATCH, WriteAheadLog, replay_wal
 
 _SEG = re.compile(r"^wal-(\d+)\.log$")
+_OBS = re.compile(r"^(journal|ledger)-(\d+)\.jsonl$")
 
 
 def _segment_path(dir_path: str, seg: int) -> str:
     return os.path.join(dir_path, f"wal-{seg:08d}.log")
+
+
+def _obs_path(dir_path: str, kind: str, ckpt_id: int) -> str:
+    return os.path.join(dir_path, f"{kind}-{ckpt_id:08d}.jsonl")
 
 
 def apply_event(store: Store, verb: str, kind: str, obj_dict: dict,
@@ -118,6 +123,10 @@ class RecoveryResult:
     unapplied_intents: int = 0
     fence_violations: int = 0
     torn_tail: bool = False
+    #: obs rings restored from the journal/ledger dumps written at
+    #: checkpoint time (docs/OBSERVABILITY.md "Cluster health & SLOs")
+    journal_events_restored: int = 0
+    ledger_rows_restored: int = 0
 
     def to_dict(self) -> dict:
         return {"checkpoint_id": self.checkpoint_id,
@@ -125,7 +134,9 @@ class RecoveryResult:
                 "replayed_intents": self.replayed_intents,
                 "unapplied_intents": self.unapplied_intents,
                 "fence_violations": self.fence_violations,
-                "torn_tail": self.torn_tail}
+                "torn_tail": self.torn_tail,
+                "journal_events_restored": self.journal_events_restored,
+                "ledger_rows_restored": self.ledger_rows_restored}
 
 
 class PersistenceManager:
@@ -136,10 +147,15 @@ class PersistenceManager:
                  keep_checkpoints: int = 2,
                  audit_interval_seconds: float = 0.0,
                  audit_auto_heal: bool = False,
+                 persist_obs: bool = True,
                  clock=time.monotonic) -> None:
         self.dir = dir_path
         os.makedirs(dir_path, exist_ok=True)
         self.fsync = fsync
+        #: dump/restore the obs journal + cycle-ledger rings alongside
+        #: checkpoints so explain/replay and per-cycle health records
+        #: survive restarts (closes the ROADMAP durability item)
+        self.persist_obs = persist_obs
         self.batch_records = batch_records
         self.checkpoint_interval_records = checkpoint_interval_records
         self.checkpoint_interval_seconds = checkpoint_interval_seconds
@@ -298,11 +314,31 @@ class PersistenceManager:
             self.segment = new_id
             self._records_since_ckpt = 0
             self._last_ckpt_at = self.clock()
+            self._dump_obs_rings(new_id)
             self._prune(new_id)
         metrics.checkpoints_total.inc("written")
         metrics.checkpoint_duration_seconds.observe(
             value=time.monotonic() - t0)
         return new_id
+
+    def _dump_obs_rings(self, ckpt_id: int) -> None:
+        """Persist the decision journal and the cycle ledger next to
+        the checkpoint (dump_jsonl is already atomic + dir-fsynced).
+        Best-effort: the checkpoint itself is the durability contract;
+        a failed ring dump is logged via the failed counter but must
+        never unpublish a checkpoint that already landed."""
+        if not self.persist_obs:
+            return
+        from kueue_oss_tpu import obs
+
+        # each ring dumps in its own try: a journal ENOSPC must not
+        # also cost the ledger its dump for this checkpoint
+        for kind, ring in (("journal", obs.recorder),
+                           ("ledger", obs.cycle_ledger)):
+            try:
+                ring.dump_jsonl(_obs_path(self.dir, kind, ckpt_id))
+            except OSError:
+                metrics.checkpoints_total.inc(f"obs_{kind}_dump_failed")
 
     def _prune(self, newest_id: int) -> None:
         """WAL truncation on checkpoint success: drop checkpoints
@@ -322,6 +358,13 @@ class PersistenceManager:
         for name in os.listdir(self.dir):
             m = _SEG.match(name)
             if m and int(m.group(1)) < oldest_kept:
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+            mo = _OBS.match(name)
+            if mo and int(mo.group(2)) < oldest_kept:
+                # obs ring dumps retire with their checkpoint
                 try:
                     os.unlink(os.path.join(self.dir, name))
                 except OSError:
@@ -371,12 +414,44 @@ class PersistenceManager:
                 result.store = store
         finally:
             self._replaying = False
+        self._restore_obs_rings(result)
         metrics.recovery_total.inc(
             "checkpoint" if loaded is not None else
             ("wal_only" if result.replayed_events else "empty"))
         metrics.recovery_replayed_records.set(
             value=result.replayed_events + result.replayed_intents)
         return result
+
+    def _restore_obs_rings(self, result: RecoveryResult) -> None:
+        """Restore the decision journal and cycle ledger from the
+        newest ring dumps in the durability dir, so ``explain`` /
+        journal replay and per-cycle health records survive a restart.
+        Loaders are torn-line tolerant; a missing dump (pre-upgrade
+        dir, or rings disabled at dump time) restores nothing."""
+        if not self.persist_obs:
+            return
+        from kueue_oss_tpu import obs
+
+        # each ring restores from ITS OWN newest dump: a failed ledger
+        # dump at checkpoint N must not hide the intact ledger-(N-1)
+        # behind a journal-N that did land
+        newest: dict[str, int] = {}
+        for n in os.listdir(self.dir):
+            m = _OBS.match(n)
+            if m and int(m.group(2)) > newest.get(m.group(1), -1):
+                newest[m.group(1)] = int(m.group(2))
+        if "journal" in newest:
+            result.journal_events_restored = obs.recorder.restore(
+                obs.load_jsonl(_obs_path(self.dir, "journal",
+                                         newest["journal"])))
+            # the SLO windows die with the process; rebuild them from
+            # the restored journal's recorded waits so burn state (and
+            # a firing alert) survives the restart (docs/DURABILITY.md)
+            obs.slo_engine.replay_journal(obs.recorder.events())
+        if "ledger" in newest:
+            result.ledger_rows_restored = obs.cycle_ledger.restore(
+                obs.load_ledger_jsonl(_obs_path(self.dir, "ledger",
+                                                newest["ledger"])))
 
     @staticmethod
     def _sync_into(target: Store, durable: Store, emit: bool) -> None:
@@ -450,5 +525,14 @@ class PersistenceManager:
     def close(self) -> None:
         if self.auditor is not None:
             self.auditor.stop()
+        # detach from the store: a scheduler that keeps cycling after
+        # close() must fall back to the no-persistence path, not write
+        # intents into a closed WAL
+        store = getattr(self, "store", None)
+        if store is not None:
+            if getattr(store, "persistence", None) is self:
+                store.persistence = None
+            if self._on_event in store._watchers:
+                store._watchers.remove(self._on_event)
         with self._lock:
             self.wal.close()

@@ -154,6 +154,9 @@ class Scheduler:
         self._requeue_heap: list[tuple[float, str]] = []
         #: CQs whose usage changed outside entry processing (evictions)
         self._cycle_touched_cqs: set[str] = set()
+        #: per-cycle skip counts by bounded reason slug — feeds the
+        #: cycle ledger row (reset at each cycle start)
+        self._cycle_skip_slugs: dict[str, int] = {}
         #: cq -> (lq, ns) label sets last reported, for gauge zero-fill
         self._lq_reported: dict[str, set] = {}
         from kueue_oss_tpu.util import logging as klog
@@ -181,6 +184,8 @@ class Scheduler:
         self.cycle_count += 1
         stats = CycleStats(cycle=self.cycle_count)
         self.queues.current_time = now  # AFS decay reference point
+        obs.slo_engine.advance(now)  # windows roll on idle cycles too
+        self._cycle_skip_slugs = {}
         self.requeue_due(now)
         self._run_second_pass(now)
 
@@ -190,7 +195,9 @@ class Scheduler:
             # Still flush gauges for CQs touched by out-of-cycle evictions
             # or finishes, so an idle scheduler doesn't report stale usage.
             # Pending counts need no snapshot; build one only when usage
-            # gauges actually have CQs to report.
+            # gauges actually have CQs to report. Empty cycles record no
+            # ledger row either — the ledger is a record of work done,
+            # and a serve loop's idle polls would churn the ring.
             for cq_name, counts in (
                     self.queues.drain_dirty_pending_counts().items()):
                 metrics.report_pending_workloads(cq_name, *counts)
@@ -199,18 +206,29 @@ class Scheduler:
             self._persist_flush()
             return stats
 
+        # per-phase walls for the cycle ledger row — the same phase
+        # vocabulary the Tracer spans use, measured on perf_counter so
+        # a ledger row and a Chrome-trace span of the same cycle agree
+        p0 = time.perf_counter()
         snapshot = build_snapshot(self.store)
+        t_snapshot = time.perf_counter() - p0
+
+        p1 = time.perf_counter()
         entries, inadmissible = self._nominate(heads, snapshot, now)
+        t_nominate = time.perf_counter() - p1
         stats.inadmissible = len(inadmissible)
         for e in inadmissible:
             # flight recorder: the nomination-stage rejection reason
             # (inactive/missing CQ, namespace mismatch) is the answer to
             # "why is my job still pending?" for these workloads
+            self._cycle_skip_slugs["inadmissible"] = (
+                self._cycle_skip_slugs.get("inadmissible", 0) + 1)
             obs.recorder.record(
                 obs.SKIPPED, e.info.key, cycle=self.cycle_count,
                 cluster_queue=e.info.cluster_queue,
                 reason=e.inadmissible_msg, reason_slug="inadmissible")
 
+        p2 = time.perf_counter()
         iterator = self._make_iterator(entries, snapshot)
         preempted_workloads: dict[str, WorkloadInfo] = {}
         while iterator.has_next():
@@ -222,6 +240,7 @@ class Scheduler:
                 self._requeue_and_update(e)
         for e in inadmissible:
             self._requeue_and_update(e)
+        t_entries = time.perf_counter() - p2
 
         stats.duration_s = self.clock() - start
         if stats.admitted:
@@ -241,8 +260,23 @@ class Scheduler:
         result = (metrics.CycleResult.SUCCESS if stats.admitted or stats.preempted
                   else metrics.CycleResult.INADMISSIBLE)
         metrics.observe_admission_attempt(result, stats.duration_s)
+        p3 = time.perf_counter()
         self._flush_metrics(snapshot, entries)
         self._persist_flush()
+        ledger = obs.cycle_ledger
+        if ledger.enabled:
+            ledger.record(
+                self.cycle_count, obs.HOST_CYCLE,
+                breaker=obs.breaker_state_name(),
+                duration_s=stats.duration_s,
+                phases={"snapshot": round(t_snapshot, 6),
+                        "nominate": round(t_nominate, 6),
+                        "entries": round(t_entries, 6),
+                        "flush": round(time.perf_counter() - p3, 6)},
+                heads=stats.heads, admitted=stats.admitted,
+                preempted=stats.preempted, skipped=stats.skipped,
+                inadmissible=stats.inadmissible,
+                skip_slugs=dict(self._cycle_skip_slugs))
         return stats
 
     def _persist_flush(self) -> None:
@@ -774,6 +808,8 @@ class Scheduler:
         """Flight-recorder emission for a skipped entry: the bounded slug
         feeds the per-reason counters, the free-form inadmissible_msg
         (the flavor assigner's no-fit text included) survives verbatim."""
+        self._cycle_skip_slugs[slug] = (
+            self._cycle_skip_slugs.get(slug, 0) + 1)
         obs.recorder.record(
             obs.SKIPPED, e.info.key, cycle=self.cycle_count,
             cluster_queue=e.info.cluster_queue,
@@ -1119,7 +1155,10 @@ class Scheduler:
             metrics.admitted_workload(e.info.cluster_queue,
                                       now - wl.creation_time,
                                       lq=wl.queue_name,
-                                      namespace=wl.namespace)
+                                      namespace=wl.namespace,
+                                      exemplar={
+                                          "cycle": self.cycle_count,
+                                          "workload": wl.key})
         self.store.update_workload(wl)
         e.status = ASSUMED
         events.eventf(wl.key, "Workload", NORMAL, "QuotaReserved",
@@ -1129,10 +1168,19 @@ class Scheduler:
             events.eventf(wl.key, "Workload", NORMAL, "Admitted",
                           f"Admitted by ClusterQueue {e.info.cluster_queue}",
                           now=now)
-        metrics.quota_reserved_workload(e.info.cluster_queue,
-                                        now - wl.creation_time,
+        wait_s = max(now - wl.creation_time, 0.0)
+        metrics.quota_reserved_workload(e.info.cluster_queue, wait_s,
                                         lq=wl.queue_name,
-                                        namespace=wl.namespace)
+                                        namespace=wl.namespace,
+                                        exemplar={
+                                            "cycle": self.cycle_count,
+                                            "workload": wl.key})
+        # queue-wait SLI: one time-to-admit observation per admission
+        # (obs/health.py); the same wait rides the journal detail so
+        # the SLO windows can be rebuilt from a restored journal
+        obs.slo_engine.observe_admission(
+            e.info.cluster_queue, wait_s, priority=wl.priority, now=now,
+            cycle=self.cycle_count, workload=wl.key)
         obs.recorder.record(
             obs.ASSIGNED, wl.key, cycle=self.cycle_count,
             cluster_queue=e.info.cluster_queue,
@@ -1142,6 +1190,8 @@ class Scheduler:
                             for psa in admission.podset_assignments},
                 "borrows": e.assignment.borrows(),
                 "admitted": wl.is_admitted,
+                "waitSeconds": round(wait_s, 3),
+                "priority": wl.priority,
             })
         # cohort subtree admission counters (metrics.go cohort_subtree_*)
         if e.cq_snapshot is not None and e.cq_snapshot.has_parent():

@@ -441,6 +441,7 @@ class SolverEngine:
                             pow2(max(problem.n_workloads, self.pad_to)))
         problem = pad_workloads(problem, self._pad_target())
         problem, frame = self._session_encode("lean", problem)
+        dev0 = self._device_totals()
 
         t0 = time.monotonic()
         if self.remote is not None:
@@ -473,7 +474,62 @@ class SolverEngine:
         result.apply_time_s = time.monotonic() - t1
         metrics.solver_cycle_duration_seconds.observe(
             "apply", value=result.apply_time_s)
+        self._ledger_record(
+            result, frame, "lean", dev0,
+            parked_n=int(np.asarray(
+                parked[:problem.n_workloads]).astype(bool).sum()))
         return result
+
+    # -- cycle ledger (obs/ledger.py) --------------------------------------
+
+    def _device_totals(self) -> dict:
+        """Cumulative donated-buffer accounting across every resident
+        device state (both arms); the ledger records per-drain DELTAS
+        of these."""
+        totals = {"donated_update_bytes": 0, "avoided_copy_bytes": 0,
+                  "full_upload_bytes": 0, "donated_full_syncs": 0}
+        for dev in self._device_states.values():
+            for k in totals:
+                totals[k] += int(getattr(dev, k, 0))
+        return totals
+
+    def _ledger_record(self, result: DrainResult, frame, kind: str,
+                       dev0: dict, parked_n: int) -> None:
+        """One solver ledger row per drain, keyed by the same cycle id
+        the recorder's DecisionEvents carry — solver routing, session
+        wire kind/bytes, and resident-buffer churn in one record."""
+        ledger = obs.cycle_ledger
+        if not ledger.enabled:
+            return
+        dev1 = self._device_totals()
+        device = {k: dev1[k] - dev0.get(k, 0)
+                  for k in dev1 if dev1[k] - dev0.get(k, 0)}
+        frame_kind, frame_bytes, frame_reason, session = "legacy", 0, "", {}
+        if frame is not None:
+            session = dict(frame.stats or {})
+            if frame.delta is not None:
+                frame_kind = "delta"
+                frame_bytes = int(frame.delta.payload_bytes())
+            else:
+                frame_kind = "sync"
+                frame_reason = frame.full_reason or ""
+                sess_obj = self._delta_sessions.get(kind)
+                if sess_obj is not None and sess_obj._last is not None:
+                    frame_bytes = sum(
+                        int(getattr(a, "nbytes", 0))
+                        for a in sess_obj._last[0].values())
+        arm = ("remote" if self.remote is not None
+               else (self.last_drain_arm or "single"))
+        ledger.record(
+            self._drain_cycle, obs.SOLVER_DRAIN,
+            breaker=obs.breaker_state_name(),
+            duration_s=result.solver_time_s + result.apply_time_s,
+            phases={"solve": round(result.solver_time_s, 6),
+                    "apply": round(result.apply_time_s, 6)},
+            admitted=result.admitted, evicted=result.evicted,
+            parked=parked_n, rounds=result.rounds, solver_arm=arm,
+            frame_kind=frame_kind, frame_bytes=frame_bytes,
+            frame_reason=frame_reason, session=session, device=device)
 
     # -- mesh routing (solver/meshutil.py, solver/sharded.py) --------------
 
@@ -1215,6 +1271,7 @@ class SolverEngine:
                             pow2(max(problem.n_workloads, self.pad_to)))
         problem = pad_workloads(problem, self._pad_target())
         problem, frame = self._session_encode("full", problem)
+        dev0 = self._device_totals()
 
         t0 = time.monotonic()
         if self.remote is not None:
@@ -1250,6 +1307,11 @@ class SolverEngine:
         result.apply_time_s = time.monotonic() - t1
         metrics.solver_cycle_duration_seconds.observe(
             "apply", value=result.apply_time_s)
+        W = problem.n_workloads
+        self._ledger_record(
+            result, frame, "full", dev0,
+            parked_n=int((np.asarray(parked[:W]).astype(bool)
+                          & ~np.asarray(admitted[:W]).astype(bool)).sum()))
         return result
 
     def _evictor(self):
@@ -1439,13 +1501,22 @@ class SolverEngine:
                     by_resource[r] = by_resource.get(r, 0) + q
             self.queues.afs.record_admission(
                 f"{wl.namespace}/{wl.queue_name}", by_resource, now)
-        metrics.quota_reserved_workload(cq_name, now - wl.creation_time,
+        wait_s = max(now - wl.creation_time, 0.0)
+        exemplar = {"cycle": self._drain_cycle, "workload": key}
+        metrics.quota_reserved_workload(cq_name, wait_s,
                                         lq=wl.queue_name,
-                                        namespace=wl.namespace)
+                                        namespace=wl.namespace,
+                                        exemplar=exemplar)
         if wl.is_admitted:
-            metrics.admitted_workload(cq_name, now - wl.creation_time,
+            metrics.admitted_workload(cq_name, wait_s,
                                       lq=wl.queue_name,
-                                      namespace=wl.namespace)
+                                      namespace=wl.namespace,
+                                      exemplar=exemplar)
+        # queue-wait SLI feed (obs/health.py), host-path parity: the
+        # solver drain's admissions count against the same objectives
+        obs.slo_engine.observe_admission(
+            cq_name, wait_s, priority=wl.priority, now=now,
+            cycle=self._drain_cycle, workload=key)
         obs.recorder.record(
             obs.SOLVER_ADMITTED, key, cycle=self._drain_cycle,
             cluster_queue=cq_name, path=obs.SOLVER,
@@ -1455,6 +1526,8 @@ class SolverEngine:
                 "flavors": dict(flavor_of),
                 "placed_with_topology": topology is not None,
                 "admitted": wl.is_admitted,
+                "waitSeconds": round(wait_s, 3),
+                "priority": wl.priority,
             })
         result.admitted += 1
         result.admitted_keys.append(key)

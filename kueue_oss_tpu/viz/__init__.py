@@ -38,6 +38,11 @@ class Dashboard:
         self.recorder = recorder if recorder is not None else obs.recorder
         #: bumped on every store event; SSE clients wake on it
         self._gen = 0
+        #: (monotonic wall, report) memo shared by slo_view and
+        #: health_view — the frontend fetches both endpoints on one
+        #: refresh tick, and each full evaluation walks every SLI key
+        #: plus every pending workload under the QueueManager mutex
+        self._slo_memo: tuple[float, dict] | None = None
         self._cond = threading.Condition()
         store.watch(self._on_event)
 
@@ -276,7 +281,81 @@ class Dashboard:
                 "events": [ev.to_dict() for ev in events]}
 
     def decisions_view(self, last_cycles: int = 10) -> dict:
-        return {"cycles": self.recorder.decisions(last_cycles)}
+        """Per-cycle decision groups, each carrying its ledger rows
+        (the host cycle row and, when a drain served the cycle, the
+        solver row) — the decision chain and the cycle's timing/
+        routing record join on the cycle id."""
+        from kueue_oss_tpu import obs
+
+        cycles = self.recorder.decisions(last_cycles)
+        wanted = {group["cycle"] for group in cycles}
+        by_cycle: dict[int, list] = {}
+        for row in obs.cycle_ledger.rows():
+            # serialize only the cycles this response returns — the
+            # ring holds up to max_cycles (4096) rows and this view is
+            # polled on the frontend's refresh tick
+            if row.cycle in wanted:
+                by_cycle.setdefault(row.cycle, []).append(row.to_dict())
+        for group in cycles:
+            rows = by_cycle.get(group["cycle"])
+            if rows:
+                group["ledger"] = rows
+        return {"cycles": cycles}
+
+    # -- cluster health & SLOs (obs/health.py, obs/ledger.py) ---------------
+
+    def _slo_report(self) -> dict:
+        """One evaluation shared across the endpoints hit in a single
+        frontend refresh tick (coalesced for ~1s of wall time)."""
+        import time as _time
+
+        from kueue_oss_tpu import obs
+
+        now = _time.monotonic()
+        memo = self._slo_memo
+        if memo is not None and now - memo[0] < 1.0:
+            return memo[1]
+        report = obs.slo_engine.evaluate(queues=self.queues)
+        self._slo_memo = (now, report)
+        return report
+
+    def slo_view(self) -> dict:
+        """The SLO engine's full report: per-CQ/per-priority SLIs with
+        burn rates + alert states, and the starvation watchdog fed
+        from the live queues (GET /api/slo)."""
+        return self._slo_report()
+
+    def health_view(self) -> dict:
+        """One-look cluster health (GET /api/health): worst-signal
+        status rollup over the burn-rate alerts, the starvation
+        watchdog, the solver breaker, and the invariant auditor."""
+        from kueue_oss_tpu import metrics, obs
+
+        report = self._slo_report()
+        firing = report["alerts"]
+        starved = [s for s in report["starvation"] if s["starved"]]
+        breaker = obs.breaker_state_name()
+        violations = int(metrics.invariant_last_violations.value())
+        if firing or violations:
+            status = "critical"
+        elif starved or breaker != "closed":
+            status = "degraded"
+        else:
+            status = "ok"
+        last = obs.cycle_ledger.last_row()
+        return {
+            "status": status,
+            "alertsFiring": firing,
+            "starved": starved,
+            "breakerState": breaker,
+            "invariantViolations": violations,
+            "ledger": {
+                "rows": len(obs.cycle_ledger.rows()),
+                "lastCycle": last.cycle if last is not None else 0,
+                "lastKind": last.kind if last is not None else "",
+            },
+            "objective": report["objective"],
+        }
 
     # -- per-resource detail views (WorkloadDetail.jsx et al) ---------------
 
@@ -425,13 +504,24 @@ class DashboardServer:
                     return
                 path = self.path.split("?", 1)[0].rstrip("/")
                 if path == "/metrics":
-                    # Prometheus text exposition (registry render)
+                    # Prometheus text exposition (registry render);
+                    # OpenMetrics (with exemplars + # EOF) under
+                    # standard content negotiation or ?format=
+                    from urllib.parse import parse_qs, urlparse
+
                     from kueue_oss_tpu import metrics as kmetrics
 
-                    body = kmetrics.registry.render().encode()
+                    qs = parse_qs(urlparse(self.path).query)
+                    accept = self.headers.get("Accept", "")
+                    om = ("openmetrics" in accept
+                          or "openmetrics" in qs.get("format", [""]))
+                    body = kmetrics.registry.render(
+                        openmetrics=om).encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8" if om else
                         "text/plain; version=0.0.4; charset=utf-8")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
@@ -538,6 +628,8 @@ class DashboardServer:
                     "/api/topologies": dash.topologies_view,
                     "/api/admissionchecks": dash.admission_checks_view,
                     "/api/overview": dash.overview,
+                    "/api/slo": dash.slo_view,
+                    "/api/health": dash.health_view,
                 }
                 fn = routes.get(path)
                 if fn is None:

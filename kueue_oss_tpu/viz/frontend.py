@@ -52,6 +52,15 @@ INDEX_HTML = """<!doctype html>
 <div id="detail" style="display:none"></div>
 <div id="main">
 <div id="overview">loading…</div>
+<h2>Cluster health</h2>
+<div id="health">loading…</div>
+<table id="slos" style="display:none"><thead><tr>
+  <th>Scope</th><th>Key</th><th>Burn 5m</th><th>Burn 1h</th>
+  <th>Fast window</th><th>Alert</th><th>Exemplar</th></tr></thead>
+  <tbody></tbody></table>
+<table id="starve" style="display:none"><thead><tr>
+  <th>ClusterQueue</th><th>Oldest pending</th><th>Workload</th>
+  <th>Starved</th></tr></thead><tbody></tbody></table>
 <h2>Cohort tree</h2>
 <div id="tree"></div>
 <h2>ClusterQueues</h2>
@@ -191,6 +200,43 @@ async function refresh() {
         a.name, a.controller || "—", a.active ? "yes" : "no",
         a.waitingWorkloads]));
   } catch (e) { /* server restarting; retry on next tick */ }
+  refreshHealth();
+}
+async function refreshHealth() {
+  // cluster health + SLO section (/api/health, /api/slo)
+  try {
+    const h = await fetch("/api/health").then(r => r.json());
+    const badge = h.status === "ok" ? "✅" :
+      (h.status === "degraded" ? "⚠️" : "🔥");
+    document.getElementById("health").innerHTML =
+      `<span>${badge} <b>${h.status}</b></span> ` +
+      `<span>${(h.alertsFiring || []).length} alert(s) firing</span> ` +
+      `<span>${(h.starved || []).length} starved CQ(s)</span> ` +
+      `<span>breaker ${h.breakerState}</span> ` +
+      `<span>${h.invariantViolations} invariant violation(s)</span> ` +
+      `<span class="frac">ledger: ${h.ledger.rows} rows, last cycle ` +
+      `${h.ledger.lastCycle} (${h.ledger.lastKind})</span>`;
+    const s = await fetch("/api/slo").then(r => r.json());
+    const slis = s.slis || [];
+    const tbl = document.getElementById("slos");
+    tbl.style.display = slis.length ? "" : "none";
+    tbl.querySelector("tbody").innerHTML = slis.map(x =>
+      `<tr><td>${x.scope}</td><td>${x.key}</td>` +
+      `<td>${x.burnFast}</td><td>${x.burnSlow}</td>` +
+      `<td>${x.fast.bad}/${x.fast.total} bad</td>` +
+      `<td><span class="pill">${x.alert.state}</span></td>` +
+      `<td>${x.alert.exemplar ? `cycle ${x.alert.exemplar.cycle} · ` +
+        `<a href="#/workload/${x.alert.exemplar.workload}">` +
+        `${x.alert.exemplar.workload}</a>` : "—"}</td></tr>`).join("");
+    const st = s.starvation || [];
+    const stb = document.getElementById("starve");
+    stb.style.display = st.length ? "" : "none";
+    stb.querySelector("tbody").innerHTML = st.map(x =>
+      `<tr><td>${x.clusterQueue}</td>` +
+      `<td>${Math.round(x.oldestAgeSeconds)}s</td>` +
+      `<td>${x.workload}</td>` +
+      `<td>${x.starved ? "⚠️ yes" : "no"}</td></tr>`).join("");
+  } catch (e) { /* health layer unavailable */ }
 }
 async function runWhatIf() {
   const status = document.getElementById("wi-status");

@@ -50,6 +50,12 @@ def pytest_configure(config):
         "(kueue_oss_tpu/persist/): WAL/checkpoint/recovery property "
         "tests and the crash-point chaos suite (seeded subprocess "
         "kill -9 + recover); deterministic, runs in tier-1")
+    config.addinivalue_line(
+        "markers", "slo: cluster health layer tests (obs/ledger.py + "
+        "obs/health.py): virtual-clock burn-rate sequences, starvation "
+        "watchdog, exemplar round-trips, ledger joins, and the "
+        "SIGKILL+recover journal/ledger survival harness; "
+        "deterministic, runs in tier-1")
 
 
 @pytest.fixture(scope="session")

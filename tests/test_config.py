@@ -99,6 +99,58 @@ def test_validate_rejects_bad_simulator_values():
     assert "simulator.minBatchForMesh" in joined
 
 
+def test_load_observability_config():
+    cfg = load({"observability": {
+        "recorderEnabled": False,
+        "ledgerEnabled": True,
+        "ledgerMaxCycles": 512,
+        "exemplars": False,
+        "sloEnabled": True,
+        "slo": {
+            "queueWaitTarget": 0.95,
+            "queueWaitThreshold": 120.0,
+            "fastWindow": 60.0,
+            "slowWindow": 900.0,
+            "burnRateThreshold": 10.0,
+            "starvationThreshold": 600.0,
+        },
+    }})
+    ob = cfg.observability
+    assert ob.recorder_enabled is False
+    assert ob.ledger_enabled is True
+    assert ob.ledger_max_cycles == 512
+    assert ob.exemplars is False
+    assert ob.slo_enabled is True
+    assert ob.slo.queue_wait_target == 0.95
+    assert ob.slo.queue_wait_threshold_seconds == 120.0
+    assert ob.slo.fast_window_seconds == 60.0
+    assert ob.slo.slow_window_seconds == 900.0
+    assert ob.slo.burn_rate_threshold == 10.0
+    assert ob.slo.starvation_threshold_seconds == 600.0
+    assert validate(cfg) == []
+    # defaults: the health layer is on, 99% within 5 minutes
+    dflt = load({}).observability
+    assert dflt.ledger_enabled and dflt.slo_enabled and dflt.exemplars
+    assert dflt.slo.queue_wait_target == 0.99
+    assert dflt.slo.queue_wait_threshold_seconds == 300.0
+
+
+def test_validate_rejects_bad_observability_values():
+    cfg = load({"observability": {
+        "ledgerMaxCycles": 0,
+        "slo": {"queueWaitTarget": 1.5, "queueWaitThreshold": 0,
+                "fastWindow": 600.0, "slowWindow": 60.0,
+                "burnRateThreshold": 0, "starvationThreshold": -1},
+    }})
+    joined = "\n".join(validate(cfg))
+    assert "observability.ledgerMaxCycles" in joined
+    assert "observability.slo.queueWaitTarget" in joined
+    assert "observability.slo.queueWaitThreshold" in joined
+    assert "observability.slo.slowWindow" in joined
+    assert "observability.slo.burnRateThreshold" in joined
+    assert "observability.slo.starvationThreshold" in joined
+
+
 def test_load_persistence_config():
     cfg = load({"persistence": {
         "enabled": True,

@@ -123,32 +123,59 @@ def test_collect_race_with_concurrent_writes():
     """A dashboard scrape (render/collect) racing inc/observe must not
     raise 'dictionary changed size during iteration': collect() now
     copies under the series lock. Hammer with a writer thread churning
-    NEW label values (each insert grows the dict) while readers render."""
+    NEW label values (each insert grows the dict) while readers render.
+    Extended past collect() to the remaining read surface: Histogram
+    count/sum/total_count reads, Registry.register/get racing a full
+    render, and exemplar-carrying observes."""
     import threading
 
     c = metrics.Counter("t_race_total", "t", ("a",))
     h = metrics.Histogram("t_race_h", "t", ("a",), buckets=(1.0, 10.0))
+    reg = metrics.Registry()
+    reg.register(c)
+    reg.register(h)
     stop = threading.Event()
     errors = []
 
-    def writer():
-        i = 0
-        while not stop.is_set():
-            c.inc(f"lbl{i}")
-            h.observe(f"lbl{i}", value=float(i % 20))
-            i += 1
+    def guard(fn):
+        def run():
+            try:
+                while not stop.is_set():
+                    fn()
+            except Exception as e:  # pragma: no cover - the bug
+                errors.append(e)
+        return run
+
+    def writer(state={"i": 0}):
+        i = state["i"] = state["i"] + 1
+        c.inc(f"lbl{i}")
+        h.observe(f"lbl{i}", value=float(i % 20),
+                  exemplar={"cycle": str(i)})
 
     def reader():
-        while not stop.is_set():
-            try:
-                c.collect()
-                h.collect()
-            except RuntimeError as e:  # pragma: no cover - the bug
-                errors.append(e)
-                return
+        c.collect()
+        h.collect()
 
-    threads = [threading.Thread(target=writer)] + [
-        threading.Thread(target=reader) for _ in range(2)]
+    def histo_reader(state={"i": 0}):
+        i = state["i"] = state["i"] + 1
+        h.count(f"lbl{i % 50}")
+        h.sum(f"lbl{i % 50}")
+        h.total_count()
+        h.exemplars(f"lbl{i % 50}")
+
+    def registrar(state={"i": 0}):
+        # late registration racing a scrape grows the series dict
+        i = state["i"] = state["i"] + 1
+        reg.register(metrics.Gauge(f"t_race_g{i % 200}", "t"))
+        reg.get(f"t_race_g{(i * 7) % 200}")
+
+    def renderer():
+        reg.render()
+        reg.render(openmetrics=True)
+
+    threads = [threading.Thread(target=guard(fn)) for fn in
+               (writer, reader, reader, histo_reader, registrar,
+                renderer)]
     for t in threads:
         t.start()
     import time
@@ -157,7 +184,33 @@ def test_collect_race_with_concurrent_writes():
     stop.set()
     for t in threads:
         t.join()
-    assert not errors, f"collect raced a concurrent write: {errors[0]!r}"
+    assert not errors, f"a read raced a concurrent write: {errors[0]!r}"
+
+
+def test_label_values_escaped_in_exposition():
+    """Recorder reason strings and CQ names flow into labels verbatim;
+    backslash, double-quote, and newline must render escaped or the
+    whole exposition corrupts for every scraper."""
+    hostile = 'he said "no fit"\nfor C:\\cluster\\cq'
+    c = metrics.Counter("t_esc_total", "t", ("reason",))
+    c.inc(hostile)
+    h = metrics.Histogram("t_esc_h", "t", ("reason",), buckets=(1.0,))
+    h.observe(hostile, value=0.5,
+              exemplar={"workload": 'ns/"w"\n'})
+    r = metrics.Registry()
+    r.register(c)
+    r.register(h)
+    for text in (r.render(), r.render(openmetrics=True)):
+        assert ('t_esc_total{reason="he said \\"no fit\\"\\nfor '
+                'C:\\\\cluster\\\\cq"} 1') in text
+        # no raw newline may survive inside any sample line
+        for line in text.splitlines():
+            assert line.count('"') % 2 == 0 or "#" in line
+        assert '\nfor C:' not in text.replace("\\n", "")
+    om = r.render(openmetrics=True)
+    assert '# {workload="ns/\\"w\\"\\n"}' in om
+    # the raw value is still queryable under its unescaped key
+    assert c.value(hostile) == 1
 
 
 def test_gauge_replace_prefix_zero_fill_then_drop():

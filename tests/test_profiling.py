@@ -111,6 +111,57 @@ def test_debug_server_endpoints():
         srv.stop()
 
 
+def test_sampling_profiler_start_stop_endpoints():
+    """/debug/pprof/sample/{start,stop}: open-ended background
+    sampling — start now, fetch the report when the incident is over —
+    next to the fixed-window profile endpoint."""
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    srv = DebugServer()
+    srv.start()
+    stop = threading.Event()
+
+    def busy_loop():
+        while not stop.is_set():
+            sum(i for i in range(1000))
+
+    t = threading.Thread(target=busy_loop, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        # stop without a session is a clean 409
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/debug/pprof/sample/stop")
+        assert e.value.code == 409
+        body = urllib.request.urlopen(
+            f"{base}/debug/pprof/sample/start").read().decode()
+        assert "started" in body
+        # double start is a 409, not a second thread
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/debug/pprof/sample/start")
+        assert e.value.code == 409
+        # the fixed-window endpoint refuses while a session is open
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{base}/debug/pprof/profile?seconds=0.05")
+        assert e.value.code == 409
+        time.sleep(0.25)
+        report = urllib.request.urlopen(
+            f"{base}/debug/pprof/sample/stop").read().decode()
+        assert "samples over" in report
+        assert "busy_loop" in report, (
+            "the background sampler sees other threads' stacks")
+        # a fresh session works after stop
+        urllib.request.urlopen(f"{base}/debug/pprof/sample/start")
+        urllib.request.urlopen(f"{base}/debug/pprof/sample/stop")
+    finally:
+        stop.set()
+        srv.stop()
+
+
 def test_tracer_disabled_records_nothing():
     t = Tracer()
     t.enabled = False

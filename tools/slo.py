@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Offline cluster-health report over dumped cycle ledgers.
+
+The live dashboard answers /api/slo and /api/health from the in-process
+ledger and SLO engine; this prints the same per-cycle story from a
+ledger dump (written with ``obs.cycle_ledger.dump_jsonl(path)``, or
+persisted automatically next to checkpoints as ``ledger-*.jsonl``), so
+a post-mortem needs only the dump files.
+
+Usage:
+    python tools/slo.py --ledger ledger.jsonl               # summary
+    python tools/slo.py --ledger ledger.jsonl --cycles 5    # newest rows
+    python tools/slo.py --ledger ledger.jsonl --cycle 17 \
+        --journal decisions.jsonl      # one cycle's ledger+decision join
+    python tools/slo.py --journal decisions.jsonl --slo \
+        --threshold 300 --target 0.99  # recompute queue-wait SLIs
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# allow running straight from a checkout: tools/ sits next to the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kueue_oss_tpu.obs import load_jsonl  # noqa: E402
+from kueue_oss_tpu.obs.health import SLOEngine  # noqa: E402
+from kueue_oss_tpu.obs.ledger import (  # noqa: E402
+    HOST_CYCLE,
+    SOLVER_DRAIN,
+    CycleRecord,
+    load_ledger_jsonl,
+)
+
+
+def _pct(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    values = sorted(values)
+    idx = min(len(values) - 1, int(q * (len(values) - 1) + 0.5))
+    return values[idx]
+
+
+def summarize(rows: list[CycleRecord], out) -> int:
+    if not rows:
+        print("ledger is empty", file=out)
+        return 1
+    host = [r for r in rows if r.kind == HOST_CYCLE]
+    solver = [r for r in rows if r.kind == SOLVER_DRAIN]
+    print(f"{len(rows)} ledger row(s): {len(host)} host cycle(s), "
+          f"{len(solver)} solver drain(s); cycles "
+          f"{rows[0].cycle}..{rows[-1].cycle}", file=out)
+    if host:
+        walls = [r.duration_s * 1000 for r in host]
+        print(f"host cycles: admitted {sum(r.admitted for r in host)}, "
+              f"preempted {sum(r.preempted for r in host)}, "
+              f"skipped {sum(r.skipped for r in host)}; "
+              f"wall p50 {_pct(walls, 0.5):.2f}ms "
+              f"p95 {_pct(walls, 0.95):.2f}ms", file=out)
+        slugs: dict[str, int] = {}
+        for r in host:
+            for slug, n in r.skip_slugs.items():
+                slugs[slug] = slugs.get(slug, 0) + n
+        if slugs:
+            top = sorted(slugs.items(), key=lambda kv: -kv[1])
+            print("skips by reason: " + ", ".join(
+                f"{s}={n}" for s, n in top), file=out)
+    if solver:
+        solves = [r.phases.get("solve", 0.0) * 1000 for r in solver]
+        arms: dict[str, int] = {}
+        frames: dict[str, int] = {}
+        bytes_by_kind: dict[str, int] = {}
+        for r in solver:
+            arms[r.solver_arm] = arms.get(r.solver_arm, 0) + 1
+            frames[r.frame_kind] = frames.get(r.frame_kind, 0) + 1
+            bytes_by_kind[r.frame_kind] = (
+                bytes_by_kind.get(r.frame_kind, 0) + r.frame_bytes)
+        print(f"solver drains: admitted "
+              f"{sum(r.admitted for r in solver)}, parked "
+              f"{sum(r.parked for r in solver)}, evicted "
+              f"{sum(r.evicted for r in solver)}; solve p50 "
+              f"{_pct(solves, 0.5):.2f}ms p95 "
+              f"{_pct(solves, 0.95):.2f}ms", file=out)
+        print("arms: " + ", ".join(f"{a}={n}"
+                                   for a, n in sorted(arms.items())),
+              file=out)
+        print("frames: " + ", ".join(
+            f"{k}={n} ({bytes_by_kind.get(k, 0)}B)"
+            for k, n in sorted(frames.items())), file=out)
+        donated = sum(r.device.get("donated_update_bytes", 0)
+                      for r in solver)
+        avoided = sum(r.device.get("avoided_copy_bytes", 0)
+                      for r in solver)
+        if donated or avoided:
+            print(f"resident buffers: {donated}B donated scatters, "
+                  f"{avoided}B full copies avoided", file=out)
+    return 0
+
+
+def show_rows(rows: list[CycleRecord], n: int, out) -> int:
+    for r in rows[-n:]:
+        print(json.dumps(r.to_dict(), sort_keys=True), file=out)
+    return 0
+
+
+def show_cycle(rows: list[CycleRecord], cycle: int,
+               journal: list, out) -> int:
+    """The ledger↔recorder join for one cycle: every ledger row tagged
+    with the cycle id, then that cycle's decision events."""
+    matched = [r for r in rows if r.cycle == cycle]
+    if not matched:
+        print(f"no ledger rows for cycle {cycle}", file=out)
+        return 1
+    print(f"cycle {cycle}: {len(matched)} ledger row(s)", file=out)
+    for r in matched:
+        print("  " + json.dumps(r.to_dict(), sort_keys=True), file=out)
+    events = [ev for ev in journal if ev.cycle == cycle]
+    if events:
+        print(f"{len(events)} decision event(s) in cycle {cycle}:",
+              file=out)
+        for ev in sorted(events, key=lambda e: e.seq):
+            print(f"  [{ev.path:>6}] {ev.kind:<16} {ev.workload:<40} "
+                  f"{ev.reason_slug or ev.reason[:60]}", file=out)
+    elif journal:
+        print(f"journal holds no events for cycle {cycle}", file=out)
+    return 0
+
+
+def recompute_slo(journal: list, threshold: float, target: float,
+                  out) -> int:
+    """Rebuild the queue-wait SLIs from a journal dump (the admission
+    events carry waitSeconds in their detail) and print burn rates at
+    the journal's final instant — the /api/slo answer, offline."""
+    last_ts = max((ev.ts for ev in journal), default=0.0)
+    eng = SLOEngine(target=target, threshold_s=threshold,
+                    clock=lambda: last_ts)
+    fed = eng.replay_journal(journal)
+    if not fed:
+        print("journal carries no admission waits (pre-health-layer "
+              "dump?)", file=out)
+        return 1
+    report = eng.evaluate(now=last_ts)
+    print(f"{fed} admission(s) replayed; objective "
+          f"{target:.3f} within {threshold}s", file=out)
+    for sli in report["slis"]:
+        a = sli["alert"]
+        line = (f"  {sli['scope']:>9}/{sli['key']:<24} "
+                f"burn fast {sli['burnFast']:>8} slow "
+                f"{sli['burnSlow']:>8}  [{a['state']}]")
+        if a.get("exemplar"):
+            ex = a["exemplar"]
+            line += (f"  exemplar: cycle {ex['cycle']} "
+                     f"{ex['workload']} ({ex['waitSeconds']}s)")
+        print(line, file=out)
+    return 0
+
+
+def main(argv=None, out=None) -> int:
+    out = out or sys.stdout
+    p = argparse.ArgumentParser(
+        prog="slo.py",
+        description="Cluster-health report from dumped cycle ledgers "
+                    "and decision journals.")
+    p.add_argument("--ledger", help="ledger dump path (JSONL, written "
+                                    "by cycle_ledger.dump_jsonl)")
+    p.add_argument("--journal", help="decision journal dump (JSONL) "
+                                     "for joins and SLO recompute")
+    p.add_argument("--cycles", type=int, default=0,
+                   help="print the newest N ledger rows as JSONL")
+    p.add_argument("--cycle", type=int,
+                   help="show one cycle's ledger rows + decision "
+                        "events (the cycle-id join)")
+    p.add_argument("--slo", action="store_true",
+                   help="recompute queue-wait SLIs from --journal")
+    p.add_argument("--threshold", type=float, default=300.0,
+                   help="good-admission wait bound, seconds")
+    p.add_argument("--target", type=float, default=0.99,
+                   help="good-admission target fraction")
+    args = p.parse_args(argv)
+
+    journal = load_jsonl(args.journal) if args.journal else []
+    if args.slo:
+        if not args.journal:
+            p.error("--slo requires --journal")
+        return recompute_slo(journal, args.threshold, args.target, out)
+    if not args.ledger:
+        p.error("--ledger (or --slo with --journal) is required")
+    rows = load_ledger_jsonl(args.ledger)
+    if args.cycle is not None:
+        return show_cycle(rows, args.cycle, journal, out)
+    if args.cycles:
+        return show_rows(rows, args.cycles, out)
+    return summarize(rows, out)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
