@@ -163,6 +163,25 @@ class SolverBackendConfig:
     #: back to auto. Routing between the mesh and single-chip arms
     #: stays adaptive (measured cost EMAs) even when a mesh exists.
     mesh: Optional[str] = None
+    #: convex-relaxation fast-path arm (solver/relax.py,
+    #: docs/SOLVER_PROTOCOL.md "Relaxed fast-path arm"): the fourth
+    #: routing arm — projected-gradient LP + exact rounding-and-repair.
+    #: The cost-EMA router still decides per drain; disabling removes
+    #: the arm entirely.
+    relax_enabled: bool = True
+    #: lean backlogs below this many live workloads never route to the
+    #: relaxed arm (the LP amortizes only on huge contended backlogs)
+    relax_min_workloads: int = 4096
+    #: every Nth relax-served drain also runs the exact kernel and
+    #: demotes the arm on plan divergence (0 disables auditing —
+    #: never recommended in production)
+    relax_audit_every: int = 8
+    #: fixed projected-gradient iteration count (deterministic wall)
+    relax_iters: int = 32
+    #: rounding threshold on the fractional admit vector, in (0, 1)
+    relax_support_threshold: float = 0.5
+    #: demoted-arm cooldown before one re-probe drain
+    relax_retry_cooldown_seconds: float = 300.0
 
 
 @dataclass
@@ -226,6 +245,12 @@ class SimulatorConfig:
     mesh: str = "off"
     #: batches below this width stay single-device even with a mesh
     min_batch_for_mesh: int = 16
+    #: round-skew bucketing: group scenarios by predicted round count
+    #: before the vmapped batch so wide batches stop running every
+    #: lane to the slowest scenario's round count
+    round_bucketing: bool = True
+    #: sweeps below this width dispatch as one batch regardless
+    min_batch_for_bucketing: int = 8
 
 
 @dataclass
@@ -360,6 +385,16 @@ def validate(cfg: Configuration) -> list[str]:
         if m not in known and not m.isdigit():
             errs.append(f"solver.mesh {sv.mesh!r} must be 'auto', 'off', "
                         "or a non-negative device count")
+    if sv.relax_min_workloads < 0:
+        errs.append("solver.relaxMinWorkloads must be >= 0")
+    if sv.relax_audit_every < 0:
+        errs.append("solver.relaxAuditEvery must be >= 0")
+    if sv.relax_iters < 1:
+        errs.append("solver.relaxIters must be >= 1")
+    if not (0.0 < sv.relax_support_threshold < 1.0):
+        errs.append("solver.relaxSupportThreshold must be in (0, 1)")
+    if sv.relax_retry_cooldown_seconds < 0:
+        errs.append("solver.relaxRetryCooldown must be >= 0")
     sim = cfg.simulator
     if sim.max_scenarios < 1:
         errs.append("simulator.maxScenarios must be >= 1")
@@ -367,6 +402,8 @@ def validate(cfg: Configuration) -> list[str]:
         errs.append("simulator.parityScenarios must be >= 0")
     if sim.min_batch_for_mesh < 1:
         errs.append("simulator.minBatchForMesh must be >= 1")
+    if sim.min_batch_for_bucketing < 1:
+        errs.append("simulator.minBatchForBucketing must be >= 1")
     if sim.mesh is not None:
         m = str(sim.mesh).strip().lower()
         known = {"auto", "on", "off", "none", "true", "false", "disabled"}
@@ -533,6 +570,13 @@ def load(data: Optional[dict] = None) -> Configuration:
             "breakerCooldown": ("breaker_cooldown_seconds", float),
             "sessionsEnabled": ("sessions_enabled", bool),
             "mesh": ("mesh", str),
+            "relaxEnabled": ("relax_enabled", bool),
+            "relaxMinWorkloads": ("relax_min_workloads", int),
+            "relaxAuditEvery": ("relax_audit_every", int),
+            "relaxIters": ("relax_iters", int),
+            "relaxSupportThreshold": ("relax_support_threshold", float),
+            "relaxRetryCooldown": ("relax_retry_cooldown_seconds",
+                                   float),
         })
 
     def conv_persist(d: dict) -> PersistenceConfig:
@@ -578,6 +622,8 @@ def load(data: Optional[dict] = None) -> Configuration:
             "padPow2": ("pad_pow2", bool),
             "mesh": ("mesh", str),
             "minBatchForMesh": ("min_batch_for_mesh", int),
+            "roundBucketing": ("round_bucketing", bool),
+            "minBatchForBucketing": ("min_batch_for_bucketing", int),
         })
 
     def conv_integrations(d: dict) -> list[str]:

@@ -576,6 +576,20 @@ solver_shard_imbalance = registry.register(Histogram(
     "((max - min) / mean occupied rows; 0 = perfectly even)", (),
     buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0)))
 
+# -- convex-relaxation fast-path arm (solver/relax.py) -----------------------
+
+solver_relax_drains_total = registry.register(Counter(
+    "kueue_tpu_solver_relax_drains_total",
+    "Relaxed-arm solves by outcome (served = relax plan emitted; "
+    "audit_match / audit_diverged = exact-kernel disagreement audits; "
+    "error = arm fault, drain fell back to an exact arm)",
+    ("outcome",)))
+solver_relax_support_fraction = registry.register(Histogram(
+    "kueue_tpu_solver_relax_support_fraction",
+    "Rounded support size as a fraction of live backlog rows per "
+    "relaxed solve", (),
+    buckets=(0.01, 0.02, 0.05, 0.1, 0.2, 0.35, 0.5, 0.75, 1.0)))
+
 # -- decision flight recorder (obs/) -----------------------------------------
 
 decision_events_total = registry.register(Counter(
@@ -603,6 +617,11 @@ whatif_duration_seconds = registry.register(Histogram(
     "kueue_tpu_whatif_duration_seconds",
     "What-if engine wall time by phase (build/solve/parity/report)",
     ("phase",)))
+whatif_round_buckets_total = registry.register(Counter(
+    "kueue_tpu_whatif_round_buckets_total",
+    "What-if scenarios dispatched per predicted-round-count bucket "
+    "(round-skew bucketing keeps short lanes out of long batches)",
+    ("bucket",)))
 whatif_parity_failures_total = registry.register(Counter(
     "kueue_tpu_whatif_parity_failures_total",
     "What-if batches whose vmapped plans diverged from the sequential "

@@ -24,6 +24,12 @@ Points (see docs/ROBUSTNESS.md fault taxonomy):
   mid_drain            -- SIGKILL after the first N solver-plan
                           admissions committed to the store (a drain
                           interrupted halfway through its apply loop)
+  sidecar_session_store -- SIGKILL inside the solver sidecar's LRU
+                          session store, after a DELTA frame's dirty
+                          rows were applied to the resident problem but
+                          before the epoch advanced / the checksum was
+                          verified (a torn session tail; RESYNC must
+                          rebuild byte-identical state)
 
 ``mode="raise"`` swaps SIGKILL for a :class:`CrashPoint` exception so
 in-process tests can exercise a point without a subprocess.
@@ -39,7 +45,7 @@ import signal
 from typing import Optional
 
 CRASH_POINTS = ("pre_fsync", "torn_tail", "post_fsync_pre_apply",
-                "mid_checkpoint", "mid_drain")
+                "mid_checkpoint", "mid_drain", "sidecar_session_store")
 
 KILL = "kill"
 RAISE = "raise"

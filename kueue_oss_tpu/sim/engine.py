@@ -42,6 +42,7 @@ from kueue_oss_tpu.sim.batch import (
     check_parity,
     pow2,
     solve_scenarios,
+    solve_scenarios_bucketed,
     solve_scenarios_sequential,
 )
 from kueue_oss_tpu.sim.report import WhatIfReport, scenario_kpis
@@ -242,9 +243,20 @@ class WhatIfEngine:
         metrics.whatif_duration_seconds.observe("build", value=build_s)
 
         mesh = self._mesh(len(specs))
-        batch = solve_scenarios(problem, overlays, mesh=mesh,
-                                pad_pow2=self.config.pad_pow2)
-        metrics.whatif_batches_total.inc()
+        if self.config.round_bucketing:
+            # round-skew bucketing (docs/SIMULATOR.md): short scenarios
+            # stop riding the batch to the slowest lane's round count
+            batch, bucket_stats, n_dispatches = solve_scenarios_bucketed(
+                problem, overlays, mesh=mesh,
+                pad_pow2=self.config.pad_pow2,
+                min_batch=self.config.min_batch_for_bucketing)
+        else:
+            batch = solve_scenarios(problem, overlays, mesh=mesh,
+                                    pad_pow2=self.config.pad_pow2)
+            bucket_stats, n_dispatches = {}, 1
+        metrics.whatif_batches_total.inc(by=n_dispatches)
+        for b, n in bucket_stats.items():
+            metrics.whatif_round_buckets_total.inc(str(b), by=n)
         metrics.whatif_scenarios_total.inc("batched", by=len(specs))
         metrics.whatif_batch_width.observe(value=batch.batch_width)
         metrics.whatif_duration_seconds.observe(
@@ -288,6 +300,9 @@ class WhatIfEngine:
             "parity_seconds": round(parity_s, 6),
             "report_seconds": round(report_s, 6),
             "batch_width": batch.batch_width,
+            "batch_dispatches": n_dispatches,
+            "round_buckets": {str(b): n
+                              for b, n in sorted(bucket_stats.items())},
             "mesh_devices": batch.mesh_devices,
             "scenarios_per_sec": round(
                 len(specs) / batch.solve_seconds, 2)

@@ -128,6 +128,26 @@ def refresh_cohort_usage(t: ProblemTensors, usage: jnp.ndarray) -> jnp.ndarray:
     return u
 
 
+def accumulate_full_charge(parent: jnp.ndarray, depth: jnp.ndarray,
+                           values: jnp.ndarray, d_max: int) -> jnp.ndarray:
+    """Sum node-row values into every ancestor WITHOUT local-quota
+    absorption — refresh_cohort_usage's relaxed cousin.
+
+    The exact algebra absorbs each child's local quota on the way up
+    (only the overflow bubbles); the convex relaxation
+    (solver/relax.py) instead prices the AGGREGATE load under each
+    node against that node's total headroom, which is exactly this
+    full-charge accumulation. ``d_max`` is the static ancestor-path
+    width (path.shape[1]).
+    """
+    u = values
+    depth_col = depth[:, None]
+    for d in range(d_max - 1, 0, -1):
+        u = u.at[parent].add(jnp.where(depth_col == d, u, 0),
+                             mode="drop")
+    return u
+
+
 def available_all(t: ProblemTensors, usage: jnp.ndarray) -> jnp.ndarray:
     """available() for every node, level-wise from the roots down.
 

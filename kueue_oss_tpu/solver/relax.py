@@ -1,0 +1,420 @@
+"""Convex-relaxation fast path for the batched admission problem.
+
+The exact lean kernel (kernels._solve_backlog_impl) replays
+priority-ordered rounds whose count grows with per-CQ backlog depth and
+contention; on huge contended backlogs the round loop dominates the
+drain wall. CvxCluster (arXiv 2605.01614) shows that large granular
+allocation problems admit convex relaxations solved orders of magnitude
+faster as dense matrix iterations — exactly the shape that ``jit``,
+``vmap``, and mesh sharding love. This module is that arm:
+
+1. **Relaxation** — the admission LP over a fractional admit vector
+   x ∈ [0, 1]^W maximizing priority-weighted admission subject to one
+   capacity row per (hierarchy node, flavor-resource):
+
+       max  Σ_w s_w x_w
+       s.t. Σ_{w under n}  req_w,f · x_w  ≤  slack_n,f      ∀ (n, f)
+
+   ``slack`` is the node's aggregate headroom: subtree quota plus its
+   borrowing allowance, minus the full-charge total of current CQ
+   usage. Solved by fixed-iteration projected gradient ascent on a
+   quadratic penalty (pure ``jax.numpy``: one fori_loop of segment-sum
+   + ancestor-accumulate + clip per iteration — it jits, vmaps, and
+   shards over the ``wl`` mesh axis trivially; sharded variant in
+   solver/sharded.py:make_sharded_relax_lp).
+
+2. **Rounding** — deterministic support selection on the host: rows
+   with x above the threshold, per-CQ slack rows by relaxed score
+   (ties broken by FIFO rank, so symmetric contention rounds to the
+   exact kernel's FIFO prefix), every live row of StrictFIFO CQs
+   (their heads may never be skipped), and a per-CQ allowance sized by
+   the CQ's fractional mass so the repair pass can fill capacity the
+   threshold underestimated.
+
+3. **Repair** — the EXACT lean kernel, run on the support rows
+   compacted into a small padded subproblem (same node/CQ tensors,
+   gathered workload rows). Whatever it admits is exactly feasible by
+   construction; results scatter back to full workload indices and the
+   emitted plan passes ``SolverEngine._check_plan`` unchanged. Rows
+   outside the support park (BestEffortFIFO) exactly like the exact
+   kernel's quiescent state; StrictFIFO rows never park.
+
+The plan is therefore ALWAYS exactly feasible — approximation error
+can only show up as a different (usually identical, see the router's
+audit in solver/engine.py) admitted set, never as overcommitted quota.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from kueue_oss_tpu.solver.tensors import BIG, SolverProblem, pow2
+
+#: projected-gradient constants: step size, score (objective) weight,
+#: and the quadratic-penalty ramp rho0 * (1 + growth * i / iters). The
+#: LP only has to CONCENTRATE mass and ORDER candidates — the repair
+#: pass is exact — so these favor robustness over last-digit optimality.
+ETA = 0.5
+ALPHA = 0.05
+RHO0 = 1.0
+RHO_GROWTH = 3.0
+
+#: effectively-unbounded capacity for constraint rows that only bind at
+#: an ancestor (non-root nodes without a borrowing limit)
+UNBOUNDED = np.float32(1 << 30)
+
+
+class RelaxLP(NamedTuple):
+    """Device inputs of the relaxed admission LP (jit pytree).
+
+    Workload-axis fields (``r``, ``s``, ``live``, ``wl_cqid``) shard
+    over the mesh ``wl`` axis; node/CQ fields replicate.
+    """
+
+    r: np.ndarray        # [W+1, F] float32 request under the first valid option
+    s: np.ndarray        # [W+1] float32 priority-major, FIFO-minor score
+    live: np.ndarray     # [W+1] bool
+    wl_cqid: np.ndarray  # [W+1] int32
+    cq_node: np.ndarray  # [C] int32
+    path_cq: np.ndarray  # [C, D] int32 ancestor chain of each CQ's node
+    parent: np.ndarray   # [N+1] int32
+    depth: np.ndarray    # [N+1] int32
+    slack: np.ndarray    # [N+1, F] float32 aggregate headroom per node
+    scale: np.ndarray    # [N+1, F] float32 max(slack, 1) normalizer
+
+
+@dataclass
+class RelaxStats:
+    """Diagnostics for one relaxed solve (bench/metrics/ledger)."""
+
+    live: int = 0
+    support: int = 0
+    support_padded: int = 0
+    iters: int = 0
+    lp_seconds: float = 0.0
+    repair_seconds: float = 0.0
+    repair_rounds: int = 0
+    #: final fractional solution (tests/diagnostics; [W+1] float32)
+    x: Optional[np.ndarray] = field(default=None, repr=False)
+
+
+def lp_step_body(lp: RelaxLP, x, i, iters: int, psum_axis=None):
+    """One projected-gradient iteration (shared by the single-chip jit
+    and the shard_map variant, which psums the per-CQ loads over the
+    mesh axis)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kueue_oss_tpu.solver.kernels import accumulate_full_charge
+
+    C = lp.cq_node.shape[0]
+    N1 = lp.parent.shape[0]
+    F = lp.r.shape[1]
+    d_max = lp.path_cq.shape[1]
+    load_cq = jax.ops.segment_sum(lp.r * x[:, None], lp.wl_cqid,
+                                  num_segments=C + 1)[:C]
+    if psum_axis is not None:
+        load_cq = jax.lax.psum(load_cq, psum_axis)
+    u = jnp.zeros((N1, F), lp.r.dtype).at[lp.cq_node].add(load_cq)
+    u = accumulate_full_charge(lp.parent, lp.depth, u, d_max)
+    # RELATIVE violation, clipped: scale-invariant pricing. Normalizing
+    # by scale**2 (the literal quadratic-penalty gradient) crushes the
+    # price on large-capacity rows (a cohort with slack ~10^3 would
+    # price a 5x oversubscription below the score term) — relative
+    # overflow prices a 2x-oversubscribed 8-cpu CQ and a 2x
+    # oversubscribed 2000-cpu cohort identically.
+    over = jnp.clip((u - lp.slack) / lp.scale, 0.0, 1.0)
+    price = over[lp.path_cq].sum(axis=1)              # [C, F]
+    rho = RHO0 * (1.0 + RHO_GROWTH * i / iters)
+    # per-row request normalized by its own largest component, so the
+    # downstep stays O(rho) for any request magnitude (no overshoot
+    # for 100-unit rows, no stall for 1-unit rows)
+    rnorm = lp.r / jnp.maximum(lp.r.max(axis=1, keepdims=True), 1.0)
+    g = ALPHA * lp.s - rho * (rnorm * price[lp.wl_cqid]).sum(axis=1)
+    x = jnp.clip(x + ETA * g, 0.0, 1.0)
+    return jnp.where(lp.live, x, 0.0)
+
+
+def lp_loop(lp: RelaxLP, iters: int, psum_axis=None):
+    """The full fixed-iteration LP solve (trace-time body)."""
+    import jax
+    import jax.numpy as jnp
+
+    x0 = jnp.where(lp.live, jnp.float32(0.5), jnp.float32(0.0))
+    return jax.lax.fori_loop(
+        0, iters,
+        lambda i, x: lp_step_body(lp, x, i, iters, psum_axis), x0)
+
+
+@functools.lru_cache(maxsize=None)
+def _single_lp(iters: int):
+    import jax
+
+    return jax.jit(functools.partial(lp_loop, iters=iters))
+
+
+# ---------------------------------------------------------------------------
+# LP assembly (host)
+# ---------------------------------------------------------------------------
+
+
+def _full_charge_np(parent: np.ndarray, depth: np.ndarray,
+                    values: np.ndarray, d_max: int) -> np.ndarray:
+    """Numpy twin of kernels.accumulate_full_charge for the per-drain
+    constant headroom tensors."""
+    u = values.copy()
+    for d in range(d_max - 1, 0, -1):
+        rows = depth == d
+        np.add.at(u, parent[rows], u[rows])
+    return u
+
+
+def build_lp(problem: SolverProblem) -> RelaxLP:
+    """Assemble the LP tensors from a (padded) lean export."""
+    C = problem.n_cqs
+    W1 = problem.wl_cqid.shape[0]
+    cqid = np.asarray(problem.wl_cqid)
+    valid = np.asarray(problem.wl_valid)
+    live = np.zeros(W1, dtype=bool)
+    live[:-1] = (cqid[:-1] < C) & valid[:-1].any(axis=1)
+
+    # request under the FIRST valid flavor option; the repair pass
+    # re-runs the exact fungibility policy, so the relaxation only
+    # needs one representative request vector per row
+    k0 = np.argmax(valid, axis=1).astype(np.int64)
+    r = np.asarray(problem.wl_req)[np.arange(W1), k0].astype(np.float32)
+    r[~live] = 0.0
+
+    prio = np.asarray(problem.wl_prio).astype(np.float32)
+    ts = np.asarray(problem.wl_ts).astype(np.float32)
+    p_lo = float(prio[live].min()) if live.any() else 0.0
+    p_hi = float(prio[live].max()) if live.any() else 0.0
+    t_hi = float(ts[live].max()) if live.any() else 0.0
+    s = ((prio - p_lo) / max(1.0, p_hi - p_lo)
+         + 0.25 * (1.0 - ts / max(1.0, t_hi))).astype(np.float32)
+    s[~live] = 0.0
+
+    # capacity rows: subtree quota + borrowing allowance (non-root
+    # nodes without a limit only bind at their ancestors), minus the
+    # full-charge total of current CQ usage under the node
+    subtree = np.asarray(problem.subtree).astype(np.float32)
+    extra = np.where(
+        np.asarray(problem.has_borrow),
+        np.asarray(problem.borrow_limit).astype(np.float32),
+        np.where(np.asarray(problem.has_parent)[:, None],
+                 UNBOUNDED, np.float32(0.0)))
+    cap = np.minimum(subtree + extra, UNBOUNDED)
+    is_cq = np.zeros(problem.parent.shape[0], dtype=bool)
+    is_cq[problem.cq_node] = True
+    usage_cq = np.where(is_cq[:, None],
+                        np.asarray(problem.usage0), 0).astype(np.float32)
+    d_max = problem.path.shape[1]
+    base = _full_charge_np(np.asarray(problem.parent),
+                           np.asarray(problem.depth), usage_cq, d_max)
+    slack = np.maximum(cap - base, 0.0).astype(np.float32)
+    scale = np.maximum(slack, 1.0).astype(np.float32)
+
+    return RelaxLP(
+        r=r, s=s, live=live, wl_cqid=cqid.astype(np.int32),
+        cq_node=np.asarray(problem.cq_node).astype(np.int32),
+        path_cq=np.asarray(problem.path)[problem.cq_node].astype(np.int32),
+        parent=np.asarray(problem.parent).astype(np.int32),
+        depth=np.asarray(problem.depth).astype(np.int32),
+        slack=slack, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# Rounding: deterministic support selection (host)
+# ---------------------------------------------------------------------------
+
+
+def strict_rows(problem: SolverProblem) -> np.ndarray:
+    """[W+1] mask of rows whose CQ is StrictFIFO — the ONE definition
+    of the strict-semantics rule both the rounding (strict rows always
+    join the support) and the plan assembly (strict rows never park)
+    share."""
+    cq = np.asarray(problem.wl_cqid)
+    strict = np.zeros(cq.shape[0], dtype=bool)
+    m = cq < problem.n_cqs
+    strict[m] = np.asarray(problem.cq_strict)[cq[m]].astype(bool)
+    return strict
+
+
+def rounded_support(x: np.ndarray, problem: SolverProblem,
+                    live: np.ndarray, threshold: float = 0.5,
+                    slack_frac: float = 0.25, slack_min: int = 4,
+                    strict: Optional[np.ndarray] = None) -> np.ndarray:
+    """Boolean support mask over the real workload rows [W].
+
+    Selected: live rows with x >= threshold; every live StrictFIFO row
+    (a strict head must never be skipped — admitting past it would
+    diverge from the reference's blocking semantics); and per CQ, extra
+    rows by (-x, FIFO rank) up to an allowance of
+    ``slack_min + ceil(slack_frac * selected + unselected fractional
+    mass)`` — the mass term sizes the allowance to the capacity the LP
+    thinks is still fillable, so a diffuse symmetric solution still
+    rounds to the exact kernel's FIFO prefix.
+    """
+    C = problem.n_cqs
+    W = problem.wl_cqid.shape[0] - 1
+    cq = np.asarray(problem.wl_cqid)[:W]
+    livew = np.asarray(live)[:W]
+    xw = np.asarray(x)[:W]
+    if strict is None:
+        strict = strict_rows(problem)
+    sel = livew & ((xw >= threshold) | strict[:W])
+    cand = np.nonzero(livew & ~sel)[0]
+    if cand.size:
+        rank = np.asarray(problem.wl_rank)[:W]
+        order = cand[np.lexsort((rank[cand], -xw[cand], cq[cand]))]
+        cqs = cq[order]
+        starts = np.r_[True, cqs[1:] != cqs[:-1]]
+        idx = np.arange(order.size)
+        gi = idx - np.maximum.accumulate(np.where(starts, idx, 0))
+        n_sel = np.bincount(cq[sel], minlength=C + 1)
+        mass = np.bincount(cq[cand], weights=xw[cand], minlength=C + 1)
+        allow = (slack_min
+                 + np.ceil(slack_frac * n_sel + mass)).astype(np.int64)
+        sel[order[gi < allow[cqs]]] = True
+    return sel
+
+
+# ---------------------------------------------------------------------------
+# Repair: the exact lean kernel on the compacted support
+# ---------------------------------------------------------------------------
+
+
+def restrict_problem(problem: SolverProblem, sel_idx: np.ndarray,
+                     target_w: int) -> SolverProblem:
+    """Compact a padded lean problem to the support rows (+ inert null
+    fills up to ``target_w`` and the trailing null row). Node/CQ
+    tensors are untouched; per-CQ FIFO rank ORDER is preserved because
+    the gather keeps ascending row order and ranks ride along."""
+    W1 = problem.wl_cqid.shape[0]
+    rows = np.concatenate([
+        np.asarray(sel_idx, dtype=np.int64),
+        np.full(target_w + 1 - len(sel_idx), W1 - 1, dtype=np.int64),
+    ])
+    return dataclasses.replace(
+        problem,
+        wl_cqid=np.ascontiguousarray(problem.wl_cqid[rows]),
+        wl_rank=np.ascontiguousarray(problem.wl_rank[rows]),
+        wl_prio=np.ascontiguousarray(problem.wl_prio[rows]),
+        wl_ts=np.ascontiguousarray(problem.wl_ts[rows]),
+        wl_uid=np.ascontiguousarray(problem.wl_uid[rows]),
+        wl_req=np.ascontiguousarray(problem.wl_req[rows]),
+        wl_valid=np.ascontiguousarray(problem.wl_valid[rows]),
+    )
+
+
+def repair(problem: SolverProblem, sel: np.ndarray, live: np.ndarray,
+           pad_to: int = 0,
+           strict: Optional[np.ndarray] = None) -> tuple[tuple, RelaxStats]:
+    """Run the exact lean kernel on the rounded support and scatter the
+    plan back to full workload indices.
+
+    Returns the full ``solve_backlog`` contract — (admitted, opt,
+    admit_round, parked, rounds, usage), numpy, [W+1]-shaped — plus
+    stats. ``pad_to`` is the caller's sticky support pad target so
+    steady-state drains reuse one compiled repair program.
+    """
+    from kueue_oss_tpu.solver.kernels import solve_backlog, to_device
+
+    W1 = problem.wl_cqid.shape[0]
+    sel_idx = np.nonzero(sel)[0]
+    S = len(sel_idx)
+    target = max(pow2(S + 1) - 1, pad_to)
+    stats = RelaxStats(live=int(np.asarray(live)[:-1].sum()), support=S,
+                       support_padded=target)
+
+    t0 = time.monotonic()
+    sub = restrict_problem(problem, sel_idx, target)
+    out = solve_backlog(to_device(sub))
+    out = tuple(np.asarray(a) for a in out)
+    stats.repair_seconds = time.monotonic() - t0
+
+    adm_s, opt_s, round_s, parked_s, rounds, usage = out
+    admitted = np.zeros(W1, dtype=bool)
+    opt = np.zeros(W1, dtype=np.int32)
+    admit_round = np.zeros(W1, dtype=np.int32)
+    admitted[sel_idx] = adm_s[:S].astype(bool)
+    opt[sel_idx] = opt_s[:S]
+    admit_round[sel_idx] = np.where(adm_s[:S].astype(bool),
+                                    round_s[:S], 0)
+    # rows the plan leaves unadmitted park exactly like the exact
+    # kernel's quiescent state: every live BestEffortFIFO row; never a
+    # StrictFIFO row (their heads block in place)
+    if strict is None:
+        strict = strict_rows(problem)
+    parked = np.asarray(live, dtype=bool) & ~admitted & ~strict
+    parked[-1] = False
+    admitted[-1] = False
+    stats.repair_rounds = int(rounds)
+    return (admitted, opt, admit_round, parked, rounds, usage), stats
+
+
+# ---------------------------------------------------------------------------
+# The whole arm
+# ---------------------------------------------------------------------------
+
+
+def solve_relaxed(problem: SolverProblem, *, iters: int = 32,
+                  threshold: float = 0.5, mesh=None,
+                  pad_to: int = 0) -> tuple[tuple, RelaxStats]:
+    """Relax → round → repair one padded lean problem.
+
+    With a ``mesh`` (whose width divides the padded axis) the LP
+    iterations run sharded over the ``wl`` axis; the repair subproblem
+    is small by construction and stays single-chip. The emitted plan is
+    exactly feasible (it IS a lean-kernel plan over the support) and
+    passes the engine's ``_check_plan`` unchanged.
+    """
+    lp = build_lp(problem)
+    t0 = time.monotonic()
+    if mesh is not None:
+        from kueue_oss_tpu.solver import meshutil
+
+        if meshutil.mesh_divisible(mesh, lp.r.shape[0]):
+            fn = meshutil.relax_mesh_lp(mesh, iters)
+        else:
+            fn = _single_lp(iters)
+    else:
+        fn = _single_lp(iters)
+    x = np.asarray(fn(lp))
+    lp_seconds = time.monotonic() - t0
+
+    strict = strict_rows(problem)
+    sel = rounded_support(x, problem, lp.live, threshold=threshold,
+                          strict=strict)
+    out, stats = repair(problem, sel, lp.live, pad_to=pad_to,
+                        strict=strict)
+    stats.iters = iters
+    stats.lp_seconds = lp_seconds
+    stats.x = x
+    return out, stats
+
+
+def plans_agree(plan_a: tuple, plan_b: tuple, n_workloads: int) -> bool:
+    """Semantic plan equality over the real rows: same admitted set,
+    same parked set, same chosen flavor option per admitted row.
+    ``admit_round``/``rounds`` are NOT compared — the relaxed arm's
+    repair runs over a compacted axis, so its round numbering differs
+    while the decisions (and the per-round apply order they induce
+    within a CQ) do not.
+    """
+    W = n_workloads
+    adm_a = np.asarray(plan_a[0])[:W].astype(bool)
+    adm_b = np.asarray(plan_b[0])[:W].astype(bool)
+    if not np.array_equal(adm_a, adm_b):
+        return False
+    if not np.array_equal(np.asarray(plan_a[3])[:W].astype(bool),
+                          np.asarray(plan_b[3])[:W].astype(bool)):
+        return False
+    return bool(np.array_equal(np.asarray(plan_a[1])[:W][adm_a],
+                               np.asarray(plan_b[1])[:W][adm_b]))

@@ -63,6 +63,7 @@ from typing import Optional
 import numpy as np
 
 from kueue_oss_tpu import metrics
+from kueue_oss_tpu.persist import hooks as persist_hooks
 from kueue_oss_tpu.solver.delta import (
     ARRAY_FIELDS,
     META_FIELDS,
@@ -391,6 +392,12 @@ def _session_request(header: dict, blob: bytes,
                 return _resync("epoch_mismatch")
             delta = deserialize_delta(header, blob)
             apply_delta(sess.kwargs, sess.meta, delta)
+            # torn-tail kill point (docs/ROBUSTNESS.md): the delta's
+            # dirty rows are applied but the epoch has not advanced and
+            # the checksum is unverified — a SIGKILL here leaves (or, in
+            # raise mode, simulates) torn resident session state that
+            # the next drain must detect and heal through RESYNC
+            persist_hooks.crash_if("sidecar_session_store")
             sess.epoch = delta.epoch
             if state_checksum(sess.kwargs, sess.meta) != delta.checksum:
                 # resident state diverged from the host's: drop the

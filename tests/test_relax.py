@@ -1,0 +1,390 @@
+"""Convex-relaxation fast-path solver arm (solver/relax.py,
+docs/SOLVER_PROTOCOL.md "Relaxed fast-path arm").
+
+Correctness contract under test:
+
+1. exact feasibility — every relaxed-arm plan is a lean-kernel plan
+   over the rounded support: it passes the engine's ``_check_plan``
+   and commits through the host oracle verify without a single
+   rejection, whatever the LP did;
+2. rounding-and-repair parity (randomized property) — the emitted plan
+   is BIT-IDENTICAL to independently running the exact lean kernel on
+   the compacted support problem and scattering the results back;
+3. symmetric contention rounds to the exact kernel's FIFO prefix (the
+   support's rank tie-break), so the audit sees agreement on the
+   shapes the arm is built for;
+4. StrictFIFO rows are always in the support and never park;
+5. the disagreement audit demotes the arm (exact plan emitted, fallback
+   counted, cooldown re-probe) and an arm fault falls through the
+   relax -> mesh/single-chip chain without losing the drain.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_oss_tpu import metrics
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.solver import relax
+from kueue_oss_tpu.solver.engine import SolverEngine
+from kueue_oss_tpu.solver.kernels import solve_backlog, to_device
+from kueue_oss_tpu.solver.tensors import pad_workloads, pow2
+
+pytestmark = pytest.mark.relax
+
+
+def _store(n_cqs=4, quota=8, strict=()):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="f"))
+    for i in range(n_cqs):
+        store.upsert_cluster_queue(ClusterQueue(
+            name=f"cq{i}",
+            queueing_strategy=("StrictFIFO" if i in strict
+                               else "BestEffortFIFO"),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f", resources=[
+                    ResourceQuota(name="cpu", nominal=quota)])])]))
+        store.upsert_local_queue(LocalQueue(
+            name=f"lq{i}", cluster_queue=f"cq{i}"))
+    return store
+
+
+def _add(store, i, cpu=1, prio=0, n_cqs=4):
+    store.add_workload(Workload(
+        name=f"w{i}", queue_name=f"lq{i % n_cqs}", uid=i + 1,
+        priority=prio, creation_time=float(i),
+        podsets=[PodSet(name="main", count=1, requests={"cpu": cpu})]))
+
+
+def _padded_problem(store):
+    queues = QueueManager(store)
+    engine = SolverEngine(store, queues)
+    problem, _ = engine.export()
+    return pad_workloads(problem, pow2(problem.n_workloads)), engine
+
+
+def _exact(problem):
+    return tuple(np.asarray(a) for a in solve_backlog(to_device(problem)))
+
+
+# ---------------------------------------------------------------------------
+# plan feasibility + agreement on the arm's home shapes
+# ---------------------------------------------------------------------------
+
+
+def test_symmetric_contention_matches_exact_and_passes_guard():
+    """Uniform contended FIFO backlog: the relaxed plan must equal the
+    exact kernel's (the support's rank tie-break rounds a symmetric
+    fractional solution to the FIFO prefix) and pass _check_plan."""
+    store = _store(n_cqs=4, quota=8)
+    for i in range(64):
+        _add(store, i)
+    problem, _engine = _padded_problem(store)
+    exact = _exact(problem)
+    out, stats = relax.solve_relaxed(problem)
+    assert relax.plans_agree(out, exact, problem.n_workloads)
+    assert 0 < stats.support <= stats.live
+    assert SolverEngine._plan_fault(
+        problem, out[0], out[1], out[2], out[3], None, out[4],
+        False) is None
+
+
+def test_priority_ordering_survives_relaxation():
+    """High-priority rows must win the contended seats, exactly like
+    the exact kernel (the LP's score term orders the support)."""
+    store = _store(n_cqs=1, quota=4)
+    for i in range(16):
+        _add(store, i, prio=(2 if i >= 12 else 0), n_cqs=1)
+    problem, _engine = _padded_problem(store)
+    exact = _exact(problem)
+    out, _stats = relax.solve_relaxed(problem)
+    assert relax.plans_agree(out, exact, problem.n_workloads)
+    admitted = np.nonzero(out[0][:problem.n_workloads])[0]
+    # all four priority-2 workloads (w12..w15) hold the four seats
+    names = {problem.wl_keys[w].rsplit("/", 1)[-1] for w in admitted}
+    assert names == {"w12", "w13", "w14", "w15"}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_repair_is_bit_identical_to_lean_kernel_on_support(seed):
+    """Randomized property: solve_relaxed's output == the exact lean
+    kernel run on restrict_problem(rounded support), scattered back.
+    The emitted plan IS a lean-kernel plan — approximation can only
+    pick the support, never bend feasibility."""
+    rng = np.random.default_rng(seed)
+    n_cqs = int(rng.integers(2, 6))
+    store = _store(n_cqs=n_cqs, quota=int(rng.integers(3, 12)))
+    for i in range(int(rng.integers(24, 72))):
+        _add(store, i, cpu=int(rng.integers(1, 4)),
+             prio=int(rng.integers(0, 3)), n_cqs=n_cqs)
+    problem, _engine = _padded_problem(store)
+    out, stats = relax.solve_relaxed(problem)
+
+    # independent reconstruction from the same fractional solution
+    lp = relax.build_lp(problem)
+    sel = relax.rounded_support(stats.x, problem, lp.live)
+    sel_idx = np.nonzero(sel)[0]
+    target = max(pow2(len(sel_idx) + 1) - 1, 0)
+    sub = relax.restrict_problem(problem, sel_idx, target)
+    ref = _exact(sub)
+    W1 = problem.wl_cqid.shape[0]
+    adm = np.zeros(W1, dtype=bool)
+    adm[sel_idx] = ref[0][:len(sel_idx)].astype(bool)
+    assert np.array_equal(out[0], adm)
+    opt = np.zeros(W1, dtype=np.int32)
+    opt[sel_idx] = ref[1][:len(sel_idx)]
+    assert np.array_equal(out[1][adm], opt[adm])
+    assert int(out[4]) == int(ref[4])
+    # feasibility guard holds for every seed
+    assert SolverEngine._plan_fault(
+        problem, out[0], out[1], out[2], out[3], None, out[4],
+        False) is None
+    # parked is exactly: live, unadmitted, BestEffortFIFO
+    assert not (out[3] & out[0]).any()
+    assert not out[3][~np.asarray(lp.live)].any()
+
+
+def test_strict_fifo_rows_ride_the_support_and_never_park():
+    """StrictFIFO heads block in place: every live strict row joins the
+    support, none parks, and the plan equals the exact kernel's."""
+    store = _store(n_cqs=2, quota=4, strict=(0,))
+    # strict cq0's head does NOT fit; followers must stay blocked
+    _add(store, 0, cpu=6, n_cqs=2)
+    for i in range(2, 20):
+        _add(store, i, cpu=1, n_cqs=2)
+    problem, _engine = _padded_problem(store)
+    exact = _exact(problem)
+    out, stats = relax.solve_relaxed(problem)
+    assert relax.plans_agree(out, exact, problem.n_workloads)
+    cq = np.asarray(problem.wl_cqid)[:problem.n_workloads]
+    strict_rows = cq == 0
+    assert not out[3][:problem.n_workloads][strict_rows].any()
+    # the blocked strict queue admitted nothing past its stuck head
+    assert not out[0][:problem.n_workloads][strict_rows].any()
+
+
+def test_zero_backlog_cq_and_empty_support_are_inert():
+    """A CQ with zero quota parks everything (BestEffortFIFO) without
+    faulting the guard, matching the exact kernel."""
+    store = _store(n_cqs=2, quota=0)
+    for i in range(12):
+        _add(store, i, n_cqs=2)
+    problem, _engine = _padded_problem(store)
+    exact = _exact(problem)
+    out, _stats = relax.solve_relaxed(problem)
+    assert relax.plans_agree(out, exact, problem.n_workloads)
+    assert int(out[0].sum()) == 0
+    assert int(out[3][:problem.n_workloads].sum()) == 12
+
+
+# ---------------------------------------------------------------------------
+# engine integration: drains, oracle verify, audit, fallback
+# ---------------------------------------------------------------------------
+
+
+def _engine(store, **knobs):
+    queues = QueueManager(store)
+    eng = SolverEngine(store, queues)
+    eng.relax_force = True
+    eng.relax_audit_every = 0
+    for k, v in knobs.items():
+        setattr(eng, k, v)
+    return eng
+
+
+def test_engine_relax_drain_commits_and_passes_oracle_verify():
+    store = _store(n_cqs=4, quota=8)
+    for i in range(64):
+        _add(store, i)
+    eng = _engine(store)
+    rejected0 = metrics.solver_plan_fallbacks_total.total()
+    result = eng.drain(now=0.0, verify=True)
+    assert eng.last_drain_arm == "relax"
+    assert result.admitted == 32  # 4 CQs x 8 cpu
+    # the host oracle re-check rejected NOTHING: the plan is exactly
+    # feasible by construction
+    assert metrics.solver_plan_fallbacks_total.total() == rejected0
+    parked = sum(len(q.inadmissible) for q in eng.queues.queues.values())
+    assert parked == 32
+
+
+def test_engine_relax_drain_passes_check_plan_unchanged():
+    """Route the relax plan through the same guard imported plans face:
+    a drain with the guard forced on must not reject it."""
+    store = _store(n_cqs=4, quota=8)
+    for i in range(48):
+        _add(store, i)
+    eng = _engine(store)
+    orig = eng._local_solve
+    checked = []
+
+    def guarded(problem, frame, **kw):
+        out = orig(problem, frame, **kw)
+        eng._check_plan(problem, np.asarray(out[0]), np.asarray(out[1]),
+                        np.asarray(out[2]), np.asarray(out[3]),
+                        rounds=out[4], full=kw.get("full", False))
+        checked.append(True)
+        return out
+
+    eng._local_solve = guarded
+    eng.drain(now=0.0)
+    assert checked
+
+
+def test_audit_match_emits_exact_plan_and_counts():
+    store = _store(n_cqs=4, quota=8)
+    for i in range(64):
+        _add(store, i)
+    eng = _engine(store, relax_audit_every=1)
+    match0 = metrics.solver_relax_drains_total.collect().get(
+        ("audit_match",), 0)
+    result = eng.drain(now=0.0)
+    assert result.admitted == 32
+    assert eng.last_relax_audit is True
+    assert metrics.solver_relax_drains_total.collect().get(
+        ("audit_match",), 0) == match0 + 1
+    assert not eng._relax_broken
+
+
+def test_seeded_divergence_demotes_arm_and_falls_back_exact():
+    """Seeded chaos: corrupt the relaxed plan (drop the top admitted
+    row) on an audited drain. The audit must demote the arm, count the
+    fallback, emit the EXACT plan (admissions unharmed), and re-probe
+    after the cooldown."""
+    store = _store(n_cqs=4, quota=8)
+    for i in range(64):
+        _add(store, i)
+    eng = _engine(store, relax_audit_every=1)
+    rng = np.random.default_rng(7)
+    real = relax.solve_relaxed
+
+    def corrupt(problem, **kw):
+        out, stats = real(problem, **kw)
+        admitted = np.asarray(out[0]).copy()
+        parked = np.asarray(out[3]).copy()
+        hit = rng.choice(np.nonzero(admitted[:-1])[0])
+        admitted[hit] = False  # seeded plan divergence
+        parked[hit] = True
+        return (admitted, out[1], out[2], parked, out[4], out[5]), stats
+
+    fb0 = metrics.solver_fallback_total.collect().get(
+        ("relax_disagreement",), 0)
+    div0 = metrics.solver_relax_drains_total.collect().get(
+        ("audit_diverged",), 0)
+    relax.solve_relaxed = corrupt
+    try:
+        result = eng.drain(now=0.0)
+    finally:
+        relax.solve_relaxed = real
+    # the audited drain emitted the exact plan: nothing was lost
+    assert result.admitted == 32
+    assert eng.last_relax_audit is False
+    assert eng._relax_broken
+    assert metrics.solver_fallback_total.collect().get(
+        ("relax_disagreement",), 0) == fb0 + 1
+    assert metrics.solver_relax_drains_total.collect().get(
+        ("audit_diverged",), 0) == div0 + 1
+
+    # while demoted, the arm never engages (cooldown)
+    for k in [k for k, w in store.workloads.items()
+              if w.is_quota_reserved][:8]:
+        sched_finish(eng, k, now=1.0)
+    eng.drain(now=1.0)
+    assert eng.last_drain_arm != "relax"
+
+    # cooldown elapsed: one probe drain re-measures the arm
+    eng._relax_broken_at -= eng.relax_retry_cooldown_s + 1
+    for k in [k for k, w in store.workloads.items()
+              if w.is_quota_reserved and not w.is_finished][:8]:
+        sched_finish(eng, k, now=2.0)
+    result = eng.drain(now=2.0)
+    assert not eng._relax_broken
+    assert eng.last_relax_audit is True
+
+
+def sched_finish(eng, key, now):
+    """Finish an admitted workload through the scheduler state machine
+    (frees capacity and re-heaps parked entries)."""
+    from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+    if eng.scheduler is None:
+        eng.scheduler = Scheduler(eng.store, eng.queues)
+    eng.scheduler.finish_workload(key, now=now)
+
+
+def test_relax_fault_falls_through_to_exact_chain():
+    store = _store(n_cqs=4, quota=8)
+    for i in range(48):
+        _add(store, i)
+    eng = _engine(store)
+
+    def boom(arm):
+        if arm == "relax":
+            raise RuntimeError("injected relax fault")
+
+    eng.solve_fault_hook = boom
+    err0 = metrics.solver_fallback_total.collect().get(
+        ("relax_error",), 0)
+    result = eng.drain(now=0.0)
+    assert result.admitted == 32
+    assert eng.last_drain_arm in ("single", "mesh")
+    assert eng._relax_broken
+    assert metrics.solver_fallback_total.collect().get(
+        ("relax_error",), 0) == err0 + 1
+
+
+def test_router_probes_relax_only_after_exact_baseline():
+    """4-arm cost-EMA routing: no exact estimate -> no relax probe;
+    with one, the arm probes, then engages only while cheaper, and the
+    losing estimate decays toward a re-probe."""
+    store = _store()
+    eng = SolverEngine(store, QueueManager(store))
+    eng.relax_min_workloads = 10
+    assert not eng._pick_relax_arm(50)           # no exact baseline yet
+    eng._arm_ema[("lean", "single")] = 1e-4
+    assert eng._pick_relax_arm(50)               # probe
+    assert not eng._pick_relax_arm(5)            # below the floor
+    eng._arm_ema[("lean", "relax")] = 2e-4       # measured slower
+    assert not eng._pick_relax_arm(50)
+    assert eng._arm_ema[("lean", "relax")] < 2e-4  # loser decays
+    eng._arm_ema[("lean", "relax")] = 5e-5       # measured faster
+    assert eng._pick_relax_arm(50)
+    eng.relax_enabled = False
+    assert not eng._pick_relax_arm(50)
+
+
+def test_mesh_sharded_lp_plans_match_single_chip(eight_devices):
+    """The shard_map LP (one psum of the [C, F] load matrix per
+    iteration) must produce the same PLAN as the single-chip LP —
+    float summation order may wiggle x, the rounded support + exact
+    repair must not."""
+    from kueue_oss_tpu.solver import meshutil
+
+    mesh = meshutil.detect_mesh("8")
+    assert mesh is not None
+    store = _store(n_cqs=4, quota=8)
+    for i in range(60):
+        _add(store, i, prio=i % 2)
+    queues = QueueManager(store)
+    engine = SolverEngine(store, queues)
+    problem, _ = engine.export()
+    target = meshutil.align_pad_target(pow2(problem.n_workloads), mesh)
+    problem = pad_workloads(problem, target)
+    W1 = problem.wl_cqid.shape[0]
+    assert W1 % 8 == 0, W1
+    out_single, _ = relax.solve_relaxed(problem, mesh=None)
+    out_mesh, _ = relax.solve_relaxed(problem, mesh=mesh)
+    assert relax.plans_agree(out_mesh, out_single, problem.n_workloads)
+    exact = _exact(problem)
+    assert relax.plans_agree(out_mesh, exact, problem.n_workloads)

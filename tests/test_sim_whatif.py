@@ -50,6 +50,7 @@ from kueue_oss_tpu.solver.tensors import (
     ExportCache,
     export_problem,
     pad_workloads,
+    pow2,
 )
 
 pytestmark = pytest.mark.sim
@@ -465,3 +466,62 @@ def test_cli_journal_anchor(tmp_path, capsys):
     rep = json.loads(capsys.readouterr().out)
     assert rep["journal"]["replay_faithful"] is True
     assert rep["journal"]["admitted"] > 0
+
+
+# -- round-skew bucketing (sim/batch.py solve_scenarios_bucketed) -----------
+
+
+def test_round_bucketing_bit_identical_with_bucket_stats():
+    """Bucketing by predicted round count must change WALL SHAPE only:
+    stitched per-scenario plans stay bitwise identical to the single
+    unbucketed dispatch, and the bucket stats cover every scenario."""
+    from kueue_oss_tpu.sim.batch import (
+        predict_rounds,
+        solve_scenarios_bucketed,
+    )
+
+    store, _ = _contended_store()
+    problem = export_problem(
+        store, pending_backlog(store),
+        cache=ExportCache(store, subscribe=False))
+    problem = pad_workloads(problem, pow2(problem.n_workloads))
+    # arrival scales spread predicted depths across multiple buckets
+    specs = arrival_sweep((0.1, 0.15, 0.2, 1.0, 1.0, 0.9, 0.1, 1.0))
+    overlays = [s.overlay(problem, arrival_idx=None) for s in specs]
+    preds = predict_rounds(problem, overlays)
+    assert len(set(int(p) for p in preds)) > 1
+
+    plain = solve_scenarios(problem, overlays)
+    bucketed, stats, dispatches = solve_scenarios_bucketed(
+        problem, overlays, min_batch=2)
+    assert dispatches >= 2
+    assert sum(stats.values()) == len(specs)
+    for name in ("admitted", "opt", "admit_round", "parked", "rounds",
+                 "usage"):
+        assert np.array_equal(getattr(plain, name),
+                              getattr(bucketed, name)), name
+
+
+def test_engine_reports_round_buckets_and_metrics():
+    store, _ = _contended_store()
+    specs = _grid(16)
+    before = {k: v for k, v in
+              metrics.whatif_round_buckets_total.collect().items()}
+    report = WhatIfEngine(store).run(specs, parity=8)
+    assert report.parity["identical"], report.parity["mismatches"]
+    buckets = report.timing["round_buckets"]
+    assert sum(buckets.values()) == len(specs)
+    assert report.timing["batch_dispatches"] >= 1
+    after = metrics.whatif_round_buckets_total.collect()
+    assert sum(after.values()) - sum(before.values()) == len(specs)
+
+
+def test_round_bucketing_off_is_single_dispatch():
+    from kueue_oss_tpu.config.configuration import SimulatorConfig
+
+    store, _ = _contended_store()
+    cfg = SimulatorConfig(round_bucketing=False)
+    report = WhatIfEngine(store, config=cfg).run(_grid(12), parity=4)
+    assert report.parity["identical"]
+    assert report.timing["batch_dispatches"] == 1
+    assert report.timing["round_buckets"] == {}

@@ -697,3 +697,181 @@ def test_meshless_client_learns_sidecar_width_and_repads(server):
     sess = next(iter(srv.sessions.values()))
     assert sess.device.mesh_placed
     assert sess.kwargs["wl_cqid"].shape[0] % 8 == 0
+
+
+# ---------------------------------------------------------------------------
+# sidecar session-store torn-tail kill point (persist/hooks.py
+# "sidecar_session_store"; docs/ROBUSTNESS.md)
+# ---------------------------------------------------------------------------
+
+
+def test_sidecar_torn_delta_crash_point_heals_byte_identical(server):
+    """RAISE-mode torn tail: the crash point fires after a DELTA's
+    dirty rows were applied to the sidecar's resident session but
+    before the epoch advanced — torn state the next drain must heal
+    with a full SYNC that rebuilds BYTE-IDENTICAL session state."""
+    from kueue_oss_tpu.persist import hooks as persist_hooks
+    from kueue_oss_tpu.solver.resilience import SolverUnavailable
+
+    path, srv = server
+    store = _store()
+    for i in range(48):
+        store.add_workload(_wl(i))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched,
+                          remote=SolverClient(path))
+    engine.pad_to = 128
+    engine.drain(now=0.0)
+    _churn_run(engine, store, sched, cycles=2)
+    assert engine.remote.frames_by_kind.get("delta", 0) >= 1
+
+    persist_hooks.arm("sidecar_session_store", mode=persist_hooks.RAISE)
+    try:
+        with pytest.raises(SolverUnavailable):
+            _churn_run(engine, store, sched, cycles=1)
+    finally:
+        persist_hooks.disarm()
+    # torn: the delta's rows were applied but the epoch never advanced
+    # — the session records an epoch whose state it no longer holds, so
+    # the next DELTA against it cannot apply cleanly
+    sidecar = next(iter(srv.sessions.values()))
+    host_sess = engine._delta_sessions["full"]
+    assert sidecar.epoch < host_sess.epoch
+
+    # the next drain heals through a full SYNC (stale-epoch client
+    # fallback); the rebuilt state is byte-identical to the host's
+    _churn_run(engine, store, sched, cycles=1)
+    sidecar = next(iter(srv.sessions.values()))
+    host_kwargs, host_meta = engine._delta_sessions["full"]._last
+    assert sidecar.meta == host_meta
+    for name, arr in host_kwargs.items():
+        if arr is None:
+            assert sidecar.kwargs[name] is None, name
+        else:
+            assert np.array_equal(sidecar.kwargs[name], arr), name
+    assert (state_checksum(sidecar.kwargs, sidecar.meta)
+            == state_checksum(host_kwargs, host_meta))
+    # steady state resumes on deltas against the healed base
+    deltas0 = engine.remote.frames_by_kind.get("delta", 0)
+    _churn_run(engine, store, sched, cycles=1)
+    assert engine.remote.frames_by_kind.get("delta", 0) == deltas0 + 1
+
+
+def _spawn_sidecar(path, crash_env=None):
+    """A real sidecar subprocess (arming crash points from its env),
+    ready once its socket accepts."""
+    import socket as socket_mod
+    import subprocess
+    import sys
+    import time as time_mod
+
+    code = (
+        "import os\n"
+        "from kueue_oss_tpu.persist import hooks\n"
+        "hooks.arm_from_env()\n"
+        "from kueue_oss_tpu.solver.service import SolverServer\n"
+        f"SolverServer({path!r}, mesh_mode='off').serve_forever()\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(crash_env or {})
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env)
+    deadline = time_mod.monotonic() + 60
+    while time_mod.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError("sidecar subprocess died during startup")
+        try:
+            s = socket_mod.socket(socket_mod.AF_UNIX,
+                                  socket_mod.SOCK_STREAM)
+            s.connect(path)
+            s.close()
+            return proc
+        except OSError:
+            time_mod.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("sidecar subprocess never came up")
+
+
+def test_sidecar_sigkill_torn_session_resync_rebuilds(tmp_path):
+    """Real SIGKILL torn tail + session_missing RESYNC, end to end:
+
+    1. the armed crash point SIGKILLs the sidecar mid-DELTA (rows
+       applied, epoch not advanced — the torn state dies with the
+       process, exactly like a power cut);
+    2. a restarted sidecar is rebuilt through a full SYNC, and the
+       NEXT delta applying cleanly (server-side state_checksum
+       verified) proves the rebuilt session state is byte-identical
+       to the host's mirror;
+    3. a second SIGKILL between drains leaves the client in delta
+       mode against an empty session store: the sidecar answers
+       session_missing, the client RESYNCs in-call (counted), and
+       steady-state deltas resume against the rebuilt state."""
+    import signal
+
+    from kueue_oss_tpu.solver.resilience import SolverUnavailable
+
+    path = str(tmp_path / "sidecar.sock")
+    store = _store(preemption=False)  # lean kernel: cheap subprocess
+    for i in range(16):
+        store.add_workload(_wl(i))
+    queues = QueueManager(store)
+    sched = Scheduler(store, queues)
+    engine = SolverEngine(store, queues, scheduler=sched,
+                          remote=SolverClient(path))
+    engine.pad_to = 32
+
+    proc = _spawn_sidecar(
+        path, crash_env={"KUEUE_CRASH_POINT": "sidecar_session_store"})
+    try:
+        engine.drain(now=0.0)  # SYNC seeds the session
+        assert engine.remote.frames_by_kind.get("sync") == 1
+        # the first DELTA trips the kill point mid-apply: the sidecar
+        # dies with torn session state and the drain degrades
+        with pytest.raises(SolverUnavailable):
+            _churn_run(engine, store, sched, cycles=1, churn=1)
+        proc.wait(timeout=30)
+        assert proc.returncode == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    proc = _spawn_sidecar(path)
+    try:
+        # stale-epoch client -> full SYNC rebuild on the fresh sidecar
+        syncs0 = engine.remote.frames_by_kind.get("sync", 0)
+        _churn_run(engine, store, sched, cycles=1, churn=1)
+        assert engine.remote.frames_by_kind.get("sync", 0) == syncs0 + 1
+        # a DELTA applying cleanly against the rebuilt base (the
+        # sidecar verifies state_checksum over EVERY array) proves the
+        # rebuilt session state is byte-identical to the host's
+        deltas0 = engine.remote.frames_by_kind.get("delta", 0)
+        resyncs0 = metrics.solver_resync_total.total()
+        _churn_run(engine, store, sched, cycles=1, churn=1)
+        assert engine.remote.frames_by_kind.get("delta", 0) == deltas0 + 1
+        assert metrics.solver_resync_total.total() == resyncs0
+
+        # plain SIGKILL between drains: client stays in delta mode,
+        # the fresh sidecar has no session -> in-band RESYNC
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    proc = _spawn_sidecar(path)
+    try:
+        missing0 = metrics.solver_resync_total.collect().get(
+            ("session_missing",), 0)
+        resync_frames0 = engine.remote.frames_by_kind.get("resync", 0)
+        _churn_run(engine, store, sched, cycles=1, churn=1)
+        assert metrics.solver_resync_total.collect().get(
+            ("session_missing",), 0) == missing0 + 1
+        assert engine.remote.frames_by_kind.get(
+            "resync", 0) == resync_frames0 + 1
+        # and deltas resume against the RESYNC-rebuilt state
+        deltas0 = engine.remote.frames_by_kind.get("delta", 0)
+        _churn_run(engine, store, sched, cycles=1, churn=1)
+        assert engine.remote.frames_by_kind.get("delta", 0) == deltas0 + 1
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
