@@ -1455,6 +1455,182 @@ def run_scenario(scenario: str) -> dict:
             "audit_diverged": relax_res["audit_diverged"],
         }
 
+    if scenario == "streaming_arm":
+        # internal helper for the "streaming" twin: ONE admission
+        # model (stream = micro-drain per tick + full solve per
+        # cadence; batch = full solve per cadence only) over an
+        # identical sustained-arrival schedule on a virtual clock.
+        # Time-to-admit is virtual (creation -> QuotaReserved
+        # transition), so the comparison measures the MODEL's latency
+        # floor, not host speed; the wall is reported for overhead.
+        from kueue_oss_tpu.api.types import (
+            ClusterQueue as _CQ,
+            FlavorQuotas as _FQ,
+            LocalQueue as _LQ,
+            PodSet as _PS,
+            ResourceFlavor as _RF,
+            ResourceGroup as _RG,
+            ResourceQuota as _RQ,
+            Workload as _WL,
+            WorkloadConditionType as _WCT,
+        )
+        from kueue_oss_tpu.core.store import Store as _Store
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+        from kueue_oss_tpu import metrics as _kmetrics
+
+        arm = os.environ.get("STREAM_ARM", "batch")
+        n_cqs = int(os.environ.get("BENCH_STREAM_CQS", "32"))
+        ticks = int(os.environ.get("BENCH_STREAM_TICKS", "400"))
+        per_tick = int(os.environ.get("BENCH_STREAM_ARRIVALS", "16"))
+        tick_s = 0.01                 # 10 ms virtual tick
+        solve_every = 100             # full solve each 1 s virtual
+
+        store = _Store()
+        store.upsert_resource_flavor(_RF(name="default"))
+        for c in range(n_cqs):
+            store.upsert_cluster_queue(_CQ(
+                name=f"cq{c}",
+                resource_groups=[_RG(
+                    covered_resources=["cpu"],
+                    flavors=[_FQ(name="default", resources=[
+                        _RQ(name="cpu", nominal=10_000_000)])])]))
+            store.upsert_local_queue(
+                _LQ(name=f"lq{c}", cluster_queue=f"cq{c}"))
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues, solver="auto",
+                          solver_min_backlog=0,
+                          streaming=(arm == "stream"))
+        eng = sched._solver_engine()
+        eng.drain(now=0.0, verify=True)  # warm + arm the fences
+
+        uid = 1
+        t0 = time.monotonic()
+        for tick in range(1, ticks + 1):
+            now = tick * tick_s
+            if arm == "stream":
+                # the micro-batch at tick start picks up the PREVIOUS
+                # tick's arrivals: one tick of honest pickup latency,
+                # never a same-instant admit
+                sched.micro_drain(now)
+            for j in range(per_tick):
+                c = (tick * per_tick + j) % n_cqs
+                store.add_workload(_WL(
+                    name=f"w{uid}", queue_name=f"lq{c}", uid=uid,
+                    creation_time=now,
+                    podsets=[_PS(count=1, requests={"cpu": 100})]))
+                uid += 1
+            if tick % solve_every == 0:
+                eng.drain(now=now, verify=True)
+        wall = time.monotonic() - t0
+
+        waits = []
+        for wl in store.workloads.values():
+            cond = wl.status.conditions.get(_WCT.QUOTA_RESERVED)
+            if cond is not None and cond.status:
+                waits.append(
+                    cond.last_transition_time - wl.creation_time)
+        waits.sort()
+
+        def pct(p):
+            return (round(waits[int(p * (len(waits) - 1))] * 1000, 3)
+                    if waits else None)
+
+        return {
+            "scenario": scenario, "arm": arm,
+            "workloads": uid - 1, "admitted": len(waits),
+            "cluster_queues": n_cqs,
+            "solve_cadence_ms": round(solve_every * tick_s * 1000, 1),
+            "tta_ms_p50": pct(0.50), "tta_ms_p95": pct(0.95),
+            "wall": round(wall, 3),
+            "stream_admitted": int(
+                _kmetrics.stream_admitted_total.total()),
+        }
+
+    if scenario == "streaming":
+        # streaming control plane (docs/ARCHITECTURE.md "Streaming
+        # dataflow"): p50/p95 time-to-admit for uncontended CQs under
+        # sustained arrivals, streaming vs the cycle-batch twin at the
+        # SAME full-solve cadence — per-arm hash-seed-pinned
+        # subprocesses (bench methodology). Acceptance: stream p50
+        # decoupled from the solve cadence (>= 5x below the batch
+        # twin). Plus the durability side: incremental vs full
+        # checkpoint wall on the 50k-workload store at <5% dirty keys
+        # (acceptance < 20%), and shipped bytes per churn cycle with
+        # WAL log shipping on.
+        import shutil
+        import tempfile
+
+        from kueue_oss_tpu.persist import PersistenceManager
+
+        arms = {}
+        for armname in ("batch", "stream"):
+            arms[armname] = measure(
+                "streaming_arm",
+                extra_env={"STREAM_ARM": armname,
+                           "PYTHONHASHSEED": "0", "BENCH_CPU": "1"},
+                timeout=1500)
+        p50_s, p50_b = arms["stream"]["tta_ms_p50"], \
+            arms["batch"]["tta_ms_p50"]
+
+        # -- incremental vs full checkpoint on the 50k store ---------
+        store, _queues, _eng = _build(preemption=True, small=small)
+        n_wl = len(store.workloads)
+        d = tempfile.mkdtemp(prefix="kueue-bench-stream-")
+        ship = tempfile.mkdtemp(prefix="kueue-bench-ship-")
+        mgr = PersistenceManager(
+            d, fsync="off", incremental=True,
+            full_checkpoint_every=1 << 30, ship_to=ship,
+            checkpoint_interval_records=1 << 62,
+            checkpoint_interval_seconds=0.0)
+        mgr.attach(store)
+        t0 = time.monotonic()
+        mgr.checkpoint(force_full=True)
+        full_ms = (time.monotonic() - t0) * 1000
+        dirty_n = max(1, n_wl // 50)  # 2% dirty keys
+        keys = list(store.workloads)[:dirty_n]
+        for k in keys:
+            store.update_workload(store.workloads[k])
+        mgr.flush()
+        t0 = time.monotonic()
+        mgr.checkpoint()
+        incr_ms = (time.monotonic() - t0) * 1000
+        # -- shipped bytes per churn cycle ---------------------------
+        base = mgr.shipper.shipped_bytes
+        churn_cycles = 5
+        for c in range(churn_cycles):
+            for k in keys[:200]:
+                store.update_workload(store.workloads[k])
+            mgr.flush()
+        shipped_per_cycle = (mgr.shipper.shipped_bytes
+                             - base) // churn_cycles
+        mgr.close()
+        shutil.rmtree(d, ignore_errors=True)
+        shutil.rmtree(ship, ignore_errors=True)
+        return {
+            "scenario": scenario,
+            "workloads": arms["stream"]["workloads"],
+            "cluster_queues": arms["stream"]["cluster_queues"],
+            "solve_cadence_ms": arms["stream"]["solve_cadence_ms"],
+            "stream_tta_ms_p50": p50_s,
+            "stream_tta_ms_p95": arms["stream"]["tta_ms_p95"],
+            "batch_tta_ms_p50": p50_b,
+            "batch_tta_ms_p95": arms["batch"]["tta_ms_p95"],
+            "tta_p50_speedup": (round(p50_b / p50_s, 1)
+                                if p50_s else None),
+            "stream_admitted_subcycle": arms["stream"][
+                "stream_admitted"],
+            "stream_wall": arms["stream"]["wall"],
+            "batch_wall": arms["batch"]["wall"],
+            "ckpt_workloads": n_wl,
+            "checkpoint_full_ms": round(full_ms, 1),
+            "checkpoint_incremental_ms": round(incr_ms, 1),
+            "checkpoint_incremental_pct": round(
+                incr_ms / full_ms * 100, 1) if full_ms else None,
+            "dirty_fraction_pct": round(dirty_n / n_wl * 100, 2),
+            "shipped_bytes_per_cycle": int(shipped_per_cycle),
+        }
+
     if scenario == "parity":
         # 1/10-scale contended preemption drain: kernel vs host
         store_h, queues_h, _ = _build(preemption=True, small=True)
@@ -1718,6 +1894,18 @@ def main() -> None:
     except Exception as e:
         log(f"[whatif] did not complete: {e}")
         whatif = None
+    # streaming control plane: p50/p95 time-to-admit streaming vs the
+    # cycle-batch twin at the same full-solve cadence, incremental vs
+    # full checkpoint wall, shipped bytes per cycle (host backend:
+    # the fast path is host-side; the twin is the model comparison)
+    try:
+        # outer cap covers the two nested streaming_arm subprocesses
+        # (1500s inner cap each) plus the 50k checkpoint section
+        streaming_res = measure("streaming", extra_env={
+            "BENCH_CPU": "1"}, timeout=4200)
+    except Exception as e:
+        log(f"[streaming] did not complete: {e}")
+        streaming_res = None
     # convex-relaxation fast-path arm vs the exact lean kernel on the
     # contended 50k x 1k shape (docs/SOLVER_PROTOCOL.md "Relaxed
     # fast-path arm"; acceptance: >= 2x solve-wall speedup, every plan
@@ -1888,6 +2076,26 @@ def main() -> None:
         extra["whatif_vmapped_speedup"] = whatif["vmapped_speedup"]
         extra["whatif_plans_identical"] = whatif["plans_identical"]
         extra["whatif_workloads"] = whatif["workloads"]
+    if streaming_res is not None:
+        # streaming control plane acceptance: p50 time-to-admit
+        # decoupled from the full-solve cadence (>= 5x below the
+        # batch twin), incremental checkpoint < 20% of the full wall
+        # at <5% dirty keys, shipped bytes per churn cycle
+        extra["stream_tta_ms_p50"] = streaming_res["stream_tta_ms_p50"]
+        extra["stream_tta_ms_p95"] = streaming_res["stream_tta_ms_p95"]
+        extra["batch_tta_ms_p50"] = streaming_res["batch_tta_ms_p50"]
+        extra["stream_tta_p50_speedup"] = streaming_res[
+            "tta_p50_speedup"]
+        extra["stream_admitted_subcycle"] = streaming_res[
+            "stream_admitted_subcycle"]
+        extra["checkpoint_full_ms"] = streaming_res[
+            "checkpoint_full_ms"]
+        extra["checkpoint_incremental_ms"] = streaming_res[
+            "checkpoint_incremental_ms"]
+        extra["checkpoint_incremental_pct"] = streaming_res[
+            "checkpoint_incremental_pct"]
+        extra["shipped_bytes_per_cycle"] = streaming_res[
+            "shipped_bytes_per_cycle"]
     if relax_res is not None:
         # relaxed fast-path arm: solve-wall speedup over the exact lean
         # kernel, audited divergence rate through the 4-arm router, and

@@ -218,6 +218,23 @@ class PersistenceConfig:
     audit_interval_seconds: float = 0.0
     #: let the auditor rebuild drifted derived indexes automatically
     audit_auto_heal: bool = False
+    #: incremental checkpoints (docs/DURABILITY.md "Incremental
+    #: checkpoints"): delta against the previous checkpoint keyed by
+    #: event-driven dirty tracking — sub-second cadences become
+    #: affordable (a <5% dirty delta costs a small fraction of the
+    #: full 50k-workload serialize)
+    incremental_checkpoints: bool = False
+    #: every Nth checkpoint is a full dump (bounds delta-chain length
+    #: and recovery fan-in); the first after attach/recovery is
+    #: always full
+    full_checkpoint_every: int = 16
+    #: WAL log shipping target directory (docs/DURABILITY.md "Log
+    #: shipping"): every flush ships the synced tail, every rotation
+    #: ships the sealed segment + checkpoint; None disables
+    ship_to: Optional[str] = None
+    #: per-key last-state-wins compaction of sealed segments during
+    #: shipping (never alters the primary's own log)
+    ship_compact: bool = True
 
 
 @dataclass
@@ -254,6 +271,28 @@ class SimulatorConfig:
 
 
 @dataclass
+class StreamingConfig:
+    """Streaming micro-batched admission knobs
+    (scheduler/streaming.py, docs/ARCHITECTURE.md "Streaming
+    dataflow").
+
+    No reference analog — the reference schedules cycle-batch only;
+    these govern the sub-cycle fast path that decouples p50
+    time-to-admit from the full-solve cadence for uncontended CQs.
+    """
+
+    #: master switch; off = the cycle-batch model, unchanged
+    enabled: bool = False
+    #: admissions per micro-drain call (bounds one batch's latency;
+    #: the remainder stays in order for the next tick)
+    max_batch: int = 512
+    #: the serve loop runs a full host cycle at least this often even
+    #: while micro-drains absorb every arrival (SLO windows roll,
+    #: requeue backoffs expire, metrics flush)
+    max_cycle_gap_seconds: float = 1.0
+
+
+@dataclass
 class SLOConfig:
     """Queue-wait SLO objectives (kueue_oss_tpu/obs/health.py,
     docs/OBSERVABILITY.md "Cluster health & SLOs").
@@ -277,6 +316,13 @@ class SLOConfig:
     #: starvation watchdog: oldest-pending age per CQ above this is
     #: flagged starved regardless of burn rates
     starvation_threshold_seconds: float = 1800.0
+    #: webhook URL POSTed on every burn-rate alert fire/clear
+    #: transition (obs/health.py WebhookSink; delivery failures are
+    #: counted, never raised); None disables the config-owned sink
+    alert_webhook_url: Optional[str] = None
+    #: per-delivery timeout bounding how long a dead receiver can
+    #: stall one SLO evaluation
+    alert_webhook_timeout_seconds: float = 2.0
 
 
 @dataclass
@@ -317,6 +363,7 @@ class Configuration:
     object_retention_policies: Optional[ObjectRetentionPolicies] = None
     multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     solver: SolverBackendConfig = field(default_factory=SolverBackendConfig)
+    streaming: StreamingConfig = field(default_factory=StreamingConfig)
     simulator: SimulatorConfig = field(default_factory=SimulatorConfig)
     persistence: PersistenceConfig = field(
         default_factory=PersistenceConfig)
@@ -410,10 +457,17 @@ def validate(cfg: Configuration) -> list[str]:
         if m not in known and not m.isdigit():
             errs.append(f"simulator.mesh {sim.mesh!r} must be 'auto', "
                         "'off', or a non-negative device count")
+    st = cfg.streaming
+    if st.max_batch < 1:
+        errs.append("streaming.maxBatch must be >= 1")
+    if st.max_cycle_gap_seconds <= 0:
+        errs.append("streaming.maxCycleGap must be > 0")
     per = cfg.persistence
     if per.enabled and not per.dir:
         errs.append("persistence.dir is required when persistence is "
                     "enabled")
+    if per.full_checkpoint_every < 1:
+        errs.append("persistence.fullCheckpointEvery must be >= 1")
     if per.fsync not in ("always", "batch", "off"):
         errs.append(f"persistence.fsync {per.fsync!r} must be "
                     "'always', 'batch', or 'off'")
@@ -445,6 +499,9 @@ def validate(cfg: Configuration) -> list[str]:
     if slo.starvation_threshold_seconds < 0:
         errs.append("observability.slo.starvationThreshold must be "
                     ">= 0")
+    if slo.alert_webhook_timeout_seconds <= 0:
+        errs.append("observability.slo.alertWebhookTimeout must be "
+                    "> 0")
     afs = cfg.admission_fair_sharing
     if afs is not None:
         if afs.usage_half_life_time_seconds < 0:
@@ -591,6 +648,17 @@ def load(data: Optional[dict] = None) -> Configuration:
             "keepCheckpoints": ("keep_checkpoints", int),
             "auditInterval": ("audit_interval_seconds", float),
             "auditAutoHeal": ("audit_auto_heal", None),
+            "incrementalCheckpoints": ("incremental_checkpoints", None),
+            "fullCheckpointEvery": ("full_checkpoint_every", int),
+            "shipTo": ("ship_to", str),
+            "shipCompact": ("ship_compact", None),
+        })
+
+    def conv_streaming(d: dict) -> StreamingConfig:
+        return _build(StreamingConfig, d, {
+            "enabled": ("enabled", None),
+            "maxBatch": ("max_batch", int),
+            "maxCycleGap": ("max_cycle_gap_seconds", float),
         })
 
     def conv_slo(d: dict) -> SLOConfig:
@@ -603,6 +671,9 @@ def load(data: Optional[dict] = None) -> Configuration:
             "burnRateThreshold": ("burn_rate_threshold", float),
             "starvationThreshold": (
                 "starvation_threshold_seconds", float),
+            "alertWebhookUrl": ("alert_webhook_url", str),
+            "alertWebhookTimeout": (
+                "alert_webhook_timeout_seconds", float),
         })
 
     def conv_obs(d: dict) -> ObservabilityConfig:
@@ -648,6 +719,7 @@ def load(data: Optional[dict] = None) -> Configuration:
         "objectRetentionPolicies": ("object_retention_policies", conv_retention),
         "multiKueue": ("multikueue", conv_mk),
         "solver": ("solver", conv_solver),
+        "streaming": ("streaming", conv_streaming),
         "simulator": ("simulator", conv_sim),
         "persistence": ("persistence", conv_persist),
         "observability": ("observability", conv_obs),

@@ -594,6 +594,14 @@ class QueueManager:
             return sum(len(q._in_heap) + len(q._stale)
                        for q in self.queues.values() if q.active)
 
+    def cqs_with_pending(self) -> list[str]:
+        """Active CQs holding any drainable work (heap or stale) —
+        the streaming fast path's per-tick candidate list
+        (scheduler/streaming.py), read in one pass under the mutex."""
+        with self._mu:
+            return [name for name, q in self.queues.items()
+                    if q.active and (q._in_heap or q._stale)]
+
     def membership_fingerprint(self) -> int:
         """Order-insensitive digest of every queue's (key, heap|parked)
         membership, maintained O(1) per transition — the scheduler's

@@ -590,6 +590,23 @@ solver_relax_support_fraction = registry.register(Histogram(
     "relaxed solve", (),
     buckets=(0.01, 0.02, 0.05, 0.1, 0.2, 0.35, 0.5, 0.75, 1.0)))
 
+# -- streaming control plane (scheduler/streaming.py) ------------------------
+
+stream_microdrains_total = registry.register(Counter(
+    "kueue_stream_microdrains_total",
+    "Micro-batched sub-cycle admission drains by outcome (admitted / "
+    "parked = only no-fit parkings / deferred = every pending CQ "
+    "fenced to the next full solve / idle)", ("outcome",)))
+stream_admitted_total = registry.register(Counter(
+    "kueue_stream_admitted_total",
+    "Workloads admitted sub-cycle by the streaming fast path", ()))
+stream_demotions_total = registry.register(Counter(
+    "kueue_stream_demotions_total",
+    "Fast-path demotions by fence reason (cohort_event / spec_change "
+    "/ borrow_capable / out_of_order / unsupported) — each defers "
+    "the subtree to the next full solve",
+    ("reason",)))
+
 # -- decision flight recorder (obs/) -----------------------------------------
 
 decision_events_total = registry.register(Counter(
@@ -650,7 +667,21 @@ starvation_oldest_pending_seconds = registry.register(Gauge(
     ("cluster_queue",)))
 ledger_records_total = registry.register(Counter(
     "kueue_ledger_records_total",
-    "Cycle-ledger rows recorded, by kind (host/solver)", ("kind",)))
+    "Cycle-ledger rows recorded, by kind (host/solver/stream)",
+    ("kind",)))
+slo_alert_deliveries_total = registry.register(Counter(
+    "kueue_slo_alert_deliveries_total",
+    "Alert-sink notifications on burn-rate fire/clear transitions, "
+    "by outcome (ok/error)", ("outcome",)))
+cycle_phase_regression = registry.register(Gauge(
+    "kueue_cycle_phase_regression",
+    "1 while the fast EWMA of a cycle phase's wall exceeds the "
+    "regression ratio over its slow baseline (ledger-driven "
+    "regression detection), else 0", ("kind", "phase")))
+cycle_phase_regression_ratio = registry.register(Gauge(
+    "kueue_cycle_phase_regression_ratio",
+    "Fast-EWMA / slow-baseline ratio per cycle phase (1.0 = at "
+    "baseline)", ("kind", "phase")))
 
 # -- durable control plane (persist/, docs/DURABILITY.md) --------------------
 
@@ -666,11 +697,25 @@ wal_fsyncs_total = registry.register(Counter(
     "fsync barriers issued by the write-ahead log", ()))
 checkpoints_total = registry.register(Counter(
     "kueue_checkpoints_total",
-    "Store checkpoints by outcome (written/failed)", ("outcome",)))
+    "Store checkpoints by outcome (written = full / incremental / "
+    "failed)", ("outcome",)))
 checkpoint_duration_seconds = registry.register(Histogram(
     "kueue_checkpoint_duration_seconds",
     "Wall time of one atomic checkpoint (serialize + fsync + rotate)",
     ()))
+checkpoint_bytes = registry.register(Gauge(
+    "kueue_checkpoint_bytes",
+    "Payload bytes of the most recent checkpoint, by kind "
+    "(full/incremental)", ("kind",)))
+wal_shipped_bytes_total = registry.register(Counter(
+    "kueue_wal_shipped_bytes_total",
+    "Bytes shipped to the warm standby, by stream (tail = synced "
+    "active-segment suffix / sealed = rotated segments / checkpoint)",
+    ("stream",)))
+wal_compaction_dropped_total = registry.register(Counter(
+    "kueue_wal_compaction_dropped_total",
+    "Records dropped by per-key log compaction during sealed-segment "
+    "shipping (superseded events + satisfied intents)", ()))
 recovery_total = registry.register(Counter(
     "kueue_recovery_total",
     "Recoveries by source (checkpoint/wal_only/empty)", ("source",)))

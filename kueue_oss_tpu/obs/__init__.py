@@ -62,6 +62,8 @@ KINDS = (NOMINATED, ASSIGNED, SKIPPED, PREEMPTED, EVICTED,
 
 HOST = "host"
 SOLVER = "solver"
+#: streaming micro-batched admission fast path (scheduler/streaming.py)
+STREAM = "stream"
 
 #: placeholder workload key for cycle-level events (e.g. a whole drain
 #: degrading because the breaker is open) that belong to no one workload
@@ -344,9 +346,17 @@ from kueue_oss_tpu.obs.health import (  # noqa: E402
     oldest_pending,
 )
 from kueue_oss_tpu.obs.health import slo as slo_engine  # noqa: E402
+from kueue_oss_tpu.obs.health import (  # noqa: E402
+    WebhookSink,
+    priority_class_of,
+)
+from kueue_oss_tpu.obs.health import (  # noqa: E402
+    phase_regression as phase_regression,
+)
 from kueue_oss_tpu.obs.ledger import (  # noqa: E402
     HOST_CYCLE,
     SOLVER_DRAIN,
+    STREAM_DRAIN,
     CycleLedger,
     CycleRecord,
     load_ledger_jsonl,
@@ -373,3 +383,9 @@ def configure(obs_cfg) -> None:
         slow_window_s=s.slow_window_seconds,
         burn_threshold=s.burn_rate_threshold,
         starvation_threshold_s=s.starvation_threshold_seconds)
+    # alert sinks: a configured webhook replaces any previously
+    # config-wired one (programmatic add_sink registrations persist)
+    slo_engine.set_config_sink(
+        WebhookSink(s.alert_webhook_url,
+                    timeout_s=s.alert_webhook_timeout_seconds)
+        if s.alert_webhook_url else None)

@@ -37,12 +37,29 @@ from typing import Optional
 
 from kueue_oss_tpu import metrics
 
-#: SLI scopes — per-ClusterQueue and per-priority series
+#: SLI scopes — per-ClusterQueue and per-priority-class series (the
+#: priority scope keys on WorkloadPriorityClass NAMES when the
+#: workload carries one, falling back to the stringified integer —
+#: /api/slo groups by class, not by raw integer)
 SCOPE_CQ = "cq"
 SCOPE_PRIORITY = "priority"
 
 FIRING = "firing"
 CLEAR = "clear"
+
+
+def priority_class_of(store, wl) -> str:
+    """The SLI key for a workload's priority: its declared
+    WorkloadPriorityClass name, else the name of a class whose value
+    matches the raw integer, else the stringified integer. One
+    mapping shared by the host admit path and the solver commit."""
+    if wl.priority_class:
+        return str(wl.priority_class)
+    if store is not None:
+        for name, pc in store.priority_classes.items():
+            if pc.value == wl.priority:
+                return name
+    return str(wl.priority)
 
 
 class _WindowSeries:
@@ -147,6 +164,15 @@ class SLOEngine:
         self.alerts: dict[tuple[str, str], Alert] = {}
         #: last starvation snapshot (evaluate(queues=...))
         self._starvation: list[dict] = []
+        #: pluggable alert sinks: callables invoked as
+        #: ``sink(transition, alert_dict)`` on every fire/clear
+        #: transition. Failures are counted
+        #: (kueue_slo_alert_deliveries_total{outcome}) and never
+        #: break evaluation. ``_config_sink`` is the slot
+        #: obs.configure() owns (a webhook from SLOConfig); add_sink
+        #: registrations are programmatic and survive reconfigures.
+        self.sinks: list = []
+        self._config_sink = None
 
     def _set_geometry(self, fast_window_s: float,
                       slow_window_s: float) -> None:
@@ -187,20 +213,23 @@ class SLOEngine:
     # -- feeding -----------------------------------------------------------
 
     def observe_admission(self, cq: str, wait_s: float, *,
-                          priority: int = 0,
+                          priority: int = 0, priority_class: str = "",
                           now: Optional[float] = None,
                           cycle: int = 0, workload: str = "") -> None:
         """One admitted workload's time-to-admit, fed at the same call
         sites as ``metrics.admitted_workload`` (scheduler._admit and
-        the solver engine's commit)."""
+        the solver engine's commit). ``priority_class`` keys the
+        priority-scope SLI by WorkloadPriorityClass name
+        (priority_class_of); blank falls back to the raw integer."""
         if not self.enabled:
             return
         t = now if now is not None else self.clock()
         if t > self._now:
             self._now = t
         good = wait_s <= self.threshold_s
+        pkey = priority_class or str(priority)
         with self._lock:
-            for key in ((SCOPE_CQ, cq), (SCOPE_PRIORITY, str(priority))):
+            for key in ((SCOPE_CQ, cq), (SCOPE_PRIORITY, pkey)):
                 s = self._series.get(key)
                 if s is None:
                     s = self._series[key] = _WindowSeries(
@@ -227,8 +256,9 @@ class SLOEngine:
                 continue
             self.observe_admission(
                 ev.cluster_queue, float(detail["waitSeconds"]),
-                priority=int(detail.get("priority", 0)), now=ev.ts,
-                cycle=ev.cycle, workload=ev.workload)
+                priority=int(detail.get("priority", 0)),
+                priority_class=str(detail.get("priorityClass", "")),
+                now=ev.ts, cycle=ev.cycle, workload=ev.workload)
             n += 1
         return n
 
@@ -285,10 +315,12 @@ class SLOEngine:
                 alert.exemplar = breach.get(key)
                 metrics.slo_alert_transitions_total.inc(
                     scope, name, "fired")
+                self._notify("fired", alert)
             elif alert.state == FIRING and recovered:
                 alert.state, alert.since = CLEAR, t
                 metrics.slo_alert_transitions_total.inc(
                     scope, name, "cleared")
+                self._notify("cleared", alert)
             elif alert.state == FIRING:
                 # keep the exemplar pointing at the newest breach while
                 # the alert stays up
@@ -329,6 +361,41 @@ class SLOEngine:
 
     def firing(self) -> list[Alert]:
         return [a for a in self.alerts.values() if a.state == FIRING]
+
+    # -- alert sinks -------------------------------------------------------
+
+    def add_sink(self, sink) -> None:
+        """Register ``sink(transition, alert_dict)`` for fire/clear
+        notifications (transition in {"fired", "cleared"})."""
+        self.sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        if sink in self.sinks:
+            self.sinks.remove(sink)
+
+    def set_config_sink(self, sink) -> None:
+        """The one config-owned sink slot (obs.configure); replacing
+        or clearing it never touches programmatic registrations."""
+        self._config_sink = sink
+
+    def _notify(self, transition: str, alert: Alert) -> None:
+        """Deliver one transition to every sink. Delivery runs inline
+        with evaluation (transitions are rare by construction); a
+        failing sink is counted, never raised — alerting must not be
+        able to break the scheduler or the dashboard."""
+        sinks = list(self.sinks)
+        if self._config_sink is not None:
+            sinks.append(self._config_sink)
+        if not sinks:
+            return
+        payload = alert.to_dict()
+        payload["transition"] = transition
+        for sink in sinks:
+            try:
+                sink(transition, dict(payload))
+                metrics.slo_alert_deliveries_total.inc("ok")
+            except Exception:
+                metrics.slo_alert_deliveries_total.inc("error")
 
     def _watch_starvation(self, now: float, queues) -> list[dict]:
         """Oldest pending age per CQ (heap + parked), newest snapshot.
@@ -381,6 +448,99 @@ def oldest_pending(queues, now: float) -> list[tuple[str, float, str]]:
     return out
 
 
+class WebhookSink:
+    """POSTs each fire/clear transition as JSON to a webhook URL.
+
+    Delivery failures raise (the engine's _notify counts them under
+    kueue_slo_alert_deliveries_total{outcome="error"}); the short
+    timeout bounds how long a dead receiver can stall an evaluation.
+    """
+
+    def __init__(self, url: str, timeout_s: float = 2.0) -> None:
+        self.url = url
+        self.timeout_s = timeout_s
+
+    def __call__(self, transition: str, payload: dict) -> None:
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            r.read()
+
+
+class PhaseRegressionDetector:
+    """Ledger-driven regression detection over per-cycle phase walls.
+
+    Every CycleLedger row's ``phases`` dict feeds a per-(kind, phase)
+    pair of EWMAs: a slow baseline (what this phase normally costs)
+    and a fast tracker (what it costs right now). After a warm-up
+    sample count, ``fast > ratio * baseline`` flags the phase as
+    regressing — surfaced as kueue_cycle_phase_regression{kind,phase}
+    and a ``health`` signal in /api/health. The baseline keeps
+    adapting slowly, so a permanent plan change (bigger store, new
+    hardware) re-baselines instead of alerting forever; a sudden 2x+
+    jump (lock contention, a pathological snapshot, GC storms) fires
+    within a handful of cycles.
+    """
+
+    def __init__(self, *, ratio: float = 2.0, min_samples: int = 20,
+                 fast_alpha: float = 0.3,
+                 slow_alpha: float = 0.02) -> None:
+        self.enabled = True
+        self.ratio = ratio
+        self.min_samples = min_samples
+        self.fast_alpha = fast_alpha
+        self.slow_alpha = slow_alpha
+        self._lock = threading.Lock()
+        #: (kind, phase) -> [fast_ewma, slow_ewma, samples, regressing]
+        self._state: dict[tuple[str, str], list] = {}
+
+    def feed(self, kind: str, phases: dict) -> None:
+        if not self.enabled or not phases:
+            return
+        with self._lock:
+            for phase, wall in phases.items():
+                try:
+                    w = float(wall)
+                except (TypeError, ValueError):
+                    continue
+                st = self._state.get((kind, phase))
+                if st is None:
+                    st = self._state[(kind, phase)] = [w, w, 0, False]
+                st[0] += self.fast_alpha * (w - st[0])
+                st[1] += self.slow_alpha * (w - st[1])
+                st[2] += 1
+                ratio = st[0] / st[1] if st[1] > 0 else 1.0
+                was = st[3]
+                st[3] = (st[2] >= self.min_samples
+                         and ratio > self.ratio)
+                metrics.cycle_phase_regression_ratio.set(
+                    kind, phase, value=ratio)
+                if st[3] != was:
+                    metrics.cycle_phase_regression.set(
+                        kind, phase, value=1.0 if st[3] else 0.0)
+
+    def regressing(self) -> list[dict]:
+        """Currently regressing phases (the /api/health signal)."""
+        with self._lock:
+            return [{"kind": k, "phase": p,
+                     "fastSeconds": round(st[0], 6),
+                     "baselineSeconds": round(st[1], 6),
+                     "ratio": round(st[0] / st[1], 3) if st[1] > 0
+                     else 1.0}
+                    for (k, p), st in self._state.items() if st[3]]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state.clear()
+
+
 #: process-wide engine (the obs.recorder idiom); obs.configure() swaps
 #: its objectives in from an ObservabilityConfig
 slo = SLOEngine()
+
+#: process-wide phase-regression detector, fed by CycleLedger.record
+phase_regression = PhaseRegressionDetector()

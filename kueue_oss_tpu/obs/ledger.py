@@ -35,9 +35,11 @@ from typing import Optional
 from kueue_oss_tpu import metrics
 
 #: row kinds — one host row per scheduler cycle, one solver row per
-#: engine drain (both tagged with the host cycle id the drain served)
+#: engine drain (both tagged with the host cycle id the drain served),
+#: one stream row per productive micro-batched admission drain
 HOST_CYCLE = "host"
 SOLVER_DRAIN = "solver"
+STREAM_DRAIN = "stream"
 
 
 @dataclass
@@ -166,6 +168,13 @@ class CycleLedger:
         with self._lock:
             self._ring.append(row)
         metrics.ledger_records_total.inc(kind)
+        if row.phases:
+            # ledger-driven regression detection: every recorded row
+            # feeds the per-(kind, phase) EWMA-vs-baseline detector
+            # (obs/health.py; kueue_cycle_phase_regression)
+            from kueue_oss_tpu.obs.health import phase_regression
+
+            phase_regression.feed(kind, row.phases)
         return row
 
     # -- queries -----------------------------------------------------------

@@ -21,7 +21,11 @@ ever made. ``persist`` closes that gap (docs/DURABILITY.md):
   ``core/quota.py`` formulas and diffing against store accounting;
 - :mod:`hooks` — named crash points for the chaos harness
   (``kueue_oss_tpu/chaos`` ``CrashPointInjector`` +
-  ``persist/crashtest.py`` subprocess driver).
+  ``persist/crashtest.py`` subprocess driver);
+- :mod:`shipping` — :class:`LogShipper` (continuous WAL tail +
+  sealed-segment + checkpoint shipping with per-key compaction) and
+  :class:`WarmStandby` (follower replay; failover = the unsynced
+  tail).
 """
 
 from kueue_oss_tpu.persist.auditor import InvariantAuditor, Violation
@@ -37,19 +41,29 @@ from kueue_oss_tpu.persist.manager import (
     PersistenceManager,
     RecoveryResult,
     apply_event,
+    materialize_chain,
+)
+from kueue_oss_tpu.persist.shipping import (
+    LogShipper,
+    WarmStandby,
+    compact_records,
 )
 from kueue_oss_tpu.persist.wal import WriteAheadLog, replay_wal
 
 __all__ = [
     "InvariantAuditor",
+    "LogShipper",
     "PersistenceManager",
     "RecoveryResult",
     "Violation",
+    "WarmStandby",
     "WriteAheadLog",
     "apply_event",
     "canonical_dump",
+    "compact_records",
     "from_dict",
     "fsync_dir",
+    "materialize_chain",
     "replay_wal",
     "store_from_dict",
     "store_to_dict",

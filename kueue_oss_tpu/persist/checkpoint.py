@@ -24,9 +24,10 @@ from typing import Optional
 from kueue_oss_tpu.persist import hooks
 from kueue_oss_tpu.util.fsutil import fsync_dir
 
-__all__ = ["CorruptCheckpoint", "checkpoint_path", "fsync_dir",
-           "list_checkpoints", "load_checkpoint", "newest_valid",
-           "write_checkpoint"]
+__all__ = ["CorruptCheckpoint", "chain_ids", "checkpoint_path",
+           "fsync_dir", "is_incremental", "list_checkpoints",
+           "load_checkpoint", "load_checkpoint_meta", "newest_valid",
+           "newest_valid_chain", "write_checkpoint"]
 
 _NAME = re.compile(r"^checkpoint-(\d+)\.ckpt$")
 
@@ -66,6 +67,26 @@ def write_checkpoint(dir_path: str, ckpt_id: int, state: bytes,
         if os.path.exists(tmp):
             os.unlink(tmp)
     return path
+
+
+def load_checkpoint_meta(path: str) -> dict:
+    """Header-only read: the metadata line without the payload.
+
+    The chain-closure prune path runs on EVERY checkpoint — at the
+    sub-second cadences incremental checkpoints enable, re-reading and
+    re-hashing each retained chain's multi-MB full base would dwarf
+    the delta write the cadence just saved; link resolution only
+    needs ``kind``/``base``. Payload integrity is still verified
+    wherever the payload is actually used (load_checkpoint)."""
+    with open(path, "rb") as f:
+        header = f.readline()
+    try:
+        meta = json.loads(header)
+    except ValueError as e:
+        raise CorruptCheckpoint(f"{path}: bad header: {e}") from e
+    if not isinstance(meta, dict) or "sha256" not in meta:
+        raise CorruptCheckpoint(f"{path}: header is not checkpoint meta")
+    return meta
 
 
 def load_checkpoint(path: str) -> tuple[dict, bytes]:
@@ -111,3 +132,63 @@ def newest_valid(dir_path: str) -> Optional[tuple[dict, bytes]]:
         except (CorruptCheckpoint, OSError):
             continue
     return None
+
+
+def is_incremental(meta: dict) -> bool:
+    return meta.get("kind") == "incremental"
+
+
+def newest_valid_chain(dir_path: str
+                       ) -> Optional[list[tuple[dict, bytes]]]:
+    """The newest checkpoint whose whole delta chain validates,
+    resolved full-base-first: ``[full, incr, ..., newest]``.
+
+    Incremental checkpoints (docs/DURABILITY.md "Incremental
+    checkpoints") carry ``meta["kind"] == "incremental"`` and a
+    ``meta["base"]`` pointer at the checkpoint they delta against.
+    A candidate with a corrupt or missing link anywhere in its chain
+    is skipped entirely and the next-newest candidate is tried —
+    recovery never materializes a partial chain.
+    """
+    by_id = dict(list_checkpoints(dir_path))
+    for ckpt_id in sorted(by_id, reverse=True):
+        chain: list[tuple[dict, bytes]] = []
+        cur: Optional[int] = ckpt_id
+        ok = True
+        seen: set[int] = set()
+        while cur is not None:
+            path = by_id.get(cur)
+            if path is None or cur in seen:
+                ok = False
+                break
+            seen.add(cur)
+            try:
+                meta, state = load_checkpoint(path)
+            except (CorruptCheckpoint, OSError):
+                ok = False
+                break
+            chain.append((meta, state))
+            cur = (int(meta["base"]) if is_incremental(meta)
+                   else None)
+        if ok and chain:
+            chain.reverse()
+            return chain
+    return None
+
+
+def chain_ids(dir_path: str, ckpt_id: int) -> set[int]:
+    """Checkpoint ids in ``ckpt_id``'s delta chain (itself included),
+    or just {ckpt_id} when the chain cannot be resolved — the prune
+    path's retention closure (a full base outlives the window while
+    a retained incremental still points at it)."""
+    by_id = dict(list_checkpoints(dir_path))
+    out: set[int] = set()
+    cur: Optional[int] = ckpt_id
+    while cur is not None and cur not in out and cur in by_id:
+        out.add(cur)
+        try:
+            meta = load_checkpoint_meta(by_id[cur])
+        except (CorruptCheckpoint, OSError):
+            break
+        cur = int(meta["base"]) if is_incremental(meta) else None
+    return out or {ckpt_id}

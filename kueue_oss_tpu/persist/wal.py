@@ -100,6 +100,10 @@ class WriteAheadLog:
         self._unsynced = 0
         self.records_appended = 0
         self.bytes_appended = 0
+        #: absolute file size / synced watermark — the log shipper's
+        #: read bounds (tail shipping copies [shipped, synced_size))
+        self.size = size - self.truncated_bytes
+        self.synced_size = self.size
 
     def append(self, record: dict, kind: str = "event",
                sync: Optional[bool] = None) -> int:
@@ -131,6 +135,7 @@ class WriteAheadLog:
         self._unsynced += 1
         self.records_appended += 1
         self.bytes_appended += len(frame)
+        self.size += len(frame)
         metrics.wal_records_total.inc(kind)
         metrics.wal_bytes_total.inc(by=len(frame))
         force = sync if sync is not None else (self.fsync == FSYNC_ALWAYS)
@@ -147,9 +152,13 @@ class WriteAheadLog:
     def _fsync(self) -> None:
         if self.fsync == FSYNC_OFF:
             self._unsynced = 0
+            # the shipping watermark still advances: fsync=off means
+            # "trust the page cache", not "never replicate"
+            self.synced_size = self.size
             return
         os.fsync(self._f.fileno())
         self._unsynced = 0
+        self.synced_size = self.size
         metrics.wal_fsyncs_total.inc()
 
     def close(self) -> None:
@@ -159,15 +168,18 @@ class WriteAheadLog:
         self._f.close()
 
 
-def _scan(path: str) -> Iterator[tuple[int, int, dict]]:
+def _scan(path: str, start: int = 0) -> Iterator[tuple[int, int, dict]]:
     """The ONE frame scanner: yield (offset, frame length, record) for
     each fully valid frame, stopping at the first invalid one. Every
-    consumer (replay, truncation boundaries, reopen-truncation) shares
-    these validity rules — a frame one path accepts and another
-    rejects would let appends continue past a frame recovery stops at,
-    permanently hiding later records."""
+    consumer (replay, truncation boundaries, reopen-truncation, the
+    warm standby's incremental catch-up) shares these validity rules —
+    a frame one path accepts and another rejects would let appends
+    continue past a frame recovery stops at, permanently hiding later
+    records. ``start`` must be a frame boundary (the standby resumes
+    from its applied offset)."""
     with open(path, "rb") as f:
-        off = 0
+        f.seek(start)
+        off = start
         while True:
             header = f.read(_HEADER.size)
             if len(header) < _HEADER.size:
@@ -218,3 +230,11 @@ def iter_frames(path: str) -> Iterator[tuple[int, int]]:
     property test cuts the file at every one of these boundaries."""
     for off, length, _rec in _scan(path):
         yield off, length
+
+
+def scan_records(path: str, start: int = 0
+                 ) -> Iterator[tuple[int, int, dict]]:
+    """Public offset-resumable scan: (offset, frame length, record)
+    from ``start`` (a frame boundary) — the warm standby's
+    incremental replay cursor (persist/shipping.py)."""
+    return _scan(path, start)

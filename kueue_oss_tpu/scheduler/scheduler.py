@@ -93,6 +93,7 @@ class Scheduler:
         solver_reengage_fraction: float = 0.05,
         solver_config=None,
         eviction_backoff_max_s: float = 3600.0,
+        streaming=None,
     ) -> None:
         self.store = store
         self.queues = queues
@@ -139,6 +140,20 @@ class Scheduler:
         #: any productive drain resets the multiplier
         self._solver_arrival_mult = 1
         self._solver_drain_trigger = None
+        #: streaming micro-batched admission between full solves
+        #: (scheduler/streaming.py, docs/ARCHITECTURE.md "Streaming
+        #: dataflow"): None/False = off (the cycle-batch model,
+        #: unchanged), True = defaults, or a config.StreamingConfig.
+        #: Requires a solver backend — commits ride the engine's
+        #: commit path so streamed admissions are indistinguishable
+        #: in durable state from batched ones.
+        self.streaming = streaming
+        self._streaming_instance = None
+        #: wall of the most recent full schedule() cycle; the serve
+        #: loop refuses to skip host cycles longer than the streaming
+        #: config's max_cycle_gap (SLO windows must roll, requeue
+        #: backoffs must expire, even while micro-drains serve)
+        self._last_full_cycle_wall = 0.0
         #: adaptive routing cost estimates (EMAs): drain wall PER
         #: EXPORTED WORKLOAD (drain cost scales with backlog) and the
         #: host cycle's per-admission cost; None until measured
@@ -430,8 +445,60 @@ class Scheduler:
                         cfg.relax_support_threshold)
                     eng.relax_retry_cooldown_s = (
                         cfg.relax_retry_cooldown_seconds)
+            self._ensure_streaming(self._solver_instance)
             return self._solver_instance
+        self._ensure_streaming(self.solver)
         return self.solver
+
+    def _streaming_on(self) -> bool:
+        """Whether streaming is enabled: truthy value, AND — for a
+        StreamingConfig — its ``enabled`` master switch."""
+        cfg = self.streaming
+        if not cfg:
+            return False
+        return cfg is True or getattr(cfg, "enabled", True)
+
+    def _ensure_streaming(self, engine) -> None:
+        """Wire the StreamingAdmitter onto a freshly resolved engine
+        (idempotent; also the path that arms fences on the engine's
+        full-solve boundaries via engine.streaming)."""
+        if (not self._streaming_on() or engine is None
+                or self._streaming_instance is not None):
+            return
+        from kueue_oss_tpu.scheduler.streaming import StreamingAdmitter
+
+        cfg = self.streaming
+        kwargs = {}
+        if cfg is not True and cfg is not None:
+            kwargs["max_batch"] = getattr(cfg, "max_batch", 512)
+        self._streaming_instance = StreamingAdmitter(
+            self.store, self.queues, engine, **kwargs)
+        engine.streaming = self._streaming_instance
+
+    def _streaming_admitter(self):
+        """The lazily built StreamingAdmitter, or None (streaming off
+        or no solver backend configured)."""
+        if not self._streaming_on():
+            return None
+        if self._streaming_instance is None:
+            self._ensure_streaming(self._solver_engine())
+        return self._streaming_instance
+
+    def _streaming_max_gap(self) -> float:
+        cfg = self.streaming
+        if cfg is True or cfg is None:
+            return 1.0
+        return getattr(cfg, "max_cycle_gap_seconds", 1.0)
+
+    def micro_drain(self, now: Optional[float] = None):
+        """One streaming micro-batch: admit in-order arrivals for
+        every uncontended fast-path CQ sub-cycle (between full
+        solves). Returns the MicroDrainResult, or None when streaming
+        is off/unarmed."""
+        sa = self._streaming_admitter()
+        if sa is None:
+            return None
+        return sa.drain(now if now is not None else self.clock())
 
     def _solver_drain(self, now: Optional[float]) -> bool:
         """Drain the backlog on-device when the solver supports it.
@@ -660,6 +727,25 @@ class Scheduler:
                     last_sweep = now_c
                     self.requeue_due(now_c)
                 continue
+            # Streaming fast path (scheduler/streaming.py): between
+            # full solves, in-order arrivals to uncontended CQs admit
+            # sub-cycle; when the micro-batch resolved everything
+            # pending, the heavy cycle is skipped — p50 time-to-admit
+            # decouples from the full-solve cadence. Host cycles still
+            # run at least every max_cycle_gap (SLO windows, requeue
+            # backoffs, metric flushes) and whenever fenced work waits.
+            micro_admitted = 0
+            sa = self._streaming_admitter()
+            if sa is not None:
+                now_c = clock()
+                micro = sa.drain(now_c)
+                micro_admitted = micro.admitted
+                if ((micro.admitted or micro.parked)
+                        and not self.queues.has_pending()
+                        and (now_c - self._last_full_cycle_wall
+                             < self._streaming_max_gap())):
+                    idle_rounds = 0
+                    continue
             # Flood-to-solver routing (run_until_quiet parity): a backlog
             # past solver_min_backlog drains through the device kernel in
             # one batched invocation; the host cycle below mops up the
@@ -667,8 +753,10 @@ class Scheduler:
             drained = self._solver_drain(clock()) if self.solver else False
             pre = self._queue_fingerprint()
             stats = self.schedule(now=clock())
+            self._last_full_cycle_wall = clock()
             cycles += 1
-            if (drained or stats.admitted or stats.preempted
+            if (drained or micro_admitted or stats.admitted
+                    or stats.preempted
                     or self._queue_fingerprint() != pre):
                 idle_rounds = 0  # KeepGoing
             else:
@@ -1188,9 +1276,13 @@ class Scheduler:
                                             "workload": wl.key})
         # queue-wait SLI: one time-to-admit observation per admission
         # (obs/health.py); the same wait rides the journal detail so
-        # the SLO windows can be rebuilt from a restored journal
+        # the SLO windows can be rebuilt from a restored journal. The
+        # priority scope keys by WorkloadPriorityClass name so
+        # /api/slo groups by class, not by raw integer.
+        pclass = obs.priority_class_of(self.store, wl)
         obs.slo_engine.observe_admission(
-            e.info.cluster_queue, wait_s, priority=wl.priority, now=now,
+            e.info.cluster_queue, wait_s, priority=wl.priority,
+            priority_class=pclass, now=now,
             cycle=self.cycle_count, workload=wl.key)
         obs.recorder.record(
             obs.ASSIGNED, wl.key, cycle=self.cycle_count,
@@ -1203,6 +1295,7 @@ class Scheduler:
                 "admitted": wl.is_admitted,
                 "waitSeconds": round(wait_s, 3),
                 "priority": wl.priority,
+                "priorityClass": pclass,
             })
         # cohort subtree admission counters (metrics.go cohort_subtree_*)
         if e.cq_snapshot is not None and e.cq_snapshot.has_parent():

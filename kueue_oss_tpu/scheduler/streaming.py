@@ -1,0 +1,523 @@
+"""Streaming control plane: micro-batched sub-cycle admission drains.
+
+The cycle-batch model re-exports and re-solves the world every cycle,
+so at sustained high arrival rates p50 time-to-admit is dominated by
+the full-solve cadence — the batch-vs-continuous trade-off quantified
+in arXiv 1106.4985. This module decouples them: between full solves,
+new arrivals to **uncontended** ClusterQueues are admitted sub-cycle
+through a compact per-CQ fast path, while anything whose outcome could
+depend on cross-CQ ordering defers to the next full solve.
+
+Soundness model (docs/ARCHITECTURE.md "Streaming dataflow"):
+
+- A CQ is *fast-path eligible* only when the lean (fit-only) kernel
+  would model it: no preemption policies, a single resource group, no
+  fair sharing, no admission-scope AFS, no TAS flavors. For such CQs a
+  greedy in-order walk of the pending heap — admit the head while the
+  host flavor-assigner oracle says FIT, park BestEffortFIFO no-fits,
+  stop at a blocked StrictFIFO head — is exactly the per-CQ behavior
+  of the batched lean solve (the established kernel↔oracle parity).
+- Cross-CQ coupling happens only through cohort **borrowing**, and
+  the batch oracle interleaves cohort-mates round-by-round — an
+  interleave no event-time fence can reproduce after the fact. So the
+  borrowing fence is *structural*: a CQ streams only when it is the
+  sole CQ in its cohort root's subtree (it may then borrow freely —
+  nobody races it), or when every CQ in the subtree has borrowing
+  disabled (zero borrowing limits make cohort-mates capacity-
+  independent, so per-CQ greedy order IS the joint order). Borrow-
+  capable multi-CQ subtrees always take the full solve.
+- On top of that, any cohort-crossing event — an eviction/finish/
+  preemption candidate (capacity freed), a quota or flavor edit, a
+  node flap (all spec events bump ``ExportCache.spec_gen``), an
+  admission by any other path — marks the subtree **contended** until
+  the next full solve.
+- Within one CQ, the cycle-batch oracle reorders a whole inter-solve
+  window by ``_order_key`` (priority, then FIFO). Streaming admits in
+  arrival order, which matches the batch order exactly while arrivals
+  are order-monotone; an **out-of-order arrival** (one sorting before
+  a workload already admitted this window) demotes the CQ before it
+  is processed. Admissions already committed before the inversion
+  arrived are the one place streaming trades strict window-priority
+  for latency — the same trade the cycle-batch model makes for any
+  arrival that lands just after a solve boundary closes its batch.
+
+Under those fences the final store state after each full solve is
+bit-identical to the pure cycle-batch oracle (the ``streaming``
+oracle-parity property test replays randomized arrival/quota/flap
+scripts against both twins and byte-compares the canonical dumps at
+every boundary).
+
+Commits reuse ``SolverEngine._commit_admission`` — the same store
+writes, WAL intents, SLO feed, and flight-recorder events as a solver
+drain — so a streaming admission is indistinguishable in durable state
+from a batched one. The delta-session slot coordinates stay valid: a
+micro-admission just dirties its ExportCache row like any other store
+event, and the next full solve ships it as a normal dirty-row delta
+(no session reset, resident device tensors untouched).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_oss_tpu import metrics, obs
+from kueue_oss_tpu.core.queue_manager import _order_key
+from kueue_oss_tpu.core.workload_info import WorkloadInfo
+from kueue_oss_tpu.scheduler import flavor_assigner as fa
+from kueue_oss_tpu.scheduler.flavor_assigner import FlavorAssigner
+from kueue_oss_tpu.scheduler.preemption import Preemptor
+
+
+@dataclass
+class MicroDrainResult:
+    """Outcome of one micro-batched admission drain."""
+
+    admitted: int = 0
+    parked: int = 0
+    #: CQs skipped this drain because their subtree is contended, a
+    #: sibling holds pending work, or an entry needs the full solve
+    deferred_cqs: int = 0
+    duration_s: float = 0.0
+    admitted_keys: list[str] = field(default_factory=list)
+
+
+class StreamingAdmitter:
+    """Per-CQ sub-cycle admission fast path between full solves.
+
+    One instance per SolverEngine; ``drain()`` runs on the scheduler
+    thread, the store-watch classifier may run on any mutating thread
+    (controller callbacks), so the contention sets are lock-guarded.
+    """
+
+    def __init__(self, store, queues, engine,
+                 max_batch: int = 512) -> None:
+        self.store = store
+        self.queues = queues
+        self.engine = engine
+        self.enabled = True
+        #: admissions per drain() call — bounds one micro-batch's
+        #: latency; the remainder stays in order for the next drain
+        self.max_batch = max_batch
+        #: a full solve must have completed since the last contending
+        #: epoch before any micro-drain runs (the parity baseline)
+        self.armed = False
+        #: ExportCache.spec_gen at arm time — ANY spec event (quota
+        #: edit, flavor change, node flap, cohort edit) bumps it, which
+        #: fences the whole window (tensors.py dirty tracking)
+        self._armed_gen = -1
+        self._mu = threading.Lock()
+        #: cohort roots contended since the last full solve, stamped
+        #: with the fence generation they were raised at — a full
+        #: solve clears only fences raised BEFORE it began, so an
+        #: event landing mid-solve (which the solve's export never
+        #: saw) keeps its subtree fenced for the next one
+        self._contended_roots: dict[str, int] = {}
+        self._gen = 0
+        self._solve_mark = 0
+        self._solve_spec_gen = -1
+        #: thread running the current full solve: its events (plan
+        #: commits, plan evictions) ARE the solve — they land in the
+        #: boundary state and must not fence the next window
+        self._solve_thread: Optional[int] = None
+        #: per-spec-gen derived tables: cq -> root key, root -> members
+        self._root_gen = -1
+        self._root_of: dict[str, str] = {}
+        self._members: dict[str, list[str]] = {}
+        #: roots whose subtree structure permits streaming at all
+        #: (singleton, or borrowing disabled throughout)
+        self._root_streamable: dict[str, bool] = {}
+        self._eligible_cache: dict[str, bool] = {}
+        #: window snapshot for oracle fit checks, built lazily at the
+        #: first micro-drain after arm and mutated incrementally by our
+        #: own admissions (contended subtrees never consult it)
+        self._snap = None
+        #: newest _order_key admitted per CQ this window (the
+        #: out-of-order arrival fence)
+        self._max_admitted: dict[str, tuple] = {}
+        #: thread id whose commit events are self-classification to
+        #: suppress — thread-SCOPED, not a process-wide flag: a
+        #: controller thread's capacity event arriving mid-commit
+        #: must still contend its root
+        self._committing_thread: Optional[int] = None
+        self._preemptor = Preemptor(enable_fair_sharing=False)
+        self.micro_drains = 0
+        store.watch(self._on_event)
+
+    # -- event classification (the safety fence) ---------------------------
+
+    def _on_event(self, event) -> None:
+        ident = threading.get_ident()
+        if self._committing_thread == ident:
+            return  # our own commit: tracked via _max_admitted/_snap
+        if self._solve_thread == ident:
+            # the full solve's own plan application: part of the
+            # boundary state note_full_solve re-arms against
+            return
+        verb, kind, obj = event
+        if kind != "Workload":
+            # spec events ride ExportCache.spec_gen (checked per
+            # drain); nothing to classify here
+            return
+        wl = obj
+        if (verb != "delete" and wl.active and not wl.is_quota_reserved
+                and not wl.is_finished and not wl.is_evicted
+                and wl.status.admission is None):
+            return  # pure pending arrival/update: the work we stream
+        cq = self.store.cluster_queue_for(wl)
+        if cq is None and wl.status.admission is not None:
+            cq = wl.status.admission.cluster_queue
+        self._contend(cq, "cohort_event")
+
+    def _contend(self, cq: Optional[str], reason: str) -> None:
+        with self._mu:
+            self._gen += 1
+            if cq is None:
+                # unresolvable owner: fence everything (rare — a
+                # deleted LQ mid-flight)
+                self.armed = False
+            else:
+                self._contended_roots[self._root(cq)] = self._gen
+        metrics.stream_demotions_total.inc(reason)
+
+    # -- per-spec-gen derived tables ---------------------------------------
+
+    def _refresh_tables(self) -> None:
+        gen = self.engine.export_cache.spec_gen
+        if self._root_gen == gen:
+            return
+        self._root_gen = gen
+        self._root_of = {}
+        self._members = {}
+        self._eligible_cache = {}
+        roots: dict[str, str] = {}
+
+        def root_of_cohort(name: str) -> str:
+            if name in roots:
+                return roots[name]
+            seen = set()
+            cur = name
+            while True:
+                if cur in seen:
+                    break
+                seen.add(cur)
+                spec_c = self.store.cohorts.get(cur)
+                if spec_c is None or not spec_c.parent:
+                    break
+                cur = spec_c.parent
+            roots[name] = cur
+            return cur
+
+        for name, spec in self.store.cluster_queues.items():
+            root = (f"cohort:{root_of_cohort(spec.cohort)}"
+                    if spec.cohort else f"cq:{name}")
+            self._root_of[name] = root
+            self._members.setdefault(root, []).append(name)
+        # structural borrowing fence: a multi-CQ subtree streams only
+        # when NO member can borrow (zero borrowing limits => the
+        # members are capacity-independent and per-CQ greedy order is
+        # the joint batch order); a singleton subtree always may (its
+        # borrowing races nobody)
+        self._root_streamable = {}
+        for root, members in self._members.items():
+            if len(members) == 1:
+                self._root_streamable[root] = True
+                continue
+            self._root_streamable[root] = all(
+                not _can_borrow(self.store.cluster_queues[m])
+                for m in members)
+
+    def _root(self, cq: str) -> str:
+        self._refresh_tables()
+        return self._root_of.get(cq, f"cq:{cq}")
+
+    def _static_eligible(self, name: str) -> bool:
+        """Lean-kernel-shaped, flavor-deterministic CQ (cached per
+        spec generation). Single flavor option only: with multiple
+        options, a capacity-freeing event between a streamed
+        admission and the next full solve could have changed which
+        flavor the batch oracle would pick for it — a retroactive
+        divergence no fence can undo. Multi-flavor CQs keep the
+        full-solve path."""
+        cached = self._eligible_cache.get(name)
+        if cached is not None:
+            return cached
+        spec = self.store.cluster_queues.get(name)
+        ok = (spec is not None
+              and not spec.preemption.any_enabled
+              and len(spec.resource_groups) <= 1
+              and sum(len(rg.flavors)
+                      for rg in spec.resource_groups) <= 1
+              and not (spec.admission_scope is not None
+                       and self.queues.afs is not None)
+              and not self.engine._is_tas_cq(name))
+        self._eligible_cache[name] = ok
+        return ok
+
+    # -- window lifecycle --------------------------------------------------
+
+    def note_solve_begin(self) -> None:
+        """Called by the engine right before a full solve: records
+        the fence generation and spec generation the solve's export
+        can possibly cover. Events landing after this mark survive
+        note_full_solve — the solve never saw them."""
+        with self._mu:
+            self._solve_mark = self._gen
+            self._solve_spec_gen = self.engine.export_cache.spec_gen
+            self._solve_thread = threading.get_ident()
+
+    def note_full_solve(self) -> None:
+        """A full solve completed: fences raised before it began
+        reset and the next window opens against the post-solve store
+        (the oracle-parity baseline boundary). Fences and spec bumps
+        from mid-solve events stay — they defer to the NEXT solve."""
+        with self._mu:
+            self.armed = True
+            self._armed_gen = self._solve_spec_gen
+            self._solve_thread = None
+            self._contended_roots = {
+                root: g for root, g in self._contended_roots.items()
+                if g > self._solve_mark}
+            self._snap = None
+            self._max_admitted.clear()
+
+    def note_solve_abort(self) -> None:
+        """The solve failed (host fallback): stop attributing events
+        to it; every fence it raised stays down until a COMPLETED
+        solve re-arms."""
+        with self._mu:
+            self._solve_thread = None
+
+    def _window_snapshot(self):
+        if self._snap is None:
+            from kueue_oss_tpu.core.snapshot import build_snapshot
+
+            self._snap = build_snapshot(self.store)
+        return self._snap
+
+    # -- the micro-drain ---------------------------------------------------
+
+    def drain(self, now: float = 0.0) -> MicroDrainResult:
+        """Admit in-order arrivals for every uncontended fast-path CQ.
+
+        Runs between full solves; O(pending-in-eligible-CQs), never
+        O(store) beyond the one lazily built window snapshot."""
+        result = MicroDrainResult()
+        if not self.enabled or not self.armed:
+            return result
+        if self.engine.enable_fair_sharing:
+            return result
+        if self.engine.export_cache.spec_gen != self._armed_gen:
+            # quota edit / flavor change / node flap since arm: the
+            # whole window is fenced until the next full solve
+            with self._mu:
+                self.armed = False
+            metrics.stream_demotions_total.inc("spec_change")
+            return result
+        t0 = time.perf_counter()
+        self.micro_drains += 1
+        pending = self.queues.cqs_with_pending()
+        if not pending:
+            metrics.stream_microdrains_total.inc("idle")
+            return result
+        self._refresh_tables()
+        with self._mu:
+            contended = set(self._contended_roots)
+        for name in pending:
+            if result.admitted + result.parked >= self.max_batch:
+                break
+            root = self._root_of.get(name, f"cq:{name}")
+            if root in contended:
+                result.deferred_cqs += 1
+                continue
+            q = self.queues.queues.get(name)
+            if q is not None and len(q._in_heap) > 4 * self.max_batch:
+                # a flood-sized heap is the batched solver's job (the
+                # scheduler's solver_min_backlog routing); walking it
+                # entry-by-entry here would stall the serve loop
+                result.deferred_cqs += 1
+                continue
+            if not self._root_streamable.get(root, False):
+                # borrow-capable multi-CQ subtree: the batch oracle
+                # interleaves its members round-by-round — only the
+                # full solve reproduces that order
+                result.deferred_cqs += 1
+                metrics.stream_demotions_total.inc("borrow_capable")
+                continue
+            if not self._static_eligible(name):
+                result.deferred_cqs += 1
+                continue
+            if not self._drain_cq(name, root, now, result):
+                contended.add(root)  # demoted mid-walk
+        result.duration_s = time.perf_counter() - t0
+        metrics.stream_microdrains_total.inc(
+            "admitted" if result.admitted else
+            ("parked" if result.parked else
+             ("deferred" if result.deferred_cqs else "idle")))
+        if result.admitted:
+            self._record_ledger(result)
+            p = getattr(self.store, "persistence", None)
+            if p is not None:
+                # sub-cycle durability barrier: the micro-batch's
+                # intents + events group-commit now, and the
+                # (incremental) checkpoint cadence gets its look —
+                # this is what makes sub-second cadences affordable
+                p.flush()
+        return result
+
+    def _drain_cq(self, name: str, root: str, now: float,
+                  result: MicroDrainResult) -> bool:
+        """Greedy in-order walk of one CQ's heap. Returns False when
+        the CQ demoted itself (out-of-order arrival, preempt-needed,
+        unsupported shape) — the caller fences its root for the rest
+        of this drain; the sticky fence rides ``_contended_roots``."""
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.api.types import QueueingStrategy
+
+        q = self.queues.queues.get(name)
+        if q is None:
+            return True
+        strict = q.strategy == QueueingStrategy.STRICT_FIFO
+        ca_gate = features.enabled("ConcurrentAdmission")
+        snap = self._window_snapshot()
+        cq_snap = snap.cluster_queue(name)
+        if cq_snap is None:
+            return True
+        floor = self._max_admitted.get(name)
+        for info in q.snapshot_order():
+            # max_batch bounds PROCESSED entries (admits + parks), not
+            # just admissions — one micro-drain must never walk an
+            # unbounded no-fit backlog; the remainder keeps its order
+            # for the next tick (or the full solve)
+            if result.admitted + result.parked >= self.max_batch:
+                return True
+            with self._mu:
+                # live fence re-check per entry: a controller thread
+                # may contend this root mid-walk (capacity freed);
+                # committing past that point would stream into state
+                # the batch oracle would re-order
+                if root in self._contended_roots or not self.armed:
+                    return True
+            key = _order_key(info)
+            if floor is not None and key < floor:
+                # out-of-order arrival: the batch oracle would have
+                # sorted it before admissions already committed this
+                # window — demote before processing it
+                self._contend(name, "out_of_order")
+                return False
+            wl = self.store.workloads.get(info.key)
+            if wl is None or wl.is_quota_reserved or not wl.active:
+                continue
+            if any(ps.topology_request is not None for ps in wl.podsets):
+                self._contend(name, "unsupported")
+                return False
+            if ca_gate and wl.parent_workload is not None:
+                self._contend(name, "unsupported")
+                return False
+            fresh = WorkloadInfo(wl, cluster_queue=name)
+            assigner = FlavorAssigner(
+                fresh, cq_snap, snap.resource_flavors,
+                oracle=self._preemptor, enable_fair_sharing=False)
+            assignment = assigner.assign()
+            mode = assignment.representative_mode()
+            if mode == fa.FIT:
+                self._commit(wl, name, fresh, assignment, now, result)
+                floor = key
+                self._max_admitted[name] = key
+                continue
+            # NO_FIT, or PREEMPT on a CQ whose policies are all Never
+            # (static eligibility excludes preemption-capable CQs, so
+            # "fits only by preempting" is a lean-kernel park) —
+            # kernel parity: BestEffortFIFO parks and walks on; a
+            # blocked StrictFIFO head blocks the queue
+            if strict:
+                return True
+            q.park(info.key)
+            result.parked += 1
+            obs.recorder.record(
+                obs.SKIPPED, info.key, cycle=self._cycle(),
+                cluster_queue=name, path=obs.STREAM,
+                reason="parked inadmissible by the streaming fast "
+                       "path: no flavor option fits at current "
+                       "capacity",
+                reason_slug="stream_parked")
+        return True
+
+    def _cycle(self) -> int:
+        sched = self.engine.scheduler
+        return (sched.cycle_count + 1 if sched is not None
+                else self.engine.drain_count + 1)
+
+    def _commit(self, wl, name: str, info: WorkloadInfo, assignment,
+                now: float, result: MicroDrainResult) -> None:
+        flavor_of: dict[str, str] = {}
+        for psa in assignment.podsets:
+            for r, rec in psa.flavors.items():
+                flavor_of[r] = rec.name
+        drain_result = _EngineResultAdapter()
+        self.engine._drain_cycle = self._cycle()
+        self.engine.last_drain_arm = "stream"
+        self._committing_thread = threading.get_ident()
+        try:
+            self.engine._commit_admission(
+                wl, name, flavor_of, info, now, drain_result)
+        finally:
+            self._committing_thread = None
+        # keep the window snapshot current so the next entry's fit
+        # check sees this admission's usage (the kernel's in-round
+        # usage refresh, host-side)
+        cq_snap = self._snap.cluster_queue(name)
+        if cq_snap is not None:
+            cq_snap.add_usage(dict(assignment.usage_quota))
+        result.admitted += drain_result.admitted
+        result.admitted_keys.extend(drain_result.admitted_keys)
+        metrics.stream_admitted_total.inc(by=drain_result.admitted)
+
+    def _record_ledger(self, result: MicroDrainResult) -> None:
+        ledger = obs.cycle_ledger
+        if not ledger.enabled:
+            return
+        ledger.record(
+            self._cycle(), obs.STREAM_DRAIN,
+            breaker=obs.breaker_state_name(),
+            duration_s=result.duration_s,
+            phases={"stream": round(result.duration_s, 6)},
+            admitted=result.admitted, parked=result.parked,
+            solver_arm="stream",
+            detail={"deferredCqs": result.deferred_cqs})
+
+    # -- introspection -----------------------------------------------------
+
+    def contended(self) -> set[str]:
+        with self._mu:
+            return set(self._contended_roots)
+
+    def status(self) -> dict:
+        gen, keys, cqs = self.engine.export_cache.dirty_snapshot()
+        with self._mu:
+            return {"armed": self.armed,
+                    "contendedRoots": sorted(self._contended_roots),
+                    "specGen": gen, "armedGen": self._armed_gen,
+                    "dirtyKeys": len(keys), "dirtyCqs": len(cqs),
+                    "microDrains": self.micro_drains}
+
+
+def _can_borrow(spec) -> bool:
+    """Whether any flavor quota of this CQ permits borrowing
+    (borrowing_limit None = unlimited, the kueue default)."""
+    for rg in spec.resource_groups:
+        for fq in rg.flavors:
+            for rq in fq.resources:
+                if rq.borrowing_limit is None or rq.borrowing_limit > 0:
+                    return True
+    return False
+
+
+class _EngineResultAdapter:
+    """Duck-typed DrainResult stand-in for _commit_admission."""
+
+    def __init__(self) -> None:
+        self.admitted = 0
+        self.admitted_keys: list[str] = []

@@ -33,6 +33,14 @@ construction (property-tested in tests/test_solver_delta.py). Anything
 the delta cannot express cheaply (shape growth, scale flips, renumber
 events, >50% dirty rows) degrades to a full sync, and the engine's
 plan-sanity guard still validates every imported plan.
+
+Streaming interplay (scheduler/streaming.py): a sub-cycle
+micro-admission is an ordinary store event — it dirties its
+ExportCache row, the workload leaves the next export's pending set,
+and its session slot recycles like any other departure. The content
+diff ships exactly those rows at the next full solve, so resident
+device tensors stay valid across arbitrarily many micro-drains with
+no session reset and no full re-upload.
 """
 
 from __future__ import annotations
@@ -505,6 +513,16 @@ class HostDeltaSession:
         return slotted, SessionFrame(epoch=self.epoch, checksum=checksum,
                                      delta=delta,
                                      full_reason=full_reason, stats=stats)
+
+    def last_sync_wire_bytes(self) -> int:
+        """Wire payload of the most recent full-sync state — the
+        byte-accounting counterpart of ``ProblemDelta.payload_bytes``
+        for sync frames, owned here so ledger consumers (engine and
+        streaming drains) never reach into ``_last`` internals."""
+        if self._last is None:
+            return 0
+        return sum(int(getattr(a, "nbytes", 0))
+                   for a in self._last[0].values())
 
     def _drain_stats(self, keys: list[str]) -> dict:
         prev = {k for k in self._last_keys if k}

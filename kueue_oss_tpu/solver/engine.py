@@ -227,6 +227,10 @@ class SolverEngine:
         #: result of the most recent disagreement audit (None = the
         #: last relax drain was not audited)
         self.last_relax_audit: Optional[bool] = None
+        #: streaming micro-batch admitter (scheduler/streaming.py);
+        #: every completed full drain re-arms its fences — a full
+        #: solve is the oracle-parity baseline boundary
+        self.streaming = None
 
     def _tracer(self):
         if self.tracer is not None:
@@ -449,8 +453,16 @@ class SolverEngine:
         tracer = self._tracer()
         with (tracer.span("solver_drain", cycle=self._drain_cycle)
               if tracer is not None else contextlib.nullcontext()):
+            completed = False
+            if self.streaming is not None:
+                # mark which fences this solve's export can cover:
+                # events landing mid-solve keep their subtree fenced
+                # past note_full_solve (the solve never saw them)
+                self.streaming.note_solve_begin()
             try:
-                return self._drain(now, verify)
+                result = self._drain(now, verify)
+                completed = True
+                return result
             finally:
                 # prework computed for a drain that failed before its
                 # apply must never leak into the next drain (stale
@@ -461,6 +473,16 @@ class SolverEngine:
                 persistence = getattr(self.store, "persistence", None)
                 if persistence is not None:
                     persistence.flush()
+                if self.streaming is not None:
+                    # full-solve boundary: the streaming fences reset
+                    # against the post-solve store (a failed drain
+                    # keeps them down — host fallback cycles are not
+                    # a parity baseline — but must stop attributing
+                    # events to the dead solve)
+                    if completed:
+                        self.streaming.note_full_solve()
+                    else:
+                        self.streaming.note_solve_abort()
 
     def _drain(self, now: float, verify: bool) -> DrainResult:
         pending = self.pending_backlog()
@@ -548,10 +570,8 @@ class SolverEngine:
                 frame_kind = "sync"
                 frame_reason = frame.full_reason or ""
                 sess_obj = self._delta_sessions.get(kind)
-                if sess_obj is not None and sess_obj._last is not None:
-                    frame_bytes = sum(
-                        int(getattr(a, "nbytes", 0))
-                        for a in sess_obj._last[0].values())
+                if sess_obj is not None:
+                    frame_bytes = sess_obj.last_sync_wire_bytes()
         arm = ("remote" if self.remote is not None
                else (self.last_drain_arm or "single"))
         ledger.record(
@@ -1705,9 +1725,12 @@ class SolverEngine:
                                       namespace=wl.namespace,
                                       exemplar=exemplar)
         # queue-wait SLI feed (obs/health.py), host-path parity: the
-        # solver drain's admissions count against the same objectives
+        # solver drain's admissions count against the same objectives;
+        # the priority scope keys by WorkloadPriorityClass name
+        pclass = obs.priority_class_of(self.store, wl)
         obs.slo_engine.observe_admission(
-            cq_name, wait_s, priority=wl.priority, now=now,
+            cq_name, wait_s, priority=wl.priority,
+            priority_class=pclass, now=now,
             cycle=self._drain_cycle, workload=key)
         obs.recorder.record(
             obs.SOLVER_ADMITTED, key, cycle=self._drain_cycle,
@@ -1720,6 +1743,7 @@ class SolverEngine:
                 "admitted": wl.is_admitted,
                 "waitSeconds": round(wait_s, 3),
                 "priority": wl.priority,
+                "priorityClass": pclass,
                 # which solver arm produced this plan (relax / mesh /
                 # single / remote) — joins the ledger row's solver_arm
                 "solver_arm": ("remote" if self.remote is not None

@@ -358,6 +358,18 @@ class ExportCache:
         self.dirty_keys, self.dirty_cqs = set(), set()
         return keys, cqs
 
+    def dirty_snapshot(self) -> tuple[int, frozenset, frozenset]:
+        """Non-consuming view (spec_gen, dirty keys, dirty CQs).
+
+        The streaming fast path (scheduler/streaming.py) reads this
+        for its fences and status surface: spec_gen is THE spec-change
+        fence (any quota edit, flavor change, cohort edit, or node
+        flap bumps it), and the dirty sets size the delta the next
+        full solve will ship — without stealing the delta session's
+        consume_dirty()."""
+        return (self.spec_gen, frozenset(self.dirty_keys),
+                frozenset(self.dirty_cqs))
+
     # -- derived-table lifecycle ------------------------------------------
 
     def refresh(self, fr_list: list, cq_names: list[str], K: int,

@@ -328,7 +328,8 @@ class Dashboard:
     def health_view(self) -> dict:
         """One-look cluster health (GET /api/health): worst-signal
         status rollup over the burn-rate alerts, the starvation
-        watchdog, the solver breaker, and the invariant auditor."""
+        watchdog, the solver breaker, the invariant auditor, and the
+        ledger-driven phase-regression detector."""
         from kueue_oss_tpu import metrics, obs
 
         report = self._slo_report()
@@ -336,9 +337,10 @@ class Dashboard:
         starved = [s for s in report["starvation"] if s["starved"]]
         breaker = obs.breaker_state_name()
         violations = int(metrics.invariant_last_violations.value())
+        regressions = obs.phase_regression.regressing()
         if firing or violations:
             status = "critical"
-        elif starved or breaker != "closed":
+        elif starved or breaker != "closed" or regressions:
             status = "degraded"
         else:
             status = "ok"
@@ -349,6 +351,7 @@ class Dashboard:
             "starved": starved,
             "breakerState": breaker,
             "invariantViolations": violations,
+            "phaseRegressions": regressions,
             "ledger": {
                 "rows": len(obs.cycle_ledger.rows()),
                 "lastCycle": last.cycle if last is not None else 0,

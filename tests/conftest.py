@@ -56,6 +56,13 @@ def pytest_configure(config):
         "plan-feasibility guarantees, 4-arm router audit/demotion "
         "transitions; deterministic, CPU-backend, runs in tier-1")
     config.addinivalue_line(
+        "markers", "streaming: streaming control plane tests "
+        "(scheduler/streaming.py + persist incremental checkpoints / "
+        "log shipping): oracle-parity event-replay property tests, "
+        "contention-fence transitions, checkpoint-chain byte "
+        "identity, and the SIGKILL log-shipping failover harness; "
+        "deterministic, runs in tier-1")
+    config.addinivalue_line(
         "markers", "slo: cluster health layer tests (obs/ledger.py + "
         "obs/health.py): virtual-clock burn-rate sequences, starvation "
         "watchdog, exemplar round-trips, ledger joins, and the "

@@ -1,0 +1,844 @@
+"""Streaming control plane tests (docs/ARCHITECTURE.md "Streaming
+dataflow" + docs/DURABILITY.md "Incremental checkpoints" / "Log
+shipping"):
+
+- oracle-parity property: randomized arrival/finish/quota/flap event
+  replays drive a streaming twin (micro-drain after every event) and a
+  pure cycle-batch twin; the canonical store dumps must be
+  byte-identical at EVERY full-solve boundary;
+- contention fences: sibling-pending (the borrowing coupling),
+  capacity-freed events, preemption-enabled CQs, spec edits, and
+  out-of-order arrivals all demote the fast path until the next full
+  solve;
+- incremental checkpoints: delta chains recover byte-identically to
+  the live store, survive pruning (the full base outlives the
+  retention window), and recovery forces a fresh full baseline;
+- WAL log shipping: per-key compaction preserves recovered state, the
+  warm standby replays continuously, and a SIGKILL failover replays
+  only the unsynced tail;
+- satellites: per-priority-CLASS SLIs, the ledger-driven phase
+  regression detector, and webhook/callback alert sinks.
+"""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kueue_oss_tpu import metrics, obs
+from kueue_oss_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    Node,
+    PodSet,
+    PreemptionPolicy,
+    QueueingStrategy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+    WorkloadPriorityClass,
+)
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.persist import (
+    PersistenceManager,
+    WarmStandby,
+    canonical_dump,
+    compact_records,
+    materialize_chain,
+)
+from kueue_oss_tpu.persist import checkpoint as ckpt_mod
+from kueue_oss_tpu.persist import wal as wal_mod
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+pytestmark = pytest.mark.streaming
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    metrics.reset_all()
+    obs.recorder.clear()
+    obs.cycle_ledger.clear()
+    obs.slo_engine.reset()
+    obs.phase_regression.reset()
+    yield
+    metrics.reset_all()
+    obs.recorder.clear()
+    obs.cycle_ledger.clear()
+    obs.slo_engine.reset()
+    obs.phase_regression.reset()
+
+
+def make_cq(name, nominal, cohort=None, strategy=None, preempt=False,
+            bl=None):
+    return ClusterQueue(
+        name=name, cohort=cohort,
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources=[
+                ResourceQuota(name="cpu", nominal=nominal,
+                              borrowing_limit=bl)])])],
+        queueing_strategy=(strategy
+                           or QueueingStrategy.BEST_EFFORT_FIFO),
+        preemption=(PreemptionPolicy(
+            within_cluster_queue="LowerPriority") if preempt
+            else PreemptionPolicy()),
+    )
+
+
+def build_store(cqs, cohorts=()):
+    store = Store()
+    store.upsert_resource_flavor(ResourceFlavor(name="default"))
+    store.upsert_node(Node(name="n1", allocatable={"cpu": 100000}))
+    for c in cohorts:
+        store.upsert_cohort(c)
+    for cq in cqs:
+        store.upsert_cluster_queue(cq)
+        store.upsert_local_queue(
+            LocalQueue(name=f"lq-{cq.name}", cluster_queue=cq.name))
+    return store
+
+
+def submit(store, name, cq, t, uid, cpu=500, prio=0):
+    store.add_workload(Workload(
+        name=name, queue_name=f"lq-{cq}", priority=prio,
+        creation_time=t, uid=uid,
+        podsets=[PodSet(count=1, requests={"cpu": cpu})]))
+
+
+def _make_sched(store, streaming):
+    qm = QueueManager(store)
+    sched = Scheduler(store, qm, solver="auto", solver_min_backlog=0,
+                      streaming=streaming)
+    return qm, sched, sched._solver_engine()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: sub-cycle admission + oracle parity
+# ---------------------------------------------------------------------------
+
+
+class TestStreamingFastPath:
+    def test_subcycle_admission(self):
+        store = build_store([make_cq("a", 10_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        submit(store, "w0", "a", 1.0, 1)
+        eng.drain(now=100.0, verify=True)
+        sa = sched._streaming_admitter()
+        assert sa.armed
+        submit(store, "w1", "a", 2.0, 2)
+        submit(store, "w2", "a", 3.0, 3)
+        res = sched.micro_drain(100.5)
+        assert res.admitted == 2
+        assert store.workloads["default/w1"].is_admitted
+        assert store.workloads["default/w2"].is_admitted
+        # the commit is the engine's: intent-fenced store write, SLO
+        # feed, recorder event tagged with the stream arm
+        ev = obs.recorder.explain("default/w2")[0]
+        assert ev.detail["solver_arm"] == "stream"
+        # ledger row for the micro-drain
+        row = obs.cycle_ledger.last_row(obs.STREAM_DRAIN)
+        assert row is not None and row.admitted == 2
+        assert metrics.stream_admitted_total.total() == 2
+        # delta-session slot coords stay valid: the admissions ride
+        # the dirty sets the next full solve ships as row deltas
+        _gen, dirty, _cqs = eng.export_cache.dirty_snapshot()
+        assert "default/w1" in dirty and "default/w2" in dirty
+
+    def test_parked_no_fit_matches_kernel(self):
+        store = build_store([make_cq("a", 1_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        submit(store, "big", "a", 1.0, 1, cpu=5_000)
+        submit(store, "ok", "a", 2.0, 2, cpu=500)
+        res = sched.micro_drain(100.5)
+        # BestEffortFIFO: no-fit parks, the walk continues in order
+        assert res.parked == 1 and res.admitted == 1
+        assert store.workloads["default/ok"].is_admitted
+        assert not store.workloads["default/big"].is_quota_reserved
+
+    def test_strict_fifo_blocked_head_blocks(self):
+        store = build_store([make_cq(
+            "s", 1_000, strategy=QueueingStrategy.STRICT_FIFO)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        submit(store, "big", "s", 1.0, 1, cpu=5_000)
+        submit(store, "ok", "s", 2.0, 2, cpu=500)
+        res = sched.micro_drain(100.5)
+        assert res.admitted == 0 and res.parked == 0
+        assert not store.workloads["default/ok"].is_quota_reserved
+
+
+def _parity_topology():
+    # a/b: no-borrow cohort-mates (capacity-independent => both
+    # stream); c: standalone (streams, may borrow — nobody races it);
+    # d/e: borrow-capable cohort (the structural fence keeps them on
+    # the full-solve path inside the same replay)
+    return ([make_cq("a", 3_000, cohort="co", bl=0),
+             make_cq("b", 2_000, cohort="co", bl=0),
+             make_cq("c", 2_500),
+             make_cq("d", 1_500, cohort="co2"),
+             make_cq("e", 1_500, cohort="co2")],
+            [Cohort(name="co"), Cohort(name="co2")])
+
+
+def _gen_script(seed, windows=4, events_per_window=6):
+    """Deterministic event script. Spec events (quota edits, node
+    flaps) land at window starts — production schedules a full solve
+    on spec edits (the serve loop falls through to the full path when
+    the fence drops), so a boundary is where they belong; mid-window
+    they would only fence (covered by the fence tests)."""
+    rng = random.Random(seed)
+    cqs = ["a", "b", "c", "d"]
+    prio_of = {"a": 0, "b": 5, "c": 0, "d": 2}
+    uid = 10
+    arrivals = []  # (name, window)
+    script = []
+    for w in range(windows):
+        window = []
+        if w > 0 and rng.random() < 0.5:
+            if rng.random() < 0.5:
+                window.append(("quota", "a",
+                               rng.choice([2_000, 3_000, 4_000])))
+            else:
+                window.append(("flap",))
+        while len(window) < events_per_window:
+            old = [a for a in arrivals if a[1] <= w - 2]
+            if old and rng.random() < 0.2:
+                name = rng.choice(old)[0]
+                window.append(("finish", f"default/{name}"))
+            else:
+                cq = rng.choice(cqs)
+                name = f"w{uid}"
+                window.append(("arrive", cq, name, uid,
+                               rng.choice([500, 1_000, 1_500]),
+                               prio_of[cq]))
+                arrivals.append((name, w))
+                uid += 1
+        script.append(window)
+    return script
+
+
+def _run_twin(script, streaming):
+    cqs, cohorts = _parity_topology()
+    store = build_store(cqs, cohorts)
+    _qm, sched, eng = _make_sched(store, streaming=streaming)
+    eng.drain(now=99.0, verify=True)  # boundary 0 arms the fences
+    flap_down = False
+    dumps = []
+    for k, window in enumerate(script):
+        now = 100.0 + k
+        for ev in window:
+            if ev[0] == "arrive":
+                _, cq, name, uid, cpu, prio = ev
+                submit(store, name, cq, 10.0 + uid, uid,
+                       cpu=cpu, prio=prio)
+            elif ev[0] == "finish":
+                sched.finish_workload(ev[1], now=now)
+            elif ev[0] == "quota":
+                store.upsert_cluster_queue(
+                    make_cq(ev[1], ev[2], cohort="co", bl=0))
+            elif ev[0] == "flap":
+                flap_down = not flap_down
+                store.upsert_node(Node(
+                    name="n1", allocatable={"cpu": 100000},
+                    ready=not flap_down))
+            if streaming:
+                sched.micro_drain(now)
+        eng.drain(now=now, verify=True)
+        dumps.append(canonical_dump(store))
+    return dumps
+
+
+class TestOracleParity:
+    @pytest.mark.parametrize("seed", [7, 23])
+    def test_streaming_bit_identical_at_boundaries(self, seed):
+        script = _gen_script(seed)
+        stream_dumps = _run_twin(script, streaming=True)
+        batch_dumps = _run_twin(script, streaming=False)
+        for k, (s, b) in enumerate(zip(stream_dumps, batch_dumps)):
+            assert s == b, f"seed {seed}: diverged at boundary {k}"
+
+    def test_streaming_actually_streamed(self):
+        # the parity above must not be vacuous: the streaming twin
+        # admits a meaningful share of arrivals sub-cycle
+        script = _gen_script(7)
+        metrics.reset_all()
+        _run_twin(script, streaming=True)
+        assert metrics.stream_admitted_total.total() >= 3
+
+
+# ---------------------------------------------------------------------------
+# contention fences
+# ---------------------------------------------------------------------------
+
+
+class TestContentionFences:
+    def test_borrow_capable_cohort_defers_to_full_solve(self):
+        cqs, cohorts = _parity_topology()
+        store = build_store(cqs, cohorts)
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        # d/e share a borrow-capable cohort: the batch oracle
+        # interleaves them round-by-round, so neither ever streams
+        submit(store, "xd", "d", 1.0, 1, cpu=2_000)  # needs borrow
+        submit(store, "xe", "e", 2.0, 2, cpu=500)
+        res = sched.micro_drain(100.5)
+        assert res.admitted == 0
+        assert res.deferred_cqs >= 2
+        assert metrics.stream_demotions_total.value(
+            "borrow_capable") >= 1
+        # no-borrow cohort-mates and the standalone CQ still stream
+        submit(store, "xa", "a", 3.0, 3, cpu=500)
+        submit(store, "xb", "b", 4.0, 4, cpu=500)
+        submit(store, "xc", "c", 5.0, 5, cpu=500)
+        res = sched.micro_drain(100.6)
+        assert res.admitted == 3
+        # the full solve resolves the borrow-capable cohort jointly
+        eng.drain(now=101.0, verify=True)
+        assert store.workloads["default/xd"].is_admitted
+        assert store.workloads["default/xe"].is_admitted
+
+    def test_capacity_event_demotes_until_full_solve(self):
+        store = build_store([make_cq("a", 1_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        submit(store, "w0", "a", 1.0, 1, cpu=1_000)
+        eng.drain(now=100.0, verify=True)
+        # a finish frees capacity -> preemption-candidate class event
+        sched.finish_workload("default/w0", now=100.2)
+        submit(store, "w1", "a", 2.0, 2, cpu=900)
+        res = sched.micro_drain(100.5)
+        assert res.admitted == 0  # fenced: capacity event in subtree
+        assert metrics.stream_demotions_total.value(
+            "cohort_event") >= 1
+        eng.drain(now=101.0, verify=True)  # boundary re-arms
+        assert store.workloads["default/w1"].is_admitted
+        submit(store, "w2", "a", 3.0, 3, cpu=50)
+        assert sched.micro_drain(101.5).admitted == 1
+
+    def test_preemption_cq_never_fast_pathed(self):
+        store = build_store([make_cq("p", 10_000, preempt=True)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        sa = sched._streaming_admitter()
+        submit(store, "w1", "p", 1.0, 1)
+        res = sched.micro_drain(100.5)
+        assert res.admitted == 0 and res.deferred_cqs == 1
+        assert not sa._static_eligible("p")
+
+    def test_spec_change_fences_whole_window(self):
+        store = build_store([make_cq("a", 10_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        store.upsert_cluster_queue(make_cq("a", 9_000))  # quota edit
+        submit(store, "w1", "a", 1.0, 1)
+        res = sched.micro_drain(100.5)
+        assert res.admitted == 0
+        assert metrics.stream_demotions_total.value(
+            "spec_change") >= 1
+        eng.drain(now=101.0, verify=True)
+        assert store.workloads["default/w1"].is_admitted
+
+    def test_out_of_order_arrival_demotes(self):
+        store = build_store([make_cq("a", 10_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        submit(store, "lo", "a", 1.0, 1, prio=0)
+        assert sched.micro_drain(100.2).admitted == 1
+        # higher priority sorts BEFORE the admitted one: demote
+        submit(store, "hi", "a", 2.0, 2, prio=9)
+        res = sched.micro_drain(100.4)
+        assert res.admitted == 0
+        assert metrics.stream_demotions_total.value(
+            "out_of_order") >= 1
+        eng.drain(now=101.0, verify=True)
+        assert store.workloads["default/hi"].is_admitted
+
+
+# ---------------------------------------------------------------------------
+# incremental checkpoints
+# ---------------------------------------------------------------------------
+
+
+def _churn(store, mgr, start, n, delete_from=None):
+    for i in range(start, start + n):
+        submit(store, f"w{i}", "a", float(i), 100 + i)
+    if delete_from is not None:
+        for key in list(store.workloads)[:delete_from]:
+            store.delete_workload(key)
+    mgr.flush()
+
+
+class TestIncrementalCheckpoints:
+    def test_chain_recovery_byte_identity(self, tmp_path):
+        d = str(tmp_path / "dur")
+        store = build_store([make_cq("a", 10_000)])
+        mgr = PersistenceManager(d, fsync="off", incremental=True,
+                                 full_checkpoint_every=8)
+        mgr.attach(store)
+        _churn(store, mgr, 0, 5)
+        assert mgr.checkpoint() == 1  # first is always full
+        metas = [ckpt_mod.load_checkpoint(p)[0]
+                 for _i, p in ckpt_mod.list_checkpoints(d)]
+        assert not ckpt_mod.is_incremental(metas[0])
+        _churn(store, mgr, 5, 3)
+        mgr.checkpoint()
+        wl_del = next(iter(store.workloads))
+        store.delete_workload(wl_del)
+        _churn(store, mgr, 8, 2)
+        mgr.checkpoint()
+        chain = ckpt_mod.newest_valid_chain(d)
+        kinds = [ckpt_mod.is_incremental(m) for m, _s in chain]
+        assert kinds == [False, True, True]
+        # chain materialization alone == live store (no WAL suffix)
+        assert canonical_dump(materialize_chain(chain)) == \
+            canonical_dump(store)
+        # full recovery (chain + suffix) after more churn
+        _churn(store, mgr, 10, 2)
+        mgr.flush()
+        mgr.close()
+        rec = PersistenceManager(d, fsync="off")
+        rr = rec.recover()
+        assert canonical_dump(rr.store) == canonical_dump(store)
+        assert rr.checkpoint_id == 3
+        rec.close()
+
+    def test_incremental_payload_is_the_delta(self, tmp_path):
+        d = str(tmp_path / "dur")
+        store = build_store([make_cq("a", 10_000)])
+        mgr = PersistenceManager(d, fsync="off", incremental=True)
+        mgr.attach(store)
+        _churn(store, mgr, 0, 50)
+        mgr.checkpoint()
+        full_size = os.path.getsize(ckpt_mod.checkpoint_path(d, 1))
+        _churn(store, mgr, 50, 2)  # <5% dirty
+        mgr.checkpoint()
+        incr_size = os.path.getsize(ckpt_mod.checkpoint_path(d, 2))
+        assert incr_size < full_size * 0.2
+        mgr.close()
+
+    def test_prune_keeps_full_base_of_retained_chain(self, tmp_path):
+        d = str(tmp_path / "dur")
+        store = build_store([make_cq("a", 10_000)])
+        mgr = PersistenceManager(d, fsync="off", incremental=True,
+                                 full_checkpoint_every=100,
+                                 keep_checkpoints=2)
+        mgr.attach(store)
+        for k in range(5):
+            _churn(store, mgr, 3 * k, 3)
+            mgr.checkpoint()
+        ids = [i for i, _p in ckpt_mod.list_checkpoints(d)]
+        # retention keeps the newest 2 AND their chain closure down
+        # to the full base (checkpoint 1)
+        assert 1 in ids and 5 in ids and 4 in ids
+        rec = PersistenceManager(d, fsync="off")
+        rr = rec.recover()
+        assert canonical_dump(rr.store) == canonical_dump(store)
+        rec.close()
+        mgr.close()
+
+    def test_recovery_resets_incremental_baseline(self, tmp_path):
+        d = str(tmp_path / "dur")
+        store = build_store([make_cq("a", 10_000)])
+        mgr = PersistenceManager(d, fsync="off", incremental=True)
+        mgr.attach(store)
+        _churn(store, mgr, 0, 3)
+        mgr.checkpoint()
+        mgr.close()
+        mgr2 = PersistenceManager(d, fsync="off", incremental=True)
+        rr = mgr2.recover()
+        mgr2.attach(rr.store)
+        submit(rr.store, "post", "a", 99.0, 999)
+        mgr2.flush()
+        new_id = mgr2.checkpoint()
+        meta, _s = ckpt_mod.load_checkpoint(
+            ckpt_mod.checkpoint_path(d, new_id))
+        # unknown dirty baseline after restart => full dump
+        assert not ckpt_mod.is_incremental(meta)
+        mgr2.close()
+
+
+# ---------------------------------------------------------------------------
+# WAL log shipping + warm standby
+# ---------------------------------------------------------------------------
+
+
+class TestLogShipping:
+    def test_compaction_preserves_recovered_state(self, tmp_path):
+        d = str(tmp_path / "dur")
+        store = build_store([make_cq("a", 10_000)])
+        mgr = PersistenceManager(d, fsync="off")
+        mgr.attach(store)
+        qm = QueueManager(store)
+        sched = Scheduler(store, qm)
+        for i in range(6):
+            submit(store, f"w{i}", "a", float(i), 100 + i)
+        sched.run_until_quiet(now=50.0)  # admissions => intents+events
+        sched.finish_workload("default/w0", now=60.0)
+        mgr.flush()
+        mgr.close()
+        path = os.path.join(d, "wal-00000000.log")
+        records, _torn = wal_mod.replay_wal(path)
+        kept, dropped = compact_records(records)
+        assert dropped > 0
+        raw = Store()
+        from kueue_oss_tpu.persist import apply_event
+
+        for rec in records:
+            if rec.get("t") == "event":
+                apply_event(raw, rec["verb"], rec["kind"], rec["obj"])
+        compacted = Store()
+        for rec in kept:
+            if rec.get("t") == "event":
+                apply_event(compacted, rec["verb"], rec["kind"],
+                            rec["obj"])
+        assert canonical_dump(raw) == canonical_dump(compacted)
+
+    def test_compact_records_keeps_unmatched_intents(self):
+        recs = [
+            {"t": "intent", "op": "admit", "wl": "d/x", "rv": 3},
+            {"t": "event", "verb": "update", "kind": "Workload",
+             "obj": {"namespace": "d", "name": "x",
+                     "resource_version": 4}},
+            {"t": "intent", "op": "admit", "wl": "d/y", "rv": 7},
+        ]
+        kept, dropped = compact_records(recs)
+        assert dropped == 1  # the satisfied d/x intent
+        assert {r.get("wl") for r in kept
+                if r.get("t") == "intent"} == {"d/y"}
+
+    def test_shipper_restart_never_corrupts_standby(self, tmp_path):
+        """A restarted primary re-bootstraps shipping over a target
+        that already holds tail-shipped and compacted-sealed copies:
+        the .sealed markers and size-resumed cursors must keep every
+        standby file a valid frame stream (no re-appends after a
+        shorter compacted copy, no duplicate prefixes)."""
+        d = str(tmp_path / "dur")
+        ship = str(tmp_path / "standby")
+        store = build_store([make_cq("a", 10_000)])
+        mgr = PersistenceManager(d, fsync="off", ship_to=ship)
+        mgr.attach(store)
+        mgr.checkpoint()
+        _churn(store, mgr, 0, 4)
+        mgr.checkpoint()  # seals (compacts) segment 1
+        _churn(store, mgr, 4, 3)
+        mgr.close()
+        # restart the primary over the same dirs; keep churning
+        mgr2 = PersistenceManager(d, fsync="off", ship_to=ship)
+        rr = mgr2.recover()
+        mgr2.attach(rr.store)
+        _churn(rr.store, mgr2, 7, 3)
+        mgr2.flush()
+        mgr2.close()
+        standby = WarmStandby(ship)
+        standby.catch_up()
+        promoted, _tail = standby.promote()
+        ship_rec = PersistenceManager(ship, fsync="off")
+        assert canonical_dump(promoted) == canonical_dump(
+            ship_rec.recover().store)
+        ship_rec.close()
+        assert canonical_dump(promoted) == canonical_dump(rr.store)
+
+    def test_standby_waits_for_bootstrap_basis(self, tmp_path):
+        """A standby attached to a mid-life primary (no shipped
+        checkpoint yet, no segment zero) must replay NOTHING until a
+        checkpoint arrives — advancing cursors against an empty store
+        would permanently skip those frames."""
+        ship = str(tmp_path / "standby")
+        os.makedirs(ship)
+        # simulate a mid-life ship target: segment 3 tail only
+        frame = wal_mod.encode_frame(
+            {"t": "event", "verb": "update", "kind": "Workload",
+             "obj": {"namespace": "d", "name": "x",
+                     "resource_version": 1}})
+        with open(os.path.join(ship, "wal-00000003.log"), "wb") as f:
+            f.write(frame)
+        standby = WarmStandby(ship)
+        assert standby.catch_up() == 0
+        assert standby.records_applied == 0
+        assert not standby._cursor  # no cursor advanced pre-bootstrap
+
+    def test_standby_catch_up_and_promote(self, tmp_path):
+        d = str(tmp_path / "dur")
+        ship = str(tmp_path / "standby")
+        store = build_store([make_cq("a", 10_000)])
+        mgr = PersistenceManager(d, fsync="off", ship_to=ship,
+                                 incremental=True)
+        mgr.attach(store)
+        _churn(store, mgr, 0, 4)
+        mgr.checkpoint()
+        _churn(store, mgr, 4, 3)
+        standby = WarmStandby(ship)
+        first = standby.catch_up()
+        assert first > 0
+        _churn(store, mgr, 7, 2)  # the "unsynced tail"
+        promoted, tail = standby.promote()
+        assert canonical_dump(promoted) == canonical_dump(store)
+        assert 0 < tail < first + tail  # only the tail at promote
+        mgr.close()
+
+    def test_sigkill_failover_replays_only_tail(self, tmp_path):
+        """Real SIGKILL on a shipping primary: the promoted standby
+        must equal the dead primary's own durable recovery, having
+        replayed only what arrived after the last catch-up."""
+        d = str(tmp_path / "dur")
+        ship = str(tmp_path / "standby")
+        script = f"""
+import sys, os
+sys.path.insert(0, {REPO_ROOT!r}); sys.path.insert(0, {REPO_ROOT!r} + "/tests")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from test_streaming import build_store, make_cq, submit
+from kueue_oss_tpu.persist import PersistenceManager
+
+store = build_store([make_cq("a", 10_000)])
+mgr = PersistenceManager({d!r}, fsync="always", ship_to={ship!r},
+                         incremental=True,
+                         checkpoint_interval_records=40)
+mgr.attach(store)
+# the shipped checkpoint is the standby's bootstrap basis (the store
+# held pre-attach objects the WAL never saw)
+mgr.checkpoint()
+for i in range(10_000):
+    submit(store, f"w{{i}}", "a", float(i), 100 + i)
+    mgr.flush()
+    if i == 20:
+        print("WARM", flush=True)
+"""
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        try:
+            line = proc.stdout.readline()
+            assert "WARM" in line
+            standby = WarmStandby(ship)
+            deadline = time.monotonic() + 60
+            while (standby.catch_up() == 0
+                   and standby.records_applied == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            # let the primary run ahead, keep catching up
+            for _ in range(10):
+                time.sleep(0.02)
+                standby.catch_up()
+            caught_up_before = standby.records_applied
+            assert caught_up_before > 0
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        promoted, tail = standby.promote()
+        # byte-identity contract: the promoted store equals a
+        # from-scratch recovery of the SHIPPED log — the incremental
+        # cursor replay loses nothing and duplicates nothing
+        ship_rec = PersistenceManager(ship, fsync="off")
+        assert canonical_dump(promoted) == canonical_dump(
+            ship_rec.recover().store)
+        ship_rec.close()
+        # against the dead primary's own durable recovery, the only
+        # permissible gap is replication lag: records fsynced after
+        # the last shipping tick (here <= 1 — the primary shipped
+        # after every append)
+        rec = PersistenceManager(d, fsync="off")
+        rr = rec.recover()
+        rec.close()
+        assert set(promoted.workloads) <= set(rr.store.workloads)
+        lag = len(rr.store.workloads) - len(promoted.workloads)
+        assert lag <= 1
+        if lag == 0:
+            assert canonical_dump(promoted) == canonical_dump(rr.store)
+        assert standby.records_applied == caught_up_before + tail
+        assert tail < standby.records_applied  # tail-only at promote
+
+
+# ---------------------------------------------------------------------------
+# satellites: priority-class SLIs, regression detector, alert sinks
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityClassSLIs:
+    def test_slo_groups_by_class_name(self):
+        store = build_store([make_cq("a", 10_000)])
+        store.priority_classes["gold"] = WorkloadPriorityClass(
+            name="gold", value=9)
+        qm = QueueManager(store)
+        sched = Scheduler(store, qm)
+        store.add_workload(Workload(
+            name="w1", queue_name="lq-a", priority=9,
+            priority_class="gold", creation_time=1.0, uid=1,
+            podsets=[PodSet(count=1, requests={"cpu": 100})]))
+        store.add_workload(Workload(
+            name="w2", queue_name="lq-a", priority=9,
+            creation_time=2.0, uid=2,  # class resolved by value
+            podsets=[PodSet(count=1, requests={"cpu": 100})]))
+        store.add_workload(Workload(
+            name="w3", queue_name="lq-a", priority=3,
+            creation_time=3.0, uid=3,  # no class: raw integer key
+            podsets=[PodSet(count=1, requests={"cpu": 100})]))
+        sched.run_until_quiet(now=10.0)
+        report = obs.slo_engine.evaluate(now=10.0)
+        pkeys = {s["key"] for s in report["slis"]
+                 if s["scope"] == "priority"}
+        assert pkeys == {"gold", "3"}
+        # journal replay keeps the class grouping
+        events = obs.recorder.events()
+        obs.slo_engine.reset()
+        n = obs.slo_engine.replay_journal(events)
+        assert n == 3
+        report = obs.slo_engine.evaluate(now=10.0)
+        pkeys = {s["key"] for s in report["slis"]
+                 if s["scope"] == "priority"}
+        assert pkeys == {"gold", "3"}
+
+
+class TestPhaseRegression:
+    def test_detector_fires_on_sustained_spike(self):
+        det = obs.phase_regression
+        for _ in range(30):
+            det.feed("host", {"snapshot": 0.010})
+        assert det.regressing() == []
+        for _ in range(10):
+            det.feed("host", {"snapshot": 0.050})
+        reg = det.regressing()
+        assert reg and reg[0]["phase"] == "snapshot"
+        assert metrics.cycle_phase_regression.value(
+            "host", "snapshot") == 1.0
+        # the baseline re-adapts (no forever-alert): feed the new
+        # normal long enough and the ratio decays back under the bar
+        for _ in range(400):
+            det.feed("host", {"snapshot": 0.050})
+        assert det.regressing() == []
+
+    def test_ledger_rows_feed_detector(self):
+        for _ in range(25):
+            obs.cycle_ledger.record(1, obs.HOST_CYCLE,
+                                    phases={"entries": 0.001})
+        for _ in range(8):
+            obs.cycle_ledger.record(2, obs.HOST_CYCLE,
+                                    phases={"entries": 0.02})
+        assert any(r["phase"] == "entries"
+                   for r in obs.phase_regression.regressing())
+
+
+class TestAlertSinks:
+    def _fire(self, engine):
+        engine.threshold_s = 10.0
+        engine.burn_threshold = 0.5
+        for i in range(20):
+            engine.observe_admission("cq1", 100.0, now=1000.0 + i)
+        engine.evaluate(now=1020.0)
+
+    def test_callback_sink_fire_and_clear(self):
+        from kueue_oss_tpu.obs.health import SLOEngine
+
+        engine = SLOEngine(clock=lambda: 0.0)
+        got = []
+        engine.add_sink(lambda tr, payload: got.append((tr, payload)))
+        self._fire(engine)
+        assert got and got[0][0] == "fired"
+        assert got[0][1]["key"] == "cq1" or got[0][1]["scope"]
+        # recovery clears (fast window empties)
+        engine.evaluate(now=1020.0 + 3600.0)
+        assert got[-1][0] == "cleared"
+        assert metrics.slo_alert_deliveries_total.value("ok") >= 2
+
+    def test_failing_sink_counted_never_raises(self):
+        from kueue_oss_tpu.obs.health import SLOEngine
+
+        engine = SLOEngine(clock=lambda: 0.0)
+
+        def bad(_tr, _payload):
+            raise RuntimeError("sink down")
+
+        engine.add_sink(bad)
+        self._fire(engine)  # must not raise
+        assert metrics.slo_alert_deliveries_total.value("error") >= 1
+
+    def test_webhook_sink_local_http(self):
+        import http.server
+
+        from kueue_oss_tpu.obs.health import SLOEngine, WebhookSink
+
+        received = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                received.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            engine = SLOEngine(clock=lambda: 0.0)
+            engine.set_config_sink(WebhookSink(
+                f"http://127.0.0.1:{srv.server_port}/alerts"))
+            self._fire(engine)
+        finally:
+            srv.shutdown()
+            t.join(timeout=10)
+        assert received and received[0]["transition"] == "fired"
+        assert received[0]["key"] == "cq1"
+        assert metrics.slo_alert_deliveries_total.value("ok") >= 1
+
+
+class TestStreamingConfig:
+    def test_load_and_validate(self):
+        from kueue_oss_tpu import config as kconfig
+
+        cfg = kconfig.load({
+            "streaming": {"enabled": True, "maxBatch": 64,
+                          "maxCycleGap": 0.5},
+            "persistence": {"enabled": True, "dir": "/tmp/x",
+                            "incrementalCheckpoints": True,
+                            "fullCheckpointEvery": 4,
+                            "shipTo": "/tmp/standby"},
+            "observability": {"slo": {
+                "alertWebhookUrl": "http://127.0.0.1:1/hook"}},
+        })
+        assert cfg.streaming.enabled
+        assert cfg.streaming.max_batch == 64
+        assert cfg.streaming.max_cycle_gap_seconds == 0.5
+        assert cfg.persistence.incremental_checkpoints
+        assert cfg.persistence.full_checkpoint_every == 4
+        assert cfg.persistence.ship_to == "/tmp/standby"
+        assert cfg.observability.slo.alert_webhook_url
+        assert kconfig.validate(cfg) == []
+        cfg.streaming.max_batch = 0
+        cfg.persistence.full_checkpoint_every = 0
+        errs = kconfig.validate(cfg)
+        assert any("maxBatch" in e for e in errs)
+        assert any("fullCheckpointEvery" in e for e in errs)
+
+    def test_enabled_master_switch_is_honored(self):
+        from kueue_oss_tpu.config.configuration import StreamingConfig
+
+        store = build_store([make_cq("a", 10_000)])
+        qm = QueueManager(store)
+        # the default config has enabled=False: passing it must NOT
+        # turn streaming on (truthiness of the dataclass is not the
+        # switch)
+        off = Scheduler(store, qm, solver="auto",
+                        streaming=StreamingConfig())
+        assert off._streaming_admitter() is None
+        on = Scheduler(store, qm, solver="auto",
+                       streaming=StreamingConfig(enabled=True))
+        assert on._streaming_admitter() is not None
