@@ -923,6 +923,179 @@ def run_scenario(scenario: str) -> dict:
             **_degradation_counts(),
         }
 
+    if scenario == "podscale":
+        # Pod-scale solver (docs/SOLVER_PROTOCOL.md "Pod-scale
+        # sessions") on the virtual host mesh — no ICI, so the numbers
+        # bound correctness and steady-state wall, not TPU throughput.
+        # Three measurements: the workload-row-sharded FULL
+        # (preemption) drain p50 with a byte-identity twin against the
+        # single-chip kernel (uneven shard count forced), churned-
+        # session shard imbalance under the classic smallest-slot
+        # policy vs round-robin interleaving over the SAME trace, and
+        # the epoch-migration resync count (bounded: one per twin).
+        import numpy as np
+
+        from kueue_oss_tpu.api.types import (
+            ClusterQueue,
+            FlavorQuotas,
+            LocalQueue,
+            PodSet,
+            PreemptionPolicy,
+            ResourceFlavor,
+            ResourceGroup,
+            ResourceQuota,
+            Workload,
+        )
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.core.store import Store
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+        from kueue_oss_tpu.solver import meshutil
+        from kueue_oss_tpu.solver.delta import HostDeltaSession
+        from kueue_oss_tpu.solver.engine import SolverEngine
+        from kueue_oss_tpu.solver.full_kernels import (
+            solve_backlog_full,
+            to_device_full,
+        )
+        from kueue_oss_tpu.solver.sharded import solve_backlog_full_sharded
+        from kueue_oss_tpu.solver.tensors import export_problem
+
+        mesh = meshutil.detect_mesh()
+        n_dev = meshutil.mesh_devices(mesh)
+        if n_dev < 2:
+            return {"scenario": scenario, "skipped": True,
+                    "reason": "single device; no mesh to measure"}
+
+        # --- row-sharded FULL drain: p50 + byte-identity twin -------
+        store, queues, engine = _build(preemption=True, small=True)
+        if (len(store.workloads) + 1) % n_dev == 0:
+            # force the uneven path: W+1 % n_dev != 0 pads-and-unpads
+            proto = next(iter(store.workloads.values()))
+            store.add_workload(Workload(
+                name="uneven-extra", queue_name=proto.queue_name,
+                uid=10_000_000, creation_time=0.5,
+                podsets=[PodSet(name="main", count=1,
+                                requests=dict(
+                                    proto.podsets[0].requests))]))
+        pending = engine.pending_backlog()
+        problem = export_problem(store, pending, include_admitted=True)
+        g_max = int(problem.cq_ngroups.max())
+        h_max, p_max = engine._size_caps(problem)
+        log(f"[podscale] W={problem.n_workloads} C={problem.n_cqs} "
+            f"mesh={n_dev} g_max={g_max} h_max={h_max} p_max={p_max}")
+        reps = int(os.environ.get("BENCH_POD_REPS", "5"))
+        walls, sharded_out = [], None
+        for _ in range(reps + 1):  # rep 0 pays compilation
+            t0 = time.monotonic()
+            sharded_out = solve_backlog_full_sharded(
+                problem, mesh, g_max=g_max, h_max=h_max, p_max=p_max)
+            np.asarray(sharded_out[0])  # host-materialized window end
+            walls.append(time.monotonic() - t0)
+        single = solve_backlog_full(to_device_full(problem),
+                                    g_max=g_max, h_max=h_max,
+                                    p_max=p_max)
+        plans_identical = all(
+            np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            for a, b in zip(single, sharded_out))
+        full_ms = np.asarray(walls[1:]) * 1000
+
+        # --- churned-session imbalance: classic vs interleaved ------
+        # small quotas pin a standing PARKED backlog (admitted rows
+        # fold into usage and leave the export); churn admits the
+        # oldest parked rows as finishes free quota while new arrivals
+        # take the freed slots — the classic smallest-slot policy
+        # packs the backlog into the low block shards
+        def build_twin(classic: bool):
+            tstore = Store()
+            tstore.upsert_resource_flavor(ResourceFlavor(name="f"))
+            for i in range(4):
+                tstore.upsert_cluster_queue(ClusterQueue(
+                    name=f"cq{i}", preemption=PreemptionPolicy(),
+                    resource_groups=[ResourceGroup(
+                        covered_resources=["cpu"],
+                        flavors=[FlavorQuotas(name="f", resources=[
+                            ResourceQuota(name="cpu", nominal=4)])])]))
+                tstore.upsert_local_queue(LocalQueue(
+                    name=f"lq{i}", cluster_queue=f"cq{i}"))
+            tqueues = QueueManager(tstore)
+            tsched = Scheduler(tstore, tqueues)
+            teng = SolverEngine(tstore, tqueues, scheduler=tsched,
+                                mesh_mode="auto")
+            teng.mesh_min_workloads = 0
+            teng.mesh_force = True
+            teng.pad_to = 64  # pinned capacity: no shape-change syncs
+            if classic:
+                sess = HostDeltaSession(cache=teng.export_cache)
+                sess.set_interleave = lambda n: None
+                teng._delta_sessions["lean"] = sess
+            return teng, tstore, tsched
+
+        def churn_twin(teng, tstore, tsched):
+            uid = 0
+
+            def add(n):
+                nonlocal uid
+                for _ in range(n):
+                    tstore.add_workload(Workload(
+                        name=f"w{uid}", queue_name=f"lq{uid % 4}",
+                        uid=uid + 1, creation_time=float(uid),
+                        podsets=[PodSet(name="main", count=1,
+                                        requests={"cpu": 1})]))
+                    uid += 1
+
+            add(56)  # 16 admit (4 CQs x quota 4), 40 park
+            teng.drain(now=0.0)
+            for cyc in range(16):
+                admitted = sorted(
+                    (w.creation_time, k)
+                    for k, w in tstore.workloads.items()
+                    if w.is_quota_reserved and not w.is_finished)
+                for _, k in admitted[:2]:
+                    tsched.finish_workload(k, now=float(cyc))
+                add(2)
+                teng.drain(now=float(cyc + 1))
+            assert teng.last_drain_arm == "mesh", teng.last_drain_arm
+            sess = teng._delta_sessions["lean"]
+            wl_cqid = np.asarray(sess._last[0]["wl_cqid"])
+            return meshutil.shard_imbalance(wl_cqid, 4, mesh)
+
+        imb_interleaved = churn_twin(*build_twin(classic=False))
+        imb_classic = churn_twin(*build_twin(classic=True))
+
+        # epoch-migration cost: a live session whose interleave width
+        # changes without a capacity change (the production case — a
+        # sidecar advertises a mesh narrower than the local device
+        # count; local width changes re-align the pad and ride a
+        # shape-change sync instead) re-lays its slots out in exactly
+        # ONE counted full RESYNC, then returns to deltas
+        from kueue_oss_tpu.solver.tensors import pad_workloads
+
+        w1 = problem.wl_cqid.shape[0]
+        mprob = pad_workloads(problem, w1 - 1 + (-w1) % n_dev)
+        msess = HostDeltaSession()
+        msess.advance(mprob)  # first_sync seeds the session
+        msess.set_interleave(n_dev)
+        _, mframe = msess.advance(mprob)
+        migration_resyncs = int(
+            mframe.full_reason == "interleave_migration")
+        _, mframe2 = msess.advance(mprob)
+        migration_resyncs += int(mframe2.full_reason is not None)
+        session_migrations = msess.migrations
+
+        return {
+            "scenario": scenario,
+            "workloads": problem.n_workloads,
+            "mesh_devices": n_dev,
+            "uneven_shards": problem.wl_cqid.shape[0] % n_dev != 0,
+            "full_shard_drain_ms_p50": float(np.percentile(full_ms, 50)),
+            "full_shard_first_drain_seconds": round(walls[0], 3),
+            "plans_identical": plans_identical,
+            "shard_imbalance_classic": round(imb_classic, 4),
+            "shard_imbalance_interleaved": round(imb_interleaved, 4),
+            "session_migrations": session_migrations,
+            "migration_resyncs": migration_resyncs,
+            **_degradation_counts(),
+        }
+
     if scenario == "recorder":
         # flight-recorder overhead on the 50k x 1k host cycle-latency
         # shape: identical twin stores run the same N host cycles with
@@ -1884,6 +2057,19 @@ def main() -> None:
     except Exception as e:
         log(f"[multichip] did not complete: {e}")
         multichip = None
+    # pod-scale solver: row-sharded FULL drain + byte-identity twin,
+    # churned-session shard imbalance classic vs interleaved, and the
+    # epoch-migration resync count (docs/SOLVER_PROTOCOL.md "Pod-scale
+    # sessions"); virtual host mesh, same XLA partitioner, no ICI
+    try:
+        podscale = measure("podscale", extra_env={
+            "BENCH_CPU": "1",
+            "XLA_FLAGS": ("--xla_force_host_platform_device_count=8 "
+                          "--xla_cpu_parallel_codegen_split_count=1 "
+                          "--xla_cpu_max_isa=AVX")}, timeout=2400)
+    except Exception as e:
+        log(f"[podscale] did not complete: {e}")
+        podscale = None
     # batched what-if planning: S counterfactual scenarios in one
     # vmapped dispatch vs the sequential oracle (docs/SIMULATOR.md);
     # host backend — the measurement is batching leverage, not device
@@ -2067,6 +2253,22 @@ def main() -> None:
         extra["mesh_uneven_shards"] = multichip["uneven_shards"]
         extra["mesh_preempt_seconds"] = multichip["preempt_mesh_seconds"]
         extra["mesh_platform"] = "cpu_virtual_mesh"
+    if podscale is not None and not podscale.get("skipped"):
+        # pod-scale solver (docs/SOLVER_PROTOCOL.md "Pod-scale
+        # sessions"): the row-sharded FULL drain p50 + parity bit,
+        # churned-session imbalance before/after slot interleaving
+        # (acceptance: interleaved <= 1.1 while classic drifts), and
+        # the bounded epoch-migration resync count
+        extra["full_shard_drain_ms_p50"] = round(
+            podscale["full_shard_drain_ms_p50"], 2)
+        extra["full_shard_plans_identical"] = podscale["plans_identical"]
+        extra["full_shard_uneven"] = podscale["uneven_shards"]
+        extra["shard_imbalance_classic"] = podscale[
+            "shard_imbalance_classic"]
+        extra["shard_imbalance_interleaved"] = podscale[
+            "shard_imbalance_interleaved"]
+        extra["interleave_migration_resyncs"] = podscale[
+            "migration_resyncs"]
     if whatif is not None:
         # what-if engine acceptance: >1 vmapped-vs-sequential speedup,
         # plans bit-identical between the two paths

@@ -163,6 +163,18 @@ class SolverBackendConfig:
     #: back to auto. Routing between the mesh and single-chip arms
     #: stays adaptive (measured cost EMAs) even when a mesh exists.
     mesh: Optional[str] = None
+    #: multi-host (pod-scale) bootstrap (docs/SOLVER_PROTOCOL.md
+    #: "Pod-scale sessions"): jax.distributed coordinator address
+    #: ("host:port"). None = KUEUE_SOLVER_COORDINATOR env
+    #: ("host:port,num_processes,process_id"), falling back to
+    #: single-host. With a coordinator, detect_mesh builds the global
+    #: mesh over every process's devices.
+    coordinator_address: Optional[str] = None
+    #: total jax processes in the pod mesh (>= 2 engages multi-host;
+    #: every process must agree)
+    coordinator_processes: int = 1
+    #: this process's rank in [0, coordinator_processes)
+    coordinator_process_id: int = 0
     #: convex-relaxation fast-path arm (solver/relax.py,
     #: docs/SOLVER_PROTOCOL.md "Relaxed fast-path arm"): the fourth
     #: routing arm — projected-gradient LP + exact rounding-and-repair.
@@ -432,6 +444,14 @@ def validate(cfg: Configuration) -> list[str]:
         if m not in known and not m.isdigit():
             errs.append(f"solver.mesh {sv.mesh!r} must be 'auto', 'off', "
                         "or a non-negative device count")
+    if sv.coordinator_processes < 1:
+        errs.append("solver.coordinatorProcesses must be >= 1")
+    elif not (0 <= sv.coordinator_process_id < sv.coordinator_processes):
+        errs.append("solver.coordinatorProcessId must be in "
+                    "[0, coordinatorProcesses)")
+    if sv.coordinator_processes > 1 and not sv.coordinator_address:
+        errs.append("solver.coordinatorAddress is required when "
+                    "coordinatorProcesses > 1")
     if sv.relax_min_workloads < 0:
         errs.append("solver.relaxMinWorkloads must be >= 0")
     if sv.relax_audit_every < 0:
@@ -627,6 +647,9 @@ def load(data: Optional[dict] = None) -> Configuration:
             "breakerCooldown": ("breaker_cooldown_seconds", float),
             "sessionsEnabled": ("sessions_enabled", bool),
             "mesh": ("mesh", str),
+            "coordinatorAddress": ("coordinator_address", str),
+            "coordinatorProcesses": ("coordinator_processes", int),
+            "coordinatorProcessId": ("coordinator_process_id", int),
             "relaxEnabled": ("relax_enabled", bool),
             "relaxMinWorkloads": ("relax_min_workloads", int),
             "relaxAuditEvery": ("relax_audit_every", int),

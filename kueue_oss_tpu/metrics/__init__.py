@@ -575,6 +575,10 @@ solver_shard_imbalance = registry.register(Histogram(
     "Real-row imbalance across mesh shards per drain "
     "((max - min) / mean occupied rows; 0 = perfectly even)", (),
     buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0)))
+solver_multihost_processes = registry.register(Gauge(
+    "kueue_tpu_solver_multihost_processes",
+    "jax processes in the pod-scale solver bootstrap "
+    "(1 = single-host; set by service.serve_multihost)", ()))
 
 # -- convex-relaxation fast-path arm (solver/relax.py) -----------------------
 
@@ -606,6 +610,11 @@ stream_demotions_total = registry.register(Counter(
     "/ borrow_capable / out_of_order / unsupported) — each defers "
     "the subtree to the next full solve",
     ("reason",)))
+stream_spec_solves_total = registry.register(Counter(
+    "kueue_stream_spec_solves_total",
+    "Full solves pulled forward because a spec edit (quota/flavor "
+    "change, node flap) was observed mid-window by the streaming "
+    "fast path", ()))
 
 # -- decision flight recorder (obs/) -----------------------------------------
 
@@ -716,6 +725,14 @@ wal_compaction_dropped_total = registry.register(Counter(
     "kueue_wal_compaction_dropped_total",
     "Records dropped by per-key log compaction during sealed-segment "
     "shipping (superseded events + satisfied intents)", ()))
+wal_standby_rebootstraps_total = registry.register(Counter(
+    "kueue_wal_standby_rebootstraps_total",
+    "Warm-standby re-bootstraps from a newer shipped checkpoint that "
+    "superseded the replay frontier", ()))
+wal_standby_pruned_total = registry.register(Counter(
+    "kueue_wal_standby_pruned_total",
+    "Superseded shipped files (retired segments, out-of-chain "
+    "checkpoints) deleted by the warm standby's GC", ()))
 recovery_total = registry.register(Counter(
     "kueue_recovery_total",
     "Recoveries by source (checkpoint/wal_only/empty)", ("source",)))

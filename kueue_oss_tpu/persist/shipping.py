@@ -290,6 +290,14 @@ class WarmStandby:
         self._cursor: dict[int, int] = {}
         self.records_applied = 0
         self.last_catch_up_records = 0
+        #: standby-side GC of superseded shipped files (segments the
+        #: bootstrap anchor retired, checkpoints outside the newest
+        #: chain); on by default — the standby directory otherwise
+        #: grows without bound while the primary's own retention only
+        #: prunes the SOURCE directory
+        self.prune = True
+        self.rebootstraps = 0
+        self.pruned_files = 0
 
     def _bootstrap(self) -> None:
         """Load the newest shipped checkpoint chain (if any) once;
@@ -313,16 +321,115 @@ class WarmStandby:
             return  # mid-life attach: wait for the first checkpoint
         self._bootstrapped = True
 
+    def _replay_position(self) -> int:
+        """The segment catch-up would touch next: every present
+        segment before it is fully consumed. An absent or unreadable
+        segment IS the position — replay cannot get past it (in-order
+        stop), which is exactly what a superseding checkpoint heals."""
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return self._start_segment
+        segs = sorted(int(m.group(1)) for m in
+                      (_SEG.match(n) for n in names) if m)
+        pos = self._start_segment
+        for seg in segs:
+            if seg < pos:
+                continue
+            if seg > pos:
+                break  # shipped history has a hole: stuck before it
+            try:
+                size = os.path.getsize(
+                    os.path.join(self.dir, f"wal-{seg:08d}.log"))
+            except OSError:
+                break
+            if self._cursor.get(seg, 0) < size:
+                break  # unconsumed bytes: replay works here next
+            pos = seg + 1
+        return pos
+
+    def _maybe_rebootstrap(self) -> None:
+        """Auto-re-bootstrap: a newer shipped checkpoint whose anchor
+        segment is MORE THAN ONE segment ahead of the replay frontier
+        supersedes it — the chain already embodies every record the
+        standby would have replayed to get there, including segments
+        that never shipped or sit behind an unreadable one (catch_up's
+        in-order stop). Re-materializing from the chain is one bounded
+        rebuild instead of a long — or permanently wedged — segment
+        replay. Steady-state tailing never re-bootstraps: each
+        rotation's checkpoint anchors exactly one segment past the
+        frontier, and that boundary keeps the cheap replay path."""
+        chain = ckpt.newest_valid_chain(self.dir)
+        if chain is None:
+            return
+        new_start = int(chain[-1][0]["id"])
+        if new_start <= self._replay_position() + 1:
+            return
+        from kueue_oss_tpu.persist.manager import materialize_chain
+
+        self.store = materialize_chain(chain)
+        self._start_segment = new_start
+        self._cursor = {s: off for s, off in self._cursor.items()
+                        if s >= new_start}
+        self.rebootstraps += 1
+        metrics.wal_standby_rebootstraps_total.inc()
+
+    def _prune_superseded(self) -> None:
+        """Standby-side GC: shipped segments older than the bootstrap
+        anchor never replay again, and checkpoint files outside the
+        newest valid chain can never be materialized (the chain's base
+        is a full dump). Deleting them bounds the standby directory to
+        the live chain + replayable segments; nothing catch_up() could
+        still read is ever removed. ``.sealed`` markers of pruned
+        segments are KEPT — they are zero-byte, and a restarted
+        shipper sharing the directory uses them to know a sealed
+        segment completed (deleting one would trigger a pointless
+        re-ship of a segment this standby already retired)."""
+        if not self.prune or not self._bootstrapped:
+            return
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return
+        chain = ckpt.newest_valid_chain(self.dir)
+        keep_ckpts = (None if chain is None
+                      else {int(m["id"]) for m, _ in chain})
+        removed = 0
+        for n in names:
+            m = _SEG.match(n)
+            if m is not None:
+                if int(m.group(1)) < self._start_segment:
+                    removed += self._rm(n)
+                continue
+            c = _CKPT.match(n)
+            if (c is not None and keep_ckpts is not None
+                    and int(c.group(1)) not in keep_ckpts):
+                removed += self._rm(n)
+        if removed:
+            self.pruned_files += removed
+            metrics.wal_standby_pruned_total.inc(by=removed)
+            fsync_dir(self.dir)
+
+    def _rm(self, name: str) -> int:
+        try:
+            os.unlink(os.path.join(self.dir, name))
+            return 1
+        except OSError:
+            return 0
+
     def catch_up(self) -> int:
         """Apply every newly shipped complete frame; returns records
         applied this call. Before bootstrap succeeds (mid-life attach
         still waiting for its first shipped checkpoint) nothing
         replays — advancing segment cursors against an empty store
         would permanently skip those frames once the checkpoint
-        arrives."""
+        arrives. Each call also re-bootstraps from a superseding
+        shipped checkpoint (``_maybe_rebootstrap``) and prunes files
+        the bootstrap anchor retired (``_prune_superseded``)."""
         self._bootstrap()
         if not self._bootstrapped:
             return 0
+        self._maybe_rebootstrap()
         applied = 0
         try:
             names = os.listdir(self.dir)
@@ -354,6 +461,7 @@ class WarmStandby:
                 break
         self.records_applied += applied
         self.last_catch_up_records = applied
+        self._prune_superseded()
         return applied
 
     def promote(self) -> tuple[Store, int]:

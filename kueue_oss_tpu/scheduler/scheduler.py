@@ -726,6 +726,21 @@ class Scheduler:
                 if now_c - last_sweep >= requeue_period:
                     last_sweep = now_c
                     self.requeue_due(now_c)
+                # a spec edit (quota/flavor change) landing while the
+                # queues are idle must not sit fenced until the next
+                # arrival: drain() observes the spec-gen bump even
+                # with nothing pending, and the requested full solve
+                # runs NOW so capacity changes propagate immediately
+                sa = self._streaming_admitter()
+                if sa is not None:
+                    sa.drain(now_c)
+                    if sa.consume_full_solve_request():
+                        metrics.stream_spec_solves_total.inc()
+                        stats = self.schedule(now=clock())
+                        self._last_full_cycle_wall = clock()
+                        cycles += 1
+                        if stats.admitted or stats.preempted:
+                            idle_rounds = 0
                 continue
             # Streaming fast path (scheduler/streaming.py): between
             # full solves, in-order arrivals to uncontended CQs admit
@@ -740,7 +755,11 @@ class Scheduler:
                 now_c = clock()
                 micro = sa.drain(now_c)
                 micro_admitted = micro.admitted
-                if ((micro.admitted or micro.parked)
+                if sa.consume_full_solve_request():
+                    # spec edit observed mid-window: fall through to
+                    # the full cycle right now — never skip it
+                    metrics.stream_spec_solves_total.inc()
+                elif ((micro.admitted or micro.parked)
                         and not self.queues.has_pending()
                         and (now_c - self._last_full_cycle_wall
                              < self._streaming_max_gap())):

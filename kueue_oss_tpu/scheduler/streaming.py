@@ -144,6 +144,13 @@ class StreamingAdmitter:
         self._committing_thread: Optional[int] = None
         self._preemptor = Preemptor(enable_fair_sharing=False)
         self.micro_drains = 0
+        #: a spec edit (quota/flavor change, node flap) observed
+        #: mid-window doesn't just fence the window — it requests the
+        #: full solve be pulled FORWARD (the serve loop consumes this
+        #: and runs the heavy cycle now): the edit changed capacity
+        #: the parked/pending backlog may now fit (or no longer fit),
+        #: and waiting out the cadence would serve stale answers
+        self.full_solve_pending = False
         store.watch(self._on_event)
 
     # -- event classification (the safety fence) ---------------------------
@@ -310,10 +317,13 @@ class StreamingAdmitter:
         if self.engine.enable_fair_sharing:
             return result
         if self.engine.export_cache.spec_gen != self._armed_gen:
-            # quota edit / flavor change / node flap since arm: the
-            # whole window is fenced until the next full solve
+            # quota edit / flavor change / node flap since arm: fence
+            # the whole window AND request an immediate full solve —
+            # consume_full_solve_request() tells the serve loop to run
+            # the heavy cycle now rather than on its natural cadence
             with self._mu:
                 self.armed = False
+                self.full_solve_pending = True
             metrics.stream_demotions_total.inc("spec_change")
             return result
         t0 = time.perf_counter()
@@ -487,6 +497,15 @@ class StreamingAdmitter:
             admitted=result.admitted, parked=result.parked,
             solver_arm="stream",
             detail={"deferredCqs": result.deferred_cqs})
+
+    def consume_full_solve_request(self) -> bool:
+        """True at most once per spec-change fence: drain() observed a
+        spec edit mid-window and the caller (the serve loop) should
+        run the full cycle immediately instead of skipping it."""
+        with self._mu:
+            pending = self.full_solve_pending
+            self.full_solve_pending = False
+            return pending
 
     # -- introspection -----------------------------------------------------
 

@@ -419,8 +419,37 @@ class HostDeltaSession:
         self._event_mark = 0
         self.full_syncs = 0
         self.delta_syncs = 0
+        #: slot->shard interleave width (1 = the classic smallest-slot
+        #: policy). With a row-sharded mesh, smallest-slot packs every
+        #: churn-era arrival into the low shards while departures
+        #: hollow out the high ones — shard_imbalance drifts > 1 on
+        #: long-lived sessions. Interleaving assigns new slots round-
+        #: robin across the mesh's block shards instead.
+        self._interleave = 1
+        self._pending_interleave: Optional[int] = None
+        #: interleave-change RESYNCs actually taken (epoch migrations)
+        self.migrations = 0
+        self._rr_cursor = 0
 
     # -- slot assignment ---------------------------------------------------
+
+    def set_interleave(self, n_shards: int) -> None:
+        """Request slot->shard interleaving over ``n_shards`` block
+        shards. A width CHANGE is an epoch migration: the next advance
+        re-lays every slot out (one full RESYNC, full_reason
+        "interleave_migration", counted in ``migrations``) and resident
+        device tensors rebuild once. Width 1 restores the classic
+        smallest-slot policy byte-for-byte."""
+        n = max(1, int(n_shards))
+        if n != self._interleave:
+            self._pending_interleave = n
+
+    def _shard_of(self, slot: int) -> int:
+        # block sharding over the PADDED axis (capacity + null row),
+        # mirroring NamedSharding's layout; the null row rides the last
+        # shard
+        block = (self._capacity + 1) // self._interleave
+        return min(slot // max(1, block), self._interleave - 1)
 
     def _assign_slots(self, keys: list[str]) -> Optional[np.ndarray]:
         """dst[i] = slot for exported row i (or None on capacity reset)."""
@@ -428,6 +457,11 @@ class HostDeltaSession:
         for k in [k for k in self._slots if k not in present]:
             self._free.append(self._slots.pop(k))
         self._free.sort(reverse=True)  # pop() yields the smallest slot
+        n = self._interleave
+        if n > 1:
+            by_shard: list[list[int]] = [[] for _ in range(n)]
+            for s in self._free:  # descending, so pop() = smallest
+                by_shard[self._shard_of(s)].append(s)
         dst = np.full(len(keys), -1, dtype=np.int64)
         for i, k in enumerate(keys):
             if not k:
@@ -436,7 +470,20 @@ class HostDeltaSession:
             if s is None:
                 if not self._free:
                     return None  # capacity exhausted: reset + full sync
-                s = self._free.pop()
+                if n > 1:
+                    # round-robin shard choice; fall through occupied
+                    # shards so capacity, not balance, is the only
+                    # reset trigger
+                    s = None
+                    for d in range(n):
+                        bucket = by_shard[(self._rr_cursor + d) % n]
+                        if bucket:
+                            s = bucket.pop()
+                            break
+                    self._rr_cursor = (self._rr_cursor + 1) % n
+                    self._free.remove(s)
+                else:
+                    s = self._free.pop()
                 self._slots[k] = s
             dst[i] = s
         return dst
@@ -445,6 +492,34 @@ class HostDeltaSession:
         self._slots = {}
         self._free = []
         dst = np.full(len(keys), -1, dtype=np.int64)
+        n = self._interleave
+        if n > 1:
+            # striped re-layout: row i of the export lands in shard
+            # i % n, at that shard's next sequential slot
+            block = (len(keys) + 1) // n
+            bounds = [min((s + 1) * block, len(keys)) for s in range(n)]
+            cursor = [s * block for s in range(n)]
+            live = 0
+            for i, k in enumerate(keys):
+                if not k:
+                    continue
+                s = None
+                for d in range(n):
+                    sh = (live + d) % n
+                    if cursor[sh] < bounds[sh]:
+                        s = cursor[sh]
+                        cursor[sh] += 1
+                        break
+                live += 1
+                if s is None:
+                    continue  # > capacity: caller's pad guarantees room
+                self._slots[k] = s
+                dst[i] = s
+            taken = set(self._slots.values())
+            self._free = sorted(
+                (s for s in range(len(keys)) if s not in taken),
+                reverse=True)
+            return dst
         nxt = 0
         for i, k in enumerate(keys):
             if k:
@@ -463,9 +538,22 @@ class HostDeltaSession:
         keys = list(problem.wl_keys)
         if W != self._capacity:
             # padded capacity changed => compiled shapes changed anyway
+            # (a pending interleave change rides along for free)
             self._capacity = W
+            if self._pending_interleave is not None:
+                self._interleave = self._pending_interleave
+                self._pending_interleave = None
             dst = self._reset_slots(keys)
             full_reason = "shape_change" if self.epoch else "first_sync"
+        elif self._pending_interleave is not None:
+            # epoch migration: re-lay every slot out under the new
+            # interleave width; ONE full RESYNC, resident device
+            # tensors rebuild once on the other side
+            self._interleave = self._pending_interleave
+            self._pending_interleave = None
+            self.migrations += 1
+            dst = self._reset_slots(keys)
+            full_reason = "interleave_migration"
         else:
             dst = self._assign_slots(keys)
             if dst is None:
@@ -668,12 +756,13 @@ class DeviceResidentProblem:
     and the scatter itself reuses the resident buffer (XLA input/output
     aliasing) instead of materializing a second full padded copy.
 
-    With a ``mesh``, the lean problem's workload-axis tensors live
+    With a ``mesh``, BOTH kernels' workload-axis tensors live
     block-sharded over the mesh's ``wl`` axis (tree/CQ state
     replicated) whenever the padded axis divides evenly; donated
     scatters preserve the placement, so delta rows land directly on
-    their owning shard. The full kernel's tensors stay replicated (its
-    mesh parallelism shards victim-search lanes, not workload rows).
+    their owning shard. The full kernel additionally lane-shards its
+    victim searches inside the solve — row and lane sharding compose
+    (full_kernels._run_searches).
     """
 
     def __init__(self, mesh=None, axis: str = "wl") -> None:
@@ -770,11 +859,17 @@ class DeviceResidentProblem:
         else:
             self.mesh_placed = False
             t = jax.tree_util.tree_map(jnp.asarray, host)
-        if self.mesh is not None and not full:
-            from kueue_oss_tpu.solver.sharded import maybe_place_lean
+        if self.mesh is not None:
+            if full:
+                from kueue_oss_tpu.solver.sharded import maybe_place_full
 
-            t, self.mesh_placed = maybe_place_lean(
-                t, problem, self.mesh, self.mesh_min_rows, self.axis)
+                t, self.mesh_placed = maybe_place_full(
+                    t, problem, self.mesh, self.mesh_min_rows, self.axis)
+            else:
+                from kueue_oss_tpu.solver.sharded import maybe_place_lean
+
+                t, self.mesh_placed = maybe_place_lean(
+                    t, problem, self.mesh, self.mesh_min_rows, self.axis)
         self.full_uploads += 1
         self.full_upload_bytes += _tree_nbytes(t)
         return t

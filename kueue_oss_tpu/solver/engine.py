@@ -891,12 +891,12 @@ class SolverEngine:
                 self.last_drain_arm = "mesh"
                 metrics.solver_mesh_devices.set(
                     value=meshutil.mesh_devices(mesh))
-                if not full:
-                    # row-shard skew exists only on the lean drain; the
-                    # full kernel shards lanes with replicated rows
-                    metrics.solver_shard_imbalance.observe(
-                        value=meshutil.shard_imbalance(
-                            problem.wl_cqid, problem.n_cqs, mesh))
+                # both drains row-shard the workload axis now (the
+                # full kernel composes lane sharding on top), so both
+                # observe block-shard skew
+                metrics.solver_shard_imbalance.observe(
+                    value=meshutil.shard_imbalance(
+                        problem.wl_cqid, problem.n_cqs, mesh))
                 return out
         try:
             if self.solve_fault_hook is not None:
@@ -988,7 +988,21 @@ class SolverEngine:
             sess = HostDeltaSession(cache=self.export_cache,
                                     neutral_fields=neutral)
             self._delta_sessions[kind] = sess
-        return sess.advance(problem)
+        # slot->shard interleaving follows whichever mesh the resident
+        # tensors will shard over: the remote sidecar's advertised
+        # width when a sidecar serves the drains, the local mesh
+        # otherwise. A width change is an epoch migration — ONE counted
+        # RESYNC re-lays the slots out and rebuilds resident tensors.
+        from kueue_oss_tpu.solver.meshutil import mesh_devices
+
+        remote_w = (int(getattr(self.remote, "remote_mesh_devices", 0))
+                    if self.remote is not None else 0)
+        sess.set_interleave(remote_w if remote_w > 1
+                            else mesh_devices(self._mesh()))
+        slotted, frame = sess.advance(problem)
+        if frame is not None and frame.full_reason == "interleave_migration":
+            metrics.solver_resync_total.inc("interleave_migration")
+        return slotted, frame
 
     def _local_tensors(self, problem: SolverProblem, frame, *,
                        full: bool, mesh=None):
@@ -1008,12 +1022,21 @@ class SolverEngine:
                 t = to_device_full(problem)
             else:
                 t = to_device(problem)
-            if mesh is not None and not full:
-                from kueue_oss_tpu.solver.sharded import maybe_place_lean
-
+            if mesh is not None:
                 # same placement policy as the resident path; routing
                 # already cleared the live-row floor for this drain
-                t, _placed = maybe_place_lean(t, problem, mesh)
+                if full:
+                    from kueue_oss_tpu.solver.sharded import (
+                        maybe_place_full,
+                    )
+
+                    t, _placed = maybe_place_full(t, problem, mesh)
+                else:
+                    from kueue_oss_tpu.solver.sharded import (
+                        maybe_place_lean,
+                    )
+
+                    t, _placed = maybe_place_lean(t, problem, mesh)
             return t
         kind = "full" if full else "lean"
         if mesh is not None:

@@ -133,6 +133,18 @@ class FullTensors(NamedTuple):
     admit_rank_base: jnp.ndarray  # scalar int32
 
 
+#: FullTensors fields carried on the [W+1] workload axis — the set the
+#: pod-scale row sharding block-distributes over the mesh ``wl`` axis
+#: (sharded.full_shardings); everything else (cohort tree, CQ policy,
+#: flavor metadata) replicates. Scatter/gather ops against these fields
+#: cross shards under GSPMD; the victim-search lane shard_map
+#: (_run_searches) composes with — it re-gathers the rows it scans.
+FULL_WL_FIELDS = ("wl_cqid", "wl_prio", "wl_ts0", "wl_uid", "wl_req",
+                  "wl_valid", "wl_parked0", "wl_admitted0",
+                  "wl_evicted0", "wl_admit_rank0", "ad_usage",
+                  "wl_class", "wl_lq", "wl_ts_buf", "wl_afs_penalty")
+
+
 def host_tensors_full(p: SolverProblem) -> FullTensors:
     """The full kernel's input tensors as HOST (numpy) arrays — see
     kernels.host_tensors for why this is split from the upload."""

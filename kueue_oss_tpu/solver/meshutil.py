@@ -27,6 +27,128 @@ from typing import Optional
 
 MESH_AXIS = "wl"
 
+#: KUEUE_SOLVER_COORDINATOR grammar: "host:port,num_processes,process_id"
+COORDINATOR_ENV = "KUEUE_SOLVER_COORDINATOR"
+
+#: one-shot jax.distributed bootstrap state (process-wide, like
+#: jax.distributed itself); tests reset it between subprocess twins by
+#: running each twin in its own interpreter
+_distributed = {"initialized": False, "processes": 1, "process_id": 0}
+
+
+def parse_coordinator(spec: Optional[str]
+                      ) -> Optional[tuple[str, int, int]]:
+    """Parse a ``host:port,num_processes,process_id`` coordinator spec
+    (the KUEUE_SOLVER_COORDINATOR grammar). Returns None for
+    absent/empty, and FAILS CLOSED (None + no multi-host init) on any
+    malformed value — a typo must degrade to single-host, never
+    half-initialize a distributed runtime."""
+    if not spec:
+        return None
+    parts = [p.strip() for p in str(spec).split(",")]
+    if len(parts) != 3 or not parts[0]:
+        return None
+    try:
+        n, pid = int(parts[1]), int(parts[2])
+    except ValueError:
+        return None
+    if n < 2 or not (0 <= pid < n):
+        return None
+    return parts[0], n, pid
+
+
+def bootstrap_distributed(coordinator_address: Optional[str] = None,
+                          num_processes: Optional[int] = None,
+                          process_id: Optional[int] = None) -> int:
+    """Idempotent multi-host bootstrap: ``jax.distributed.initialize``
+    driven by explicit args (SolverBackendConfig.coordinator_*) or the
+    ``KUEUE_SOLVER_COORDINATOR`` env ("host:port,num_processes,pid").
+
+    Returns the process count (1 = single-host, nothing initialized).
+    After a successful bootstrap ``jax.devices()`` is GLOBAL, so
+    :func:`detect_mesh` builds the pod-wide mesh with no further
+    changes. On the CPU backend the gloo collectives implementation is
+    selected first — the default CPU collectives cannot execute
+    cross-process computations at all — and each process should run ONE
+    local device: gloo's TCP pairs carry untagged ordered frames, so
+    concurrent per-device execution threads issuing collectives inside
+    one SPMD program interleave on the pair and abort with a preamble
+    size mismatch (real pods run one process per host regardless).
+    """
+    if _distributed["initialized"]:
+        return _distributed["processes"]
+    if coordinator_address is None:
+        import os
+
+        parsed = parse_coordinator(os.environ.get(COORDINATOR_ENV))
+        if parsed is None:
+            return 1
+        coordinator_address, num_processes, process_id = parsed
+    if not num_processes or num_processes < 2:
+        return 1
+    import jax
+
+    if "cpu" in str(getattr(jax.config, "jax_platforms", None) or "cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            pass  # non-CPU build or option renamed: backend default
+        try:
+            # belt and suspenders for the gloo frame-interleaving
+            # hazard above: synchronous dispatch keeps two PROGRAMS
+            # from being in flight at once (the one-device-per-process
+            # deployment shape handles the within-program case)
+            jax.config.update("jax_cpu_enable_async_dispatch", False)
+        except Exception:
+            pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes), process_id=int(process_id))
+    _distributed.update(initialized=True,
+                        processes=int(num_processes),
+                        process_id=int(process_id))
+    return int(num_processes)
+
+
+def process_count() -> int:
+    """jax process count AFTER any bootstrap (1 = single-host)."""
+    if not _distributed["initialized"]:
+        return 1
+    import jax
+
+    return int(jax.process_count())
+
+
+def process_index() -> int:
+    if not _distributed["initialized"]:
+        return 0
+    import jax
+
+    return int(jax.process_index())
+
+
+def host_replicated(arrays) -> tuple:
+    """Materialize global (possibly cross-process sharded) solver
+    outputs as full host numpy arrays on EVERY process. Collective —
+    all processes of the mesh must call it in the same order. Identity
+    (plain np.asarray) on single-process runs."""
+    import numpy as np
+
+    if process_count() < 2:
+        return tuple(np.asarray(a) for a in arrays)
+    from jax.experimental import multihost_utils as mhu
+
+    out = []
+    for a in arrays:
+        if (getattr(a, "ndim", 1) == 0
+                or getattr(a, "is_fully_replicated", False)):
+            # replicated values are addressable everywhere already
+            out.append(np.asarray(a))
+        else:
+            out.append(np.asarray(mhu.process_allgather(a, tiled=True)))
+    return tuple(out)
+
 
 def shard_map(f, mesh, in_specs, out_specs):
     """Version-portable shard_map.
@@ -163,8 +285,8 @@ def shard_imbalance(wl_cqid, n_cqs: int, mesh) -> float:
         return 0.0
     occ = np.asarray(wl_cqid) < n_cqs
     if occ.shape[0] % n != 0:
-        # defense in depth: callers only observe row-sharded (lean)
-        # drains, whose padded axis always divides; a non-divisible
+        # defense in depth: callers observe row-sharded drains (lean
+        # and full), whose padded axis always divides; a non-divisible
         # axis has no block shards to skew
         return 0.0
     per = occ.reshape(n, -1).sum(axis=1).astype(np.float64)

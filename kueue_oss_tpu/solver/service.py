@@ -186,9 +186,11 @@ def deserialize_problem(meta: dict, blob: bytes) -> SolverProblem:
 
 def _solve_kernel(tensors, header: dict, mesh=None):
     """Run the jitted kernel matching the request params; returns
-    (out tuple, legacy array names). With a ``mesh`` the full kernel
-    shards its victim-search lanes and the lean kernel runs the
-    sharded SPMD drain (bit-identical plans either way)."""
+    (out tuple, legacy array names). With a ``mesh`` BOTH kernels
+    block-shard the workload axis over it — the full kernel
+    additionally shard_maps its victim-search lanes inside the solve
+    (row and lane sharding compose) — and plans stay bit-identical to
+    the single-chip kernels either way."""
     if header["full"]:
         from kueue_oss_tpu.solver.full_kernels import solve_backlog_full
 
@@ -293,18 +295,16 @@ def _resync(reason: str) -> tuple[dict, bytes]:
     return {"ok": False, "resync": reason}, b""
 
 
-def _solve_mesh(server, sess, full: bool, n_live: int):
-    """The mesh this solve should run on, or None. Lean solves follow
-    the session's resident placement; full solves lane-shard over the
-    server mesh when the LIVE row count clears the floor (the tensors
-    stay replicated)."""
-    if not full:
-        return sess.device.mesh if sess.device.mesh_placed else None
-    if server is None or getattr(server, "mesh", None) is None:
-        return None
-    if n_live < getattr(server, "mesh_min_workloads", 0):
-        return None
-    return server.mesh
+def _solve_mesh(sess):
+    """The mesh this solve should run on, or None. BOTH kernels follow
+    the session's resident placement: DeviceResidentProblem row-shards
+    the workload axis for lean AND full tensors (the full kernel then
+    composes its victim-search lane shard_map on top) when the padded
+    axis divides the mesh and the live-row floor clears. A session
+    whose tensors stayed replicated solves single-chip — routing a
+    replicated resident problem through the mesh solver would silently
+    re-place it every drain."""
+    return sess.device.mesh if sess.device.mesh_placed else None
 
 
 def _solve_resilient(server, sess, tensors, header: dict,
@@ -321,20 +321,18 @@ def _solve_resilient(server, sess, tensors, header: dict,
     """
     from kueue_oss_tpu.solver import meshutil
 
-    mesh = _solve_mesh(server, sess, bool(header["full"]),
-                       meshutil.live_rows(problem.wl_cqid,
-                                          problem.n_cqs))
+    mesh = _solve_mesh(sess)
     if mesh is not None:
         try:
             out = _solve_kernel(tensors, header, mesh)[0]
             metrics.solver_mesh_devices.set(
                 value=meshutil.mesh_devices(mesh))
-            if not header["full"]:
-                # row-shard skew exists only on the lean (row-sharded)
-                # drain; full drains lane-shard with replicated rows
-                metrics.solver_shard_imbalance.observe(
-                    value=meshutil.shard_imbalance(
-                        problem.wl_cqid, problem.n_cqs, mesh))
+            # both drains row-shard the workload axis now, so both
+            # observe the block-shard skew the interleaved session
+            # layout is meant to flatten
+            metrics.solver_shard_imbalance.observe(
+                value=meshutil.shard_imbalance(
+                    problem.wl_cqid, problem.n_cqs, mesh))
             return out
         except Exception:
             metrics.solver_fallback_total.inc("mesh_error")
@@ -348,6 +346,130 @@ def _solve_resilient(server, sess, tensors, header: dict,
     out = _solve_kernel(tensors, header, None)[0]
     metrics.solver_mesh_devices.set(value=0)
     return out
+
+
+# -- pod-scale (multi-host) sidecar mode -------------------------------------
+#
+# docs/SOLVER_PROTOCOL.md "Pod-scale sessions": after a jax.distributed
+# bootstrap (KUEUE_SOLVER_COORDINATOR / SolverBackendConfig
+# coordinator_* fields) the detected mesh spans EVERY process's
+# devices, and SPMD solves over it are collective — each process must
+# enter the same jitted computation in the same order. The wire
+# protocol therefore cannot run independently per host: process 0 (the
+# coordinator) owns the unix socket and re-broadcasts each stateless
+# request to the followers, which sit in follower_solve_loop() and
+# join every solve. Delta-sync sessions are per-process resident state
+# and are NOT supported in this mode — a session frame answers an
+# in-band error (run pod-scale clients with sessions_enabled=false).
+
+
+def _bcast_bytes(payload: Optional[bytes]) -> bytes:
+    """One coordinator->follower broadcast of a byte blob. Process 0
+    passes the payload; followers pass None and receive it. Two
+    collectives — the int64 length, then the body — because
+    broadcast_one_to_all needs shape agreement on every process.
+
+    The body travels as int32 WORDS (zero-padded to a word boundary,
+    the length collective carries the exact byte count): the XLA:CPU
+    gloo all-reduce widens sub-32-bit integers on the wire, so a uint8
+    body lands int32-strided in the receiver's uint8 buffer — each
+    payload byte followed by three zeros, truncated at n.
+    """
+    from jax.experimental import multihost_utils as mhu
+
+    if payload is None:
+        n = int(mhu.broadcast_one_to_all(np.zeros((), np.int64)))
+        body = mhu.broadcast_one_to_all(np.zeros((n + 3) // 4, np.int32))
+        return np.asarray(body).tobytes()[:n]
+    mhu.broadcast_one_to_all(np.int64(len(payload)))
+    padded = payload + b"\x00" * (-len(payload) % 4)
+    mhu.broadcast_one_to_all(np.frombuffer(padded, np.int32))
+    return payload
+
+
+def _multihost_solve(header: dict, blob: bytes, mesh):
+    """The collective body every process of the pod mesh runs for one
+    stateless request: deserialize the (identically broadcast)
+    problem, pad + row-shard it over the global mesh, solve, and
+    materialize the plan host-side everywhere (host_replicated inside
+    the sharded entry points). Returns (out tuple, array names)."""
+    problem = deserialize_problem(header["meta"], blob)
+    if header["full"]:
+        from kueue_oss_tpu.solver.sharded import solve_backlog_full_sharded
+
+        out = solve_backlog_full_sharded(
+            problem, mesh, header["g_max"], header["h_max"],
+            header["p_max"], fs_enabled=header["fs_enabled"])
+        names = ["admitted", "opt", "admit_round", "parked",
+                 "rounds", "usage", "wl_usage", "victim_reason"]
+    else:
+        from kueue_oss_tpu.solver.sharded import solve_backlog_sharded
+
+        out = solve_backlog_sharded(problem, mesh)
+        names = ["admitted", "opt", "admit_round", "parked",
+                 "rounds", "usage"]
+    return out, names
+
+
+def follower_solve_loop(mesh_mode: Optional[str] = None) -> int:
+    """Body for every non-coordinator process of a pod-scale sidecar:
+    block on the coordinator's broadcast, join each collective solve,
+    repeat until the shutdown op arrives. Returns the number of solves
+    served (tests assert on it). Call AFTER
+    meshutil.bootstrap_distributed — serve_multihost() wires both.
+
+    A solve that raises does so DETERMINISTICALLY on every process of
+    the pod (same program, same broadcast inputs), so the coordinator
+    reports it in-band to its client while each follower swallows its
+    own copy and stays in the loop — the broadcast order never skews.
+    """
+    from kueue_oss_tpu.solver.meshutil import detect_mesh
+
+    mesh = detect_mesh(mesh_mode)
+    if mesh is None:
+        raise RuntimeError(
+            "follower_solve_loop needs a mesh; a pod-scale sidecar "
+            "without one cannot join collective solves")
+    served = 0
+    while True:
+        header = json.loads(_bcast_bytes(None).decode("utf-8"))
+        if header.get("op") == "shutdown":
+            return served
+        blob = _bcast_bytes(None)
+        try:
+            _multihost_solve(header, blob, mesh)
+        except Exception:
+            pass  # the coordinator's copy reports in-band
+        served += 1
+
+
+def serve_multihost(socket_path: str,
+                    coordinator_address: Optional[str] = None,
+                    num_processes: Optional[int] = None,
+                    process_id: Optional[int] = None,
+                    mesh_mode: Optional[str] = None,
+                    **server_kwargs):
+    """Pod-scale sidecar entry point.
+
+    Bootstraps jax.distributed from the explicit coordinator args
+    (SolverBackendConfig.coordinator_*) or KUEUE_SOLVER_COORDINATOR,
+    then splits by rank: process 0 returns a ready ``SolverServer``
+    whose stateless solves are re-broadcast to the pod (run
+    serve_forever / serve_in_background on it; server_close() releases
+    the followers); every other process enters follower_solve_loop and
+    returns its served-solve count once the coordinator shuts down.
+    """
+    from kueue_oss_tpu.solver import meshutil
+
+    n = meshutil.bootstrap_distributed(coordinator_address,
+                                       num_processes, process_id)
+    metrics.solver_multihost_processes.set(value=n)
+    if meshutil.process_index() != 0:
+        return follower_solve_loop(mesh_mode)
+    server = SolverServer(socket_path, mesh_mode=mesh_mode,
+                          **server_kwargs)
+    server.multihost = n > 1
+    return server
 
 
 def _session_request(header: dict, blob: bytes,
@@ -443,10 +565,28 @@ def solve_request(header: dict, blob: bytes,
     """
     kind = header.get("kind", "solve")
     if kind in ("sync", "delta"):
+        if server is not None and getattr(server, "multihost", False):
+            # sessions are per-process resident state; the pod-scale
+            # coordinator serves stateless solves only (run the client
+            # with sessions_enabled=false against this sidecar)
+            return {"ok": False, "error": "delta-sync sessions are "
+                    "unsupported in multihost mode"}, b""
         if kind == "delta" and server is None:
             return _resync("session_unsupported")
         return _session_request(header, blob, server)
     t0 = time.perf_counter()
+    if (server is not None and getattr(server, "multihost", False)
+            and getattr(server, "mesh", None) is not None):
+        # collective pod solve: replay the request to the followers,
+        # then join the same SPMD computation they run
+        with server._multihost_lock:
+            _bcast_bytes(json.dumps(header).encode("utf-8"))
+            _bcast_bytes(blob)
+            out, names = _multihost_solve(header, blob, server.mesh)
+        buf = io.BytesIO()
+        np.savez(buf, **{n: np.asarray(v) for n, v in zip(names, out)})
+        return {"ok": True, "names": names,
+                "spans": _spans(header, t0)}, buf.getvalue()
     problem = deserialize_problem(header["meta"], blob)
     if header["full"]:
         from kueue_oss_tpu.solver.full_kernels import to_device_full
@@ -534,6 +674,14 @@ class SolverServer(socketserver.ThreadingUnixStreamServer):
         #: problems narrower than this solve single-chip even with a
         #: mesh (the mesh is the large-backlog path)
         self.mesh_min_workloads = int(mesh_min_workloads)
+        #: pod-scale coordinator mode (serve_multihost sets it): every
+        #: stateless solve is re-broadcast to the follower processes
+        #: and solved collectively over the global mesh; session
+        #: frames answer an in-band error. The lock serializes the
+        #: broadcast+solve pair — handler threads must not interleave
+        #: collectives or the followers would decode skewed frames.
+        self.multihost = False
+        self._multihost_lock = threading.Lock()
 
     def session(self, sid: str) -> _SidecarSession:
         with self._sessions_lock:
@@ -561,6 +709,16 @@ class SolverServer(socketserver.ThreadingUnixStreamServer):
         t = threading.Thread(target=self.serve_forever, daemon=True)
         t.start()
         return t
+
+    def server_close(self) -> None:
+        if self.multihost:
+            self.multihost = False
+            try:
+                with self._multihost_lock:
+                    _bcast_bytes(json.dumps({"op": "shutdown"}).encode())
+            except Exception:
+                pass  # followers already gone; don't wedge shutdown
+        super().server_close()
 
 
 class _ClientSession:

@@ -338,31 +338,105 @@ def make_sharded_relax_lp(mesh: Mesh, iters: int, axis: str = "wl"):
     return jax.jit(run)
 
 
+def full_shardings(mesh: Mesh, axis: str = "wl") -> dict:
+    """field -> NamedSharding for mesh-placing FULL problem tensors:
+    the [W+1] workload-axis fields (full_kernels.FULL_WL_FIELDS)
+    block-shard; tree/CQ/flavor state replicates."""
+    from kueue_oss_tpu.solver.full_kernels import (
+        FULL_WL_FIELDS,
+        FullTensors,
+    )
+
+    row = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    return {f: (row if f in FULL_WL_FIELDS else rep)
+            for f in FullTensors._fields}
+
+
+def place_full_tensors(t, mesh: Mesh, axis: str = "wl"):
+    """Mesh-place FULL tensors: workload rows block-sharded over the
+    ``wl`` axis (cross-shard victim gathers/psums are inserted by the
+    partitioner), everything else replicated. Requires an evenly
+    divisible padded axis (meshutil.align_pad_target /
+    tensors.pad_workloads)."""
+    n_dev = mesh.shape[axis]
+    W1 = t.wl_cqid.shape[0]
+    if W1 % n_dev != 0:
+        raise ValueError(
+            f"workload axis of {W1} rows does not shard over {n_dev} "
+            "devices; pad with meshutil.align_pad_target first")
+    sh = full_shardings(mesh, axis)
+    return t._replace(**{
+        f: jax.device_put(getattr(t, f), sh[f])
+        for f in type(t)._fields})
+
+
+def maybe_place_full(t, problem: SolverProblem, mesh,
+                     min_rows: int = 0, axis: str = "wl"):
+    """Mesh-place FULL tensors when the policy allows — the same
+    gate as maybe_place_lean (mesh present, divisible padded axis,
+    live rows clear the floor), shared by the resident device state
+    and the engine's sessionless full path. Returns (tensors,
+    placed)."""
+    from kueue_oss_tpu.solver.meshutil import live_rows, mesh_divisible
+
+    if (mesh is None
+            or not mesh_divisible(mesh, problem.wl_cqid.shape[0])
+            or live_rows(problem.wl_cqid, problem.n_cqs) < min_rows):
+        return t, False
+    return place_full_tensors(t, mesh, axis), True
+
+
 def solve_backlog_full_sharded(problem: SolverProblem, mesh: Mesh,
                                g_max: int, h_max: int = 32,
                                p_max: int = 128, fs_enabled: bool = False,
                                axis: str = "wl", round_cap: int = 0):
-    """Multi-chip PREEMPTION-capable drain.
+    """Multi-chip PREEMPTION-capable drain, row- AND lane-sharded.
 
-    Scaling model (complementary to the fit-only workload-axis shard
-    below): the full kernel's per-round cost is dominated by the
-    victim searches — h_max x K independent candidate scans over the
-    whole workload axis — so those LANES shard across the mesh
-    (full_kernels._run_searches) while the cohort-tree state stays
-    replicated. Per-round ICI volume is the gathered lane results
-    (lanes x p_max victim slots); admission/eviction bookkeeping is
-    identical on every device. Results match the single-chip
-    solve_backlog_full bit-for-bit.
+    Scaling model: the workload axis block-shards over the mesh with
+    NamedSharding (same placement as the lean drain — backlogs of
+    10^5-10^7 rows are the growing dimension), and the partitioner
+    inserts the cross-shard victim-candidate gathers/psums the round's
+    bookkeeping needs. The victim searches — the round's dominant cost
+    — additionally shard their LANE axis inside
+    full_kernels._run_searches; lane sharding composes with row
+    sharding (the search re-gathers the rows it scans), it does not
+    replace it. Under a multi-host mesh
+    (meshutil.bootstrap_distributed) the same program spans every
+    process's devices.
+
+    Padding inserts inert null-row replicas BEFORE the final null row
+    (tensors.pad_workloads), so W_null keeps pointing at the real null
+    row and every dump scatter lands exactly where the single-chip
+    kernel puts it: results match solve_backlog_full bit-for-bit,
+    including uneven caller row counts (W+1 not divisible by the
+    mesh).
     """
     from kueue_oss_tpu.solver.full_kernels import (
         make_full_solver,
         to_device_full,
     )
+    from kueue_oss_tpu.solver.meshutil import host_replicated
+    from kueue_oss_tpu.solver.tensors import pad_workloads as _pad_rows
 
-    t = to_device_full(problem)
+    n_dev = mesh.shape[axis]
+    W1 = problem.wl_cqid.shape[0]
+    target_w = W1 - 1 + ((-W1) % n_dev)
+    padded = _pad_rows(problem, target_w)
+    t = place_full_tensors(to_device_full(padded), mesh, axis)
     solver = make_full_solver(g_max, h_max, p_max, fs_enabled,
                               round_cap=round_cap, mesh=mesh, axis=axis)
-    return solver(t)
+    out = host_replicated(solver(t))
+    if target_w + 1 == W1:
+        return out
+
+    def unpad(a):
+        # real rows kept their indices; the null row moved to the end
+        return np.concatenate([a[: W1 - 1], a[-1:]])
+
+    admitted, opt, admit_round, parked, rounds, usage, wl_usage, vr = out
+    return (unpad(admitted), unpad(opt), unpad(admit_round),
+            unpad(parked), rounds, usage, unpad(wl_usage), unpad(vr))
 
 
 def solve_backlog_sharded(problem: SolverProblem, mesh: Mesh,
@@ -375,12 +449,16 @@ def solve_backlog_sharded(problem: SolverProblem, mesh: Mesh,
     back to the caller's row count.
     """
     from kueue_oss_tpu.solver.kernels import to_device
-    from kueue_oss_tpu.solver.meshutil import lean_mesh_solver
+    from kueue_oss_tpu.solver.meshutil import (host_replicated,
+                                               lean_mesh_solver)
 
     n_dev = mesh.shape[axis]
     padded = pad_workloads(problem, n_dev)
     t = place_lean_tensors(to_device(padded), mesh, axis)
-    admitted, opt, admit_round, parked, rounds, usage = (
+    # host_replicated is the identity on single-process runs; on a
+    # multi-host (pod) mesh it allgathers the cross-process shards so
+    # every process slices the same full plan below
+    admitted, opt, admit_round, parked, rounds, usage = host_replicated(
         lean_mesh_solver(mesh, axis)(t))
     W1 = problem.wl_cqid.shape[0]
     admitted = np.asarray(admitted)[:W1].copy()

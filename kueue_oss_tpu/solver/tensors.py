@@ -211,6 +211,15 @@ def pad_workloads(problem: SolverProblem, target_w: int) -> SolverProblem:
     Power-of-two bucketing keeps the jitted kernels' shape cache small
     when drains run repeatedly over a changing backlog (the Simulator
     drains after every event batch).
+
+    Layout contract: inert rows are inserted BEFORE the null row, so
+    the null row is ALWAYS the last row of the padded axis. The
+    row-sharded kernels (solver/sharded.py) depend on this — they pad
+    an uneven axis to a mesh multiple and unpad the plan by
+    re-concatenating ``[:W1-1]`` with the final row; kernels address
+    the null row as ``[-1]``. Inserting padding anywhere else would
+    shift dump scatters off the rows the single-chip kernel writes and
+    break bit-identical parity.
     """
     W = problem.n_workloads
     if target_w <= W:

@@ -63,6 +63,12 @@ def pytest_configure(config):
         "identity, and the SIGKILL log-shipping failover harness; "
         "deterministic, runs in tier-1")
     config.addinivalue_line(
+        "markers", "multihost: pod-scale solver tests that boot a real "
+        "2-process jax.distributed mesh (gloo CPU collectives) via "
+        "subprocess twins and prove the workload-row-sharded kernels "
+        "return byte-identical plans to the single-process run; "
+        "deterministic, runs in tier-1")
+    config.addinivalue_line(
         "markers", "slo: cluster health layer tests (obs/ledger.py + "
         "obs/health.py): virtual-clock burn-rate sequences, starvation "
         "watchdog, exemplar round-trips, ledger joins, and the "

@@ -640,6 +640,49 @@ def test_full_device_loss_degrades_round_to_host_cycles():
     assert injector.injected == {"mesh_lost": 1, "single_lost": 1}
 
 
+def test_full_arm_mesh_fault_falls_back_within_drain():
+    """The FULL (preemption) kernel's mesh arm rides the same
+    mesh -> single-chip chain as the lean arm: a device loss mid-drain
+    re-runs the SAME preemption-heavy drain on the single-chip kernel
+    (counted, never silent), and the committed store state still
+    matches the host scheduler exactly. A healthy twin engine proves
+    the row-sharded full drain is actually what the router selects on
+    the virtual mesh before the fault lands."""
+    from test_engine_full_drain import _setup, _state
+
+    from kueue_oss_tpu.chaos import MeshFaultInjector
+
+    # host-only reference
+    store_h, _queues_h, sched_h = _setup(0)
+    assert sched_h.run_until_quiet(now=200.0, max_cycles=300,
+                                   tick=1.0) < 300
+
+    # healthy twin: the preemption-heavy drain routes to the mesh arm
+    store_m, queues_m, _ = _setup(0)
+    engine_m = SolverEngine(store_m, queues_m)
+    engine_m.mesh_min_workloads = 0
+    engine_m.mesh_force = True
+    engine_m.drain(now=200.0)
+    assert engine_m.last_drain_arm == "mesh"
+    assert _state(store_m) == _state(store_h)
+
+    # faulted twin: mesh device loss -> same drain completes single-chip
+    store_f, queues_f, _ = _setup(0)
+    engine_f = SolverEngine(store_f, queues_f)
+    engine_f.mesh_min_workloads = 0
+    engine_f.mesh_force = True
+    injector = MeshFaultInjector(engine_f)
+    before = metrics.solver_fallback_total.collect().get(
+        ("mesh_error",), 0)
+    injector.lose_mesh(1)
+    engine_f.drain(now=200.0)
+    assert engine_f.last_drain_arm == "single"
+    assert injector.injected.get("mesh_lost") == 1
+    assert metrics.solver_fallback_total.collect().get(
+        ("mesh_error",), 0) == before + 1
+    assert _state(store_f) == _state(store_h)
+
+
 def test_mesh_shrink_repads_and_keeps_plans_bit_identical():
     from kueue_oss_tpu.chaos import MeshFaultInjector
 

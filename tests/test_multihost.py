@@ -1,0 +1,318 @@
+"""Pod-scale solver: multi-host mesh bootstrap and cross-process row
+sharding (docs/SOLVER_PROTOCOL.md "Pod-scale sessions").
+
+Two layers under test:
+
+1. REAL 2-process ``jax.distributed`` runs (marker: multihost) —
+   subprocess twins bootstrap over a loopback coordinator with gloo CPU
+   collectives via the ``KUEUE_SOLVER_COORDINATOR`` env grammar, build
+   the global mesh, and prove the workload-row-sharded FULL drain
+   returns a plan BYTE-identical to the in-process single-chip kernel.
+   A second twin drives the whole sidecar stack: ``serve_multihost``
+   coordinator + wire client on rank 0, ``follower_solve_loop`` on
+   rank 1, shutdown broadcast on close.
+2. single-process regressions that ride along: uneven shard counts
+   (W+1 % n_dev != 0 pads via tensors.pad_workloads) and the
+   churned-session shard-imbalance bound under slot interleaving.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS_DIR = os.path.join(REPO_ROOT, "tests")
+
+#: W+1 = 17 for this scenario: not divisible by 2, 3, 5, or 8, so
+#: every mesh width below exercises the pad-and-unpad path too
+SEED = 3
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _twin_env(port: int, rank: int) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # ONE local device per process -> a 2-wide global mesh. gloo's
+        # TCP pairs carry untagged ordered frames, so two per-process
+        # device threads issuing collectives concurrently inside one
+        # SPMD program interleave on the pair and abort with a preamble
+        # size mismatch; a single device per process keeps exactly one
+        # execution thread on the pair (real pods have one process per
+        # host anyway). The stability flags mirror tests/conftest.py.
+        "XLA_FLAGS": ("--xla_force_host_platform_device_count=1"
+                      " --xla_cpu_parallel_codegen_split_count=1"
+                      " --xla_cpu_max_isa=AVX"),
+        "PYTHONHASHSEED": "0",
+        # the env-driven bootstrap path (meshutil.parse_coordinator)
+        "KUEUE_SOLVER_COORDINATOR": f"127.0.0.1:{port},2,{rank}",
+        "PYTHONPATH": REPO_ROOT + os.pathsep + TESTS_DIR,
+    })
+    env.pop("KUEUE_SOLVER_MESH", None)
+    return env
+
+
+def _run_twins(script: str, outdir: str, timeout: float = 540.0,
+               extra: tuple = ()) -> list:
+    """Launch the same body as 2 jax.distributed processes; returns
+    their stdouts, asserting both exited 0."""
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", script, str(rank), outdir,
+         *[str(a) for a in extra]],
+        env=_twin_env(port, rank), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for rank in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    return outs
+
+
+def _single_chip_reference(seed: int):
+    """The (host-parity-tested) single-chip FULL plan for a scenario."""
+    from test_sharded_full import export_from_seed
+
+    from kueue_oss_tpu.solver.full_kernels import (
+        solve_backlog_full,
+        to_device_full,
+    )
+
+    problem = export_from_seed(seed)
+    g_max = int(problem.cq_ngroups.max())
+    out = solve_backlog_full(to_device_full(problem), g_max=g_max,
+                             h_max=8, p_max=32)
+    return problem, tuple(np.asarray(a) for a in out)
+
+
+def _assert_bytes_identical(single, pod):
+    assert len(single) == len(pod)
+    for i, (ref, got) in enumerate(zip(single, pod)):
+        ref, got = np.asarray(ref), np.asarray(got)
+        assert ref.dtype == got.dtype, i
+        assert ref.shape == got.shape, i
+        assert ref.tobytes() == got.tobytes(), i
+
+
+# ---------------------------------------------------------------------------
+# real 2-process jax.distributed twins
+# ---------------------------------------------------------------------------
+
+_TWIN_KERNEL = """
+import os, sys
+rank, outdir, seed = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
+from kueue_oss_tpu.solver import meshutil
+n = meshutil.bootstrap_distributed()  # KUEUE_SOLVER_COORDINATOR env
+assert n == 2, n
+import jax
+assert jax.process_count() == 2
+assert len(jax.devices()) == 2, jax.devices()
+import numpy as np
+mesh = meshutil.detect_mesh("auto")  # pod-wide: both processes' devices
+assert mesh is not None and meshutil.mesh_devices(mesh) == 2
+from test_sharded_full import export_from_seed
+from kueue_oss_tpu.solver.sharded import solve_backlog_full_sharded
+problem = export_from_seed(seed)
+g_max = int(problem.cq_ngroups.max())
+out = solve_backlog_full_sharded(problem, mesh, g_max=g_max, h_max=8,
+                                 p_max=32)
+if rank == 0:
+    np.savez(os.path.join(outdir, "plan.npz"),
+             **{f"a{i}": np.asarray(v) for i, v in enumerate(out)})
+print("TWIN-KERNEL-OK", flush=True)
+"""
+
+
+@pytest.mark.multihost
+def test_two_process_sharded_full_plan_byte_identical(tmp_path):
+    """2-process bootstrap (gloo CPU collectives) + global mesh: the
+    row-sharded FULL drain spanning both processes' devices returns the
+    byte-identical plan of the single-process single-chip kernel —
+    with an UNEVEN row count (W+1 = 17 over 2 shards)."""
+    problem, single = _single_chip_reference(SEED)
+    assert problem.wl_cqid.shape[0] % 2 != 0  # pads cross-process too
+    outs = _run_twins(_TWIN_KERNEL, str(tmp_path), extra=(SEED,))
+    assert all("TWIN-KERNEL-OK" in o for o in outs), outs
+    with np.load(str(tmp_path / "plan.npz")) as z:
+        pod = [z[f"a{i}"] for i in range(len(z.files))]
+    _assert_bytes_identical(single, pod)
+
+
+_TWIN_SIDECAR = """
+import os, sys
+rank, outdir, seed = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
+import numpy as np
+from kueue_oss_tpu.solver import service
+sock = os.path.join(outdir, "solver.sock")
+got = service.serve_multihost(sock, mesh_mode="auto")
+if rank != 0:
+    # ran until the coordinator's shutdown broadcast
+    print(f"FOLLOWER-SERVED {got}", flush=True)
+    sys.exit(0 if got == 1 else 3)
+server = got
+assert server.multihost and server.mesh is not None
+server.serve_in_background()
+from test_sharded_full import export_from_seed
+problem = export_from_seed(seed)
+g_max = int(problem.cq_ngroups.max())
+client = service.SolverClient(sock, sessions=False, timeout_s=600.0)
+out = client.solve(problem, full=True, g_max=g_max, h_max=8, p_max=32)
+np.savez(os.path.join(outdir, "wire_plan.npz"),
+         **{f"a{i}": np.asarray(v) for i, v in enumerate(out)})
+server.shutdown()
+server.server_close()  # broadcasts the follower shutdown op
+print("COORDINATOR-OK", flush=True)
+"""
+
+
+@pytest.mark.multihost
+def test_two_process_sidecar_serves_collective_solves(tmp_path):
+    """The pod-scale sidecar end to end: rank 0 owns the unix-socket
+    wire protocol (serve_multihost -> SolverServer), re-broadcasts the
+    stateless request, and both ranks join one collective SPMD solve;
+    the plan on the wire is byte-identical to the single-chip kernel
+    and the follower's served count is exact."""
+    _, single = _single_chip_reference(SEED)
+    outs = _run_twins(_TWIN_SIDECAR, str(tmp_path), extra=(SEED,))
+    assert "COORDINATOR-OK" in outs[0], outs[0]
+    assert "FOLLOWER-SERVED 1" in outs[1], outs[1]
+    with np.load(str(tmp_path / "wire_plan.npz")) as z:
+        pod = [z[f"a{i}"] for i in range(len(z.files))]
+    _assert_bytes_identical(single, pod)
+
+
+# ---------------------------------------------------------------------------
+# single-process regressions riding along
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_dev", [3, 5])
+def test_uneven_shard_counts_stay_bit_identical(n_dev, eight_devices):
+    """W+1 % n_dev != 0: pad_workloads inserts inert rows BEFORE the
+    null row, so dump scatters land where the single-chip kernel puts
+    them and the unpadded plan matches bit-for-bit."""
+    from jax.sharding import Mesh
+
+    from kueue_oss_tpu.solver.sharded import solve_backlog_full_sharded
+
+    problem, single = _single_chip_reference(SEED)
+    assert problem.wl_cqid.shape[0] % n_dev != 0
+    mesh = Mesh(np.array(eight_devices[:n_dev]), ("wl",))
+    sharded = solve_backlog_full_sharded(
+        problem, mesh, g_max=int(problem.cq_ngroups.max()),
+        h_max=8, p_max=32)
+    _assert_bytes_identical(single, sharded)
+
+
+def test_churned_session_interleave_keeps_shards_balanced(eight_devices):
+    """Long-lived churned sessions: a standing parked backlog whose
+    oldest entries keep getting admitted (quota freed by finishing
+    workloads) while new arrivals join. The classic smallest-slot
+    policy recycles the freed LOW slots for every arrival, packing the
+    backlog into the low block shards (shard_imbalance drifts to ~3);
+    round-robin slot interleaving must hold it ~flat (acceptance
+    bound: <= 1.1) over the same churn trace."""
+    from jax.sharding import Mesh
+
+    from kueue_oss_tpu.core.queue_manager import QueueManager
+    from kueue_oss_tpu.scheduler.scheduler import Scheduler
+    from kueue_oss_tpu.solver import meshutil
+    from kueue_oss_tpu.solver.delta import HostDeltaSession
+    from kueue_oss_tpu.solver.engine import SolverEngine
+    from test_solver_delta import _store, _wl
+
+    mesh = Mesh(np.array(eight_devices[:8]), ("wl",))
+
+    def build(classic: bool):
+        store = _store(quota=4, preemption=False)
+        queues = QueueManager(store)
+        sched = Scheduler(store, queues)
+        engine = SolverEngine(store, queues, scheduler=sched,
+                              mesh_mode="auto")
+        engine.mesh_min_workloads = 0
+        engine.mesh_force = True
+        engine.pad_to = 64  # capacity pinned: no shape-change resets
+        if classic:
+            # pin the control twin to the classic smallest-slot policy
+            # while everything else (mesh arm, padding) stays identical
+            sess = HostDeltaSession(cache=engine.export_cache)
+            sess.set_interleave = lambda n: None
+            engine._delta_sessions["lean"] = sess
+        return engine, store, sched
+
+    def churn(engine, store, sched):
+        uid = 0
+        for _ in range(56):  # 16 admit (4 CQs x quota 4), 40 park
+            store.add_workload(_wl(uid))
+            uid += 1
+        engine.drain(now=0.0)
+        for cyc in range(16):
+            admitted = sorted(
+                (w.creation_time, k)
+                for k, w in store.workloads.items()
+                if w.is_quota_reserved and not w.is_finished)
+            for _, k in admitted[:2]:
+                sched.finish_workload(k, now=float(cyc))
+            for _ in range(2):
+                store.add_workload(_wl(uid))
+                uid += 1
+            engine.drain(now=float(cyc + 1))
+        assert engine.last_drain_arm == "mesh"
+        sess = engine._delta_sessions["lean"]
+        wl_cqid = np.asarray(sess._last[0]["wl_cqid"])
+        assert int((wl_cqid < 4).sum()) > 16  # a standing backlog
+        return sess, meshutil.shard_imbalance(wl_cqid, 4, mesh)
+
+    sess_i, imb_interleaved = churn(*build(classic=False))
+    sess_c, imb_classic = churn(*build(classic=True))
+    assert sess_i._interleave == 8
+    assert sess_c._interleave == 1
+    assert imb_interleaved <= 1.1, imb_interleaved
+    assert imb_classic > 1.1, imb_classic  # the drift being regressed
+    assert imb_classic > imb_interleaved
+
+
+def test_interleave_width_change_is_one_counted_migration():
+    """set_interleave on a live session: exactly ONE epoch-migration
+    RESYNC (full_reason "interleave_migration", counted in
+    ``migrations``) re-lays the slots out striped; later drains go back
+    to deltas and never migrate again."""
+    from kueue_oss_tpu.solver.delta import HostDeltaSession
+    from kueue_oss_tpu.solver.tensors import pad_workloads
+
+    from test_sharded_full import export_from_seed
+
+    problem = pad_workloads(export_from_seed(SEED), 31)  # axis 32
+    sess = HostDeltaSession()
+    _, frame = sess.advance(problem)
+    assert frame.full_reason == "first_sync"
+    assert sess.migrations == 0
+    sess.set_interleave(8)
+    _, frame = sess.advance(problem)
+    assert frame.full_reason == "interleave_migration"
+    assert sess.migrations == 1
+    # striped layout: live slots spread over the 8 block shards
+    shards = {sess._shard_of(s) for s in sess._slots.values()}
+    assert len(shards) > 1
+    sess.set_interleave(8)  # same width: no pending change
+    _, frame = sess.advance(problem)
+    assert frame.full_reason is None and frame.delta is not None
+    assert sess.migrations == 1

@@ -1,10 +1,16 @@
-"""Multi-chip PREEMPTION drain parity: the lane-sharded full kernel on
-the virtual 8-device mesh must produce bit-identical results to the
+"""Multi-chip PREEMPTION drain parity: the sharded full kernel on the
+virtual 8-device mesh must produce bit-identical results to the
 single-chip solve_backlog_full (which is itself host-parity-tested over
 the randomized preemption scenarios).
 
-Scaling model under test: victim-search lanes shard across the mesh
-(solver/sharded.py solve_backlog_full_sharded), tree state replicated.
+Scaling model under test: workload rows block-shard across the mesh
+(pad_workloads grows the axis to a multiple of the mesh width) and the
+victim-search lanes shard WITH the rows — the lane sharding composes
+with, not replaces, the row sharding (solver/sharded.py
+solve_backlog_full_sharded); tree state stays replicated. The same
+entry point spans multi-host meshes; tests/test_multihost.py proves
+the 2-process case byte-identical over a real jax.distributed
+bootstrap.
 """
 
 import random

@@ -559,11 +559,19 @@ def test_mesh_single_host_churn_plans_bit_identical(eight_devices):
                 store.add_workload(_wl(uid[0] + j, prio=(cyc + j) % 3))
             results.append(engine.drain(now=float(cyc)))
         uid[0] += 2
-        # single-chip resident vs mesh-resident: BIT-IDENTICAL plan
-        # application — same keys in the same order, same victims (the
-        # two arms drain the byte-identical session encoding)
-        assert results[1].admitted_keys == results[2].admitted_keys, cyc
-        assert results[1].evicted_keys == results[2].evicted_keys, cyc
+        # single-chip resident vs mesh-resident: the same PLAN (sets,
+        # victims). The two engines' sessions no longer share one slot
+        # layout — the mesh engine interleaves slots across block
+        # shards (HostDeltaSession.set_interleave) while the mesh-off
+        # twin keeps the classic smallest-slot packing — so key ORDER
+        # within an admit round may legally differ between the twins.
+        # Cross-ARM bit-identity still holds inside one engine: both
+        # its arms drain the byte-identical session encoding
+        # (test_sharded_full.py proves kernel-level bit-identity).
+        assert (sorted(results[1].admitted_keys)
+                == sorted(results[2].admitted_keys)), cyc
+        assert (sorted(results[1].evicted_keys)
+                == sorted(results[2].evicted_keys)), cyc
         # vs the sessionless fresh-sync path the PLAN (sets, victims)
         # matches; within one admit round the apply tie-break is slot
         # order vs export order, so key order may legally differ there

@@ -350,6 +350,52 @@ class TestContentionFences:
         eng.drain(now=101.0, verify=True)
         assert store.workloads["default/w1"].is_admitted
 
+    def test_spec_change_requests_immediate_full_solve(self):
+        store = build_store([make_cq("a", 1_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        sa = sched._streaming_admitter()
+        assert sa.armed
+        store.upsert_cluster_queue(make_cq("a", 10_000))  # quota raise
+        res = sched.micro_drain(100.5)
+        # the edit doesn't just fence the window: drain() flags a
+        # pull-forward, the serve loop consumes it (exactly once) and
+        # runs the full cycle NOW instead of on its natural cadence
+        assert res.admitted == 0 and not sa.armed
+        assert sa.consume_full_solve_request()
+        assert not sa.consume_full_solve_request()  # one-shot
+
+    def test_serve_pulls_full_solve_forward_on_spec_edit(self):
+        import threading
+        import time as _time
+
+        store = build_store([make_cq("a", 1_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        # parked mid-window: does not fit at the current quota
+        submit(store, "big", "a", 1.0, 1, cpu=5_000)
+        assert sched.micro_drain(100.5).parked == 1
+        before = metrics.stream_spec_solves_total.total()
+        stop = threading.Event()
+        t = threading.Thread(target=sched.serve, args=(stop,),
+                             kwargs={"poll": 0.01}, daemon=True)
+        t.start()
+        try:
+            # quota raise: the CQ event requeues the parked entry, the
+            # serve loop wakes, drain() observes the fence, and the
+            # requested full solve runs immediately — "big" admits
+            # without waiting for another arrival or cadence tick
+            store.upsert_cluster_queue(make_cq("a", 10_000))
+            deadline = _time.monotonic() + 10.0
+            while (not store.workloads["default/big"].is_quota_reserved
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.01)
+        finally:
+            stop.set()
+            t.join(timeout=10.0)
+        assert store.workloads["default/big"].is_quota_reserved
+        assert metrics.stream_spec_solves_total.total() >= before + 1
+
     def test_out_of_order_arrival_demotes(self):
         store = build_store([make_cq("a", 10_000)])
         _qm, sched, eng = _make_sched(store, streaming=True)
@@ -586,6 +632,77 @@ class TestLogShipping:
         promoted, tail = standby.promote()
         assert canonical_dump(promoted) == canonical_dump(store)
         assert 0 < tail < first + tail  # only the tail at promote
+        mgr.close()
+
+    def test_standby_rebootstraps_from_superseding_checkpoint(
+            self, tmp_path):
+        """A standby whose replay frontier fell more than one segment
+        behind the newest shipped checkpoint re-materializes from the
+        chain (one bounded rebuild) instead of replaying the whole
+        backlog — and its GC then prunes the retired segments and
+        out-of-chain checkpoints from the standby directory."""
+        d = str(tmp_path / "dur")
+        ship = str(tmp_path / "standby")
+        store = build_store([make_cq("a", 10_000)])
+        mgr = PersistenceManager(d, fsync="off", ship_to=ship)
+        mgr.attach(store)
+        _churn(store, mgr, 0, 4)
+        mgr.checkpoint()  # id 1, anchors segment 1
+        _churn(store, mgr, 4, 3)
+        standby = WarmStandby(ship)
+        assert standby.catch_up() > 0
+        assert standby.rebootstraps == 0
+        # fully tailed: next replay work is the yet-unshipped segment 2
+        assert standby._replay_position() == 2
+        # the primary runs ahead two rotations while the standby naps
+        # (appending to segment 1 pulls the frontier back there)
+        _churn(store, mgr, 7, 3)
+        mgr.checkpoint()  # id 2
+        _churn(store, mgr, 10, 3)
+        mgr.checkpoint()  # id 3, anchors segment 3 — frontier 1 + 1 < 3
+        standby.catch_up()
+        assert standby.rebootstraps == 1
+        assert standby._start_segment == 3
+        promoted, _tail = standby.promote()
+        assert canonical_dump(promoted) == canonical_dump(store)
+        # standby-side pruning: retired segments and superseded (full)
+        # checkpoints are gone; .sealed markers stay for the shipper
+        names = set(os.listdir(ship))
+        assert standby.pruned_files > 0
+        for seg in (0, 1, 2):
+            assert f"wal-{seg:08d}.log" not in names
+        assert "checkpoint-00000001.ckpt" not in names
+        assert "checkpoint-00000002.ckpt" not in names
+        assert "checkpoint-00000003.ckpt" in names
+        assert "wal-00000000.log.sealed" in names
+        # the pruned directory still recovers to the identical store
+        ship_rec = PersistenceManager(ship, fsync="off")
+        assert canonical_dump(ship_rec.recover().store) == \
+            canonical_dump(store)
+        ship_rec.close()
+        mgr.close()
+
+    def test_standby_steady_state_tailing_never_rebootstraps(
+            self, tmp_path):
+        """Rotation anchors each checkpoint exactly one segment past a
+        tailing standby's frontier — that boundary must keep the cheap
+        incremental replay path, not trigger a rebuild."""
+        d = str(tmp_path / "dur")
+        ship = str(tmp_path / "standby")
+        store = build_store([make_cq("a", 10_000)])
+        mgr = PersistenceManager(d, fsync="off", ship_to=ship)
+        mgr.attach(store)
+        _churn(store, mgr, 0, 3)
+        mgr.checkpoint()
+        standby = WarmStandby(ship)
+        standby.catch_up()
+        for k in range(3):
+            _churn(store, mgr, 3 + 3 * k, 3)
+            mgr.checkpoint()
+            standby.catch_up()  # tails every rotation promptly
+        assert standby.rebootstraps == 0
+        promoted, _tail = standby.promote()
+        assert canonical_dump(promoted) == canonical_dump(store)
         mgr.close()
 
     def test_sigkill_failover_replays_only_tail(self, tmp_path):
