@@ -302,6 +302,12 @@ class StreamingConfig:
     #: while micro-drains absorb every arrival (SLO windows roll,
     #: requeue backoffs expire, metrics flush)
     max_cycle_gap_seconds: float = 1.0
+    #: drive micro-drains from the store watch stream (a dedicated
+    #: drain worker signaled per arrival) instead of the serve loop's
+    #: poll tick — keeps sub-cycle latency event-bound through the
+    #: loop's SlowDown backoff; bursts coalesce into one drain
+    #: (stream_demotions_total{reason="watch_coalesced"})
+    watch_driven: bool = True
 
 
 @dataclass
@@ -682,6 +688,7 @@ def load(data: Optional[dict] = None) -> Configuration:
             "enabled": ("enabled", None),
             "maxBatch": ("max_batch", int),
             "maxCycleGap": ("max_cycle_gap_seconds", float),
+            "watchDriven": ("watch_driven", None),
         })
 
     def conv_slo(d: dict) -> SLOConfig:

@@ -607,9 +607,21 @@ stream_admitted_total = registry.register(Counter(
 stream_demotions_total = registry.register(Counter(
     "kueue_stream_demotions_total",
     "Fast-path demotions by fence reason (cohort_event / spec_change "
-    "/ borrow_capable / out_of_order / unsupported) — each defers "
-    "the subtree to the next full solve",
+    "/ borrow_capable / out_of_order / unsupported / "
+    "flavor_witness_invalid = a capacity event could flip the "
+    "full-solve flavor pick / headroom_exhausted = the admission "
+    "needed borrowed capacity or overran the reserved nominal-"
+    "headroom budget / watch_coalesced = arrival signals absorbed "
+    "into an already-running watch-driven micro-drain under burst "
+    "backpressure, not a fence) — fence reasons defer the subtree "
+    "to the next full solve",
     ("reason",)))
+stream_eligible_fraction = registry.register(Gauge(
+    "kueue_stream_eligible_fraction",
+    "Fraction of pending ClusterQueues the last micro-drain walked "
+    "on the streaming fast path (1 - deferred/considered; the "
+    "coverage the wide fences buy over the structural PR-11 fences)",
+    ()))
 stream_spec_solves_total = registry.register(Counter(
     "kueue_stream_spec_solves_total",
     "Full solves pulled forward because a spec edit (quota/flavor "

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import functools
 import heapq
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -149,6 +150,10 @@ class Scheduler:
         #: in durable state from batched ones.
         self.streaming = streaming
         self._streaming_instance = None
+        #: serializes cycle bodies and micro-drains across the serve
+        #: loop and the watch-driven drain worker (reentrant: the
+        #: serve loop's cycle body calls micro_drain itself)
+        self._cycle_mu = threading.RLock()
         #: wall of the most recent full schedule() cycle; the serve
         #: loop refuses to skip host cycles longer than the streaming
         #: config's max_cycle_gap (SLO windows must roll, requeue
@@ -490,6 +495,46 @@ class Scheduler:
             return 1.0
         return getattr(cfg, "max_cycle_gap_seconds", 1.0)
 
+    def _streaming_watch_driven(self) -> bool:
+        """Whether serve() runs the watch-driven drain worker (on by
+        default with streaming): arrivals signal the worker straight
+        from the store watch stream, so micro-drain latency stays
+        event-bound even while the serve loop sleeps on its SlowDown
+        backoff or poll tick."""
+        if not self._streaming_on():
+            return False
+        cfg = self.streaming
+        if cfg is True:
+            return True
+        return getattr(cfg, "watch_driven", True)
+
+    def _watch_drain_loop(self, sa, wake, stop, clock) -> None:
+        """Watch-driven drain worker: blocks on the arrival signal
+        (set by the admitter's store-watch classifier), coalesces
+        whatever burst accumulated while a drain ran, and drains
+        under the cycle lock. Full-solve requests are deferred to the
+        serve loop — the worker only ever runs micro-drains."""
+        while not stop.is_set():
+            if not wake.wait(timeout=0.2):
+                continue
+            wake.clear()
+            if stop.is_set():
+                return
+            n = sa.take_arrival_signals()
+            if n <= 0:
+                continue
+            if n > 1:
+                # burst backpressure: n arrival signals collapsed
+                # into this one drain
+                metrics.stream_demotions_total.inc(
+                    "watch_coalesced", by=float(n - 1))
+            with self._cycle_mu:
+                sa.drain(clock())
+            if sa.full_solve_pending:
+                # spec edit observed mid-window: the HEAVY cycle is
+                # the serve loop's job — nudge its condition wait
+                self.queues.wakeup()
+
     def micro_drain(self, now: Optional[float] = None):
         """One streaming micro-batch: admit in-order arrivals for
         every uncontended fast-path CQ sub-cycle (between full
@@ -498,7 +543,8 @@ class Scheduler:
         sa = self._streaming_admitter()
         if sa is None:
             return None
-        return sa.drain(now if now is not None else self.clock())
+        with self._cycle_mu:
+            return sa.drain(now if now is not None else self.clock())
 
     def _solver_drain(self, now: Optional[float]) -> bool:
         """Drain the backlog on-device when the solver supports it.
@@ -709,6 +755,32 @@ class Scheduler:
         clock = clock or _time.monotonic
         backoff = backoff or Backoff(initial=0.002, cap=max(poll, 0.002),
                                      factor=2.0)
+        # Watch-driven micro-drains: arrivals signal a dedicated
+        # drain worker straight from the store watch stream, so the
+        # sub-cycle path stays event-bound even while this loop
+        # sleeps (poll timeout, SlowDown backoff). The worker and
+        # this loop serialize through _cycle_mu.
+        sa_watch = (self._streaming_admitter()
+                    if self._streaming_watch_driven() else None)
+        watch_wake = None
+        watch_thread = None
+        if sa_watch is not None:
+            watch_wake = threading.Event()
+            sa_watch.set_arrival_notifier(watch_wake.set)
+            watch_thread = threading.Thread(
+                target=self._watch_drain_loop,
+                args=(sa_watch, watch_wake, stop, clock),
+                name="stream-watch-drain", daemon=True)
+            watch_thread.start()
+        try:
+            return self._serve_loop(stop, poll, clock, backoff, features)
+        finally:
+            if sa_watch is not None:
+                sa_watch.set_arrival_notifier(None)
+                watch_wake.set()
+                watch_thread.join(timeout=1.0)
+
+    def _serve_loop(self, stop, poll, clock, backoff, features) -> int:
         # requeue sweeps batch like the reference requeuer
         # (inadmissible_workloads.go:37-47): 1s normally, 10s under
         # SchedulerLongRequeueInterval (re-read per tick so live gate
@@ -733,14 +805,15 @@ class Scheduler:
                 # runs NOW so capacity changes propagate immediately
                 sa = self._streaming_admitter()
                 if sa is not None:
-                    sa.drain(now_c)
-                    if sa.consume_full_solve_request():
-                        metrics.stream_spec_solves_total.inc()
-                        stats = self.schedule(now=clock())
-                        self._last_full_cycle_wall = clock()
-                        cycles += 1
-                        if stats.admitted or stats.preempted:
-                            idle_rounds = 0
+                    with self._cycle_mu:
+                        sa.drain(now_c)
+                        if sa.consume_full_solve_request():
+                            metrics.stream_spec_solves_total.inc()
+                            stats = self.schedule(now=clock())
+                            self._last_full_cycle_wall = clock()
+                            cycles += 1
+                            if stats.admitted or stats.preempted:
+                                idle_rounds = 0
                 continue
             # Streaming fast path (scheduler/streaming.py): between
             # full solves, in-order arrivals to uncontended CQs admit
@@ -749,31 +822,39 @@ class Scheduler:
             # decouples from the full-solve cadence. Host cycles still
             # run at least every max_cycle_gap (SLO windows, requeue
             # backoffs, metric flushes) and whenever fenced work waits.
-            micro_admitted = 0
-            sa = self._streaming_admitter()
-            if sa is not None:
-                now_c = clock()
-                micro = sa.drain(now_c)
-                micro_admitted = micro.admitted
-                if sa.consume_full_solve_request():
-                    # spec edit observed mid-window: fall through to
-                    # the full cycle right now — never skip it
-                    metrics.stream_spec_solves_total.inc()
-                elif ((micro.admitted or micro.parked)
-                        and not self.queues.has_pending()
-                        and (now_c - self._last_full_cycle_wall
-                             < self._streaming_max_gap())):
-                    idle_rounds = 0
-                    continue
-            # Flood-to-solver routing (run_until_quiet parity): a backlog
-            # past solver_min_backlog drains through the device kernel in
-            # one batched invocation; the host cycle below mops up the
-            # trickle and anything the solver could not model or verify.
-            drained = self._solver_drain(clock()) if self.solver else False
-            pre = self._queue_fingerprint()
-            stats = self.schedule(now=clock())
-            self._last_full_cycle_wall = clock()
-            cycles += 1
+            skip_heavy = False
+            with self._cycle_mu:
+                micro_admitted = 0
+                sa = self._streaming_admitter()
+                if sa is not None:
+                    now_c = clock()
+                    micro = sa.drain(now_c)
+                    micro_admitted = micro.admitted
+                    if sa.consume_full_solve_request():
+                        # spec edit observed mid-window: fall through
+                        # to the full cycle right now — never skip it
+                        metrics.stream_spec_solves_total.inc()
+                    elif ((micro.admitted or micro.parked)
+                            and not self.queues.has_pending()
+                            and (now_c - self._last_full_cycle_wall
+                                 < self._streaming_max_gap())):
+                        skip_heavy = True
+                if not skip_heavy:
+                    # Flood-to-solver routing (run_until_quiet
+                    # parity): a backlog past solver_min_backlog
+                    # drains through the device kernel in one batched
+                    # invocation; the host cycle below mops up the
+                    # trickle and anything the solver could not model
+                    # or verify.
+                    drained = (self._solver_drain(clock())
+                               if self.solver else False)
+                    pre = self._queue_fingerprint()
+                    stats = self.schedule(now=clock())
+                    self._last_full_cycle_wall = clock()
+                    cycles += 1
+            if skip_heavy:
+                idle_rounds = 0
+                continue
             if (drained or micro_admitted or stats.admitted
                     or stats.preempted
                     or self._queue_fingerprint() != pre):

@@ -10,22 +10,44 @@ depend on cross-CQ ordering defers to the next full solve.
 
 Soundness model (docs/ARCHITECTURE.md "Streaming dataflow"):
 
-- A CQ is *fast-path eligible* only when the lean (fit-only) kernel
-  would model it: no preemption policies, a single resource group, no
-  fair sharing, no admission-scope AFS, no TAS flavors. For such CQs a
+- A CQ is *fast-path eligible* only when the host flavor-assigner
+  oracle fully models it sub-cycle: no preemption policies, no fair
+  sharing, no admission-scope AFS, no TAS flavors. For such CQs a
   greedy in-order walk of the pending heap — admit the head while the
-  host flavor-assigner oracle says FIT, park BestEffortFIFO no-fits,
-  stop at a blocked StrictFIFO head — is exactly the per-CQ behavior
-  of the batched lean solve (the established kernel↔oracle parity).
+  oracle says FIT, park BestEffortFIFO no-fits, stop at a blocked
+  StrictFIFO head — is exactly the per-CQ behavior of the batched
+  solve (the established kernel↔oracle parity).
+- **Multi-flavor determinism.** With several flavor options, the
+  batch oracle's pick for a workload depends on capacity margins: a
+  capacity event landing later in the window (a finish freeing an
+  earlier-preference flavor) would make the boundary solve pick a
+  different flavor than the one already streamed — a retroactive
+  divergence no fence can undo. So each pick is checked against a
+  **flavor-pick witness** captured per full solve (the preference
+  order plus static zero-usage capacity ceilings derived from the
+  same spec data the solver exports — ``engine.flavor_witness()`` /
+  ``tensors.flavor_option_ceilings``, cached by
+  ``ExportCache.spec_gen``): a pick streams only when every
+  earlier-preference compatible option's ceiling sits below the
+  request, i.e. no capacity event can flip it; otherwise the CQ
+  demotes (``flavor_witness_invalid``).
 - Cross-CQ coupling happens only through cohort **borrowing**, and
-  the batch oracle interleaves cohort-mates round-by-round — an
-  interleave no event-time fence can reproduce after the fact. So the
-  borrowing fence is *structural*: a CQ streams only when it is the
-  sole CQ in its cohort root's subtree (it may then borrow freely —
-  nobody races it), or when every CQ in the subtree has borrowing
-  disabled (zero borrowing limits make cohort-mates capacity-
-  independent, so per-CQ greedy order IS the joint order). Borrow-
-  capable multi-CQ subtrees always take the full solve.
+  the batch oracle interleaves cohort-mates in global priority
+  order. Singleton cohort subtrees stream freely (their borrowing
+  races nobody) and no-borrow subtrees are capacity-independent. A
+  borrow-capable multi-CQ subtree streams under the
+  **reserved-headroom protocol**: each full solve reserves a per-CQ
+  nominal-headroom budget (the CQ's unused nominal at the boundary);
+  sub-cycle admissions consume only that budget — never borrowed
+  capacity (borrowing stays a full-solve-only decision, the Aryl
+  capacity-loaning contract, arXiv 2202.07896) — and the subtree's
+  members are walked as ONE merged sequence in global order (the
+  batch interleave). Within-nominal admissions are order-independent
+  across mates, so the merged prefix matches the boundary solve
+  bit-for-bit; the first entry that would need borrowed capacity (or
+  overruns its budget) fences the whole subtree
+  (``headroom_exhausted``) until the next full solve resolves the
+  borrow jointly.
 - On top of that, any cohort-crossing event — an eviction/finish/
   preemption candidate (capacity freed), a quota or flavor edit, a
   node flap (all spec events bump ``ExportCache.spec_gen``), an
@@ -84,6 +106,37 @@ class MicroDrainResult:
     admitted_keys: list[str] = field(default_factory=list)
 
 
+#: human-readable fence explanations (tools/explain.py surfaces these
+#: for "why did this workload not stream"); keys double as
+#: stream_demotions_total reasons where a metric is emitted
+_FENCE_TEXT = {
+    "out_of_order": (
+        "demoted from the streaming fast path: the arrival sorts "
+        "before an admission already committed this window, so only "
+        "the full solve can honor batch priority order"),
+    "unsupported": (
+        "deferred to the full solve: workload shape outside the "
+        "streaming fast path (topology request, concurrent-admission "
+        "variant, or multi-podset flavor choice)"),
+    "flavor_witness_invalid": (
+        "demoted from the streaming fast path: an earlier-preference "
+        "flavor option stays reachable under its capacity ceiling, "
+        "so a capacity event could flip the batch oracle's pick"),
+    "headroom_exhausted": (
+        "demoted from the streaming fast path: the admission would "
+        "need borrowed capacity or overrun the reserved "
+        "nominal-headroom budget (borrowing is a full-solve-only "
+        "decision)"),
+    "borrow_capable": (
+        "deferred to the full solve: borrow-capable cohort subtree "
+        "with a member outside the streaming fast path"),
+    "ineligible": (
+        "deferred to the full solve: the ClusterQueue uses "
+        "preemption, AdmissionFairSharing, TAS flavors, or "
+        "non-default flavor fungibility"),
+}
+
+
 class StreamingAdmitter:
     """Per-CQ sub-cycle admission fast path between full solves.
 
@@ -126,10 +179,34 @@ class StreamingAdmitter:
         self._root_gen = -1
         self._root_of: dict[str, str] = {}
         self._members: dict[str, list[str]] = {}
-        #: roots whose subtree structure permits streaming at all
+        #: roots whose subtree structure permits free per-CQ streaming
         #: (singleton, or borrowing disabled throughout)
         self._root_streamable: dict[str, bool] = {}
+        #: borrow-capable multi-CQ roots whose members are ALL
+        #: statically eligible — these stream through the merged-order
+        #: reserved-headroom walk instead of deferring outright
+        self._root_merge_ok: dict[str, bool] = {}
         self._eligible_cache: dict[str, bool] = {}
+        self._multi_flavor_cache: dict[str, bool] = {}
+        #: static zero-usage capacity ceilings per CQ flavor option —
+        #: the flavor-pick witness (engine.flavor_witness, cached per
+        #: spec generation)
+        self._flavor_ceilings: dict[str, dict] = {}
+        #: reserved nominal-headroom budgets, cq -> fr -> remaining;
+        #: captured from the window snapshot at first touch after each
+        #: full solve and drawn down by merged-walk commits
+        self._headroom: dict[str, dict] = {}
+        #: newest merged-order key admitted per borrow-capable root
+        #: this window (the cross-CQ out-of-order fence)
+        self._root_floor: dict[str, tuple] = {}
+        #: structural fences already recorded to the flight recorder
+        #: this spec generation (explain support, one event per cause)
+        self._fence_noted: set[tuple[str, str]] = set()
+        #: watch-driven drain support: the serve loop registers a
+        #: notifier; _on_event signals it on every streamable arrival
+        #: so micro-drain latency is event-bound, not tick-bound
+        self._notify = None
+        self._signal_pending = 0
         #: window snapshot for oracle fit checks, built lazily at the
         #: first micro-drain after arm and mutated incrementally by our
         #: own admissions (contended subtrees never consult it)
@@ -172,11 +249,34 @@ class StreamingAdmitter:
         if (verb != "delete" and wl.active and not wl.is_quota_reserved
                 and not wl.is_finished and not wl.is_evicted
                 and wl.status.admission is None):
-            return  # pure pending arrival/update: the work we stream
+            # pure pending arrival/update: the work we stream — wake
+            # the watch-driven drain worker instead of waiting for the
+            # serve loop's next tick
+            with self._mu:
+                self._signal_pending += 1
+                cb = self._notify
+            if cb is not None:
+                cb()
+            return
         cq = self.store.cluster_queue_for(wl)
         if cq is None and wl.status.admission is not None:
             cq = wl.status.admission.cluster_queue
         self._contend(cq, "cohort_event")
+
+    def set_arrival_notifier(self, cb) -> None:
+        """Register the watch-driven drain wakeup (serve loop). The
+        callback runs on the mutating thread — it must only signal."""
+        with self._mu:
+            self._notify = cb
+
+    def take_arrival_signals(self) -> int:
+        """Number of arrival signals since the last take — the drain
+        worker coalesces a burst of N signals into one drain and
+        accounts the other N-1 as ``watch_coalesced``."""
+        with self._mu:
+            n = self._signal_pending
+            self._signal_pending = 0
+            return n
 
     def _contend(self, cq: Optional[str], reason: str) -> None:
         with self._mu:
@@ -199,6 +299,11 @@ class StreamingAdmitter:
         self._root_of = {}
         self._members = {}
         self._eligible_cache = {}
+        self._multi_flavor_cache = {}
+        self._fence_noted = set()
+        #: the flavor-pick witness for this spec generation (static
+        #: zero-usage ceilings the multi-flavor fence checks against)
+        self._flavor_ceilings = self.engine.flavor_witness()
         roots: dict[str, str] = {}
 
         def root_of_cohort(name: str) -> str:
@@ -235,33 +340,66 @@ class StreamingAdmitter:
             self._root_streamable[root] = all(
                 not _can_borrow(self.store.cluster_queues[m])
                 for m in members)
+        # borrow-capable multi-CQ subtrees stream via the merged-order
+        # reserved-headroom walk — but only when every member is
+        # statically eligible (one ineligible member's full-solve
+        # admissions would interleave with streamed ones)
+        self._root_merge_ok = {}
+        for root, members in self._members.items():
+            if self._root_streamable[root]:
+                continue
+            self._root_merge_ok[root] = all(
+                self._static_eligible(m) for m in members)
 
     def _root(self, cq: str) -> str:
         self._refresh_tables()
         return self._root_of.get(cq, f"cq:{cq}")
 
     def _static_eligible(self, name: str) -> bool:
-        """Lean-kernel-shaped, flavor-deterministic CQ (cached per
-        spec generation). Single flavor option only: with multiple
-        options, a capacity-freeing event between a streamed
-        admission and the next full solve could have changed which
-        flavor the batch oracle would pick for it — a retroactive
-        divergence no fence can undo. Multi-flavor CQs keep the
-        full-solve path."""
+        """Oracle-modelable CQ (cached per spec generation): no
+        preemption, no admission-scope AFS, no TAS flavors. Multi-
+        flavor-option CQs are eligible — the per-pick flavor witness
+        (``_pick_stable``) guards their determinism at walk time —
+        but only under the DEFAULT flavor fungibility: a non-default
+        early-stop policy (TryNextFlavor on borrow, preemption
+        preference) makes the pick depend on capacity margins of
+        LATER flavors too, which the zero-usage witness cannot
+        bound."""
         cached = self._eligible_cache.get(name)
         if cached is not None:
             return cached
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.api.types import (
+            FlavorFungibilityPolicy,
+        )
+
         spec = self.store.cluster_queues.get(name)
         ok = (spec is not None
               and not spec.preemption.any_enabled
-              and len(spec.resource_groups) <= 1
-              and sum(len(rg.flavors)
-                      for rg in spec.resource_groups) <= 1
               and not (spec.admission_scope is not None
                        and self.queues.afs is not None)
               and not self.engine._is_tas_cq(name))
+        if ok and self._cq_multi_flavor(name) and features.enabled(
+                "FlavorFungibility"):
+            fung = spec.flavor_fungibility
+            ok = (fung.when_can_borrow == FlavorFungibilityPolicy.BORROW
+                  and fung.when_can_preempt
+                  == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+                  and fung.preference is None)
         self._eligible_cache[name] = ok
         return ok
+
+    def _cq_multi_flavor(self, name: str) -> bool:
+        """Whether any resource group of this CQ offers a flavor
+        choice (cached per spec generation)."""
+        cached = self._multi_flavor_cache.get(name)
+        if cached is not None:
+            return cached
+        spec = self.store.cluster_queues.get(name)
+        multi = (spec is not None and any(
+            len(rg.flavors) > 1 for rg in spec.resource_groups))
+        self._multi_flavor_cache[name] = multi
+        return multi
 
     # -- window lifecycle --------------------------------------------------
 
@@ -289,6 +427,11 @@ class StreamingAdmitter:
                 if g > self._solve_mark}
             self._snap = None
             self._max_admitted.clear()
+            # the boundary re-reserves the headroom budgets and
+            # resets the merged-order floors: the new window opens
+            # against post-solve usage
+            self._headroom.clear()
+            self._root_floor.clear()
 
     def note_solve_abort(self) -> None:
         """The solve failed (host fallback): stop attributing events
@@ -335,32 +478,58 @@ class StreamingAdmitter:
         self._refresh_tables()
         with self._mu:
             contended = set(self._contended_roots)
+        considered = 0
+        by_root: dict[str, list[str]] = {}
         for name in pending:
+            root = self._root_of.get(name, f"cq:{name}")
+            by_root.setdefault(root, []).append(name)
+        for root, names in by_root.items():
             if result.admitted + result.parked >= self.max_batch:
                 break
-            root = self._root_of.get(name, f"cq:{name}")
+            considered += len(names)
             if root in contended:
-                result.deferred_cqs += 1
+                result.deferred_cqs += len(names)
                 continue
-            q = self.queues.queues.get(name)
-            if q is not None and len(q._in_heap) > 4 * self.max_batch:
-                # a flood-sized heap is the batched solver's job (the
-                # scheduler's solver_min_backlog routing); walking it
-                # entry-by-entry here would stall the serve loop
-                result.deferred_cqs += 1
+            flooded = False
+            for name in names:
+                q = self.queues.queues.get(name)
+                if (q is not None
+                        and len(q._in_heap) > 4 * self.max_batch):
+                    # a flood-sized heap is the batched solver's job
+                    # (the scheduler's solver_min_backlog routing);
+                    # walking it entry-by-entry here would stall the
+                    # serve loop
+                    flooded = True
+                    break
+            if flooded:
+                result.deferred_cqs += len(names)
                 continue
-            if not self._root_streamable.get(root, False):
-                # borrow-capable multi-CQ subtree: the batch oracle
-                # interleaves its members round-by-round — only the
-                # full solve reproduces that order
-                result.deferred_cqs += 1
+            if self._root_streamable.get(root, False):
+                for name in names:
+                    if root in contended:
+                        break
+                    if not self._static_eligible(name):
+                        result.deferred_cqs += 1
+                        self._note_structural(name, "ineligible")
+                        continue
+                    if not self._drain_cq(name, root, now, result):
+                        contended.add(root)  # demoted mid-walk
+                continue
+            # borrow-capable multi-CQ subtree: streams through the
+            # merged-order reserved-headroom walk when every member
+            # is statically eligible; otherwise only the full solve
+            # reproduces the joint order
+            if not self._root_merge_ok.get(root, False):
+                result.deferred_cqs += len(names)
                 metrics.stream_demotions_total.inc("borrow_capable")
+                for name in names:
+                    self._note_structural(name, "borrow_capable")
                 continue
-            if not self._static_eligible(name):
-                result.deferred_cqs += 1
-                continue
-            if not self._drain_cq(name, root, now, result):
+            if not self._drain_root(root, names, now, result):
                 contended.add(root)  # demoted mid-walk
+        if considered:
+            metrics.stream_eligible_fraction.set(value=max(
+                0.0, 1.0 - result.deferred_cqs / considered))
         result.duration_s = time.perf_counter() - t0
         metrics.stream_microdrains_total.inc(
             "admitted" if result.admitted else
@@ -396,6 +565,7 @@ class StreamingAdmitter:
         if cq_snap is None:
             return True
         floor = self._max_admitted.get(name)
+        multi = self._cq_multi_flavor(name)
         for info in q.snapshot_order():
             # max_batch bounds PROCESSED entries (admits + parks), not
             # just admissions — one micro-drain must never walk an
@@ -415,15 +585,28 @@ class StreamingAdmitter:
                 # out-of-order arrival: the batch oracle would have
                 # sorted it before admissions already committed this
                 # window — demote before processing it
+                self._fence_event(info.key, name, "out_of_order")
                 self._contend(name, "out_of_order")
                 return False
             wl = self.store.workloads.get(info.key)
             if wl is None or wl.is_quota_reserved or not wl.active:
                 continue
             if any(ps.topology_request is not None for ps in wl.podsets):
+                self._fence_event(info.key, name, "unsupported",
+                                  {"check": "topology_request"})
                 self._contend(name, "unsupported")
                 return False
             if ca_gate and wl.parent_workload is not None:
+                self._fence_event(info.key, name, "unsupported",
+                                  {"check": "concurrent_admission"})
+                self._contend(name, "unsupported")
+                return False
+            if multi and len(wl.podsets) > 1:
+                # the flavor witness bounds single-podset picks only:
+                # grouped multi-podset assignment shares flavors in
+                # ways the per-resource ceilings don't model
+                self._fence_event(info.key, name, "unsupported",
+                                  {"check": "multi_flavor_multi_podset"})
                 self._contend(name, "unsupported")
                 return False
             fresh = WorkloadInfo(wl, cluster_queue=name)
@@ -433,6 +616,12 @@ class StreamingAdmitter:
             assignment = assigner.assign()
             mode = assignment.representative_mode()
             if mode == fa.FIT:
+                if multi and not self._pick_stable(
+                        name, wl, cq_snap, snap, assignment):
+                    self._fence_event(
+                        info.key, name, "flavor_witness_invalid")
+                    self._contend(name, "flavor_witness_invalid")
+                    return False
                 self._commit(wl, name, fresh, assignment, now, result)
                 floor = key
                 self._max_admitted[name] = key
@@ -454,6 +643,247 @@ class StreamingAdmitter:
                        "capacity",
                 reason_slug="stream_parked")
         return True
+
+    def _drain_root(self, root: str, names: list[str], now: float,
+                    result: MicroDrainResult) -> bool:
+        """Merged-order walk of a borrow-capable multi-CQ subtree
+        under the reserved-headroom protocol: every member's pending
+        entries are walked as one sequence in global ``_order_key``
+        order (the batch oracle's cohort interleave), each admission
+        must fit its CQ's reserved nominal-headroom budget with a
+        zero borrowing level, and the first entry that would need
+        borrowed capacity fences the subtree to the full solve.
+        Returns False when the subtree demoted itself mid-walk."""
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.api.types import QueueingStrategy
+
+        snap = self._window_snapshot()
+        entries: list[tuple] = []
+        lanes: dict[str, tuple] = {}
+        for name in names:
+            q = self.queues.queues.get(name)
+            if q is None:
+                continue
+            cq_snap = snap.cluster_queue(name)
+            if cq_snap is None:
+                continue
+            # reserve the budget before the first commit can land
+            self._headroom_budget(name, cq_snap)
+            lanes[name] = (q, cq_snap)
+            for info in q.snapshot_order():
+                entries.append((name, info))
+        entries.sort(key=lambda e: _order_key(e[1]))
+        ca_gate = features.enabled("ConcurrentAdmission")
+        blocked: set[str] = set()
+        floor = self._root_floor.get(root)
+        for name, info in entries:
+            if result.admitted + result.parked >= self.max_batch:
+                return True
+            if name in blocked:
+                continue
+            with self._mu:
+                if root in self._contended_roots or not self.armed:
+                    return True
+            q, cq_snap = lanes[name]
+            key = _order_key(info)
+            if floor is not None and key < floor:
+                # cross-CQ out-of-order arrival: the batch oracle
+                # would interleave it before an admission another
+                # member already committed this window
+                self._fence_event(info.key, name, "out_of_order")
+                self._contend(name, "out_of_order")
+                return False
+            wl = self.store.workloads.get(info.key)
+            if wl is None or wl.is_quota_reserved or not wl.active:
+                continue
+            if any(ps.topology_request is not None
+                   for ps in wl.podsets):
+                self._fence_event(info.key, name, "unsupported",
+                                  {"check": "topology_request"})
+                self._contend(name, "unsupported")
+                return False
+            if ca_gate and wl.parent_workload is not None:
+                self._fence_event(info.key, name, "unsupported",
+                                  {"check": "concurrent_admission"})
+                self._contend(name, "unsupported")
+                return False
+            multi = self._cq_multi_flavor(name)
+            if multi and len(wl.podsets) > 1:
+                self._fence_event(info.key, name, "unsupported",
+                                  {"check": "multi_flavor_multi_podset"})
+                self._contend(name, "unsupported")
+                return False
+            fresh = WorkloadInfo(wl, cluster_queue=name)
+            assigner = FlavorAssigner(
+                fresh, cq_snap, snap.resource_flavors,
+                oracle=self._preemptor, enable_fair_sharing=False)
+            assignment = assigner.assign()
+            mode = assignment.representative_mode()
+            if mode == fa.FIT:
+                if (assignment.borrows()
+                        or not self._headroom_admits(name, assignment)):
+                    # the admission would consume borrowed capacity
+                    # (or overrun the reserved budget): borrowing is
+                    # a full-solve-only decision — fence the subtree
+                    # until the next solve resolves it jointly
+                    self._fence_event(
+                        info.key, name, "headroom_exhausted",
+                        {"borrows": assignment.borrows()})
+                    self._contend(name, "headroom_exhausted")
+                    return False
+                if multi and not self._pick_stable(
+                        name, wl, cq_snap, snap, assignment):
+                    self._fence_event(
+                        info.key, name, "flavor_witness_invalid")
+                    self._contend(name, "flavor_witness_invalid")
+                    return False
+                self._headroom_consume(name, assignment)
+                self._commit(wl, name, fresh, assignment, now, result)
+                floor = key
+                self._root_floor[root] = key
+                prev = self._max_admitted.get(name)
+                if prev is None or key > prev:
+                    self._max_admitted[name] = key
+                continue
+            # NO_FIT / lean-kernel park: a blocked StrictFIFO head
+            # blocks only its own lane — the batch interleave keeps
+            # walking the other members
+            if q.strategy == QueueingStrategy.STRICT_FIFO:
+                blocked.add(name)
+                continue
+            q.park(info.key)
+            result.parked += 1
+            obs.recorder.record(
+                obs.SKIPPED, info.key, cycle=self._cycle(),
+                cluster_queue=name, path=obs.STREAM,
+                reason="parked inadmissible by the streaming fast "
+                       "path: no flavor option fits at current "
+                       "capacity",
+                reason_slug="stream_parked")
+        return True
+
+    # -- wide-fence support: witness, headroom, explain events -------------
+
+    def _pick_stable(self, name: str, wl, cq_snap, snap,
+                     assignment) -> bool:
+        """The multi-flavor determinism witness: True when NO
+        capacity event could make the batch oracle prefer an
+        earlier-preference flavor over the pick just made — every
+        earlier option is either statically incompatible (taint,
+        selector, TAS shape, variant pin) or exceeds its static
+        zero-usage capacity ceiling for some covered resource, so
+        freeing capacity cannot surface it."""
+        ceilings = self._flavor_ceilings.get(name) or {}
+        ps = wl.podsets[0]
+        checked: set[tuple[int, str]] = set()
+        for psa in assignment.podsets:
+            for res, rec in psa.flavors.items():
+                rg = cq_snap.rg_by_resource(res)
+                if rg is None:
+                    return False
+                if len(rg.flavors) <= 1:
+                    continue
+                mark = (id(rg), rec.name)
+                if mark in checked:
+                    continue
+                checked.add(mark)
+                order = [fq.name for fq in rg.flavors]
+                try:
+                    k = order.index(rec.name)
+                except ValueError:
+                    return False
+                if k == 0:
+                    continue
+                allowed_keys = frozenset(
+                    lk for fname in order
+                    for lk in self._flavor_labels(snap, fname))
+                covered = [(r, v) for r, v in psa.requests.items()
+                           if r in rg.covered_resources]
+                for g in order[:k]:
+                    if (wl.allowed_flavor is not None
+                            and g != wl.allowed_flavor):
+                        continue  # variant-pinned away: static
+                    flavor = snap.resource_flavors.get(g)
+                    if flavor is None:
+                        continue
+                    if fa._untolerated_taint(ps, flavor) is not None:
+                        continue
+                    if not fa._selector_matches(ps, flavor,
+                                                allowed_keys):
+                        continue
+                    if fa.tas_flavor_mismatch(
+                            ps, flavor, cq_snap) is not None:
+                        continue
+                    # a compatible earlier option: the pick is stable
+                    # only if the request tops the option's ceiling
+                    # even on an empty hierarchy
+                    if not any(v > ceilings.get((g, r), 0)
+                               for r, v in covered):
+                        return False
+        return True
+
+    @staticmethod
+    def _flavor_labels(snap, fname: str):
+        flavor = snap.resource_flavors.get(fname)
+        return flavor.node_labels if flavor is not None else {}
+
+    def _headroom_budget(self, name: str, cq_snap) -> dict:
+        """The reserved nominal-headroom budget for one CQ, captured
+        lazily from the window snapshot at first touch after the full
+        solve (= the boundary's unused nominal) and drawn down by
+        merged-walk commits. Mate commits never touch it: within-
+        nominal usage lives on the CQ's own quota node."""
+        budget = self._headroom.get(name)
+        if budget is None:
+            budget = {}
+            spec = self.store.cluster_queues.get(name)
+            if spec is not None:
+                for rg in spec.resource_groups:
+                    for fq in rg.flavors:
+                        for rq in fq.resources:
+                            fr = (fq.name, rq.name)
+                            used = cq_snap.node.usage.get(fr, 0)
+                            budget[fr] = max(0, rq.nominal - used)
+            self._headroom[name] = budget
+        return budget
+
+    def _headroom_admits(self, name: str, assignment) -> bool:
+        budget = self._headroom.get(name) or {}
+        return all(v <= budget.get(fr, 0)
+                   for fr, v in assignment.usage_quota.items())
+
+    def _headroom_consume(self, name: str, assignment) -> None:
+        budget = self._headroom.get(name)
+        if budget is None:
+            return
+        for fr, v in assignment.usage_quota.items():
+            budget[fr] = max(0, budget.get(fr, 0) - v)
+
+    def _fence_event(self, key: str, cq: str, fence: str,
+                     detail: Optional[dict] = None) -> None:
+        """Flight-recorder trail for tools/explain.py: WHY a workload
+        did not stream (which fence demoted it)."""
+        d = {"fence": fence, "root": self._root_of.get(cq, f"cq:{cq}")}
+        if detail:
+            d.update(detail)
+        obs.recorder.record(
+            obs.SKIPPED, key, cycle=self._cycle(), cluster_queue=cq,
+            path=obs.STREAM, reason=_FENCE_TEXT.get(fence, fence),
+            reason_slug=f"stream_fence_{fence}", detail=d)
+
+    def _note_structural(self, name: str, fence: str) -> None:
+        """Record a structural (per-spec-generation) fence once per
+        CQ against its current queue head, so explain can answer
+        "why is this stuck on the slow path" without a per-drain
+        event flood."""
+        if (name, fence) in self._fence_noted:
+            return
+        self._fence_noted.add((name, fence))
+        q = self.queues.queues.get(name)
+        if q is None or not q._in_heap:
+            return
+        head = min(q._in_heap.values(), key=_order_key)
+        self._fence_event(head.key, name, fence)
 
     def _cycle(self) -> int:
         sched = self.engine.scheduler
@@ -520,7 +950,15 @@ class StreamingAdmitter:
                     "contendedRoots": sorted(self._contended_roots),
                     "specGen": gen, "armedGen": self._armed_gen,
                     "dirtyKeys": len(keys), "dirtyCqs": len(cqs),
-                    "microDrains": self.micro_drains}
+                    "microDrains": self.micro_drains,
+                    "mergedRoots": sorted(
+                        r for r, ok in self._root_merge_ok.items()
+                        if ok),
+                    "headroom": {
+                        cq: {f"{fr[0]}/{fr[1]}": v
+                             for fr, v in budget.items()}
+                        for cq, budget in self._headroom.items()},
+                    "pendingArrivalSignals": self._signal_pending}
 
 
 def _can_borrow(spec) -> bool:

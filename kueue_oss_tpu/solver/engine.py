@@ -91,6 +91,8 @@ class SolverEngine:
         #: assemble the problem with vectorized gathers instead of
         #: per-workload Python loops
         self.export_cache = ExportCache(store)
+        #: (spec_gen, ceilings) memo backing flavor_witness()
+        self._flavor_witness_cache: Optional[tuple[int, dict]] = None
         #: sticky pad high-water mark: the padded workload axis never
         #: shrinks, so a backlog oscillating around a power-of-two
         #: boundary (pending + admitted crossing pad_to) can't flap
@@ -293,6 +295,24 @@ class SolverEngine:
                 if fl is not None and fl.topology_name is not None:
                     return True
         return False
+
+    def flavor_witness(self) -> dict[str, dict]:
+        """Per-CQ static flavor-option capacity ceilings for the
+        streaming flavor-pick witness, cached by ``ExportCache.spec_gen``
+        (any quota/flavor/cohort edit invalidates it together with the
+        export tensors it mirrors). The streaming admitter combines
+        these with the post-solve window snapshot to decide whether a
+        multi-flavor pick could be flipped by a capacity event
+        (tensors.flavor_option_ceilings, scheduler/streaming.py)."""
+        gen = self.export_cache.spec_gen
+        cached = self._flavor_witness_cache
+        if cached is not None and cached[0] == gen:
+            return cached[1]
+        from kueue_oss_tpu.solver.tensors import flavor_option_ceilings
+
+        witness = flavor_option_ceilings(self.store)
+        self._flavor_witness_cache = (gen, witness)
+        return witness
 
     def pending_backlog(self) -> dict[str, list[WorkloadInfo]]:
         """Current heap contents per CQ in rank (pop) order, plus stale

@@ -3,7 +3,13 @@
 import datetime
 import ssl
 
-from kueue_oss_tpu.util.internalcert import ensure_cert
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="internal cert bootstrap needs the cryptography package")
+
+from kueue_oss_tpu.util.internalcert import ensure_cert  # noqa: E402
 from kueue_oss_tpu.util.tlsconfig import (
     TLSOptions,
     build_ssl_context,

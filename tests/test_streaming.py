@@ -285,20 +285,21 @@ class TestOracleParity:
 
 
 class TestContentionFences:
-    def test_borrow_capable_cohort_defers_to_full_solve(self):
+    def test_borrow_needing_admission_fences_cohort_to_full_solve(self):
         cqs, cohorts = _parity_topology()
         store = build_store(cqs, cohorts)
         _qm, sched, eng = _make_sched(store, streaming=True)
         eng.drain(now=100.0, verify=True)
-        # d/e share a borrow-capable cohort: the batch oracle
-        # interleaves them round-by-round, so neither ever streams
+        # d/e share a borrow-capable cohort: the merged-order walk
+        # streams within reserved nominal headroom, but xd needs
+        # borrowed capacity — the first borrow-needing entry fences
+        # the whole subtree (xe sorts after it) to the full solve
         submit(store, "xd", "d", 1.0, 1, cpu=2_000)  # needs borrow
         submit(store, "xe", "e", 2.0, 2, cpu=500)
         res = sched.micro_drain(100.5)
         assert res.admitted == 0
-        assert res.deferred_cqs >= 2
         assert metrics.stream_demotions_total.value(
-            "borrow_capable") >= 1
+            "headroom_exhausted") >= 1
         # no-borrow cohort-mates and the standalone CQ still stream
         submit(store, "xa", "a", 3.0, 3, cpu=500)
         submit(store, "xb", "b", 4.0, 4, cpu=500)
@@ -309,6 +310,31 @@ class TestContentionFences:
         eng.drain(now=101.0, verify=True)
         assert store.workloads["default/xd"].is_admitted
         assert store.workloads["default/xe"].is_admitted
+
+    def test_borrow_capable_cohort_streams_within_headroom(self):
+        cqs, cohorts = _parity_topology()
+        store = build_store(cqs, cohorts)
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        # both fit their own nominal (1500 each): the reserved-
+        # headroom protocol streams them sub-cycle — the PR-11
+        # structural fence would have deferred both
+        submit(store, "yd", "d", 1.0, 1, cpu=1_000)
+        submit(store, "ye", "e", 2.0, 2, cpu=1_200)
+        res = sched.micro_drain(100.5)
+        assert res.admitted == 2
+        assert store.workloads["default/yd"].is_quota_reserved
+        assert store.workloads["default/ye"].is_quota_reserved
+        # headroom draws down across drains within one window: d has
+        # 500 left, a second 600-cpu arrival needs borrow -> fence
+        submit(store, "yd2", "d", 3.0, 3, cpu=600)
+        res = sched.micro_drain(100.6)
+        assert res.admitted == 0
+        assert metrics.stream_demotions_total.value(
+            "headroom_exhausted") >= 1
+        # the boundary re-reserves budgets from post-solve usage
+        eng.drain(now=101.0, verify=True)
+        assert store.workloads["default/yd2"].is_admitted
 
     def test_capacity_event_demotes_until_full_solve(self):
         store = build_store([make_cq("a", 1_000)])
@@ -410,6 +436,283 @@ class TestContentionFences:
             "out_of_order") >= 1
         eng.drain(now=101.0, verify=True)
         assert store.workloads["default/hi"].is_admitted
+
+
+# ---------------------------------------------------------------------------
+# wide fences: multi-flavor witness, reserved headroom, watch-driven
+# ---------------------------------------------------------------------------
+
+
+def make_mf_cq(name, nominal_small, nominal_large, cohort=None,
+               bl=None):
+    """Two ordered flavor options (small preferred) on one resource
+    group — the multi-flavor determinism shape."""
+    return ClusterQueue(
+        name=name, cohort=cohort,
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[
+                FlavorQuotas(name="small", resources=[
+                    ResourceQuota(name="cpu", nominal=nominal_small,
+                                  borrowing_limit=bl)]),
+                FlavorQuotas(name="large", resources=[
+                    ResourceQuota(name="cpu", nominal=nominal_large,
+                                  borrowing_limit=bl)]),
+            ])],
+        queueing_strategy=QueueingStrategy.BEST_EFFORT_FIFO,
+        preemption=PreemptionPolicy(),
+    )
+
+
+def build_mf_store(cqs, cohorts=()):
+    store = Store()
+    for f in ("default", "small", "large"):
+        store.upsert_resource_flavor(ResourceFlavor(name=f))
+    store.upsert_node(Node(name="n1", allocatable={"cpu": 100000}))
+    for c in cohorts:
+        store.upsert_cohort(c)
+    for cq in cqs:
+        store.upsert_cluster_queue(cq)
+        store.upsert_local_queue(
+            LocalQueue(name=f"lq-{cq.name}", cluster_queue=cq.name))
+    return store
+
+
+def _picked_flavor(store, key):
+    return store.workloads[key].status.admission \
+        .podset_assignments[0].flavors["cpu"]
+
+
+class TestWideFences:
+    def test_multi_flavor_stable_picks_stream(self):
+        store = build_mf_store([make_mf_cq("m", 1_000, 10_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        # first-preference pick: trivially stable (k == 0)
+        submit(store, "w1", "m", 1.0, 1, cpu=500)
+        assert sched.micro_drain(100.2).admitted == 1
+        assert _picked_flavor(store, "default/w1") == "small"
+        # exceeds small's static ceiling (1000): no capacity event
+        # can ever surface small for it — the large pick is stable
+        submit(store, "w2", "m", 2.0, 2, cpu=2_000)
+        assert sched.micro_drain(100.4).admitted == 1
+        assert _picked_flavor(store, "default/w2") == "large"
+
+    def test_witness_invalidation_demotion_chain(self):
+        store = build_mf_store([make_mf_cq("m", 1_000, 10_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        submit(store, "w1", "m", 1.0, 1, cpu=600)
+        assert sched.micro_drain(100.2).admitted == 1
+        # 800 fits large NOW, but only because small is 600/1000
+        # full — a finish could free small and flip the batch pick:
+        # the witness demotes instead of streaming
+        submit(store, "w2", "m", 2.0, 2, cpu=800)
+        res = sched.micro_drain(100.4)
+        assert res.admitted == 0
+        assert metrics.stream_demotions_total.value(
+            "flavor_witness_invalid") >= 1
+        # the fence leaves an explain trail on the workload
+        evs = obs.recorder.explain("default/w2")
+        assert any(ev.reason_slug == "stream_fence_flavor_witness_invalid"
+                   for ev in evs)
+        # the boundary resolves it (and re-arms the window)
+        eng.drain(now=101.0, verify=True)
+        assert _picked_flavor(store, "default/w2") == "large"
+        submit(store, "w3", "m", 3.0, 3, cpu=5_000)  # > small ceiling
+        assert sched.micro_drain(101.2).admitted == 1
+
+    def test_multi_flavor_cohort_merged_walk(self):
+        # multi-flavor member inside a borrow-capable cohort: both
+        # wide fences compose — witness-stable picks stream within
+        # reserved headroom
+        store = build_mf_store(
+            [make_mf_cq("m4", 1_000, 2_000, cohort="mx"),
+             make_cq("m5", 1_500, cohort="mx")],
+            [Cohort(name="mx")])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        submit(store, "a1", "m4", 1.0, 1, cpu=800)   # small, stable
+        submit(store, "a2", "m5", 2.0, 2, cpu=1_000)
+        res = sched.micro_drain(100.5)
+        assert res.admitted == 2
+        assert _picked_flavor(store, "default/a1") == "small"
+
+    def test_eligible_fraction_gauge(self):
+        store = build_mf_store(
+            [make_mf_cq("m4", 1_000, 2_000, cohort="mx"),
+             make_cq("m5", 1_500, cohort="mx"),
+             make_cq("p", 5_000, preempt=True)],
+            [Cohort(name="mx")])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        submit(store, "b1", "m4", 1.0, 1, cpu=500)
+        submit(store, "b2", "m5", 2.0, 2, cpu=500)
+        sched.micro_drain(100.5)
+        # 2 of 2 pending CQs walked the fast path
+        assert metrics.stream_eligible_fraction.value() == 1.0
+        submit(store, "b3", "p", 3.0, 3, cpu=500)  # preemption CQ
+        submit(store, "b4", "m4", 4.0, 4, cpu=100)
+        sched.micro_drain(100.7)
+        val = metrics.stream_eligible_fraction.value()
+        assert 0.0 < val < 1.0
+
+    def test_watch_driven_drain_coalesces_burst(self):
+        store = build_store([make_cq("a", 50_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        sa = sched._streaming_admitter()
+        stop = threading.Event()
+        wake = threading.Event()
+        sa.set_arrival_notifier(wake.set)
+        t = threading.Thread(
+            target=sched._watch_drain_loop,
+            args=(sa, wake, stop, time.monotonic), daemon=True)
+        t.start()
+        try:
+            # burst while the cycle lock is held: the worker cannot
+            # drain mid-burst, so the signals coalesce
+            with sched._cycle_mu:
+                for i in range(6):
+                    submit(store, f"burst{i}", "a", 1.0 + i, 10 + i)
+            deadline = time.monotonic() + 10.0
+            while (metrics.stream_admitted_total.total() < 6
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        finally:
+            stop.set()
+            wake.set()
+            t.join(timeout=10.0)
+        assert metrics.stream_admitted_total.total() == 6
+        for i in range(6):
+            assert store.workloads[f"default/burst{i}"].is_quota_reserved
+        # 6 signals collapsed into at most 2 drains -> >= 4 coalesced
+        assert metrics.stream_demotions_total.value(
+            "watch_coalesced") >= 4
+
+    def test_serve_wires_watch_worker(self):
+        store = build_store([make_cq("a", 10_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=100.0, verify=True)
+        sa = sched._streaming_admitter()
+        stop = threading.Event()
+        t = threading.Thread(target=sched.serve, args=(stop,),
+                             kwargs={"poll": 0.01}, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while sa._notify is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert sa._notify is not None
+            submit(store, "w1", "a", 1.0, 1)
+            while (not store.workloads["default/w1"].is_quota_reserved
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        finally:
+            stop.set()
+            t.join(timeout=10.0)
+        assert store.workloads["default/w1"].is_quota_reserved
+        assert sa._notify is None  # serve cleans up its notifier
+
+
+# ---------------------------------------------------------------------------
+# wide-fence oracle parity: multi-flavor + borrow-capable worlds
+# ---------------------------------------------------------------------------
+
+
+def _mf_parity_topology():
+    # m1: standalone multi-flavor; m2/m3: borrow-capable single-
+    # flavor cohort (reserved-headroom protocol); m4/m5: cohort
+    # mixing a multi-flavor member with a borrow-capable mate (both
+    # wide fences compose)
+    return ([make_mf_cq("m1", 2_000, 3_000),
+             make_cq("m2", 1_500, cohort="mco"),
+             make_cq("m3", 1_500, cohort="mco"),
+             make_mf_cq("m4", 1_000, 2_000, cohort="mco2"),
+             make_cq("m5", 1_500, cohort="mco2")],
+            [Cohort(name="mco"), Cohort(name="mco2")])
+
+
+def _gen_mf_script(seed, windows=4, events_per_window=6):
+    rng = random.Random(seed)
+    cqs = ["m1", "m2", "m3", "m4", "m5"]
+    prio_of = {"m1": 0, "m2": 5, "m3": 2, "m4": 0, "m5": 3}
+    uid = 10
+    arrivals = []
+    script = []
+    for w in range(windows):
+        window = []
+        if w > 0 and rng.random() < 0.5:
+            if rng.random() < 0.5:
+                window.append(("quota", "m2",
+                               rng.choice([1_000, 1_500, 2_500])))
+            else:
+                window.append(("flap",))
+        while len(window) < events_per_window:
+            old = [a for a in arrivals if a[1] <= w - 2]
+            if old and rng.random() < 0.2:
+                name = rng.choice(old)[0]
+                window.append(("finish", f"default/{name}"))
+            else:
+                cq = rng.choice(cqs)
+                name = f"w{uid}"
+                window.append(("arrive", cq, name, uid,
+                               rng.choice([300, 500, 900, 1_400]),
+                               prio_of[cq]))
+                arrivals.append((name, w))
+                uid += 1
+        script.append(window)
+    return script
+
+
+def _run_mf_twin(script, streaming):
+    cqs, cohorts = _mf_parity_topology()
+    store = build_mf_store(cqs, cohorts)
+    _qm, sched, eng = _make_sched(store, streaming=streaming)
+    eng.drain(now=99.0, verify=True)
+    flap_down = False
+    dumps = []
+    for k, window in enumerate(script):
+        now = 100.0 + k
+        for ev in window:
+            if ev[0] == "arrive":
+                _, cq, name, uid, cpu, prio = ev
+                submit(store, name, cq, 10.0 + uid, uid,
+                       cpu=cpu, prio=prio)
+            elif ev[0] == "finish":
+                sched.finish_workload(ev[1], now=now)
+            elif ev[0] == "quota":
+                store.upsert_cluster_queue(
+                    make_cq(ev[1], ev[2], cohort="mco"))
+            elif ev[0] == "flap":
+                flap_down = not flap_down
+                store.upsert_node(Node(
+                    name="n1", allocatable={"cpu": 100000},
+                    ready=not flap_down))
+            if streaming:
+                sched.micro_drain(now)
+        eng.drain(now=now, verify=True)
+        dumps.append(canonical_dump(store))
+    return dumps
+
+
+class TestWideFenceOracleParity:
+    @pytest.mark.parametrize("seed", [11, 29, 41])
+    def test_bit_identical_at_boundaries(self, seed):
+        script = _gen_mf_script(seed)
+        stream_dumps = _run_mf_twin(script, streaming=True)
+        batch_dumps = _run_mf_twin(script, streaming=False)
+        for k, (s, b) in enumerate(zip(stream_dumps, batch_dumps)):
+            assert s == b, f"seed {seed}: diverged at boundary {k}"
+
+    def test_wide_fences_actually_stream(self):
+        # the PR-11 fences streamed ~0 on this fleet (every CQ is
+        # multi-flavor or borrow-capable); the wide fences must admit
+        # a meaningful share sub-cycle for the parity to be non-vacuous
+        script = _gen_mf_script(11)
+        metrics.reset_all()
+        _run_mf_twin(script, streaming=True)
+        assert metrics.stream_admitted_total.total() >= 3
 
 
 # ---------------------------------------------------------------------------

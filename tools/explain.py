@@ -51,6 +51,19 @@ def explain_workload(events: list[DecisionEvent], key: str,
           f"(latest: {newest.kind}"
           + (f" in ClusterQueue {newest.cluster_queue}"
              if newest.cluster_queue else "") + ")", file=out)
+    fence = next(
+        (ev for ev in chain
+         if (ev.reason_slug or "").startswith("stream_fence_")
+         or ev.reason_slug == "stream_parked"), None)
+    if fence is not None:
+        slug = fence.reason_slug or ""
+        what = (fence.detail or {}).get(
+            "fence",
+            slug[len("stream_fence_"):] if slug.startswith(
+                "stream_fence_") else "parked")
+        print(f"streaming: not admitted sub-cycle — fence "
+              f"'{what}' at cycle {fence.cycle}: "
+              f"{fence.reason or slug}", file=out)
     for ev in chain:
         print(_fmt_event(ev), file=out)
     return 0
