@@ -1931,6 +1931,237 @@ def run_scenario(scenario: str) -> dict:
             "shipped_bytes_per_cycle": int(shipped_per_cycle),
         }
 
+    if scenario == "megascale":
+        # million-workload control plane (docs/ARCHITECTURE.md
+        # "Columnar export path"): the export/delta/micro-drain
+        # pipeline at BENCH_MEGA_WLS x BENCH_MEGA_CQS (default 1M x
+        # 10k). Three stories, each with its own budget line in the
+        # JSON tail:
+        #   1. columnar export — the unchanged-store re-export
+        #      (incrementally-maintained columns, O(dirty) refresh)
+        #      vs the classic O(W) per-row dict walk, plus the
+        #      churned-store scatter re-export with dirty-row counts;
+        #   2. delta encode — the hint-driven DELTA frame straight
+        #      from the dirty columns after a clustered churn;
+        #   3. streamed burst — a coalesced arrival burst through the
+        #      device micro-solve vs the per-entry host walk. The
+        #      engine commit (store writes, metrics, recorder) is
+        #      bit-identical work in both arms — parity requires it —
+        #      so the decision-phase rates subtract it on the host
+        #      side and time the kernel solve on the device side;
+        #      end-to-end walls for both arms ride along unsubtracted.
+        import gc
+
+        from kueue_oss_tpu.api.types import (
+            ClusterQueue as _CQ,
+            FlavorQuotas as _FQ,
+            LocalQueue as _LQ,
+            Node as _Node,
+            PodSet as _PS,
+            ResourceFlavor as _RF,
+            ResourceGroup as _RG,
+            ResourceQuota as _RQ,
+            Workload as _WL,
+        )
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.core.store import Store as _Store
+        from kueue_oss_tpu.solver.delta import HostDeltaSession
+        from kueue_oss_tpu.solver.engine import SolverEngine
+        from kueue_oss_tpu.solver.tensors import export_problem
+
+        W = int(os.environ.get("BENCH_MEGA_WLS", "1000000"))
+        C = int(os.environ.get("BENCH_MEGA_CQS", "10000"))
+        churn_n = min(int(os.environ.get("BENCH_MEGA_CHURN", "4096")),
+                      W // 2)
+        burst = int(os.environ.get("BENCH_MEGA_BURST", "8192"))
+        per_cq = max(1, W // C)
+
+        def _flat_cq(name, nominal):
+            return _CQ(name=name, resource_groups=[_RG(
+                covered_resources=["cpu"],
+                flavors=[_FQ(name="default", resources=[
+                    _RQ(name="cpu", nominal=nominal)])])])
+
+        store = _Store()
+        store.upsert_resource_flavor(_RF(name="default"))
+        store.upsert_node(_Node(name="n1",
+                                allocatable={"cpu": 10 ** 12}))
+        for c in range(C):
+            store.upsert_cluster_queue(
+                _flat_cq(f"cq{c:05d}", 10_000_000))
+            store.upsert_local_queue(
+                _LQ(name=f"lq{c:05d}", cluster_queue=f"cq{c:05d}"))
+        log(f"[megascale] {C} CQs up; adding {W} workloads")
+        # block assignment (workload i -> CQ i // per_cq) keeps the
+        # churn slice below clustered in a few hot CQs, the realistic
+        # dirty-set shape for the scatter re-export
+        for i in range(W):
+            c = min(i // per_cq, C - 1)
+            store.add_workload(_WL(
+                name=f"w{i}", queue_name=f"lq{c:05d}", uid=i + 1,
+                creation_time=float(i) * 1e-3,
+                podsets=[_PS(count=1,
+                             requests={"cpu": 100 + (i % 5) * 50})]))
+        queues = QueueManager(store)
+        engine = SolverEngine(store, queues)
+        cache = engine.export_cache
+        pending = engine.pending_backlog()
+        n_pend = sum(len(v) for v in pending.values())
+        log(f"[megascale] backlog built: {n_pend} pending")
+
+        # -- 1. export: classic walk vs columnar ---------------------
+        t0 = time.monotonic()
+        p_cold = export_problem(store, pending, now=1.0, cache=cache,
+                                columnar=False)
+        export_cold_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        p_walk = export_problem(store, pending, now=1.0, cache=cache,
+                                columnar=False)
+        export_walk_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        export_problem(store, pending, now=1.0, cache=cache)
+        export_build_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        p_cached = export_problem(store, pending, now=1.0, cache=cache)
+        export_unchanged_s = time.monotonic() - t0
+        stats = dict(cache.columnar.last_stats) \
+            if cache.columnar is not None else {}
+        log(f"[megascale] export: cold {export_cold_s:.2f}s, warm walk "
+            f"{export_walk_s:.2f}s, columnar build {export_build_s:.2f}s, "
+            f"unchanged {export_unchanged_s * 1000:.1f}ms "
+            f"({stats.get('mode')})")
+        identical = (
+            p_cached.n_workloads == p_walk.n_workloads
+            and p_cached.wl_keys == p_walk.wl_keys
+            and p_cached.cq_names == p_walk.cq_names
+            and all(np.array_equal(getattr(p_cached, f),
+                                   getattr(p_walk, f))
+                    for f in ("wl_cqid", "wl_rank", "wl_prio", "wl_ts",
+                              "wl_uid", "wl_req", "wl_valid",
+                              "nominal", "usage0")))
+
+        # -- 2. clustered churn: scatter re-export + DELTA encode ----
+        sess = HostDeltaSession(cache=cache)
+        sess.cheap_checksum = True
+        sess.advance(p_cached,
+                     hint=getattr(p_cached, "_columnar_hint", None))
+        for i in range(churn_n):
+            wl = store.workloads[f"default/w{i}"]
+            wl.podsets[0].requests["cpu"] += 50
+            store.update_workload(wl)
+        pending2 = engine.pending_backlog()
+        t0 = time.monotonic()
+        p_churn = export_problem(store, pending2, now=1.0, cache=cache)
+        export_churn_s = time.monotonic() - t0
+        churn_stats = dict(cache.columnar.last_stats) \
+            if cache.columnar is not None else {}
+        t0 = time.monotonic()
+        _slotted, frame = sess.advance(
+            p_churn, hint=getattr(p_churn, "_columnar_hint", None))
+        delta_encode_s = time.monotonic() - t0
+        frame_kind = ("delta" if frame.delta is not None
+                      else (frame.full_reason or "full"))
+        log(f"[megascale] churn {churn_n}: re-export "
+            f"{export_churn_s * 1000:.1f}ms ({churn_stats.get('mode')}, "
+            f"{churn_stats.get('dirty_rows')} dirty), encode "
+            f"{delta_encode_s * 1000:.1f}ms ({frame_kind})")
+
+        del (store, queues, engine, cache, pending, pending2, p_cold,
+             p_walk, p_cached, p_churn, sess, frame)
+        gc.collect()
+
+        # -- 3. streamed burst: device micro-solve vs host walk ------
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+
+        burst_cqs = min(256, C)
+
+        def _burst_arm(micro):
+            st = _Store()
+            st.upsert_resource_flavor(_RF(name="default"))
+            st.upsert_node(_Node(name="n1",
+                                 allocatable={"cpu": 10 ** 12}))
+            for c in range(burst_cqs):
+                st.upsert_cluster_queue(
+                    _flat_cq(f"bq{c}", 10 ** 9))
+                st.upsert_local_queue(
+                    _LQ(name=f"blq{c}", cluster_queue=f"bq{c}"))
+            qs = QueueManager(st)
+            sc = Scheduler(st, qs, solver="auto",
+                           solver_min_backlog=0, streaming=True)
+            sc._solver_engine().drain(now=0.0, verify=True)
+            sa = sc._streaming_admitter()
+            sa.micro_solve = micro
+            sa.micro_solve_min = 1
+            sa.max_batch = burst + 64
+
+            def _arrivals(uid0, now):
+                for j in range(burst):
+                    st.add_workload(_WL(
+                        name=f"bw{uid0 + j}",
+                        queue_name=f"blq{j % burst_cqs}",
+                        uid=uid0 + j, creation_time=now,
+                        podsets=[_PS(count=1,
+                                     requests={"cpu": 100})]))
+
+            _arrivals(1, 1.0)
+            r = sc.micro_drain(1.5)  # warm (compiles the micro kernel)
+            assert r.admitted == burst, (micro, r.admitted)
+            _arrivals(10_000_000, 2.0)
+            t0 = time.monotonic()
+            r = sc.micro_drain(2.5)
+            wall = time.monotonic() - t0
+            assert r.admitted == burst, (micro, r.admitted)
+            assert r.micro_batch == (burst if micro else 0)
+            return wall, r
+
+        wall_h, r_h = _burst_arm(False)
+        wall_m, r_m = _burst_arm(True)
+        host_decision_s = max(wall_h - r_h.commit_s, 1e-9)
+        log(f"[megascale] burst {burst} x {burst_cqs} CQs: host "
+            f"{wall_h * 1000:.0f}ms (commit {r_h.commit_s * 1000:.0f}ms)"
+            f", micro {wall_m * 1000:.0f}ms (export "
+            f"{r_m.micro_export_s * 1000:.0f}ms solve "
+            f"{r_m.micro_solve_s * 1000:.0f}ms commit "
+            f"{r_m.commit_s * 1000:.0f}ms)")
+
+        return {
+            "scenario": scenario,
+            "workloads": W,
+            "cqs": C,
+            "pending": n_pend,
+            "export_ms": round(export_cold_s * 1000, 1),
+            "export_walk_warm_ms": round(export_walk_s * 1000, 1),
+            "export_columnar_build_ms": round(export_build_s * 1000, 1),
+            "export_ms_unchanged": round(export_unchanged_s * 1000, 3),
+            "export_speedup": round(
+                export_cold_s / max(export_unchanged_s, 1e-9), 1),
+            "export_speedup_warm": round(
+                export_walk_s / max(export_unchanged_s, 1e-9), 1),
+            "export_mode_unchanged": stats.get("mode"),
+            "columnar_identical": bool(identical),
+            "churn_rows": churn_n,
+            "export_churn_ms": round(export_churn_s * 1000, 1),
+            "export_churn_mode": churn_stats.get("mode"),
+            "export_churn_dirty_rows": churn_stats.get("dirty_rows"),
+            "delta_encode_ms": round(delta_encode_s * 1000, 2),
+            "delta_frame": frame_kind,
+            "burst": burst,
+            "burst_cqs": burst_cqs,
+            "micro_solve_ms": round(r_m.micro_solve_s * 1000, 2),
+            "micro_export_ms": round(r_m.micro_export_s * 1000, 2),
+            "stream_commit_ms_host": round(r_h.commit_s * 1000, 1),
+            "stream_commit_ms_micro": round(r_m.commit_s * 1000, 1),
+            "stream_e2e_ms_host": round(wall_h * 1000, 1),
+            "stream_e2e_ms_micro": round(wall_m * 1000, 1),
+            # decision-phase rates: host = per-entry walk net of the
+            # shared commit; device = the coalesced kernel solve
+            "arrivals_per_sec": round(burst / max(r_m.micro_solve_s,
+                                                  1e-9), 1),
+            "arrivals_per_sec_host": round(burst / host_decision_s, 1),
+            "arrivals_speedup": round(
+                host_decision_s / max(r_m.micro_solve_s, 1e-9), 1),
+        }
+
     if scenario == "parity":
         # 1/10-scale contended preemption drain: kernel vs host
         store_h, queues_h, _ = _build(preemption=True, small=True)
@@ -2233,6 +2464,20 @@ def main() -> None:
     except Exception as e:
         log(f"[relax] did not complete: {e}")
         relax_res = None
+    # million-workload control plane: columnar/delta export budget plus
+    # the device micro-drain burst twin (host backend: the export and
+    # encode phases are host-side by construction). The full 1M x 10k
+    # shape runs only with BENCH_MEGA=1; the default ladder keeps the
+    # 50k x 1k smoke shape so the bench wall stays bounded.
+    mega_env = {"BENCH_CPU": "1"}
+    if os.environ.get("BENCH_MEGA") != "1":
+        mega_env.update({"BENCH_MEGA_WLS": "50000",
+                         "BENCH_MEGA_CQS": "1000"})
+    try:
+        mega = measure("megascale", extra_env=mega_env, timeout=3600)
+    except Exception as e:
+        log(f"[megascale] did not complete: {e}")
+        mega = None
     log(f"total bench time {time.monotonic() - t_start:.1f}s")
 
     # HEADLINE: the reference's own protocol — same shape, same
@@ -2439,6 +2684,23 @@ def main() -> None:
             "checkpoint_incremental_pct"]
         extra["shipped_bytes_per_cycle"] = streaming_res[
             "shipped_bytes_per_cycle"]
+    if mega is not None:
+        # million-workload control plane acceptance: unchanged-store
+        # columnar re-export >= 20x the from-scratch walk, the DELTA
+        # frame encoded straight from dirty columns, and the device
+        # micro-drain decision rate >= 10x the host per-entry walk
+        extra["mega_workloads"] = mega["workloads"]
+        extra["mega_cqs"] = mega["cqs"]
+        extra["mega_export_ms"] = mega["export_ms"]
+        extra["mega_export_ms_unchanged"] = mega["export_ms_unchanged"]
+        extra["mega_export_speedup"] = mega["export_speedup"]
+        extra["mega_columnar_identical"] = mega["columnar_identical"]
+        extra["mega_delta_encode_ms"] = mega["delta_encode_ms"]
+        extra["mega_micro_solve_ms"] = mega["micro_solve_ms"]
+        extra["mega_arrivals_per_sec"] = mega["arrivals_per_sec"]
+        extra["mega_arrivals_per_sec_host"] = mega[
+            "arrivals_per_sec_host"]
+        extra["mega_arrivals_speedup"] = mega["arrivals_speedup"]
     if relax_res is not None:
         # relaxed fast-path arm: solve-wall speedup over the exact lean
         # kernel, audited divergence rate through the 4-arm router, and

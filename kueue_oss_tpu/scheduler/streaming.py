@@ -76,14 +76,28 @@ from a batched one. The delta-session slot coordinates stay valid: a
 micro-admission just dirties its ExportCache row like any other store
 event, and the next full solve ships it as a normal dirty-row delta
 (no session reset, resident device tensors untouched).
+
+**Device micro-solve.** A watch-driven burst large enough to amortize
+a kernel dispatch (``micro_solve_min`` eligible entries across the
+streamable single-flavor CQs) is coalesced into ONE lean-kernel solve
+(``_drain_micro``): per-entry fences are re-checked host-side while
+building the batch, the export pins the window snapshot (so earlier
+streamed usage is visible), and the plan decodes through the same
+opt->flavor mapping as ``SolverEngine._apply_plan``. Small bursts and
+multi-flavor CQs keep the per-entry host FlavorAssigner walk — the
+small-burst path and the micro-solve's parity oracle
+(``KUEUE_STREAM_MICROSOLVE=0`` forces it everywhere).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
+
+import numpy as np
 
 from kueue_oss_tpu import metrics, obs
 from kueue_oss_tpu.core.queue_manager import _order_key
@@ -104,6 +118,14 @@ class MicroDrainResult:
     deferred_cqs: int = 0
     duration_s: float = 0.0
     admitted_keys: list[str] = field(default_factory=list)
+    #: entries routed through the device micro-solve (0 = host walk)
+    micro_batch: int = 0
+    micro_export_s: float = 0.0
+    micro_solve_s: float = 0.0
+    #: wall spent inside the engine commit core — identical work in
+    #: the host-walk and micro-solve arms (parity requires it), so
+    #: benches subtract it to compare the decision phases honestly
+    commit_s: float = 0.0
 
 
 #: human-readable fence explanations (tools/explain.py surfaces these
@@ -154,6 +176,24 @@ class StreamingAdmitter:
         #: admissions per drain() call — bounds one micro-batch's
         #: latency; the remainder stays in order for the next drain
         self.max_batch = max_batch
+        #: device micro-solve: coalesce a watch-driven burst into ONE
+        #: lean-kernel solve instead of per-entry host oracle walks.
+        #: The host walk stays as the small-burst path and the parity
+        #: oracle (KUEUE_STREAM_MICROSOLVE=0 forces it everywhere).
+        self.micro_solve = os.environ.get(
+            "KUEUE_STREAM_MICROSOLVE", "1") != "0"
+        #: bursts below this many pending entries stay on the host
+        #: walk — kernel dispatch overhead dominates tiny batches
+        self.micro_solve_min = int(os.environ.get(
+            "KUEUE_STREAM_MICROSOLVE_MIN", "64"))
+        #: sticky pow2 pad target for micro-solve exports (bounds
+        #: lean-kernel recompiles, same discipline as _pad_target)
+        self._micro_hwm = 0
+        #: subscribed ExportCache for micro-solve exports (memoized
+        #: row building); its columnar view is disabled — the micro
+        #: path always exports classic against the pinned window
+        #: snapshot
+        self._micro_cache = None
         #: a full solve must have completed since the last contending
         #: epoch before any micro-drain runs (the parity baseline)
         self.armed = False
@@ -483,6 +523,11 @@ class StreamingAdmitter:
         for name in pending:
             root = self._root_of.get(name, f"cq:{name}")
             by_root.setdefault(root, []).append(name)
+        #: streamable CQs this drain, routed by burst size: large
+        #: coalesced bursts go through ONE device micro-solve, small
+        #: ones (and every multi-flavor CQ) through the host walk
+        micro_cqs: list[tuple[str, str]] = []
+        host_cqs: list[tuple[str, str]] = []
         for root, names in by_root.items():
             if result.admitted + result.parked >= self.max_batch:
                 break
@@ -506,14 +551,19 @@ class StreamingAdmitter:
                 continue
             if self._root_streamable.get(root, False):
                 for name in names:
-                    if root in contended:
-                        break
                     if not self._static_eligible(name):
                         result.deferred_cqs += 1
                         self._note_structural(name, "ineligible")
                         continue
-                    if not self._drain_cq(name, root, now, result):
-                        contended.add(root)  # demoted mid-walk
+                    # single-flavor CQs may batch into the device
+                    # micro-solve; multi-flavor picks stay on the
+                    # host walk where the per-pick witness
+                    # (_pick_stable) guards their determinism
+                    if (self.micro_solve
+                            and not self._cq_multi_flavor(name)):
+                        micro_cqs.append((name, root))
+                    else:
+                        host_cqs.append((name, root))
                 continue
             # borrow-capable multi-CQ subtree: streams through the
             # merged-order reserved-headroom walk when every member
@@ -526,6 +576,25 @@ class StreamingAdmitter:
                     self._note_structural(name, "borrow_capable")
                 continue
             if not self._drain_root(root, names, now, result):
+                contended.add(root)  # demoted mid-walk
+        if micro_cqs:
+            total = sum(
+                len(q._in_heap) for q in
+                (self.queues.queues.get(n) for n, _ in micro_cqs)
+                if q is not None)
+            if total >= self.micro_solve_min:
+                self._drain_micro(micro_cqs, now, result, contended)
+            else:
+                # small burst: the per-entry host oracle walk is
+                # cheaper than a kernel dispatch (and doubles as the
+                # micro-solve's parity oracle)
+                host_cqs = micro_cqs + host_cqs
+        for name, root in host_cqs:
+            if root in contended:
+                continue
+            if result.admitted + result.parked >= self.max_batch:
+                break
+            if not self._drain_cq(name, root, now, result):
                 contended.add(root)  # demoted mid-walk
         if considered:
             metrics.stream_eligible_fraction.set(value=max(
@@ -762,6 +831,197 @@ class StreamingAdmitter:
                 reason_slug="stream_parked")
         return True
 
+    # -- device micro-solve ------------------------------------------------
+
+    def _micro_export_cache(self):
+        if self._micro_cache is None:
+            from kueue_oss_tpu.solver.tensors import ExportCache
+
+            self._micro_cache = ExportCache(self.store)
+            # micro exports always run classic against the pinned
+            # window snapshot; drop the columnar view so this cache
+            # only pays for memoized row building
+            self._micro_cache.columnar = None
+        return self._micro_cache
+
+    def _drain_micro(self, cqs: list[tuple[str, str]], now: float,
+                     result: MicroDrainResult,
+                     contended: set[str]) -> None:
+        """Batch a streamed burst into ONE lean-kernel micro-solve.
+
+        Every per-entry fence the host walk applies (out-of-order
+        floor, topology request, concurrent-admission variant) is
+        re-checked host-side while building the batch, and the
+        kernel's plan decodes through the same opt -> flavor mapping
+        as ``SolverEngine._apply_plan`` — so the committed store
+        state is bit-identical to walking the same entries through
+        the host FlavorAssigner (the small-burst path below
+        ``micro_solve_min``, which doubles as the parity oracle).
+        Multi-flavor CQs never route here; their picks keep the
+        per-pick witness on the host path. The export pins the window
+        snapshot, so usage from this window's earlier streamed
+        commits is visible to the kernel exactly as the host oracle
+        would see it, and no delta session is touched (no session
+        reset; the admissions dirty their ExportCache rows like any
+        other store event and ship as normal deltas next full solve).
+        """
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.solver.kernels import solve_backlog, to_device
+        from kueue_oss_tpu.solver.tensors import (
+            export_problem, pad_workloads,
+        )
+
+        t0 = time.perf_counter()
+        ca_gate = features.enabled("ConcurrentAdmission")
+        snap = self._window_snapshot()
+        budget = self.max_batch - (result.admitted + result.parked)
+        batch: dict[str, list[WorkloadInfo]] = {}
+        root_of_batch: dict[str, str] = {}
+        for name, root in cqs:
+            if budget <= 0:
+                break
+            if root in contended:
+                result.deferred_cqs += 1
+                continue
+            q = self.queues.queues.get(name)
+            if q is None or snap.cluster_queue(name) is None:
+                continue
+            floor = self._max_admitted.get(name)
+            infos: list[WorkloadInfo] = []
+            demoted = False
+            for info in q.snapshot_order():
+                if len(infos) >= budget:
+                    break
+                key = _order_key(info)
+                if floor is not None and key < floor:
+                    self._fence_event(info.key, name, "out_of_order")
+                    self._contend(name, "out_of_order")
+                    contended.add(root)
+                    demoted = True
+                    break
+                wl = self.store.workloads.get(info.key)
+                if wl is None or wl.is_quota_reserved or not wl.active:
+                    continue
+                if any(ps.topology_request is not None
+                       for ps in wl.podsets):
+                    self._fence_event(info.key, name, "unsupported",
+                                      {"check": "topology_request"})
+                    self._contend(name, "unsupported")
+                    contended.add(root)
+                    demoted = True
+                    break
+                if ca_gate and wl.parent_workload is not None:
+                    self._fence_event(info.key, name, "unsupported",
+                                      {"check": "concurrent_admission"})
+                    self._contend(name, "unsupported")
+                    contended.add(root)
+                    demoted = True
+                    break
+                infos.append(info)
+            if demoted or not infos:
+                continue
+            batch[name] = infos
+            root_of_batch[name] = root
+            budget -= len(infos)
+        if not batch:
+            return
+        problem = export_problem(
+            self.store, batch, snapshot=snap, now=now,
+            cache=self._micro_export_cache(), columnar=False)
+        W = problem.n_workloads
+        if not W:
+            return
+        target = 1 << max(6, (W - 1).bit_length())
+        self._micro_hwm = max(self._micro_hwm, target)
+        padded = pad_workloads(problem, self._micro_hwm)
+        t1 = time.perf_counter()
+        out = solve_backlog(to_device(padded))
+        admitted, opt, admit_round, parked = (
+            np.asarray(a) for a in out[:4])
+        t2 = time.perf_counter()
+        result.micro_batch += W
+        result.micro_export_s += t1 - t0
+        result.micro_solve_s += t2 - t1
+        self._commit_plan(padded, admitted, opt, admit_round, parked,
+                          root_of_batch, now, result)
+
+    def _commit_plan(self, problem, admitted, opt, admit_round,
+                     parked, root_of_batch: dict[str, str],
+                     now: float, result: MicroDrainResult) -> None:
+        """Decode and commit the micro-solve plan — the streaming
+        twin of ``SolverEngine._apply_plan``: admissions in (round,
+        entry) order through the engine commit, kernel park decisions
+        mirrored into the heaps, StrictFIFO blocked heads untouched."""
+        W = problem.n_workloads
+        adm = np.nonzero(admitted[:-1])[0]
+        order = adm[np.argsort(admit_round[adm], kind="stable")]
+        declared_of: dict[str, set] = {}
+        for w in order:
+            key = problem.wl_keys[w]
+            if not key:
+                continue
+            name = problem.cq_names[problem.wl_cqid[w]]
+            root = root_of_batch.get(name)
+            if root is None:
+                continue
+            with self._mu:
+                # live fence re-check per commit: a controller thread
+                # may have contended this root mid-solve — committing
+                # past that point would stream into state the batch
+                # oracle would re-order
+                if root in self._contended_roots or not self.armed:
+                    continue
+            wl = self.store.workloads.get(key)
+            if wl is None or wl.is_quota_reserved or not wl.active:
+                continue
+            flavor = problem.cq_option_flavors[name][opt[w]]
+            info = WorkloadInfo(wl, cluster_queue=name)
+            flavor_of = {r: flavor for psr in info.total_requests
+                         for r in psr.requests}
+            declared = declared_of.get(name)
+            if declared is None:
+                declared = {
+                    r for rg in
+                    self.store.cluster_queues[name].resource_groups
+                    for r in rg.covered_resources}
+                declared_of[name] = declared
+            usage: dict[tuple[str, str], int] = {}
+            for psr in info.total_requests:
+                for r, qty in psr.requests.items():
+                    if r not in declared:
+                        continue  # QuotaCheckStrategy=IgnoreUndeclared
+                    fr = (flavor, r)
+                    usage[fr] = usage.get(fr, 0) + qty
+            self._commit_entry(wl, name, info, flavor_of, usage,
+                               now, result)
+            key_o = _order_key(info)
+            prev = self._max_admitted.get(name)
+            if prev is None or key_o > prev:
+                self._max_admitted[name] = key_o
+        for w in np.nonzero(parked[:W])[0]:
+            key = problem.wl_keys[w]
+            if not key:
+                continue
+            name = problem.cq_names[problem.wl_cqid[w]]
+            root = root_of_batch.get(name)
+            if root is None:
+                continue
+            with self._mu:
+                if root in self._contended_roots or not self.armed:
+                    continue
+            q = self.queues.queues.get(name)
+            if q is None:
+                continue
+            q.park(key)
+            result.parked += 1
+            obs.recorder.record(
+                obs.SKIPPED, key, cycle=self._cycle(),
+                cluster_queue=name, path=obs.STREAM,
+                reason="parked inadmissible by the streaming fast "
+                       "path: no flavor option fits at current "
+                       "capacity",
+                reason_slug="stream_parked")
+
     # -- wide-fence support: witness, headroom, explain events -------------
 
     def _pick_stable(self, name: str, wl, cq_snap, snap,
@@ -896,21 +1156,32 @@ class StreamingAdmitter:
         for psa in assignment.podsets:
             for r, rec in psa.flavors.items():
                 flavor_of[r] = rec.name
+        self._commit_entry(wl, name, info, flavor_of,
+                           dict(assignment.usage_quota), now, result)
+
+    def _commit_entry(self, wl, name: str, info: WorkloadInfo,
+                      flavor_of: dict[str, str],
+                      usage_quota: dict, now: float,
+                      result: MicroDrainResult) -> None:
+        """Engine commit shared by the host-walk (assignment-decoded)
+        and micro-solve (plan-decoded) paths."""
         drain_result = _EngineResultAdapter()
         self.engine._drain_cycle = self._cycle()
         self.engine.last_drain_arm = "stream"
         self._committing_thread = threading.get_ident()
+        t0 = time.perf_counter()
         try:
             self.engine._commit_admission(
                 wl, name, flavor_of, info, now, drain_result)
         finally:
             self._committing_thread = None
+            result.commit_s += time.perf_counter() - t0
         # keep the window snapshot current so the next entry's fit
         # check sees this admission's usage (the kernel's in-round
         # usage refresh, host-side)
         cq_snap = self._snap.cluster_queue(name)
         if cq_snap is not None:
-            cq_snap.add_usage(dict(assignment.usage_quota))
+            cq_snap.add_usage(dict(usage_quota))
         result.admitted += drain_result.admitted
         result.admitted_keys.extend(drain_result.admitted_keys)
         metrics.stream_admitted_total.inc(by=drain_result.admitted)
@@ -919,14 +1190,20 @@ class StreamingAdmitter:
         ledger = obs.cycle_ledger
         if not ledger.enabled:
             return
+        phases = {"stream": round(result.duration_s, 6)}
+        detail: dict = {"deferredCqs": result.deferred_cqs}
+        if result.micro_batch:
+            phases["micro_export"] = round(result.micro_export_s, 6)
+            phases["micro_solve"] = round(result.micro_solve_s, 6)
+            detail["microBatch"] = result.micro_batch
         ledger.record(
             self._cycle(), obs.STREAM_DRAIN,
             breaker=obs.breaker_state_name(),
             duration_s=result.duration_s,
-            phases={"stream": round(result.duration_s, 6)},
+            phases=phases,
             admitted=result.admitted, parked=result.parked,
             solver_arm="stream",
-            detail={"deferredCqs": result.deferred_cqs})
+            detail=detail)
 
     def consume_full_solve_request(self) -> bool:
         """True at most once per spec-change fence: drain() observed a
@@ -951,6 +1228,8 @@ class StreamingAdmitter:
                     "specGen": gen, "armedGen": self._armed_gen,
                     "dirtyKeys": len(keys), "dirtyCqs": len(cqs),
                     "microDrains": self.micro_drains,
+                    "microSolve": self.micro_solve,
+                    "microSolveMin": self.micro_solve_min,
                     "mergedRoots": sorted(
                         r for r, ok in self._root_merge_ok.items()
                         if ok),

@@ -398,6 +398,14 @@ class HostDeltaSession:
     content, so they are separate sessions on the wire too.
     """
 
+    #: W-axis fields copied straight from the export row in the hint
+    #: fast path — everything except the session-stable re-derivations
+    #: (wl_ts/wl_ts_buf/wl_admit_rank/wl_class come from the rankers)
+    _FAST_DIRECT = (
+        "wl_cqid", "wl_rank", "wl_prio", "wl_uid", "wl_req", "wl_valid",
+        "wl_parked0", "wl_admitted0", "wl_evicted0", "ad_usage",
+        "wl_lq", "wl_afs_penalty")
+
     def __init__(self, cache=None,
                  neutral_fields: tuple[str, ...] = ()) -> None:
         #: optional ExportCache: per-workload/per-CQ dirty sets feed the
@@ -430,6 +438,19 @@ class HostDeltaSession:
         #: interleave-change RESYNCs actually taken (epoch migrations)
         self.migrations = 0
         self._rr_cursor = 0
+        #: columnar-hint fast path state: the previous slotted problem
+        #: (its arrays alias ``_last``'s, so in-place row scatters keep
+        #: both views coherent), the last consumed assembly seq, and
+        #: the chained cheap checksum
+        self._last_slotted: Optional[SolverProblem] = None
+        self._hint_seq: Optional[int] = None
+        #: when True (engine sets it on the LOCAL path only — no remote
+        #: sidecar will recompute state_checksum), fast-path frames
+        #: carry a chained checksum over the delta payload instead of
+        #: an O(W) crc over the full state
+        self.cheap_checksum = False
+        self._fast_crc = 0
+        self.fast_advances = 0
 
     # -- slot assignment ---------------------------------------------------
 
@@ -531,8 +552,30 @@ class HostDeltaSession:
 
     # -- the per-drain step ------------------------------------------------
 
-    def advance(self, problem: SolverProblem
+    def advance(self, problem: SolverProblem, hint=None
                 ) -> tuple[SolverProblem, SessionFrame]:
+        """Re-encode ``problem`` into slot space and emit its frame.
+
+        ``hint`` is the export's ``ColumnarHint`` (solver/columnar.py)
+        when the problem came off the columnar scatter/cached path: a
+        contiguous-seq hint whose membership did not change lets the
+        session scatter just the changed rows into the previous slotted
+        encoding — O(dirty) instead of the O(W) permute + content diff.
+        Every precondition failure falls back to the classic path,
+        which diffs actual array content and is therefore always
+        correct regardless of how far the fast path got.
+        """
+        if hint is not None and not hint.membership_changed:
+            fast = self._advance_fast(problem, hint)
+            if fast is not None:
+                self._hint_seq = hint.seq
+                return fast
+        out = self._advance_classic(problem)
+        self._hint_seq = hint.seq if hint is not None else None
+        return out
+
+    def _advance_classic(self, problem: SolverProblem
+                         ) -> tuple[SolverProblem, SessionFrame]:
         full_reason = None
         W = problem.n_workloads
         keys = list(problem.wl_keys)
@@ -594,6 +637,7 @@ class HostDeltaSession:
             full_reason = "first_sync"
         self._last = (kwargs, meta)
         self._last_keys = keys
+        self._last_slotted = slotted
         if delta is None:
             self.full_syncs += 1
         else:
@@ -601,6 +645,222 @@ class HostDeltaSession:
         return slotted, SessionFrame(epoch=self.epoch, checksum=checksum,
                                      delta=delta,
                                      full_reason=full_reason, stats=stats)
+
+    # -- columnar-hint O(dirty) advance ------------------------------------
+
+    def _advance_fast(self, problem: SolverProblem, hint
+                      ) -> Optional[tuple[SolverProblem, SessionFrame]]:
+        """Scatter the hint's changed rows straight into the previous
+        slotted encoding. Returns None when any precondition fails; the
+        ranker registrations it may have done before bailing are
+        harmless (the classic path re-registers idempotently and diffs
+        actual content, so a renumber mid-bail just rides the diff)."""
+        from kueue_oss_tpu import features
+        from kueue_oss_tpu.scheduler.preemption import (
+            TIMESTAMP_PREEMPTION_BUFFER_S,
+        )
+
+        prev = self._last_slotted
+        if (prev is None or self._last is None or not self.epoch
+                or self._hint_seq is None
+                or hint.base_seq != self._hint_seq
+                or problem.n_workloads != self._capacity
+                or self._pending_interleave is not None):
+            return None
+        active = len(self._slots)
+        cap = max(4096, 4 * active)
+        if self._ts.size > cap or self._admit.size > cap:
+            return None  # classic path prunes the rankers (full sync)
+        kwargs, meta = self._last
+        if (int(problem.scale) != meta["scale"]
+                or int(problem.n_resources) != meta["n_resources"]):
+            return None
+        ckeys = list(hint.changed)
+        slots = np.empty(len(ckeys), dtype=np.int64)
+        rows = np.empty(len(ckeys), dtype=np.int64)
+        for i, k in enumerate(ckeys):
+            s = self._slots.get(k)
+            if s is None:
+                return None
+            slots[i] = s
+            rows[i] = hint.changed[k]
+        if rows.size and int(rows.max()) >= problem.n_workloads:
+            return None
+
+        # new raw timestamps register into the rankers before anything
+        # mutates: a renumber moves OTHER rows' ranks, and under the
+        # preemption-buffer gate even a plain registry growth can move
+        # other rows' buffered ranks — both degrade to classic
+        new_raw = np.ascontiguousarray(problem.wl_raw_ts[rows])
+        gate = features.enabled("SchedulerTimestampPreemptionBuffer")
+        ts_size0 = self._ts.size
+        if self._ts.update(new_raw):
+            return None
+        if gate and active and self._ts.size != ts_size0:
+            return None
+        new_adm = np.ascontiguousarray(problem.wl_admitted0[rows])
+        new_raw_admit = np.ascontiguousarray(
+            problem.wl_raw_admit_ts[rows])
+        if new_adm.any() and self._admit.update(new_raw_admit[new_adm]):
+            return None
+        new_tok = np.ascontiguousarray(problem.wl_class_tok[rows])
+        root = problem.class_tok_root
+        max_tok = int(new_tok.max()) if new_tok.size else -1
+        if root is not None:
+            max_tok = max(max_tok, len(root) - 1)
+        if pow2(max_tok + 2) > self._class_cs:
+            return None  # class space must grow: shapes change
+        for name in NON_W_FIELDS:
+            if name == "class_root":
+                continue  # session-derived, handled below
+            a, b = kwargs.get(name), getattr(problem, name)
+            if (a is None) != (b is None):
+                return None
+            if a is not None and (a.shape != np.shape(b)
+                                  or a.dtype != np.asarray(b).dtype):
+                return None
+
+        # -- all preconditions hold; mutate the resident encoding. The
+        # kwargs arrays alias the slotted problem's, so one scatter
+        # updates the wire state and the returned problem together.
+        row_updates: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+
+        def scatter(name: str, new_vals: np.ndarray) -> None:
+            arr = kwargs.get(name)
+            if arr is None or not slots.size:
+                return
+            old_vals = arr[slots]
+            neq = old_vals != new_vals
+            if neq.ndim > 1:
+                neq = neq.reshape(len(ckeys), -1).any(axis=1)
+            if not neq.any():
+                return
+            sub = np.nonzero(neq)[0]
+            arr[slots[sub]] = new_vals[sub]
+            row_updates[name] = (slots[sub].astype(np.int32),
+                                 np.ascontiguousarray(new_vals[sub]))
+
+        for name in self._FAST_DIRECT:
+            if name in self.neutral_fields:
+                continue
+            src = getattr(problem, name)
+            if src is None:
+                continue
+            scatter(name, np.ascontiguousarray(src[rows]))
+
+        if slots.size:
+            new_ts = self._ts.rank(new_raw).astype(np.int32)
+            scatter("wl_ts", new_ts)
+            if gate:
+                scatter("wl_ts_buf", self._ts.rank_before(
+                    new_raw
+                    + TIMESTAMP_PREEMPTION_BUFFER_S).astype(np.int32))
+            else:
+                scatter("wl_ts_buf", new_ts)
+            ar = np.zeros(len(ckeys), dtype=np.int32)
+            if new_adm.any():
+                ar[new_adm] = (self._admit.rank(new_raw_admit[new_adm])
+                               + 1).astype(np.int32)
+            scatter("wl_admit_rank", ar)
+            scatter("wl_class", np.where(
+                new_tok >= 0, new_tok,
+                self._class_cs - 1).astype(np.int32))
+            prev.wl_raw_ts[slots] = new_raw
+            prev.wl_raw_admit_ts[slots] = new_raw_admit
+            prev.wl_class_tok[slots] = new_tok
+
+        repl: dict[str, np.ndarray] = {}
+        cs = self._class_cs
+        class_root = np.full(cs, problem.n_nodes, dtype=np.int32)
+        if root is not None and len(root):
+            class_root[:len(root)] = root
+        if not np.array_equal(kwargs["class_root"], class_root):
+            repl["class_root"] = class_root
+            kwargs["class_root"] = class_root
+            prev.class_root = class_root
+        for name in NON_W_FIELDS:
+            if name == "class_root":
+                continue
+            a, b = kwargs.get(name), getattr(problem, name)
+            if a is None or np.array_equal(a, b):
+                continue
+            repl[name] = np.ascontiguousarray(b)
+            kwargs[name] = repl[name]
+            setattr(prev, name, repl[name])
+        if root is not None:
+            prev.class_tok_root = root
+
+        meta_delta: dict[str, int] = {}
+        new_meta = {"n_resources": int(problem.n_resources),
+                    "scale": int(problem.scale),
+                    "ts_evict_base": self._ts.max + 1,
+                    "admit_rank_base": self._admit.max + 2}
+        for k in META_FIELDS:
+            if meta[k] != new_meta[k]:
+                meta_delta[k] = new_meta[k]
+                meta[k] = new_meta[k]
+        prev.ts_evict_base = new_meta["ts_evict_base"]
+        prev.admit_rank_base = new_meta["admit_rank_base"]
+        # host-only scalars ride the export (n_classes and friends can
+        # move without any wire array changing); the session-derived
+        # rank bases above are the only scalars the session owns
+        for f in dataclasses.fields(problem):
+            if f.name in ("ts_evict_base", "admit_rank_base"):
+                continue
+            val = getattr(problem, f.name)
+            if isinstance(val, (bool, int, float, np.integer,
+                                np.floating)):
+                setattr(prev, f.name, val)
+
+        self.epoch += 1
+        if self.cheap_checksum:
+            checksum = self._delta_checksum(row_updates, repl,
+                                            meta_delta)
+        else:
+            checksum = state_checksum(kwargs, meta)
+        stats = self._drain_stats_fast(len(ckeys))
+        delta = ProblemDelta(epoch=self.epoch, base_epoch=self.epoch - 1,
+                             checksum=checksum, row_updates=row_updates,
+                             repl=repl, meta_delta=meta_delta,
+                             stats=stats)
+        self.delta_syncs += 1
+        self.fast_advances += 1
+        return prev, SessionFrame(epoch=self.epoch, checksum=checksum,
+                                  delta=delta, full_reason=None,
+                                  stats=stats)
+
+    def _delta_checksum(self, row_updates: dict, repl: dict,
+                        meta_delta: dict) -> int:
+        """Chained cheap checksum over the delta payload (local-path
+        only): NOT comparable with ``state_checksum`` — the engine
+        enables it only when no remote sidecar will verify frames, so a
+        1M-row session does not pay an O(W) crc per drain."""
+        crc = zlib.crc32(f"{self.epoch}|{self._fast_crc}".encode())
+        for name in sorted(row_updates):
+            idx, vals = row_updates[name]
+            crc = zlib.crc32(name.encode(), crc)
+            crc = zlib.crc32(np.ascontiguousarray(idx).tobytes(), crc)
+            crc = zlib.crc32(np.ascontiguousarray(vals).tobytes(), crc)
+        for name in sorted(repl):
+            crc = zlib.crc32(name.encode(), crc)
+            crc = zlib.crc32(
+                np.ascontiguousarray(repl[name]).tobytes(), crc)
+        crc = zlib.crc32(json.dumps(
+            {k: int(v) for k, v in sorted(meta_delta.items())}).encode(),
+            crc)
+        self._fast_crc = crc & 0xFFFFFFFF
+        return self._fast_crc
+
+    def _drain_stats_fast(self, n_changed: int) -> dict:
+        stats = {"removed_keys": 0, "added_keys": 0,
+                 "fast_rows": n_changed}
+        if self.cache is not None:
+            stats["dirty_workloads"] = len(self.cache.dirty_keys)
+            stats["dirty_cqs"] = len(self.cache.dirty_cqs)
+            stats["events"] = self.cache.events_seen - self._event_mark
+            self._event_mark = self.cache.events_seen
+            self.cache.consume_dirty()
+        return stats
 
     def last_sync_wire_bytes(self) -> int:
         """Wire payload of the most recent full-sync state — the

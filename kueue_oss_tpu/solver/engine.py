@@ -509,14 +509,21 @@ class SolverEngine:
         if self.needs_full_kernel(pending):
             return self._drain_full(now, verify=verify, pending=pending)
         result = DrainResult()
+        self._drain_phases = {}
+        te = time.monotonic()
         problem, pending = self.export(pending)
+        self._note_export_phase(time.monotonic() - te)
         if problem.n_workloads == 0:
             return result
+        # pad_workloads rebuilds the dataclass, so the columnar hint
+        # must be captured off the unpadded export (real-row positions
+        # survive padding; the hint's row indices stay valid)
+        hint = getattr(problem, "_columnar_hint", None)
         n_live = problem.n_workloads
         self._pad_hwm = max(self._pad_hwm,
                             pow2(max(problem.n_workloads, self.pad_to)))
         problem = pad_workloads(problem, self._pad_target())
-        problem, frame = self._session_encode("lean", problem)
+        problem, frame = self._session_encode("lean", problem, hint=hint)
         dev0 = self._device_totals()
 
         t0 = time.monotonic()
@@ -594,12 +601,19 @@ class SolverEngine:
                     frame_bytes = sess_obj.last_sync_wire_bytes()
         arm = ("remote" if self.remote is not None
                else (self.last_drain_arm or "single"))
+        phases = {"solve": round(result.solver_time_s, 6),
+                  "apply": round(result.apply_time_s, 6)}
+        # export/encode/device_put walls + the columnar walk/scatter
+        # split, accumulated by _note_export_phase/_session_encode/
+        # _local_tensors over this drain
+        for k, v in (getattr(self, "_drain_phases", None) or {}).items():
+            phases[k] = round(v, 6)
+        session.update(getattr(self, "_export_stats", None) or {})
         ledger.record(
             self._drain_cycle, obs.SOLVER_DRAIN,
             breaker=obs.breaker_state_name(),
             duration_s=result.solver_time_s + result.apply_time_s,
-            phases={"solve": round(result.solver_time_s, 6),
-                    "apply": round(result.apply_time_s, 6)},
+            phases=phases,
             admitted=result.admitted, evicted=result.evicted,
             parked=parked_n, rounds=result.rounds, solver_arm=arm,
             frame_kind=frame_kind, frame_bytes=frame_bytes,
@@ -985,7 +999,31 @@ class SolverEngine:
         self._delta_sessions.clear()
         self._device_states.clear()
 
-    def _session_encode(self, kind: str, problem: SolverProblem):
+    def _note_export_phase(self, wall_s: float) -> None:
+        """Fold one export's wall + the columnar view's walk/scatter
+        split and dirty-row counts into this drain's phase breakdown
+        (ledger satellite: export cost must be attributable)."""
+        phases = getattr(self, "_drain_phases", None)
+        if phases is None:
+            phases = self._drain_phases = {}
+        phases["export"] = phases.get("export", 0.0) + wall_s
+        col = getattr(self.export_cache, "columnar", None)
+        stats = getattr(col, "last_stats", None) or {}
+        if stats:
+            phases["export_walk"] = (phases.get("export_walk", 0.0)
+                                     + stats.get("walk_s", 0.0))
+            phases["export_scatter"] = (
+                phases.get("export_scatter", 0.0)
+                + stats.get("scatter_s", 0.0))
+            self._export_stats = {
+                "export_mode": stats.get("mode", ""),
+                "export_dirty_rows": int(stats.get("dirty_rows", 0)),
+                "export_rows": int(stats.get("rows", 0))}
+        else:
+            self._export_stats = {}
+
+    def _session_encode(self, kind: str, problem: SolverProblem,
+                        hint=None):
         """Stable slot/rank re-encoding + the SessionFrame to ship.
 
         Returns (problem, None) with sessions disabled — the drain then
@@ -1019,7 +1057,16 @@ class SolverEngine:
                     if self.remote is not None else 0)
         sess.set_interleave(remote_w if remote_w > 1
                             else mesh_devices(self._mesh()))
-        slotted, frame = sess.advance(problem)
+        # no sidecar will recompute state_checksum over frames on the
+        # local path, so fast-path frames may carry the cheap chained
+        # checksum instead of an O(W) crc per drain
+        sess.cheap_checksum = self.remote is None
+        t0 = time.monotonic()
+        slotted, frame = sess.advance(problem, hint=hint)
+        phases = getattr(self, "_drain_phases", None)
+        if phases is not None:
+            phases["encode"] = (phases.get("encode", 0.0)
+                                + time.monotonic() - t0)
         if frame is not None and frame.full_reason == "interleave_migration":
             metrics.solver_resync_total.inc("interleave_migration")
         return slotted, frame
@@ -1033,6 +1080,18 @@ class SolverEngine:
         resident state lives sharded over the ``wl`` axis; mesh and
         single-chip arms keep separate resident copies so arm flips
         cannot corrupt each other's donated buffers."""
+        t0 = time.monotonic()
+        try:
+            return self._local_tensors_inner(problem, frame, full=full,
+                                             mesh=mesh)
+        finally:
+            phases = getattr(self, "_drain_phases", None)
+            if phases is not None:
+                phases["device_put"] = (phases.get("device_put", 0.0)
+                                        + time.monotonic() - t0)
+
+    def _local_tensors_inner(self, problem: SolverProblem, frame, *,
+                             full: bool, mesh=None):
         if frame is None:
             if full:
                 from kueue_oss_tpu.solver.full_kernels import (
@@ -1513,19 +1572,23 @@ class SolverEngine:
                              for ps in i.obj.podsets)]
             if infos:
                 parked_map[name] = infos
+        self._drain_phases = {}
+        te = time.monotonic()
         problem = export_problem(self.store, pending,
                                  include_admitted=True, parked=parked_map,
                                  afs=self.queues.afs, now=now,
                                  cache=self.export_cache)
+        self._note_export_phase(time.monotonic() - te)
         if problem.n_workloads == 0:
             return result
+        hint = getattr(problem, "_columnar_hint", None)
         g_max = int(problem.cq_ngroups.max())
         h_max, p_max = self._size_caps(problem)
         n_live = problem.n_workloads
         self._pad_hwm = max(self._pad_hwm,
                             pow2(max(problem.n_workloads, self.pad_to)))
         problem = pad_workloads(problem, self._pad_target())
-        problem, frame = self._session_encode("full", problem)
+        problem, frame = self._session_encode("full", problem, hint=hint)
         dev0 = self._device_totals()
 
         t0 = time.monotonic()

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -408,8 +409,18 @@ class ExportCache:
         self.dirty_keys: set[str] = set()
         self.dirty_cqs: set[str] = set()
         self.events_seen = 0
+        #: incremental columnar assembly view (solver/columnar.py). Only
+        #: subscribed caches get one: an unsubscribed cache never sees
+        #: invalidation events, so its columns could go silently stale.
+        self.columnar = None
         if subscribe:
             store.watch(self._on_event)
+            import os
+
+            if os.environ.get("KUEUE_COLUMNAR_EXPORT", "1") != "0":
+                from kueue_oss_tpu.solver.columnar import ColumnarStore
+
+                self.columnar = ColumnarStore(self)
 
     def _on_event(self, event) -> None:
         verb, kind, obj = event
@@ -417,6 +428,8 @@ class ExportCache:
         if kind == "Workload":
             self.rows.pop(obj.key, None)
             self.dirty_keys.add(obj.key)
+            if self.columnar is not None:
+                self.columnar.note_dirty(obj.key)
             lq = self.store.local_queues.get(
                 f"{obj.namespace}/{obj.queue_name}")
             if lq is not None:
@@ -600,6 +613,22 @@ class ExportCache:
         return sid
 
 
+def order_nodes(forest) -> list:
+    """Cohort-forest nodes in parents-first BFS order — THE node axis
+    ordering every export (classic and columnar) shares. A deque keeps
+    the traversal O(n); the previous list ``pop(0)`` was O(n²), which
+    showed up at 10k-CQ cohort forests."""
+    nodes = []
+    queue: deque = deque()
+    for root in forest.roots():
+        queue.append(root)
+        while queue:
+            n = queue.popleft()
+            nodes.append(n)
+            queue.extend(n.children.values())
+    return nodes
+
+
 def export_problem(
     store: Store,
     pending: dict[str, list[WorkloadInfo]],
@@ -609,6 +638,7 @@ def export_problem(
     afs=None,
     now: float = 0.0,
     cache: Optional[ExportCache] = None,
+    columnar: bool = True,
 ) -> SolverProblem:
     """Build a SolverProblem from the store and the pending backlog.
 
@@ -624,17 +654,23 @@ def export_problem(
     (per-podset topology groups) so the caller can fall back to the
     oracle.
     """
+    # Columnar fast path (solver/columnar.py): when the cache carries a
+    # ColumnarStore and the caller did not pin an out-of-band snapshot,
+    # assemble the problem from incrementally-maintained columns instead
+    # of the per-row walk below. The columnar view bails (returns None)
+    # on anything it cannot prove bit-identical — AFS-active exports,
+    # first build, vocabulary changes — and this classic walk runs.
+    col = getattr(cache, "columnar", None) if cache is not None else None
+    if col is not None and snapshot is None and columnar:
+        out = col.export(pending, include_admitted=include_admitted,
+                         parked=parked, afs=afs, now=now)
+        if out is not None:
+            return out
+
     snapshot = snapshot or build_snapshot(store)
     forest = snapshot.forest
 
-    # ---- node ordering: parents-first (BFS from roots) -------------------
-    nodes = []
-    for root in forest.roots():
-        stack = [root]
-        while stack:
-            n = stack.pop(0)
-            nodes.append(n)
-            stack.extend(n.children.values())
+    nodes = order_nodes(forest)
     index = {id(n): i for i, n in enumerate(nodes)}
     n_nodes = len(nodes)
     null = n_nodes

@@ -69,6 +69,11 @@ def pytest_configure(config):
         "return byte-identical plans to the single-process run; "
         "deterministic, runs in tier-1")
     config.addinivalue_line(
+        "markers", "megascale: million-workload control-plane scale "
+        "tests (solver/columnar.py + solver/delta.py): the 1M x 10k "
+        "columnar export/delta pipeline; paired with slow — tier-1 "
+        "runs the 50k x 1k smoke instead")
+    config.addinivalue_line(
         "markers", "slo: cluster health layer tests (obs/ledger.py + "
         "obs/health.py): virtual-clock burn-rate sequences, starvation "
         "watchdog, exemplar round-trips, ledger joins, and the "

@@ -280,6 +280,98 @@ class TestOracleParity:
 
 
 # ---------------------------------------------------------------------------
+# device micro-solve: coalesced bursts through the lean kernel
+# ---------------------------------------------------------------------------
+
+
+def _micro_burst_script(seed, windows=3, per_window=40):
+    """Bursts large enough to engage the device path, with no-fit
+    sizes (parks), StrictFIFO blocking, priorities, and a
+    borrow-capable cohort member mixed in."""
+    rng = random.Random(seed)
+    uid = 10
+    script = []
+    for _ in range(windows):
+        window = []
+        for _ in range(per_window):
+            window.append((rng.choice(["a", "a", "b", "c", "s", "d"]),
+                           f"w{uid}", uid,
+                           rng.choice([200, 500, 900, 4_000]),
+                           rng.choice([0, 0, 3])))
+            uid += 1
+        script.append(window)
+    return script
+
+
+def _run_micro_twin(script, micro):
+    cqs, cohorts = _parity_topology()
+    cqs.append(make_cq("s", 3_000,
+                       strategy=QueueingStrategy.STRICT_FIFO))
+    store = build_store(cqs, cohorts)
+    _qm, sched, eng = _make_sched(store, streaming=True)
+    eng.drain(now=99.0, verify=True)
+    sa = sched._streaming_admitter()
+    sa.micro_solve = micro
+    sa.micro_solve_min = 1  # every burst through the device path
+    assert sa.armed
+    dumps = []
+    micro_entries = 0
+    for k, window in enumerate(script):
+        now = 100.0 + k
+        for cq, name, uid, cpu, prio in window:
+            submit(store, name, cq, 10.0 + uid, uid, cpu=cpu,
+                   prio=prio)
+        res = sched.micro_drain(now)
+        micro_entries += res.micro_batch
+        dumps.append(canonical_dump(store))
+        eng.drain(now=now, verify=True)
+        dumps.append(canonical_dump(store))
+    return dumps, micro_entries
+
+
+class TestMicroSolveParity:
+    @pytest.mark.parametrize("seed", [7, 23])
+    def test_device_path_bit_identical_to_host_walk(self, seed):
+        """The coalesced lean-kernel micro-solve must leave the store
+        byte-identical to the per-entry host FlavorAssigner walk —
+        after every micro-drain AND at every full-solve boundary."""
+        script = _micro_burst_script(seed)
+        micro_dumps, micro_n = _run_micro_twin(script, micro=True)
+        host_dumps, host_n = _run_micro_twin(script, micro=False)
+        assert micro_n > 0, "device path never engaged"
+        assert host_n == 0, "host twin used the device path"
+        for k, (m, h) in enumerate(zip(micro_dumps, host_dumps)):
+            assert m == h, f"seed {seed}: diverged at dump {k}"
+
+    def test_small_bursts_stay_on_host_walk(self):
+        store = build_store([make_cq("a", 10_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=99.0, verify=True)
+        sa = sched._streaming_admitter()
+        assert sa.micro_solve and sa.micro_solve_min > 2
+        submit(store, "w1", "a", 1.0, 1)
+        submit(store, "w2", "a", 2.0, 2)
+        res = sched.micro_drain(100.0)
+        assert res.admitted == 2 and res.micro_batch == 0
+
+    def test_micro_ledger_phases(self):
+        store = build_store([make_cq("a", 100_000)])
+        _qm, sched, eng = _make_sched(store, streaming=True)
+        eng.drain(now=99.0, verify=True)
+        sa = sched._streaming_admitter()
+        sa.micro_solve_min = 1
+        for i in range(8):
+            submit(store, f"w{i}", "a", 1.0 + i, 10 + i, cpu=100)
+        res = sched.micro_drain(100.0)
+        assert res.admitted == 8 and res.micro_batch == 8
+        row = obs.cycle_ledger.last_row(obs.STREAM_DRAIN)
+        assert row is not None
+        assert row.detail["microBatch"] == 8
+        assert "micro_solve" in row.phases
+        assert "micro_export" in row.phases
+
+
+# ---------------------------------------------------------------------------
 # contention fences
 # ---------------------------------------------------------------------------
 

@@ -598,3 +598,83 @@ def test_viz_round5_resource_views():
         assert "AdmissionChecks" in html and "Topologies" in html
     finally:
         srv.stop()
+
+
+# -- bench JSON-tail schema guard (tools/benchcheck.py) ----------------------
+
+
+def _mega_tail(**over):
+    tail = {
+        "scenario": "megascale", "workloads": 50000, "cqs": 1000,
+        "pending": 50000, "export_ms": 800.0,
+        "export_walk_warm_ms": 200.0,
+        "export_columnar_build_ms": 190.0, "export_ms_unchanged": 0.5,
+        "export_speedup": 1600.0, "export_speedup_warm": 400.0,
+        "export_mode_unchanged": "cached", "columnar_identical": True,
+        "churn_rows": 4096, "export_churn_ms": 120.0,
+        "export_churn_mode": "scatter", "export_churn_dirty_rows": 4096,
+        "delta_encode_ms": 8.0, "delta_frame": "delta", "burst": 8192,
+        "burst_cqs": 256, "micro_solve_ms": 40.0,
+        "micro_export_ms": 180.0, "stream_commit_ms_host": 800.0,
+        "stream_commit_ms_micro": 900.0, "stream_e2e_ms_host": 1600.0,
+        "stream_e2e_ms_micro": 1300.0, "arrivals_per_sec": 200000.0,
+        "arrivals_per_sec_host": 11000.0, "arrivals_speedup": 18.0,
+    }
+    tail.update(over)
+    return tail
+
+
+def test_benchcheck_valid_megascale_tail():
+    from tools.benchcheck import check
+
+    assert check(_mega_tail(), "megascale") == []
+    assert check(_mega_tail(), "megascale", strict=True) == []
+
+
+def test_benchcheck_flags_missing_and_mistyped_keys():
+    from tools.benchcheck import check
+
+    tail = _mega_tail()
+    del tail["arrivals_speedup"]
+    tail["export_ms"] = "fast"          # wrong type
+    tail["columnar_identical"] = 1      # int is not bool
+    tail["workloads"] = True            # bool is not int
+    errs = "\n".join(check(tail, "megascale"))
+    assert "missing key: arrivals_speedup" in errs
+    assert "export_ms: expected number, got str" in errs
+    assert "columnar_identical: expected bool" in errs
+    assert "workloads: expected int, got bool" in errs
+
+
+def test_benchcheck_strict_enforces_floors_and_modes():
+    from tools.benchcheck import check
+
+    bad = _mega_tail(arrivals_speedup=3.0, export_speedup=5.0,
+                     export_mode_unchanged="assemble",
+                     columnar_identical=False)
+    # shape-only validation still passes; --strict flags every floor
+    assert check(bad, "megascale") == []
+    errs = "\n".join(check(bad, "megascale", strict=True))
+    assert "arrivals_speedup" in errs and "export_speedup" in errs
+    assert "export_mode_unchanged" in errs
+    assert "columnar_identical" in errs
+
+
+def test_benchcheck_unknown_scenario_and_cli(tmp_path):
+    import io
+
+    from tools.benchcheck import check, main as bc_main
+
+    assert check({}, "nope") == ["unknown scenario 'nope' (known: "
+                                 "main, megascale)"]
+    path = tmp_path / "tail.json"
+    path.write_text("garbage first line\n"
+                    + json.dumps(_mega_tail()) + "\n")
+    buf = io.StringIO()
+    assert bc_main(["--json", str(path), "--strict"], out=buf) == 0
+    assert "tail valid" in buf.getvalue()
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"scenario": "megascale"}))
+    buf = io.StringIO()
+    assert bc_main(["--json", str(bad)], out=buf) == 1
+    assert "missing key" in buf.getvalue()

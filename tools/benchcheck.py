@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Schema guard for bench.py JSON tails.
+
+bench.py prints exactly one JSON line per run; downstream tooling (the
+perf trajectory, BENCH_r*.json archives) indexes those keys blind, so a
+silently renamed or dropped field turns a perf regression invisible.
+This validates a bench JSON tail against the declared schema: required
+keys present, types right, and the acceptance-bearing ratios sane.
+
+Usage:
+    python tools/benchcheck.py --json BENCH_r06.json
+    python bench.py --scenario megascale | \
+        python tools/benchcheck.py --scenario megascale
+    python tools/benchcheck.py --json out.json --strict   # floors too
+
+Exit status: 0 valid, 1 schema violation (messages on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+NUM = (int, float)
+
+#: scenario -> {key: expected type(s)}. Every listed key is REQUIRED in
+#: that scenario's tail; extra keys are always allowed (the tails grow).
+SCHEMAS = {
+    # the megascale scenario's budget tail (bench.py "megascale"):
+    # columnar export, delta encode, and the streamed-burst twin
+    "megascale": {
+        "scenario": str,
+        "workloads": int,
+        "cqs": int,
+        "pending": int,
+        "export_ms": NUM,
+        "export_walk_warm_ms": NUM,
+        "export_columnar_build_ms": NUM,
+        "export_ms_unchanged": NUM,
+        "export_speedup": NUM,
+        "export_speedup_warm": NUM,
+        "export_mode_unchanged": str,
+        "columnar_identical": bool,
+        "churn_rows": int,
+        "export_churn_ms": NUM,
+        "export_churn_mode": str,
+        "export_churn_dirty_rows": int,
+        "delta_encode_ms": NUM,
+        "delta_frame": str,
+        "burst": int,
+        "burst_cqs": int,
+        "micro_solve_ms": NUM,
+        "micro_export_ms": NUM,
+        "stream_commit_ms_host": NUM,
+        "stream_commit_ms_micro": NUM,
+        "stream_e2e_ms_host": NUM,
+        "stream_e2e_ms_micro": NUM,
+        "arrivals_per_sec": NUM,
+        "arrivals_per_sec_host": NUM,
+        "arrivals_speedup": NUM,
+    },
+    # the orchestrated run's headline tail (bench.py main): only the
+    # always-present core — optional scenarios may drop their fields
+    "main": {
+        "metric": str,
+        "value": NUM,
+        "unit": str,
+        "vs_baseline": NUM,
+        "preempt_drain_admissions_per_s": NUM,
+        "preempt_drain_decisions_per_s": NUM,
+        "cycle_ms_p50_50k_1k": NUM,
+        "cycle_ms_p99_50k_1k": NUM,
+        "platform": str,
+    },
+}
+
+#: --strict acceptance floors per scenario (the documented targets;
+#: soft-skipped otherwise so a smoke-shape tail still validates shape)
+FLOORS = {
+    "megascale": {
+        "export_speedup": 20.0,
+        "arrivals_speedup": 10.0,
+    },
+}
+
+#: exact-value requirements per scenario under --strict
+STRICT_EQ = {
+    "megascale": {
+        "columnar_identical": True,
+        "export_mode_unchanged": "cached",
+        "export_churn_mode": "scatter",
+        "delta_frame": "delta",
+    },
+}
+
+
+def check(tail: dict, scenario: str, strict: bool = False) -> list[str]:
+    """Return a list of violations (empty = valid)."""
+    schema = SCHEMAS.get(scenario)
+    if schema is None:
+        return [f"unknown scenario {scenario!r} "
+                f"(known: {', '.join(sorted(SCHEMAS))})"]
+    errors = []
+    for key, typ in schema.items():
+        if key not in tail:
+            errors.append(f"missing key: {key}")
+            continue
+        val = tail[key]
+        # bool is an int subclass; an int-typed key must not accept it
+        if typ is int and isinstance(val, bool):
+            errors.append(f"{key}: expected int, got bool")
+        elif typ is bool and not isinstance(val, bool):
+            errors.append(f"{key}: expected bool, "
+                          f"got {type(val).__name__}")
+        elif not isinstance(val, typ):
+            name = (typ.__name__ if isinstance(typ, type)
+                    else "number")
+            errors.append(f"{key}: expected {name}, "
+                          f"got {type(val).__name__}")
+    if strict and not errors:
+        for key, floor in FLOORS.get(scenario, {}).items():
+            if tail[key] < floor:
+                errors.append(f"{key}: {tail[key]} below the "
+                              f"documented floor {floor}")
+        for key, want in STRICT_EQ.get(scenario, {}).items():
+            if tail[key] != want:
+                errors.append(f"{key}: expected {want!r}, "
+                              f"got {tail[key]!r}")
+    return errors
+
+
+def main(argv=None, out=None) -> int:
+    out = out or sys.stderr
+    p = argparse.ArgumentParser(
+        prog="benchcheck.py",
+        description="Validate a bench.py JSON tail against its schema.")
+    p.add_argument("--json", help="path to the JSON tail (default: "
+                                  "read the last line of stdin)")
+    p.add_argument("--scenario",
+                   help="schema to check against (default: the tail's "
+                        "own 'scenario' key, else 'main')")
+    p.add_argument("--strict", action="store_true",
+                   help="also enforce documented acceptance floors")
+    args = p.parse_args(argv)
+
+    if args.json:
+        with open(args.json) as fh:
+            text = fh.read()
+    else:
+        text = sys.stdin.read()
+    lines = [ln for ln in text.strip().splitlines() if ln.strip()]
+    if not lines:
+        print("no input", file=out)
+        return 1
+    try:
+        tail = json.loads(lines[-1])
+    except json.JSONDecodeError as e:
+        print(f"last line is not JSON: {e}", file=out)
+        return 1
+    scenario = args.scenario or tail.get("scenario") or "main"
+    errors = check(tail, scenario, strict=args.strict)
+    for err in errors:
+        print(f"[{scenario}] {err}", file=out)
+    if not errors:
+        print(f"[{scenario}] tail valid "
+              f"({len(SCHEMAS[scenario])} required keys)", file=out)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

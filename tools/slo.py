@@ -31,6 +31,7 @@ from kueue_oss_tpu.obs.health import SLOEngine  # noqa: E402
 from kueue_oss_tpu.obs.ledger import (  # noqa: E402
     HOST_CYCLE,
     SOLVER_DRAIN,
+    STREAM_DRAIN,
     CycleRecord,
     load_ledger_jsonl,
 )
@@ -50,9 +51,11 @@ def summarize(rows: list[CycleRecord], out) -> int:
         return 1
     host = [r for r in rows if r.kind == HOST_CYCLE]
     solver = [r for r in rows if r.kind == SOLVER_DRAIN]
+    stream = [r for r in rows if r.kind == STREAM_DRAIN]
     print(f"{len(rows)} ledger row(s): {len(host)} host cycle(s), "
-          f"{len(solver)} solver drain(s); cycles "
-          f"{rows[0].cycle}..{rows[-1].cycle}", file=out)
+          f"{len(solver)} solver drain(s), {len(stream)} stream "
+          f"drain(s); cycles {rows[0].cycle}..{rows[-1].cycle}",
+          file=out)
     if host:
         walls = [r.duration_s * 1000 for r in host]
         print(f"host cycles: admitted {sum(r.admitted for r in host)}, "
@@ -97,6 +100,49 @@ def summarize(rows: list[CycleRecord], out) -> int:
         if donated or avoided:
             print(f"resident buffers: {donated}B donated scatters, "
                   f"{avoided}B full copies avoided", file=out)
+        # export-pipeline breakdown (engine phase timers): where the
+        # pre-solve wall goes — the dict walk / columnar scatter split
+        # plus delta encode and host->device upload
+        parts = []
+        for label, key in (("export", "export"),
+                           ("walk", "export_walk"),
+                           ("scatter", "export_scatter"),
+                           ("encode", "encode"),
+                           ("device_put", "device_put")):
+            vals = [r.phases[key] * 1000 for r in solver
+                    if key in r.phases]
+            if vals:
+                parts.append(f"{label} p50 {_pct(vals, 0.5):.2f}ms "
+                             f"p95 {_pct(vals, 0.95):.2f}ms")
+        if parts:
+            print("export pipeline: " + "; ".join(parts), file=out)
+        modes: dict[str, int] = {}
+        dirty = 0
+        exported = 0
+        for r in solver:
+            m = r.session.get("export_mode")
+            if m:
+                modes[m] = modes.get(m, 0) + 1
+                dirty += int(r.session.get("export_dirty_rows", 0))
+                exported += int(r.session.get("export_rows", 0))
+        if modes:
+            print("columnar exports: " + ", ".join(
+                f"{m}={n}" for m, n in sorted(modes.items()))
+                + f"; {dirty} dirty row(s) scattered across "
+                  f"{exported} exported", file=out)
+    if stream:
+        micro = [r for r in stream if r.detail.get("microBatch")]
+        solves = [r.phases.get("micro_solve", 0.0) * 1000
+                  for r in micro]
+        line = (f"stream drains: admitted "
+                f"{sum(r.admitted for r in stream)}, parked "
+                f"{sum(r.parked for r in stream)}")
+        if micro:
+            line += (f"; micro-solves {len(micro)} "
+                     f"({sum(r.detail['microBatch'] for r in micro)} "
+                     f"entries) solve p50 {_pct(solves, 0.5):.2f}ms "
+                     f"p95 {_pct(solves, 0.95):.2f}ms")
+        print(line, file=out)
     return 0
 
 
