@@ -1467,6 +1467,324 @@ def run_scenario(scenario: str) -> dict:
                 batch.admitted[0]).sum()),
         }
 
+    if scenario == "federation":
+        # federated control planes (docs/FEDERATION.md). Phase 1: four
+        # tenants x two control-plane instances each share ONE solver
+        # sidecar through the weighted-DRR farm; a deadline-bound
+        # contended churn (every member re-drains as fast as its grants
+        # come back, so demand exceeds the single solve slot) measures
+        # whether per-tenant solver WALL-TIME shares track the 2:2:1:1
+        # weights. Plans must stay bit-identical to dedicated-sidecar
+        # host twins replaying the same churn, and every resident
+        # session's state checksum must match its own tenant only.
+        # Phase 2: the WhatIf dispatcher priced against Incremental on
+        # a heterogeneous 4-worker fleet where the three constrained
+        # workers list first — an unpriced strategy races them for a
+        # full round before reaching the roomy one, a priced one goes
+        # straight there; time-to-admit is counted in simulated seconds.
+        import tempfile
+        import threading
+
+        from kueue_oss_tpu import metrics as kmetrics
+        from kueue_oss_tpu.api.types import (
+            AdmissionCheck,
+            CheckState,
+            ClusterQueue,
+            FlavorQuotas,
+            LocalQueue,
+            PodSet,
+            PreemptionPolicy,
+            ResourceFlavor,
+            ResourceGroup,
+            ResourceQuota,
+            Workload,
+        )
+        from kueue_oss_tpu.controllers import WorkloadReconciler
+        from kueue_oss_tpu.core.queue_manager import QueueManager
+        from kueue_oss_tpu.core.store import Store
+        from kueue_oss_tpu.federation import (
+            attach_farm,
+            build_member,
+            plan_fingerprint,
+        )
+        from kueue_oss_tpu.multikueue import (
+            MULTIKUEUE_CONTROLLER_NAME,
+            IncrementalDispatcher,
+            MultiKueueCluster,
+            MultiKueueController,
+            WhatIfDispatcher,
+            WorkerEnvironment,
+        )
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+        from kueue_oss_tpu.solver.delta import state_checksum
+        from kueue_oss_tpu.solver.service import SolverServer
+
+        def seed_cluster(store, n_cqs=4, quota=8):
+            store.upsert_resource_flavor(ResourceFlavor(name="f"))
+            for i in range(n_cqs):
+                store.upsert_cluster_queue(ClusterQueue(
+                    name=f"cq{i}", preemption=PreemptionPolicy(),
+                    resource_groups=[ResourceGroup(
+                        covered_resources=["cpu"],
+                        flavors=[FlavorQuotas(name="f", resources=[
+                            ResourceQuota(name="cpu", nominal=quota)])])]))
+                store.upsert_local_queue(LocalQueue(
+                    name=f"lq{i}", cluster_queue=f"cq{i}"))
+
+        def fed_wl(i, cpu=1):
+            return Workload(
+                name=f"w{i}", queue_name=f"lq{i % 4}", uid=i + 1,
+                creation_time=float(i),
+                podsets=[PodSet(name="main", count=1,
+                                requests={"cpu": cpu})])
+
+        def churn(member, cycles, uid0, t0):
+            uid = uid0
+            for cyc in range(t0, t0 + cycles):
+                admitted = sorted(
+                    k for k, w in member.store.workloads.items()
+                    if w.is_quota_reserved and not w.is_finished)
+                for k in admitted[:2]:
+                    member.scheduler.finish_workload(k, now=float(cyc))
+                for _ in range(2):
+                    member.store.add_workload(fed_wl(uid))
+                    uid += 1
+                member.drain(now=float(cyc))
+            return uid
+
+        weights = {"cp-a": 2.0, "cp-b": 2.0, "cp-c": 1.0, "cp-d": 1.0}
+        sock = os.path.join(tempfile.mkdtemp(), "farm.sock")
+        srv = SolverServer(sock, max_sessions=16)
+        farm = attach_farm(srv, weights=weights, quantum_s=0.002)
+        srv.serve_in_background()
+        members = {}
+        for tname in weights:
+            for j in range(2):
+                members[f"{tname}/{j}"] = build_member(
+                    tname, socket_path=sock,
+                    seed=lambda s: seed_cluster(s), pad_to=64)
+        offsets = {n: 10000 * i for i, n in enumerate(members)}
+        # warm sequentially (initial SYNC + kernel compile) so compile
+        # wall never lands on one tenant's bill
+        uids = {}
+        for name, m in members.items():
+            for i in range(24):
+                m.store.add_workload(fed_wl(i + offsets[name]))
+            m.drain(now=0.0)
+            uids[name] = churn(m, 2, offsets[name] + 100, t0=1)
+        base_wall = dict(farm.wall_by_tenant)
+        base_served = dict(farm.served)
+
+        secs = float(os.environ.get("BENCH_FED_SECS", "5.0"))
+        barrier = threading.Barrier(len(members))
+        cycles_run = {}
+
+        def contend(name, m):
+            barrier.wait()
+            deadline = time.monotonic() + secs
+            cyc = 3
+            while time.monotonic() < deadline:
+                uids[name] = churn(m, 1, uids[name], t0=cyc)
+                cyc += 1
+            cycles_run[name] = cyc - 3
+
+        threads = [threading.Thread(target=contend, args=(n, m))
+                   for n, m in members.items()]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        contended_s = time.monotonic() - t0
+        shares = {t: farm.wall_by_tenant.get(t, 0.0) - base_wall.get(t, 0.0)
+                  for t in weights}
+        solves = sum(farm.served.get(t, 0) - base_served.get(t, 0)
+                     for t in weights)
+        norm = {t: shares[t] / weights[t] for t in weights}
+        spread = (max(norm.values()) / min(norm.values())
+                  if min(norm.values()) > 0 else float("inf"))
+        log(f"[federation] contended {contended_s:.1f}s, "
+            f"{solves} solves, wall shares {shares}, spread "
+            f"{spread:.2f}, throttled {dict(farm.throttled)}")
+
+        # zero cross-tenant: every resident session's checksum matches
+        # one of its OWN tenant's control planes and no other tenant's
+        host_sums = {}
+        for name, m in members.items():
+            sess = next(iter(m.engine._delta_sessions.values()))
+            kwargs, meta = sess._last
+            host_sums[name] = state_checksum(kwargs, meta)
+        with srv._sessions_lock:
+            side_sums = {k: state_checksum(s.kwargs, s.meta)
+                         for k, s in srv.sessions.items()}
+        zero_cross = bool(side_sums)
+        for (tenant, _sid), chk in side_sums.items():
+            own = {host_sums[n] for n in host_sums
+                   if n.split("/")[0] == tenant}
+            other = {host_sums[n] for n in host_sums
+                     if n.split("/")[0] != tenant}
+            if chk not in own or chk in other:
+                zero_cross = False
+        # farm-vs-dedicated bit-identity: a host twin of each member
+        # replaying the same churn lands the exact same plan
+        identical = True
+        for name, m in members.items():
+            twin = build_member(f"{name}-twin", pad_to=64,
+                                seed=lambda s: seed_cluster(s))
+            twin.engine.use_sessions = False
+            for i in range(24):
+                twin.store.add_workload(fed_wl(i + offsets[name]))
+            twin.drain(now=0.0)
+            uid = churn(twin, 2, offsets[name] + 100, t0=1)
+            churn(twin, cycles_run[name], uid, t0=3)
+            if (plan_fingerprint(twin.store, twin.queues)
+                    != plan_fingerprint(m.store, m.queues)):
+                identical = False
+                log(f"[federation] PLAN MISMATCH vs twin: {name}")
+        srv.shutdown()
+        srv.server_close()
+
+        # -- phase 2: what-if-scored dispatch vs Incremental ----------
+        def worker_env(name, quota, background_cpu=()):
+            env = WorkerEnvironment(name)
+            store = env.store
+            store.upsert_resource_flavor(ResourceFlavor(name="f0"))
+            store.upsert_cluster_queue(ClusterQueue(
+                name="wcq", preemption=PreemptionPolicy(),
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="f0", resources=[
+                        ResourceQuota(name="cpu", nominal=quota)])])]))
+            store.upsert_local_queue(LocalQueue(
+                name="lq", cluster_queue="wcq"))
+            for i, cpu in enumerate(background_cpu):
+                store.add_workload(Workload(
+                    name=f"bg{i}", queue_name="lq",
+                    creation_time=float(i),
+                    podsets=[PodSet(count=1, requests={"cpu": cpu})]))
+            env.run_cycle(5.0)
+            return env
+
+        def make_workers():
+            return [
+                worker_env("tight-a", 2000, background_cpu=(1500,)),
+                worker_env("tight-b", 2500, background_cpu=(2000,)),
+                worker_env("tight-c", 2000, background_cpu=(1600,)),
+                worker_env("roomy", 8000, background_cpu=(1000,)),
+            ]
+
+        class Hub:
+            def __init__(self, workers, dispatcher):
+                self.store = Store()
+                self.store.upsert_resource_flavor(
+                    ResourceFlavor(name="f0"))
+                self.store.upsert_cluster_queue(ClusterQueue(
+                    name="hubcq", admission_checks=["multikueue"],
+                    resource_groups=[ResourceGroup(
+                        covered_resources=["cpu"],
+                        flavors=[FlavorQuotas(name="f0", resources=[
+                            ResourceQuota(name="cpu",
+                                          nominal=16000)])])]))
+                self.store.upsert_local_queue(LocalQueue(
+                    name="lq", cluster_queue="hubcq"))
+                self.store.upsert_admission_check(AdmissionCheck(
+                    name="multikueue",
+                    controller_name=MULTIKUEUE_CONTROLLER_NAME))
+                self.queues = QueueManager(self.store)
+                self.scheduler = Scheduler(self.store, self.queues)
+                self.wr = WorkloadReconciler(self.store, self.scheduler)
+                self.clusters = [
+                    MultiKueueCluster(name=e.name, environment=e)
+                    for e in workers]
+                self.dispatcher = dispatcher
+                self.mk = MultiKueueController(
+                    self.store, self.scheduler, self.clusters,
+                    dispatcher=dispatcher)
+                self.t = 10.0
+
+            def submit(self, cpu):
+                self.t += 1.0
+                self.store.add_workload(Workload(
+                    name="wl", queue_name="lq", creation_time=self.t,
+                    podsets=[PodSet(count=1, requests={"cpu": cpu})]))
+
+            def tick(self):
+                self.t += 1.0
+                self.scheduler.schedule(self.t)
+                self.mk.reconcile_all(self.t)
+                for c in self.clusters:
+                    if c.active:
+                        c.environment.run_cycle(self.t)
+                self.mk.reconcile_all(self.t)
+                self.wr.reconcile_all(self.t)
+
+        round_timeout = 15.0
+        sizes = (300, 3000, 1000, 300, 2500, 1500)
+
+        def dispatch_once(dispatcher, cpu):
+            hub = Hub(make_workers(), dispatcher)
+            hub.submit(cpu)
+            t_submit = hub.t
+            for _ in range(60):
+                hub.tick()
+                wl = hub.store.workloads["default/wl"]
+                st = wl.status.admission_checks.get("multikueue")
+                if st is not None and st.state == CheckState.READY:
+                    return hub.t - t_submit, hub
+            raise RuntimeError(f"dispatch never admitted (cpu={cpu})")
+
+        # compile the pricer programs outside the measured stream
+        dispatch_once(WhatIfDispatcher(round_timeout_s=round_timeout,
+                                       check_oracle=True), 1000)
+        _, score_sum0, score_n0 = (
+            kmetrics.multikueue_dispatch_score_ms._values[()])
+        ttas = {}
+        agree = scored = 0
+        for label in ("whatif", "incremental"):
+            ttas[label] = []
+            for cpu in sizes:
+                dispatcher = (
+                    WhatIfDispatcher(round_timeout_s=round_timeout,
+                                     check_oracle=True)
+                    if label == "whatif" else
+                    IncrementalDispatcher(round_timeout_s=round_timeout))
+                tta, hub = dispatch_once(dispatcher, cpu)
+                ttas[label].append(tta)
+                if label == "whatif":
+                    rep = dispatcher.last_reports.get("default/wl")
+                    if rep is not None:
+                        scored += 1
+                        if (rep.best == rep.oracle_best
+                                and rep.oracle_identical):
+                            agree += 1
+        _, score_sum1, score_n1 = (
+            kmetrics.multikueue_dispatch_score_ms._values[()])
+        tta_whatif = sum(ttas["whatif"]) / len(sizes)
+        tta_inc = sum(ttas["incremental"]) / len(sizes)
+        score_ms = ((score_sum1 - score_sum0)
+                    / max(1, score_n1 - score_n0))
+        log(f"[federation] whatif tta {ttas['whatif']} vs incremental "
+            f"{ttas['incremental']} (sim s); oracle {agree}/{scored}; "
+            f"score {score_ms:.2f} ms")
+        return {
+            "scenario": scenario,
+            "tenants": len(weights),
+            "members": len(members),
+            "contended_seconds": round(contended_s, 2),
+            "farm_solves": int(solves),
+            "farm_throttled": int(sum(farm.throttled.values())),
+            "tenant_wall_share_spread": round(spread, 3),
+            "zero_cross_tenant": zero_cross,
+            "plans_identical_dedicated": identical,
+            "whatif_dispatches": len(sizes),
+            "whatif_oracle_agreement": round(agree / max(1, scored), 4),
+            "dispatch_score_ms_mean": round(score_ms, 3),
+            "whatif_time_to_admit_s": round(tta_whatif, 2),
+            "incremental_time_to_admit_s": round(tta_inc, 2),
+            "whatif_admit_speedup": round(
+                tta_inc / max(1e-9, tta_whatif), 2),
+        }
+
     if scenario == "relax_arm":
         # internal helper for the "relax" twin: ONE solver arm (exact
         # lean kernel vs the convex-relaxation fast path) timed in its
@@ -2438,6 +2756,16 @@ def main() -> None:
     except Exception as e:
         log(f"[whatif] did not complete: {e}")
         whatif = None
+    # federated control planes: multi-tenant solver-farm DRR fairness
+    # under contended churn + the what-if-scored dispatcher vs
+    # Incremental (docs/FEDERATION.md; host backend — the measurement
+    # is arbitration and dispatch quality, not kernel speed)
+    try:
+        federation = measure("federation",
+                             extra_env={"BENCH_CPU": "1"}, timeout=1200)
+    except Exception as e:
+        log(f"[federation] did not complete: {e}")
+        federation = None
     # streaming control plane: p50/p95 time-to-admit streaming vs the
     # cycle-batch twin at the same full-solve cadence, incremental vs
     # full checkpoint wall, shipped bytes per cycle (host backend:
@@ -2650,6 +2978,28 @@ def main() -> None:
         extra["whatif_vmapped_speedup"] = whatif["vmapped_speedup"]
         extra["whatif_plans_identical"] = whatif["plans_identical"]
         extra["whatif_workloads"] = whatif["workloads"]
+    if federation is not None:
+        # federation acceptance (docs/FEDERATION.md): per-tenant solver
+        # wall-time shares within 1.5x of the DRR weights, zero
+        # cross-tenant session state, farm plans bit-identical to
+        # dedicated-sidecar twins, and the what-if dispatcher agreeing
+        # with the sequential oracle on >= 95% of scored dispatches
+        extra["fed_tenant_wall_share_spread"] = federation[
+            "tenant_wall_share_spread"]
+        extra["fed_farm_solves"] = federation["farm_solves"]
+        extra["fed_zero_cross_tenant"] = federation["zero_cross_tenant"]
+        extra["fed_plans_identical_dedicated"] = federation[
+            "plans_identical_dedicated"]
+        extra["fed_whatif_oracle_agreement"] = federation[
+            "whatif_oracle_agreement"]
+        extra["fed_dispatch_score_ms_mean"] = federation[
+            "dispatch_score_ms_mean"]
+        extra["fed_whatif_time_to_admit_s"] = federation[
+            "whatif_time_to_admit_s"]
+        extra["fed_incremental_time_to_admit_s"] = federation[
+            "incremental_time_to_admit_s"]
+        extra["fed_whatif_admit_speedup"] = federation[
+            "whatif_admit_speedup"]
     if streaming_res is not None:
         # streaming control plane acceptance: p50 time-to-admit
         # decoupled from the full-solve cadence (>= 5x below the

@@ -139,6 +139,15 @@ class SolverBackendConfig:
 
     #: unix socket of the sidecar; None = solve in-process
     socket_path: Optional[str] = None
+    #: tenant id stamped into every frame header when this control
+    #: plane shares a multi-tenant solver farm (docs/FEDERATION.md);
+    #: "" = single-tenant legacy framing. None-equivalent env:
+    #: KUEUE_SOLVER_TENANT.
+    tenant: str = ""
+    #: sidecar-resident session cap (LRU-evicted past it, counted in
+    #: solver_session_evictions_total{reason="lru"}); None =
+    #: KUEUE_SOLVER_MAX_SESSIONS env, falling back to 4
+    max_sessions: Optional[int] = None
     #: per-call deadline covering every retry of one solve
     timeout_seconds: float = 600.0
     #: re-attempts (fresh connection each) on transport faults
@@ -194,6 +203,32 @@ class SolverBackendConfig:
     relax_support_threshold: float = 0.5
     #: demoted-arm cooldown before one re-probe drain
     relax_retry_cooldown_seconds: float = 300.0
+
+
+@dataclass
+class FederationConfig:
+    """Multi-tenant solver-farm knobs (kueue_oss_tpu/federation/,
+    docs/FEDERATION.md).
+
+    No reference analog — the reference has no shared solver service;
+    these govern the sidecar-side weighted deficit-round-robin that
+    arbitrates solver wall-time between the control planes sharing one
+    farm. Applied via ``federation.attach_farm(server, **knobs)``.
+    """
+
+    #: tenant id -> DRR weight (share of solver wall-time); tenants
+    #: absent here get default_weight
+    tenant_weights: dict[str, float] = field(default_factory=dict)
+    default_weight: float = 1.0
+    #: wall-time credit granted per DRR ring visit, scaled by weight
+    quantum_seconds: float = 0.025
+    #: per-tenant queued-request cap; arrivals past it are rejected
+    #: with an in-band backpressure error (the client degrades to host
+    #: cycles via SolverUnavailable — it never wedges)
+    max_queued: int = 8
+    #: idle-credit cap, in quanta, bounding how large a burst a
+    #: backlogged tenant can run from accrued deficit
+    max_credit_quanta: float = 4.0
 
 
 @dataclass
@@ -381,6 +416,7 @@ class Configuration:
     object_retention_policies: Optional[ObjectRetentionPolicies] = None
     multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     solver: SolverBackendConfig = field(default_factory=SolverBackendConfig)
+    federation: FederationConfig = field(default_factory=FederationConfig)
     streaming: StreamingConfig = field(default_factory=StreamingConfig)
     simulator: SimulatorConfig = field(default_factory=SimulatorConfig)
     persistence: PersistenceConfig = field(
@@ -396,7 +432,7 @@ class Configuration:
 _REQUEUING_TIMESTAMPS = {RequeuingTimestamp.EVICTION, RequeuingTimestamp.CREATION}
 _TRANSFORM_STRATEGIES = {"Retain", "Replace"}
 _FS_STRATEGIES = {"LessThanOrEqualToFinalShare", "LessThanInitialShare"}
-_DISPATCHERS = {"AllAtOnce", "Incremental"}
+_DISPATCHERS = {"AllAtOnce", "Incremental", "WhatIf"}
 
 
 def validate(cfg: Configuration) -> list[str]:
@@ -468,6 +504,20 @@ def validate(cfg: Configuration) -> list[str]:
         errs.append("solver.relaxSupportThreshold must be in (0, 1)")
     if sv.relax_retry_cooldown_seconds < 0:
         errs.append("solver.relaxRetryCooldown must be >= 0")
+    if sv.max_sessions is not None and sv.max_sessions < 1:
+        errs.append("solver.maxSessions must be >= 1")
+    fed = cfg.federation
+    if fed.default_weight <= 0:
+        errs.append("federation.defaultWeight must be > 0")
+    for t, w in fed.tenant_weights.items():
+        if w <= 0:
+            errs.append(f"federation.tenantWeights[{t!r}] must be > 0")
+    if fed.quantum_seconds <= 0:
+        errs.append("federation.quantum must be > 0")
+    if fed.max_queued < 1:
+        errs.append("federation.maxQueued must be >= 1")
+    if fed.max_credit_quanta <= 0:
+        errs.append("federation.maxCreditQuanta must be > 0")
     sim = cfg.simulator
     if sim.max_scenarios < 1:
         errs.append("simulator.maxScenarios must be >= 1")
@@ -644,6 +694,8 @@ def load(data: Optional[dict] = None) -> Configuration:
     def conv_solver(d: dict) -> SolverBackendConfig:
         return _build(SolverBackendConfig, d, {
             "socketPath": ("socket_path", None),
+            "tenant": ("tenant", str),
+            "maxSessions": ("max_sessions", int),
             "timeout": ("timeout_seconds", float),
             "maxRetries": ("max_retries", int),
             "retryBackoffBase": ("retry_backoff_base_seconds", float),
@@ -663,6 +715,15 @@ def load(data: Optional[dict] = None) -> Configuration:
             "relaxSupportThreshold": ("relax_support_threshold", float),
             "relaxRetryCooldown": ("relax_retry_cooldown_seconds",
                                    float),
+        })
+
+    def conv_federation(d: dict) -> FederationConfig:
+        return _build(FederationConfig, d, {
+            "tenantWeights": ("tenant_weights", dict),
+            "defaultWeight": ("default_weight", float),
+            "quantum": ("quantum_seconds", float),
+            "maxQueued": ("max_queued", int),
+            "maxCreditQuanta": ("max_credit_quanta", float),
         })
 
     def conv_persist(d: dict) -> PersistenceConfig:
@@ -749,6 +810,7 @@ def load(data: Optional[dict] = None) -> Configuration:
         "objectRetentionPolicies": ("object_retention_policies", conv_retention),
         "multiKueue": ("multikueue", conv_mk),
         "solver": ("solver", conv_solver),
+        "federation": ("federation", conv_federation),
         "streaming": ("streaming", conv_streaming),
         "simulator": ("simulator", conv_sim),
         "persistence": ("persistence", conv_persist),

@@ -1,0 +1,248 @@
+"""Multi-tenant solver farm: weighted DRR admission over solver wall-time.
+
+docs/FEDERATION.md: ROADMAP item 4's "many clusters, one brain" — N
+control planes share ONE pod-scale solver sidecar. Each control plane
+is a *tenant*: its ``SolverClient`` stamps a tenant id into every frame
+header, the sidecar keys resident sessions ``(tenant, sid)`` (service.py),
+and this module arbitrates who gets the solver next.
+
+The scheduler is a weighted deficit round robin (DRR) over solver
+WALL-TIME, not request count — one tenant's 50 ms full solves must not
+buy it 10x the farm share of a neighbor's 5 ms lean solves:
+
+- each tenant owns a FIFO queue of waiting requests and a deficit
+  counter in seconds;
+- a grant opportunity walks the tenant ring from the rotating cursor;
+  every backlogged tenant visited accrues ``quantum_s * weight``; the
+  first whose deficit goes positive is granted (the walk is computed in
+  closed form — O(tenants), not O(rounds));
+- the granted request's ACTUAL wall-time is charged afterwards, so the
+  deficit can go negative: an expensive solve is a debt the tenant
+  pays off by waiting out its neighbors' quanta;
+- a tenant with an empty queue forfeits its credit (deficit resets to
+  0) — idle time is not bankable, exactly like classic DRR;
+- positive credit is capped at ``max_credit_quanta`` quanta so a
+  lightly-loaded tenant cannot hoard an unbounded burst.
+
+Backpressure is the contract that keeps a starved tenant from wedging:
+a tenant with ``max_queued`` requests already waiting gets an IN-BAND
+error (``{"ok": false, "error": "...backpressure..."}``) instead of a
+queue slot. The client collapses that into ``SolverUnavailable``, the
+engine's breaker trips, and the control plane degrades to host cycles —
+it keeps scheduling, just without the accelerator, and re-probes later.
+
+One executor slot: the underlying solver is one device (or one mesh) —
+running two tenants' solves concurrently would just interleave compile
+queues. The DRR therefore serializes solve bodies; fairness comes from
+the grant ORDER, not parallelism.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from kueue_oss_tpu import metrics
+
+
+def _backpressure(tenant: str, why: str) -> tuple[dict, bytes]:
+    """The in-band throttle answer. Deliberately NOT a resync: the
+    client must degrade via SolverUnavailable (host cycles), not burn
+    the farm's time re-SYNCing a session that is perfectly healthy."""
+    return {"ok": False,
+            "error": f"solver farm backpressure for tenant "
+                     f"{tenant!r}: {why}"}, b""
+
+
+class _Ticket:
+    __slots__ = ("granted",)
+
+    def __init__(self) -> None:
+        self.granted = threading.Event()
+
+
+class FarmScheduler:
+    """Weighted deficit-round-robin admission over solver wall-time.
+
+    ``run(tenant, fn)`` is the only entry point the sidecar uses: it
+    enqueues, waits for its DRR grant, times ``fn()``, charges the
+    wall-time, and hands the slot to the next winner. Attach to a
+    ``SolverServer`` with :func:`attach_farm` (or ``server.farm = ...``).
+
+    ``clock`` is injectable so tests drive fairness deterministically.
+    """
+
+    def __init__(self,
+                 weights: Optional[dict[str, float]] = None,
+                 default_weight: float = 1.0,
+                 quantum_s: float = 0.025,
+                 max_queued: int = 8,
+                 max_credit_quanta: float = 4.0,
+                 grant_timeout_s: float = 600.0,
+                 clock=time.monotonic) -> None:
+        self.weights = dict(weights or {})
+        self.default_weight = float(default_weight)
+        self.quantum_s = float(quantum_s)
+        self.max_queued = max(1, int(max_queued))
+        self.max_credit_quanta = float(max_credit_quanta)
+        self.grant_timeout_s = float(grant_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._queues: dict[str, deque[_Ticket]] = {}
+        self._ring: list[str] = []
+        self._cursor = 0
+        self._deficit: dict[str, float] = {}
+        self._busy = False
+        #: per-tenant ledgers (bench/tests read these directly; the
+        #: metrics registry carries the same totals for operators)
+        self.wall_by_tenant: dict[str, float] = {}
+        self.served: dict[str, int] = {}
+        self.throttled: dict[str, int] = {}
+
+    @classmethod
+    def from_config(cls, cfg, clock=time.monotonic) -> "FarmScheduler":
+        """Build from a ``config.FederationConfig``."""
+        return cls(weights=dict(cfg.tenant_weights),
+                   default_weight=cfg.default_weight,
+                   quantum_s=cfg.quantum_seconds,
+                   max_queued=cfg.max_queued,
+                   max_credit_quanta=cfg.max_credit_quanta,
+                   clock=clock)
+
+    # -- accounting --------------------------------------------------------
+
+    def weight(self, tenant: str) -> float:
+        return max(1e-9, float(self.weights.get(tenant,
+                                                self.default_weight)))
+
+    def stats(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            tenants = set(self.wall_by_tenant) | set(self.throttled)
+            return {t: {"wall_s": self.wall_by_tenant.get(t, 0.0),
+                        "served": self.served.get(t, 0),
+                        "throttled": self.throttled.get(t, 0)}
+                    for t in tenants}
+
+    # -- the DRR core ------------------------------------------------------
+
+    def _register_locked(self, tenant: str) -> None:
+        if tenant not in self._queues:
+            self._queues[tenant] = deque()
+            self._ring.append(tenant)
+            self._deficit.setdefault(tenant, 0.0)
+            metrics.solver_farm_tenants.set(value=len(self._ring))
+
+    def _grant_next_locked(self) -> None:
+        """Pick the next winner by simulating the ring walk in closed
+        form: for each backlogged tenant, the number of quantum visits
+        it needs before its deficit goes positive; the winner is the
+        fewest-visits tenant, ring order from the cursor breaking ties.
+        Every backlogged tenant then accrues the winner's visit count
+        (that is exactly what walking the ring that many times would
+        have paid out), so relative credit is preserved."""
+        if self._busy:
+            return
+        n = len(self._ring)
+        backlogged = [(i, t) for i, t in enumerate(self._ring)
+                      if self._queues[t]]
+        if not backlogged:
+            return
+        # idle tenants forfeit credit — DRR's "no banking" rule
+        for t in self._ring:
+            if not self._queues[t] and self._deficit.get(t, 0.0) > 0:
+                self._deficit[t] = 0.0
+
+        def visits_needed(t: str) -> int:
+            d = self._deficit.get(t, 0.0)
+            if d > 0:
+                return 0
+            per = self.quantum_s * self.weight(t)
+            return int(-d / per) + 1
+
+        best = None
+        for i, t in backlogged:
+            need = visits_needed(t)
+            pos = (i - self._cursor) % n  # ring distance from cursor
+            key = (need, pos)
+            if best is None or key < best[0]:
+                best = (key, i, t)
+        (rounds, _), idx, winner = best
+        if rounds:
+            for _, t in backlogged:
+                cap = (self.quantum_s * self.weight(t)
+                       * self.max_credit_quanta)
+                self._deficit[t] = min(
+                    self._deficit.get(t, 0.0)
+                    + rounds * self.quantum_s * self.weight(t), cap)
+        self._cursor = (idx + 1) % n
+        self._busy = True
+        ticket = self._queues[winner].popleft()
+        ticket.granted.set()
+
+    def _complete(self, tenant: str, wall_s: float) -> None:
+        with self._lock:
+            self._busy = False
+            self._deficit[tenant] = self._deficit.get(tenant, 0.0) - wall_s
+            self.wall_by_tenant[tenant] = (
+                self.wall_by_tenant.get(tenant, 0.0) + wall_s)
+            self.served[tenant] = self.served.get(tenant, 0) + 1
+            self._grant_next_locked()
+        metrics.solver_farm_wall_seconds_total.inc(tenant, by=wall_s)
+
+    # -- entry point -------------------------------------------------------
+
+    def run(self, tenant: str, fn: Callable[[], tuple[dict, bytes]]
+            ) -> tuple[dict, bytes]:
+        """Admit one request for ``tenant`` through the DRR and run it.
+
+        Returns ``fn()``'s (header, blob) — or the in-band backpressure
+        tuple when the tenant's queue is full / the grant timed out.
+        ``fn`` exceptions propagate after the slot is released (the
+        sidecar's ``respond`` reports them in-band, same as unfarmed).
+        """
+        tenant = str(tenant)
+        ticket = _Ticket()
+        with self._lock:
+            self._register_locked(tenant)
+            q = self._queues[tenant]
+            if len(q) >= self.max_queued:
+                self.throttled[tenant] = self.throttled.get(tenant, 0) + 1
+                metrics.solver_farm_throttled_total.inc(tenant)
+                return _backpressure(
+                    tenant, f"{len(q)} requests already queued "
+                            f"(max_queued={self.max_queued})")
+            q.append(ticket)
+            self._grant_next_locked()
+        if not ticket.granted.wait(self.grant_timeout_s):
+            with self._lock:
+                if not ticket.granted.is_set():
+                    # never granted: withdraw and throttle — the slot
+                    # was starved past any sane client deadline
+                    try:
+                        self._queues[tenant].remove(ticket)
+                    except ValueError:
+                        pass
+                    self.throttled[tenant] = (
+                        self.throttled.get(tenant, 0) + 1)
+                    metrics.solver_farm_throttled_total.inc(tenant)
+                    return _backpressure(tenant, "grant wait timed out")
+                # granted in the race window: fall through and run
+        metrics.solver_farm_requests_total.inc(tenant)
+        t0 = self._clock()
+        try:
+            return fn()
+        finally:
+            self._complete(tenant, max(0.0, self._clock() - t0))
+
+
+def attach_farm(server, scheduler: Optional[FarmScheduler] = None,
+                **farm_kwargs) -> FarmScheduler:
+    """Wire a FarmScheduler onto a ``SolverServer`` (service.py checks
+    ``server.farm`` per request). Returns the scheduler for test/bench
+    introspection."""
+    if scheduler is None:
+        scheduler = FarmScheduler(**farm_kwargs)
+    server.farm = scheduler
+    return scheduler

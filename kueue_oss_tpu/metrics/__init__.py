@@ -563,6 +563,54 @@ solver_session_frames_total = registry.register(Counter(
 solver_session_bytes_total = registry.register(Counter(
     "kueue_tpu_solver_session_bytes_total",
     "Solver request payload bytes shipped by frame kind", ("kind",)))
+solver_session_evictions_total = registry.register(Counter(
+    "kueue_tpu_solver_session_evictions_total",
+    "Sidecar session-table evictions by reason (lru = capacity "
+    "pressure past max_sessions; tenant_evicted = a whole tenant "
+    "namespace dropped by the farm/chaos layer)", ("reason",)))
+
+# -- federation / multi-tenant solver farm (docs/FEDERATION.md) --------------
+
+solver_farm_requests_total = registry.register(Counter(
+    "kueue_tpu_solver_farm_requests_total",
+    "Solver farm requests admitted to the executor, by tenant", ("tenant",)))
+solver_farm_wall_seconds_total = registry.register(Counter(
+    "kueue_tpu_solver_farm_wall_seconds_total",
+    "Solver wall-time consumed on the shared farm, by tenant (the "
+    "quantity the deficit-round-robin scheduler arbitrates)",
+    ("tenant",)))
+solver_farm_throttled_total = registry.register(Counter(
+    "kueue_tpu_solver_farm_throttled_total",
+    "Farm requests rejected with backpressure (per-tenant queue "
+    "overflow; the client degrades to host cycles via "
+    "SolverUnavailable)", ("tenant",)))
+solver_farm_tenants = registry.register(Gauge(
+    "kueue_tpu_solver_farm_tenants",
+    "Distinct tenants with live state on the shared solver farm", ()))
+
+# -- federated dispatch (multikueue/dispatcher.py WhatIf strategy) -----------
+
+multikueue_whatif_dispatch_total = registry.register(Counter(
+    "kueue_multikueue_whatif_dispatch_total",
+    "What-if-scored dispatch decisions by outcome (scored = batched "
+    "pricer nominated a cluster; fallback = farm/pricer unavailable, "
+    "degraded to Incremental; deferred = outstanding nomination still "
+    "within its round timeout)", ("outcome",)))
+multikueue_dispatch_score_ms = registry.register(Histogram(
+    "kueue_multikueue_dispatch_score_ms",
+    "Wall milliseconds spent pricing one dispatch across candidate "
+    "clusters with the batched what-if solve", (),
+    buckets=(1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+             1000.0, 2500.0)))
+
+# -- columnar export-path health (solver/columnar.py) ------------------------
+
+columnar_bailouts_total = registry.register(Counter(
+    "kueue_tpu_columnar_bailouts_total",
+    "Columnar exports that bailed out to the classic dict walk, by "
+    "reason (afs_active = AdmissionFairSharing consulted, column "
+    "store cannot price usage-ordering; retry_exhausted = concurrent "
+    "mutation raced the lock-free snapshot three times)", ("reason",)))
 
 # -- mesh-sharded drains (solver/sharded.py, docs/SOLVER_PROTOCOL.md) --------
 

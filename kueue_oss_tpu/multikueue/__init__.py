@@ -7,7 +7,10 @@ worker status back, and re-dispatches when a worker is lost past a
 timeout (multikueuecluster.go remote clients/watchers; workload.go
 mirroring). Dispatchers decide which workers to nominate: AllAtOnce or
 Incremental (up to 3 per round with a round timeout,
-workloaddispatcher/incrementaldispatcher.go:162).
+workloaddispatcher/incrementaldispatcher.go:162), or this repo's own
+WhatIf strategy — one batched counterfactual solve prices every
+candidate and nominates only the predicted-best worker
+(sim/dispatch.py, docs/FEDERATION.md).
 
 A "worker cluster" here is a full in-process environment (Store + queues
 + scheduler), matching the reference's multiple-envtest-control-planes
@@ -22,7 +25,9 @@ from kueue_oss_tpu.multikueue.dispatcher import (
     AllAtOnceDispatcher,
     DISPATCHER_ALL_AT_ONCE,
     DISPATCHER_INCREMENTAL,
+    DISPATCHER_WHAT_IF,
     IncrementalDispatcher,
+    WhatIfDispatcher,
 )
 from kueue_oss_tpu.multikueue.controller import (
     MULTIKUEUE_CONTROLLER_NAME,
@@ -34,8 +39,10 @@ __all__ = [
     "WorkerEnvironment",
     "AllAtOnceDispatcher",
     "IncrementalDispatcher",
+    "WhatIfDispatcher",
     "DISPATCHER_ALL_AT_ONCE",
     "DISPATCHER_INCREMENTAL",
+    "DISPATCHER_WHAT_IF",
     "MULTIKUEUE_CONTROLLER_NAME",
     "MultiKueueController",
 ]

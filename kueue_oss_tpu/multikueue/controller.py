@@ -41,6 +41,10 @@ class MultiKueueController:
         self.scheduler = hub_scheduler
         self.clusters = {c.name: c for c in clusters}
         self.dispatcher = dispatcher or AllAtOnceDispatcher()
+        if hasattr(self.dispatcher, "bind"):
+            # pricing dispatchers (WhatIf) need the worker environments,
+            # not just the names nominate() receives
+            self.dispatcher.bind(self.clusters)
         self.worker_lost_timeout_s = worker_lost_timeout_s
         self.check_name = check_name
         #: config-declared generic adapters for custom job GVKs

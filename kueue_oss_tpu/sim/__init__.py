@@ -17,6 +17,11 @@ from kueue_oss_tpu.sim.batch import (  # noqa: F401
     solve_scenarios,
     solve_scenarios_sequential,
 )
+from kueue_oss_tpu.sim.dispatch import (  # noqa: F401
+    DispatchReport,
+    Unpriceable,
+    price_dispatch,
+)
 from kueue_oss_tpu.sim.engine import (  # noqa: F401
     WhatIfEngine,
     pending_backlog,

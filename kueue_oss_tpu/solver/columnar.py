@@ -609,12 +609,12 @@ class ColumnarStore:
             walk_s += time.perf_counter() - tw
             break
         else:
-            return None
+            return self._bailout("retry_exhausted", t0, walk_s)
 
         # AFS-active exports thread per-LQ decayed penalties through a
         # per-row walk; bail to the classic path (rare, full-drain only).
         if afs is not None and spec["cq_afs_spec"].any():
-            return None
+            return self._bailout("afs_active", t0, walk_s)
 
         asm = self._asms.get(include_admitted)
         membership_ok = (
@@ -666,6 +666,21 @@ class ColumnarStore:
             "scatter_s": time.perf_counter() - t0 - walk_s,
             "dirty_rows": 0, "blocks_rebuilt": rebuilt, "rows": asm.W}
         return problem
+
+    def _bailout(self, reason: str, t0: float, walk_s: float):
+        """A columnar export that degrades to the classic dict walk is
+        a silent megascale regression unless accounted: counted by
+        reason and stamped into ``last_stats`` so the engine's export
+        phase (cycle ledger ``export_mode``) attributes the slow
+        cycle."""
+        from kueue_oss_tpu import metrics
+
+        metrics.columnar_bailouts_total.inc(reason)
+        self.last_stats = {
+            "mode": f"bailout:{reason}", "walk_s": walk_s,
+            "scatter_s": time.perf_counter() - t0 - walk_s,
+            "dirty_rows": 0, "blocks_rebuilt": 0, "rows": 0}
+        return None
 
     # -- cached path -------------------------------------------------------
 

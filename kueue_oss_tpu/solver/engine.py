@@ -609,6 +609,12 @@ class SolverEngine:
         for k, v in (getattr(self, "_drain_phases", None) or {}).items():
             phases[k] = round(v, 6)
         session.update(getattr(self, "_export_stats", None) or {})
+        # farm tenancy attribution (docs/FEDERATION.md): ledger rows
+        # from a control plane sharing a multi-tenant solver farm carry
+        # the tenant id its frames were billed under
+        tenant = getattr(self.remote, "tenant", "")
+        if tenant:
+            session["tenant"] = tenant
         ledger.record(
             self._drain_cycle, obs.SOLVER_DRAIN,
             breaker=obs.breaker_state_name(),

@@ -529,6 +529,14 @@ BATCHABLE_FIELDS = frozenset({
     "wl_req",
 })
 
+#: Every ProblemTensors field. The drain body is shape-static pure
+#: gather/scatter arithmetic with no host-side dependence on array
+#: CONTENT, so any field may carry the scenario axis — the federation
+#: dispatcher batches whole canvas-normalized problems from DIFFERENT
+#: clusters this way (sim/dispatch.py). BATCHABLE_FIELDS remains the
+#: documented subset single-problem overlay sweeps vary.
+ALL_PROBLEM_FIELDS = frozenset(ProblemTensors._fields)
+
 
 @functools.lru_cache(maxsize=None)
 def _batched_solver(fields: frozenset):
@@ -556,11 +564,11 @@ def solve_backlog_batched(t: ProblemTensors, overrides: dict):
     if not overrides:
         raise ValueError("batched solve needs at least one scenario-"
                          "varying field (use solve_backlog otherwise)")
-    bad = set(overrides) - BATCHABLE_FIELDS
+    bad = set(overrides) - ALL_PROBLEM_FIELDS
     if bad:
         raise ValueError(
-            f"fields {sorted(bad)} cannot vary per scenario; "
-            f"batchable: {sorted(BATCHABLE_FIELDS)}")
+            f"fields {sorted(bad)} are not ProblemTensors fields; "
+            f"batchable: {sorted(ALL_PROBLEM_FIELDS)}")
     fn = _batched_solver(frozenset(overrides))
     return fn(t._replace(**{k: jnp.asarray(v)
                             for k, v in overrides.items()}))

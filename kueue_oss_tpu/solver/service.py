@@ -111,6 +111,13 @@ def default_max_frame_bytes() -> int:
     return int(_env_float("KUEUE_SOLVER_MAX_FRAME_MB", 256.0) * (1 << 20))
 
 
+def default_max_sessions() -> int:
+    """Resident-session cap; KUEUE_SOLVER_MAX_SESSIONS overrides 4.
+    A federated farm (N tenants x ~2 kernel kinds each) must raise this
+    or the LRU thrashes — evictions are counted, never silent."""
+    return max(1, int(_env_float("KUEUE_SOLVER_MAX_SESSIONS", 4.0)))
+
+
 def _send(sock: socket.socket, header: dict, blob: bytes) -> None:
     h = json.dumps(header).encode()
     sock.sendall(struct.pack(">II", len(h), len(blob)))
@@ -478,6 +485,7 @@ def _session_request(header: dict, blob: bytes,
     t0 = time.perf_counter()
     kind = header["kind"]
     sid = str(header.get("sid", ""))
+    tenant = str(header.get("tenant", ""))
     if kind == "sync":
         data = np.load(io.BytesIO(blob))
         kwargs = {name: (np.array(data[name]) if name in data else None)
@@ -489,7 +497,7 @@ def _session_request(header: dict, blob: bytes,
             # transport corruption, not a session-state divergence
             return {"ok": False, "error": "sync frame checksum mismatch"
                     }, b""
-        sess = (server.session(sid) if server is not None
+        sess = (server.session(sid, tenant) if server is not None
                 else _SidecarSession())
         with sess.lock:
             sess.kwargs, sess.meta = kwargs, meta
@@ -504,7 +512,8 @@ def _session_request(header: dict, blob: bytes,
             arrays = compact_plan(out, bool(header["full"]))
             epoch = sess.epoch
     else:  # delta
-        sess = server.get_session(sid) if server is not None else None
+        sess = (server.get_session(sid, tenant)
+                if server is not None else None)
         if sess is None:
             return _resync("session_missing")
         with sess.lock:
@@ -524,7 +533,7 @@ def _session_request(header: dict, blob: bytes,
             if state_checksum(sess.kwargs, sess.meta) != delta.checksum:
                 # resident state diverged from the host's: drop the
                 # session so the client re-seeds it with a full SYNC
-                server.drop_session(sid)
+                server.drop_session(sid, tenant)
                 return _resync("checksum_mismatch")
             problem = SolverProblem(**sess.kwargs, **sess.meta)
             frame = SessionFrame(epoch=delta.epoch,
@@ -558,11 +567,28 @@ def solve_request(header: dict, blob: bytes,
     carries the session store for SYNC/DELTA frames; without it, SYNC
     degrades to a stateless solve and DELTA answers resync.
 
+    With a solver farm attached (``server.farm``, see
+    federation/farm.py), the whole solve body runs under the farm's
+    weighted deficit-round-robin admission: the tenant id from the
+    frame header picks the queue, and an over-quota tenant gets an
+    in-band backpressure error instead of solver time — the client
+    collapses that into ``SolverUnavailable`` and the engine degrades
+    to host cycles, so a starved tenant never wedges.
+
     The optional ``trace_cycle`` header field is the host scheduler's
     cycle id: the response carries a ``spans`` list timing the sidecar
     solve, tagged with that cycle, so the engine can merge it into the
     host Tracer's Chrome-trace export as one timeline.
     """
+    farm = getattr(server, "farm", None)
+    if farm is not None:
+        return farm.run(str(header.get("tenant", "")),
+                        lambda: _solve_request_body(header, blob, server))
+    return _solve_request_body(header, blob, server)
+
+
+def _solve_request_body(header: dict, blob: bytes,
+                        server=None) -> tuple[dict, bytes]:
     kind = header.get("kind", "solve")
     if kind in ("sync", "delta"):
         if server is not None and getattr(server, "multihost", False):
@@ -645,7 +671,7 @@ class SolverServer(socketserver.ThreadingUnixStreamServer):
     def __init__(self, socket_path: str,
                  max_frame_bytes: Optional[int] = None,
                  read_timeout_s: Optional[float] = None,
-                 max_sessions: int = 4,
+                 max_sessions: Optional[int] = None,
                  mesh_mode: Optional[str] = None,
                  mesh_min_workloads: int = 1024) -> None:
         if os.path.exists(socket_path):
@@ -656,11 +682,19 @@ class SolverServer(socketserver.ThreadingUnixStreamServer):
                                 is not None else default_max_frame_bytes())
         self.read_timeout_s = (read_timeout_s if read_timeout_s
                                is not None else default_timeout_s())
-        #: delta-sync session store (sid -> _SidecarSession), LRU-capped
-        #: so abandoned sessions can't accumulate resident problems
-        self.sessions: dict[str, _SidecarSession] = {}
+        #: delta-sync session store ((tenant, sid) -> _SidecarSession),
+        #: LRU-capped so abandoned sessions can't accumulate resident
+        #: problems. The tenant component namespaces the table: two
+        #: control planes reusing a sid can never read each other's
+        #: resident state (docs/FEDERATION.md).
+        self.sessions: dict[tuple[str, str], _SidecarSession] = {}
         self._sessions_lock = threading.Lock()
-        self.max_sessions = max(1, int(max_sessions))
+        self.max_sessions = (max(1, int(max_sessions))
+                             if max_sessions is not None
+                             else default_max_sessions())
+        #: optional federation/farm.py FarmScheduler; when set, every
+        #: decoded request is admitted through its per-tenant DRR queue
+        self.farm = None
         #: sidecar mesh detection (solver/meshutil.py): sessions place
         #: their resident lean tensors over the mesh and solve via the
         #: sharded SPMD drain; full solves lane-shard. KUEUE_SOLVER_MESH
@@ -683,27 +717,44 @@ class SolverServer(socketserver.ThreadingUnixStreamServer):
         self.multihost = False
         self._multihost_lock = threading.Lock()
 
-    def session(self, sid: str) -> _SidecarSession:
+    def session(self, sid: str, tenant: str = "") -> _SidecarSession:
+        key = (tenant, sid)
         with self._sessions_lock:
-            sess = self.sessions.pop(sid, None)
+            sess = self.sessions.pop(key, None)
             if sess is None:
                 sess = _SidecarSession(mesh=self.mesh)
                 sess.device.mesh_min_rows = self.mesh_min_workloads
-            self.sessions[sid] = sess  # re-insert = LRU touch
+            self.sessions[key] = sess  # re-insert = LRU touch
             while len(self.sessions) > self.max_sessions:
                 self.sessions.pop(next(iter(self.sessions)))
+                metrics.solver_session_evictions_total.inc("lru")
             return sess
 
-    def get_session(self, sid: str) -> Optional[_SidecarSession]:
+    def get_session(self, sid: str,
+                    tenant: str = "") -> Optional[_SidecarSession]:
+        key = (tenant, sid)
         with self._sessions_lock:
-            sess = self.sessions.pop(sid, None)
+            sess = self.sessions.pop(key, None)
             if sess is not None:
-                self.sessions[sid] = sess
+                self.sessions[key] = sess
             return sess
 
-    def drop_session(self, sid: str) -> None:
+    def drop_session(self, sid: str, tenant: str = "") -> None:
         with self._sessions_lock:
-            self.sessions.pop(sid, None)
+            self.sessions.pop((tenant, sid), None)
+
+    def drop_tenant(self, tenant: str) -> int:
+        """Evict every resident session of one tenant (farm-side chaos /
+        tenant decommission); the tenant's next frame answers
+        ``resync: session_missing`` and its client re-seeds with a full
+        SYNC — counted, never silent. Returns the eviction count."""
+        with self._sessions_lock:
+            victims = [k for k in self.sessions if k[0] == tenant]
+            for k in victims:
+                self.sessions.pop(k, None)
+                metrics.solver_session_evictions_total.inc(
+                    "tenant_evicted")
+            return len(victims)
 
     def serve_in_background(self) -> threading.Thread:
         t = threading.Thread(target=self.serve_forever, daemon=True)
@@ -771,8 +822,13 @@ class SolverClient:
                  jitter_seed: int = 0,
                  clock=time.monotonic,
                  sleep=time.sleep,
-                 sessions: Optional[bool] = None) -> None:
+                 sessions: Optional[bool] = None,
+                 tenant: str = "") -> None:
         self.socket_path = socket_path
+        #: federation tenant id; rides EVERY frame header so the farm's
+        #: DRR scheduler can bill the request and the sidecar keys the
+        #: session under (tenant, sid) — empty = single-tenant sidecar
+        self.tenant = str(tenant)
         self.timeout_s = (timeout_s if timeout_s is not None
                           else default_timeout_s())
         self.max_retries = max(0, int(max_retries))
@@ -815,7 +871,9 @@ class SolverClient:
                    backoff_base_s=cfg.retry_backoff_base_seconds,
                    backoff_max_s=cfg.retry_backoff_max_seconds,
                    max_frame_bytes=cfg.max_frame_bytes,
-                   sessions=getattr(cfg, "sessions_enabled", None))
+                   sessions=getattr(cfg, "sessions_enabled", None),
+                   tenant=getattr(cfg, "tenant", "")
+                   or os.environ.get("KUEUE_SOLVER_TENANT", ""))
 
     # -- payload builders --------------------------------------------------
 
@@ -823,6 +881,8 @@ class SolverClient:
                      p_max: int, fs_enabled: bool) -> dict:
         params = {"full": full, "g_max": g_max, "h_max": h_max,
                   "p_max": p_max, "fs_enabled": fs_enabled}
+        if self.tenant:
+            params["tenant"] = self.tenant
         if self.trace_cycle is not None:
             params["trace_cycle"] = int(self.trace_cycle)
         return params

@@ -74,6 +74,13 @@ def pytest_configure(config):
         "columnar export/delta pipeline; paired with slow — tier-1 "
         "runs the 50k x 1k smoke instead")
     config.addinivalue_line(
+        "markers", "federation: federated control-plane tests "
+        "(kueue_oss_tpu/federation/ + sim/dispatch.py + the WhatIf "
+        "MultiKueue dispatcher): multi-tenant solver-farm DRR fairness "
+        "and isolation, what-if dispatch pricing vs the sequential "
+        "oracle, and member-loss chaos recovery; deterministic, runs "
+        "in tier-1")
+    config.addinivalue_line(
         "markers", "slo: cluster health layer tests (obs/ledger.py + "
         "obs/health.py): virtual-clock burn-rate sequences, starvation "
         "watchdog, exemplar round-trips, ledger joins, and the "

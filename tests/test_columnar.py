@@ -329,3 +329,37 @@ def test_smoke_50k_1k_cached_reexport_beats_walk():
 @pytest.mark.megascale
 def test_megascale_1m_10k_cached_reexport_beats_walk():
     _scale_harness(1_000_000, 10_000, 20.0, IDENTITY_FIELDS)
+
+
+def test_afs_bailout_is_counted_and_stamped():
+    """A columnar export that hands back to the classic walk must be
+    ACCOUNTED: counted by reason in columnar_bailouts_total and stamped
+    into last_stats (mode="bailout:<reason>") so the engine's export
+    phase surfaces it in the cycle ledger — a silent per-cycle walk at
+    megascale is a regression, not a fallback."""
+    from kueue_oss_tpu import metrics
+    from kueue_oss_tpu.api.types import AdmissionScope
+    from kueue_oss_tpu.config.configuration import (
+        AdmissionFairSharingConfig,
+    )
+    from kueue_oss_tpu.core.afs import AfsManager
+
+    store = build_store()
+    cq = store.cluster_queues["a"]
+    cq.admission_scope = AdmissionScope()
+    store.upsert_cluster_queue(cq)
+    afs = AfsManager(AdmissionFairSharingConfig())
+    qm = QueueManager(store, afs=afs)
+    cache = ExportCache(store)
+    for i in range(4):
+        submit(store, f"w{i}", "a", float(i), 100 + i)
+    before = metrics.columnar_bailouts_total.collect().get(
+        ("afs_active",), 0)
+    problem = export_problem(store, backlog(qm), cache=cache,
+                             afs=afs, now=1.0)
+    assert problem is not None, "the classic walk still serves the export"
+    assert metrics.columnar_bailouts_total.collect().get(
+        ("afs_active",), 0) == before + 1
+    stats = cache.columnar.last_stats
+    assert stats["mode"] == "bailout:afs_active"
+    assert stats["rows"] == 0 and stats["dirty_rows"] == 0

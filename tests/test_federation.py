@@ -1,0 +1,662 @@
+"""Federated control-plane tests (docs/FEDERATION.md).
+
+Contract under test, by layer:
+
+1. farm DRR — solver wall-time shares track tenant weights (within the
+   1.5x acceptance band), expensive solves are debts not free rides,
+   idle credit is forfeited/capped, and an over-quota or starved tenant
+   gets an IN-BAND backpressure error (degrade, never wedge);
+2. tenant isolation — two control planes interleaving SYNC/DELTA
+   against ONE sidecar never observe each other's resident state (the
+   checksum handshake proves whose state each session holds), their
+   plans stay bit-identical to dedicated-sidecar twins, and evicting
+   one tenant's sessions mid-churn heals through RESYNC with the
+   neighbor's sessions untouched;
+3. what-if dispatch — the WhatIf MultiKueue dispatcher nominates the
+   single predicted-best worker, matches the sequential per-cluster
+   oracle bit-for-bit through the canvas normalization, and falls back
+   to Incremental whenever a lane is unpriceable;
+4. member loss — the chaos injector's silent worker drop re-dispatches
+   only past the grace window, a flap inside it never re-dispatches,
+   and a member store recovers byte-identical on a WAL-shipped warm
+   standby.
+"""
+
+import os
+import tempfile
+
+import pytest
+
+from kueue_oss_tpu import metrics, obs
+from kueue_oss_tpu.api.types import (
+    AdmissionCheck,
+    CheckState,
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_oss_tpu.chaos import ClusterLossInjector
+from kueue_oss_tpu.config import load as load_config
+from kueue_oss_tpu.config import validate as validate_config
+from kueue_oss_tpu.controllers import WorkloadReconciler
+from kueue_oss_tpu.core.queue_manager import QueueManager
+from kueue_oss_tpu.core.store import Store
+from kueue_oss_tpu.federation import (
+    FarmScheduler,
+    attach_farm,
+    build_fleet,
+    build_member,
+    plan_fingerprint,
+)
+from kueue_oss_tpu.federation.farm import _Ticket
+from kueue_oss_tpu.multikueue import (
+    MULTIKUEUE_CONTROLLER_NAME,
+    MultiKueueCluster,
+    MultiKueueController,
+    WhatIfDispatcher,
+    WorkerEnvironment,
+)
+from kueue_oss_tpu.persist import (
+    PersistenceManager,
+    WarmStandby,
+    canonical_dump,
+)
+from kueue_oss_tpu.scheduler.scheduler import Scheduler
+from kueue_oss_tpu.sim.dispatch import price_dispatch
+from kueue_oss_tpu.solver.delta import state_checksum
+from kueue_oss_tpu.solver.service import (
+    SolverClient,
+    SolverServer,
+    default_max_sessions,
+)
+
+pytestmark = pytest.mark.federation
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    metrics.reset_all()
+    obs.recorder.clear()
+    obs.cycle_ledger.clear()
+    yield
+    metrics.reset_all()
+    obs.recorder.clear()
+    obs.cycle_ledger.clear()
+
+
+# ---------------------------------------------------------------------------
+# shared builders
+# ---------------------------------------------------------------------------
+
+
+def _seed_cluster(store, n_cqs=4, quota=8):
+    store.upsert_resource_flavor(ResourceFlavor(name="f"))
+    for i in range(n_cqs):
+        store.upsert_cluster_queue(ClusterQueue(
+            name=f"cq{i}", preemption=PreemptionPolicy(),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f", resources=[
+                    ResourceQuota(name="cpu", nominal=quota)])])]))
+        store.upsert_local_queue(LocalQueue(
+            name=f"lq{i}", cluster_queue=f"cq{i}"))
+
+
+def _wl(i, prio=0, cpu=1):
+    return Workload(
+        name=f"w{i}", queue_name=f"lq{i % 4}", uid=i + 1, priority=prio,
+        creation_time=float(i),
+        podsets=[PodSet(name="main", count=1, requests={"cpu": cpu})])
+
+
+def _sock_path():
+    return os.path.join(tempfile.mkdtemp(), "solver.sock")
+
+
+def _churn(member, cycles, uid0, churn=2):
+    """finish-some / submit-some / drain, the solver-delta recipe."""
+    uid = uid0
+    for cyc in range(1, cycles + 1):
+        admitted = sorted(
+            k for k, w in member.store.workloads.items()
+            if w.is_quota_reserved and not w.is_finished)
+        for k in admitted[:churn]:
+            member.scheduler.finish_workload(k, now=float(cyc))
+        for _ in range(churn):
+            member.store.add_workload(_wl(uid))
+            uid += 1
+        member.drain(now=float(cyc))
+    return uid
+
+
+@pytest.fixture()
+def farm_server():
+    path = _sock_path()
+    srv = SolverServer(path)
+    farm = attach_farm(srv, weights={"cp-a": 2.0, "cp-b": 1.0})
+    srv.serve_in_background()
+    yield path, srv, farm
+    srv.shutdown()
+    srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# 1. farm DRR fairness (deterministic: driven grants, injected walls)
+# ---------------------------------------------------------------------------
+
+
+def _drive(fs, tenants, total, wall_for, deficit_cap_check=False):
+    """Keep every tenant backlogged and pump ``total`` grants through
+    the single slot synchronously, charging ``wall_for(tenant)`` per
+    completed solve. Returns (grants, walls) per tenant."""
+    grants = {t: 0 for t in tenants}
+    walls = {t: 0.0 for t in tenants}
+    pending = {t: [] for t in tenants}
+    for _ in range(total):
+        with fs._lock:
+            for t in tenants:
+                fs._register_locked(t)
+                while len(fs._queues[t]) < 2:
+                    tk = _Ticket()
+                    fs._queues[t].append(tk)
+                    pending[t].append(tk)
+            fs._grant_next_locked()
+        winner = None
+        for t in tenants:
+            for tk in pending[t]:
+                if tk.granted.is_set():
+                    winner = t
+                    pending[t].remove(tk)
+                    break
+            if winner:
+                break
+        assert winner is not None, "a backlogged farm must always grant"
+        grants[winner] += 1
+        walls[winner] += wall_for(winner)
+        fs._complete(winner, wall_for(winner))
+        if deficit_cap_check:
+            for t in tenants:
+                cap = fs.quantum_s * fs.weight(t) * fs.max_credit_quanta
+                assert fs._deficit.get(t, 0.0) <= cap + 1e-9
+    return grants, walls
+
+
+def test_drr_grant_shares_track_weights():
+    fs = FarmScheduler(weights={"a": 3.0, "b": 1.0}, quantum_s=0.01,
+                       max_queued=64)
+    grants, _ = _drive(fs, ["a", "b"], 200, lambda t: 0.01)
+    ratio = grants["a"] / max(1, grants["b"])
+    assert 3.0 / 1.5 <= ratio <= 3.0 * 1.5, grants
+
+
+def test_drr_wall_time_shares_survive_uneven_solve_costs():
+    """Equal weights, 5x cost skew: WALL-TIME shares stay ~1:1 (the
+    expensive tenant gets fewer grants, not more seconds)."""
+    fs = FarmScheduler(quantum_s=0.002, max_queued=64)
+    costs = {"big": 0.005, "small": 0.001}
+    grants, walls = _drive(fs, ["big", "small"], 300,
+                           lambda t: costs[t])
+    share = walls["big"] / max(1e-12, walls["small"])
+    assert 1.0 / 1.5 <= share <= 1.5, walls
+    assert grants["small"] > grants["big"], \
+        "cheap solves must out-count expensive ones at equal wall share"
+    # farm ledgers carry the same totals
+    assert fs.wall_by_tenant["big"] == pytest.approx(walls["big"])
+    assert fs.served == grants
+
+
+def test_drr_idle_credit_forfeited_and_capped():
+    fs = FarmScheduler(quantum_s=0.01, max_credit_quanta=2.0,
+                       max_queued=64)
+    with fs._lock:
+        fs._register_locked("idle")
+    # a debtor (huge walls) forces accrual rounds on its neighbor:
+    # the neighbor's banked credit must stay under the cap, and the
+    # idle tenant must bank nothing at all
+    _drive(fs, ["debtor", "saver"], 60,
+           lambda t: 0.08 if t == "debtor" else 0.001,
+           deficit_cap_check=True)
+    assert fs._deficit.get("idle", 0.0) <= 0.0
+
+
+def test_farm_backpressure_on_queue_overflow():
+    fs = FarmScheduler(max_queued=2)
+    fs._busy = True  # wedge the slot so nothing drains
+    with fs._lock:
+        fs._register_locked("t")
+        fs._queues["t"].extend([_Ticket(), _Ticket()])
+    header, blob = fs.run("t", lambda: ({"ok": True}, b""))
+    assert header["ok"] is False and "backpressure" in header["error"]
+    assert blob == b""
+    assert fs.throttled["t"] == 1
+    assert metrics.solver_farm_throttled_total.collect().get(
+        ("t",), 0) == 1
+
+
+def test_farm_backpressure_on_grant_starvation():
+    fs = FarmScheduler(grant_timeout_s=0.01)
+    fs._busy = True  # the slot never frees: grant wait must time out
+    header, _ = fs.run("t", lambda: ({"ok": True}, b""))
+    assert header["ok"] is False and "backpressure" in header["error"]
+    assert fs.throttled["t"] == 1
+
+
+def test_farm_from_config():
+    cfg = load_config({"federation": {
+        "tenantWeights": {"a": 2.0}, "defaultWeight": 0.5,
+        "quantum": 0.004, "maxQueued": 3, "maxCreditQuanta": 2.5}})
+    assert validate_config(cfg) == []
+    fs = FarmScheduler.from_config(cfg.federation)
+    assert fs.weights == {"a": 2.0}
+    assert fs.default_weight == 0.5
+    assert fs.quantum_s == 0.004
+    assert fs.max_queued == 3
+    assert fs.max_credit_quanta == 2.5
+    bad = load_config({"federation": {"defaultWeight": 0.0},
+                       "multiKueue": {"dispatcherName": "WhatIf"}})
+    errs = validate_config(bad)
+    assert any("defaultWeight" in e for e in errs)
+    assert not any("dispatcherName" in e for e in errs), \
+        "WhatIf is a valid dispatcher name"
+
+
+# ---------------------------------------------------------------------------
+# 2. tenant session isolation on the wire
+# ---------------------------------------------------------------------------
+
+
+def _host_checksum(member):
+    sess = next(iter(member.engine._delta_sessions.values()))
+    kwargs, meta = sess._last
+    return state_checksum(kwargs, meta)
+
+
+def _sidecar_checksums(srv):
+    with srv._sessions_lock:
+        return {k: state_checksum(s.kwargs, s.meta)
+                for k, s in srv.sessions.items()}
+
+
+def test_tenant_sessions_isolated_under_interleaved_churn(farm_server):
+    path, srv, farm = farm_server
+    fleet = build_fleet(["cp-a", "cp-b"], socket_path=path,
+                        seed=lambda name, s: _seed_cluster(s),
+                        pad_to=64)
+    uids = {"cp-a": 0, "cp-b": 1000}
+    for name, m in fleet.items():
+        for i in range(24):
+            m.store.add_workload(_wl(i + uids[name]))
+        m.drain(now=0.0)
+    # interleave the tenants' churn cycle by cycle
+    next_uid = {"cp-a": 100, "cp-b": 2100}
+    for cyc in range(4):
+        for name, m in fleet.items():
+            next_uid[name] = _churn(m, 1, next_uid[name])
+    # every resident session belongs to exactly one tenant, and the
+    # checksum handshake proves WHOSE state each one holds: it matches
+    # its own tenant's host session and nobody else's
+    sums = _sidecar_checksums(srv)
+    assert {k[0] for k in sums} == {"cp-a", "cp-b"}
+    host = {name: _host_checksum(m) for name, m in fleet.items()}
+    assert host["cp-a"] != host["cp-b"], "distinct churn, distinct state"
+    for (tenant, _sid), chk in sums.items():
+        assert chk == host[tenant]
+        other = "cp-b" if tenant == "cp-a" else "cp-a"
+        assert chk != host[other], "cross-tenant state observed"
+    # farm-vs-dedicated bit-identity: a host-side twin of each member
+    # running the same churn lands the exact same plan
+    for name in fleet:
+        twin = build_member(f"{name}-twin", pad_to=64,
+                            seed=lambda s: _seed_cluster(s))
+        twin.engine.use_sessions = False
+        for i in range(24):
+            twin.store.add_workload(_wl(i + uids[name]))
+        twin.drain(now=0.0)
+        _churn(twin, 4, 100 if name == "cp-a" else 2100)
+        assert (plan_fingerprint(twin.store, twin.queues)
+                == plan_fingerprint(fleet[name].store,
+                                    fleet[name].queues)), name
+    # both tenants were admitted through the DRR and billed
+    assert farm.served["cp-a"] >= 4 and farm.served["cp-b"] >= 4
+    assert metrics.solver_farm_requests_total.collect().get(
+        ("cp-a",), 0) >= 4
+
+
+def test_tenant_eviction_mid_churn_heals_without_neighbor_impact(
+        farm_server):
+    path, srv, farm = farm_server
+    fleet = build_fleet(["cp-a", "cp-b"], socket_path=path,
+                        seed=lambda name, s: _seed_cluster(s),
+                        pad_to=64)
+    for off, m in zip((0, 1000), fleet.values()):
+        for i in range(24):
+            m.store.add_workload(_wl(i + off))
+        m.drain(now=0.0)
+    ua = _churn(fleet["cp-a"], 2, 100)
+    ub = _churn(fleet["cp-b"], 2, 2100)
+    with srv._sessions_lock:
+        neighbor = {k: v for k, v in srv.sessions.items()
+                    if k[0] == "cp-a"}
+    # mid-churn farm-side eviction of cp-b via the chaos injector
+    injector = ClusterLossInjector(controller=None, farm_server=srv)
+    n = injector.evict_farm_tenant("cp-b")
+    assert n >= 1 and injector.injected["tenant_evict"] == 1
+    assert metrics.solver_session_evictions_total.collect().get(
+        ("tenant_evicted",), 0) == n
+    resyncs0 = metrics.solver_resync_total.total()
+    _churn(fleet["cp-b"], 1, ub)  # heals in-band, one RESYNC
+    assert metrics.solver_resync_total.total() == resyncs0 + 1
+    # cp-a's resident sessions are the SAME objects, same state
+    with srv._sessions_lock:
+        for k, sess in neighbor.items():
+            assert srv.sessions.get(k) is sess
+    _churn(fleet["cp-a"], 1, ua)
+    assert metrics.solver_resync_total.total() == resyncs0 + 1, \
+        "the neighbor must not resync after someone else's eviction"
+    # and the evicted tenant's re-seeded state is correct
+    sums = _sidecar_checksums(srv)
+    host_b = _host_checksum(fleet["cp-b"])
+    assert any(chk == host_b for (t, _), chk in sums.items()
+               if t == "cp-b")
+
+
+# ---------------------------------------------------------------------------
+# 3. session-cap satellite: configurable max_sessions
+# ---------------------------------------------------------------------------
+
+
+def test_max_sessions_env_default(monkeypatch):
+    monkeypatch.setenv("KUEUE_SOLVER_MAX_SESSIONS", "2")
+    assert default_max_sessions() == 2
+    monkeypatch.delenv("KUEUE_SOLVER_MAX_SESSIONS")
+    assert default_max_sessions() == 4
+
+
+def test_max_sessions_lru_eviction_is_counted():
+    srv = SolverServer(_sock_path(), max_sessions=2)
+    try:
+        srv.session("s1", tenant="a")
+        srv.session("s2", tenant="a")
+        srv.session("s1", tenant="b")  # third distinct key: evicts LRU
+        assert len(srv.sessions) == 2
+        assert ("a", "s1") not in srv.sessions, "LRU order evicts s1"
+        assert metrics.solver_session_evictions_total.collect().get(
+            ("lru",), 0) == 1
+    finally:
+        srv.server_close()
+
+
+def test_solver_config_carries_tenant_and_max_sessions():
+    cfg = load_config({"solver": {"tenant": "cp-x", "maxSessions": 7,
+                                  "socketPath": "/tmp/x.sock"}})
+    assert validate_config(cfg) == []
+    assert cfg.solver.tenant == "cp-x"
+    assert cfg.solver.max_sessions == 7
+    bad = load_config({"solver": {"maxSessions": 0}})
+    assert any("maxSessions" in e for e in validate_config(bad))
+    client = SolverClient.from_config(cfg.solver)
+    assert client.tenant == "cp-x"
+
+
+# ---------------------------------------------------------------------------
+# 4. what-if dispatch pricing
+# ---------------------------------------------------------------------------
+
+
+def _worker_env(name, quota, background_cpu=(), cohorted=False,
+                preempt=False, n_cqs=1, nflavors=1):
+    env = WorkerEnvironment(name)
+    store = env.store
+    for j in range(nflavors):
+        store.upsert_resource_flavor(ResourceFlavor(name=f"f{j}"))
+    if cohorted:
+        store.upsert_cohort(Cohort(name="pool"))
+    for i in range(n_cqs):
+        store.upsert_cluster_queue(ClusterQueue(
+            name=f"wcq{i}", cohort="pool" if cohorted else None,
+            preemption=(PreemptionPolicy(
+                within_cluster_queue="LowerPriority") if preempt
+                else PreemptionPolicy()),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name=f"f{j}", resources=[
+                    ResourceQuota(name="cpu", nominal=quota)])
+                    for j in range(nflavors)])]))
+        store.upsert_local_queue(LocalQueue(
+            name=f"lq{i}" if i else "lq", cluster_queue=f"wcq{i}"))
+    for i, cpu in enumerate(background_cpu):
+        store.add_workload(Workload(
+            name=f"bg{i}", queue_name="lq", creation_time=float(i),
+            podsets=[PodSet(count=1, requests={"cpu": cpu})]))
+    env.run_cycle(5.0)
+    return env
+
+
+def test_price_dispatch_matches_oracle_across_heterogeneous_shapes():
+    """Clusters with different CQ counts, cohort forests, and flavor
+    vocabularies batch through the canvas normalization — and every
+    lane's plan is bit-identical to solving it alone."""
+    envs = {
+        "lean": _worker_env("lean", 4000, background_cpu=(1000, 1000)),
+        "wide": _worker_env("wide", 3000, background_cpu=(500,) * 4,
+                            cohorted=True, n_cqs=3),
+        "rich": _worker_env("rich", 2000, background_cpu=(1500,),
+                            nflavors=2, n_cqs=2),
+    }
+    wl = Workload(name="cand", queue_name="lq", creation_time=50.0,
+                  podsets=[PodSet(count=1, requests={"cpu": 1200})])
+    report = price_dispatch(wl, envs, now=51.0, check_oracle=True)
+    assert not report.unpriceable
+    assert report.oracle_identical, \
+        "batched lanes must match the sequential oracle bit-for-bit"
+    assert report.best == report.oracle_best
+    assert len(report.scores) == 3
+    assert report.batch_width >= 3
+
+
+class FedEnv:
+    """Hub + heterogeneous workers under the WhatIf dispatcher (the
+    test_multikueue MkEnv recipe, federated)."""
+
+    def __init__(self, workers, dispatcher=None, hub_quota=16000,
+                 worker_lost_timeout_s=100.0):
+        self.hub_store = Store()
+        self.hub_store.upsert_resource_flavor(ResourceFlavor(name="f0"))
+        self.hub_store.upsert_cluster_queue(ClusterQueue(
+            name="hubcq", admission_checks=["multikueue"],
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f0", resources=[
+                    ResourceQuota(name="cpu", nominal=hub_quota)])])]))
+        self.hub_store.upsert_local_queue(LocalQueue(
+            name="lq", cluster_queue="hubcq"))
+        self.hub_store.upsert_admission_check(AdmissionCheck(
+            name="multikueue",
+            controller_name=MULTIKUEUE_CONTROLLER_NAME))
+        self.hub_queues = QueueManager(self.hub_store)
+        self.hub_scheduler = Scheduler(self.hub_store, self.hub_queues)
+        self.hub_wr = WorkloadReconciler(self.hub_store,
+                                         self.hub_scheduler)
+        self.clusters = [MultiKueueCluster(name=e.name, environment=e)
+                         for e in workers]
+        self.dispatcher = dispatcher or WhatIfDispatcher(
+            check_oracle=True)
+        self.mk = MultiKueueController(
+            self.hub_store, self.hub_scheduler, self.clusters,
+            dispatcher=self.dispatcher,
+            worker_lost_timeout_s=worker_lost_timeout_s)
+        self.t = 10.0
+
+    def submit(self, name="wl", cpu=1000):
+        self.t += 1.0
+        self.hub_store.add_workload(Workload(
+            name=name, queue_name="lq", creation_time=self.t,
+            podsets=[PodSet(count=1, requests={"cpu": cpu})]))
+
+    def tick(self, run_workers=True):
+        self.t += 1.0
+        self.hub_scheduler.schedule(self.t)
+        self.mk.reconcile_all(self.t)
+        if run_workers:
+            for c in self.clusters:
+                if c.active:
+                    c.environment.run_cycle(self.t)
+        self.mk.reconcile_all(self.t)
+        self.hub_wr.reconcile_all(self.t)
+        return self.t
+
+    def wl(self, name="wl"):
+        return self.hub_store.workloads[f"default/{name}"]
+
+
+def _whatif_outcomes():
+    c = metrics.multikueue_whatif_dispatch_total.collect()
+    return {k[0]: v for k, v in c.items()}
+
+
+def test_whatif_nominates_single_predicted_best_worker():
+    envs = [
+        _worker_env("tight", 2000, background_cpu=(1500,)),
+        _worker_env("roomy", 8000, background_cpu=(1000,)),
+        _worker_env("full", 2000, background_cpu=(2000,)),
+    ]
+    fed = FedEnv(envs)
+    fed.submit(cpu=1000)
+    fed.tick()
+    wl = fed.wl()
+    # exactly one worker raced: the one the pricer predicted
+    assert wl.status.cluster_name == "roomy"
+    report = fed.dispatcher.last_reports[wl.key]
+    assert report.best == "roomy"
+    assert report.oracle_best == report.best
+    assert report.oracle_identical
+    assert _whatif_outcomes().get("scored", 0) >= 1
+    _, _, n_obs = metrics.multikueue_dispatch_score_ms._values[()]
+    assert n_obs >= 1, "every pricing call must observe its wall"
+    # only the winner ever saw a mirror (no blind racing)
+    for c in fed.clusters:
+        mirror = c.environment.store.workloads.get(wl.key)
+        assert (mirror is not None) == (c.name == "roomy")
+
+
+def test_whatif_falls_back_to_incremental_when_unpriceable():
+    envs = [
+        _worker_env("p1", 4000, background_cpu=(500,), preempt=True),
+        _worker_env("p2", 4000, background_cpu=(500,), preempt=True),
+    ]
+    fed = FedEnv(envs)
+    fed.submit(cpu=1000)
+    fed.tick()
+    wl = fed.wl()
+    assert wl.status.cluster_name in ("p1", "p2")
+    assert _whatif_outcomes().get("fallback", 0) >= 1, \
+        "preemption-enabled lanes are unpriceable: must degrade"
+    report = fed.dispatcher.last_reports.get(wl.key)
+    if report is not None:
+        assert set(report.unpriceable) == {"p1", "p2"}
+
+
+def test_whatif_defers_within_an_unfinished_round():
+    envs = [
+        _worker_env("fullA", 2000, background_cpu=(2000,)),
+        _worker_env("fullB", 2000, background_cpu=(2000,)),
+    ]
+    fed = FedEnv(envs)
+    fed.submit(cpu=1000)  # fits nowhere: the round cannot admit
+    fed.tick()
+    wl = fed.wl()
+    nominated = list(wl.status.nominated_cluster_names)
+    assert len(nominated) == 1, "scored round nominates exactly one"
+    fed.tick()
+    assert _whatif_outcomes().get("deferred", 0) >= 1
+    assert list(wl.status.nominated_cluster_names) == nominated, \
+        "no second nomination while the round clock runs"
+
+
+# ---------------------------------------------------------------------------
+# 5. member-loss chaos
+# ---------------------------------------------------------------------------
+
+
+def test_worker_silent_drop_redispatches_only_past_grace():
+    envs = [
+        _worker_env("big", 8000, background_cpu=(1000,)),
+        _worker_env("small", 4000, background_cpu=(1000,)),
+    ]
+    fed = FedEnv(envs, worker_lost_timeout_s=100.0)
+    fed.submit(cpu=1000)
+    fed.tick()
+    wl = fed.wl()
+    winner = wl.status.cluster_name
+    assert winner == "big"
+    injector = ClusterLossInjector(fed.mk)
+    assert injector.drop_worker(winner) == winner
+    # inside the grace window: still bound to the silent worker
+    fed.tick()
+    assert wl.status.cluster_name == winner
+    state = wl.status.admission_checks["multikueue"]
+    assert state.state == CheckState.READY
+    # past the grace window: RETRY + re-dispatch to the survivor
+    fed.t += 200.0
+    fed.tick()
+    assert state.state in (CheckState.RETRY, CheckState.READY)
+    for _ in range(3):
+        fed.tick()
+    assert wl.status.cluster_name == "small", \
+        "lost-member workloads must re-dispatch to a live worker"
+    assert injector.faults_injected() == 1
+
+
+def test_worker_flap_inside_grace_never_redispatches():
+    envs = [
+        _worker_env("big", 8000, background_cpu=(1000,)),
+        _worker_env("small", 4000, background_cpu=(1000,)),
+    ]
+    fed = FedEnv(envs, worker_lost_timeout_s=100.0)
+    fed.submit(cpu=1000)
+    fed.tick()
+    wl = fed.wl()
+    winner = wl.status.cluster_name
+    injector = ClusterLossInjector(fed.mk)
+    injector.flap_worker(winner, fed.t)
+    for _ in range(3):
+        fed.tick()
+    assert wl.status.cluster_name == winner, \
+        "a link flap inside the grace window must not re-dispatch"
+    assert injector.injected == {"worker_drop": 1, "worker_flap": 1,
+                                 "worker_restore": 1}
+
+
+def test_member_store_recovers_byte_identical_on_warm_standby(
+        tmp_path):
+    """WAL-shipped warm standby: a federation member's control plane
+    state is byte-identical after standby promotion — the member
+    recovery half of the chaos acceptance."""
+    d = str(tmp_path / "member-a")
+    ship = str(tmp_path / "standby-a")
+    store = Store()
+    _seed_cluster(store, n_cqs=2, quota=1000)
+    mgr = PersistenceManager(d, fsync="off", ship_to=ship)
+    mgr.attach(store)
+    for i in range(6):
+        store.add_workload(_wl(i, cpu=100))
+    mgr.checkpoint()
+    for i in range(6, 10):
+        store.add_workload(_wl(i, cpu=100))
+    store.delete_workload(next(iter(store.workloads)))
+    mgr.flush()
+    standby = WarmStandby(ship)
+    assert standby.catch_up() > 0
+    for i in range(10, 12):
+        store.add_workload(_wl(i, cpu=100))  # the unsynced tail
+    mgr.flush()
+    promoted, _tail = standby.promote()
+    assert canonical_dump(promoted) == canonical_dump(store)
+    mgr.close()

@@ -60,6 +60,25 @@ SCHEMAS = {
         "arrivals_per_sec_host": NUM,
         "arrivals_speedup": NUM,
     },
+    # the federation scenario's tail (bench.py "federation"): farm DRR
+    # fairness under contended churn + what-if-scored dispatch
+    "federation": {
+        "scenario": str,
+        "tenants": int,
+        "members": int,
+        "contended_seconds": NUM,
+        "farm_solves": int,
+        "farm_throttled": int,
+        "tenant_wall_share_spread": NUM,
+        "zero_cross_tenant": bool,
+        "plans_identical_dedicated": bool,
+        "whatif_dispatches": int,
+        "whatif_oracle_agreement": NUM,
+        "dispatch_score_ms_mean": NUM,
+        "whatif_time_to_admit_s": NUM,
+        "incremental_time_to_admit_s": NUM,
+        "whatif_admit_speedup": NUM,
+    },
     # the orchestrated run's headline tail (bench.py main): only the
     # always-present core — optional scenarios may drop their fields
     "main": {
@@ -82,6 +101,18 @@ FLOORS = {
         "export_speedup": 20.0,
         "arrivals_speedup": 10.0,
     },
+    "federation": {
+        "whatif_oracle_agreement": 0.95,
+        "whatif_admit_speedup": 1.0,
+    },
+}
+
+#: --strict acceptance ceilings per scenario (upper bounds: fairness
+#: spreads and overheads regress UPWARD)
+CEILINGS = {
+    "federation": {
+        "tenant_wall_share_spread": 1.5,
+    },
 }
 
 #: exact-value requirements per scenario under --strict
@@ -91,6 +122,10 @@ STRICT_EQ = {
         "export_mode_unchanged": "cached",
         "export_churn_mode": "scatter",
         "delta_frame": "delta",
+    },
+    "federation": {
+        "zero_cross_tenant": True,
+        "plans_identical_dedicated": True,
     },
 }
 
@@ -123,6 +158,10 @@ def check(tail: dict, scenario: str, strict: bool = False) -> list[str]:
             if tail[key] < floor:
                 errors.append(f"{key}: {tail[key]} below the "
                               f"documented floor {floor}")
+        for key, ceiling in CEILINGS.get(scenario, {}).items():
+            if tail[key] > ceiling:
+                errors.append(f"{key}: {tail[key]} above the "
+                              f"documented ceiling {ceiling}")
         for key, want in STRICT_EQ.get(scenario, {}).items():
             if tail[key] != want:
                 errors.append(f"{key}: expected {want!r}, "
