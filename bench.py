@@ -693,6 +693,53 @@ def run_scenario(scenario: str) -> dict:
             **_degradation_counts(),
         }
 
+    if scenario == "chaoscampaign":
+        # composed-fault chaos campaigns with the convergence oracle
+        # (kueue_oss_tpu/chaos/campaign.py, docs/ROBUSTNESS.md "Chaos
+        # campaigns"): every profile storms one subsystem's degradation
+        # ladder against a live plane, then must converge back to the
+        # fault-free twin's exact bytes within the bound.
+        import tempfile
+
+        from kueue_oss_tpu.chaos.campaign import PROFILES, run_campaign
+
+        seed = int(os.environ.get("BENCH_CAMPAIGN_SEED", "42"))
+        results = []
+        profiles = {}
+        t0 = time.monotonic()
+        for profile in PROFILES:
+            kw = {}
+            if profile == "kill-storm":
+                kw["persistence_dir"] = tempfile.mkdtemp()
+            r = run_campaign(profile, seed=seed, **kw)
+            results.append(r)
+            profiles[profile] = r.to_dict()
+            log(f"[campaign:{profile}] ok={r.ok} "
+                f"conv={r.convergence_cycles} "
+                f"lvl={r.max_degradation_level} "
+                f"avail={r.availability:.2f}")
+        return {
+            "scenario": scenario,
+            "seed": seed,
+            "seconds": time.monotonic() - t0,
+            "profiles": profiles,
+            # aggregate oracle verdicts: worst case across profiles
+            "converged_all": all(r.ok for r in results),
+            "recovered_identical": all(r.recovered_identical
+                                       for r in results),
+            "convergence_cycles": max(r.convergence_cycles
+                                      for r in results),
+            "max_degradation_level": max(r.max_degradation_level
+                                         for r in results),
+            "availability": min(r.availability for r in results),
+            "unavailable_wall_ms": round(sum(r.unavailable_wall_ms
+                                             for r in results), 3),
+            "invariant_violations": sum(r.invariant_violations
+                                        for r in results),
+            "faults_injected": sum(r.faults_injected for r in results),
+            **_degradation_counts(),
+        }
+
     if scenario == "delta":
         # delta-sync steady state on the 50k x 1k churn shape
         # (docs/SOLVER_PROTOCOL.md): a real sidecar on a unix socket,
@@ -2687,6 +2734,14 @@ def main() -> None:
     except Exception as e:
         log(f"[chaos] did not complete: {e}")
         chaos = None
+    # composed-fault campaigns + convergence oracle (host backend:
+    # the measurement is recovery discipline, not kernel speed)
+    try:
+        campaign = measure("chaoscampaign",
+                           extra_env={"BENCH_CPU": "1"}, timeout=1200)
+    except Exception as e:
+        log(f"[chaoscampaign] did not complete: {e}")
+        campaign = None
     # flight-recorder overhead on the 50k x 1k host cycle shape (host
     # backend: the recorder instruments the host path)
     try:
@@ -2893,6 +2948,16 @@ def main() -> None:
         extra["chaos_capacity"] = chaos["capacity"]
         extra["chaos_faults_injected"] = chaos["faults_injected"]
         extra["chaos_seconds"] = round(chaos["seconds"], 3)
+    if campaign is not None:
+        extra["campaign_converged_all"] = campaign["converged_all"]
+        extra["campaign_convergence_cycles"] = campaign[
+            "convergence_cycles"]
+        extra["campaign_max_degradation_level"] = campaign[
+            "max_degradation_level"]
+        extra["campaign_availability"] = campaign["availability"]
+        extra["campaign_unavailable_wall_ms"] = campaign[
+            "unavailable_wall_ms"]
+        extra["campaign_faults_injected"] = campaign["faults_injected"]
     if recorder is not None:
         # flight-recorder cost + decision volume (docs/OBSERVABILITY.md:
         # the overhead bar is <2% on this shape)

@@ -370,6 +370,17 @@ class CrashPointInjector:
                 "KUEUE_CRASH_MODE": self.mode}
 
 
+def __getattr__(name: str):
+    # campaign composition layer (chaos/campaign.py) — lazy so that
+    # importing the injectors never pulls the scheduler stack
+    if name in ("ChaosCampaign", "CampaignSpec", "CampaignResult",
+                "PROFILES", "PROFILE_SUBSYSTEM", "run_campaign"):
+        from kueue_oss_tpu.chaos import campaign
+
+        return getattr(campaign, name)
+    raise AttributeError(name)
+
+
 class NodeFlapInjector:
     """Seeded node-readiness flapping against the store.
 

@@ -232,6 +232,25 @@ class FederationConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Degradation-ladder knobs (kueue_oss_tpu/resilience/,
+    docs/ROBUSTNESS.md "Degradation ladder").
+
+    No reference analog — the reference has no explicit degraded-mode
+    state machine; these govern the process-wide DegradationController
+    every breaker/demotion/backpressure handler reports into. Applied
+    via ``resilience.configure(cfg.resilience)``.
+    """
+
+    enabled: bool = True
+    #: bounded transition-history length kept for /api/degradation
+    history_limit: int = 512
+    #: quiet period before a degraded WAL durability policy gets one
+    #: probe fsync (the persistence ladder's restore hysteresis)
+    wal_restore_cooldown_seconds: float = 60.0
+
+
+@dataclass
 class PersistenceConfig:
     """Durable control plane knobs (kueue_oss_tpu/persist/,
     docs/DURABILITY.md).
@@ -417,6 +436,7 @@ class Configuration:
     multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     solver: SolverBackendConfig = field(default_factory=SolverBackendConfig)
     federation: FederationConfig = field(default_factory=FederationConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     streaming: StreamingConfig = field(default_factory=StreamingConfig)
     simulator: SimulatorConfig = field(default_factory=SimulatorConfig)
     persistence: PersistenceConfig = field(
@@ -518,6 +538,11 @@ def validate(cfg: Configuration) -> list[str]:
         errs.append("federation.maxQueued must be >= 1")
     if fed.max_credit_quanta <= 0:
         errs.append("federation.maxCreditQuanta must be > 0")
+    res = cfg.resilience
+    if res.history_limit < 1:
+        errs.append("resilience.historyLimit must be >= 1")
+    if res.wal_restore_cooldown_seconds < 0:
+        errs.append("resilience.walRestoreCooldown must be >= 0")
     sim = cfg.simulator
     if sim.max_scenarios < 1:
         errs.append("simulator.maxScenarios must be >= 1")
@@ -744,6 +769,14 @@ def load(data: Optional[dict] = None) -> Configuration:
             "shipCompact": ("ship_compact", None),
         })
 
+    def conv_resilience(d: dict) -> ResilienceConfig:
+        return _build(ResilienceConfig, d, {
+            "enabled": ("enabled", None),
+            "historyLimit": ("history_limit", int),
+            "walRestoreCooldown": ("wal_restore_cooldown_seconds",
+                                   float),
+        })
+
     def conv_streaming(d: dict) -> StreamingConfig:
         return _build(StreamingConfig, d, {
             "enabled": ("enabled", None),
@@ -811,6 +844,7 @@ def load(data: Optional[dict] = None) -> Configuration:
         "multiKueue": ("multikueue", conv_mk),
         "solver": ("solver", conv_solver),
         "federation": ("federation", conv_federation),
+        "resilience": ("resilience", conv_resilience),
         "streaming": ("streaming", conv_streaming),
         "simulator": ("simulator", conv_sim),
         "persistence": ("persistence", conv_persist),

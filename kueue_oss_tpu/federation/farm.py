@@ -39,12 +39,13 @@ the grant ORDER, not parallelism.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
 from typing import Callable, Optional
 
-from kueue_oss_tpu import metrics
+from kueue_oss_tpu import metrics, resilience
 
 
 def _backpressure(tenant: str, why: str) -> tuple[dict, bytes]:
@@ -100,6 +101,9 @@ class FarmScheduler:
         self.wall_by_tenant: dict[str, float] = {}
         self.served: dict[str, int] = {}
         self.throttled: dict[str, int] = {}
+        #: chaos seam (ClusterLossInjector.partition_farm): tenant ->
+        #: remaining run() calls to answer with forced backpressure
+        self.throttle_fault: dict[str, int] = {}
 
     @classmethod
     def from_config(cls, cfg, clock=time.monotonic) -> "FarmScheduler":
@@ -116,6 +120,63 @@ class FarmScheduler:
     def weight(self, tenant: str) -> float:
         return max(1e-9, float(self.weights.get(tenant,
                                                 self.default_weight)))
+
+    def set_weights(self, weights: Optional[dict] = None,
+                    default_weight: Optional[float] = None) -> dict:
+        """Runtime re-weighting (closes the ROADMAP item 4 residual).
+
+        Takes effect within ONE ring walk: the closed-form grant walk
+        reads :meth:`weight` live for every visit computation, so the
+        very next grant opportunity accrues at the new rates — no
+        queue drain, no ring rebuild, and standing deficits (debt from
+        already-charged solves) are preserved. Positive credit is
+        re-capped against the new weights. Returns the effective map.
+
+        Raises ValueError on a non-positive or non-finite weight: a
+        zero weight would starve a tenant silently (use admission
+        policy for that), and ``weight()``'s 1e-9 clamp would mask
+        the operator's typo instead of rejecting it.
+        """
+        with self._lock:
+            if weights is not None:
+                parsed = {str(k): float(v) for k, v in weights.items()}
+                for t, w in parsed.items():
+                    if not (w > 0.0) or math.isinf(w):
+                        raise ValueError(
+                            f"weight for {t!r} must be finite and > 0, "
+                            f"got {w}")
+                self.weights = parsed
+            if default_weight is not None:
+                dw = float(default_weight)
+                if not (dw > 0.0) or math.isinf(dw):
+                    raise ValueError(
+                        f"defaultWeight must be finite and > 0, got {dw}")
+                self.default_weight = dw
+            for t in self._ring:
+                cap = (self.quantum_s * self.weight(t)
+                       * self.max_credit_quanta)
+                if self._deficit.get(t, 0.0) > cap:
+                    self._deficit[t] = cap
+            return dict(self.weights)
+
+    def reload_config(self, cfg) -> dict:
+        """Hot-reload the DRR knobs from a ``config.FederationConfig``
+        (the /api/farm/weights surface and SIGHUP-style reloads)."""
+        with self._lock:
+            self.quantum_s = float(cfg.quantum_seconds)
+            self.max_queued = max(1, int(cfg.max_queued))
+            self.max_credit_quanta = float(cfg.max_credit_quanta)
+        return self.set_weights(dict(cfg.tenant_weights),
+                                cfg.default_weight)
+
+    def force_throttle(self, tenant: str, times: int = 1) -> None:
+        """Chaos seam: the next ``times`` run() calls for ``tenant``
+        answer with in-band backpressure as if the farm were
+        partitioned away — the client degrades to host cycles exactly
+        like real starvation."""
+        with self._lock:
+            self.throttle_fault[str(tenant)] = (
+                self.throttle_fault.get(str(tenant), 0) + max(1, times))
 
     def stats(self) -> dict[str, dict[str, float]]:
         with self._lock:
@@ -206,11 +267,13 @@ class FarmScheduler:
         ticket = _Ticket()
         with self._lock:
             self._register_locked(tenant)
+            if self.throttle_fault.get(tenant, 0) > 0:
+                self.throttle_fault[tenant] -= 1
+                return self._throttle_locked(
+                    tenant, "injected farm partition (chaos)")
             q = self._queues[tenant]
             if len(q) >= self.max_queued:
-                self.throttled[tenant] = self.throttled.get(tenant, 0) + 1
-                metrics.solver_farm_throttled_total.inc(tenant)
-                return _backpressure(
+                return self._throttle_locked(
                     tenant, f"{len(q)} requests already queued "
                             f"(max_queued={self.max_queued})")
             q.append(ticket)
@@ -224,17 +287,30 @@ class FarmScheduler:
                         self._queues[tenant].remove(ticket)
                     except ValueError:
                         pass
-                    self.throttled[tenant] = (
-                        self.throttled.get(tenant, 0) + 1)
-                    metrics.solver_farm_throttled_total.inc(tenant)
-                    return _backpressure(tenant, "grant wait timed out")
+                    return self._throttle_locked(
+                        tenant, "grant wait timed out")
                 # granted in the race window: fall through and run
         metrics.solver_farm_requests_total.inc(tenant)
         t0 = self._clock()
         try:
-            return fn()
+            out = fn()
         finally:
             self._complete(tenant, max(0.0, self._clock() - t0))
+        ctl = resilience.controller
+        if ctl.active(resilience.FEDERATION, "backpressure"):
+            ctl.report(resilience.FEDERATION, "backpressure", False,
+                       reason=f"farm served tenant {tenant!r}; "
+                              "backpressure relieved")
+        return out
+
+    def _throttle_locked(self, tenant: str, why: str
+                         ) -> tuple[dict, bytes]:
+        self.throttled[tenant] = self.throttled.get(tenant, 0) + 1
+        metrics.solver_farm_throttled_total.inc(tenant)
+        resilience.controller.report(
+            resilience.FEDERATION, "backpressure", True,
+            reason=f"farm backpressure for tenant {tenant!r}: {why}")
+        return _backpressure(tenant, why)
 
 
 def attach_farm(server, scheduler: Optional[FarmScheduler] = None,

@@ -548,6 +548,14 @@ solver_breaker_state = registry.register(Gauge(
 solver_plan_rejected_total = registry.register(Counter(
     "kueue_tpu_solver_plan_rejected_total",
     "Imported plans rejected wholesale by the sanity guard", ()))
+degradation_level = registry.register(Gauge(
+    "kueue_degradation_level",
+    "Current degradation ladder level per subsystem (0 = healthy; "
+    "see docs/ROBUSTNESS.md 'Degradation ladder')", ("subsystem",)))
+degradation_transitions_total = registry.register(Counter(
+    "kueue_degradation_transitions_total",
+    "Degradation condition transitions (direction: degrade/recover)",
+    ("subsystem", "direction")))
 
 # -- delta-sync solver sessions (docs/SOLVER_PROTOCOL.md) --------------------
 
@@ -764,6 +772,10 @@ wal_bytes_total = registry.register(Counter(
 wal_fsyncs_total = registry.register(Counter(
     "kueue_wal_fsyncs_total",
     "fsync barriers issued by the write-ahead log", ()))
+wal_fsync_faults_total = registry.register(Counter(
+    "kueue_wal_fsync_faults_total",
+    "fsync failures absorbed by the WAL durability ladder "
+    "(always -> batch -> off; docs/ROBUSTNESS.md)", ()))
 checkpoints_total = registry.register(Counter(
     "kueue_checkpoints_total",
     "Store checkpoints by outcome (written = full / incremental / "

@@ -54,9 +54,10 @@ PREEMPTED = "preempted"          # evicted to make room for another workload
 EVICTED = "evicted"              # evicted for a non-preemption reason
 SOLVER_ADMITTED = "solver-admitted"  # quota reserved by the solver plan
 SOLVER_FALLBACK = "solver-fallback"  # solver path degraded to the host path
+DEGRADATION = "degradation"          # a degradation-ladder transition
 
 KINDS = (NOMINATED, ASSIGNED, SKIPPED, PREEMPTED, EVICTED,
-         SOLVER_ADMITTED, SOLVER_FALLBACK)
+         SOLVER_ADMITTED, SOLVER_FALLBACK, DEGRADATION)
 
 # -- decision paths ---------------------------------------------------------
 
@@ -354,6 +355,7 @@ from kueue_oss_tpu.obs.health import (  # noqa: E402
     phase_regression as phase_regression,
 )
 from kueue_oss_tpu.obs.ledger import (  # noqa: E402
+    DEGRADATION_ROW,
     HOST_CYCLE,
     SOLVER_DRAIN,
     STREAM_DRAIN,

@@ -40,6 +40,9 @@ from kueue_oss_tpu import metrics
 HOST_CYCLE = "host"
 SOLVER_DRAIN = "solver"
 STREAM_DRAIN = "stream"
+#: degradation-ladder transition rows (resilience.DegradationController):
+#: the transition entry rides in ``detail``; cycle-outcome fields stay 0
+DEGRADATION_ROW = "degradation"
 
 
 @dataclass

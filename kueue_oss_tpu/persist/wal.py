@@ -26,6 +26,14 @@ Durability policy (`fsync`):
             <5% wal_overhead_pct budget lives here)
   off    -- never fsync (bench twins, throwaway dirs)
 
+These three modes are also the PERSISTENCE degradation ladder
+(docs/ROBUSTNESS.md): an ``os.fsync`` failure (sick disk, full
+filesystem, injected chaos via ``fsync_fault``) drops the effective
+policy one rung and raises ``fsync_degraded`` — a second failure drops
+to off and raises the ``wal_off`` alarm. The configured policy is
+remembered; once the restore cooldown elapses a single probe fsync
+(the controller's unified half-open discipline) restores it.
+
 Intents follow the same policy: they are appended to the same file
 strictly before the event they fence, so ORDER (not an extra fsync)
 is what guarantees recovery never sees an event without its intent.
@@ -43,7 +51,7 @@ import struct
 import zlib
 from typing import Iterator, Optional
 
-from kueue_oss_tpu import metrics
+from kueue_oss_tpu import metrics, resilience
 from kueue_oss_tpu.persist import hooks
 
 MAGIC = b"KW"
@@ -76,6 +84,14 @@ class WriteAheadLog:
             raise ValueError(f"fsync {fsync!r} not in {FSYNC_MODES}")
         self.path = path
         self.fsync = fsync
+        #: the operator-configured policy `maybe_restore` returns to
+        #: after the degradation ladder dropped `self.fsync` below it
+        self._configured_fsync = fsync
+        #: chaos seam: the next N fsync attempts fail as if the disk
+        #: were sick (drives the persistence ladder deterministically)
+        self.fsync_fault = 0
+        #: quiet period before a degraded policy gets one probe fsync
+        self.restore_cooldown_s = resilience.wal_restore_cooldown_s
         self.batch_records = max(1, int(batch_records))
         # A crash can leave a torn frame at the tail; appending after
         # it would strand every later record behind an unreadable
@@ -145,9 +161,14 @@ class WriteAheadLog:
         return len(frame)
 
     def sync(self) -> None:
-        """Group-commit barrier: make every appended record durable."""
+        """Group-commit barrier: make every appended record durable.
+        Doubles as the degraded-policy restore point — the scheduler
+        calls this at every cycle end, so a healed disk is re-probed
+        on the admission cadence without a dedicated timer."""
         if self._unsynced:
             self._fsync()
+        if self.fsync != self._configured_fsync:
+            self.maybe_restore()
 
     def _fsync(self) -> None:
         if self.fsync == FSYNC_OFF:
@@ -156,10 +177,83 @@ class WriteAheadLog:
             # "trust the page cache", not "never replicate"
             self.synced_size = self.size
             return
-        os.fsync(self._f.fileno())
+        if self.fsync_fault > 0:
+            self.fsync_fault -= 1
+            self._degrade(OSError("injected fsync fault (chaos)"))
+            return
+        try:
+            os.fsync(self._f.fileno())
+        except OSError as e:
+            self._degrade(e)
+            return
         self._unsynced = 0
         self.synced_size = self.size
         metrics.wal_fsyncs_total.inc()
+
+    def _degrade(self, err: BaseException) -> None:
+        """An fsync failed: drop one durability rung rather than crash
+        the admission loop — degraded-but-sound beats wedged. The
+        ladder is fsync-always -> batch -> wal-off(+alarm); every hop
+        is metered, journaled, and owned by the degradation
+        controller, and ``maybe_restore`` walks back up after a quiet
+        cooldown."""
+        metrics.wal_fsync_faults_total.inc()
+        ctl = resilience.controller
+        if self.fsync == FSYNC_ALWAYS:
+            self.fsync = FSYNC_BATCH
+            ctl.report(
+                resilience.PERSISTENCE, "fsync_degraded", True,
+                reason=f"fsync failed ({err!r}); durability drops to "
+                       "group commit")
+        else:
+            self.fsync = FSYNC_OFF
+            ctl.report(
+                resilience.PERSISTENCE, "fsync_degraded", True,
+                reason=f"fsync failed ({err!r})")
+            ctl.report(
+                resilience.PERSISTENCE, "wal_off", True,
+                reason=f"group commit failed too ({err!r}); WAL "
+                       "durability OFF — page cache only (alarm)")
+        # the failed barrier's records stay page-cache-only, exactly
+        # like fsync=off: the watermark advances so shipping and the
+        # group-commit counter don't wedge on an unreachable barrier
+        self._unsynced = 0
+        self.synced_size = self.size
+
+    def maybe_restore(self) -> bool:
+        """One timed half-open probe of a degraded durability policy:
+        once ``restore_cooldown_s`` has passed since the last fault,
+        a single caller attempts a real fsync; success restores the
+        configured policy and clears the ladder conditions, failure
+        restarts the cooldown."""
+        if self.fsync == self._configured_fsync:
+            return False
+        ctl = resilience.controller
+        cond = ("wal_off" if self.fsync == FSYNC_OFF
+                else "fsync_degraded")
+        if not ctl.begin_probe(resilience.PERSISTENCE, cond,
+                               self.restore_cooldown_s):
+            return False
+        try:
+            if self.fsync_fault > 0:
+                self.fsync_fault -= 1
+                raise OSError("injected fsync fault (chaos)")
+            os.fsync(self._f.fileno())
+        except OSError:
+            metrics.wal_fsync_faults_total.inc()
+            ctl.end_probe(resilience.PERSISTENCE, cond, success=False)
+            return False
+        self.fsync = self._configured_fsync
+        self._unsynced = 0
+        self.synced_size = self.size
+        metrics.wal_fsyncs_total.inc()
+        for c in ("wal_off", "fsync_degraded"):
+            if ctl.active(resilience.PERSISTENCE, c):
+                ctl.report(
+                    resilience.PERSISTENCE, c, False,
+                    reason="probe fsync succeeded; configured "
+                           f"policy {self._configured_fsync!r} restored")
+        return True
 
     def close(self) -> None:
         if self._f.closed:

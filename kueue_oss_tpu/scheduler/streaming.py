@@ -99,7 +99,7 @@ from typing import Optional
 
 import numpy as np
 
-from kueue_oss_tpu import metrics, obs
+from kueue_oss_tpu import metrics, obs, resilience
 from kueue_oss_tpu.core.queue_manager import _order_key
 from kueue_oss_tpu.core.workload_info import WorkloadInfo
 from kueue_oss_tpu.scheduler import flavor_assigner as fa
@@ -328,6 +328,11 @@ class StreamingAdmitter:
             else:
                 self._contended_roots[self._root(cq)] = self._gen
         metrics.stream_demotions_total.inc(reason)
+        if cq is None:
+            resilience.controller.report(
+                resilience.STREAMING, "stream_off", True,
+                reason=f"window disarmed ({reason}): event owner "
+                       "unresolvable; full fence")
 
     # -- per-spec-gen derived tables ---------------------------------------
 
@@ -472,6 +477,18 @@ class StreamingAdmitter:
             # against post-solve usage
             self._headroom.clear()
             self._root_floor.clear()
+            contended = bool(self._contended_roots)
+        # degradation ladder: a completed full solve re-arms the window
+        # (stream_off clears); structural fences survive only for roots
+        # contended mid-solve
+        ctl = resilience.controller
+        if ctl.active(resilience.STREAMING, "stream_off"):
+            ctl.report(resilience.STREAMING, "stream_off", False,
+                       reason="full solve completed; window re-armed")
+        if not contended and ctl.active(resilience.STREAMING,
+                                        "structural_fence"):
+            ctl.report(resilience.STREAMING, "structural_fence", False,
+                       reason="full solve cleared every contended root")
 
     def note_solve_abort(self) -> None:
         """The solve failed (host fallback): stop attributing events
@@ -508,6 +525,10 @@ class StreamingAdmitter:
                 self.armed = False
                 self.full_solve_pending = True
             metrics.stream_demotions_total.inc("spec_change")
+            resilience.controller.report(
+                resilience.STREAMING, "stream_off", True,
+                reason="spec generation changed mid-window; streaming "
+                       "disarmed pending a full solve")
             return result
         t0 = time.perf_counter()
         self.micro_drains += 1
@@ -604,6 +625,19 @@ class StreamingAdmitter:
             "admitted" if result.admitted else
             ("parked" if result.parked else
              ("deferred" if result.deferred_cqs else "idle")))
+        # degradation ladder: deferrals mean part of the fleet runs on
+        # the structural (full-solve-only) rung; a clean drain over a
+        # non-empty set recovers it
+        ctl = resilience.controller
+        if result.deferred_cqs:
+            ctl.report(
+                resilience.STREAMING, "structural_fence", True,
+                reason=f"{result.deferred_cqs} CQ(s) deferred to the "
+                       "next full solve behind structural fences")
+        elif considered and ctl.active(resilience.STREAMING,
+                                       "structural_fence"):
+            ctl.report(resilience.STREAMING, "structural_fence", False,
+                       reason="micro-drain covered every eligible CQ")
         if result.admitted:
             self._record_ledger(result)
             p = getattr(self.store, "persistence", None)

@@ -26,7 +26,7 @@ from kueue_oss_tpu.api.types import (
 from kueue_oss_tpu.core.queue_manager import QueueManager
 from kueue_oss_tpu.core.store import Store
 from kueue_oss_tpu.core.workload_info import WorkloadInfo
-from kueue_oss_tpu import metrics, obs
+from kueue_oss_tpu import metrics, obs, resilience
 from kueue_oss_tpu.solver.delta import (
     DeviceResidentProblem,
     HostDeltaSession,
@@ -159,13 +159,15 @@ class SolverEngine:
         self._mesh_resolved = False
         #: chaos/device-loss cap on mesh width (refresh_mesh)
         self._mesh_max_devices = 0
-        #: a mesh drain fault (device loss, compile failure) trips this;
+        #: a mesh drain fault (device loss, compile failure) raises the
+        #: ``mesh_broken`` condition on the degradation controller;
         #: drains degrade to single-chip until refresh_mesh() re-probes
-        #: or the retry cooldown elapses (timed half-open, mirroring
-        #: the SolverHealth breaker — a transient fault must not
-        #: disable the mesh for the process lifetime)
+        #: or the retry cooldown elapses (timed half-open, owned by the
+        #: controller's unified CooldownPolicy — a transient fault must
+        #: not disable the mesh for the process lifetime). The
+        #: _mesh_broken/_mesh_broken_at names survive as properties
+        #: over the controller state.
         self._mesh_broken = False
-        self._mesh_broken_at = 0.0
         self.mesh_retry_cooldown_s = 300.0
         #: backlogs below this stay single-chip: the mesh is the
         #: LARGE-backlog path — tiny problems would pay per-shape SPMD
@@ -219,7 +221,6 @@ class SolverEngine:
         #: pin lean drains to the relax arm (bench/tests only)
         self.relax_force = False
         self._relax_broken = False
-        self._relax_broken_at = 0.0
         self._relax_drains = 0
         #: sticky pow2 pad target for the repair subproblem's support
         #: axis, so steady-state relax drains reuse ONE compiled repair
@@ -627,14 +628,68 @@ class SolverEngine:
 
     # -- mesh routing (solver/meshutil.py, solver/sharded.py) --------------
 
+    # The mesh/relax breaker state lives on the process-wide
+    # DegradationController (resilience package) — one ladder, one
+    # cooldown policy, observable levels. These properties keep the
+    # historical private names working for tests and diagnostics.
+
+    @property
+    def _mesh_broken(self) -> bool:
+        return resilience.controller.active(resilience.SOLVER,
+                                            "mesh_broken")
+
+    @_mesh_broken.setter
+    def _mesh_broken(self, v: bool) -> None:
+        resilience.controller.report(
+            resilience.SOLVER, "mesh_broken", bool(v),
+            cycle=self._drain_cycle,
+            reason=("mesh arm tripped" if v
+                    else "mesh re-probed; arm restored"))
+
+    @property
+    def _mesh_broken_at(self) -> float:
+        return (resilience.controller.cooldowns.stamp(
+            (resilience.SOLVER, "mesh_broken")) or 0.0)
+
+    @_mesh_broken_at.setter
+    def _mesh_broken_at(self, t: float) -> None:
+        resilience.controller.cooldowns.set_stamp(
+            (resilience.SOLVER, "mesh_broken"), float(t))
+
+    @property
+    def _relax_broken(self) -> bool:
+        return resilience.controller.active(resilience.SOLVER,
+                                            "relax_broken")
+
+    @_relax_broken.setter
+    def _relax_broken(self, v: bool) -> None:
+        resilience.controller.report(
+            resilience.SOLVER, "relax_broken", bool(v),
+            cycle=self._drain_cycle,
+            reason=("relaxed arm demoted" if v
+                    else "relaxed arm re-probed; arm restored"))
+
+    @property
+    def _relax_broken_at(self) -> float:
+        return (resilience.controller.cooldowns.stamp(
+            (resilience.SOLVER, "relax_broken")) or 0.0)
+
+    @_relax_broken_at.setter
+    def _relax_broken_at(self, t: float) -> None:
+        resilience.controller.cooldowns.set_stamp(
+            (resilience.SOLVER, "relax_broken"), float(t))
+
     def _mesh(self):
         """The resolved solver mesh, or None (single device / off /
         tripped by a mesh fault). A tripped mesh self-heals after
-        ``mesh_retry_cooldown_s`` (timed half-open: one probe drain
-        re-measures; another fault re-trips and restarts the clock)."""
+        ``mesh_retry_cooldown_s`` (timed half-open via the degradation
+        controller's cooldown policy: ONE probe drain re-measures,
+        concurrent drains stay single-chip; another fault re-trips and
+        restarts the clock)."""
         if self._mesh_broken:
-            if (time.monotonic() - self._mesh_broken_at
-                    < self.mesh_retry_cooldown_s):
+            if not resilience.controller.begin_probe(
+                    resilience.SOLVER, "mesh_broken",
+                    self.mesh_retry_cooldown_s):
                 return None
             self.refresh_mesh(self._mesh_max_devices)
         if not self._mesh_resolved:
@@ -703,13 +758,25 @@ class SolverEngine:
         self._arm_ema[key] = (
             per_wl if prev is None else 0.7 * prev + 0.3 * per_wl)
 
+    def _clear_device_error(self) -> None:
+        """A local solve landed: the accelerator works again, so the
+        device_error rung (host-only) recovers on the ladder."""
+        ctl = resilience.controller
+        if ctl.active(resilience.SOLVER, "device_error"):
+            ctl.report(resilience.SOLVER, "device_error", False,
+                       cycle=self._drain_cycle,
+                       reason="local solve succeeded; device healthy")
+
     def _note_mesh_failure(self, e: BaseException, kind: str) -> None:
         """A mesh drain fault (device loss / compile abort / injected):
         count it, drop the possibly-corrupt mesh-resident state, and
         degrade to single-chip until refresh_mesh() or the retry
         cooldown re-probes. Never silent — metered AND journaled."""
-        self._mesh_broken = True
-        self._mesh_broken_at = time.monotonic()
+        resilience.controller.report(
+            resilience.SOLVER, "mesh_broken", True,
+            cycle=self._drain_cycle,
+            reason=f"mesh drain failed ({e!r}); degrading to the "
+                   "single-chip solver arm")
         self._arm_warm.discard((kind, "mesh"))
         self._device_states.pop(kind + "-mesh", None)
         metrics.solver_fallback_total.inc("mesh_error")
@@ -749,11 +816,13 @@ class SolverEngine:
         if not self.relax_enabled:
             return False
         if self._relax_broken:
-            if (time.monotonic() - self._relax_broken_at
-                    < self.relax_retry_cooldown_s):
-                return False
-            # timed half-open: one probe drain re-measures; another
+            # timed half-open via the degradation controller: one probe
+            # drain re-measures once the cooldown elapses; another
             # fault or divergence re-demotes and restarts the clock
+            if not resilience.controller.begin_probe(
+                    resilience.SOLVER, "relax_broken",
+                    self.relax_retry_cooldown_s):
+                return False
             self._relax_broken = False
             self._arm_warm.discard(("lean", "relax"))
         return True
@@ -790,16 +859,17 @@ class SolverEngine:
                             slug: str) -> None:
         """Demote the relaxed arm (fault or audit divergence): counted,
         journaled, cooled down — never silent, never wedged open."""
-        self._relax_broken = True
-        self._relax_broken_at = time.monotonic()
-        self._arm_ema.pop(("lean", "relax"), None)
-        self._arm_warm.discard(("lean", "relax"))
-        metrics.solver_fallback_total.inc(slug)
         reason = ("relaxed-arm plan diverged from the exact kernel on "
                   "an audited drain; arm demoted (exact plan emitted)"
                   if slug == "relax_disagreement" else
                   f"relaxed solver arm fault ({e!r}); falling back to "
                   "the exact arms")
+        resilience.controller.report(
+            resilience.SOLVER, "relax_broken", True,
+            cycle=self._drain_cycle, reason=reason)
+        self._arm_ema.pop(("lean", "relax"), None)
+        self._arm_warm.discard(("lean", "relax"))
+        metrics.solver_fallback_total.inc(slug)
         obs.recorder.record(
             obs.SOLVER_FALLBACK, obs.CYCLE_SCOPE,
             cycle=self._drain_cycle, path=obs.SOLVER,
@@ -937,6 +1007,7 @@ class SolverEngine:
                 metrics.solver_shard_imbalance.observe(
                     value=meshutil.shard_imbalance(
                         problem.wl_cqid, problem.n_cqs, mesh))
+                self._clear_device_error()
                 return out
         try:
             if self.solve_fault_hook is not None:
@@ -958,6 +1029,11 @@ class SolverEngine:
             self._device_states.pop(kind, None)
             metrics.solver_fallback_total.inc("device_error")
             metrics.solver_mesh_devices.set(value=0)
+            resilience.controller.report(
+                resilience.SOLVER, "device_error", True,
+                cycle=self._drain_cycle,
+                reason=f"local solver backend fault ({e!r}); admissions "
+                       "degrade to the host cycle")
             obs.recorder.record(
                 obs.SOLVER_FALLBACK, obs.CYCLE_SCOPE,
                 cycle=self._drain_cycle, path=obs.SOLVER,
@@ -969,6 +1045,7 @@ class SolverEngine:
         self._note_arm_wall(kind, "single", _time.monotonic() - t0, W)
         self.last_drain_arm = "single"
         metrics.solver_mesh_devices.set(value=0)
+        self._clear_device_error()
         return out
 
     # -- delta-sync sessions + pipelined dispatch --------------------------

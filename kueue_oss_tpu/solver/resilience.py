@@ -19,15 +19,23 @@ Two pieces live here:
   the host path until a cooldown expires; then a single probe call
   either closes the breaker or re-opens it for another cooldown.
 
+Half-open admits exactly ONE in-flight probe: concurrent callers stay
+degraded (host path) instead of thundering-herding the recovering
+sidecar — the probe slot discipline is the resilience package's shared
+:class:`~kueue_oss_tpu.resilience.CooldownPolicy`, and every breaker
+transition reports the ``breaker_open`` condition into the process-wide
+degradation controller (docs/ROBUSTNESS.md "Degradation ladder").
+
 The clock is injected so breaker tests (and the chaos harness) run with
 a fake clock — no sleeps.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 
-from kueue_oss_tpu import metrics
+from kueue_oss_tpu import metrics, resilience
 
 #: breaker states (exported for tests/metrics; gauge encodes the index)
 CLOSED = "closed"
@@ -54,13 +62,22 @@ class SolverHealth:
                   trip the breaker open.
     open       -- calls are refused without touching the socket until
                   ``cooldown_s`` has elapsed.
-    half-open  -- after the cooldown one probe call is allowed; success
-                  closes the breaker, failure re-opens it (and restarts
-                  the cooldown).
+    half-open  -- after the cooldown exactly one probe call is allowed;
+                  success closes the breaker, failure re-opens it (and
+                  restarts the cooldown). Concurrent callers during the
+                  probe are refused — they keep degrading to the host
+                  path instead of piling onto the recovering sidecar.
 
-    Single-threaded by design: the scheduler loop is the only caller, so
-    allow()/record_*() pairs never interleave.
+    allow()/record_*() hold a lock, so concurrent drains (the serve
+    loop plus an operator-triggered drain) see a consistent machine.
+    The cooldown's *elapsed* check keeps this instance's injected clock
+    (tests drive it); the single-probe slot is the shared CooldownPolicy
+    in the resilience package, giving every half-open re-probe in the
+    system one discipline.
     """
+
+    #: the shared-cooldown-policy key for the probe slot
+    _KEY = (resilience.SOLVER, "breaker_open")
 
     def __init__(self, failure_threshold: int = 3, cooldown_s: float = 30.0,
                  clock=time.monotonic) -> None:
@@ -73,34 +90,60 @@ class SolverHealth:
         #: kueue_tpu_solver_breaker_trips_total counter)
         self.trips = 0
         self._opened_at = 0.0
+        self._lock = threading.Lock()
         # the state gauge is written only on TRANSITIONS: SolverEngine
         # default-constructs a SolverHealth per instance, and a fresh
         # (closed) breaker must not overwrite the gauge while another
         # engine's live breaker is open
+
+    @property
+    def probing(self) -> bool:
+        """Whether a half-open probe is in flight right now."""
+        return resilience.controller.cooldowns.probing(self._KEY)
 
     def _set_state(self, state: str) -> None:
         self.state = state
         metrics.solver_breaker_state.set(value=_STATE_CODE[state])
 
     def allow(self) -> bool:
-        """Whether a remote call may be attempted right now."""
-        if self.state == OPEN:
-            if self.clock() - self._opened_at >= self.cooldown_s:
-                self._set_state(HALF_OPEN)  # next call is the probe
+        """Whether a remote call may be attempted right now.
+
+        At most one caller gets True per half-open window; it MUST
+        follow up with record_success()/record_failure() to release the
+        probe slot.
+        """
+        cooldowns = resilience.controller.cooldowns
+        with self._lock:
+            if self.state == OPEN:
+                if self.clock() - self._opened_at < self.cooldown_s:
+                    return False
+                if not cooldowns.acquire_probe(self._KEY):
+                    return False  # someone else is already probing
+                self._set_state(HALF_OPEN)
                 return True
-            return False
-        return True
+            if self.state == HALF_OPEN:
+                # a second drain arriving mid-probe stays degraded
+                return cooldowns.acquire_probe(self._KEY)
+            return True
 
     def record_success(self) -> None:
-        self.consecutive_failures = 0
-        if self.state != CLOSED:
-            self._set_state(CLOSED)
+        ctl = resilience.controller
+        with self._lock:
+            ctl.cooldowns.release_probe(self._KEY)
+            self.consecutive_failures = 0
+            if self.state != CLOSED:
+                self._set_state(CLOSED)
+                ctl.report(resilience.SOLVER, "breaker_open", False,
+                           reason="probe succeeded; breaker closed")
 
     def record_failure(self) -> None:
-        self.consecutive_failures += 1
-        if (self.state == HALF_OPEN
-                or self.consecutive_failures >= self.failure_threshold):
-            self._trip()
+        ctl = resilience.controller
+        with self._lock:
+            ctl.cooldowns.release_probe(self._KEY)
+            self.consecutive_failures += 1
+            if (self.state == HALF_OPEN
+                    or self.consecutive_failures >= self.failure_threshold):
+                self._trip()
 
     def _trip(self) -> None:
         if self.state != OPEN:
@@ -108,3 +151,7 @@ class SolverHealth:
             metrics.solver_breaker_trips_total.inc()
         self._opened_at = self.clock()
         self._set_state(OPEN)
+        resilience.controller.report(
+            resilience.SOLVER, "breaker_open", True,
+            reason=(f"breaker open after "
+                    f"{self.consecutive_failures} consecutive failures"))

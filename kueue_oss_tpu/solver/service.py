@@ -62,7 +62,7 @@ from typing import Optional
 
 import numpy as np
 
-from kueue_oss_tpu import metrics
+from kueue_oss_tpu import metrics, resilience
 from kueue_oss_tpu.persist import hooks as persist_hooks
 from kueue_oss_tpu.solver.delta import (
     ARRAY_FIELDS,
@@ -957,6 +957,11 @@ class SolverClient:
                     st.acked_epoch = frame.epoch
                 self._account("resync" if resynced else mode,
                               header, blob)
+                ctl = resilience.controller
+                if ctl.active(resilience.FEDERATION, "farm_unavailable"):
+                    ctl.report(resilience.FEDERATION, "farm_unavailable",
+                               False, reason="solver farm answered; "
+                                             "dedicated lane restored")
                 return out
             except _ResyncRequested as e:
                 # the sidecar lost (or never had) our session state:
@@ -1015,9 +1020,16 @@ class SolverClient:
             # would deterministically fail again, so don't burn the
             # deadline on it
             metrics.solver_remote_failures_total.inc("server")
+            err = str(resp.get("error", "unknown"))
+            if "backpressure" in err:
+                # the farm is throttling this whole control plane: the
+                # federation ladder degrades past the farm rung and the
+                # engine's breaker walks us down to host cycles
+                resilience.controller.report(
+                    resilience.FEDERATION, "farm_unavailable", True,
+                    reason=f"farm refused the solve: {err}")
             raise SolverUnavailable(
-                f"solver sidecar reported failure: "
-                f"{resp.get('error', 'unknown')}")
+                f"solver sidecar reported failure: {err}")
         spans = resp.get("spans")
         self.last_spans = spans if isinstance(spans, list) else []
         try:

@@ -36,6 +36,9 @@ class Dashboard:
         #: per-workload explain endpoint (defaults to the process-wide
         #: journal the scheduler/solver emit into)
         self.recorder = recorder if recorder is not None else obs.recorder
+        #: the solver-farm scheduler behind /api/farm/weights (attach
+        #: via ``dash.farm = scheduler`` when federation is on)
+        self.farm = None
         #: bumped on every store event; SSE clients wake on it
         self._gen = 0
         #: (monotonic wall, report) memo shared by slo_view and
@@ -332,15 +335,19 @@ class Dashboard:
         ledger-driven phase-regression detector."""
         from kueue_oss_tpu import metrics, obs
 
+        from kueue_oss_tpu import resilience
+
         report = self._slo_report()
         firing = report["alerts"]
         starved = [s for s in report["starvation"] if s["starved"]]
         breaker = obs.breaker_state_name()
         violations = int(metrics.invariant_last_violations.value())
         regressions = obs.phase_regression.regressing()
+        degradation = resilience.controller.snapshot()
         if firing or violations:
             status = "critical"
-        elif starved or breaker != "closed" or regressions:
+        elif (starved or breaker != "closed" or regressions
+                or degradation["degraded"]):
             status = "degraded"
         else:
             status = "ok"
@@ -352,6 +359,7 @@ class Dashboard:
             "breakerState": breaker,
             "invariantViolations": violations,
             "phaseRegressions": regressions,
+            "degradation": degradation,
             "ledger": {
                 "rows": len(obs.cycle_ledger.rows()),
                 "lastCycle": last.cycle if last is not None else 0,
@@ -359,6 +367,43 @@ class Dashboard:
             },
             "objective": report["objective"],
         }
+
+    def degradation_view(self) -> dict:
+        """The degradation-ladder rollup + recent transitions (GET
+        /api/degradation): per-subsystem level/rung/conditions from the
+        process-wide DegradationController."""
+        from kueue_oss_tpu import resilience
+
+        ctl = resilience.controller
+        snap = ctl.snapshot()
+        snap["recentTransitions"] = list(ctl.history[-50:])
+        return snap
+
+    def farm_weights_view(self) -> dict:
+        """The solver farm's live DRR weights (GET /api/farm/weights)."""
+        if self.farm is None:
+            return {"attached": False}
+        return {"attached": True,
+                "weights": dict(self.farm.weights),
+                "defaultWeight": self.farm.default_weight,
+                "stats": self.farm.stats()}
+
+    def set_farm_weights(self, payload: dict) -> dict:
+        """Runtime re-weighting (POST /api/farm/weights): body
+        ``{"weights": {tenant: w}, "defaultWeight": w}``; either key
+        optional. Takes effect within one ring walk."""
+        if self.farm is None:
+            return {"ok": False, "error": "no farm attached"}
+        weights = payload.get("weights")
+        if weights is not None and not isinstance(weights, dict):
+            return {"ok": False, "error": "weights must be an object"}
+        try:
+            effective = self.farm.set_weights(
+                weights, payload.get("defaultWeight"))
+        except (TypeError, ValueError) as e:
+            return {"ok": False, "error": f"bad weights: {e}"}
+        return {"ok": True, "weights": effective,
+                "defaultWeight": self.farm.default_weight}
 
     # -- per-resource detail views (WorkloadDetail.jsx et al) ---------------
 
@@ -633,6 +678,8 @@ class DashboardServer:
                     "/api/overview": dash.overview,
                     "/api/slo": dash.slo_view,
                     "/api/health": dash.health_view,
+                    "/api/degradation": dash.degradation_view,
+                    "/api/farm/weights": dash.farm_weights_view,
                 }
                 fn = routes.get(path)
                 if fn is None:
@@ -641,6 +688,30 @@ class DashboardServer:
                     return
                 body = json.dumps(fn()).encode()
                 self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if path != "/api/farm/weights":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, TypeError) as e:
+                    out = {"ok": False, "error": f"bad request: {e}"}
+                    code = 400
+                else:
+                    out = dash.set_farm_weights(payload)
+                    code = 200 if out.get("ok") else 409
+                body = json.dumps(out).encode()
+                self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

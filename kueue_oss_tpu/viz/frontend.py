@@ -215,7 +215,10 @@ async function refreshHealth() {
       `<span>breaker ${h.breakerState}</span> ` +
       `<span>${h.invariantViolations} invariant violation(s)</span> ` +
       `<span class="frac">ledger: ${h.ledger.rows} rows, last cycle ` +
-      `${h.ledger.lastCycle} (${h.ledger.lastKind})</span>`;
+      `${h.ledger.lastCycle} (${h.ledger.lastKind})</span>` +
+      (h.degradation ? `<br/><span class="frac">degradation: ` +
+        Object.entries(h.degradation.subsystems || {}).map(([k, v]) =>
+          `${k} L${v.level} (${v.rung})`).join(" · ") + `</span>` : "");
     const s = await fetch("/api/slo").then(r => r.json());
     const slis = s.slis || [];
     const tbl = document.getElementById("slos");

@@ -88,6 +88,17 @@ def pytest_configure(config):
         "deterministic, runs in tier-1")
 
 
+@pytest.fixture(autouse=True)
+def _reset_degradation_controller():
+    """The degradation controller is process-wide (like the recorder);
+    a condition raised by one test must not leak into the next."""
+    from kueue_oss_tpu import resilience
+
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

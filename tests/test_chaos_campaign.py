@@ -1,0 +1,151 @@
+"""Composed-fault chaos campaigns + the convergence oracle.
+
+The tentpole acceptance test: every seeded storm profile drives a live
+control plane through composed faults, and afterwards the oracle must
+hold — the store is BYTE-IDENTICAL to a fault-free twin that replayed
+the same trace, zero invariant violations, and the degradation ladder
+recovered monotonically to level 0 (docs/ROBUSTNESS.md "Chaos
+campaigns").
+
+Tier 1 (`chaos` marker): one cheap 2-fault campaign per storm family.
+`slow` marker: the full 5-fault storms swept over >=3 seeds x all 4
+profiles.
+"""
+
+import pytest
+
+from kueue_oss_tpu import resilience
+from kueue_oss_tpu.chaos import (
+    PROFILE_SUBSYSTEM,
+    PROFILES,
+    CampaignSpec,
+    ChaosCampaign,
+    run_campaign,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def _assert_oracle(res, profile):
+    """The full convergence oracle, with readable per-leg messages."""
+    assert res.recovered_identical, \
+        f"{profile}: post-recovery store diverged from the twin"
+    assert res.converged, \
+        f"{profile}: no convergence within {res.twin_cycles} cycles"
+    assert res.convergence_cycles <= 16, res.convergence_cycles
+    assert res.invariant_violations == 0
+    assert res.monotone_recovery, \
+        f"{profile}: degradation level bounced during recovery"
+    assert res.levels_zero, f"{profile}: ladder did not return to 0"
+    assert res.durable_identical is not False, \
+        f"{profile}: recovered-from-disk store diverged"
+    assert res.ok
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smokes: small deterministic campaigns, one per storm family
+# ---------------------------------------------------------------------------
+
+
+def test_solver_storm_smoke_degrades_and_converges():
+    res = run_campaign("solver-storm", seed=3, storm_cycles=8,
+                       n_workloads=48)
+    _assert_oracle(res, "solver-storm")
+    # the storm actually bit: faults landed, the solver subsystem
+    # transitioned, and the plane visibly degraded
+    assert res.faults_injected > 0
+    assert res.max_degradation_level >= 1
+    assert res.transitions.get(resilience.SOLVER, 0) >= 2, \
+        res.transitions
+
+
+def test_kill_storm_smoke_survives_crash_and_fsync_faults(tmp_path):
+    res = run_campaign("kill-storm", seed=2, storm_cycles=8,
+                       n_workloads=48,
+                       persistence_dir=str(tmp_path / "ks"))
+    _assert_oracle(res, "kill-storm")
+    assert res.durable_identical is True, \
+        "kill-storm must prove disk-recovery identity, not skip it"
+    assert res.transitions.get(resilience.PERSISTENCE, 0) >= 2, \
+        res.transitions
+    assert res.max_degradation_level >= 1
+
+
+def test_fed_partition_smoke_throttles_and_recovers():
+    res = run_campaign("fed-partition", seed=5, storm_cycles=8,
+                       n_workloads=48)
+    _assert_oracle(res, "fed-partition")
+    assert res.transitions.get(resilience.FEDERATION, 0) >= 2, \
+        res.transitions
+
+
+def test_pod_loss_smoke_fences_streaming():
+    res = run_campaign("pod-loss", seed=1, storm_cycles=9,
+                       n_workloads=48)
+    _assert_oracle(res, "pod-loss")
+    assert res.transitions.get(resilience.STREAMING, 0) >= 2, \
+        res.transitions
+    # fenced streamed-only cycles honestly admit nothing
+    assert res.unavailable_cycles > 0
+    assert 0.0 < res.availability < 1.0
+
+
+def test_campaign_is_deterministic_per_seed(tmp_path):
+    a = run_campaign("kill-storm", seed=7, storm_cycles=6,
+                     n_workloads=32,
+                     persistence_dir=str(tmp_path / "a"))
+    b = run_campaign("kill-storm", seed=7, storm_cycles=6,
+                     n_workloads=32,
+                     persistence_dir=str(tmp_path / "b"))
+    for field in ("converged", "convergence_cycles",
+                  "max_degradation_level", "availability",
+                  "unavailable_cycles", "faults_injected",
+                  "transitions"):
+        assert getattr(a, field) == getattr(b, field), field
+
+
+def test_campaign_emits_degradation_events_per_subsystem():
+    """Acceptance: every fault response routes through the
+    DegradationController — the campaign's transition ledger must show
+    events for the profile's subsystem, sourced from the controller's
+    own history (not campaign-side bookkeeping)."""
+    res = run_campaign("solver-storm", seed=3, storm_cycles=8,
+                       n_workloads=48)
+    sub = PROFILE_SUBSYSTEM["solver-storm"]
+    assert res.transitions.get(sub, 0) >= 2
+    # degrade AND recover both present = the ladder closed the loop
+    assert res.levels_zero and res.max_degradation_level >= 1
+
+
+def test_spec_validation(tmp_path):
+    with pytest.raises(ValueError):
+        CampaignSpec(profile="nope")
+    with pytest.raises(ValueError):
+        CampaignSpec(profile="kill-storm")  # needs persistence_dir
+    with pytest.raises(ValueError):
+        # demand over capacity can never converge to all-admitted
+        CampaignSpec(profile="solver-storm", n_workloads=10_000,
+                     quota=1, n_cqs=1)
+    spec = CampaignSpec(profile="kill-storm",
+                        persistence_dir=str(tmp_path))
+    assert ChaosCampaign(spec).spec is spec
+
+
+# ---------------------------------------------------------------------------
+# slow sweep: full 5-fault storms, >=3 seeds x all 4 profiles
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("profile", PROFILES)
+@pytest.mark.parametrize("seed", [1, 11, 29])
+def test_storm_sweep_every_profile_every_seed_converges(
+        profile, seed, tmp_path):
+    kw = {}
+    if profile == "kill-storm":
+        kw["persistence_dir"] = str(tmp_path / "wal")
+    res = run_campaign(profile, seed=seed, **kw)
+    _assert_oracle(res, f"{profile}/seed={seed}")
+    assert res.faults_injected > 0
+    assert res.transitions.get(PROFILE_SUBSYSTEM[profile], 0) >= 2, \
+        (profile, seed, res.transitions)

@@ -666,7 +666,8 @@ def test_benchcheck_unknown_scenario_and_cli(tmp_path):
     from tools.benchcheck import check, main as bc_main
 
     assert check({}, "nope") == ["unknown scenario 'nope' (known: "
-                                 "main, megascale)"]
+                                 "chaoscampaign, federation, main, "
+                                 "megascale)"]
     path = tmp_path / "tail.json"
     path.write_text("garbage first line\n"
                     + json.dumps(_mega_tail()) + "\n")
@@ -678,3 +679,56 @@ def test_benchcheck_unknown_scenario_and_cli(tmp_path):
     buf = io.StringIO()
     assert bc_main(["--json", str(bad)], out=buf) == 1
     assert "missing key" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# benchcheck: chaoscampaign tail (docs/ROBUSTNESS.md "Chaos campaigns")
+# ---------------------------------------------------------------------------
+
+
+def _campaign_tail(**over):
+    tail = {
+        "scenario": "chaoscampaign", "seed": 42, "seconds": 4.0,
+        "profiles": {"solver-storm": {"converged": True}},
+        "converged_all": True, "recovered_identical": True,
+        "convergence_cycles": 12, "max_degradation_level": 3,
+        "availability": 0.7, "unavailable_wall_ms": 0.4,
+        "invariant_violations": 0, "faults_injected": 36,
+    }
+    tail.update(over)
+    return tail
+
+
+def test_benchcheck_valid_chaoscampaign_tail():
+    from tools.benchcheck import check
+
+    assert check(_campaign_tail(), "chaoscampaign") == []
+    assert check(_campaign_tail(), "chaoscampaign", strict=True) == []
+
+
+def test_benchcheck_chaoscampaign_strict_bounds():
+    from tools.benchcheck import check
+
+    # the convergence ceiling, the availability floor, and the two
+    # exact-true oracle verdicts each fail strict independently
+    bad = _campaign_tail(convergence_cycles=17, availability=0.5,
+                         recovered_identical=False, converged_all=False,
+                         invariant_violations=2)
+    assert check(bad, "chaoscampaign") == []  # shape still valid
+    errs = "\n".join(check(bad, "chaoscampaign", strict=True))
+    assert "convergence_cycles" in errs and "ceiling 16" in errs
+    assert "availability" in errs and "floor 0.6" in errs
+    assert "recovered_identical" in errs
+    assert "converged_all" in errs
+    assert "invariant_violations" in errs
+
+
+def test_benchcheck_chaoscampaign_types():
+    from tools.benchcheck import check
+
+    tail = _campaign_tail(convergence_cycles=True, profiles=[])
+    del tail["availability"]
+    errs = "\n".join(check(tail, "chaoscampaign"))
+    assert "convergence_cycles: expected int, got bool" in errs
+    assert "profiles: expected dict, got list" in errs
+    assert "missing key: availability" in errs

@@ -79,6 +79,23 @@ SCHEMAS = {
         "incremental_time_to_admit_s": NUM,
         "whatif_admit_speedup": NUM,
     },
+    # the chaoscampaign scenario's tail (bench.py "chaoscampaign"):
+    # composed-fault storms + the convergence oracle's aggregate
+    # verdicts (docs/ROBUSTNESS.md "Chaos campaigns")
+    "chaoscampaign": {
+        "scenario": str,
+        "seed": int,
+        "seconds": NUM,
+        "profiles": dict,
+        "converged_all": bool,
+        "recovered_identical": bool,
+        "convergence_cycles": int,
+        "max_degradation_level": int,
+        "availability": NUM,
+        "unavailable_wall_ms": NUM,
+        "invariant_violations": int,
+        "faults_injected": int,
+    },
     # the orchestrated run's headline tail (bench.py main): only the
     # always-present core — optional scenarios may drop their fields
     "main": {
@@ -105,6 +122,12 @@ FLOORS = {
         "whatif_oracle_agreement": 0.95,
         "whatif_admit_speedup": 1.0,
     },
+    "chaoscampaign": {
+        # worst profile still admits in most eligible cycles (the
+        # degraded-but-available claim; pod-loss's fenced streaming
+        # cycles are the binding case)
+        "availability": 0.6,
+    },
 }
 
 #: --strict acceptance ceilings per scenario (upper bounds: fairness
@@ -112,6 +135,12 @@ FLOORS = {
 CEILINGS = {
     "federation": {
         "tenant_wall_share_spread": 1.5,
+    },
+    "chaoscampaign": {
+        # the oracle's bound: every profile back to the twin's bytes
+        # within this many recovery cycles
+        "convergence_cycles": 16,
+        "invariant_violations": 0,
     },
 }
 
@@ -126,6 +155,10 @@ STRICT_EQ = {
     "federation": {
         "zero_cross_tenant": True,
         "plans_identical_dedicated": True,
+    },
+    "chaoscampaign": {
+        "converged_all": True,
+        "recovered_identical": True,
     },
 }
 
