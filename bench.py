@@ -2556,6 +2556,205 @@ def run_scenario(scenario: str) -> dict:
             "kernel_seconds": kernel_s,
         }
 
+    if scenario == "telemetry_arm":
+        # internal helper for the "telemetry" twin: one PAIRED run of
+        # the devtel collector off/on. Whole-run subprocess twins (the
+        # slo_arm protocol) cannot resolve this measurement — the
+        # per-drain wall is solver-execution dominated and swings
+        # +/-15% BETWEEN interpreters, far above the <=2% bar — so the
+        # two arms instead alternate per cycle inside ONE process on
+        # one shared store trajectory: even churn cycles run with the
+        # collector off, odd cycles with everything on (compile
+        # accounting, transfer ledger, HBM watermarks, armed capture,
+        # fabric tracer), and the medians of each parity are compared.
+        import gc
+        import tempfile
+
+        from kueue_oss_tpu import metrics as kmetrics
+        from kueue_oss_tpu import obs
+        from kueue_oss_tpu.api.types import PodSet, Workload
+        from kueue_oss_tpu.debugger.profiling import Tracer
+        from kueue_oss_tpu.federation import attach_farm
+        from kueue_oss_tpu.obs import devtel
+        from kueue_oss_tpu.scheduler.scheduler import Scheduler
+        from kueue_oss_tpu.solver.service import SolverClient, SolverServer
+
+        # 32 cycles PER PARITY: the per-cycle wall carries multi-ms
+        # solver-execution noise, and the parity medians need enough
+        # samples to resolve a sub-percent delta
+        n_cycles = int(os.environ.get("BENCH_DEVTEL_CYCLES", "32"))
+        warm_cycles = 2
+
+        store, queues, engine = _build(preemption=True, small=small)
+        sched = Scheduler(store, queues)
+        engine.scheduler = sched
+        obs.cycle_ledger.enabled = True  # constant across both arms
+        col = devtel.collector
+        col.compile_enabled = True
+        col.transfer_enabled = True
+        col.hbm_enabled = True
+        col.capture_enabled = True
+        tracer = Tracer()
+        col.tracer = tracer
+
+        def set_devtel(on: bool) -> None:
+            col.enabled = on
+            engine.tracer = tracer if on else None
+
+        set_devtel(True)  # warm-up runs the full collector path
+        path = os.path.join(tempfile.mkdtemp(), "solver.sock")
+        srv = SolverServer(path)
+        attach_farm(srv, weights={"bench": 1.0})
+        srv.serve_in_background()
+        n_wl = len(store.workloads)
+        churn = max(1, n_wl // 200)
+        # one padded capacity across the run (no pow2-boundary resyncs)
+        engine.pad_to = n_wl + churn * (2 * n_cycles + warm_cycles) + 1
+        try:
+            # cycle 0 drains IN-PROCESS: the engine's own arm router
+            # times the solve, so the compile probe sees the fresh XLA
+            # compiles (the sidecar's solves are outside the host
+            # router); the churn cycles then run through the sidecar
+            engine.drain(now=0.0, verify=True)
+            engine.remote = SolverClient(path, tenant="bench")
+            lqs = sorted({w.queue_name for w in store.workloads.values()})
+            proto = next(iter(store.workloads.values()))
+            req = dict(proto.podsets[0].requests)
+            uid = max(w.uid for w in store.workloads.values()) + 1
+            t_base = max(w.creation_time
+                         for w in store.workloads.values()) + 1.0
+
+            def churn_cycle(cyc):
+                admitted = [k for k, w in store.workloads.items()
+                            if w.is_quota_reserved and not w.is_finished]
+                for k in admitted[:churn]:
+                    sched.finish_workload(k, now=float(cyc))
+                for j in range(churn):
+                    i = uid + cyc * churn + j
+                    store.add_workload(Workload(
+                        name=f"churn-{cyc}-{j}",
+                        queue_name=lqs[i % len(lqs)], uid=i,
+                        creation_time=t_base + cyc * churn + j,
+                        podsets=[PodSet(name="main", count=1,
+                                        requests=dict(req))]))
+                engine.drain(now=float(cyc), verify=True)
+
+            for c in range(1, warm_cycles + 1):  # churn settles in
+                churn_cycle(c)
+            # keep the collector out of the timed window (slo_arm
+            # discipline): a GC pass over the 50k-object store is
+            # multiple percent of the wall
+            gc.collect()
+            gc.disable()
+            walls: dict[bool, list[float]] = {False: [], True: []}
+            try:
+                for i, c in enumerate(range(
+                        warm_cycles + 1,
+                        warm_cycles + 1 + 2 * n_cycles)):
+                    # ABBA assignment (off,on,on,off,...): churn
+                    # cycles carry an intrinsic even/odd rhythm, so a
+                    # plain alternation would conflate that parity
+                    # with the collector under test
+                    on = bool(i % 2) ^ bool((i // 2) % 2)
+                    set_devtel(on)
+                    t0 = time.monotonic()
+                    churn_cycle(c)
+                    walls[on].append(time.monotonic() - t0)
+            finally:
+                gc.enable()
+                set_devtel(True)
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        out = {"scenario": scenario, "workloads": n_wl,
+               "cycles": n_cycles,
+               # median-of-cycles x n beats the window sum: one
+               # straggler cycle (an XLA recompile, a socket hiccup)
+               # is several percent of a window — far above the delta
+               # under measurement
+               "wall_off": round(
+                   float(np.median(walls[False])) * n_cycles, 4),
+               "wall_on": round(
+                   float(np.median(walls[True])) * n_cycles, 4)}
+        # evidence OUTSIDE the timed window: the acceptance bar wants
+        # non-zero compile events + transfer bytes, a grant-wait p50
+        # out of the ledger rows, the synthetic track count of the
+        # merged timeline, and a deterministic virtual-clock capture
+        # drill
+        out["compiles_detected"] = int(
+            kmetrics.solver_compiles_total.total())
+        out["transfer_bytes_total"] = int(
+            kmetrics.solver_transfer_bytes_total.total())
+        waits = [r.grant_wait_ms for r in obs.cycle_ledger.rows()
+                 if r.kind != "host"]
+        out["grant_wait_ms_p50"] = (
+            round(float(np.percentile(waits, 50)), 4)
+            if waits else 0.0)
+        doc = json.loads(tracer.chrome_trace())
+        out["trace_tracks"] = len({
+            e.get("tid") for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "thread_name"})
+        cap = col.capture
+        cap.reset()  # clear any phase-regression cooldown stamp
+        vt = [0.0]
+        cap.clock = lambda: vt[0]
+        cap.dir = tempfile.mkdtemp()
+        cap.max_seconds = 0.5
+        started = cap.trigger("manual", {"source": "bench_drill"})
+        vt[0] = 1.0
+        finished = cap.poll()
+        marker = bool(cap.history and cap.history[-1].get("path")
+                      and os.path.exists(os.path.join(
+                          cap.history[-1]["path"], "capture.json")))
+        out["capture_trigger_works"] = bool(
+            started and finished and marker)
+        return out
+
+    if scenario == "telemetry":
+        # device-telemetry overhead twin on the 50k x 1k churn shape
+        # (docs/OBSERVABILITY.md "Device telemetry & fabric tracing"):
+        # one sidecar+farm churn loop whose cycles alternate the
+        # devtel collector off and fully on (compile accounting +
+        # transfer ledger + HBM watermarks + armed capture + fabric
+        # tracer) inside each hash-seed-pinned subprocess, repeated
+        # reps times. The overhead is computed PER REP (the pairing
+        # lives inside one process; min-reducing the parities
+        # independently would re-introduce the between-process noise)
+        # and median-reduced across reps. The JSON
+        # tail reports the relative overhead (<=2% acceptance bar,
+        # enforced by tools/benchcheck.py --strict) plus the on-arm
+        # evidence: compile events detected, unified transfer bytes,
+        # the grant-wait p50 out of the ledger, the merged timeline's
+        # synthetic track count, and the capture trigger drill.
+        import statistics
+
+        reps = int(os.environ.get("BENCH_DEVTEL_REPS", "3"))
+        pcts, offs, ons = [], [], []
+        res = None
+        for _ in range(reps):
+            res = measure("telemetry_arm",
+                          extra_env={"PYTHONHASHSEED": "0"},
+                          timeout=600)
+            offs.append(res["wall_off"])
+            ons.append(res["wall_on"])
+            if res["wall_off"] > 0:
+                pcts.append((res["wall_on"] - res["wall_off"])
+                            / res["wall_off"] * 100)
+        return {
+            "scenario": scenario,
+            "workloads": res["workloads"],
+            "cycles": res["cycles"],
+            "seconds_devtel_off": round(min(offs), 3),
+            "seconds_devtel_on": round(min(ons), 3),
+            "devtel_overhead_pct": (round(statistics.median(pcts), 2)
+                                    if pcts else 0.0),
+            "compiles_detected": res["compiles_detected"],
+            "transfer_bytes_total": res["transfer_bytes_total"],
+            "grant_wait_ms_p50": res["grant_wait_ms_p50"],
+            "trace_tracks": res["trace_tracks"],
+            "capture_trigger_works": res["capture_trigger_works"],
+        }
+
     raise SystemExit(f"unknown scenario {scenario}")
 
 
@@ -2758,6 +2957,16 @@ def main() -> None:
     except Exception as e:
         log(f"[slo] did not complete: {e}")
         slo = None
+    # device telemetry collector (compile accounting + transfer
+    # ledger + HBM watermarks + capture + fabric tracer) on the same
+    # churn shape (docs/OBSERVABILITY.md "Device telemetry & fabric
+    # tracing" acceptance: <= 2%)
+    try:
+        telemetry = measure("telemetry", extra_env={"BENCH_CPU": "1"},
+                            timeout=1800)
+    except Exception as e:
+        log(f"[telemetry] did not complete: {e}")
+        telemetry = None
     # durable control plane on the 50k x 1k churn shape (host backend:
     # the WAL instruments the host write path; docs/DURABILITY.md
     # acceptance: wal_overhead_pct under ~5%)
@@ -2976,6 +3185,19 @@ def main() -> None:
             "slo_combined_overhead_pct"]
         extra["slo_eval_ms"] = slo["slo_eval_ms"]
         extra["ledger_rows"] = slo["ledger_rows"]
+    if telemetry is not None:
+        # device telemetry (docs/OBSERVABILITY.md "Device telemetry &
+        # fabric tracing"): paired off/on collector overhead plus the
+        # compile/transfer/grant-wait/capture evidence bundle
+        extra["devtel_overhead_pct"] = telemetry["devtel_overhead_pct"]
+        extra["devtel_compiles_detected"] = telemetry[
+            "compiles_detected"]
+        extra["devtel_transfer_bytes_total"] = telemetry[
+            "transfer_bytes_total"]
+        extra["devtel_grant_wait_ms_p50"] = telemetry[
+            "grant_wait_ms_p50"]
+        extra["devtel_capture_trigger_works"] = telemetry[
+            "capture_trigger_works"]
     if durability is not None:
         # durable control plane (docs/DURABILITY.md): WAL overhead on
         # the churn shape, atomic checkpoint wall, and recovery

@@ -398,10 +398,44 @@ class SLOConfig:
 
 
 @dataclass
+class DevTelConfig:
+    """Device telemetry collector (kueue_oss_tpu/obs/devtel.py,
+    docs/OBSERVABILITY.md "Device telemetry & fabric tracing").
+
+    Off by default: every engine hook gates on ``enabled`` with a
+    cheap attribute read, the bench telemetry scenario's overhead
+    contract (devtel_overhead_pct <= 2)."""
+
+    #: master switch for the collector
+    enabled: bool = False
+    #: first-call compile detection per (kernel, arm, shape bucket);
+    #: replaces the router's one-shot compile-tainted warm set
+    compile_accounting: bool = True
+    #: unified solver_transfer_bytes_total{direction,arm,tenant} family
+    transfer_ledger: bool = True
+    #: per-drain HBM watermark gauges (memory_stats() where available,
+    #: resident-problem byte bookkeeping as the portable fallback)
+    hbm_watermarks: bool = True
+    #: tail-based deep capture on SLO burn / phase-regression triggers
+    capture_enabled: bool = False
+    #: artifact directory; None defaults beside the checkpoints
+    #: (persistence.dir) when persistence is configured
+    capture_dir: Optional[str] = None
+    #: capture session budget, seconds (finished by the drain poll)
+    capture_max_seconds: float = 5.0
+    #: CooldownPolicy window between capture STARTS
+    capture_cooldown_seconds: float = 300.0
+    #: bracket captures with a real jax.profiler trace (off by
+    #: default: the marker artifact alone is cheap and test-safe)
+    capture_use_profiler: bool = False
+
+
+@dataclass
 class ObservabilityConfig:
     """Cluster health layer switches (kueue_oss_tpu/obs/):
-    flight recorder, cycle ledger, histogram exemplars, SLO engine.
-    Applied to the process-wide obs state via ``obs.configure``."""
+    flight recorder, cycle ledger, histogram exemplars, SLO engine,
+    device telemetry. Applied to the process-wide obs state via
+    ``obs.configure``."""
 
     #: decision flight recorder (PR 4) master switch
     recorder_enabled: bool = True
@@ -414,6 +448,7 @@ class ObservabilityConfig:
     #: queue-wait SLI feeding + burn-rate alerting
     slo_enabled: bool = True
     slo: SLOConfig = field(default_factory=SLOConfig)
+    devtel: DevTelConfig = field(default_factory=DevTelConfig)
 
 
 @dataclass
@@ -603,6 +638,13 @@ def validate(cfg: Configuration) -> list[str]:
     if slo.alert_webhook_timeout_seconds <= 0:
         errs.append("observability.slo.alertWebhookTimeout must be "
                     "> 0")
+    dtl = ob.devtel
+    if dtl.capture_max_seconds <= 0:
+        errs.append("observability.devtel.captureMaxSeconds must be "
+                    "> 0")
+    if dtl.capture_cooldown_seconds < 0:
+        errs.append("observability.devtel.captureCooldownSeconds must "
+                    "be >= 0")
     afs = cfg.admission_fair_sharing
     if afs is not None:
         if afs.usage_half_life_time_seconds < 0:
@@ -800,6 +842,20 @@ def load(data: Optional[dict] = None) -> Configuration:
                 "alert_webhook_timeout_seconds", float),
         })
 
+    def conv_devtel(d: dict) -> DevTelConfig:
+        return _build(DevTelConfig, d, {
+            "enabled": ("enabled", None),
+            "compileAccounting": ("compile_accounting", None),
+            "transferLedger": ("transfer_ledger", None),
+            "hbmWatermarks": ("hbm_watermarks", None),
+            "captureEnabled": ("capture_enabled", None),
+            "captureDir": ("capture_dir", None),
+            "captureMaxSeconds": ("capture_max_seconds", float),
+            "captureCooldownSeconds": ("capture_cooldown_seconds",
+                                       float),
+            "captureUseProfiler": ("capture_use_profiler", None),
+        })
+
     def conv_obs(d: dict) -> ObservabilityConfig:
         return _build(ObservabilityConfig, d, {
             "recorderEnabled": ("recorder_enabled", None),
@@ -808,6 +864,7 @@ def load(data: Optional[dict] = None) -> Configuration:
             "exemplars": ("exemplars", None),
             "sloEnabled": ("slo_enabled", None),
             "slo": ("slo", conv_slo),
+            "devtel": ("devtel", conv_devtel),
         })
 
     def conv_sim(d: dict) -> SimulatorConfig:

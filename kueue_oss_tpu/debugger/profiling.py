@@ -211,6 +211,13 @@ class Tracer:
         #: stall needs the RECENT activity, not warm-up)
         self._spans: list[tuple] = []
         self._next = 0
+        #: external span sources (sidecar solves, followers, the farm)
+        #: get stable SYNTHETIC track ids so their spans never
+        #: interleave with host threads on one Chrome-trace track.
+        #: Small ids are safe: host tids are pthread pointers.
+        self._tracks: dict[str, int] = {}
+        self._track_meta: dict[str, dict] = {}
+        self._next_track = 2
 
     @contextmanager
     def span(self, name: str, **args):
@@ -225,16 +232,35 @@ class Tracer:
             self._push((name, threading.get_ident(),
                         int(t0 * 1e6), int(dur * 1e6), args or None))
 
+    def track(self, source: str, **meta) -> int:
+        """Stable synthetic track id for an external span source
+        (``"sidecar:tenant-a"``, ``"farm"``, ``"follower:1"``).
+        ``meta`` (process/tenant tags) accumulates onto the track and
+        exports as Chrome thread_name metadata."""
+        with self._lock:
+            tid = self._tracks.get(source)
+            if tid is None:
+                tid = self._tracks[source] = self._next_track
+                self._next_track += 1
+            if meta:
+                self._track_meta.setdefault(source, {}).update(meta)
+            return tid
+
     def add_span(self, name: str, ts_us: int, dur_us: int,
-                 tid: Optional[int] = None, **args) -> None:
+                 tid: Optional[int] = None,
+                 source: Optional[str] = None, **args) -> None:
         """Record an externally-timed span (e.g. a sidecar solve whose
         timing arrived over the wire) into the same ring, so host and
-        remote activity export as one Chrome-trace timeline."""
+        remote activity export as one Chrome-trace timeline. Pass
+        ``source`` for external spans — they land on that source's own
+        synthetic track instead of the CALLER's thread track (merged
+        remote spans used to interleave with host spans)."""
         if not self.enabled:
             return
-        self._push((name,
-                    threading.get_ident() if tid is None else tid,
-                    int(ts_us), int(dur_us), args or None))
+        if tid is None:
+            tid = (self.track(source) if source is not None
+                   else threading.get_ident())
+        self._push((name, tid, int(ts_us), int(dur_us), args or None))
 
     def _push(self, entry: tuple) -> None:
         with self._lock:
@@ -260,11 +286,22 @@ class Tracer:
         return [dur / 1000 for (n, _, _, dur, _) in self.spans()
                 if n == name]
 
-    def chrome_trace(self) -> str:
+    def chrome_trace(self, spans: Optional[list] = None) -> str:
         """Chrome-trace JSON ('X' complete events) — loadable in
-        chrome://tracing or Perfetto alongside a JAX device trace."""
+        chrome://tracing or Perfetto alongside a JAX device trace.
+        Synthetic source tracks lead with 'M' thread_name metadata so
+        the timeline labels them by source + tenant/process tags."""
+        with self._lock:
+            tracks = sorted(self._tracks.items(), key=lambda kv: kv[1])
+            meta = {s: dict(m) for s, m in self._track_meta.items()}
         events = []
-        for name, tid, ts, dur, args in self.spans():
+        for src, tid in tracks:
+            args = {"name": src}
+            args.update(meta.get(src, {}))
+            events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                           "tid": tid, "args": args})
+        for name, tid, ts, dur, args in (self.spans() if spans is None
+                                         else spans):
             ev = {"name": name, "ph": "X", "pid": 1, "tid": tid,
                   "ts": ts, "dur": dur, "cat": "scheduler"}
             if args:

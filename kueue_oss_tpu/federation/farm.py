@@ -64,6 +64,18 @@ class _Ticket:
         self.granted = threading.Event()
 
 
+#: the handler thread that called run() also runs fn() and builds the
+#: response, so the grant-wait it just paid rides a thread-local out to
+#: the service layer (the response header's grant_wait_ms field)
+_tls = threading.local()
+
+
+def last_grant_wait_s() -> float:
+    """Grant-wait of the newest farm admission on THIS thread (0.0
+    when the thread never went through a farm)."""
+    return getattr(_tls, "grant_wait_s", 0.0)
+
+
 class FarmScheduler:
     """Weighted deficit-round-robin admission over solver wall-time.
 
@@ -90,6 +102,9 @@ class FarmScheduler:
         self.max_credit_quanta = float(max_credit_quanta)
         self.grant_timeout_s = float(grant_timeout_s)
         self._clock = clock
+        #: optional debugger.profiling.Tracer — when set, every grant
+        #: stamps a "farm_grant_wait" span on a per-tenant farm track
+        self.tracer = None
         self._lock = threading.Lock()
         self._queues: dict[str, deque[_Ticket]] = {}
         self._ring: list[str] = []
@@ -265,6 +280,8 @@ class FarmScheduler:
         """
         tenant = str(tenant)
         ticket = _Ticket()
+        _tls.grant_wait_s = 0.0
+        t_enq = self._clock()
         with self._lock:
             self._register_locked(tenant)
             if self.throttle_fault.get(tenant, 0) > 0:
@@ -290,6 +307,17 @@ class FarmScheduler:
                     return self._throttle_locked(
                         tenant, "grant wait timed out")
                 # granted in the race window: fall through and run
+        wait_s = max(0.0, self._clock() - t_enq)
+        _tls.grant_wait_s = wait_s
+        metrics.solver_farm_grant_wait_seconds.observe(tenant,
+                                                       value=wait_s)
+        tracer = self.tracer
+        if tracer is not None and getattr(tracer, "enabled", False):
+            dur_us = int(wait_s * 1e6)
+            now_us = int(tracer.clock() * 1e6)
+            tracer.add_span("farm_grant_wait", now_us - dur_us, dur_us,
+                            source=f"farm:{tenant or 'solver'}",
+                            tenant=tenant)
         metrics.solver_farm_requests_total.inc(tenant)
         t0 = self._clock()
         try:

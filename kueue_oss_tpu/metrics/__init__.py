@@ -595,6 +595,48 @@ solver_farm_throttled_total = registry.register(Counter(
 solver_farm_tenants = registry.register(Gauge(
     "kueue_tpu_solver_farm_tenants",
     "Distinct tenants with live state on the shared solver farm", ()))
+solver_farm_grant_wait_seconds = registry.register(Histogram(
+    "kueue_tpu_solver_farm_grant_wait_seconds",
+    "Seconds between a solve request's arrival at the farm and its "
+    "DRR grant (the queue-wait the deficit scheduler imposes), by "
+    "tenant", ("tenant",),
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)))
+
+# -- device telemetry (obs/devtel.py, docs/OBSERVABILITY.md) -----------------
+
+solver_compiles_total = registry.register(Counter(
+    "kueue_tpu_solver_compiles_total",
+    "First-call XLA compilations detected per (kernel, arm, pow2 "
+    "shape bucket) by the devtel compile detector",
+    ("kernel", "arm", "bucket")))
+solver_compile_seconds = registry.register(Histogram(
+    "kueue_tpu_solver_compile_seconds",
+    "Wall seconds of solves flagged as compile-bearing (first call "
+    "for a (kernel, arm, shape-bucket); upper-bounds compile time — "
+    "the wall includes the traced execution)", (),
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0, 60.0)))
+solver_transfer_bytes_total = registry.register(Counter(
+    "kueue_tpu_solver_transfer_bytes_total",
+    "Host<->device and wire transfer bytes by direction (h2d = "
+    "uploads incl. donated deltas; avoided = copies elided by "
+    "donation/aliasing; tx = request frames on the sidecar wire), "
+    "arm, and tenant", ("direction", "arm", "tenant")))
+solver_hbm_resident_bytes = registry.register(Gauge(
+    "kueue_tpu_solver_hbm_resident_bytes",
+    "Bytes of solver problem state resident on device after the last "
+    "drain (portable bookkeeping over the delta-session buffers)", ()))
+solver_hbm_bytes_in_use = registry.register(Gauge(
+    "kueue_tpu_solver_hbm_bytes_in_use",
+    "Device-reported bytes_in_use per device (memory_stats(); absent "
+    "on backends that do not expose allocator stats)", ("device",)))
+solver_deep_captures_total = registry.register(Counter(
+    "kueue_tpu_solver_deep_captures_total",
+    "Tail-based deep-capture sessions by trigger "
+    "(slo_burn/phase_regression/manual) and outcome "
+    "(started/suppressed_cooldown/suppressed_busy/disarmed)",
+    ("trigger", "outcome")))
 
 # -- federated dispatch (multikueue/dispatcher.py WhatIf strategy) -----------
 

@@ -364,13 +364,23 @@ from kueue_oss_tpu.obs.ledger import (  # noqa: E402
     load_ledger_jsonl,
 )
 from kueue_oss_tpu.obs.ledger import ledger as cycle_ledger  # noqa: E402
+from kueue_oss_tpu.obs import devtel  # noqa: E402
+from kueue_oss_tpu.obs.devtel import (  # noqa: E402
+    CompileDetector,
+    DeepCapture,
+    DeviceTelemetry,
+)
+from kueue_oss_tpu.obs.devtel import collector as device_telemetry  # noqa: E402
 
 
-def configure(obs_cfg) -> None:
+def configure(obs_cfg, capture_dir=None) -> None:
     """Apply a config.ObservabilityConfig to the process-wide obs
     state: the recorder/ledger switches and bounds, the metrics
-    exemplar switch, and the SLO engine's objectives (windows and
-    alert state reset — a reconfigured objective starts clean)."""
+    exemplar switch, the SLO engine's objectives (windows and alert
+    state reset — a reconfigured objective starts clean), and the
+    device-telemetry collector. ``capture_dir`` defaults devtel's
+    deep-capture artifacts beside the checkpoints (callers pass
+    ``cfg.persistence.dir``)."""
     recorder.enabled = obs_cfg.recorder_enabled
     cycle_ledger.enabled = obs_cfg.ledger_enabled
     if obs_cfg.ledger_max_cycles != cycle_ledger.max_cycles:
@@ -391,3 +401,4 @@ def configure(obs_cfg) -> None:
         WebhookSink(s.alert_webhook_url,
                     timeout_s=s.alert_webhook_timeout_seconds)
         if s.alert_webhook_url else None)
+    devtel.collector.configure(obs_cfg.devtel, capture_dir=capture_dir)

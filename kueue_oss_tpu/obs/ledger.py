@@ -82,6 +82,9 @@ class CycleRecord:
     frame_reason: str = ""
     #: HostDeltaSession churn stats (added/removed keys, dirty rows)
     session: dict = field(default_factory=dict)
+    #: milliseconds this drain's solve request waited for its farm DRR
+    #: grant (0 = dedicated sidecar / host path / farm idle)
+    grant_wait_ms: float = 0.0
     #: resident-device accounting DELTAS for this drain: donated
     #: scatter bytes, avoided full-copy bytes, full uploads, donated
     #: full syncs (DeviceResidentProblem counters)
@@ -110,6 +113,8 @@ class CycleRecord:
                 d["frameReason"] = self.frame_reason
             if self.session:
                 d["session"] = self.session
+            if self.grant_wait_ms:
+                d["grantWaitMs"] = self.grant_wait_ms
             if self.device:
                 d["device"] = self.device
         if self.detail:
@@ -139,6 +144,7 @@ class CycleRecord:
             frame_bytes=int(d.get("frameBytes", 0)),
             frame_reason=str(d.get("frameReason", "")),
             session=dict(d.get("session") or {}),
+            grant_wait_ms=float(d.get("grantWaitMs", 0.0)),
             device=dict(d.get("device") or {}),
             detail=d.get("detail"))
 

@@ -1055,6 +1055,13 @@ class DeviceResidentProblem:
         self.apply_faults = 0
         self._scatter_cache: dict = {}
 
+    def resident_bytes(self) -> int:
+        """Bytes of problem state currently pinned on device — the
+        portable HBM-watermark bookkeeping obs/devtel.py gauges when
+        the backend exposes no allocator stats (0 = nothing resident)."""
+        return _tree_nbytes(self.tensors) if self.tensors is not None \
+            else 0
+
     def update(self, problem: SolverProblem, frame: Optional[SessionFrame],
                full: bool):
         kind = "full" if full else "lean"

@@ -27,6 +27,7 @@ from kueue_oss_tpu.core.queue_manager import QueueManager
 from kueue_oss_tpu.core.store import Store
 from kueue_oss_tpu.core.workload_info import WorkloadInfo
 from kueue_oss_tpu import metrics, obs, resilience
+from kueue_oss_tpu.obs import devtel
 from kueue_oss_tpu.solver.delta import (
     DeviceResidentProblem,
     HostDeltaSession,
@@ -577,17 +578,42 @@ class SolverEngine:
                 totals[k] += int(getattr(dev, k, 0))
         return totals
 
+    def _resident_bytes(self) -> int:
+        """Problem bytes pinned on device right now, summed over every
+        resident state (both kernels, both arms) — devtel's portable
+        HBM watermark."""
+        return sum(int(dev.resident_bytes())
+                   for dev in self._device_states.values()
+                   if hasattr(dev, "resident_bytes"))
+
     def _ledger_record(self, result: DrainResult, frame, kind: str,
                        dev0: dict, parked_n: int) -> None:
         """One solver ledger row per drain, keyed by the same cycle id
         the recorder's DecisionEvents carry — solver routing, session
-        wire kind/bytes, and resident-buffer churn in one record."""
+        wire kind/bytes, resident-buffer churn, and (devtel) the
+        drain's transfer/HBM/compile/grant-wait telemetry in one
+        record."""
         ledger = obs.cycle_ledger
-        if not ledger.enabled:
-            return
         dev1 = self._device_totals()
         device = {k: dev1[k] - dev0.get(k, 0)
                   for k in dev1 if dev1[k] - dev0.get(k, 0)}
+        arm = ("remote" if self.remote is not None
+               else (self.last_drain_arm or "single"))
+        tenant = getattr(self.remote, "tenant", "")
+        dtl = devtel.collector
+        if dtl.enabled:
+            # unified transfer family + per-drain HBM watermark; the
+            # gauges/counters flow even with the ledger disabled (the
+            # bench twin's off arm disables the ledger, not devtel)
+            dtl.note_transfers(arm, tenant, device)
+            device.update(dtl.sample_residency(self._resident_bytes()))
+            events = dtl.compiles.drain_events()
+            if events:
+                device["compiles"] = len(events)
+                device["compile_events"] = events
+            dtl.on_drain()
+        if not ledger.enabled:
+            return
         frame_kind, frame_bytes, frame_reason, session = "legacy", 0, "", {}
         if frame is not None:
             session = dict(frame.stats or {})
@@ -600,8 +626,6 @@ class SolverEngine:
                 sess_obj = self._delta_sessions.get(kind)
                 if sess_obj is not None:
                     frame_bytes = sess_obj.last_sync_wire_bytes()
-        arm = ("remote" if self.remote is not None
-               else (self.last_drain_arm or "single"))
         phases = {"solve": round(result.solver_time_s, 6),
                   "apply": round(result.apply_time_s, 6)}
         # export/encode/device_put walls + the columnar walk/scatter
@@ -613,9 +637,12 @@ class SolverEngine:
         # farm tenancy attribution (docs/FEDERATION.md): ledger rows
         # from a control plane sharing a multi-tenant solver farm carry
         # the tenant id its frames were billed under
-        tenant = getattr(self.remote, "tenant", "")
         if tenant:
             session["tenant"] = tenant
+        # the farm's DRR grant-wait for this drain's solve request,
+        # echoed back by the sidecar (0 = dedicated / host / farm idle)
+        grant_wait_ms = float(getattr(self.remote, "last_grant_wait_ms",
+                                      0.0) or 0.0)
         ledger.record(
             self._drain_cycle, obs.SOLVER_DRAIN,
             breaker=obs.breaker_state_name(),
@@ -624,7 +651,8 @@ class SolverEngine:
             admitted=result.admitted, evicted=result.evicted,
             parked=parked_n, rounds=result.rounds, solver_arm=arm,
             frame_kind=frame_kind, frame_bytes=frame_bytes,
-            frame_reason=frame_reason, session=session, device=device)
+            frame_reason=frame_reason, session=session,
+            grant_wait_ms=grant_wait_ms, device=device)
 
     # -- mesh routing (solver/meshutil.py, solver/sharded.py) --------------
 
@@ -718,6 +746,7 @@ class SolverEngine:
             self._device_states.pop(kind + "-mesh", None)
             self._arm_ema.pop((kind, "mesh"), None)
             self._arm_warm.discard((kind, "mesh"))
+            devtel.collector.forget(kind, "mesh")
         return meshutil.mesh_devices(self._mesh())
 
     def _pick_mesh_arm(self, kind: str, n_workloads: int):
@@ -748,7 +777,16 @@ class SolverEngine:
     def _note_arm_wall(self, kind: str, arm: str, wall_s: float,
                        n_workloads: int) -> None:
         key = (kind, arm)
-        if key not in self._arm_warm:
+        dtl = devtel.collector
+        if dtl.enabled and dtl.compile_enabled:
+            # devtel's per-(kernel, arm, shape-bucket) verdict replaces
+            # the legacy one-shot warm set: a warm arm re-solving at a
+            # new padded width is caught (its compile-tainted wall
+            # stays out of the EMA), and a warm arm's first sample is
+            # no longer wasted
+            if dtl.observe_solve(kind, arm, n_workloads, wall_s):
+                return
+        elif key not in self._arm_warm:
             # compile-tainted probe sample: discard it (the arm stays
             # unmeasured, so the router probes it once more, warm)
             self._arm_warm.add(key)
@@ -778,6 +816,7 @@ class SolverEngine:
             reason=f"mesh drain failed ({e!r}); degrading to the "
                    "single-chip solver arm")
         self._arm_warm.discard((kind, "mesh"))
+        devtel.collector.forget(kind, "mesh")
         self._device_states.pop(kind + "-mesh", None)
         metrics.solver_fallback_total.inc("mesh_error")
         metrics.solver_mesh_devices.set(value=0)
@@ -825,6 +864,7 @@ class SolverEngine:
                 return False
             self._relax_broken = False
             self._arm_warm.discard(("lean", "relax"))
+            devtel.collector.forget("lean", "relax")
         return True
 
     def _pick_relax_arm(self, n_live: int) -> bool:
@@ -869,6 +909,7 @@ class SolverEngine:
             cycle=self._drain_cycle, reason=reason)
         self._arm_ema.pop(("lean", "relax"), None)
         self._arm_warm.discard(("lean", "relax"))
+        devtel.collector.forget("lean", "relax")
         metrics.solver_fallback_total.inc(slug)
         obs.recorder.record(
             obs.SOLVER_FALLBACK, obs.CYCLE_SCOPE,
@@ -1315,18 +1356,32 @@ class SolverEngine:
         if tracer is None or not spans:
             return
         now_us = int(tracer.clock() * 1e6)
+        tenant = str(getattr(self.remote, "tenant", "") or "")
         for sp in spans:
             # span import is best-effort diagnostics: a version-skewed
             # or garbled spans entry must not abort the drain (the plan
             # itself is separately sanity-guarded)
             try:
                 dur_us = int(sp.get("dur_us", 0))
+                # a span that ended BEFORE the response (the farm's
+                # grant-wait precedes the solve) declares the gap so
+                # the merged timeline keeps wait -> solve ordering
+                skew_us = int(sp.get("end_skew_us", 0))
                 args = {str(k): v
                         for k, v in dict(sp.get("args") or {}).items()
-                        if k not in ("name", "ts_us", "dur_us", "tid")}
+                        if k not in ("name", "ts_us", "dur_us", "tid",
+                                     "source")}
                 args.setdefault("cycle", self._drain_cycle)
+                # each remote source gets its own stable synthetic
+                # track (tagged with the tenant) instead of the old
+                # shared tid=0 pile-up
+                src = str(dict(sp.get("args") or {}).get("source", "")
+                          or f"sidecar:{tenant or 'solver'}")
                 tracer.add_span(str(sp.get("name", "sidecar_solve")),
-                                now_us - dur_us, dur_us, tid=0, **args)
+                                now_us - skew_us - dur_us, dur_us,
+                                source=src, **args)
+                if tenant:
+                    tracer.track(src, tenant=tenant)
             except Exception:
                 continue
 

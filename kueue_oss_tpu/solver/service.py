@@ -221,13 +221,43 @@ def _solve_kernel(tensors, header: dict, mesh=None):
 
 
 def _spans(header: dict, t0: float) -> list[dict]:
+    """The response's trace-context spans: the sidecar solve itself
+    plus (farmed requests) the DRR grant-wait the handler thread just
+    paid. Every span names its ``source`` so the importing host tracer
+    lands it on a stable per-process/per-tenant synthetic track."""
+    tenant = str(header.get("tenant", ""))
+    src_tail = tenant or "solver"
     span_args = {"full": bool(header["full"]),
-                 "kind": header.get("kind", "solve")}
+                 "kind": header.get("kind", "solve"),
+                 "source": f"sidecar:{src_tail}"}
+    if tenant:
+        span_args["tenant"] = tenant
     if header.get("trace_cycle") is not None:
         span_args["cycle"] = header["trace_cycle"]
-    return [{"name": "sidecar_solve",
-             "dur_us": int((time.perf_counter() - t0) * 1e6),
-             "args": span_args}]
+    solve_dur_us = int((time.perf_counter() - t0) * 1e6)
+    spans = [{"name": "sidecar_solve", "dur_us": solve_dur_us,
+              "args": span_args}]
+    try:
+        from kueue_oss_tpu.federation.farm import last_grant_wait_s
+
+        wait_s = last_grant_wait_s()
+    except Exception:
+        wait_s = 0.0
+    if wait_s > 0.0:
+        wait_args = {"kind": "grant_wait",
+                     "source": f"farm:{src_tail}"}
+        if tenant:
+            wait_args["tenant"] = tenant
+        if header.get("trace_cycle") is not None:
+            wait_args["cycle"] = header["trace_cycle"]
+        # the wait ENDED when the solve began: end_skew_us lets the
+        # importing tracer place it just before the solve span instead
+        # of overlapping it (both are end-aligned at response arrival)
+        spans.append({"name": "farm_grant_wait",
+                      "dur_us": int(wait_s * 1e6),
+                      "end_skew_us": solve_dur_us,
+                      "args": wait_args})
+    return spans
 
 
 def compact_plan(out, full: bool) -> dict[str, np.ndarray]:
@@ -582,8 +612,18 @@ def solve_request(header: dict, blob: bytes,
     """
     farm = getattr(server, "farm", None)
     if farm is not None:
-        return farm.run(str(header.get("tenant", "")),
-                        lambda: _solve_request_body(header, blob, server))
+        resp, out = farm.run(
+            str(header.get("tenant", "")),
+            lambda: _solve_request_body(header, blob, server))
+        if resp.get("ok"):
+            # echo the DRR grant-wait so the client's engine can ledger
+            # it per drain (solver_farm_grant_wait_seconds carries the
+            # same value farm-side)
+            from kueue_oss_tpu.federation.farm import last_grant_wait_s
+
+            resp.setdefault("grant_wait_ms",
+                            round(last_grant_wait_s() * 1e3, 3))
+        return resp, out
     return _solve_request_body(header, blob, server)
 
 
@@ -845,6 +885,9 @@ class SolverClient:
         self.trace_cycle: Optional[int] = None
         #: sidecar spans from the LAST successful solve's response header
         self.last_spans: list[dict] = []
+        #: the farm's DRR grant-wait echoed in the LAST successful
+        #: response (ms; 0 = dedicated sidecar or farm idle)
+        self.last_grant_wait_ms = 0.0
         #: the sidecar's advertised mesh width (session responses);
         #: the engine aligns its pad target to it so the sidecar can
         #: shard the resident problem (0 = unknown / no sidecar mesh)
@@ -919,6 +962,10 @@ class SolverClient:
         self.last_frame = (kind, n)
         metrics.solver_session_frames_total.inc(kind)
         metrics.solver_session_bytes_total.inc(kind, by=float(n))
+        # devtel transfer ledger: request frames are direction "tx"
+        from kueue_oss_tpu.obs import devtel
+
+        devtel.collector.note_wire("remote", self.tenant, n)
 
     # -- the call ----------------------------------------------------------
 
@@ -928,6 +975,7 @@ class SolverClient:
               session_key: str = "default"):
         params = self._base_params(full, g_max, h_max, p_max, fs_enabled)
         self.last_spans = []
+        self.last_grant_wait_ms = 0.0
         st = None
         mode = "legacy"
         if frame is not None and self.use_sessions:
@@ -1032,6 +1080,11 @@ class SolverClient:
                 f"solver sidecar reported failure: {err}")
         spans = resp.get("spans")
         self.last_spans = spans if isinstance(spans, list) else []
+        try:
+            self.last_grant_wait_ms = float(
+                resp.get("grant_wait_ms", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            self.last_grant_wait_ms = 0.0
         try:
             self.remote_mesh_devices = int(resp.get("mesh_devices", 0))
         except (TypeError, ValueError):

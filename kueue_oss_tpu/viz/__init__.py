@@ -39,6 +39,10 @@ class Dashboard:
         #: the solver-farm scheduler behind /api/farm/weights (attach
         #: via ``dash.farm = scheduler`` when federation is on)
         self.farm = None
+        #: the Tracer behind /api/trace (attach via ``dash.tracer =
+        #: engine.debugger.tracer`` — or any Tracer merging fabric
+        #: spans); None = 404-equivalent empty export
+        self.tracer = None
         #: bumped on every store event; SSE clients wake on it
         self._gen = 0
         #: (monotonic wall, report) memo shared by slo_view and
@@ -405,6 +409,64 @@ class Dashboard:
         return {"ok": True, "weights": effective,
                 "defaultWeight": self.farm.default_weight}
 
+    def trace_view(self, last_cycles: int = 0) -> dict:
+        """Chrome-trace export of the merged fabric timeline (GET
+        /api/trace[?cycles=N]): host drain spans, farm grant-waits and
+        sidecar/mesh solves on their own synthetic tracks. ``cycles``
+        windows to the newest N distinct cycle ids (0 = the whole
+        bounded ring — the Tracer ring already caps the export)."""
+        if self.tracer is None:
+            return {"attached": False, "traceEvents": []}
+        spans = self.tracer.spans()
+        if last_cycles > 0:
+            cycles = sorted({(a or {}).get("cycle")
+                             for (_, _, _, _, a) in spans}
+                            - {None})
+            keep = set(cycles[-last_cycles:])
+            spans = [s for s in spans
+                     if (s[4] or {}).get("cycle") is None
+                     or s[4]["cycle"] in keep]
+        return json.loads(self.tracer.chrome_trace(spans=spans))
+
+    def telemetry_view(self) -> dict:
+        """Device-telemetry status (GET /api/telemetry): collector
+        flags, compile-detector summary, deep-capture arm/active/
+        cooldown state and recent capture artifacts."""
+        from kueue_oss_tpu.obs import devtel
+
+        return devtel.collector.status()
+
+    def telemetry_post(self, payload: dict) -> dict:
+        """Capture control (POST /api/telemetry): body ``{"action":
+        "arm"|"disarm"|"trigger"|"stop"}``; ``trigger`` takes an
+        optional ``reason`` detail string. Arm/disarm gate the
+        tail-capture trigger path; trigger starts a manual capture
+        (subject to the single-slot and cooldown gates); stop
+        force-finishes the in-flight capture."""
+        from kueue_oss_tpu.obs import devtel
+
+        cap = devtel.collector.capture
+        action = payload.get("action")
+        if action == "arm":
+            cap.armed = True
+        elif action == "disarm":
+            cap.armed = False
+        elif action == "trigger":
+            started = cap.trigger(
+                "manual", {"reason": str(payload.get("reason", "api"))})
+            if not started:
+                return {"ok": False,
+                        "error": "capture suppressed (disarmed, busy, "
+                                 "or cooling down)",
+                        "status": devtel.collector.status()}
+        elif action == "stop":
+            cap.stop()
+        else:
+            return {"ok": False,
+                    "error": "action must be one of arm, disarm, "
+                             "trigger, stop"}
+        return {"ok": True, "status": devtel.collector.status()}
+
     # -- per-resource detail views (WorkloadDetail.jsx et al) ---------------
 
     def workload_detail(self, namespace: str, name: str) -> Optional[dict]:
@@ -590,6 +652,21 @@ class DashboardServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if path == "/api/trace":
+                    from urllib.parse import parse_qs, urlparse
+
+                    qs = parse_qs(urlparse(self.path).query)
+                    try:
+                        n = int(qs.get("cycles", ["0"])[0])
+                    except ValueError:
+                        n = 0
+                    body = json.dumps(dash.trace_view(n)).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path == "/api/whatif":
                     from urllib.parse import parse_qs, urlparse
 
@@ -680,6 +757,7 @@ class DashboardServer:
                     "/api/health": dash.health_view,
                     "/api/degradation": dash.degradation_view,
                     "/api/farm/weights": dash.farm_weights_view,
+                    "/api/telemetry": dash.telemetry_view,
                 }
                 fn = routes.get(path)
                 if fn is None:
@@ -695,7 +773,10 @@ class DashboardServer:
 
             def do_POST(self) -> None:
                 path = self.path.split("?", 1)[0]
-                if path != "/api/farm/weights":
+                posts = {"/api/farm/weights": dash.set_farm_weights,
+                         "/api/telemetry": dash.telemetry_post}
+                fn = posts.get(path)
+                if fn is None:
                     self.send_response(404)
                     self.end_headers()
                     return
@@ -708,7 +789,7 @@ class DashboardServer:
                     out = {"ok": False, "error": f"bad request: {e}"}
                     code = 400
                 else:
-                    out = dash.set_farm_weights(payload)
+                    out = fn(payload)
                     code = 200 if out.get("ok") else 409
                 body = json.dumps(out).encode()
                 self.send_response(code)

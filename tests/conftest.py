@@ -81,6 +81,13 @@ def pytest_configure(config):
         "oracle, and member-loss chaos recovery; deterministic, runs "
         "in tier-1")
     config.addinivalue_line(
+        "markers", "devtel: device-telemetry tests (obs/devtel.py): "
+        "compile-detector fresh/warm/forget verdicts, unified "
+        "transfer-byte + HBM-watermark accounting (in-process and "
+        "sidecar), fabric-wide trace track merging, virtual-clock "
+        "deep-capture lifecycle, and the /api/trace + /api/telemetry "
+        "surfaces; deterministic, runs in tier-1")
+    config.addinivalue_line(
         "markers", "slo: cluster health layer tests (obs/ledger.py + "
         "obs/health.py): virtual-clock burn-rate sequences, starvation "
         "watchdog, exemplar round-trips, ledger joins, and the "

@@ -667,7 +667,7 @@ def test_benchcheck_unknown_scenario_and_cli(tmp_path):
 
     assert check({}, "nope") == ["unknown scenario 'nope' (known: "
                                  "chaoscampaign, federation, main, "
-                                 "megascale)"]
+                                 "megascale, telemetry)"]
     path = tmp_path / "tail.json"
     path.write_text("garbage first line\n"
                     + json.dumps(_mega_tail()) + "\n")

@@ -96,6 +96,22 @@ SCHEMAS = {
         "invariant_violations": int,
         "faults_injected": int,
     },
+    # the telemetry scenario's tail (bench.py "telemetry"): devtel
+    # collector off/on twin + the on-arm evidence bundle
+    # (docs/OBSERVABILITY.md "Device telemetry & fabric tracing")
+    "telemetry": {
+        "scenario": str,
+        "workloads": int,
+        "cycles": int,
+        "seconds_devtel_off": NUM,
+        "seconds_devtel_on": NUM,
+        "devtel_overhead_pct": NUM,
+        "compiles_detected": int,
+        "transfer_bytes_total": int,
+        "grant_wait_ms_p50": NUM,
+        "trace_tracks": int,
+        "capture_trigger_works": bool,
+    },
     # the orchestrated run's headline tail (bench.py main): only the
     # always-present core — optional scenarios may drop their fields
     "main": {
@@ -128,6 +144,14 @@ FLOORS = {
         # cycles are the binding case)
         "availability": 0.6,
     },
+    "telemetry": {
+        # the acceptance bar wants non-trivial evidence, not a tail of
+        # zeros: at least one compile event, some transferred bytes,
+        # and the merged timeline's synthetic tracks (sidecar + farm)
+        "compiles_detected": 1,
+        "transfer_bytes_total": 1,
+        "trace_tracks": 2,
+    },
 }
 
 #: --strict acceptance ceilings per scenario (upper bounds: fairness
@@ -141,6 +165,10 @@ CEILINGS = {
         # within this many recovery cycles
         "convergence_cycles": 16,
         "invariant_violations": 0,
+    },
+    "telemetry": {
+        # the collector's overhead contract on the churn shape
+        "devtel_overhead_pct": 2.0,
     },
 }
 
@@ -159,6 +187,9 @@ STRICT_EQ = {
     "chaoscampaign": {
         "converged_all": True,
         "recovered_identical": True,
+    },
+    "telemetry": {
+        "capture_trigger_works": True,
     },
 }
 

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Offline fabric-timeline join — one per-cycle report from the three
+observability artifacts, keyed by the shared cycle id.
+
+The dashboard answers live (/api/trace, /api/decisions); this joins the
+SAME three records from their dump files, so a post-mortem needs only
+the artifacts beside a checkpoint:
+
+  * a Chrome-trace export (``GET /api/trace`` or
+    ``tracer.chrome_trace()`` saved to a file) — host drain spans, farm
+    grant-waits, sidecar/mesh solves on their synthetic tracks;
+  * a cycle-ledger dump (``obs.cycle_ledger.dump_jsonl``) — per-drain
+    arm/frame/wall/grant-wait/device-transfer rows;
+  * a decision-journal dump (``obs.recorder.dump_jsonl``) — the
+    per-workload reason chain.
+
+All three tag their records with the host cycle id, so one join key
+reconstructs "what happened in cycle N" across processes and tenants.
+
+Usage:
+    python tools/trace.py --trace trace.json --ledger ledger.jsonl \
+        --journal decisions.jsonl
+    python tools/trace.py --trace trace.json --cycles 5    # newest 5
+    python tools/trace.py --ledger ledger.jsonl --cycle 42 # one cycle
+
+Exit status: 0 on a report, 1 when no input yields any cycles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+# allow running straight from a checkout: tools/ sits next to the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kueue_oss_tpu.obs import load_jsonl  # noqa: E402
+from kueue_oss_tpu.obs.ledger import load_ledger_jsonl  # noqa: E402
+
+
+def load_trace(path: str) -> tuple[list[dict], dict[int, str]]:
+    """Chrome-trace file -> (X events, tid -> track label). Accepts
+    both the bare ``{"traceEvents": [...]}`` export and a raw list."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    spans, labels = [], {}
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            args = ev.get("args") or {}
+            labels[int(ev.get("tid", 0))] = str(args.get("name", ""))
+        elif ev.get("ph") == "X":
+            spans.append(ev)
+    return spans, labels
+
+
+def span_cycle(ev: dict):
+    args = ev.get("args") or {}
+    c = args.get("cycle")
+    return int(c) if isinstance(c, (int, float)) else None
+
+
+def _fmt_span(ev: dict, labels: dict[int, str]) -> str:
+    tid = int(ev.get("tid", 0))
+    track = labels.get(tid) or f"host:{tid}"
+    dur_ms = float(ev.get("dur", 0)) / 1000.0
+    args = ev.get("args") or {}
+    extra = ""
+    if args.get("tenant"):
+        extra = f"  tenant={args['tenant']}"
+    return (f"    span {ev.get('name', '?'):<18} {dur_ms:9.3f} ms"
+            f"  [{track}]{extra}")
+
+
+def _fmt_ledger_row(row) -> str:
+    if row.kind == "host":
+        line = (f"    ledger host    {row.duration_s * 1e3:9.3f} ms"
+                f"  admitted={row.admitted} preempted={row.preempted}"
+                f" skipped={row.skipped}")
+    else:
+        line = (f"    ledger {row.kind:<7} {row.duration_s * 1e3:9.3f} ms"
+                f"  arm={row.solver_arm or '?'}"
+                f" frame={row.frame_kind or '-'}"
+                f" admitted={row.admitted}")
+        if row.grant_wait_ms:
+            line += f" grantWait={row.grant_wait_ms:.3f}ms"
+        dev = row.device or {}
+        moved = sum(int(dev.get(k, 0)) for k in
+                    ("donated_update_bytes", "full_upload_bytes"))
+        if moved:
+            line += f" h2d={moved}B"
+        if dev.get("compiles"):
+            line += f" compiles={dev['compiles']}"
+        if dev.get("hbm_resident_bytes"):
+            line += f" hbm={dev['hbm_resident_bytes']}B"
+    if row.breaker != "closed":
+        line += f"  (breaker {row.breaker})"
+    return line
+
+
+def _fmt_decision(d) -> str:
+    return (f"    decide {d.kind:<16} {d.workload}"
+            f"  [{d.path}] {d.reason_slug or d.reason or ''}".rstrip())
+
+
+def report(spans, labels, rows, events, cycles, out) -> int:
+    by_cycle: dict[int, dict] = defaultdict(
+        lambda: {"spans": [], "rows": [], "events": []})
+    for ev in spans:
+        c = span_cycle(ev)
+        if c is not None:
+            by_cycle[c]["spans"].append(ev)
+    for row in rows:
+        by_cycle[row.cycle]["rows"].append(row)
+    for d in events:
+        by_cycle[d.cycle]["events"].append(d)
+    if not by_cycle:
+        print("no cycles found in any input", file=out)
+        return 1
+    keys = sorted(by_cycle)
+    if cycles:
+        keys = keys[-cycles:]
+    print(f"{len(keys)} cycle(s) "
+          f"({len(spans)} spans, {len(rows)} ledger rows, "
+          f"{len(events)} decisions joined on the cycle id)", file=out)
+    for c in keys:
+        bucket = by_cycle[c]
+        print(f"\ncycle {c}:", file=out)
+        for row in sorted(bucket["rows"], key=lambda r: r.seq):
+            print(_fmt_ledger_row(row), file=out)
+        for ev in sorted(bucket["spans"], key=lambda e: e.get("ts", 0)):
+            print(_fmt_span(ev, labels), file=out)
+        for d in sorted(bucket["events"], key=lambda e: e.seq)[:12]:
+            print(_fmt_decision(d), file=out)
+        if len(bucket["events"]) > 12:
+            print(f"    ... {len(bucket['events']) - 12} more "
+                  f"decision(s)", file=out)
+    return 0
+
+
+def main(argv=None, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    p = argparse.ArgumentParser(
+        description="Join a Chrome-trace export, a cycle-ledger dump, "
+                    "and a decision-journal dump into one per-cycle "
+                    "fabric timeline report.")
+    p.add_argument("--trace", help="Chrome-trace JSON (GET /api/trace "
+                                   "or tracer.chrome_trace())")
+    p.add_argument("--ledger", help="cycle-ledger dump (JSONL, written "
+                                    "by cycle_ledger.dump_jsonl)")
+    p.add_argument("--journal", help="decision-journal dump (JSONL, "
+                                     "written by recorder.dump_jsonl)")
+    p.add_argument("--cycles", type=int, default=0,
+                   help="report only the newest N cycles")
+    p.add_argument("--cycle", type=int, default=None,
+                   help="report exactly this cycle id")
+    args = p.parse_args(argv)
+    if not (args.trace or args.ledger or args.journal):
+        p.error("at least one of --trace/--ledger/--journal is required")
+    spans, labels = load_trace(args.trace) if args.trace else ([], {})
+    rows = load_ledger_jsonl(args.ledger) if args.ledger else []
+    events = load_jsonl(args.journal) if args.journal else []
+    if args.cycle is not None:
+        spans = [e for e in spans if span_cycle(e) == args.cycle]
+        rows = [r for r in rows if r.cycle == args.cycle]
+        events = [d for d in events if d.cycle == args.cycle]
+    return report(spans, labels, rows, events, args.cycles, out)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
