@@ -1514,6 +1514,163 @@ def run_scenario(scenario: str) -> dict:
                 batch.admitted[0]).sum()),
         }
 
+    if scenario == "fullsweep":
+        # FULL-kernel what-if sweeps (docs/SIMULATOR.md "FULL-kernel
+        # sweeps, lane budgets & resident state"): S preemption-aware
+        # scenario solves over a production-shaped Philly trace with
+        # admitted incumbents, dispatched in lane-budgeted pow2 chunks
+        # of jit(vmap(solve_backlog_full)) vs the sequential FULL
+        # oracle. Protocol: every program compiles OUTSIDE the timing
+        # windows, walls are best-of-3, and the chunked plans must be
+        # bit-identical to the oracle. Also measured: the resident
+        # device-state win (ResidentSweep reuse vs a fresh upload per
+        # sweep) and the relax-tier mega-sweep throughput.
+        import time as _time
+
+        import numpy as np
+
+        from kueue_oss_tpu.api.types import (
+            Admission,
+            PodSetAssignment,
+            WorkloadConditionType,
+        )
+        from kueue_oss_tpu.sim import batch as simbatch
+        from kueue_oss_tpu.sim import traces as simtraces
+        from kueue_oss_tpu.sim.batch import pow2
+        from kueue_oss_tpu.sim.engine import pending_backlog
+        from kueue_oss_tpu.sim.resident import ResidentSweep
+        from kueue_oss_tpu.sim.scenario import (
+            arrival_sweep,
+            cross,
+            quota_sweep,
+        )
+        from kueue_oss_tpu.solver.full_kernels import to_device_full
+        from kueue_oss_tpu.solver.tensors import (
+            ExportCache,
+            export_problem,
+            pad_workloads,
+        )
+
+        # the planning sweet spot, like whatif: MANY scenarios over a
+        # small contended trace — the scenario axis is what batching
+        # amortizes (per-scenario dispatch overhead dominates the
+        # sequential oracle); W scales up via env on real hardware
+        n_jobs = int(os.environ.get("BENCH_FULLSWEEP_JOBS", "7"))
+        n_scen = int(os.environ.get("BENCH_FULLSWEEP_S", "64"))
+        chunk = int(os.environ.get("BENCH_FULLSWEEP_CHUNK", "0"))
+        n_relax = int(os.environ.get("BENCH_FULLSWEEP_RELAX", "256"))
+
+        jobs = simtraces.philly_trace(n_jobs, seed=11)
+        store = simtraces.store_from_trace(jobs, capacity_frac=0.25)
+        # admit the earliest ~40% so quota cuts have preemption targets
+        for j in sorted(jobs, key=lambda j: j.submit_s)[
+                : int(n_jobs * 0.4)]:
+            wl = store.workloads[f"default/{j.job_id}"]
+            wl.status.admission = Admission(
+                cluster_queue=j.vc,
+                podset_assignments=[PodSetAssignment(
+                    name="main", flavors={"gpu": "gpu"},
+                    resource_usage=dict(wl.podsets[0].total_requests()),
+                    count=1)])
+            wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                             reason="QuotaReserved", now=j.submit_s)
+            store.update_workload(wl)
+        problem = export_problem(store, pending_backlog(store),
+                                 cache=ExportCache(store,
+                                                   subscribe=False),
+                                 include_admitted=True)
+        W = problem.n_workloads
+        problem = pad_workloads(problem, pow2(W))
+        caps = simbatch.full_caps(problem)
+        grid = cross(quota_sweep((0.25, 0.4, 0.5, 0.75, 1.5, 2.0)),
+                     arrival_sweep((0.5, 0.75, 1.25, 1.5, 2.0, 2.5,
+                                    3.0)))
+        specs = (grid * (n_scen // len(grid) + 1))[:n_scen]
+        overlays = [s.overlay(problem) for s in specs]
+        order = simbatch.sweep_order(specs)
+        tensors = to_device_full(problem)
+        log(f"[fullsweep] {n_scen} scenarios x {W} workloads "
+            f"(padded {problem.n_workloads}) caps={caps} chunk={chunk}")
+
+        def best3(fn):
+            walls = []
+            for _ in range(3):
+                t0 = _time.perf_counter()
+                fn()
+                walls.append(_time.perf_counter() - t0)
+            return min(walls)
+
+        # chunked FULL vs the sequential FULL oracle
+        simbatch.solve_scenarios_full(problem, overlays, *caps,
+                                      tensors=tensors, chunk=chunk,
+                                      order=order)
+        simbatch.solve_scenarios_sequential_full(
+            problem, overlays[:1], *caps, tensors=tensors)
+        t_chunked = best3(lambda: simbatch.solve_scenarios_full(
+            problem, overlays, *caps, tensors=tensors, chunk=chunk,
+            order=order))
+        t_seq = best3(
+            lambda: simbatch.solve_scenarios_sequential_full(
+                problem, overlays, *caps, tensors=tensors))
+        full = simbatch.solve_scenarios_full(
+            problem, overlays, *caps, tensors=tensors, chunk=chunk,
+            order=order)
+        seq = simbatch.solve_scenarios_sequential_full(
+            problem, overlays, *caps, tensors=tensors)
+        pr = simbatch.check_parity_full(full, seq, range(n_scen))
+        preempt = int((np.asarray(seq.victim_reason)[:, :W] > 0).sum())
+
+        # resident device state vs a fresh upload per sweep
+        rs = ResidentSweep(store)
+        rp, rdev = rs.refresh()
+        rovl = [s.overlay(rp) for s in specs]
+        simbatch.solve_scenarios_full(rp, rovl, *caps, tensors=rdev,
+                                      chunk=chunk)
+
+        def resident_sweep():
+            p, dev = rs.refresh()
+            simbatch.solve_scenarios_full(p, rovl, *caps, tensors=dev,
+                                          chunk=chunk)
+
+        def reupload_sweep():
+            dev = to_device_full(rp)
+            simbatch.solve_scenarios_full(rp, rovl, *caps, tensors=dev,
+                                          chunk=chunk)
+
+        resident_sweep(), reupload_sweep()  # warm both arms
+        t_res = best3(resident_sweep)
+        t_re = best3(reupload_sweep)
+
+        # relax approximate tier: mega-sweep throughput
+        mega = (grid * (n_relax // len(grid) + 1))[:n_relax]
+        movl = [s.overlay(problem) for s in mega]
+        simbatch.solve_scenarios_relax(problem, movl[:8])
+        t_rx = best3(
+            lambda: simbatch.solve_scenarios_relax(problem, movl))
+
+        return {
+            "scenario": scenario,
+            "scenarios": n_scen,
+            "workloads": W,
+            "padded_workloads": problem.n_workloads,
+            "chunk_width": chunk,
+            "chunks": len(full.chunks),
+            "chunked_wall_s": round(t_chunked, 6),
+            "sequential_wall_s": round(t_seq, 6),
+            "full_speedup": round(t_seq / t_chunked, 2)
+            if t_chunked else 0.0,
+            "plans_identical": pr.identical,
+            "preemptions_total": preempt,
+            "resident_sweep_s": round(t_res, 6),
+            "reupload_sweep_s": round(t_re, 6),
+            "resident_win": round(t_re / t_res, 2) if t_res else 0.0,
+            "resident_reuses": rs.reuses,
+            "resident_full_uploads": rs.full_uploads,
+            "relax_scenarios": n_relax,
+            "relax_scenarios_per_sec": round(n_relax / t_rx, 1)
+            if t_rx else 0.0,
+        }
+
     if scenario == "federation":
         # federated control planes (docs/FEDERATION.md). Phase 1: four
         # tenants x two control-plane instances each share ONE solver
@@ -3020,6 +3177,16 @@ def main() -> None:
     except Exception as e:
         log(f"[whatif] did not complete: {e}")
         whatif = None
+    # FULL-kernel what-if sweeps: lane-budgeted chunked batching vs
+    # the sequential FULL oracle over a Philly-shaped trace, plus the
+    # resident-state and relax-tier measurements (docs/SIMULATOR.md;
+    # host backend for the same reason as whatif)
+    try:
+        fullsweep = measure("fullsweep", extra_env={"BENCH_CPU": "1"},
+                            timeout=1200)
+    except Exception as e:
+        log(f"[fullsweep] did not complete: {e}")
+        fullsweep = None
     # federated control planes: multi-tenant solver-farm DRR fairness
     # under contended churn + the what-if-scored dispatcher vs
     # Incremental (docs/FEDERATION.md; host backend — the measurement
@@ -3265,6 +3432,19 @@ def main() -> None:
         extra["whatif_vmapped_speedup"] = whatif["vmapped_speedup"]
         extra["whatif_plans_identical"] = whatif["plans_identical"]
         extra["whatif_workloads"] = whatif["workloads"]
+    if fullsweep is not None:
+        # FULL-sweep acceptance (docs/SIMULATOR.md): >= 3x chunked-vs-
+        # sequential FULL wall, plans bit-identical at the tested lane
+        # budget, and a measured resident-state win per sweep
+        extra["fullsweep_scenarios"] = fullsweep["scenarios"]
+        extra["fullsweep_full_speedup"] = fullsweep["full_speedup"]
+        extra["fullsweep_plans_identical"] = fullsweep[
+            "plans_identical"]
+        extra["fullsweep_resident_win"] = fullsweep["resident_win"]
+        extra["fullsweep_relax_scenarios_per_sec"] = fullsweep[
+            "relax_scenarios_per_sec"]
+        extra["fullsweep_preemptions_total"] = fullsweep[
+            "preemptions_total"]
     if federation is not None:
         # federation acceptance (docs/FEDERATION.md): per-tenant solver
         # wall-time shares within 1.5x of the DRR weights, zero

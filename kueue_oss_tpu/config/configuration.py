@@ -334,6 +334,18 @@ class SimulatorConfig:
     round_bucketing: bool = True
     #: sweeps below this width dispatch as one batch regardless
     min_batch_for_bucketing: int = 8
+    #: route sweeps through the FULL preemption kernel by default
+    #: (lane-budgeted chunks; per-run override via run(full=...)) —
+    #: preemption-aware planning at a higher device cost
+    full_kernel: bool = False
+    #: device-byte budget the LaneBudget planner sizes FULL-sweep
+    #: scenario chunks from (S x h_max x K x W lane accounting)
+    lane_budget_mb: int = 256
+    #: scenarios per sweep solved exactly on the FULL kernel; overflow
+    #: rows re-tier to the relax LP (reported per row, never silent)
+    full_sweep_max: int = 256
+    #: fixed LP iterations for the relax approximate tier
+    relax_iters: int = 32
 
 
 @dataclass
@@ -593,6 +605,12 @@ def validate(cfg: Configuration) -> list[str]:
         if m not in known and not m.isdigit():
             errs.append(f"simulator.mesh {sim.mesh!r} must be 'auto', "
                         "'off', or a non-negative device count")
+    if sim.lane_budget_mb < 1:
+        errs.append("simulator.laneBudgetMB must be >= 1")
+    if sim.full_sweep_max < 1:
+        errs.append("simulator.fullSweepMax must be >= 1")
+    if sim.relax_iters < 1:
+        errs.append("simulator.relaxIters must be >= 1")
     st = cfg.streaming
     if st.max_batch < 1:
         errs.append("streaming.maxBatch must be >= 1")
@@ -876,6 +894,10 @@ def load(data: Optional[dict] = None) -> Configuration:
             "minBatchForMesh": ("min_batch_for_mesh", int),
             "roundBucketing": ("round_bucketing", bool),
             "minBatchForBucketing": ("min_batch_for_bucketing", int),
+            "fullKernel": ("full_kernel", bool),
+            "laneBudgetMB": ("lane_budget_mb", int),
+            "fullSweepMax": ("full_sweep_max", int),
+            "relaxIters": ("relax_iters", int),
         })
 
     def conv_integrations(d: dict) -> list[str]:

@@ -762,6 +762,20 @@ whatif_parity_failures_total = registry.register(Counter(
     "kueue_tpu_whatif_parity_failures_total",
     "What-if batches whose vmapped plans diverged from the sequential "
     "oracle (must stay 0; a nonzero count is a kernel bug)", ()))
+whatif_retier_total = registry.register(Counter(
+    "kueue_tpu_whatif_retier_total",
+    "What-if scenarios re-tiered from the FULL kernel to the relax-LP "
+    "approximate tier by the lane-budget planner, by reason — every "
+    "re-tier is reported per scenario row; none may happen silently",
+    ("reason",)))
+whatif_full_chunks_total = registry.register(Counter(
+    "kueue_tpu_whatif_full_chunks_total",
+    "Lane-budgeted FULL-kernel sweep chunk dispatches", ()))
+whatif_resident_syncs_total = registry.register(Counter(
+    "kueue_tpu_whatif_resident_syncs_total",
+    "ResidentSweep device-state refreshes by kind (full upload on "
+    "spec-gen change / row scatter on workload churn / reuse when "
+    "nothing moved)", ("kind",)))
 
 # -- cluster health layer (obs/health.py + obs/ledger.py,
 # docs/OBSERVABILITY.md "Cluster health & SLOs") -----------------------------

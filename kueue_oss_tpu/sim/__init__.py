@@ -7,15 +7,30 @@ Public surface:
 - :class:`WhatIfEngine` / :func:`simulate_trace` (execution);
 - :func:`solve_scenarios` / :func:`solve_scenarios_sequential`
   (batched solve layer, for direct tensor-level use);
+- FULL-kernel sweeps: :class:`LaneBudget` /
+  :func:`solve_scenarios_tiered` / the sequential FULL oracle
+  (lane-budgeted preemption-aware batching);
+- :class:`ResidentSweep` (scenario-resident device state);
+- traces: Philly/Helios-shaped generators, CSV/JSONL import, and the
+  :func:`load_ladder` breaking-point driver;
 - :class:`WhatIfReport` (report layer);
 - journal replay (:mod:`kueue_oss_tpu.sim.replay`).
 """
 
 from kueue_oss_tpu.sim.batch import (  # noqa: F401
     BatchSolveResult,
+    FullSweepResult,
+    LaneBudget,
     check_parity,
+    check_parity_full,
+    full_caps,
     solve_scenarios,
+    solve_scenarios_full,
+    solve_scenarios_relax,
     solve_scenarios_sequential,
+    solve_scenarios_sequential_full,
+    solve_scenarios_tiered,
+    sweep_order,
 )
 from kueue_oss_tpu.sim.dispatch import (  # noqa: F401
     DispatchReport,
@@ -33,11 +48,26 @@ from kueue_oss_tpu.sim.replay import (  # noqa: F401
     load_events,
     replay,
 )
-from kueue_oss_tpu.sim.report import WhatIfReport, scenario_kpis  # noqa: F401
+from kueue_oss_tpu.sim.report import (  # noqa: F401
+    WhatIfReport,
+    borrow_stats,
+    scenario_kpis,
+)
+from kueue_oss_tpu.sim.resident import ResidentSweep  # noqa: F401
 from kueue_oss_tpu.sim.scenario import (  # noqa: F401
     FlapEvent,
     ScenarioSpec,
     arrival_sweep,
     cross,
     quota_sweep,
+)
+from kueue_oss_tpu.sim.traces import (  # noqa: F401
+    TraceJob,
+    helios_trace,
+    load_ladder,
+    load_trace,
+    philly_trace,
+    save_trace,
+    store_from_trace,
+    synthetic_trace,
 )

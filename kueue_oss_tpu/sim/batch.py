@@ -21,6 +21,7 @@ scenarios in the same SPMD dispatch).
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -34,6 +35,8 @@ from kueue_oss_tpu.solver.kernels import (
     solve_backlog_batched,
 )
 from kueue_oss_tpu.solver.tensors import BIG, SolverProblem, pow2
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -291,4 +294,468 @@ def check_parity(batch: BatchSolveResult, seq: BatchSolveResult,
                 res.identical = False
                 res.mismatches.append({"scenario": int(i),
                                        "field": name})
+    return res
+
+
+# ---------------------------------------------------------------------------
+# FULL-kernel sweeps: lane-budgeted chunking + relax approximate tier
+# ---------------------------------------------------------------------------
+
+#: per-row tier markers in tiered sweep results
+FULL_TIER = "full"
+RELAX_TIER = "relax"
+
+#: lean overlay field -> FullTensors field. Identity unless listed;
+#: ``wl_rank`` has no FULL twin (the full kernel selects heads by
+#: (priority, ts, uid) and masked rows leave the per-CQ segment
+#: reductions through ``wl_cqid = C`` + ``wl_valid = False``, which
+#: every arrival overlay sets alongside the rank).
+_FULL_RENAME = {"wl_ts": "wl_ts0"}
+_FULL_DROP = frozenset({"wl_rank"})
+
+
+def to_full_fields(fields: dict) -> dict:
+    """Translate a lean overlay dict (SolverProblem field names) to the
+    FULL kernel's FullTensors field names."""
+    return {_FULL_RENAME.get(k, k): v for k, v in fields.items()
+            if k not in _FULL_DROP}
+
+
+def full_caps(problem: SolverProblem, h_cap: int = 64,
+              h_work_budget: int = 512) -> tuple[int, int, int]:
+    """Static caps (g_max, h_max, p_max) for a FULL-kernel sweep.
+
+    A lighter sizing than the drain engine's ``_size_caps``: the engine
+    optimizes round-convergence latency of ONE live drain (h lanes up
+    to a 64-lane floor), while a sweep multiplies every lane by S, so
+    lanes here default to the CQ count under a smaller work budget.
+    Chunked/sequential parity holds for ANY caps because both paths
+    share them; callers needing engine-exact plans pass the engine's
+    caps explicitly."""
+    C = problem.n_cqs
+    K = problem.wl_req.shape[1] if problem.wl_req.ndim == 3 else 1
+    g_max = max(1, int(problem.cq_ngroups.max()) if C else 1)
+    lane_cap = max(16, pow2(
+        max(1, h_work_budget // max(K * g_max, 1)) + 1) // 2)
+    h_max = max(1, pow2(min(max(C, 1), h_cap, lane_cap)))
+    if C:
+        wl_root = np.asarray(problem.cq_root)[
+            np.minimum(np.asarray(problem.wl_cqid)[:-1], C - 1)]
+        counts = np.bincount(wl_root, minlength=problem.n_nodes + 1)
+        pop = int(counts.max()) if counts.size else 1
+    else:
+        pop = 1
+    return g_max, h_max, pow2(max(8, pop))
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (int(n).bit_length() - 1) if n >= 1 else 0
+
+
+@dataclass
+class SweepPlan:
+    """A lane-budget dispatch plan over S scenarios (see LaneBudget)."""
+
+    #: contiguous (start, width) FULL-tier chunks, in scenario order
+    chunks: list = field(default_factory=list)
+    #: scenarios solved exactly (the prefix [0, full_count))
+    full_count: int = 0
+    #: scenario indices re-tiered to the relax LP, with the reason —
+    #: NEVER silent: plan() logs and counts every entry
+    relax_idx: list = field(default_factory=list)
+    retier_reason: Optional[str] = None
+    #: pow2 chunk width the budget allows (0: one scenario > budget)
+    chunk_width: int = 0
+    #: the planner's per-scenario device-byte estimate
+    per_scenario_bytes: int = 0
+
+
+@dataclass
+class LaneBudget:
+    """Sizes FULL-sweep chunks from a device-byte budget.
+
+    The FULL kernel's round body fans out h_max x K victim searches,
+    each carrying its own [N+1, F] usage walk and [p_max] candidate
+    columns; vmapping S scenarios multiplies ALL of that by S. The
+    planner estimates the per-scenario transient footprint
+    (``lane_bytes``), floors the scenario chunk to a power of two that
+    fits ``budget_bytes`` (pow2 so repeated sweeps reuse one compiled
+    program), and dispatches ceil(S / chunk) chunks — the uneven tail
+    pads to its own pow2 width with inert repeats.
+
+    Two re-tier conditions route scenarios to the relax LP instead
+    (reported per row, counted in ``whatif_retier_total{reason}``):
+    a single scenario exceeding the budget (chunk width 0), or a
+    mega-sweep beyond ``max_full_scenarios`` (overflow rows only).
+    """
+
+    budget_bytes: int = 256 << 20
+    #: hard cap on exactly-solved scenarios per sweep; overflow rows
+    #: are relax-tier (mega-sweep triage, not a silent truncation)
+    max_full_scenarios: int = 256
+
+    def lane_bytes(self, problem: SolverProblem, g_max: int,
+                   h_max: int, p_max: int) -> int:
+        """Per-scenario device bytes of the dominant sweep state: the
+        S x h_max x K x W accounting from ROADMAP item 5."""
+        W1 = problem.wl_cqid.shape[0]
+        N1 = problem.parent.shape[0]
+        F = problem.wl_req.shape[-1]
+        K = problem.wl_req.shape[1] if problem.wl_req.ndim == 3 else 1
+        D = problem.path.shape[1]
+        lanes = h_max * K
+        # each victim-search lane: ~3 usage walks [N+1, F] i32 plus
+        # [p_max] candidate columns (usage [F], path [D] x2, ancestor
+        # [D, D] bool, ~16 scalar i32 columns)
+        per_lane = (3 * N1 * F * 4
+                    + p_max * (F * 4 + 2 * D * 4 + D * D + 16 * 4))
+        # plan/state rows: per-workload plan + usage tables + the
+        # [N+1, p_max] candidate table the searches gather from
+        state = (W1 * (F * 4 + 8 * g_max + 28)
+                 + 2 * N1 * F * 4 + N1 * p_max * 4)
+        return lanes * per_lane + state
+
+    def chunk_width_for(self, problem: SolverProblem, g_max: int,
+                        h_max: int, p_max: int) -> int:
+        per = self.lane_bytes(problem, g_max, h_max, p_max)
+        return _pow2_floor(self.budget_bytes // per)
+
+    def plan(self, n_scenarios: int, problem: SolverProblem,
+             g_max: int, h_max: int, p_max: int) -> SweepPlan:
+        """Plan chunks + tiers for ``n_scenarios``; audits every
+        re-tier (log + ``whatif_retier_total{reason}``)."""
+        from kueue_oss_tpu import metrics
+
+        per = self.lane_bytes(problem, g_max, h_max, p_max)
+        width = _pow2_floor(self.budget_bytes // per)
+        plan = SweepPlan(chunk_width=width, per_scenario_bytes=per)
+        if width == 0:
+            plan.relax_idx = list(range(n_scenarios))
+            plan.retier_reason = "scenario_exceeds_lane_budget"
+        else:
+            plan.full_count = min(n_scenarios, self.max_full_scenarios)
+            plan.relax_idx = list(range(plan.full_count, n_scenarios))
+            if plan.relax_idx:
+                plan.retier_reason = "sweep_above_full_cap"
+            start = 0
+            while start < plan.full_count:
+                w = min(width, plan.full_count - start)
+                plan.chunks.append((start, w))
+                start += w
+        if plan.relax_idx:
+            metrics.whatif_retier_total.inc(plan.retier_reason,
+                                            by=len(plan.relax_idx))
+            log.warning(
+                "lane budget re-tiered %d/%d scenarios to the relax "
+                "LP (%s): indices %s (budget %d B, per-scenario %d B, "
+                "chunk %d)", len(plan.relax_idx), n_scenarios,
+                plan.retier_reason, plan.relax_idx[:16],
+                self.budget_bytes, per, width)
+        return plan
+
+
+@dataclass
+class FullSweepResult:
+    """Stacked FULL-kernel plans for S scenarios (numpy, leading
+    scenario axis). Superset of BatchSolveResult: the preemption
+    kernel also reports per-workload usage and victim reasons."""
+
+    admitted: np.ndarray       # [S, W+1] bool
+    opt: np.ndarray            # [S, W+1, g] int32
+    admit_round: np.ndarray    # [S, W+1] int32
+    parked: np.ndarray         # [S, W+1] bool
+    rounds: np.ndarray         # [S] int32
+    usage: np.ndarray          # [S, N+1, F] int32
+    wl_usage: np.ndarray       # [S, W+1, F] int32
+    victim_reason: np.ndarray  # [S, W+1] int8
+    #: per-scenario solve tier ("full" exact / "relax" approximate)
+    tier: list = field(default_factory=list)
+    #: scenario indices the budget re-tiered, and why (audit trail)
+    retier_idx: list = field(default_factory=list)
+    retier_reason: Optional[str] = None
+    #: FULL-tier chunk widths dispatched, in order
+    chunks: list = field(default_factory=list)
+    batch_width: int = 0
+    solve_seconds: float = 0.0
+
+    def plan(self, i: int) -> tuple:
+        """The lean six-tuple plan contract for scenario ``i`` (opt
+        collapsed to the first group's choice for KPI consumers)."""
+        opt = self.opt[i]
+        return (self.admitted[i], opt[..., 0] if opt.ndim == 2 else opt,
+                self.admit_round[i], self.parked[i], self.rounds[i],
+                self.usage[i])
+
+    def preemptions(self, i: int, n_workloads: int) -> int:
+        return int((self.victim_reason[i][:n_workloads] > 0).sum())
+
+
+def _full_tensors(problem: SolverProblem):
+    from kueue_oss_tpu.solver.full_kernels import to_device_full
+
+    return to_device_full(problem)
+
+
+def sweep_order(specs) -> list[int]:
+    """Skew-aware dispatch order for a chunked FULL sweep.
+
+    A chunk's vmap lanes all run to the chunk's MAX drain-round count
+    (finished lanes freeze on selects), so one contended scenario in a
+    chunk bills its round count to every lane sharing the dispatch.
+    Grouping scenarios with similar expected contention — identical
+    quota cuts first, then backlog fraction — keeps each chunk's max
+    near its mean. Returns a permutation of ``range(len(specs))`` for
+    ``solve_scenarios_full(..., order=)``; the stitch inverts it, so
+    results stay in caller order (and bitwise identical — lane
+    membership never changes lane arithmetic)."""
+    def key(s):
+        qs = tuple(sorted((str(k), float(v))
+                          for k, v in (s.quota_scale or {}).items()))
+        return (min((f for _, f in qs), default=1.0), qs,
+                -float(getattr(s, "arrival_scale", 1.0) or 1.0))
+
+    return sorted(range(len(specs)), key=lambda i: key(specs[i]))
+
+
+def solve_scenarios_full(problem: SolverProblem, overlays: list[dict],
+                         g_max: int, h_max: int, p_max: int,
+                         tensors=None, chunk: int = 0,
+                         pad_pow2: bool = True,
+                         order: Optional[list] = None,
+                         ) -> FullSweepResult:
+    """Solve every scenario overlay through the FULL preemption kernel
+    in lane-budgeted chunks of ``jit(vmap(solve_backlog_full))``.
+
+    ``overlays`` use LEAN field names (the scenario layer's contract);
+    translation to FullTensors names happens after stacking. ``chunk``
+    is the LaneBudget chunk width (0 = everything in one dispatch);
+    chunks are contiguous ranges of the dispatch sequence so the
+    stitch is a concatenate — bitwise-identical to the sequential FULL
+    oracle at any chunk width because vmap lanes never interact.
+    ``order`` (a permutation of the scenario indices, e.g.
+    ``sweep_order(specs)``) picks the dispatch sequence — chunkmates
+    with similar round counts waste less frozen-lane work — and the
+    stitch inverts it, so results are ALWAYS in ``overlays`` order."""
+    from kueue_oss_tpu import metrics
+    from kueue_oss_tpu.solver.full_kernels import (
+        solve_backlog_full_batched,
+    )
+
+    if not overlays:
+        raise ValueError("need at least one scenario overlay")
+    S = len(overlays)
+    if order is not None:
+        order = [int(i) for i in order]
+        if sorted(order) != list(range(S)):
+            raise ValueError(
+                "order must be a permutation of the scenario indices")
+        dispatch = [overlays[i] for i in order]
+    else:
+        dispatch = overlays
+    if tensors is None:
+        tensors = _full_tensors(problem)
+    width = chunk if chunk else S
+    parts = []
+    chunk_widths = []
+    total_width = 0
+    t0 = time.monotonic()
+    for start in range(0, S, width):
+        ovs = dispatch[start:start + width]
+        stacked = stack_overlays(problem, ovs)
+        if not stacked:
+            stacked = {"usage0": np.repeat(problem.usage0[None],
+                                           len(ovs), axis=0)}
+        stacked = to_full_fields(stacked)
+        target_s = pow2(len(ovs)) if pad_pow2 else len(ovs)
+        stacked = pad_scenario_axis(stacked, target_s)
+        out = solve_backlog_full_batched(
+            tensors, stacked, g_max, h_max=h_max, p_max=p_max)
+        parts.append(tuple(np.asarray(a)[:len(ovs)] for a in out))
+        chunk_widths.append(target_s)
+        total_width += target_s
+        metrics.whatif_full_chunks_total.inc()
+    wall = time.monotonic() - t0
+    cat = (np.concatenate if len(parts) > 1
+           else (lambda xs, axis=0: xs[0]))
+    fields = [cat([p[j] for p in parts]) for j in range(8)]
+    if order is not None:  # stitch back to caller (overlays) order
+        inv = np.argsort(np.asarray(order, dtype=np.int64))
+        fields = [f[inv] for f in fields]
+    return FullSweepResult(
+        *fields, tier=[FULL_TIER] * S, chunks=chunk_widths,
+        batch_width=total_width, solve_seconds=wall)
+
+
+def solve_scenarios_sequential_full(
+        problem: SolverProblem, overlays: list[dict],
+        g_max: int, h_max: int, p_max: int,
+        tensors=None) -> FullSweepResult:
+    """The FULL-kernel oracle: each scenario solved alone through
+    ``solve_backlog_full``. Parity target for the chunked sweep."""
+    import jax.numpy as jnp
+
+    from kueue_oss_tpu.solver.full_kernels import solve_backlog_full
+
+    if not overlays:
+        raise ValueError("need at least one scenario overlay")
+    if tensors is None:
+        tensors = _full_tensors(problem)
+    outs = []
+    t0 = time.monotonic()
+    for ov in overlays:
+        t = tensors._replace(
+            **{k: jnp.asarray(v)
+               for k, v in to_full_fields(ov).items()})
+        outs.append(tuple(np.asarray(a) for a in solve_backlog_full(
+            t, g_max, h_max=h_max, p_max=p_max)))
+    wall = time.monotonic() - t0
+    return FullSweepResult(
+        *[np.stack([o[j] for o in outs]) for j in range(8)],
+        tier=[FULL_TIER] * len(overlays), batch_width=1,
+        solve_seconds=wall)
+
+
+#: result-field names of the FULL plan, in kernel output order
+_FULL_FIELDS = ("admitted", "opt", "admit_round", "parked", "rounds",
+                "usage", "wl_usage", "victim_reason")
+
+
+def check_parity_full(batch: FullSweepResult, seq: FullSweepResult,
+                      indices) -> ParityResult:
+    """Bitwise plan comparison for FULL sweeps — all eight output
+    tensors, including per-workload usage and victim reasons."""
+    res = ParityResult()
+    for pos, i in enumerate(indices):
+        res.checked += 1
+        for name in _FULL_FIELDS:
+            a = getattr(batch, name)[i]
+            b = getattr(seq, name)[pos]
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                res.identical = False
+                res.mismatches.append({"scenario": int(i),
+                                       "field": name})
+    return res
+
+
+def solve_scenarios_relax(problem: SolverProblem,
+                          overlays: list[dict],
+                          iters: int = 32) -> FullSweepResult:
+    """The approximate tier: vmapped relax-LP over all scenarios in
+    one dispatch, then per-scenario round + exact repair on the small
+    support. Fit-only by construction — ``wl_usage`` is zeros and no
+    victims are modeled, which is why re-tiering here is always
+    reported, never silent."""
+    import dataclasses
+    import functools
+
+    import jax
+
+    from kueue_oss_tpu.solver.relax import (
+        RelaxLP,
+        build_lp,
+        lp_loop,
+        repair,
+        rounded_support,
+    )
+
+    if not overlays:
+        raise ValueError("need at least one scenario overlay")
+    t0 = time.monotonic()
+    probs, lps = [], []
+    for ov in overlays:
+        p = (dataclasses.replace(
+            problem, **{k: np.asarray(v) for k, v in ov.items()})
+            if ov else problem)
+        probs.append(p)
+        lps.append(build_lp(p))
+    stacked = RelaxLP(*[np.stack([getattr(lp, f) for lp in lps])
+                        for f in RelaxLP._fields])
+    fn = jax.jit(jax.vmap(functools.partial(lp_loop, iters=iters)))
+    xs = np.asarray(fn(stacked))
+    S = len(overlays)
+    W1 = problem.wl_cqid.shape[0]
+    N1 = problem.parent.shape[0]
+    F = problem.wl_req.shape[-1]
+    out = FullSweepResult(
+        admitted=np.zeros((S, W1), dtype=bool),
+        opt=np.zeros((S, W1), dtype=np.int32),
+        admit_round=np.zeros((S, W1), dtype=np.int32),
+        parked=np.zeros((S, W1), dtype=bool),
+        rounds=np.zeros(S, dtype=np.int32),
+        usage=np.zeros((S, N1, F), dtype=np.int32),
+        wl_usage=np.zeros((S, W1, F), dtype=np.int32),
+        victim_reason=np.zeros((S, W1), dtype=np.int8),
+        tier=[RELAX_TIER] * S, batch_width=S)
+    for i, (p, lp) in enumerate(zip(probs, lps)):
+        sel = rounded_support(xs[i], p, np.asarray(lp.live))
+        (admitted, opt, admit_round, parked, rounds, usage), _ = repair(
+            p, sel, np.asarray(lp.live))
+        out.admitted[i] = np.asarray(admitted)
+        out.opt[i] = np.asarray(opt)
+        out.admit_round[i] = np.asarray(admit_round)
+        out.parked[i] = np.asarray(parked)
+        out.rounds[i] = np.asarray(rounds)
+        out.usage[i] = np.asarray(usage)
+    out.solve_seconds = time.monotonic() - t0
+    return out
+
+
+def solve_scenarios_tiered(problem: SolverProblem,
+                           overlays: list[dict],
+                           budget: Optional[LaneBudget] = None,
+                           caps: Optional[tuple] = None,
+                           tensors=None, relax_iters: int = 32,
+                           pad_pow2: bool = True,
+                           order: Optional[list] = None,
+                           ) -> FullSweepResult:
+    """The sweep entry the what-if engine uses: LaneBudget plans the
+    chunks and tiers, FULL chunks solve exactly, overflow solves on
+    the relax tier, and the stitched result carries a per-row ``tier``
+    plus the re-tier audit trail. ``order`` is the skew-aware dispatch
+    permutation over ALL scenarios (``sweep_order``); the FULL-tier
+    subset dispatches in its induced sub-order."""
+    if not overlays:
+        raise ValueError("need at least one scenario overlay")
+    budget = budget or LaneBudget()
+    g_max, h_max, p_max = caps or full_caps(problem)
+    plan = budget.plan(len(overlays), problem, g_max, h_max, p_max)
+    parts = []
+    if plan.full_count:
+        sub_order = None
+        if order is not None:
+            rank = {int(i): k for k, i in enumerate(order)}
+            sub_order = sorted(range(plan.full_count),
+                               key=lambda i: rank.get(i, i))
+        parts.append(solve_scenarios_full(
+            problem, overlays[:plan.full_count], g_max, h_max, p_max,
+            tensors=tensors, chunk=plan.chunk_width,
+            pad_pow2=pad_pow2, order=sub_order))
+    if plan.relax_idx:
+        parts.append(solve_scenarios_relax(
+            problem, [overlays[i] for i in plan.relax_idx],
+            iters=relax_iters))
+    if len(parts) == 1:
+        res = parts[0]
+    else:
+        full, relax = parts
+        # opt shapes differ across tiers ([W+1, g] vs [W+1]): widen
+        # the relax rows to the FULL layout (choice in group 0)
+        r_opt = relax.opt
+        if full.opt.ndim == 3 and r_opt.ndim == 2:
+            widened = np.zeros(
+                (r_opt.shape[0],) + full.opt.shape[1:],
+                dtype=full.opt.dtype)
+            widened[..., 0] = r_opt
+            r_opt = widened
+        res = FullSweepResult(
+            *[np.concatenate([getattr(full, n),
+                              r_opt if n == "opt"
+                              else getattr(relax, n)])
+              for n in _FULL_FIELDS],
+            tier=full.tier + relax.tier,
+            batch_width=full.batch_width + relax.batch_width,
+            solve_seconds=full.solve_seconds + relax.solve_seconds)
+        res.chunks = full.chunks
+    res.retier_idx = plan.relax_idx
+    res.retier_reason = plan.retier_reason
     return res

@@ -153,12 +153,22 @@ class WhatIfEngine:
     store. Construction is cheap; every :meth:`run` exports fresh."""
 
     def __init__(self, store: Store, queues=None, config=None,
-                 now: Optional[float] = None) -> None:
+                 now: Optional[float] = None,
+                 resident: bool = False) -> None:
         from kueue_oss_tpu.config.configuration import SimulatorConfig
 
         self.store = store
         self.queues = queues
         self.config = config if config is not None else SimulatorConfig()
+        #: optional scenario-resident device state for FULL sweeps: the
+        #: session pins the padded base tensors across run() calls on
+        #: a live store (sim/resident.py) so steady-state sweep cost is
+        #: overlays + solve, not upload + solve
+        self.resident = None
+        if resident:
+            from kueue_oss_tpu.sim.resident import ResidentSweep
+
+            self.resident = ResidentSweep(store)
         #: planning instant for age KPIs. None (default) derives it
         #: from the export itself — the newest pending creation
         #: timestamp — so starvation ages are meaningful RELATIVE queue
@@ -184,10 +194,19 @@ class WhatIfEngine:
 
     def run(self, specs: list[ScenarioSpec],
             pending: Optional[dict[str, list[WorkloadInfo]]] = None,
-            parity: Optional[int] = None) -> WhatIfReport:
+            parity: Optional[int] = None,
+            full: Optional[bool] = None) -> WhatIfReport:
         """Solve every scenario in one batched dispatch; return the
         report. Raises UnsupportedProblem for stores the lean solver
-        cannot model (TAS podset groups etc.)."""
+        cannot model (TAS podset groups etc.).
+
+        ``full`` routes the sweep through the FULL preemption kernel
+        (lane-budgeted chunks of ``jit(vmap(solve_backlog_full))``,
+        sim/batch.py) instead of the lean fit-only batch; ``None``
+        defers to ``config.full_kernel``. FULL sweeps export admitted
+        rows too (preemption candidates), report real preemption
+        counts, and may re-tier overflow scenarios to the relax LP —
+        always reported per row (``tier``), never silently."""
         if not specs:
             raise ValueError("need at least one ScenarioSpec")
         if len(specs) > self.config.max_scenarios:
@@ -209,25 +228,40 @@ class WhatIfEngine:
             now = max((i.obj.creation_time
                        for infos in pending.values() for i in infos),
                       default=0.0)
+        use_full = (self.config.full_kernel if full is None
+                    else bool(full))
         replicas = int(np.ceil(max_arrival_scale(specs)))
         pending = _materialize_replicas(pending, replicas)
-        problem = export_problem(self.store, pending,
-                                 cache=ExportCache(self.store,
-                                                   subscribe=False))
+        full_tensors = None
+        if use_full and self.resident is not None:
+            # the resident session exports, pads, and syncs the pinned
+            # device tensors in one step (reuse / row-scatter / full
+            # upload, keyed on spec_gen + shapes)
+            problem, full_tensors = self.resident.refresh(
+                pending=pending)
+            n_real = self.resident.last_real_workloads
+        else:
+            problem = export_problem(self.store, pending,
+                                     include_admitted=use_full,
+                                     cache=ExportCache(self.store,
+                                                       subscribe=False))
+            n_real = problem.n_workloads
         report = WhatIfReport()
         report.base = {
-            "workloads": problem.n_workloads,
+            "workloads": n_real,
             "cluster_queues": problem.n_cqs,
             "nodes": problem.n_nodes,
             "flavors": len(problem.fr_list),
             "arrival_replicas": replicas,
             "scenarios": len(specs),
+            "tier": "full" if use_full else "lean",
         }
-        if problem.n_workloads == 0:
+        if n_real == 0:
             report.parity = {"checked": 0, "identical": True,
                              "mismatches": []}
             return report
-        problem = pad_workloads(problem, pow2(problem.n_workloads))
+        if full_tensors is None:
+            problem = pad_workloads(problem, pow2(problem.n_workloads))
         report.base["padded_workloads"] = problem.n_workloads
         # the O(W) arrival ordering depends only on the base problem;
         # compute it once for the whole sweep
@@ -243,7 +277,49 @@ class WhatIfEngine:
         metrics.whatif_duration_seconds.observe("build", value=build_s)
 
         mesh = self._mesh(len(specs))
-        if self.config.round_bucketing:
+        if use_full:
+            from kueue_oss_tpu.sim.batch import (
+                FULL_TIER,
+                LaneBudget,
+                check_parity_full,
+                full_caps,
+                solve_scenarios_sequential_full,
+                solve_scenarios_tiered,
+                sweep_order,
+            )
+
+            caps = full_caps(problem)
+            budget = LaneBudget(
+                budget_bytes=self.config.lane_budget_mb << 20,
+                max_full_scenarios=self.config.full_sweep_max)
+            batch = solve_scenarios_tiered(
+                problem, overlays, budget=budget, caps=caps,
+                tensors=full_tensors,
+                relax_iters=self.config.relax_iters,
+                pad_pow2=self.config.pad_pow2,
+                order=sweep_order(specs))
+            bucket_stats = {}
+            n_dispatches = max(1, len(batch.chunks)
+                               + (1 if batch.retier_idx else 0))
+            n_full = sum(1 for t in batch.tier if t == FULL_TIER)
+            metrics.whatif_scenarios_total.inc("full", by=n_full)
+            if len(specs) > n_full:
+                metrics.whatif_scenarios_total.inc(
+                    "relax", by=len(specs) - n_full)
+            report.base["full_caps"] = {"g_max": caps[0],
+                                        "h_max": caps[1],
+                                        "p_max": caps[2]}
+            if batch.retier_idx:
+                # the silent-cap audit's report surface: WHICH rows
+                # were approximated, and why (the metrics counter and
+                # the planner's log line fire in LaneBudget.plan)
+                report.base["retier"] = {
+                    "reason": batch.retier_reason,
+                    "scenarios": [specs[i].name
+                                  for i in batch.retier_idx],
+                    "indices": list(batch.retier_idx),
+                }
+        elif self.config.round_bucketing:
             # round-skew bucketing (docs/SIMULATOR.md): short scenarios
             # stop riding the batch to the slowest lane's round count
             batch, bucket_stats, n_dispatches = solve_scenarios_bucketed(
@@ -267,12 +343,25 @@ class WhatIfEngine:
         parity_s = 0.0
         if n_parity > 0:
             t1 = time.monotonic()
-            idx = list(range(min(n_parity, len(specs))))
-            seq = solve_scenarios_sequential(
-                problem, [overlays[i] for i in idx])
+            if use_full:
+                # parity is defined against the sequential FULL
+                # oracle, so only exactly-solved rows participate —
+                # relax-tier rows are approximate BY DECLARATION
+                # (tier="relax" per row), not a parity failure
+                idx = [i for i, t in enumerate(batch.tier)
+                       if t == FULL_TIER][:n_parity]
+                seq = solve_scenarios_sequential_full(
+                    problem, [overlays[i] for i in idx], *caps,
+                    tensors=full_tensors) if idx else None
+                pr = (check_parity_full(batch, seq, idx) if idx
+                      else check_parity(batch, batch, []))
+            else:
+                idx = list(range(min(n_parity, len(specs))))
+                seq = solve_scenarios_sequential(
+                    problem, [overlays[i] for i in idx])
+                pr = check_parity(batch, seq, idx)
             metrics.whatif_scenarios_total.inc("sequential",
                                                by=len(idx))
-            pr = check_parity(batch, seq, idx)
             parity_s = time.monotonic() - t1
             metrics.whatif_duration_seconds.observe(
                 "parity", value=parity_s)
@@ -287,11 +376,16 @@ class WhatIfEngine:
 
         t2 = time.monotonic()
         for spec, overlay, i in zip(specs, overlays, range(len(specs))):
+            kw = {}
+            if use_full:
+                kw = {"tier": batch.tier[i],
+                      "victim_reason": batch.victim_reason[i]
+                      if batch.tier[i] == FULL_TIER else None}
             report.scenarios.append(scenario_kpis(
                 problem, spec, overlay,
                 batch.admitted[i], batch.opt[i], batch.admit_round[i],
                 batch.parked[i], batch.rounds[i], batch.usage[i],
-                now=now))
+                now=now, **kw))
         report_s = time.monotonic() - t2
         metrics.whatif_duration_seconds.observe("report", value=report_s)
         report.timing = {
@@ -303,7 +397,8 @@ class WhatIfEngine:
             "batch_dispatches": n_dispatches,
             "round_buckets": {str(b): n
                               for b, n in sorted(bucket_stats.items())},
-            "mesh_devices": batch.mesh_devices,
+            "mesh_devices": getattr(batch, "mesh_devices", 0),
+            "full_chunks": list(getattr(batch, "chunks", [])),
             "scenarios_per_sec": round(
                 len(specs) / batch.solve_seconds, 2)
             if batch.solve_seconds > 0 else 0.0,

@@ -32,15 +32,49 @@ def _pct(arr: np.ndarray, q: float) -> float:
     return _r(np.percentile(arr, q))
 
 
+def borrow_stats(problem: SolverProblem, overlay: dict,
+                 usage: np.ndarray) -> dict:
+    """Per-scenario borrowing posture: how many CQs are borrowing and
+    how many sit AT their borrowing ceiling — either their own
+    borrowingLimit (``has_borrow``) or an exhausted cohort pool (root
+    usage at subtree capacity). The load-ladder driver's third
+    breaking-point signal ("first cohort at borrowing ceiling")."""
+    C = problem.n_cqs
+    if not C:
+        return {"borrowing_cqs": 0, "cqs_at_borrow_ceiling": 0}
+    cq_rows = problem.cq_node
+    nominal = np.asarray(overlay.get("nominal", problem.nominal))
+    blimit = np.asarray(
+        overlay.get("borrow_limit", problem.borrow_limit))
+    subtree = np.asarray(overlay.get("subtree", problem.subtree))
+    has_b = np.asarray(problem.has_borrow)[cq_rows]
+    u = np.maximum(usage[cq_rows], 0)
+    nom = nominal[cq_rows]
+    borrowing = (u > nom).any(axis=1)
+    ceiling = nom + blimit[cq_rows]
+    at_limit = has_b.reshape(-1, 1) & (u >= ceiling) & (u > nom)
+    root = problem.cq_root
+    pool_full = (np.maximum(usage[root], 0)
+                 >= subtree[root]).any(axis=1)
+    at_ceiling = borrowing & (at_limit.any(axis=1) | pool_full)
+    return {"borrowing_cqs": int(borrowing.sum()),
+            "cqs_at_borrow_ceiling": int(at_ceiling.sum())}
+
+
 def scenario_kpis(problem: SolverProblem, spec, overlay: dict,
                   admitted: np.ndarray, opt: np.ndarray,
                   admit_round: np.ndarray, parked: np.ndarray,
-                  rounds, usage: np.ndarray, now: float = 0.0) -> dict:
+                  rounds, usage: np.ndarray, now: float = 0.0,
+                  tier: str = "lean",
+                  victim_reason: np.ndarray = None) -> dict:
     """KPIs for one solved scenario.
 
     ``overlay`` is the scenario's field overrides — the effective
     wl_cqid (arrival masking) and quota arrays come from it when
     present, so KPIs describe the world the kernel actually solved.
+    ``tier`` names the solve tier the row came from ("lean" fit-only
+    batch / "full" preemption kernel / "relax" approximate LP);
+    ``victim_reason`` (FULL tier) makes the preemption count real.
     """
     W = problem.n_workloads
     C = problem.n_cqs
@@ -87,14 +121,20 @@ def scenario_kpis(problem: SolverProblem, spec, overlay: dict,
     ages = np.maximum(0.0, float(now) - raw_ts[pending])
     admit_rounds = admit_round[:W][adm]
 
+    # the lean drain is fit-only by contract (preemptions stay 0);
+    # the FULL tier reports real victims via victim_reason > 0
+    preemptions = (int((victim_reason[:W] > 0).sum())
+                   if victim_reason is not None else 0)
+
     kpis = {
         "name": spec.name,
         "spec": spec.to_dict(),
+        "tier": tier,
         "workloads": n_live,
         "admitted": n_adm,
         "parked": n_parked,
         "pending": int(pending.sum()),
-        "preemptions": 0,  # the lean drain is fit-only by contract
+        "preemptions": preemptions,
         "admission_rate": _r(n_adm / n_live) if n_live else 0.0,
         "rounds": int(rounds),
         "utilization": utilization,
@@ -105,6 +145,7 @@ def scenario_kpis(problem: SolverProblem, spec, overlay: dict,
         "admit_round_p50": _pct(admit_rounds, 50),
         "admit_round_p95": _pct(admit_rounds, 95),
     }
+    kpis.update(borrow_stats(problem, overlay, usage))
     if C <= PER_CQ_BREAKDOWN_MAX:
         per_cq = np.bincount(cqid[adm], minlength=C + 1)[:C]
         kpis["admitted_by_cq"] = {

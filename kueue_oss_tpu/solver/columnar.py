@@ -419,16 +419,7 @@ class ColumnarStore:
             for name in cq_set:
                 blk.cq_frs |= self._cq_frs(name, cache.spec_gen)
         if bk[0] == "a":
-            u_rows, u_fs, u_qs = [], [], []
-            for li, r in enumerate(rows):
-                if r.usage_fs is not None and r.usage_fs.size:
-                    u_rows.append(np.full(r.usage_fs.size, li,
-                                          dtype=np.int64))
-                    u_fs.append(r.usage_fs)
-                    u_qs.append(r.usage_qs)
-            blk.u_rows = _concat(u_rows, np.int64)
-            blk.u_fs = _concat(u_fs, np.int64)
-            blk.u_qs = _concat(u_qs, np.int64)
+            self._admitted_usage(blk)
         blk._pos = None
         # The queue manager re-wraps a workload in a fresh WorkloadInfo
         # on every update, so content-only churn still fails the
@@ -459,6 +450,21 @@ class ColumnarStore:
             self._key_home[k] = bk
         return blk
 
+    @staticmethod
+    def _admitted_usage(blk: _Block) -> None:
+        """(Re)build the admitted block's COO usage triplets from its
+        cached rows — O(admitted) list walk, no cache.row calls."""
+        u_rows, u_fs, u_qs = [], [], []
+        for li, r in enumerate(blk.rows):
+            if r.usage_fs is not None and r.usage_fs.size:
+                u_rows.append(np.full(r.usage_fs.size, li,
+                                      dtype=np.int64))
+                u_fs.append(r.usage_fs)
+                u_qs.append(r.usage_qs)
+        blk.u_rows = _concat(u_rows, np.int64)
+        blk.u_fs = _concat(u_fs, np.int64)
+        blk.u_qs = _concat(u_qs, np.int64)
+
     def _patch_valid_rows(self, order: list, valid: dict,
                           spec: dict, stamp: tuple) -> None:
         """Bring every membership-valid block current with the dirty
@@ -482,6 +488,7 @@ class ColumnarStore:
         cq_strict = spec["cq_strict"]
         cq_root = spec["cq_root"]
         K, F = spec["K"], spec["F"]
+        touched_admitted = None
         for key in set(self._log[start:]):
             bk = self._key_home.get(key)
             blk = targets.get(bk)
@@ -505,6 +512,10 @@ class ColumnarStore:
             blk.shape_id[idx] = r.shape_id
             blk.class_tok[idx] = r.class_tok
             blk.admit_ts[idx] = r.admit_ts
+            if blk.kind == "a":
+                touched_admitted = blk
+        if touched_admitted is not None:
+            self._admitted_usage(touched_admitted)
         for blk in targets.values():
             blk.log_pos = log_len
 
@@ -558,8 +569,28 @@ class ColumnarStore:
             for bk in order:
                 if bk[0] == "a":
                     blk = self._blocks.get(bk)
-                    valid[bk] = (blk is not None
-                                 and blk.events_mark == events)
+                    ok = blk is not None and blk.events_mark == events
+                    if blk is not None and not ok:
+                        # Row-granular revalidation: any store event
+                        # used to retire the whole admitted section
+                        # (O(admitted) row rebuild). Membership is a
+                        # key/CQ sequence compare against a fresh info
+                        # list; when it holds, swap in the fresh infos
+                        # (rows rebuild from info content) and let the
+                        # dirty log drive O(dirty) row patches instead.
+                        infos = [i for i in store.admitted_infos()
+                                 if i.cluster_queue in forest.cqs]
+                        section_infos[bk] = infos
+                        if (len(infos) == len(blk.infos)
+                                and all(a is b or (
+                                    a.key == b.key
+                                    and a.cluster_queue
+                                    == b.cluster_queue)
+                                    for a, b in zip(infos, blk.infos))):
+                            blk.infos = infos
+                            blk.events_mark = events
+                            ok = True
+                    valid[bk] = ok
                     continue
                 infos = section_infos[bk]
                 blk = self._blocks.get(bk)
@@ -596,7 +627,7 @@ class ColumnarStore:
                 for bk in order:
                     if valid[bk]:
                         continue
-                    if bk[0] == "a":
+                    if bk[0] == "a" and bk not in section_infos:
                         infos = [i for i in store.admitted_infos()
                                  if i.cluster_queue in spec["cq_id"]]
                         section_infos[bk] = infos
@@ -811,6 +842,7 @@ class ColumnarStore:
 
         fields: dict = {}
         ts_changed = tok_changed = shape_changed = False
+        admit_changed = ad_usage_changed = False
         if changed:
             gpos = np.fromiter(changed.values(), np.int64, len(changed))
             wl_prio = old.wl_prio.copy()
@@ -843,6 +875,42 @@ class ColumnarStore:
                           wl_evicted0=wl_evicted0, wl_raw_ts=wl_raw_ts)
             asm.toks = new_toks
             asm.shape_ids = new_shapes
+            # Admitted rows additionally carry an admission timestamp
+            # (ranked below) and an admission-usage row; patch both
+            # from the freshly rebuilt block rows.
+            wl_raw_admit_ts = old.wl_raw_admit_ts
+            for bk, idxs in per_block.items():
+                blk = self._blocks[bk]
+                if blk.kind != "a":
+                    continue
+                off = asm.offsets[bk]
+                for li in idxs:
+                    gi = off + li
+                    r = blk.rows[li]
+                    if wl_raw_admit_ts[gi] != r.admit_ts:
+                        if wl_raw_admit_ts is old.wl_raw_admit_ts:
+                            wl_raw_admit_ts = \
+                                old.wl_raw_admit_ts.copy()
+                        wl_raw_admit_ts[gi] = r.admit_ts
+                        admit_changed = True
+                    dense = np.zeros(asm.ad_usage_raw.shape[1],
+                                     dtype=np.int64)
+                    if r.usage_fs is not None and r.usage_fs.size:
+                        dense[r.usage_fs] = r.usage_qs
+                    if np.any(asm.ad_usage_raw[gi] != dense):
+                        asm.ad_usage_raw[gi] = dense
+                        ad_usage_changed = True
+            if admit_changed:
+                raw_admit = wl_raw_admit_ts[asm.n_pending:asm.W]
+                distinct_admit, inv_a = np.unique(
+                    raw_admit, return_inverse=True)
+                wl_admit_rank = old.wl_admit_rank.copy()
+                wl_admit_rank[asm.n_pending:asm.W] = inv_a + 1
+                asm.n_admit_rank = len(distinct_admit)
+                fields.update(
+                    wl_raw_admit_ts=wl_raw_admit_ts,
+                    wl_admit_rank=wl_admit_rank,
+                    admit_rank_base=len(distinct_admit) + 2)
         else:
             wl_raw_ts = old.wl_raw_ts
 
@@ -865,7 +933,7 @@ class ColumnarStore:
                 wl_valid[:W] = stack_valid[asm.shape_ids]
             fields["wl_req"] = self._scaled(wl_req_raw, scale)
             fields["wl_valid"] = wl_valid
-        if rescale and include_admitted:
+        if include_admitted and (rescale or ad_usage_changed):
             fields["ad_usage"] = self._scaled(asm.ad_usage_raw, scale)
 
         if ts_changed:

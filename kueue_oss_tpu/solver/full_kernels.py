@@ -1384,6 +1384,45 @@ def _init_state(t: FullTensors, g_max: int):
     }
 
 
+def _solve_full_impl(t: FullTensors, g_max: int, h_max: int, p_max: int,
+                     fs_enabled: bool = False, round_cap: int = 0,
+                     mesh=None, axis: str = "wl"):
+    """The drain body shared by the single-problem jit
+    (:func:`make_full_solver`) and the scenario-batched vmap
+    (:func:`solve_backlog_full_batched`). Pure traced jnp code — the
+    static caps select the program, the tensors are the only inputs."""
+    W1 = t.wl_cqid.shape[0]
+    C = t.cq_node.shape[0]
+    W_null = W1 - 1
+    pot = potential_available_all(t)
+    if fs_enabled:
+        from kueue_oss_tpu.solver.fair_kernels import (
+            lendable_by_resource,
+        )
+
+        lendable_r = lendable_by_resource(t, pot)
+    else:
+        lendable_r = None
+    bound = 2 * W1 + C + 5
+    if round_cap:
+        bound = min(bound, round_cap)
+
+    def cond(state):
+        return state["progress"] & (state["rounds"] < bound)
+
+    def body(state):
+        new_state, _ = round_body(t, state, pot, g_max, h_max, p_max,
+                                  fs_enabled, lendable_r, mesh, axis)
+        return new_state
+
+    final = jax.lax.while_loop(cond, body, _init_state(t, g_max))
+    admitted = final["admitted"].at[W_null].set(False)
+    parked = final["parked"].at[W_null].set(False)
+    return (admitted, final["opt"], final["admit_round"], parked,
+            final["rounds"], final["usage"], final["wl_usage"],
+            final["victim_reason"])
+
+
 def make_full_solver(g_max: int, h_max: int, p_max: int,
                      fs_enabled: bool = False, round_cap: int = 0,
                      mesh=None, axis: str = "wl"):
@@ -1396,36 +1435,8 @@ def make_full_solver(g_max: int, h_max: int, p_max: int,
 
     @jax.jit
     def solve(t: FullTensors):
-        W1 = t.wl_cqid.shape[0]
-        C = t.cq_node.shape[0]
-        W_null = W1 - 1
-        pot = potential_available_all(t)
-        if fs_enabled:
-            from kueue_oss_tpu.solver.fair_kernels import (
-                lendable_by_resource,
-            )
-
-            lendable_r = lendable_by_resource(t, pot)
-        else:
-            lendable_r = None
-        bound = 2 * W1 + C + 5
-        if round_cap:
-            bound = min(bound, round_cap)
-
-        def cond(state):
-            return state["progress"] & (state["rounds"] < bound)
-
-        def body(state):
-            new_state, _ = round_body(t, state, pot, g_max, h_max, p_max,
-                                      fs_enabled, lendable_r, mesh, axis)
-            return new_state
-
-        final = jax.lax.while_loop(cond, body, _init_state(t, g_max))
-        admitted = final["admitted"].at[W_null].set(False)
-        parked = final["parked"].at[W_null].set(False)
-        return (admitted, final["opt"], final["admit_round"], parked,
-                final["rounds"], final["usage"], final["wl_usage"],
-                final["victim_reason"])
+        return _solve_full_impl(t, g_max, h_max, p_max, fs_enabled,
+                                round_cap, mesh, axis)
 
     return solve
 
@@ -1500,3 +1511,71 @@ def solve_backlog_full(t: FullTensors, g_max: int, h_max: int = 32,
                               mesh=mesh, axis=axis)
         _solver_cache[key] = fn
     return fn(t)
+
+
+#: FullTensors fields the scenario overlay layer varies — the FULL
+#: twins of kernels.BATCHABLE_FIELDS (lean ``wl_ts`` is ``wl_ts0``
+#: here; the lean ``wl_rank`` has no FULL twin: the full kernel
+#: selects heads by (priority, ts, uid) and masked rows drop out of
+#: the per-CQ segment reductions through ``wl_cqid = C``).
+FULL_BATCHABLE_FIELDS = frozenset({
+    "nominal", "subtree", "local_quota", "has_borrow", "borrow_limit",
+    "usage0", "wl_cqid", "wl_prio", "wl_ts0", "wl_valid", "wl_req",
+})
+
+#: Every FullTensors field. Like the lean kernel, the drain body is
+#: shape-static gather/scatter arithmetic with no host-side dependence
+#: on array content, so any field may carry the scenario axis;
+#: FULL_BATCHABLE_FIELDS remains the documented overlay subset.
+ALL_FULL_FIELDS = frozenset(FullTensors._fields)
+
+
+def solve_backlog_full_batched(t: FullTensors, overrides: dict,
+                               g_max: int, h_max: int = 32,
+                               p_max: int = 128,
+                               fs_enabled: bool = False,
+                               round_cap: int = 0):
+    """Solve S counterfactual variants of one FULL problem in ONE
+    device dispatch: ``jit(vmap)`` of the preemption-capable drain.
+
+    ``overrides`` maps FullTensors field names to stacked [S, ...]
+    scenario variants; unnamed fields broadcast unbatched (the large
+    ``wl_req`` tensor on quota-only sweeps costs one copy, not S).
+    Returns the solve_backlog_full 8-tuple with a leading scenario
+    axis on every output. The victim-search lane memory scales as
+    S x h_max x K x p_max — callers size S from a
+    :class:`~kueue_oss_tpu.sim.batch.LaneBudget`, not from the sweep
+    width. Mesh lane-sharding never composes with the scenario axis
+    (the batched path is single-program; chunking IS the scale story).
+    """
+    if not overrides:
+        raise ValueError("batched full solve needs at least one "
+                         "scenario-varying field (use "
+                         "solve_backlog_full otherwise)")
+    bad = set(overrides) - ALL_FULL_FIELDS
+    if bad:
+        raise ValueError(
+            f"fields {sorted(bad)} are not FullTensors fields; "
+            f"batchable: {sorted(ALL_FULL_FIELDS)}")
+    from kueue_oss_tpu import features
+
+    gates = ()
+    if fs_enabled:
+        gates = (features.enabled("FairSharingPreemptWithinNominal"),
+                 features.enabled("FairSharingPrioritizeNonBorrowing"),
+                 features.enabled("PrioritySortingWithinCohort"))
+    key = ("batched", frozenset(overrides), g_max, h_max, p_max,
+           fs_enabled, gates, round_cap)
+    fn = _solver_cache.get(key)
+    if fn is None:
+        axes = FullTensors(
+            **{f: (0 if f in overrides else None)
+               for f in FullTensors._fields})
+        fn = jax.jit(jax.vmap(
+            partial(_solve_full_impl, g_max=g_max, h_max=h_max,
+                    p_max=p_max, fs_enabled=fs_enabled,
+                    round_cap=round_cap),
+            in_axes=(axes,)))
+        _solver_cache[key] = fn
+    return fn(t._replace(**{k: jnp.asarray(v)
+                            for k, v in overrides.items()}))

@@ -246,30 +246,47 @@ class Dashboard:
     # -- what-if planning (sim/, docs/SIMULATOR.md) ------------------------
 
     def whatif_view(self, factors=None, target: str = "*",
-                    arrival=None, max_scenarios: int = 64) -> dict:
+                    arrival=None, max_scenarios: int = 64,
+                    full=None, ladder=None) -> dict:
         """Counterfactual sweep over the LIVE store's current backlog:
         quota factors (x arrival factors when given) on the matched CQ
         or cohort, solved in one vmapped dispatch. The capacity-planning
-        answer straight from the dashboard."""
+        answer straight from the dashboard.
+
+        ``full`` routes through the FULL preemption kernel
+        (lane-budgeted; relax-LP re-tiers reported per row); ``ladder``
+        switches to the breaking-point load ladder over the given
+        arrival factors ("what breaks first as load doubles",
+        sim/traces.py)."""
         from kueue_oss_tpu.config.configuration import SimulatorConfig
         from kueue_oss_tpu.sim import (
             WhatIfEngine,
             arrival_sweep,
             cross,
+            load_ladder,
             quota_sweep,
         )
         from kueue_oss_tpu.solver.tensors import UnsupportedProblem
 
+        cfg = (self.sim_config if self.sim_config is not None
+               else SimulatorConfig())
+        if ladder:
+            try:
+                res = load_ladder(self.store, factors=list(ladder),
+                                  queues=self.queues, config=cfg,
+                                  full=full)
+            except (UnsupportedProblem, ValueError) as e:
+                return {"error": str(e)}
+            res["report"] = res["report"].to_dict()
+            return res
         factors = list(factors or (0.5, 1.5, 2.0))
         specs = quota_sweep(factors, target=target)
         if arrival:
             specs = cross(specs, arrival_sweep(list(arrival)))
-        cfg = (self.sim_config if self.sim_config is not None
-               else SimulatorConfig())
         specs = specs[:max(1, min(max_scenarios, cfg.max_scenarios))]
         engine = WhatIfEngine(self.store, self.queues, config=cfg)
         try:
-            report = engine.run(specs)
+            report = engine.run(specs, full=full)
         except (UnsupportedProblem, ValueError) as e:
             return {"error": str(e)}
         return report.to_dict()
@@ -684,11 +701,17 @@ class DashboardServer:
                                 f"{key} must be comma-separated "
                                 f"numbers, got {raw!r}")
 
+                    raw_full = qs.get("full", [None])[0]
+                    full = (None if raw_full is None
+                            else raw_full.lower() in ("1", "true",
+                                                      "yes", "on"))
                     try:
                         view = dash.whatif_view(
                             factors=floats("factors") or None,
                             target=qs.get("target", ["*"])[0],
-                            arrival=floats("arrival") or None)
+                            arrival=floats("arrival") or None,
+                            full=full,
+                            ladder=floats("ladder") or None)
                     except ValueError as e:
                         view = {"error": str(e)}
                     body = json.dumps(view).encode()

@@ -95,13 +95,30 @@ INDEX_HTML = """<!doctype html>
            title="CQ or cohort name glob; a cohort scales its subtree">
   arrival <input id="wi-arrival" value="" size="10"
                  placeholder="e.g. 0.5,2">
+  <label title="FULL preemption kernel: real preemption counts,
+lane-budgeted batching; overflow rows fall back to the relax LP
+(tier column)"><input type="checkbox" id="wi-full"> preemption</label>
   <button onclick="runWhatIf()">simulate</button>
+  <button onclick="runLadder()"
+          title="double the arrival load until something breaks:
+admission SLO, starvation age, or a borrowing ceiling">load
+ladder</button>
   <span id="wi-status" class="frac"></span>
 </div>
 <table id="wis" style="display:none"><thead><tr>
-  <th>Scenario</th><th>Workloads</th><th>Admitted</th><th>Parked</th>
-  <th>Utilization</th><th>Fairness drift</th><th>Rounds</th>
+  <th>Scenario</th><th>Tier</th><th>Workloads</th><th>Admitted</th>
+  <th>Parked</th><th>Preempt</th><th>Utilization</th>
+  <th>Fairness drift</th><th>Rounds</th>
   </tr></thead><tbody></tbody></table>
+<div id="wi-ladder" style="display:none">
+  <h3>Breaking points</h3>
+  <p id="wi-breaks" class="frac"></p>
+  <table id="wil"><thead><tr>
+    <th>Load</th><th>Tier</th><th>Admission rate</th>
+    <th>Starvation p95</th><th>CQs at borrow ceiling</th>
+    <th>Preempt</th><th>Breaches</th>
+    </tr></thead><tbody></tbody></table>
+</div>
 </div>
 <footer>live over SSE (/api/stream), 2s polling fallback ·
 JSON at /api/overview · decision traces at /api/decisions ·
@@ -244,28 +261,72 @@ async function refreshHealth() {
 async function runWhatIf() {
   const status = document.getElementById("wi-status");
   const table = document.getElementById("wis");
+  document.getElementById("wi-ladder").style.display = "none";
   status.textContent = "solving…";
   const params = new URLSearchParams();
   params.set("factors", document.getElementById("wi-factors").value);
   params.set("target", document.getElementById("wi-target").value);
   const arr = document.getElementById("wi-arrival").value.trim();
   if (arr) params.set("arrival", arr);
+  if (document.getElementById("wi-full").checked)
+    params.set("full", "1");
   try {
     const r = await fetch("/api/whatif?" + params.toString());
     const rep = await r.json();
     if (rep.error) { status.textContent = rep.error; return; }
     const t = rep.timing || {};
+    const retier = (rep.base || {}).retier;
     status.textContent = `${(rep.scenarios || []).length} scenarios in ` +
       `one dispatch (${t.scenarios_per_sec || "?"}/s, parity ` +
-      `${rep.parity && rep.parity.identical ? "ok" : "FAILED"})`;
+      `${rep.parity && rep.parity.identical ? "ok" : "FAILED"}` +
+      (retier ? `, ${retier.indices.length} re-tiered to relax: ` +
+                `${retier.reason}` : "") + `)`;
     table.style.display = "";
     document.querySelector("#wis tbody").innerHTML =
       (rep.scenarios || []).map(s => `<tr><td>${s.name}</td>` +
+        `<td>${s.tier || "lean"}</td>` +
         `<td>${s.workloads}</td><td>${s.admitted}</td>` +
-        `<td>${s.parked}</td>` +
+        `<td>${s.parked}</td><td>${s.preemptions}</td>` +
         `<td>${(s.utilization * 100).toFixed(0)}%</td>` +
         `<td>${s.fairness_drift}</td><td>${s.rounds}</td></tr>`)
       .join("");
+  } catch (e) { status.textContent = "what-if unavailable"; }
+}
+async function runLadder() {
+  const status = document.getElementById("wi-status");
+  document.getElementById("wis").style.display = "none";
+  const box = document.getElementById("wi-ladder");
+  status.textContent = "climbing the load ladder…";
+  const params = new URLSearchParams();
+  params.set("ladder", "1,2,4,8");
+  if (document.getElementById("wi-full").checked)
+    params.set("full", "1");
+  try {
+    const r = await fetch("/api/whatif?" + params.toString());
+    const res = await r.json();
+    if (res.error) { status.textContent = res.error; return; }
+    status.textContent = `${(res.ladder || []).length} rungs`;
+    const firsts = [
+      ["SLO burn", res.first_slo_burn],
+      ["starvation breach", res.first_starvation_breach],
+      ["borrow ceiling", res.first_borrow_ceiling]];
+    document.getElementById("wi-breaks").textContent =
+      (res.what_breaks_first
+        ? `first to break: ${res.what_breaks_first.replace(/_/g, " ")} — `
+        : "nothing breaks on this ladder — ") +
+      firsts.map(([n, f]) =>
+        `${n}: ${f == null ? "never" : "x" + f}`).join(", ");
+    box.style.display = "";
+    document.querySelector("#wil tbody").innerHTML =
+      (res.ladder || []).map(s => `<tr><td>x${s.factor}</td>` +
+        `<td>${s.tier || "lean"}</td>` +
+        `<td>${(s.admission_rate * 100).toFixed(0)}%</td>` +
+        `<td>${Math.round(s.starvation_age_p95)}s</td>` +
+        `<td>${s.cqs_at_borrow_ceiling}</td>` +
+        `<td>${s.preemptions}</td>` +
+        `<td>${Object.entries(s.breaches || {}).filter(([, v]) => v)
+                .map(([k]) => k.replace(/_/g, " ")).join(", ") || "—"}` +
+        `</td></tr>`).join("");
   } catch (e) { status.textContent = "what-if unavailable"; }
 }
 const obj = (o) => `<table><tbody>` + Object.entries(o || {}).map(

@@ -28,18 +28,21 @@ import numpy as np
 import pytest
 
 from kueue_oss_tpu.api.types import (
+    Admission,
     ClusterQueue,
     Cohort,
     FlavorQuotas,
     LocalQueue,
     Node,
     PodSet,
+    PodSetAssignment,
     PreemptionPolicy,
     QueueingStrategy,
     ResourceFlavor,
     ResourceGroup,
     ResourceQuota,
     Workload,
+    WorkloadConditionType,
 )
 from kueue_oss_tpu.core.queue_manager import QueueManager
 from kueue_oss_tpu.core.store import Store
@@ -363,3 +366,123 @@ def test_afs_bailout_is_counted_and_stamped():
     stats = cache.columnar.last_stats
     assert stats["mode"] == "bailout:afs_active"
     assert stats["rows"] == 0 and stats["dirty_rows"] == 0
+
+
+class TestAdmittedRowGranular:
+    """Admitted-section churn must ride the scatter path: content
+    edits to admitted workloads (priority, requests/usage, admission
+    timestamp) patch O(dirty) rows instead of retiring the whole
+    section, and unrelated pending events must not rebuild it either —
+    all while staying bit-identical to the classic walk."""
+
+    def _admit(self, store, name, cq, t, uid, cpu=500):
+        submit(store, name, cq, t, uid, cpu=cpu)
+        wl = store.workloads[f"default/{name}"]
+        wl.status.admission = Admission(
+            cluster_queue=cq,
+            podset_assignments=[PodSetAssignment(
+                name="main", flavors={"cpu": "default"},
+                resource_usage=dict(wl.podsets[0].total_requests()),
+                count=1)])
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                         reason="QuotaReserved", now=t)
+        store.update_workload(wl)
+        return wl
+
+    def _setup(self):
+        store = build_store()
+        qm = QueueManager(store)
+        cache = ExportCache(store)
+        for i in range(6):
+            submit(store, f"p{i}", "abcm"[i % 4], float(i), 200 + i,
+                   cpu=100 * (1 + i % 3))
+        for i in range(8):
+            self._admit(store, f"ad{i}", "abc"[i % 3], 50.0 + i,
+                        300 + i, cpu=250 * (1 + i % 3))
+        pending = backlog(qm)
+        warm = export_problem(store, pending, cache=cache, now=1.0,
+                              include_admitted=True)
+        assert warm is not None
+        return store, qm, cache
+
+    def _export_both(self, store, qm, cache, label):
+        pending = backlog(qm)
+        col = export_problem(store, pending, cache=cache, now=1.0,
+                             include_admitted=True)
+        classic = export_problem(store, pending, cache=cache, now=1.0,
+                                 include_admitted=True, columnar=False)
+        assert_problems_equal(classic, col, label)
+        return col
+
+    def test_admitted_content_churn_scatters(self):
+        store, qm, cache = self._setup()
+        wl = store.workloads["default/ad3"]
+        wl.priority = 7
+        wl.podsets[0].requests["cpu"] = 950
+        wl.status.admission.podset_assignments[0].resource_usage = (
+            dict(wl.podsets[0].total_requests()))
+        cond = wl.status.conditions[
+            WorkloadConditionType.QUOTA_RESERVED]
+        cond.last_transition_time = 321.0
+        store.update_workload(wl)
+        col = self._export_both(store, qm, cache, "admitted-churn")
+        stats = cache.columnar.last_stats
+        assert stats["mode"] == "scatter", stats
+        assert stats["dirty_rows"] == 1, stats
+        assert stats["blocks_rebuilt"] == 0, stats
+        # the patched row actually landed: admitted usage & admit rank
+        # reflect the edit (sanity on top of the twin compare)
+        pos = col.wl_keys.index("default/ad3")
+        assert col.wl_raw_admit_ts[pos] == 321.0
+        assert col.wl_prio[pos] == 7
+
+    def test_pending_churn_keeps_admitted_block(self):
+        store, qm, cache = self._setup()
+        wl = store.workloads["default/p2"]
+        wl.priority = 4
+        store.update_workload(wl)
+        self._export_both(store, qm, cache, "pending-churn")
+        stats = cache.columnar.last_stats
+        # the pending block's content-only rebuild is expected (its
+        # infos were re-wrapped); the admitted section must NOT be
+        # rebuilt, which is what keeps this on the scatter path —
+        # before row-granular revalidation this forced an assemble
+        assert stats["mode"] == "scatter", stats
+        assert stats["blocks_rebuilt"] == 1, stats
+
+    def test_admitted_membership_change_assembles(self):
+        store, qm, cache = self._setup()
+        self._admit(store, "ad-new", "b", 99.0, 400)
+        self._export_both(store, qm, cache, "admitted-join")
+        stats = cache.columnar.last_stats
+        assert stats["mode"] == "assemble", stats
+        # release one: membership shrinks, still bit-identical
+        store.delete_workload("default/ad1")
+        self._export_both(store, qm, cache, "admitted-release")
+        assert cache.columnar.last_stats["mode"] == "assemble"
+
+    def test_admitted_churn_burst_random(self):
+        rng = random.Random(11)
+        store, qm, cache = self._setup()
+        for batch in range(12):
+            for _ in range(rng.randint(1, 3)):
+                name = f"ad{rng.randrange(8)}"
+                wl = store.workloads.get(f"default/{name}")
+                if wl is None:
+                    continue
+                roll = rng.random()
+                if roll < 0.4:
+                    wl.priority = rng.randint(0, 9)
+                elif roll < 0.8:
+                    wl.podsets[0].requests["cpu"] = rng.choice(
+                        [250, 500, 750, 950])
+                    psa = wl.status.admission.podset_assignments[0]
+                    psa.resource_usage = dict(
+                        wl.podsets[0].total_requests())
+                else:
+                    wl.status.conditions[
+                        WorkloadConditionType.QUOTA_RESERVED
+                    ].last_transition_time = rng.uniform(10.0, 400.0)
+                store.update_workload(wl)
+            self._export_both(store, qm, cache, f"burst-b{batch}")
+            assert cache.columnar.last_stats["mode"] == "scatter"

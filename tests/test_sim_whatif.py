@@ -118,8 +118,8 @@ def test_batch_layer_parity_direct():
 def test_batched_entry_rejects_unbatchable_fields():
     from kueue_oss_tpu.solver.kernels import solve_backlog_batched
 
-    with pytest.raises(ValueError, match="cannot vary"):
-        solve_backlog_batched(None, {"path": np.zeros((2, 3, 1))})
+    with pytest.raises(ValueError, match="not ProblemTensors fields"):
+        solve_backlog_batched(None, {"nope": np.zeros((2, 3))})
     with pytest.raises(ValueError, match="at least one"):
         solve_backlog_batched(None, {})
 

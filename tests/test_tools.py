@@ -666,8 +666,8 @@ def test_benchcheck_unknown_scenario_and_cli(tmp_path):
     from tools.benchcheck import check, main as bc_main
 
     assert check({}, "nope") == ["unknown scenario 'nope' (known: "
-                                 "chaoscampaign, federation, main, "
-                                 "megascale, telemetry)"]
+                                 "chaoscampaign, federation, fullsweep, "
+                                 "main, megascale, telemetry)"]
     path = tmp_path / "tail.json"
     path.write_text("garbage first line\n"
                     + json.dumps(_mega_tail()) + "\n")
@@ -679,6 +679,59 @@ def test_benchcheck_unknown_scenario_and_cli(tmp_path):
     buf = io.StringIO()
     assert bc_main(["--json", str(bad)], out=buf) == 1
     assert "missing key" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# benchcheck: fullsweep tail (docs/SIMULATOR.md "FULL-kernel sweeps")
+# ---------------------------------------------------------------------------
+
+
+def _fullsweep_tail(**over):
+    tail = {
+        "scenario": "fullsweep", "scenarios": 64, "workloads": 12,
+        "padded_workloads": 16, "chunk_width": 64, "chunks": 1,
+        "chunked_wall_s": 0.05, "sequential_wall_s": 0.2,
+        "full_speedup": 4.0, "plans_identical": True,
+        "preemptions_total": 120, "resident_sweep_s": 0.05,
+        "reupload_sweep_s": 0.06, "resident_win": 1.2,
+        "resident_reuses": 3, "resident_full_uploads": 1,
+        "relax_scenarios": 256, "relax_scenarios_per_sec": 300.0,
+    }
+    tail.update(over)
+    return tail
+
+
+def test_benchcheck_valid_fullsweep_tail():
+    from tools.benchcheck import check
+
+    assert check(_fullsweep_tail(), "fullsweep") == []
+    assert check(_fullsweep_tail(), "fullsweep", strict=True) == []
+
+
+def test_benchcheck_fullsweep_strict_bounds():
+    from tools.benchcheck import check
+
+    # the speedup/resident floors, the preemption-evidence floor, and
+    # the exact-true parity bit each fail strict independently
+    bad = _fullsweep_tail(full_speedup=2.0, resident_win=0.8,
+                          preemptions_total=0, plans_identical=False)
+    assert check(bad, "fullsweep") == []  # shape still valid
+    errs = "\n".join(check(bad, "fullsweep", strict=True))
+    assert "full_speedup" in errs and "floor 3.0" in errs
+    assert "resident_win" in errs
+    assert "preemptions_total" in errs
+    assert "plans_identical" in errs
+
+
+def test_benchcheck_fullsweep_types():
+    from tools.benchcheck import check
+
+    tail = _fullsweep_tail(plans_identical=1, chunks=2.5)
+    del tail["full_speedup"]
+    errs = "\n".join(check(tail, "fullsweep"))
+    assert "plans_identical: expected bool" in errs
+    assert "chunks: expected int" in errs
+    assert "missing key: full_speedup" in errs
 
 
 # ---------------------------------------------------------------------------

@@ -112,6 +112,30 @@ SCHEMAS = {
         "trace_tracks": int,
         "capture_trigger_works": bool,
     },
+    # the fullsweep scenario's tail (bench.py "fullsweep"): chunked
+    # FULL-kernel sweeps vs the sequential FULL oracle + the resident
+    # and relax-tier measurements (docs/SIMULATOR.md "FULL-kernel
+    # sweeps, lane budgets & resident state")
+    "fullsweep": {
+        "scenario": str,
+        "scenarios": int,
+        "workloads": int,
+        "padded_workloads": int,
+        "chunk_width": int,
+        "chunks": int,
+        "chunked_wall_s": NUM,
+        "sequential_wall_s": NUM,
+        "full_speedup": NUM,
+        "plans_identical": bool,
+        "preemptions_total": int,
+        "resident_sweep_s": NUM,
+        "reupload_sweep_s": NUM,
+        "resident_win": NUM,
+        "resident_reuses": int,
+        "resident_full_uploads": int,
+        "relax_scenarios": int,
+        "relax_scenarios_per_sec": NUM,
+    },
     # the orchestrated run's headline tail (bench.py main): only the
     # always-present core — optional scenarios may drop their fields
     "main": {
@@ -152,6 +176,15 @@ FLOORS = {
         "transfer_bytes_total": 1,
         "trace_tracks": 2,
     },
+    "fullsweep": {
+        # the ISSUE's acceptance bar: >= 3x chunked-vs-sequential FULL
+        # sweep wall, a resident-state win (never slower than fresh
+        # uploads), and preemption traffic proving the FULL tier is
+        # actually exercised (a zero-victim sweep proves nothing)
+        "full_speedup": 3.0,
+        "resident_win": 1.0,
+        "preemptions_total": 1,
+    },
 }
 
 #: --strict acceptance ceilings per scenario (upper bounds: fairness
@@ -190,6 +223,11 @@ STRICT_EQ = {
     },
     "telemetry": {
         "capture_trigger_works": True,
+    },
+    "fullsweep": {
+        # the non-negotiable: chunked plans bit-identical to the
+        # sequential FULL oracle at the benched lane budget
+        "plans_identical": True,
     },
 }
 
